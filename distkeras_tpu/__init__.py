@@ -1,0 +1,48 @@
+"""distkeras_tpu — a TPU-native distributed deep-learning framework.
+
+A from-scratch JAX/XLA rebuild of the capability surface of
+``amoussoubaruch/dist-keras`` (a fork of ``cerndb/dist-keras``): data-parallel
+training with a menu of synchronous and asynchronous optimization schemes
+(DOWNPOUR, AEASGD, EAMSGD, ADAG, DynSGD), a parameter-server runtime, Spark-
+DataFrame-style preprocessing transformers, predictors and evaluators — all
+re-designed TPU-first:
+
+- single-worker forward/backward  -> ``jax.grad`` over a jit-compiled step
+  (reference: distkeras/workers.py -> Worker.train)
+- socket parameter server         -> ICI ``psum`` allreduce for the sync path
+  (reference: distkeras/parameter_servers.py -> SocketParameterServer) and a
+  host-resident, thread/TCP-served PS for the async algorithms
+- Spark mapPartitions launch      -> ``shard_map`` over a ``jax.sharding.Mesh``
+  (reference: distkeras/trainers.py -> DistributedTrainer.train)
+- Spark DataFrame + transformers  -> host-side columnar ``Dataset`` + the same
+  transformer zoo (reference: distkeras/transformers.py)
+"""
+
+__version__ = "0.1.0"
+
+from distkeras_tpu.trainers import (
+    Trainer,
+    SingleTrainer,
+    EnsembleTrainer,
+    AveragingTrainer,
+    DistributedTrainer,
+    AsynchronousDistributedTrainer,
+    SynchronousDistributedTrainer,
+    DOWNPOUR,
+    AEASGD,
+    EAMSGD,
+    ADAG,
+    DynSGD,
+)
+from distkeras_tpu.predictors import ModelPredictor
+from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.transformers import (
+    Transformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    DenseTransformer,
+    ReshapeTransformer,
+    LabelIndexTransformer,
+)
+from distkeras_tpu.models.sequential import Sequential, Model

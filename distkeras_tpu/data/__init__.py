@@ -1,0 +1,19 @@
+"""Host-side data pipeline: columnar Dataset, transformers, loaders.
+
+Replaces the reference's Spark layer (L0/L5): ``Dataset`` stands in for the
+Spark DataFrame, the transformers mirror distkeras/transformers.py, and the
+loaders replace ``spark.read`` + examples' CSV plumbing. Batches are built on
+host as numpy and shipped to devices by the trainers (the trainers own
+device placement/sharding).
+"""
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.transformers import (
+    Transformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    DenseTransformer,
+    ReshapeTransformer,
+    LabelIndexTransformer,
+)
+from distkeras_tpu.data import loaders

@@ -1,0 +1,89 @@
+"""Dataset loaders.
+
+Replaces the examples' ``spark.read.csv`` plumbing (reference:
+examples/mnist.py loads MNIST CSV into a DataFrame). Two tiers:
+
+- ``load_csv`` — real data from disk in the same CSV layout the reference
+  examples consume (label column + flat pixel/feature columns).
+- ``synthetic_*`` — deterministic, *learnable* generated stand-ins (class
+  prototypes + noise) for the sandbox, where no dataset downloads exist.
+  They drive the convergence/integration tests and the benchmark harness.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+def load_csv(path, label_col="label", dtype=np.float32) -> Dataset:
+    """CSV with a header row -> Dataset with 'features' + 'label' columns."""
+    with open(path, newline="") as f:
+        reader = csv.reader(f)
+        header = next(reader)
+        rows = np.asarray([[float(v) for v in row] for row in reader], dtype)
+    if label_col in header:
+        li = header.index(label_col)
+        label = rows[:, li].astype(np.int64)
+        feats = np.delete(rows, li, axis=1)
+    else:
+        label = rows[:, 0].astype(np.int64)
+        feats = rows[:, 1:]
+    return Dataset({"features": feats.astype(dtype), "label": label})
+
+
+def _prototype_classification(
+    n, num_classes, feature_shape, noise, seed, flatten=False
+):
+    """Per-class random prototypes + gaussian noise: separable but nontrivial."""
+    rng = np.random.default_rng(seed)
+    dim = int(np.prod(feature_shape))
+    protos = rng.normal(0.0, 1.0, (num_classes, dim)).astype(np.float32)
+    labels = rng.integers(0, num_classes, n)
+    x = protos[labels] + rng.normal(0.0, noise, (n, dim)).astype(np.float32)
+    # squash into [0, 255] so the MinMax(0..255) pipeline stays meaningful
+    x = (255.0 / (1.0 + np.exp(-x))).astype(np.float32)
+    if not flatten:
+        x = x.reshape(n, *feature_shape)
+    return Dataset({"features": x, "label": labels.astype(np.int64)})
+
+
+def synthetic_mnist(n=8192, noise=1.0, seed=0, flat=True) -> Dataset:
+    """MNIST-shaped: features (784,) in [0,255], labels 0..9."""
+    return _prototype_classification(n, 10, (28, 28, 1), noise, seed, flatten=flat)
+
+
+def synthetic_higgs(n=8192, num_features=30, noise=1.5, seed=1) -> Dataset:
+    """ATLAS-Higgs-shaped binary tabular task with ~30 physics features."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(0.0, 1.0, (num_features,)).astype(np.float32)
+    x = rng.normal(0.0, 1.0, (n, num_features)).astype(np.float32)
+    logits = x @ w + 0.5 * (x[:, 0] * x[:, 1]) + noise * rng.normal(0.0, 1.0, n)
+    label = (logits > 0).astype(np.int64)
+    return Dataset({"features": x, "label": label})
+
+
+def synthetic_cifar10(n=4096, noise=1.0, seed=2) -> Dataset:
+    """CIFAR-shaped: features (32, 32, 3) in [0,255], labels 0..9."""
+    return _prototype_classification(n, 10, (32, 32, 3), noise, seed)
+
+
+def synthetic_imagenet(n=512, num_classes=1000, size=64, noise=0.5, seed=3) -> Dataset:
+    """ImageNet-shaped smoke data (reduced spatial size by default)."""
+    return _prototype_classification(n, num_classes, (size, size, 3), noise, seed)
+
+
+def mnist(path=None, n=8192, seed=0, flat=True) -> Dataset:
+    """Real MNIST CSV if available (path or $DISTKERAS_MNIST_CSV), else synthetic."""
+    path = path or os.environ.get("DISTKERAS_MNIST_CSV")
+    if path and os.path.exists(path):
+        ds = load_csv(path)
+        if not flat:
+            x = ds["features"].reshape(len(ds), 28, 28, 1)
+            ds = ds.with_column("features", x)
+        return ds
+    return synthetic_mnist(n=n, seed=seed, flat=flat)

@@ -1,0 +1,55 @@
+"""Evaluation over Datasets (reference: distkeras/evaluators.py ->
+AccuracyEvaluator.evaluate compares prediction vs label columns)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.ops.losses import get_loss
+
+
+class Evaluator:
+    def evaluate(self, ds: Dataset) -> float:
+        raise NotImplementedError
+
+
+class AccuracyEvaluator(Evaluator):
+    """Fraction of rows where prediction matches the label.
+
+    ``prediction_col`` may hold class ids (from LabelIndexTransformer) or
+    probability vectors (argmax is taken); ``label_col`` may be ids or
+    one-hot.
+    """
+
+    def __init__(self, prediction_col="prediction", label_col="label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, ds: Dataset) -> float:
+        pred = ds[self.prediction_col]
+        if pred.ndim > 1:
+            pred = np.argmax(pred, axis=-1)
+        label = ds[self.label_col]
+        if label.ndim > 1:
+            label = np.argmax(label, axis=-1)
+        return float(np.mean(pred.astype(np.int64) == label.astype(np.int64)))
+
+
+class LossEvaluator(Evaluator):
+    """Mean loss of a prediction column against a (one-hot) label column."""
+
+    def __init__(self, loss="categorical_crossentropy", prediction_col="prediction", label_col="label"):
+        self.loss_fn = get_loss(loss)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, ds: Dataset) -> float:
+        import jax.numpy as jnp
+
+        return float(
+            self.loss_fn(
+                jnp.asarray(ds[self.prediction_col]),
+                jnp.asarray(ds[self.label_col]),
+            )
+        )

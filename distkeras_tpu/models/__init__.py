@@ -1,0 +1,23 @@
+"""Model layer: Keras-like declarative builder over pure JAX functions.
+
+The reference's examples define models in Keras terms (reference:
+examples/mnist.py -> keras.models.Sequential with Dense/Conv2D/Flatten/
+Dropout/Activation). This package provides the same builder vocabulary, but a
+model compiles down to pure ``init``/``apply`` functions over pytree params —
+jit/grad/shard_map-friendly, NHWC layouts, MXU-sized matmuls.
+"""
+
+from distkeras_tpu.models.layers import (
+    Layer,
+    Dense,
+    Conv2D,
+    MaxPool2D,
+    AvgPool2D,
+    GlobalAvgPool2D,
+    Flatten,
+    Dropout,
+    Activation,
+    BatchNorm,
+)
+from distkeras_tpu.models.sequential import Sequential, Model
+from distkeras_tpu.models import zoo

@@ -1,0 +1,369 @@
+"""Functional layer zoo.
+
+Each layer is a declarative config object with two pure methods:
+
+- ``init(rng, in_shape) -> (params, state, out_shape)``
+- ``apply(params, state, x, train, rng) -> (y, new_state)``
+
+``params`` are trainable (a dict pytree), ``state`` is non-trainable (e.g.
+BatchNorm moving stats). Both are empty dicts for stateless layers. All apply
+functions are jit-traceable with static shapes; convolutions use NHWC/HWIO
+layouts so XLA tiles them onto the MXU directly.
+
+Covers the builder vocabulary the reference examples use (reference:
+examples/mnist.py — Dense/Conv2D/MaxPooling2D/Flatten/Dropout/Activation)
+plus BatchNorm and pooling variants needed for the CIFAR/ResNet configs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---------------------------------------------------------------- activations
+
+_ACTIVATIONS = {
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "log_softmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "elu": jax.nn.elu,
+    "leaky_relu": jax.nn.leaky_relu,
+}
+
+
+def get_activation(name):
+    if name is None:
+        return _ACTIVATIONS["linear"]
+    if callable(name):
+        return name
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"unknown activation {name!r}")
+    return _ACTIVATIONS[name]
+
+
+# ------------------------------------------------------------------- registry
+
+_LAYER_REGISTRY = {}
+
+
+def register_layer(cls):
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_config(cfg: dict):
+    cfg = dict(cfg)
+    cls = _LAYER_REGISTRY[cfg.pop("layer")]
+    return cls(**cfg)
+
+
+# ----------------------------------------------------------------------- init
+
+
+def _glorot_uniform(rng, shape, fan_in, fan_out, dtype=jnp.float32):
+    limit = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+# ---------------------------------------------------------------------- base
+
+
+class Layer:
+    """Base declarative layer. Subclasses override init/apply/get_config."""
+
+    def init(self, rng, in_shape):
+        return {}, {}, in_shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return x, state
+
+    def get_config(self) -> dict:
+        return {"layer": type(self).__name__}
+
+    def __repr__(self):
+        cfg = {k: v for k, v in self.get_config().items() if k != "layer"}
+        args = ", ".join(f"{k}={v!r}" for k, v in cfg.items())
+        return f"{type(self).__name__}({args})"
+
+
+# --------------------------------------------------------------------- layers
+
+
+@register_layer
+class Dense(Layer):
+    """y = act(x @ W + b). Matmul-shaped for the MXU: keep units large/batched."""
+
+    def __init__(self, units, activation=None, use_bias=True):
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+
+    def init(self, rng, in_shape):
+        fan_in = in_shape[-1]
+        params = {
+            "kernel": _glorot_uniform(
+                rng, (fan_in, self.units), fan_in, self.units
+            )
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.units,), jnp.float32)
+        return params, {}, (*in_shape[:-1], self.units)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        y = x @ params["kernel"].astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return get_activation(self.activation)(y), state
+
+    def get_config(self):
+        return {
+            "layer": "Dense",
+            "units": self.units,
+            "activation": self.activation,
+            "use_bias": self.use_bias,
+        }
+
+
+@register_layer
+class Conv2D(Layer):
+    """NHWC conv, HWIO kernel — the layout XLA maps onto the MXU."""
+
+    def __init__(
+        self,
+        filters,
+        kernel_size,
+        strides=1,
+        padding="SAME",
+        activation=None,
+        use_bias=True,
+    ):
+        self.filters = int(filters)
+        self.kernel_size = (
+            (kernel_size, kernel_size)
+            if isinstance(kernel_size, int)
+            else tuple(kernel_size)
+        )
+        self.strides = (
+            (strides, strides) if isinstance(strides, int) else tuple(strides)
+        )
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+
+    def init(self, rng, in_shape):
+        kh, kw = self.kernel_size
+        cin = in_shape[-1]
+        fan_in = kh * kw * cin
+        fan_out = kh * kw * self.filters
+        params = {
+            "kernel": _glorot_uniform(
+                rng, (kh, kw, cin, self.filters), fan_in, fan_out
+            )
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), jnp.float32)
+        out_shape = jax.eval_shape(
+            lambda x, k: self._conv(x, k),
+            jax.ShapeDtypeStruct((1, *in_shape), jnp.float32),
+            jax.ShapeDtypeStruct(params["kernel"].shape, jnp.float32),
+        ).shape[1:]
+        return params, {}, out_shape
+
+    def _conv(self, x, kernel):
+        return lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=self.strides,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    def apply(self, params, state, x, train=False, rng=None):
+        y = self._conv(x, params["kernel"].astype(x.dtype))
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return get_activation(self.activation)(y), state
+
+    def get_config(self):
+        return {
+            "layer": "Conv2D",
+            "filters": self.filters,
+            "kernel_size": list(self.kernel_size),
+            "strides": list(self.strides),
+            "padding": self.padding,
+            "activation": self.activation,
+            "use_bias": self.use_bias,
+        }
+
+
+class _Pool2D(Layer):
+    def __init__(self, pool_size=2, strides=None, padding="VALID"):
+        self.pool_size = (
+            (pool_size, pool_size)
+            if isinstance(pool_size, int)
+            else tuple(pool_size)
+        )
+        strides = strides if strides is not None else self.pool_size
+        self.strides = (
+            (strides, strides) if isinstance(strides, int) else tuple(strides)
+        )
+        self.padding = padding
+
+    def init(self, rng, in_shape):
+        out = jax.eval_shape(
+            lambda x: self.apply({}, {}, x)[0],
+            jax.ShapeDtypeStruct((1, *in_shape), jnp.float32),
+        ).shape[1:]
+        return {}, {}, out
+
+    def _window(self, x, init, op):
+        return lax.reduce_window(
+            x,
+            init,
+            op,
+            window_dimensions=(1, *self.pool_size, 1),
+            window_strides=(1, *self.strides, 1),
+            padding=self.padding,
+        )
+
+    def get_config(self):
+        return {
+            "layer": type(self).__name__,
+            "pool_size": list(self.pool_size),
+            "strides": list(self.strides),
+            "padding": self.padding,
+        }
+
+
+@register_layer
+class MaxPool2D(_Pool2D):
+    def apply(self, params, state, x, train=False, rng=None):
+        return self._window(x, -jnp.inf, lax.max), state
+
+
+@register_layer
+class AvgPool2D(_Pool2D):
+    def apply(self, params, state, x, train=False, rng=None):
+        s = self._window(x, 0.0, lax.add)
+        return s / (self.pool_size[0] * self.pool_size[1]), state
+
+
+@register_layer
+class GlobalAvgPool2D(Layer):
+    def init(self, rng, in_shape):
+        return {}, {}, (in_shape[-1],)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+@register_layer
+class Flatten(Layer):
+    def init(self, rng, in_shape):
+        size = 1
+        for d in in_shape:
+            size *= d
+        return {}, {}, (size,)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@register_layer
+class Dropout(Layer):
+    """Inverted dropout; identity in eval mode. Needs an rng when train=True."""
+
+    def __init__(self, rate):
+        self.rate = float(rate)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout.apply(train=True) requires an rng")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state
+
+    def get_config(self):
+        return {"layer": "Dropout", "rate": self.rate}
+
+
+@register_layer
+class Activation(Layer):
+    def __init__(self, activation):
+        self.activation = activation
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return get_activation(self.activation)(x), state
+
+    def get_config(self):
+        return {"layer": "Activation", "activation": self.activation}
+
+
+@register_layer
+class BatchNorm(Layer):
+    """Batch normalization over all but the channel axis.
+
+    Train mode normalizes with batch statistics and updates moving stats in
+    ``state``; eval mode uses the moving stats. Functional state threading —
+    no in-place mutation — keeps this jit/shard_map-safe. Under the sync
+    data-parallel trainer, batch stats are per-shard (the common large-batch
+    approximation); the moving stats that ship home are the mean over shards.
+    """
+
+    def __init__(self, momentum=0.99, epsilon=1e-5, scale=True, center=True):
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+        self.scale = bool(scale)
+        self.center = bool(center)
+
+    def init(self, rng, in_shape):
+        c = in_shape[-1]
+        params = {}
+        if self.scale:
+            params["gamma"] = jnp.ones((c,), jnp.float32)
+        if self.center:
+            params["beta"] = jnp.zeros((c,), jnp.float32)
+        state = {
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32),
+        }
+        return params, state, in_shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            m = self.momentum
+            new_state = {
+                "mean": m * state["mean"] + (1 - m) * mean,
+                "var": m * state["var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.epsilon)
+        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+        if self.scale:
+            y = y * params["gamma"].astype(x.dtype)
+        if self.center:
+            y = y + params["beta"].astype(x.dtype)
+        return y, new_state
+
+    def get_config(self):
+        return {
+            "layer": "BatchNorm",
+            "momentum": self.momentum,
+            "epsilon": self.epsilon,
+            "scale": self.scale,
+            "center": self.center,
+        }

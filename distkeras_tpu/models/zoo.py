@@ -1,0 +1,149 @@
+"""Model zoo: the architectures behind the five BASELINE configs.
+
+1. ``mnist_mlp``   — SingleTrainer anchor (reference: examples/mnist.py MLP)
+2. ``mnist_cnn``   — DOWNPOUR config and the north-star benchmark model
+3. ``higgs_mlp``   — AEASGD ATLAS-Higgs tabular classifier
+   (reference: examples/workflow.ipynb)
+4. ``cifar10_cnn`` — ADAG config
+5. ``resnet18``    — DynSGD / ImageNet scale config
+
+All NHWC, float32 params; trainers may run compute in bfloat16.
+"""
+
+from __future__ import annotations
+
+from distkeras_tpu.models.layers import (
+    Activation,
+    AvgPool2D,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    GlobalAvgPool2D,
+    MaxPool2D,
+)
+from distkeras_tpu.models.sequential import Residual, Sequential
+
+
+def mnist_mlp(hidden=500, num_classes=10, seed=0):
+    """MLP over flattened 28x28 inputs (input shape (784,))."""
+    return Sequential(
+        [
+            Dense(hidden, activation="relu"),
+            Dense(hidden, activation="relu"),
+            Dense(num_classes, activation="softmax"),
+        ]
+    ).build((784,), seed=seed)
+
+
+def mnist_cnn(num_classes=10, seed=0):
+    """Small convnet over (28, 28, 1) images — the north-star bench model."""
+    return Sequential(
+        [
+            Conv2D(32, 3, activation="relu", padding="SAME"),
+            Conv2D(32, 3, activation="relu", padding="SAME"),
+            MaxPool2D(2),
+            Conv2D(64, 3, activation="relu", padding="SAME"),
+            Conv2D(64, 3, activation="relu", padding="SAME"),
+            MaxPool2D(2),
+            Flatten(),
+            Dense(256, activation="relu"),
+            Dropout(0.5),
+            Dense(num_classes, activation="softmax"),
+        ]
+    ).build((28, 28, 1), seed=seed)
+
+
+def higgs_mlp(num_features=30, hidden=600, num_classes=2, seed=0):
+    """ATLAS-Higgs-style tabular classifier (wide MLP over ~30 features)."""
+    return Sequential(
+        [
+            Dense(hidden, activation="relu"),
+            Dropout(0.3),
+            Dense(hidden, activation="relu"),
+            Dropout(0.3),
+            Dense(num_classes, activation="softmax"),
+        ]
+    ).build((num_features,), seed=seed)
+
+
+def cifar10_cnn(num_classes=10, seed=0):
+    """VGG-ish convnet over (32, 32, 3)."""
+    return Sequential(
+        [
+            Conv2D(64, 3, padding="SAME", use_bias=False),
+            BatchNorm(),
+            Activation("relu"),
+            Conv2D(64, 3, padding="SAME", use_bias=False),
+            BatchNorm(),
+            Activation("relu"),
+            MaxPool2D(2),
+            Conv2D(128, 3, padding="SAME", use_bias=False),
+            BatchNorm(),
+            Activation("relu"),
+            Conv2D(128, 3, padding="SAME", use_bias=False),
+            BatchNorm(),
+            Activation("relu"),
+            MaxPool2D(2),
+            Flatten(),
+            Dense(256, activation="relu"),
+            Dropout(0.5),
+            Dense(num_classes, activation="softmax"),
+        ]
+    ).build((32, 32, 3), seed=seed)
+
+
+def _basic_block(filters, stride=1, downsample=False):
+    shortcut = (
+        [Conv2D(filters, 1, strides=stride, padding="SAME", use_bias=False), BatchNorm()]
+        if downsample
+        else None
+    )
+    return Residual(
+        [
+            Conv2D(filters, 3, strides=stride, padding="SAME", use_bias=False),
+            BatchNorm(),
+            Activation("relu"),
+            Conv2D(filters, 3, padding="SAME", use_bias=False),
+            BatchNorm(),
+        ],
+        shortcut=shortcut,
+        activation="relu",
+    )
+
+
+def resnet18(num_classes=1000, input_shape=(224, 224, 3), small_stem=False, seed=0):
+    """ResNet-18 (NHWC). ``small_stem=True`` swaps the 7x7/s2+maxpool stem for
+    a 3x3/s1 stem, the standard CIFAR-scale variant used in smoke tests."""
+    stem = (
+        [Conv2D(64, 3, strides=1, padding="SAME", use_bias=False), BatchNorm(), Activation("relu")]
+        if small_stem
+        else [
+            Conv2D(64, 7, strides=2, padding="SAME", use_bias=False),
+            BatchNorm(),
+            Activation("relu"),
+            MaxPool2D(3, strides=2, padding="SAME"),
+        ]
+    )
+    body = [
+        _basic_block(64),
+        _basic_block(64),
+        _basic_block(128, stride=2, downsample=True),
+        _basic_block(128),
+        _basic_block(256, stride=2, downsample=True),
+        _basic_block(256),
+        _basic_block(512, stride=2, downsample=True),
+        _basic_block(512),
+    ]
+    head = [GlobalAvgPool2D(), Dense(num_classes, activation="softmax")]
+    return Sequential(stem + body + head).build(input_shape, seed=seed)
+
+
+ZOO = {
+    "mnist_mlp": mnist_mlp,
+    "mnist_cnn": mnist_cnn,
+    "higgs_mlp": higgs_mlp,
+    "cifar10_cnn": cifar10_cnn,
+    "resnet18": resnet18,
+}

@@ -1,0 +1,63 @@
+"""Socket networking primitives for the cross-host (DCN) parameter-server path.
+
+Behavioral equivalent of the reference's entire communication backend
+(reference: distkeras/networking.py -> determine_host_address / connect /
+send_data / recv_data): length-prefixed messages over TCP with Nagle
+disabled. Two deliberate upgrades over the reference:
+
+- payloads are serialized with the pytree/npz codec from
+  ``utils.serialization`` (no pickled code objects on the wire), and
+- an 8-byte big-endian length prefix replaces pickle-stream framing, so a
+  message is one contiguous read.
+
+Within one host, trainers never touch sockets — workers share the PS object
+in-process. Sockets are only the DCN transport between hosts, where the
+reference used them for everything.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+_LEN = struct.Struct(">Q")
+
+
+def determine_host_address() -> str:
+    """Best-effort externally visible address of this host."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def connect(host: str, port: int, timeout=30.0) -> socket.socket:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def send_data(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_data(sock: socket.socket) -> bytes:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    return _recv_exact(sock, length)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(1 << 20, n - got))
+        if not chunk:
+            raise ConnectionError("socket closed mid-message")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)

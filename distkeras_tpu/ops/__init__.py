@@ -1,0 +1,5 @@
+"""Compute ops: losses, metrics, optimizer resolution, fused/Pallas kernels."""
+
+from distkeras_tpu.ops.losses import get_loss, categorical_crossentropy, mse
+from distkeras_tpu.ops.metrics import accuracy
+from distkeras_tpu.ops.optimizers import get_optimizer

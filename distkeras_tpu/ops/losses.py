@@ -1,0 +1,70 @@
+"""Loss functions, resolvable by Keras-style string names.
+
+The reference passes loss names straight through to Keras ``model.compile``
+(reference: distkeras/workers.py -> Worker.prepare_model compiles with the
+trainer's ``loss`` kwarg). Same contract here: trainers accept either a name
+or a callable ``loss(y_pred, y_true) -> scalar``.
+
+Cross-entropy takes softmax *probabilities* (the zoo models end in softmax,
+like the reference's Keras models) and is computed via clipped log for
+numerical safety; models emitting logits can use the ``*_from_logits`` forms.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import nn
+
+_EPS = 1e-7
+
+
+def categorical_crossentropy(y_pred, y_true):
+    """Mean CE; y_pred = probabilities (B, C); y_true = one-hot (B, C)."""
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    return -jnp.mean(jnp.sum(y_true * jnp.log(p), axis=-1))
+
+
+def categorical_crossentropy_from_logits(y_pred, y_true):
+    return -jnp.mean(jnp.sum(y_true * nn.log_softmax(y_pred, axis=-1), axis=-1))
+
+
+def sparse_categorical_crossentropy(y_pred, y_true):
+    """y_true = integer class ids (B,)."""
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    ll = jnp.take_along_axis(
+        jnp.log(p), y_true.astype(jnp.int32)[:, None], axis=-1
+    )
+    return -jnp.mean(ll)
+
+
+def binary_crossentropy(y_pred, y_true):
+    p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+
+
+def mse(y_pred, y_true):
+    return jnp.mean((y_pred - y_true) ** 2)
+
+
+def mae(y_pred, y_true):
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+_LOSSES = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "categorical_crossentropy_from_logits": categorical_crossentropy_from_logits,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mse,
+    "mean_squared_error": mse,
+    "mae": mae,
+    "mean_absolute_error": mae,
+}
+
+
+def get_loss(name):
+    if callable(name):
+        return name
+    if name not in _LOSSES:
+        raise ValueError(f"unknown loss {name!r}; known: {sorted(_LOSSES)}")
+    return _LOSSES[name]

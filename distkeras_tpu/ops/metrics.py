@@ -1,0 +1,23 @@
+"""Metrics (reference: distkeras/evaluators.py computes accuracy driver-side)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy(y_pred, y_true):
+    """Fraction of argmax matches. y_true may be one-hot or integer ids."""
+    pred_ids = jnp.argmax(y_pred, axis=-1)
+    true_ids = y_true if y_true.ndim == y_pred.ndim - 1 else jnp.argmax(y_true, axis=-1)
+    return jnp.mean((pred_ids == true_ids).astype(jnp.float32))
+
+
+_METRICS = {"accuracy": accuracy, "acc": accuracy}
+
+
+def get_metric(name):
+    if callable(name):
+        return name
+    if name not in _METRICS:
+        raise ValueError(f"unknown metric {name!r}")
+    return _METRICS[name]

@@ -1,0 +1,62 @@
+"""Worker-optimizer resolution (Keras-style names -> optax transforms).
+
+The reference passes a ``worker_optimizer`` string/object through to Keras
+``model.compile`` (reference: distkeras/trainers.py -> Trainer.__init__,
+distkeras/workers.py -> Worker.prepare_model). Here the same kwarg resolves
+to an ``optax.GradientTransformation``; callables and ready-made optax
+transforms pass through untouched.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def _sgd(learning_rate=0.01, momentum=0.0, nesterov=False):
+    if momentum:
+        return optax.sgd(learning_rate, momentum=momentum, nesterov=nesterov)
+    return optax.sgd(learning_rate)
+
+
+_OPTIMIZERS = {
+    "sgd": _sgd,
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "adagrad": optax.adagrad,
+    "adadelta": optax.adadelta,
+    "rmsprop": optax.rmsprop,
+    "nadam": optax.nadam,
+    "lamb": optax.lamb,
+}
+
+_DEFAULT_LR = {"sgd": 0.01, "adam": 1e-3, "adamw": 1e-3, "adagrad": 1e-2,
+               "adadelta": 1e-3, "rmsprop": 1e-3, "nadam": 1e-3, "lamb": 1e-3}
+
+
+def effective_learning_rate(name, learning_rate=None) -> float:
+    """The lr the resolved optimizer will actually run with.
+
+    Algorithms whose PS/elastic rules scale by the learning rate (AEASGD's
+    alpha = rho*lr, ADAG's commit -lr/W) must use the same value the local
+    optimizer steps with. For callables/ready-made transforms the lr cannot
+    be introspected; fall back to 0.01 (callers should pass learning_rate
+    explicitly in that case).
+    """
+    if learning_rate is not None:
+        return float(learning_rate)
+    if isinstance(name, str) and name.lower() in _DEFAULT_LR:
+        return _DEFAULT_LR[name.lower()]
+    return 0.01
+
+
+def get_optimizer(name, learning_rate=None, **kwargs):
+    """Resolve a name/transform to an optax GradientTransformation."""
+    if isinstance(name, optax.GradientTransformation):
+        return name
+    if callable(name):
+        return name(learning_rate, **kwargs) if learning_rate is not None else name(**kwargs)
+    key = str(name).lower()
+    if key not in _OPTIMIZERS:
+        raise ValueError(f"unknown optimizer {name!r}; known: {sorted(_OPTIMIZERS)}")
+    lr = learning_rate if learning_rate is not None else _DEFAULT_LR[key]
+    return _OPTIMIZERS[key](lr, **kwargs)

@@ -1,0 +1,16 @@
+"""Parallelism layer: mesh construction, sharding placement, collectives.
+
+This is the TPU-native replacement for the reference's cluster runtime
+(Spark executor placement + the star-topology socket fabric, reference:
+distkeras/networking.py). Sync data-parallel traffic rides ICI via XLA
+collectives inside compiled programs; the async PS path stays on host.
+"""
+
+from distkeras_tpu.parallel.mesh import (
+    make_mesh,
+    local_devices,
+    replicated_sharding,
+    batch_sharding,
+    shard_batch,
+    replicate,
+)

@@ -1,0 +1,69 @@
+"""Device mesh + sharding placement helpers.
+
+The sync trainer's entire communication story (replacing the reference's
+pull/commit socket protocol, reference: distkeras/parameter_servers.py ->
+SocketParameterServer) is: params replicated over a 1-D ``Mesh(("data",))``,
+batches sharded along "data", loss averaged over the global batch inside
+``jit`` — XLA inserts the gradient ``psum`` over ICI automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def local_devices(n=None):
+    devs = jax.devices()
+    if n is None:
+        return devs
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return devs[:n]
+
+
+def make_mesh(num_devices=None, axis_names=("data",), devices=None) -> Mesh:
+    """1-D (default) or n-D mesh over the first ``num_devices`` devices."""
+    devs = devices if devices is not None else local_devices(num_devices)
+    n = len(devs)
+    if len(axis_names) == 1:
+        shape = (n,)
+    else:
+        # factor n into len(axis_names) axes, largest-first
+        shape = []
+        rem = n
+        for _ in axis_names[:-1]:
+            f = _largest_factor(rem)
+            shape.append(f)
+            rem //= f
+        shape.append(rem)
+        shape = tuple(shape)
+    return Mesh(np.array(devs).reshape(shape), axis_names)
+
+
+def _largest_factor(n):
+    for f in range(int(n**0.5), 0, -1):
+        if n % f == 0:
+            return max(f, n // f)
+    return n
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(batch: dict, mesh: Mesh, axis: str = "data"):
+    """Place a host batch dict on the mesh, split along the leading dim."""
+    sh = batch_sharding(mesh, axis)
+    return {k: jax.device_put(v, sh) for k, v in batch.items()}
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate a pytree (params/opt state) across the mesh."""
+    sh = replicated_sharding(mesh)
+    return jax.device_put(tree, sh)

@@ -1,0 +1,258 @@
+"""Parameter servers for the asynchronous trainers.
+
+Re-creation of the reference's PS runtime (reference:
+distkeras/parameter_servers.py -> ParameterServer / SocketParameterServer /
+DeltaParameterServer / ADAGParameterServer / DynSGDParameterServer) with the
+same pull/commit verbs and per-algorithm commit rules, re-homed for TPU:
+
+- The center variable is a host-resident pytree (numpy leaves — commits are
+  in-place host adds, no device round-trip).
+- In-process workers (threads driving per-chip windows) call ``pull`` /
+  ``commit`` directly under a lock — the single-host fast path.
+- ``SocketParameterServer`` serves the same PS object over TCP for
+  cross-host (DCN) workers, with the reference's one-byte action protocol:
+  b"p" pull, b"c" commit, b"s" stop.
+
+Every commit rule is also exposed as a pure function
+(``center', meta' = RULE(center, meta, delta, tag)``) so tests can assert
+staleness/normalization semantics exactly (SURVEY §7.4).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+
+import jax
+import numpy as np
+
+from distkeras_tpu import networking
+from distkeras_tpu.utils.serialization import deserialize_params, serialize_params
+
+
+def _to_host(tree):
+    return jax.tree.map(lambda a: np.asarray(a, dtype=np.float32), tree)
+
+
+# --------------------------------------------------------------------- rules
+# Pure commit rules (testable without threads; reference: §4.2/§4.3 semantics)
+
+
+def delta_rule(center, meta, delta, tag=None):
+    """center += delta (DOWNPOUR / AEASGD / EAMSGD / ADAG commits)."""
+    new_center = jax.tree.map(lambda c, d: c + np.asarray(d), center, delta)
+    meta = dict(meta)
+    meta["num_updates"] = meta.get("num_updates", 0) + 1
+    return new_center, meta
+
+
+def dynsgd_rule(center, meta, delta, tag):
+    """Staleness-aware: center += delta / (staleness + 1).
+
+    ``tag`` is the update counter the worker saw at pull time; staleness is
+    how many commits landed since (reference: distkeras/parameter_servers.py
+    -> DynSGDParameterServer.handle_commit).
+    """
+    meta = dict(meta)
+    version = meta.get("version", 0)
+    staleness = max(0, version - int(tag))
+    scale = 1.0 / (staleness + 1.0)
+    new_center = jax.tree.map(
+        lambda c, d: c + scale * np.asarray(d), center, delta
+    )
+    meta["version"] = version + 1
+    meta["num_updates"] = meta.get("num_updates", 0) + 1
+    return new_center, meta
+
+
+# -------------------------------------------------------------------- servers
+
+
+class ParameterServer:
+    """Base PS: owns the center pytree + update counter under one lock."""
+
+    commit_rule = staticmethod(delta_rule)
+
+    def __init__(self, params):
+        self._center = _to_host(params)
+        self._meta = {"num_updates": 0}
+        self._lock = threading.Lock()
+        self.stopped = threading.Event()
+
+    # -- protocol verbs -----------------------------------------------------
+
+    def pull(self):
+        """Return (copy of center, tag). Tag is None unless versioned."""
+        with self._lock:
+            center = jax.tree.map(np.copy, self._center)
+            tag = self._pull_tag()
+        return center, tag
+
+    def commit(self, delta, tag=None):
+        with self._lock:
+            self._center, self._meta = type(self).commit_rule(
+                self._center, self._meta, delta, tag
+            )
+
+    def _pull_tag(self):
+        return None
+
+    # -- lifecycle / results ------------------------------------------------
+
+    def start(self):
+        self.stopped.clear()
+
+    def stop(self):
+        self.stopped.set()
+
+    def get_params(self):
+        with self._lock:
+            return jax.tree.map(np.copy, self._center)
+
+    def reset(self, params):
+        with self._lock:
+            self._center = _to_host(params)
+
+    @property
+    def num_updates(self) -> int:
+        with self._lock:
+            return self._meta.get("num_updates", 0)
+
+
+class DeltaParameterServer(ParameterServer):
+    """center += delta — serves DOWNPOUR / AEASGD / EAMSGD."""
+
+    commit_rule = staticmethod(delta_rule)
+
+
+class ADAGParameterServer(ParameterServer):
+    """Applies accumulated-gradient-normalized deltas.
+
+    The normalization (divide the accumulated gradient by the window length)
+    happens worker-side (reference: Hermans' AGN; distkeras/workers.py ->
+    ADAGWorker), so the server-side rule is the plain delta add; the class
+    exists for parity and for server-side instrumentation.
+    """
+
+    commit_rule = staticmethod(delta_rule)
+
+
+class DynSGDParameterServer(ParameterServer):
+    """Versioned PS: pull returns the update counter; commits are scaled by
+    1/(staleness+1)."""
+
+    commit_rule = staticmethod(dynsgd_rule)
+
+    def __init__(self, params):
+        super().__init__(params)
+        self._meta["version"] = 0
+
+    def _pull_tag(self):
+        return self._meta.get("version", 0)
+
+
+# ------------------------------------------------------- socket (DCN) serving
+
+
+class SocketParameterServer:
+    """Serves a ParameterServer over TCP for cross-host workers.
+
+    Protocol (reference: distkeras/parameter_servers.py ->
+    SocketParameterServer.run): connection sends a 1-byte action —
+    b"p": pull -> reply with serialized (center, tag);
+    b"c": commit -> payload of serialized (delta, tag), reply b"k";
+    b"s": stop the server.
+    One thread per connection; commits serialize on the PS lock.
+    """
+
+    def __init__(self, ps: ParameterServer, host="0.0.0.0", port=0):
+        self.ps = ps
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = None
+        self._conn_threads = []
+        self._running = threading.Event()
+
+    def start(self):
+        self.ps.start()
+        self._running.set()
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        self._listener.settimeout(0.2)
+        while self._running.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._conn_threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while self._running.is_set():
+                action = conn.recv(1)
+                if not action:
+                    break
+                if action == b"p":
+                    center, tag = self.ps.pull()
+                    networking.send_data(
+                        conn, pickle.dumps((serialize_params(center), tag))
+                    )
+                elif action == b"c":
+                    blob, tag = pickle.loads(networking.recv_data(conn))
+                    self.ps.commit(deserialize_params(blob), tag)
+                    conn.sendall(b"k")
+                elif action == b"s":
+                    self.stop()
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._running.clear()
+        self.ps.stop()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class RemoteParameterServerClient:
+    """Worker-side proxy speaking the socket protocol; drop-in for a local PS."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = networking.connect(host, port)
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            self._sock.sendall(b"p")
+            blob, tag = pickle.loads(networking.recv_data(self._sock))
+        return deserialize_params(blob), tag
+
+    def commit(self, delta, tag=None):
+        payload = pickle.dumps((serialize_params(_to_host(delta)), tag))
+        with self._lock:
+            self._sock.sendall(b"c")
+            networking.send_data(self._sock, payload)
+            ack = self._sock.recv(1)
+        if ack != b"k":
+            raise ConnectionError("commit not acknowledged")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

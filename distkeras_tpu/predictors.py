@@ -1,0 +1,48 @@
+"""Inference over Datasets (reference: distkeras/predictors.py ->
+ModelPredictor.predict appends a prediction column via mapPartitions).
+
+Here prediction is a jit-compiled batched forward pass; the ragged final
+batch is padded to the batch size so XLA sees one static shape (one compile).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+class Predictor:
+    def predict(self, ds: Dataset) -> Dataset:
+        raise NotImplementedError
+
+
+class ModelPredictor(Predictor):
+    def __init__(
+        self,
+        model,
+        features_col="features",
+        output_col="prediction",
+        batch_size=1024,
+    ):
+        self.model = model
+        self.features_col = features_col
+        self.output_col = output_col
+        self.batch_size = int(batch_size)
+        self._fn = jax.jit(
+            lambda p, s, x: self.model.apply(p, s, x, train=False)[0]
+        )
+
+    def predict(self, ds: Dataset) -> Dataset:
+        x = ds[self.features_col]
+        n = len(x)
+        outs = []
+        for i in range(0, n, self.batch_size):
+            chunk = x[i : i + self.batch_size]
+            pad = self.batch_size - len(chunk)
+            if pad:
+                chunk = np.concatenate([chunk, np.zeros((pad, *chunk.shape[1:]), chunk.dtype)])
+            y = np.asarray(self._fn(self.model.params, self.model.state, chunk))
+            outs.append(y[: self.batch_size - pad] if pad else y)
+        return ds.with_column(self.output_col, np.concatenate(outs, axis=0))

@@ -1,0 +1,592 @@
+"""Trainer orchestration — the core public API.
+
+Rebuild of the reference's trainer zoo (reference: distkeras/trainers.py ->
+Trainer / SingleTrainer / EnsembleTrainer / AveragingTrainer /
+DistributedTrainer / AsynchronousDistributedTrainer / DOWNPOUR / AEASGD /
+EAMSGD / ADAG / DynSGD), same constructor vocabulary
+(``worker_optimizer``, ``loss``, ``num_workers``, ``batch_size``,
+``communication_window``, ``rho``, ``learning_rate``, ``num_epoch``) and the
+same contract: ``trainer.train(dataset) -> trained Model``.
+
+TPU-native mapping (SURVEY §7.1):
+
+- Spark ``mapPartitionsWithIndex`` worker launch -> per-device workers over a
+  ``jax.sharding.Mesh`` (threads for true asynchrony, or a seeded
+  deterministic simulator for reproducible staleness in tests);
+- the socket PS star topology -> in-process host-resident PS (optionally
+  served over TCP for cross-host DCN workers);
+- NEW first-class ``SynchronousDistributedTrainer``: per-step allreduce data
+  parallelism — params replicated, batch sharded along ``Mesh(("data",))``,
+  XLA inserts the gradient ``psum`` over ICI (this is the path the
+  north-star benchmarks).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from distkeras_tpu.ops.optimizers import effective_learning_rate, get_optimizer
+from distkeras_tpu.parallel.mesh import (
+    batch_sharding,
+    local_devices,
+    make_mesh,
+    replicate,
+)
+from distkeras_tpu.parameter_servers import (
+    ADAGParameterServer,
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    SocketParameterServer,
+)
+from distkeras_tpu.utils.history import TrainingHistory
+from distkeras_tpu.utils.serialization import serialize_model
+from distkeras_tpu.utils.tree import host_copy, tree_mean
+from distkeras_tpu.workers import (
+    ADAGWorker,
+    AEASGDWorker,
+    AsyncWorker,
+    DOWNPOURWorker,
+    DynSGDWorker,
+    EAMSGDWorker,
+    SingleTrainerWorker,
+    WorkerCore,
+    _metrics_to_records,
+    stack_window,
+)
+
+
+class Trainer:
+    """Base trainer: model + optimizer/loss spec + history bookkeeping
+    (reference: distkeras/trainers.py -> Trainer)."""
+
+    def __init__(
+        self,
+        model,
+        worker_optimizer="sgd",
+        loss="categorical_crossentropy",
+        metrics=("accuracy",),
+        learning_rate=None,
+        features_col="features",
+        label_col="label",
+        batch_size=32,
+        num_epoch=1,
+        seed=0,
+        compute_dtype=None,
+    ):
+        if model.params is None:
+            raise ValueError("model must be built (call model.build(input_shape))")
+        self.model = model
+        # the lr the optimizer actually runs with — PS/elastic rules that
+        # scale by lr (AEASGD, ADAG) must see the same value
+        self.learning_rate = effective_learning_rate(worker_optimizer, learning_rate)
+        self.worker_optimizer = worker_optimizer
+        self.optimizer = get_optimizer(worker_optimizer, learning_rate)
+        self.loss = loss
+        self.metrics = tuple(metrics)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.seed = int(seed)
+        self.compute_dtype = compute_dtype
+        self.history = TrainingHistory()
+
+    def _make_core(self, optimizer=None) -> WorkerCore:
+        return WorkerCore(
+            self.model,
+            optimizer or self.optimizer,
+            self.loss,
+            metrics=self.metrics,
+            compute_dtype=self.compute_dtype,
+        )
+
+    def _finish(self, params, state=None):
+        """Produce the result model (trained weights on a copy)."""
+        result = self.model.copy()
+        result.params = jax.tree.map(np.asarray, params)
+        if state is not None:
+            result.state = jax.tree.map(np.asarray, state)
+        return result
+
+    # -- bookkeeping parity -------------------------------------------------
+
+    def get_history(self, worker_id=None):
+        return self.history.get_history(worker_id)
+
+    def get_training_time(self):
+        return self.history.get_training_time()
+
+    def get_averaged_metrics(self):
+        return self.history.averages()
+
+    def serialize(self) -> bytes:
+        return serialize_model(self.model)
+
+    def train(self, dataset, shuffle=False):
+        raise NotImplementedError
+
+
+class SingleTrainer(Trainer):
+    """One worker, one device — the correctness anchor (reference:
+    distkeras/trainers.py -> SingleTrainer; BASELINE config 1)."""
+
+    def __init__(self, *args, window=8, device=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.window = int(window)
+        self.device = device
+
+    def train(self, dataset, shuffle=False):
+        self.history.record_training_start()
+        core = self._make_core()
+        worker = SingleTrainerWorker(
+            core,
+            self.features_col,
+            self.label_col,
+            seed=self.seed,
+            device=self.device,
+        )
+        params, state, records = worker.train(
+            dataset,
+            self.batch_size,
+            num_epoch=self.num_epoch,
+            window=self.window,
+            shuffle_seed=self.seed if shuffle else None,
+        )
+        self.history.extend(0, records)
+        self.history.record_training_end()
+        return self._finish(params, state)
+
+
+class SynchronousDistributedTrainer(Trainer):
+    """Per-step allreduce data parallelism over a device mesh.
+
+    The batch (``batch_size`` per worker, ``batch_size * num_workers``
+    global) is sharded along the "data" mesh axis; params/opt state are
+    replicated; the global-mean loss makes XLA emit the gradient ``psum``
+    over ICI inside the compiled step. This replaces the reference's
+    pull/commit protocol entirely for the synchronous path [BASELINE
+    north-star]. Windows of W steps are scanned inside one XLA program.
+    """
+
+    def __init__(self, *args, num_workers=None, window=8, mesh=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.mesh = mesh if mesh is not None else make_mesh(num_workers)
+        self.num_workers = int(self.mesh.devices.size)
+        self.window = int(window)
+
+    def train(self, dataset, shuffle=False):
+        self.history.record_training_start()
+        core = self._make_core()
+        global_batch = self.batch_size * self.num_workers
+
+        params = replicate(host_copy(self.model.params), self.mesh)
+        state = replicate(host_copy(self.model.state), self.mesh)
+        opt_state = replicate(core.init_opt_state(params), self.mesh)
+        rng = jax.random.PRNGKey(self.seed)
+        data_sh = batch_sharding(self.mesh)
+        cols = [self.features_col, self.label_col]
+
+        def run_window(params, state, opt_state, rng, batches):
+            xs, ys = stack_window(batches, self.features_col, self.label_col)
+            xs = jax.device_put(xs, data_sh.update(spec=(None, "data")))
+            ys = jax.device_put(ys, data_sh.update(spec=(None, "data")))
+            params, state, opt_state, rng, mets = core.window(
+                params, state, opt_state, rng, xs, ys
+            )
+            self.history.extend(0, _metrics_to_records(mets))
+            return params, state, opt_state, rng
+
+        for epoch in range(self.num_epoch):
+            ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
+            pend = []
+            for batch in ds.batches(global_batch, columns=cols):
+                pend.append(batch)
+                if len(pend) == self.window:
+                    params, state, opt_state, rng = run_window(
+                        params, state, opt_state, rng, pend
+                    )
+                    pend = []
+            if pend:
+                params, state, opt_state, rng = run_window(
+                    params, state, opt_state, rng, pend
+                )
+
+        self.history.record_training_end()
+        return self._finish(params, state)
+
+
+class EnsembleTrainer(Trainer):
+    """Train ``num_models`` independent models on disjoint partitions; return
+    the list (reference: distkeras/trainers.py -> EnsembleTrainer)."""
+
+    def __init__(self, *args, num_models=2, window=8, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_models = int(num_models)
+        self.window = int(window)
+
+    def train(self, dataset, shuffle=False):
+        self.history.record_training_start()
+        parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
+            self.num_models
+        )
+        devices = local_devices()
+        results = [None] * self.num_models
+
+        core = self._make_core()
+
+        def run(i):
+            # independent init per ensemble member, shared compiled core
+            model_i = self.model.copy()
+            model_i.build(self.model.input_shape, seed=self.seed + i)
+            worker = SingleTrainerWorker(
+                core,
+                self.features_col,
+                self.label_col,
+                seed=self.seed + i,
+                device=devices[i % len(devices)],
+            )
+            params, state, records = worker.train(
+                parts[i],
+                self.batch_size,
+                num_epoch=self.num_epoch,
+                window=self.window,
+                initial=(model_i.params, model_i.state),
+            )
+            self.history.extend(i, records)
+            model_i.params = jax.tree.map(np.asarray, params)
+            model_i.state = jax.tree.map(np.asarray, state)
+            results[i] = model_i
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(self.num_models)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.history.record_training_end()
+        return results
+
+
+class AveragingTrainer(Trainer):
+    """Per epoch: train a replica per partition from the current center, then
+    average the replicas' weights (reference: distkeras/trainers.py ->
+    AveragingTrainer)."""
+
+    def __init__(self, *args, num_workers=2, window=8, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_workers = int(num_workers)
+        self.window = int(window)
+
+    def train(self, dataset, shuffle=False):
+        self.history.record_training_start()
+        core = self._make_core()
+        parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
+            self.num_workers
+        )
+        devices = local_devices()
+        center = host_copy(self.model.params)
+        state = host_copy(self.model.state)
+
+        for epoch in range(self.num_epoch):
+            results = [None] * self.num_workers
+
+            def run(i, center=center, state=state):
+                dev = devices[i % len(devices)]
+                params_i = jax.device_put(center, dev)
+                state_i = jax.device_put(state, dev)
+                opt_i = jax.device_put(core.init_opt_state(params_i), dev)
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(self.seed + epoch), i
+                )
+                records = []
+                pend = []
+                for batch in parts[i].batches(
+                    self.batch_size, columns=[self.features_col, self.label_col]
+                ):
+                    pend.append(batch)
+                    if len(pend) == self.window:
+                        xs, ys = stack_window(
+                            pend, self.features_col, self.label_col
+                        )
+                        xs, ys = jax.device_put((xs, ys), dev)
+                        params_i, state_i, opt_i, rng, mets = core.window(
+                            params_i, state_i, opt_i, rng, xs, ys
+                        )
+                        records.extend(_metrics_to_records(mets))
+                        pend = []
+                if pend:
+                    xs, ys = stack_window(pend, self.features_col, self.label_col)
+                    xs, ys = jax.device_put((xs, ys), dev)
+                    params_i, state_i, opt_i, rng, mets = core.window(
+                        params_i, state_i, opt_i, rng, xs, ys
+                    )
+                    records.extend(_metrics_to_records(mets))
+                self.history.extend(i, records)
+                results[i] = (
+                    jax.tree.map(np.asarray, params_i),
+                    jax.tree.map(np.asarray, state_i),
+                )
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(self.num_workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # host_copy: tree_mean yields default-device JAX arrays, which the
+            # next epoch's windows would donate while other workers still
+            # reference them
+            center = host_copy(tree_mean([r[0] for r in results]))
+            state = results[0][1]
+
+        self.history.record_training_end()
+        return self._finish(center, state)
+
+
+class DistributedTrainer(Trainer):
+    """Template for PS-based distributed training (reference:
+    distkeras/trainers.py -> DistributedTrainer): partition data, start the
+    PS, launch workers, collect, read the center back.
+
+    ``mode``: "threads" (true async, one thread per worker, workers mapped
+    round-robin onto devices) or "simulated" (seeded deterministic
+    interleaving of pull/commit across workers — reproducible staleness for
+    tests; SURVEY §7.3).
+    """
+
+    worker_cls = None
+    ps_cls = DeltaParameterServer
+
+    def __init__(
+        self,
+        *args,
+        num_workers=2,
+        communication_window=5,
+        mode="threads",
+        serve_socket=False,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.num_workers = int(num_workers)
+        self.communication_window = int(communication_window)
+        self.mode = mode
+        self.serve_socket = bool(serve_socket)
+        self.parameter_server = None
+        self.service = None
+
+    # -- template hooks -----------------------------------------------------
+
+    def allocate_parameter_server(self):
+        return self.ps_cls(self.model.params)
+
+    def worker_kwargs(self) -> dict:
+        return {}
+
+    def allocate_worker(self, core, worker_id, device) -> AsyncWorker:
+        return self.worker_cls(
+            core,
+            self.parameter_server,
+            worker_id,
+            self.features_col,
+            self.label_col,
+            self.communication_window,
+            seed=self.seed,
+            device=device,
+            **self.worker_kwargs(),
+        )
+
+    def start_service(self):
+        self.parameter_server.start()
+        if self.serve_socket:
+            self.service = SocketParameterServer(self.parameter_server)
+            self.service.start()
+
+    def stop_service(self):
+        if self.service is not None:
+            self.service.stop()
+            self.service = None
+        self.parameter_server.stop()
+
+    # -- run ----------------------------------------------------------------
+
+    def train(self, dataset, shuffle=False):
+        self.history.record_training_start()
+        core = self._make_core()
+        self.parameter_server = self.allocate_parameter_server()
+        self.start_service()
+        parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
+            self.num_workers
+        )
+        devices = local_devices()
+        workers = [
+            self.allocate_worker(core, i, devices[i % len(devices)])
+            for i in range(self.num_workers)
+        ]
+
+        if self.mode == "threads":
+            self._warmup(core, workers[0], parts[0])
+            self._run_threads(workers, parts)
+        elif self.mode == "simulated":
+            self._run_simulated(workers, parts)
+        else:
+            raise ValueError(f"unknown mode {self.mode!r}")
+
+        for w in workers:
+            self.history.extend(w.worker_id, w.records)
+        self.stop_service()
+        self.history.record_training_end()
+        state = workers[0]._state
+        return self._finish(self.parameter_server.get_params(), state)
+
+    def _warmup(self, core, worker, part):
+        """Compile the window program before launching worker threads.
+
+        Without this, every worker's first window dispatches into the XLA
+        compile gap: all of them pull the identical initial center and later
+        commit full deltas on top of each other — a maximal-staleness burst
+        that measurably hurts early training. One throwaway window on zero
+        data populates the jit cache first.
+        """
+        batch = next(
+            part.batches(self.batch_size, columns=[self.features_col, self.label_col]),
+            None,
+        )
+        if batch is None:  # partition smaller than one batch: nothing to warm
+            return
+        zeros = {k: np.zeros_like(v) for k, v in batch.items()}
+        batches = [zeros] * self.communication_window
+        xs, ys = stack_window(batches, self.features_col, self.label_col)
+        params = host_copy(self.model.params)
+        state = host_copy(self.model.state)
+        opt_state = core.init_opt_state(params)
+        rng = jax.random.PRNGKey(0)
+        fn = core.grad_window if worker.uses_grad_window else core.window
+        out = fn(params, state, opt_state, rng, xs, ys)
+        jax.block_until_ready(out)
+
+    def _run_threads(self, workers, parts):
+        def run(w, part):
+            w.train(
+                part,
+                self.batch_size,
+                num_epoch=self.num_epoch,
+                shuffle_seed=self.seed + w.worker_id,
+            )
+
+        threads = [
+            threading.Thread(target=run, args=(w, p))
+            for w, p in zip(workers, parts)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _run_simulated(self, workers, parts):
+        """Deterministic async: per round, begin windows in one seeded order
+        and finish them in another — cross-worker staleness with an exact,
+        replayable schedule."""
+        cols = [self.features_col, self.label_col]
+        queues = []
+        for w, part in zip(workers, parts):
+            windows, pend = [], []
+            for epoch in range(self.num_epoch):
+                ds = part.shuffle(self.seed + w.worker_id + epoch)
+                for batch in ds.batches(self.batch_size, columns=cols):
+                    pend.append(batch)
+                    if len(pend) == self.communication_window:
+                        windows.append(pend)
+                        pend = []
+                if pend:
+                    windows.append(pend)
+                    pend = []
+            queues.append(windows)
+
+        # Event-driven schedule: repeatedly pick a worker at random; begin its
+        # next window if idle, else finish the in-flight one. Staleness varies
+        # 0..num_workers-1 exactly as thread interleavings produce, but the
+        # seed makes every run bit-identical.
+        rng = np.random.default_rng(self.seed)
+        inflight = [False] * len(workers)
+        while any(queues) or any(inflight):
+            candidates = [
+                i
+                for i in range(len(workers))
+                if inflight[i] or queues[i]
+            ]
+            i = int(rng.choice(candidates))
+            if inflight[i]:
+                workers[i].finish_window()
+                inflight[i] = False
+            else:
+                workers[i].begin_window(queues[i].pop(0))
+                inflight[i] = True
+
+
+class AsynchronousDistributedTrainer(DistributedTrainer):
+    """Marker base adding the async-specific knobs (reference:
+    distkeras/trainers.py -> AsynchronousDistributedTrainer); the
+    ``communication_window`` commit cadence lives on DistributedTrainer."""
+
+
+class DOWNPOUR(AsynchronousDistributedTrainer):
+    """Downpour-SGD (Dean et al.): workers restart from the pulled center
+    every window and commit weight deltas; PS adds them
+    (reference: distkeras/trainers.py -> DOWNPOUR)."""
+
+    worker_cls = DOWNPOURWorker
+    ps_cls = DeltaParameterServer
+
+
+class AEASGD(AsynchronousDistributedTrainer):
+    """Async Elastic Averaging SGD (reference: distkeras/trainers.py ->
+    AEASGD): persistent local replicas, elastic force toward/from center."""
+
+    worker_cls = AEASGDWorker
+    ps_cls = DeltaParameterServer
+
+    def __init__(self, *args, rho=5.0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.rho = float(rho)
+
+    def worker_kwargs(self):
+        return {"rho": self.rho, "learning_rate": self.learning_rate}
+
+
+class EAMSGD(AEASGD):
+    """Elastic averaging with (Nesterov) momentum on the local optimizer
+    (reference: distkeras/trainers.py -> EAMSGD)."""
+
+    worker_cls = EAMSGDWorker
+
+    def __init__(self, *args, momentum=0.9, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.momentum = float(momentum)
+        self.optimizer = get_optimizer(
+            "sgd", self.learning_rate, momentum=self.momentum, nesterov=True
+        )
+
+
+class ADAG(AsynchronousDistributedTrainer):
+    """Accumulated Gradient Normalization (Hermans; reference:
+    distkeras/trainers.py -> ADAG): commit -lr * mean-of-window gradients."""
+
+    worker_cls = ADAGWorker
+    ps_cls = ADAGParameterServer
+
+    def worker_kwargs(self):
+        return {"learning_rate": self.learning_rate}
+
+
+class DynSGD(AsynchronousDistributedTrainer):
+    """Staleness-aware async SGD (reference: distkeras/trainers.py ->
+    DynSGD): versioned PS scales commits by 1/(staleness+1)."""
+
+    worker_cls = DynSGDWorker
+    ps_cls = DynSGDParameterServer

@@ -1,0 +1,26 @@
+"""Core utilities: pytree math, serialization, RNG, training history.
+
+TPU-native replacement for the reference's ``distkeras/utils.py``
+(serialize_keras_model / deserialize_keras_model / shuffle / row helpers).
+"""
+
+from distkeras_tpu.utils.tree import (
+    tree_add,
+    tree_sub,
+    tree_scale,
+    tree_zeros_like,
+    tree_mean,
+    tree_dot,
+    tree_norm,
+    tree_allclose,
+)
+from distkeras_tpu.utils.serialization import (
+    serialize_model,
+    deserialize_model,
+    serialize_params,
+    deserialize_params,
+    save_params,
+    load_params,
+)
+from distkeras_tpu.utils.history import TrainingHistory
+from distkeras_tpu.utils.rng import RngSeq

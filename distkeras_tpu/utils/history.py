@@ -1,0 +1,64 @@
+"""Training history & wall-clock bookkeeping.
+
+Parity with the reference's Trainer bookkeeping (reference:
+distkeras/trainers.py -> Trainer.record_training_start/record_training_end/
+get_training_time/get_history): per-worker batch histories plus start/stop
+wall-clock timing.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+
+class TrainingHistory:
+    """Accumulates per-step metrics, per worker, plus wall-clock timing."""
+
+    def __init__(self):
+        self._records = defaultdict(list)  # worker_id -> list of dict
+        self._t_start = None
+        self._t_end = None
+
+    def record_training_start(self):
+        self._t_start = time.time()
+
+    def record_training_end(self):
+        self._t_end = time.time()
+
+    def get_training_time(self) -> float:
+        if self._t_start is None:
+            return 0.0
+        end = self._t_end if self._t_end is not None else time.time()
+        return end - self._t_start
+
+    def append(self, worker_id: int, **metrics):
+        self._records[worker_id].append(
+            {k: float(v) for k, v in metrics.items()}
+        )
+
+    def extend(self, worker_id: int, records):
+        for r in records:
+            self.append(worker_id, **r)
+
+    def get_history(self, worker_id=None):
+        if worker_id is not None:
+            return list(self._records[worker_id])
+        merged = []
+        for wid in sorted(self._records):
+            merged.extend(self._records[wid])
+        return merged
+
+    def num_updates(self) -> int:
+        return sum(len(v) for v in self._records.values())
+
+    def averages(self) -> dict:
+        merged = self.get_history()
+        if not merged:
+            return {}
+        keys = merged[-1].keys()
+        return {
+            k: sum(r[k] for r in merged if k in r)
+            / max(1, sum(1 for r in merged if k in r))
+            for k in keys
+        }

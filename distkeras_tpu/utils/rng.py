@@ -1,0 +1,31 @@
+"""Deterministic RNG key management.
+
+The reference relied on Python/numpy global RNG (reference: distkeras/utils.py
+-> shuffle and Keras init). JAX requires explicit threading of PRNG keys; this
+sequence wrapper gives trainers/workers a deterministic, per-consumer stream.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class RngSeq:
+    """A splittable stream of jax PRNG keys: next() is deterministic in seed."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+
+    def next(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def next_n(self, n: int):
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return list(subs)
+
+    def fork(self, index: int) -> "RngSeq":
+        """Deterministic per-worker fork (worker index -> independent stream)."""
+        child = RngSeq.__new__(RngSeq)
+        child._key = jax.random.fold_in(self._key, index)
+        return child

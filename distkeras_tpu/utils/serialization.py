@@ -1,0 +1,70 @@
+"""Model and parameter (de)serialization.
+
+TPU-native equivalent of the reference's model wire format (reference:
+distkeras/utils.py -> serialize_keras_model / deserialize_keras_model, which
+ship a dict of {architecture-JSON, weight list} between driver and executors).
+
+Here a model is (spec, params): the architecture is a declarative layer-spec
+list (JSON-able), and the parameters are a pytree of arrays. The wire format
+is a dict {"spec": <json str>, "weights": <flat list of ndarrays>} — the same
+split the reference uses, so models survive process/network boundaries without
+pickling code objects.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+
+import jax
+import numpy as np
+
+
+def serialize_params(params) -> bytes:
+    """Pytree of arrays -> bytes (treedef-json + npz payload, no pickled code)."""
+    leaves, treedef = jax.tree.flatten(params)
+    buf = io.BytesIO()
+    np.savez(buf, *[np.asarray(leaf) for leaf in leaves])
+    return pickle.dumps({"treedef": treedef, "npz": buf.getvalue()})
+
+
+def deserialize_params(blob: bytes):
+    payload = pickle.loads(blob)
+    with np.load(io.BytesIO(payload["npz"])) as z:
+        leaves = [z[k] for k in z.files]
+    return jax.tree.unflatten(payload["treedef"], leaves)
+
+
+def serialize_model(model) -> bytes:
+    """Sequential model -> bytes: architecture spec JSON + weight arrays."""
+    buf = io.BytesIO()
+    np.savez(buf, *[np.asarray(w) for w in model.get_weights()])
+    return pickle.dumps(
+        {
+            "spec": json.dumps(model.get_config()),
+            "input_shape": model.input_shape,
+            "weights": buf.getvalue(),
+        }
+    )
+
+
+def deserialize_model(blob: bytes):
+    from distkeras_tpu.models.sequential import Sequential
+
+    payload = pickle.loads(blob)
+    model = Sequential.from_config(json.loads(payload["spec"]))
+    model.build(payload["input_shape"])
+    with np.load(io.BytesIO(payload["weights"])) as z:
+        model.set_weights([z[k] for k in z.files])
+    return model
+
+
+def save_params(path: str, params) -> None:
+    with open(path, "wb") as f:
+        f.write(serialize_params(params))
+
+
+def load_params(path: str):
+    with open(path, "rb") as f:
+        return deserialize_params(f.read())

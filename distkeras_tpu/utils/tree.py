@@ -1,0 +1,79 @@
+"""Pytree arithmetic helpers.
+
+The async parameter-server algorithms (reference:
+distkeras/parameter_servers.py -> DeltaParameterServer.handle_commit and
+distkeras/workers.py per-algorithm delta rules) operate on "weight lists".
+Here the model parameters are an arbitrary JAX pytree, so every delta rule is
+expressed through these pure, jit-friendly tree ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_add(a, b):
+    """a + b, leaf-wise."""
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a, b):
+    """a - b, leaf-wise."""
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(a, s):
+    """s * a for scalar s, leaf-wise."""
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_zeros_like(a):
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def tree_mean(trees):
+    """Element-wise mean of a list of pytrees (AveragingTrainer's merge rule)."""
+    n = len(trees)
+    if n == 0:
+        raise ValueError("tree_mean of empty list")
+    acc = trees[0]
+    for t in trees[1:]:
+        acc = tree_add(acc, t)
+    return tree_scale(acc, 1.0 / n)
+
+
+def tree_dot(a, b):
+    """Sum of element-wise products across all leaves (scalar)."""
+    parts = jax.tree.leaves(jax.tree.map(lambda x, y: jnp.vdot(x, y), a, b))
+    return sum(parts)
+
+
+def tree_norm(a):
+    """Global L2 norm across all leaves."""
+    return jnp.sqrt(
+        sum(jax.tree.leaves(jax.tree.map(lambda x: jnp.vdot(x, x), a, a)))
+    )
+
+
+def host_copy(a):
+    """Forced copy of every leaf to host numpy.
+
+    The compiled window functions donate their params/state/opt-state input
+    buffers (HBM double-buffering); callers seed those loops with owned host
+    copies so donation can never consume an array something else still
+    references (np.array(, copy=True) — np.asarray may alias on CPU).
+    """
+    return jax.tree.map(lambda x: np.array(x, copy=True), a)
+
+
+def tree_allclose(a, b, rtol=1e-5, atol=1e-6):
+    """Host-side structural + numerical equality check (for tests)."""
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False
+    return all(
+        np.allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol)
+        for x, y in zip(la, lb)
+    )

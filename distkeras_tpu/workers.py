@@ -1,0 +1,408 @@
+"""Worker runtime: the per-chip training loops.
+
+TPU-native rebuild of the reference's executor-side workers (reference:
+distkeras/workers.py -> Worker / SingleTrainerWorker / DOWNPOURWorker /
+AEASGDWorker / EAMSGDWorker / ADAGWorker / DynSGDWorker). The Keras
+``train_on_batch`` hot loop becomes a jit-compiled ``lax.scan`` over a
+*window* of W minibatches (the ``communication_window``): one XLA program
+per window keeps the chip busy between host round-trips, which is the
+TPU-shaped version of "train W batches between pull/commit".
+
+Async workers split each window into ``begin_window`` (pull + launch device
+compute) and ``finish_window`` (fetch result + commit) so that
+
+- thread mode calls them back-to-back per worker thread (true asynchrony,
+  one worker per chip), and
+- the deterministic simulator interleaves begins/finishes across workers on
+  a seeded schedule, reproducing staleness exactly (SURVEY §7.3: async
+  semantics need a deterministic test harness).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.metrics import get_metric
+from distkeras_tpu.utils.tree import host_copy, tree_scale, tree_sub
+
+# ------------------------------------------------------------------ core step
+
+
+class WorkerCore:
+    """Compiles the shared train/eval step functions for a model+optimizer.
+
+    One core is shared by all workers of a trainer, so XLA compiles each
+    program once per device; dispatch follows input placement.
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer: optax.GradientTransformation,
+        loss,
+        metrics=("accuracy",),
+        compute_dtype=None,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = get_loss(loss)
+        self.metric_names = list(metrics)
+        self.metric_fns = [get_metric(m) for m in metrics]
+        self.compute_dtype = compute_dtype
+
+        model_apply = model.apply
+        loss_fn = self.loss_fn
+        metric_fns = self.metric_fns
+        cdtype = compute_dtype
+
+        def compute_loss(params, state, rng, x, y):
+            if cdtype is not None:
+                x = x.astype(cdtype)
+            y_pred, new_state = model_apply(params, state, x, train=True, rng=rng)
+            y_pred = y_pred.astype(jnp.float32)
+            return loss_fn(y_pred, y), (new_state, y_pred)
+
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+
+        def train_step(carry, batch):
+            params, state, opt_state, rng = carry
+            rng, sub = jax.random.split(rng)
+            (loss, (state, y_pred)), grads = grad_fn(
+                params, state, sub, batch["x"], batch["y"]
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            mets = {"loss": loss}
+            for name, fn in zip(self.metric_names, metric_fns):
+                mets[name] = fn(y_pred, batch["y"])
+            return (params, state, opt_state, rng), mets
+
+        def window(params, state, opt_state, rng, xs, ys):
+            """Run a scan over W stacked minibatches; returns per-step metrics."""
+            (params, state, opt_state, rng), mets = jax.lax.scan(
+                train_step, (params, state, opt_state, rng), {"x": xs, "y": ys}
+            )
+            return params, state, opt_state, rng, mets
+
+        def grad_window(params, state, opt_state, rng, xs, ys):
+            """Like window, but also accumulates raw gradients (ADAG)."""
+
+            def step(carry, batch):
+                params, state, opt_state, rng, acc = carry
+                rng, sub = jax.random.split(rng)
+                (loss, (state, y_pred)), grads = grad_fn(
+                    params, state, sub, batch["x"], batch["y"]
+                )
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                mets = {"loss": loss}
+                for name, fn in zip(self.metric_names, metric_fns):
+                    mets[name] = fn(y_pred, batch["y"])
+                return (params, state, opt_state, rng, acc), mets
+
+            acc0 = jax.tree.map(jnp.zeros_like, params)
+            (params, state, opt_state, rng, acc), mets = jax.lax.scan(
+                step, (params, state, opt_state, rng, acc0), {"x": xs, "y": ys}
+            )
+            return params, state, opt_state, rng, acc, mets
+
+        def eval_step(params, state, x, y):
+            if cdtype is not None:
+                x = x.astype(cdtype)
+            y_pred, _ = model_apply(params, state, x, train=False)
+            y_pred = y_pred.astype(jnp.float32)
+            mets = {"loss": loss_fn(y_pred, y)}
+            for name, fn in zip(self.metric_names, metric_fns):
+                mets[name] = fn(y_pred, y)
+            return mets
+
+        self.window = jax.jit(window, donate_argnums=(0, 1, 2))
+        self.grad_window = jax.jit(grad_window, donate_argnums=(0, 1, 2))
+        self.eval_step = jax.jit(eval_step)
+
+    def init_opt_state(self, params):
+        return self.optimizer.init(params)
+
+
+def _metrics_to_records(mets) -> list:
+    """Device metrics dict of (W,) arrays -> list of per-step float dicts."""
+    host = {k: np.asarray(v) for k, v in mets.items()}
+    w = len(next(iter(host.values())))
+    return [{k: float(v[i]) for k, v in host.items()} for i in range(w)]
+
+
+def stack_window(batches: list, features_col: str, label_col: str):
+    """List of W batch dicts -> stacked (W, B, ...) arrays."""
+    xs = np.stack([b[features_col] for b in batches])
+    ys = np.stack([b[label_col] for b in batches])
+    return xs, ys
+
+
+# --------------------------------------------------------------- sync workers
+
+
+class SingleTrainerWorker:
+    """Sequential minibatch loop on one device (reference:
+    distkeras/workers.py -> SingleTrainerWorker.train)."""
+
+    def __init__(self, core: WorkerCore, features_col, label_col, seed=0, device=None):
+        self.core = core
+        self.features_col = features_col
+        self.label_col = label_col
+        self.rng = jax.random.PRNGKey(seed)
+        self.device = device
+
+    def train(
+        self,
+        dataset,
+        batch_size,
+        num_epoch=1,
+        window=8,
+        shuffle_seed=None,
+        initial=None,
+    ):
+        """``initial``: optional (params, state) to start from instead of the
+        core model's (lets many workers share one compiled core)."""
+        if initial is not None:
+            params, state = host_copy(initial[0]), host_copy(initial[1])
+        else:
+            params = host_copy(self.core.model.params)
+            state = host_copy(self.core.model.state)
+        opt_state = self.core.init_opt_state(params)
+        if self.device is not None:
+            params, state, opt_state = jax.device_put(
+                (params, state, opt_state), self.device
+            )
+        rng = self.rng
+        records = []
+        for epoch in range(num_epoch):
+            ds = (
+                dataset.shuffle(shuffle_seed + epoch)
+                if shuffle_seed is not None
+                else dataset
+            )
+            pend = []
+            for batch in ds.batches(
+                batch_size, columns=[self.features_col, self.label_col]
+            ):
+                pend.append(batch)
+                if len(pend) == window:
+                    params, state, opt_state, rng, records_w = self._run(
+                        params, state, opt_state, rng, pend
+                    )
+                    records.extend(records_w)
+                    pend = []
+            if pend:
+                params, state, opt_state, rng, records_w = self._run(
+                    params, state, opt_state, rng, pend
+                )
+                records.extend(records_w)
+        return params, state, records
+
+    def _run(self, params, state, opt_state, rng, batches):
+        xs, ys = stack_window(batches, self.features_col, self.label_col)
+        if self.device is not None:
+            xs, ys = jax.device_put((xs, ys), self.device)
+        params, state, opt_state, rng, mets = self.core.window(
+            params, state, opt_state, rng, xs, ys
+        )
+        return params, state, opt_state, rng, _metrics_to_records(mets)
+
+
+# -------------------------------------------------------------- async workers
+
+
+class AsyncWorker:
+    """Base async worker: owns one partition, one device, one PS connection.
+
+    Lifecycle per window (reference: distkeras/workers.py -> NetworkWorker
+    pull/commit cadence):
+      begin_window(batches): pull from PS (algorithm-specific), launch the
+        compiled window on the device (dispatch is async — the chip computes
+        while the host thread yields);
+      finish_window(): block on the result, compute the delta, commit.
+    """
+
+    uses_grad_window = False
+
+    def __init__(
+        self,
+        core: WorkerCore,
+        ps,
+        worker_id: int,
+        features_col,
+        label_col,
+        communication_window: int,
+        seed=0,
+        device=None,
+    ):
+        self.core = core
+        self.ps = ps
+        self.worker_id = worker_id
+        self.features_col = features_col
+        self.label_col = label_col
+        self.window_size = int(communication_window)
+        self.rng = jax.random.fold_in(jax.random.PRNGKey(seed), worker_id)
+        self.device = device
+        self.records = []
+        # persistent local slots
+        self._params = None
+        self._state = None
+        self._opt_state = None
+        self._pending = None
+
+    # -- algorithm hooks ----------------------------------------------------
+
+    def on_pull(self, center, tag):
+        """Set local params from the pulled center. Override per algorithm."""
+        raise NotImplementedError
+
+    def make_delta(self, pulled, result):
+        """Compute (delta, tag) to commit. Override per algorithm."""
+        raise NotImplementedError
+
+    # -- window machinery ---------------------------------------------------
+
+    def _ensure_initialized(self, center):
+        if self._state is None:
+            self._state = host_copy(self.core.model.state)
+            if self.device is not None:
+                self._state = jax.device_put(self._state, self.device)
+        if self._opt_state is None:
+            opt = self.core.init_opt_state(center)
+            self._opt_state = (
+                jax.device_put(opt, self.device) if self.device is not None else opt
+            )
+
+    def begin_window(self, batches):
+        center_host, tag = self.ps.pull()  # owned host (numpy) copies
+        center = (
+            jax.device_put(center_host, self.device)
+            if self.device is not None
+            else center_host
+        )
+        self._ensure_initialized(center)
+        self.on_pull(center, tag)
+        xs, ys = stack_window(batches, self.features_col, self.label_col)
+        if self.device is not None:
+            xs, ys = jax.device_put((xs, ys), self.device)
+        fn = self.core.grad_window if self.uses_grad_window else self.core.window
+        out = fn(self._params, self._state, self._opt_state, self.rng, xs, ys)
+        # keep the host copy for delta computation: the device-side center may
+        # be donated by the window call through self._params
+        self._pending = {"pulled": (center_host, tag), "out": out}
+
+    def finish_window(self):
+        pend = self._pending
+        self._pending = None
+        if self.uses_grad_window:
+            params, state, opt_state, rng, acc, mets = pend["out"]
+            result = {"params": params, "grad_acc": acc}
+        else:
+            params, state, opt_state, rng, mets = pend["out"]
+            result = {"params": params}
+        self._params, self._state, self._opt_state, self.rng = (
+            params,
+            state,
+            opt_state,
+            rng,
+        )
+        self.records.extend(_metrics_to_records(mets))
+        delta, tag = self.make_delta(pend["pulled"], result)
+        self.ps.commit(jax.tree.map(np.asarray, delta), tag)
+
+    def train(self, dataset, batch_size, num_epoch=1, shuffle_seed=None):
+        """Thread-mode entry: run all windows of this worker's partition."""
+        cols = [self.features_col, self.label_col]
+        for epoch in range(num_epoch):
+            ds = (
+                dataset.shuffle(shuffle_seed + epoch)
+                if shuffle_seed is not None
+                else dataset
+            )
+            pend = []
+            for batch in ds.batches(batch_size, columns=cols):
+                pend.append(batch)
+                if len(pend) == self.window_size:
+                    self.begin_window(pend)
+                    self.finish_window()
+                    pend = []
+            if pend:
+                self.begin_window(pend)
+                self.finish_window()
+        return self.records
+
+
+class DOWNPOURWorker(AsyncWorker):
+    """Pull center, run W local steps, commit the weight delta
+    (reference: distkeras/workers.py -> DOWNPOURWorker)."""
+
+    def on_pull(self, center, tag):
+        self._params = center  # local replica restarts from the center
+
+    def make_delta(self, pulled, result):
+        center, tag = pulled
+        delta = tree_sub(result["params"], center)
+        return delta, tag
+
+
+class ADAGWorker(AsyncWorker):
+    """Accumulated Gradient Normalization (Hermans): run W local steps,
+    commit -lr * (sum of gradients) / W (reference: distkeras/workers.py ->
+    ADAGWorker; the PS adds the pre-normalized delta)."""
+
+    uses_grad_window = True
+
+    def __init__(self, *args, learning_rate=0.01, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.learning_rate = float(learning_rate)
+
+    def on_pull(self, center, tag):
+        self._params = center
+
+    def make_delta(self, pulled, result):
+        scale = -self.learning_rate / float(self.window_size)
+        return tree_scale(result["grad_acc"], scale), pulled[1]
+
+
+class DynSGDWorker(DOWNPOURWorker):
+    """DOWNPOUR cadence against the versioned PS: the pull tag (PS update
+    counter) rides along with the commit so the server can scale by
+    1/(staleness+1) (reference: distkeras/workers.py -> DynSGDWorker)."""
+
+
+class AEASGDWorker(AsyncWorker):
+    """Asynchronous Elastic Averaging SGD (Zhang et al.).
+
+    The local replica persists across windows (it does NOT reset to the
+    center). Every window: train W steps, then with elastic force
+    e = rho * lr * (x_local - x_center): x_local -= e; commit(e)
+    (reference: distkeras/workers.py -> AEASGDWorker; §4.3).
+    """
+
+    def __init__(self, *args, rho=5.0, learning_rate=0.01, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.rho = float(rho)
+        self.learning_rate = float(learning_rate)
+
+    def on_pull(self, center, tag):
+        if self._params is None:
+            self._params = center  # first window: adopt the center
+
+    def make_delta(self, pulled, result):
+        center, tag = pulled
+        alpha = self.rho * self.learning_rate
+        elastic = tree_scale(tree_sub(result["params"], center), alpha)
+        self._params = tree_sub(result["params"], elastic)
+        return elastic, tag
+
+
+class EAMSGDWorker(AEASGDWorker):
+    """Elastic averaging with momentum: identical elastic rule; the momentum
+    lives in the worker's local optimizer (the trainer builds it with
+    Nesterov momentum — reference: distkeras/workers.py -> EAMSGDWorker)."""

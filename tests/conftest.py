@@ -1,0 +1,27 @@
+"""Test bootstrap: force an 8-device CPU platform (SURVEY §7.4).
+
+Multi-device code paths (mesh, sync allreduce, per-device async workers) are
+exercised on CPU via ``--xla_force_host_platform_device_count=8``. Must run
+before any JAX backend initialization; the axon TPU plugin registered by the
+sandbox's sitecustomize is overridden by selecting the cpu platform
+explicitly.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"

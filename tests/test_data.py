@@ -1,0 +1,128 @@
+"""Dataset + transformers: golden-value semantics (SURVEY §7.4 unit tier)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data import loaders
+from distkeras_tpu.data.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+    StandardScaleTransformer,
+)
+
+
+def _ds(n=10):
+    return Dataset(
+        {
+            "features": np.arange(n * 3, dtype=np.float32).reshape(n, 3),
+            "label": np.arange(n) % 4,
+        }
+    )
+
+
+def test_dataset_basics():
+    ds = _ds(10)
+    assert len(ds) == 10
+    assert set(ds.columns) == {"features", "label"}
+    assert ds["label"].shape == (10,)
+    sub = ds[:4]
+    assert len(sub) == 4
+
+
+def test_partition_disjoint_and_complete():
+    ds = _ds(10)
+    parts = ds.partition(3)
+    assert [len(p) for p in parts] == [4, 3, 3]
+    rows = np.concatenate([p["features"] for p in parts])
+    np.testing.assert_array_equal(rows, ds["features"])
+
+
+def test_shuffle_deterministic():
+    ds = _ds(32)
+    a = ds.shuffle(5)["label"]
+    b = ds.shuffle(5)["label"]
+    c = ds.shuffle(6)["label"]
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    np.testing.assert_array_equal(np.sort(a), np.sort(ds["label"]))
+
+
+def test_batches_static_shape():
+    ds = _ds(10)
+    batches = list(ds.batches(4))
+    assert len(batches) == 2  # remainder dropped
+    assert all(b["features"].shape == (4, 3) for b in batches)
+    assert ds.num_batches(4) == 2
+
+
+def test_minmax_golden():
+    ds = Dataset({"features": np.array([[0.0], [127.5], [255.0]], np.float32)})
+    out = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    np.testing.assert_allclose(out["features"].ravel(), [0.0, 0.5, 1.0])
+    out2 = MinMaxTransformer(-1, 1, o_min=0, o_max=255).transform(ds)
+    np.testing.assert_allclose(out2["features"].ravel(), [-1.0, 0.0, 1.0])
+
+
+def test_onehot_golden_and_range_check():
+    ds = Dataset({"label": np.array([0, 2, 1])})
+    out = OneHotTransformer(3).transform(ds)
+    np.testing.assert_array_equal(
+        out["label_onehot"],
+        [[1, 0, 0], [0, 0, 1], [0, 1, 0]],
+    )
+    with pytest.raises(ValueError):
+        OneHotTransformer(2).transform(ds)
+
+
+def test_dense_transformer_stacks_columns():
+    ds = Dataset(
+        {"a": np.ones((4, 2), np.float32), "b": np.arange(4, dtype=np.float32)}
+    )
+    out = DenseTransformer(["a", "b"]).transform(ds)
+    assert out["features"].shape == (4, 3)
+    np.testing.assert_array_equal(out["features"][:, 2], np.arange(4))
+
+
+def test_reshape_transformer():
+    ds = Dataset({"features": np.zeros((5, 784), np.float32)})
+    out = ReshapeTransformer("features", "matrix", (28, 28, 1)).transform(ds)
+    assert out["matrix"].shape == (5, 28, 28, 1)
+
+
+def test_label_index_transformer():
+    ds = Dataset({"prediction": np.array([[0.1, 0.9], [0.8, 0.2]])})
+    out = LabelIndexTransformer().transform(ds)
+    np.testing.assert_array_equal(out["prediction_index"], [1, 0])
+
+
+def test_standard_scale():
+    ds = Dataset(
+        {"features": np.random.default_rng(0).normal(5, 3, (100, 4)).astype(np.float32)}
+    )
+    out = StandardScaleTransformer().transform(ds)
+    assert abs(out["features"].mean()) < 1e-5
+    assert abs(out["features"].std() - 1.0) < 1e-2
+
+
+def test_synthetic_loaders_deterministic():
+    a = loaders.synthetic_mnist(n=64, seed=3)
+    b = loaders.synthetic_mnist(n=64, seed=3)
+    np.testing.assert_array_equal(a["features"], b["features"])
+    assert a["features"].shape == (64, 784)
+    assert a["features"].min() >= 0 and a["features"].max() <= 255
+    h = loaders.synthetic_higgs(n=64)
+    assert set(np.unique(h["label"])) <= {0, 1}
+    c = loaders.synthetic_cifar10(n=8)
+    assert c["features"].shape == (8, 32, 32, 3)
+
+
+def test_load_csv(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("label,p0,p1\n1,0.5,0.25\n0,1.0,0.0\n")
+    ds = loaders.load_csv(str(p))
+    np.testing.assert_array_equal(ds["label"], [1, 0])
+    np.testing.assert_allclose(ds["features"], [[0.5, 0.25], [1.0, 0.0]])

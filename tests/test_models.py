@@ -1,0 +1,102 @@
+"""Model layer: shapes, param counts, config/serialization round-trips."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import zoo
+from distkeras_tpu.models.layers import BatchNorm, Conv2D, Dense, Dropout
+from distkeras_tpu.models.sequential import Sequential
+from distkeras_tpu.utils.serialization import (
+    deserialize_model,
+    deserialize_params,
+    serialize_model,
+    serialize_params,
+)
+
+
+def test_mlp_shapes_and_softmax():
+    m = zoo.mnist_mlp(hidden=32)
+    x = np.random.default_rng(0).normal(size=(4, 784)).astype(np.float32)
+    y = m(x)
+    assert y.shape == (4, 10)
+    np.testing.assert_allclose(np.asarray(y).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_cnn_shapes():
+    m = zoo.mnist_cnn()
+    x = np.zeros((2, 28, 28, 1), np.float32)
+    assert m(x).shape == (2, 10)
+
+
+def test_resnet18_param_count():
+    # standard ResNet-18 (1000 classes) has ~11.69M params; our softmax head
+    # variant with 10 classes and small stem lands at ~11.17M
+    m = zoo.resnet18(num_classes=10, input_shape=(32, 32, 3), small_stem=True)
+    assert 11_000_000 < m.num_params() < 11_300_000
+
+
+def test_dense_math():
+    m = Sequential([Dense(3, use_bias=True)]).build((2,), seed=0)
+    k = np.asarray(m.params["0"]["kernel"])
+    x = np.array([[1.0, 2.0]], np.float32)
+    np.testing.assert_allclose(m(x), x @ k, rtol=1e-6)
+
+
+def test_dropout_train_vs_eval():
+    m = Sequential([Dropout(0.5)]).build((100,))
+    x = np.ones((4, 100), np.float32)
+    y_eval = m(x)
+    np.testing.assert_array_equal(np.asarray(y_eval), x)
+    y1, _ = m.apply(m.params, m.state, x, train=True, rng=jax.random.PRNGKey(1))
+    y2, _ = m.apply(m.params, m.state, x, train=True, rng=jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))  # deterministic in rng
+    assert (np.asarray(y1) == 0).any() and (np.asarray(y1) > 1).any()
+
+
+def test_batchnorm_updates_state():
+    m = Sequential([BatchNorm(momentum=0.5)]).build((4,))
+    x = np.random.default_rng(0).normal(3.0, 2.0, (64, 4)).astype(np.float32)
+    y, new_state = m.apply(m.params, m.state, x, train=True)
+    # normalized output: ~zero mean, unit var
+    assert abs(float(np.asarray(y).mean())) < 1e-4
+    assert abs(float(np.asarray(y).std()) - 1.0) < 1e-2
+    assert float(new_state["0"]["mean"].mean()) > 1.0  # moved toward batch mean
+
+
+def test_config_roundtrip():
+    m = zoo.cifar10_cnn()
+    m2 = Sequential.from_config(m.get_config()).build((32, 32, 3), seed=0)
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)), atol=1e-6)
+
+
+def test_model_serialization_roundtrip():
+    m = zoo.mnist_cnn()
+    m2 = deserialize_model(serialize_model(m))
+    x = np.random.default_rng(1).normal(size=(2, 28, 28, 1)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)), atol=1e-6)
+
+
+def test_params_serialization_roundtrip():
+    params = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3), "b": {"c": jnp.ones(4)}}
+    out = deserialize_params(serialize_params(params))
+    assert jax.tree.structure(out) == jax.tree.structure(params)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.asarray(params["a"]))
+
+
+def test_set_get_weights_roundtrip():
+    m = zoo.mnist_mlp(hidden=16)
+    w = m.get_weights()
+    m2 = zoo.mnist_mlp(hidden=16, seed=7)
+    m2.set_weights(w)
+    x = np.random.default_rng(2).normal(size=(3, 784)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)), atol=1e-6)
+
+
+def test_residual_shape_mismatch_raises():
+    from distkeras_tpu.models.sequential import Residual
+
+    with pytest.raises(ValueError):
+        Sequential([Residual([Dense(8)])]).build((4,))

@@ -1,0 +1,116 @@
+"""PS commit rules as pure functions + concurrency + socket protocol
+(SURVEY §7.4: assert DynSGD scaling and delta semantics exactly)."""
+
+import threading
+
+import numpy as np
+
+from distkeras_tpu.parameter_servers import (
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    RemoteParameterServerClient,
+    SocketParameterServer,
+    delta_rule,
+    dynsgd_rule,
+)
+
+
+def _params(v=0.0):
+    return {"w": np.full((3,), v, np.float32), "b": {"x": np.full((2,), v, np.float32)}}
+
+
+def test_delta_rule_pure():
+    center, meta = delta_rule(_params(1.0), {}, _params(0.5))
+    np.testing.assert_allclose(center["w"], 1.5)
+    np.testing.assert_allclose(center["b"]["x"], 1.5)
+    assert meta["num_updates"] == 1
+
+
+def test_dynsgd_rule_staleness_scaling():
+    meta = {"version": 5, "num_updates": 5}
+    # worker pulled at version 3 -> staleness 2 -> delta scaled by 1/3
+    center, meta2 = dynsgd_rule(_params(0.0), meta, _params(3.0), tag=3)
+    np.testing.assert_allclose(center["w"], 1.0)
+    assert meta2["version"] == 6
+    # fresh worker (tag == version): full delta
+    center3, _ = dynsgd_rule(_params(0.0), meta, _params(3.0), tag=5)
+    np.testing.assert_allclose(center3["w"], 3.0)
+
+
+def test_delta_ps_pull_commit():
+    ps = DeltaParameterServer(_params(0.0))
+    center, tag = ps.pull()
+    assert tag is None
+    ps.commit(_params(2.0))
+    ps.commit(_params(1.0))
+    np.testing.assert_allclose(ps.get_params()["w"], 3.0)
+    assert ps.num_updates == 2
+    # pulled copy must be isolated from subsequent commits
+    np.testing.assert_allclose(center["w"], 0.0)
+
+
+def test_dynsgd_ps_versioned_pull():
+    ps = DynSGDParameterServer(_params(0.0))
+    _, v0 = ps.pull()
+    assert v0 == 0
+    ps.commit(_params(1.0), tag=v0)  # staleness 0 -> full
+    _, v1 = ps.pull()
+    assert v1 == 1
+    ps.commit(_params(1.0), tag=v0)  # staleness 1 -> half
+    np.testing.assert_allclose(ps.get_params()["w"], 1.5)
+
+
+def test_ps_concurrent_commits_all_land():
+    ps = DeltaParameterServer(_params(0.0))
+    n_threads, n_commits = 8, 25
+
+    def worker():
+        for _ in range(n_commits):
+            ps.commit(_params(1.0))
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    np.testing.assert_allclose(ps.get_params()["w"], n_threads * n_commits)
+    assert ps.num_updates == n_threads * n_commits
+
+
+def test_socket_ps_roundtrip():
+    ps = DynSGDParameterServer(_params(0.0))
+    server = SocketParameterServer(ps, host="127.0.0.1")
+    server.start()
+    try:
+        client = RemoteParameterServerClient("127.0.0.1", server.port)
+        center, tag = client.pull()
+        assert tag == 0
+        np.testing.assert_allclose(center["w"], 0.0)
+        client.commit(_params(2.0), tag=tag)
+        center2, tag2 = client.pull()
+        assert tag2 == 1
+        np.testing.assert_allclose(center2["w"], 2.0)
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_socket_ps_concurrent_clients():
+    ps = DeltaParameterServer(_params(0.0))
+    server = SocketParameterServer(ps, host="127.0.0.1")
+    server.start()
+    try:
+        def client_run():
+            c = RemoteParameterServerClient("127.0.0.1", server.port)
+            for _ in range(10):
+                c.commit(_params(1.0))
+            c.close()
+
+        threads = [threading.Thread(target=client_run) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        np.testing.assert_allclose(ps.get_params()["w"], 40.0)
+    finally:
+        server.stop()

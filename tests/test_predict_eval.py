@@ -1,0 +1,58 @@
+"""Predictor + evaluator semantics (reference: distkeras/predictors.py,
+distkeras/evaluators.py)."""
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.transformers import LabelIndexTransformer
+from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
+from distkeras_tpu.models import zoo
+from distkeras_tpu.predictors import ModelPredictor
+
+
+def test_predictor_appends_column_ragged_batch():
+    m = zoo.mnist_mlp(hidden=16)
+    ds = Dataset(
+        {
+            "features": np.random.default_rng(0)
+            .normal(size=(70, 784))
+            .astype(np.float32),
+            "label": np.zeros(70, np.int64),
+        }
+    )
+    out = ModelPredictor(m, batch_size=32).predict(ds)
+    assert out["prediction"].shape == (70, 10)
+    # padding must not leak: direct forward of last row matches
+    np.testing.assert_allclose(
+        out["prediction"][-1],
+        np.asarray(m(ds["features"][-1:]))[0],
+        rtol=2e-5, atol=1e-6,
+    )
+
+
+def test_accuracy_evaluator_onehot_and_ids():
+    ds = Dataset(
+        {
+            "prediction": np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]]),
+            "label": np.array([0, 1, 1]),
+        }
+    )
+    assert AccuracyEvaluator(label_col="label").evaluate(ds) == 2 / 3
+    ds2 = ds.with_column("label", np.eye(2)[[0, 1, 1]])
+    assert AccuracyEvaluator(label_col="label").evaluate(ds2) == 2 / 3
+    # via LabelIndexTransformer path
+    ds3 = LabelIndexTransformer().transform(ds)
+    assert (
+        AccuracyEvaluator(prediction_col="prediction_index", label_col="label").evaluate(ds3)
+        == 2 / 3
+    )
+
+
+def test_loss_evaluator():
+    ds = Dataset(
+        {
+            "prediction": np.array([[1.0, 0.0], [0.0, 1.0]], np.float32),
+            "label": np.array([[1.0, 0.0], [0.0, 1.0]], np.float32),
+        }
+    )
+    assert LossEvaluator().evaluate(ds) < 1e-5

@@ -1,0 +1,168 @@
+"""Async PS trainers: deterministic-simulator semantics, convergence, and
+thread-mode smoke (SURVEY §7.4: async without nondeterminism)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import ADAG, AEASGD, DOWNPOUR, DynSGD, EAMSGD
+from distkeras_tpu.data import loaders
+from distkeras_tpu.data.transformers import MinMaxTransformer, OneHotTransformer
+from distkeras_tpu.evaluators import AccuracyEvaluator
+from distkeras_tpu.models import zoo
+from distkeras_tpu.predictors import ModelPredictor
+
+
+def make_data(n=2048, seed=0):
+    ds = loaders.synthetic_mnist(n=n, seed=seed)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    return ds.split(0.85, seed=seed)
+
+
+def accuracy_of(model, test):
+    pred = ModelPredictor(model, batch_size=256).predict(test)
+    return AccuracyEvaluator(label_col="label").evaluate(pred)
+
+
+def _trainer(cls, model, **extra):
+    kw = dict(
+        loss="categorical_crossentropy",
+        learning_rate=0.02,
+        batch_size=32,
+        num_epoch=2,
+        num_workers=4,
+        communication_window=4,
+        label_col="label_onehot",
+        mode="simulated",
+        seed=0,
+    )
+    kw.update(extra)
+    return cls(model, "sgd", **kw)
+
+
+@pytest.mark.parametrize(
+    "cls,extra",
+    [
+        (DOWNPOUR, {}),
+        # the elastic center moves only by rho*lr-scaled differences, so the
+        # tiny test partitions need a stronger spring + more passes
+        (AEASGD, {"rho": 10.0, "num_epoch": 4}),
+        # ADAG's center advances ~lr*mean-grad once per window (4x fewer
+        # effective steps than sequential SGD) -> more passes + higher lr
+        (ADAG, {"num_epoch": 4, "learning_rate": 0.05}),
+        (DynSGD, {}),
+    ],
+    ids=lambda v: v.__name__ if isinstance(v, type) else "",
+)
+def test_async_converges_simulated(cls, extra):
+    train, test = make_data()
+    t = _trainer(cls, zoo.mnist_mlp(hidden=64), **extra)
+    trained = t.train(train)
+    acc = accuracy_of(trained, test)
+    assert acc > 0.9, f"{cls.__name__} accuracy {acc}"
+    assert t.parameter_server.num_updates > 0
+    assert len(t.get_history()) > 0
+
+
+def test_simulated_mode_is_deterministic():
+    train, _ = make_data(n=1024)
+    a = _trainer(DOWNPOUR, zoo.mnist_mlp(hidden=32)).train(train)
+    b = _trainer(DOWNPOUR, zoo.mnist_mlp(hidden=32)).train(train)
+    for wa, wb in zip(a.get_weights(), b.get_weights()):
+        np.testing.assert_array_equal(wa, wb)
+
+
+def test_threads_mode_converges():
+    train, test = make_data(n=1024)
+    t = _trainer(DOWNPOUR, zoo.mnist_mlp(hidden=32), mode="threads", num_epoch=3)
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.85
+    # all workers' partitions were consumed: commits from every worker
+    worker_ids = {wid for wid in range(4) if t.get_history(wid)}
+    assert worker_ids == {0, 1, 2, 3}
+
+
+def test_eamsgd_converges():
+    train, test = make_data(n=1024)
+    t = _trainer(
+        EAMSGD, zoo.mnist_mlp(hidden=32), momentum=0.3, rho=10.0, num_epoch=6
+    )
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.8
+
+
+def test_dynsgd_uses_versioned_ps():
+    train, _ = make_data(n=512)
+    t = _trainer(DynSGD, zoo.mnist_mlp(hidden=16), num_epoch=1)
+    t.train(train)
+    from distkeras_tpu.parameter_servers import DynSGDParameterServer
+
+    assert isinstance(t.parameter_server, DynSGDParameterServer)
+    assert t.parameter_server._meta["version"] == t.parameter_server.num_updates
+
+
+def test_downpour_single_worker_no_staleness_matches_sgd():
+    """With 1 worker the PS path is pure bookkeeping: DOWNPOUR must equal
+    plain SGD on the same data order (window restarts included)."""
+    from distkeras_tpu import SingleTrainer
+
+    train, _ = make_data(n=512)
+    dp = _trainer(
+        DOWNPOUR,
+        zoo.mnist_mlp(hidden=16),
+        num_workers=1,
+        num_epoch=1,
+        communication_window=4,
+    )
+    m_dp = dp.train(train)
+
+    # reproduce the worker's exact data order: partition(1) then shuffle(seed)
+    part = train.partition(1)[0].shuffle(0)
+    single = SingleTrainer(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        learning_rate=0.02,
+        batch_size=32,
+        num_epoch=1,
+        label_col="label_onehot",
+        seed=0,
+    )
+    m_s = single.train(part)
+    for wa, wb in zip(m_dp.get_weights(), m_s.get_weights()):
+        np.testing.assert_allclose(wa, wb, rtol=1e-4, atol=1e-5)
+
+
+def test_aeasgd_elastic_pull_toward_center():
+    """One elastic window moves the center toward the worker and the worker
+    toward the center by exactly rho*lr*(x_local - x_center)."""
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+    from distkeras_tpu.workers import AEASGDWorker, WorkerCore
+    from distkeras_tpu.ops.optimizers import get_optimizer
+
+    m = zoo.mnist_mlp(hidden=8)
+    core = WorkerCore(m, get_optimizer("sgd", 0.0), "categorical_crossentropy")
+    ps = DeltaParameterServer(m.params)
+    w = AEASGDWorker(
+        core, ps, 0, "features", "label_onehot", 1, rho=1.0, learning_rate=0.1
+    )
+    # hand the worker a shifted local replica; lr=0 so training is a no-op
+    shift = 1.0
+    w._params = {k: {kk: vv + shift for kk, vv in v.items()} for k, v in m.params.items()}
+    batch = {
+        "features": np.zeros((4, 784), np.float32),
+        "label_onehot": np.eye(10, dtype=np.float32)[[0, 1, 2, 3]],
+    }
+    w.begin_window([batch])
+    w.finish_window()
+    # elastic displacement = rho*lr*shift = 0.1 per element
+    center = ps.get_params()
+    np.testing.assert_allclose(
+        np.asarray(center["0"]["bias"]),
+        np.asarray(m.params["0"]["bias"]) + 0.1 * shift,
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(w._params["0"]["bias"]),
+        np.asarray(m.params["0"]["bias"]) + shift - 0.1 * shift,
+        rtol=1e-5,
+    )

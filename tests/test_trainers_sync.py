@@ -1,0 +1,150 @@
+"""Sync trainers: convergence anchors + DP-vs-single parity (SURVEY §7.4)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import (
+    AveragingTrainer,
+    EnsembleTrainer,
+    SingleTrainer,
+    SynchronousDistributedTrainer,
+)
+from distkeras_tpu.data import loaders
+from distkeras_tpu.data.transformers import MinMaxTransformer, OneHotTransformer
+from distkeras_tpu.evaluators import AccuracyEvaluator
+from distkeras_tpu.models import zoo
+from distkeras_tpu.predictors import ModelPredictor
+
+
+def make_data(n=2048, seed=0):
+    ds = loaders.synthetic_mnist(n=n, seed=seed)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    return ds.split(0.85, seed=seed)
+
+
+def accuracy_of(model, test):
+    pred = ModelPredictor(model, batch_size=256).predict(test)
+    return AccuracyEvaluator(label_col="label").evaluate(pred)
+
+
+def test_single_trainer_converges():
+    train, test = make_data()
+    m = zoo.mnist_mlp(hidden=64)
+    t = SingleTrainer(
+        m,
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=64,
+        num_epoch=3,
+        label_col="label_onehot",
+    )
+    trained = t.train(train)
+    acc = accuracy_of(trained, test)
+    assert acc > 0.95, f"accuracy {acc}"
+    hist = t.get_history()
+    assert len(hist) == 3 * (len(train) // 64)
+    assert hist[0]["loss"] > hist[-1]["loss"]
+    assert t.get_training_time() > 0
+
+
+def test_single_trainer_adam_and_callable_loss():
+    train, test = make_data(n=1024)
+    from distkeras_tpu.ops.losses import categorical_crossentropy
+
+    m = zoo.mnist_mlp(hidden=32)
+    t = SingleTrainer(
+        m,
+        "adam",
+        categorical_crossentropy,
+        batch_size=64,
+        num_epoch=2,
+        label_col="label_onehot",
+    )
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.9
+
+
+def test_sync_dp_matches_single_at_equal_global_batch():
+    """Allreduce DP with 8 workers x batch 8 must track a single worker with
+    batch 64 (same data order, no shuffling): convergence-parity gate."""
+    train, _ = make_data(n=1024)
+    kw = dict(
+        loss="categorical_crossentropy",
+        learning_rate=0.05,
+        num_epoch=1,
+        label_col="label_onehot",
+        seed=0,
+    )
+    single = SingleTrainer(
+        zoo.mnist_mlp(hidden=32), "sgd", batch_size=64, **kw
+    )
+    m_single = single.train(train)
+
+    dp = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=32), "sgd", batch_size=8, num_workers=8, **kw
+    )
+    m_dp = dp.train(train)
+
+    for a, b in zip(m_single.get_weights(), m_dp.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_sync_dp_converges_on_8_devices():
+    train, test = make_data(n=2048)
+    t = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=64),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=16,
+        num_workers=8,
+        num_epoch=3,
+        label_col="label_onehot",
+    )
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.95
+
+
+def test_ensemble_trainer_returns_n_models():
+    train, test = make_data(n=1024)
+    t = EnsembleTrainer(
+        zoo.mnist_mlp(hidden=32),
+        "sgd",
+        learning_rate=0.05,
+        batch_size=32,
+        num_epoch=8,
+        num_models=3,
+        label_col="label_onehot",
+    )
+    models = t.train(train)
+    assert len(models) == 3
+    accs = [accuracy_of(m, test) for m in models]
+    assert all(a > 0.8 for a in accs), accs
+    # independent inits: models must differ
+    w0, w1 = models[0].get_weights()[0], models[1].get_weights()[0]
+    assert not np.allclose(w0, w1)
+
+
+def test_averaging_trainer_converges():
+    train, test = make_data(n=1024)
+    t = AveragingTrainer(
+        zoo.mnist_mlp(hidden=32),
+        "sgd",
+        learning_rate=0.05,
+        batch_size=32,
+        num_epoch=8,
+        num_workers=4,
+        label_col="label_onehot",
+    )
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.9
+
+
+def test_unbuilt_model_raises():
+    from distkeras_tpu.models.sequential import Sequential
+    from distkeras_tpu.models.layers import Dense
+
+    with pytest.raises(ValueError):
+        SingleTrainer(Sequential([Dense(4)]), "sgd")
