@@ -1,0 +1,96 @@
+"""Benchmark harness: north-star MNIST CNN throughput on the local chip(s).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+Baseline: `BASELINE.json.published` is `{}` (nothing citable exists for the
+reference), so per BASELINE.md the comparison point is a documented analytic
+estimate of the reference's per-executor throughput: dist-keras drives Keras
+`train_on_batch` from a Python row-iterator inside a Spark executor, with
+pickle/TCP pull-commit to a driver-hosted PS. For the MNIST CNN
+(~32-64ch convs + 256-dense, batch 32), 2016-era published Keras/TF
+single-GPU figures and the framework's own per-row Python + serialization
+overheads put a well-tuned executor at ~2,000 samples/sec. We take
+
+    SPARK_BASELINE_SAMPLES_PER_SEC_PER_EXECUTOR = 2000.0
+
+as the stand-in; `vs_baseline` = measured samples/sec/chip divided by it.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+SPARK_BASELINE = 2000.0  # samples/sec/executor, analytic estimate (see above)
+
+BATCH = 1024
+WINDOW = 16  # steps fused into one XLA program per dispatch
+WARMUP_WINDOWS = 2
+TIMED_WINDOWS = 8
+
+
+def main():
+    import jax
+
+    from distkeras_tpu.models.zoo import mnist_cnn
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.workers import WorkerCore
+
+    n_chips = len(jax.devices())
+    print(
+        f"devices: {n_chips} x {jax.devices()[0].platform}", file=sys.stderr
+    )
+
+    model = mnist_cnn(seed=0)
+    core = WorkerCore(
+        model,
+        get_optimizer("sgd", 0.01),
+        "categorical_crossentropy",
+        compute_dtype="bfloat16",
+    )
+
+    rng = np.random.default_rng(0)
+    xs = rng.random((WINDOW, BATCH, 28, 28, 1), np.float32)
+    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, (WINDOW, BATCH))]
+
+    params = model.params
+    state = model.state
+    opt_state = core.init_opt_state(params)
+    key = jax.random.PRNGKey(0)
+
+    def run(params, state, opt_state, key):
+        params, state, opt_state, key, mets = core.window(
+            params, state, opt_state, key, xs, ys
+        )
+        return params, state, opt_state, key, mets
+
+    for _ in range(WARMUP_WINDOWS):
+        params, state, opt_state, key, mets = run(params, state, opt_state, key)
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_WINDOWS):
+        params, state, opt_state, key, mets = run(params, state, opt_state, key)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    samples = TIMED_WINDOWS * WINDOW * BATCH
+    sps = samples / dt  # single-chip run: per-chip == total
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_cnn_train_samples_per_sec_per_chip",
+                "value": round(sps, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(sps / SPARK_BASELINE, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""CIFAR-10 CNN — ADAG (Hermans' accumulated gradient normalization;
+BASELINE config 4)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from distkeras_tpu import (
+    ADAG,
+    AccuracyEvaluator,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    ModelPredictor,
+    OneHotTransformer,
+)
+from distkeras_tpu.data.loaders import synthetic_cifar10
+from distkeras_tpu.models.zoo import cifar10_cnn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--n", type=int, default=8192)
+    args = ap.parse_args()
+
+    raw = synthetic_cifar10(n=args.n)
+    ds = MinMaxTransformer(0.0, 1.0, 0.0, 255.0)(raw)
+    ds = OneHotTransformer(10, input_col="label", output_col="label_onehot")(ds)
+    train, test = ds.split(0.9, seed=7)
+
+    model = cifar10_cnn(seed=0)
+    trainer = ADAG(
+        model, worker_optimizer="adam", loss="categorical_crossentropy",
+        label_col="label_onehot", batch_size=args.batch,
+        num_epoch=args.epochs, num_workers=args.workers,
+        communication_window=5, compute_dtype="bfloat16",
+    )
+    t0 = time.time()
+    trained = trainer.train(train, shuffle=True)
+    print(f"trained in {time.time() - t0:.1f}s; "
+          f"PS updates: {trainer.parameter_server.num_updates}")
+
+    pred = ModelPredictor(trained).predict(test)
+    pred = LabelIndexTransformer(10)(pred)
+    acc = AccuracyEvaluator(
+        prediction_col="prediction_index", label_col="label"
+    ).evaluate(pred)
+    print(f"test accuracy: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()
