@@ -79,6 +79,12 @@ class ParameterServer:
         self._meta = {"num_updates": 0}
         self._lock = threading.Lock()
         self.stopped = threading.Event()
+        # snapshot hook: every `snapshot_every` commits, `on_snapshot(n,
+        # center_copy, meta_copy)` fires with a copy taken INSIDE the commit's
+        # locked section — the state labelled n really is the n-update state
+        # even while other workers keep committing (checkpointing uses this)
+        self.snapshot_every = 0
+        self.on_snapshot = None
 
     # -- protocol verbs -----------------------------------------------------
 
@@ -90,10 +96,21 @@ class ParameterServer:
         return center, tag
 
     def commit(self, delta, tag=None):
+        snap = None
         with self._lock:
             self._center, self._meta = type(self).commit_rule(
                 self._center, self._meta, delta, tag
             )
+            n = self._meta.get("num_updates", 0)
+            cb = self.on_snapshot
+            if (
+                cb is not None
+                and self.snapshot_every > 0
+                and n % self.snapshot_every == 0
+            ):
+                snap = (jax.tree.map(np.copy, self._center), dict(self._meta))
+        if snap is not None:
+            cb(n, *snap)  # heavy IO outside the lock; content still == step n
 
     def _pull_tag(self):
         return None
@@ -113,6 +130,18 @@ class ParameterServer:
     def reset(self, params):
         with self._lock:
             self._center = _to_host(params)
+
+    def snapshot(self):
+        """Consistent (center copy, meta copy) — the checkpoint payload.
+        Meta includes the DynSGD version counter, so staleness bookkeeping
+        survives a restore."""
+        with self._lock:
+            return jax.tree.map(np.copy, self._center), dict(self._meta)
+
+    def restore_snapshot(self, center, meta):
+        with self._lock:
+            self._center = _to_host(center)
+            self._meta = dict(meta)
 
     @property
     def num_updates(self) -> int:

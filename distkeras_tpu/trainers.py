@@ -24,6 +24,7 @@ TPU-native mapping (SURVEY §7.1):
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 import numpy as np
@@ -41,7 +42,9 @@ from distkeras_tpu.parameter_servers import (
     DynSGDParameterServer,
     SocketParameterServer,
 )
+from distkeras_tpu.utils.checkpoint import Checkpointer
 from distkeras_tpu.utils.history import TrainingHistory
+from distkeras_tpu.utils.profiling import MetricsLogger, trace as profiler_trace
 from distkeras_tpu.utils.serialization import serialize_model
 from distkeras_tpu.utils.tree import host_copy, tree_mean
 from distkeras_tpu.workers import (
@@ -75,6 +78,8 @@ class Trainer:
         num_epoch=1,
         seed=0,
         compute_dtype=None,
+        profile_dir=None,
+        metrics_path=None,
     ):
         if model.params is None:
             raise ValueError("model must be built (call model.build(input_shape))")
@@ -93,6 +98,9 @@ class Trainer:
         self.seed = int(seed)
         self.compute_dtype = compute_dtype
         self.history = TrainingHistory()
+        # observability (absent upstream — SURVEY §5.1/§5.5 required addition)
+        self.profile_dir = profile_dir
+        self.metrics_logger = MetricsLogger(metrics_path) if metrics_path else None
 
     def _make_core(self, optimizer=None) -> WorkerCore:
         return WorkerCore(
@@ -125,7 +133,67 @@ class Trainer:
     def serialize(self) -> bytes:
         return serialize_model(self.model)
 
-    def train(self, dataset, shuffle=False):
+    # -- checkpointing (absent upstream — SURVEY §5.4 required addition) ----
+
+    def _init_checkpointing(self, checkpoint_dir, checkpoint_every, max_to_keep):
+        self.checkpointer = (
+            Checkpointer(checkpoint_dir, max_to_keep=max_to_keep)
+            if checkpoint_dir
+            else None
+        )
+        self.checkpoint_every = int(checkpoint_every)
+
+    def _restore_latest(self):
+        """(step, trees, meta) of the latest checkpoint, or None."""
+        if self.checkpointer is None or self.checkpointer.latest_step() is None:
+            return None
+        return self.checkpointer.restore()
+
+    def _save_epoch_checkpoint(self, done, params, state, opt_state, rng):
+        """Epoch-granular snapshot policy shared by SingleTrainer and the
+        sync-DP trainer: every `checkpoint_every` epochs (0 = final only)
+        and always at the last epoch."""
+        if self.checkpointer is None:
+            return
+        every = self.checkpoint_every
+        if (every > 0 and done % every == 0) or done == self.num_epoch:
+            self.checkpointer.save(
+                done,
+                {
+                    "params": params,
+                    "state": state,
+                    "opt_state": opt_state,
+                    "rng": rng,
+                },
+                {"epoch": done},
+            )
+
+    def train(self, dataset, shuffle=False, **kwargs):
+        """Public entry: optional device profile around the run (xprof trace
+        into ``profile_dir``) + structured summary into ``metrics_path``."""
+        if self.profile_dir:
+            with profiler_trace(self.profile_dir):
+                result = self._train(dataset, shuffle=shuffle, **kwargs)
+        else:
+            result = self._train(dataset, shuffle=shuffle, **kwargs)
+        self._log_summary()
+        return result
+
+    def _log_summary(self):
+        if self.metrics_logger is None:
+            return
+        avg = {f"avg_{k}": v for k, v in self.get_averaged_metrics().items()}
+        self.metrics_logger.log(
+            event="train_end",
+            trainer=type(self).__name__,
+            training_time=self.get_training_time(),
+            num_updates=self.history.num_updates(),
+            total_samples=self.history.total_samples(),
+            samples_per_sec=self.history.samples_per_second(),
+            **avg,
+        )
+
+    def _train(self, dataset, shuffle=False):
         raise NotImplementedError
 
 
@@ -133,12 +201,22 @@ class SingleTrainer(Trainer):
     """One worker, one device — the correctness anchor (reference:
     distkeras/trainers.py -> SingleTrainer; BASELINE config 1)."""
 
-    def __init__(self, *args, window=8, device=None, **kwargs):
+    def __init__(
+        self,
+        *args,
+        window=8,
+        device=None,
+        checkpoint_dir=None,
+        checkpoint_every=1,
+        max_to_keep=3,
+        **kwargs,
+    ):
         super().__init__(*args, **kwargs)
         self.window = int(window)
         self.device = device
+        self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
-    def train(self, dataset, shuffle=False):
+    def _train(self, dataset, shuffle=False, resume=False):
         self.history.record_training_start()
         core = self._make_core()
         worker = SingleTrainerWorker(
@@ -148,14 +226,38 @@ class SingleTrainer(Trainer):
             seed=self.seed,
             device=self.device,
         )
+
+        initial_full, start_epoch = None, 0
+        if resume:
+            restored = self._restore_latest()
+            if restored is not None:
+                _, trees, meta = restored
+                initial_full = (
+                    trees["params"],
+                    trees["state"],
+                    trees["opt_state"],
+                    trees["rng"],
+                )
+                start_epoch = int(meta["epoch"])
+
+        on_epoch_end = None
+        if self.checkpointer is not None:
+            def on_epoch_end(epoch, params, state, opt_state, rng):
+                self._save_epoch_checkpoint(epoch + 1, params, state, opt_state, rng)
+
         params, state, records = worker.train(
             dataset,
             self.batch_size,
             num_epoch=self.num_epoch,
             window=self.window,
             shuffle_seed=self.seed if shuffle else None,
+            initial_full=initial_full,
+            start_epoch=start_epoch,
+            on_epoch_end=on_epoch_end,
         )
         self.history.extend(0, records)
+        for s, dt in worker.timings:
+            self.history.record_window(0, s, dt)
         self.history.record_training_end()
         return self._finish(params, state)
 
@@ -171,25 +273,47 @@ class SynchronousDistributedTrainer(Trainer):
     north-star]. Windows of W steps are scanned inside one XLA program.
     """
 
-    def __init__(self, *args, num_workers=None, window=8, mesh=None, **kwargs):
+    def __init__(
+        self,
+        *args,
+        num_workers=None,
+        window=8,
+        mesh=None,
+        checkpoint_dir=None,
+        checkpoint_every=1,
+        max_to_keep=3,
+        **kwargs,
+    ):
         super().__init__(*args, **kwargs)
         self.mesh = mesh if mesh is not None else make_mesh(num_workers)
         self.num_workers = int(self.mesh.devices.size)
         self.window = int(window)
+        self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
-    def train(self, dataset, shuffle=False):
+    def _train(self, dataset, shuffle=False, resume=False):
         self.history.record_training_start()
         core = self._make_core()
         global_batch = self.batch_size * self.num_workers
 
-        params = replicate(host_copy(self.model.params), self.mesh)
-        state = replicate(host_copy(self.model.state), self.mesh)
-        opt_state = replicate(core.init_opt_state(params), self.mesh)
-        rng = jax.random.PRNGKey(self.seed)
+        start_epoch = 0
+        restored = self._restore_latest() if resume else None
+        if restored is not None:
+            _, trees, meta = restored
+            params = replicate(trees["params"], self.mesh)
+            state = replicate(trees["state"], self.mesh)
+            opt_state = replicate(trees["opt_state"], self.mesh)
+            rng = jax.device_put(trees["rng"])
+            start_epoch = int(meta["epoch"])
+        else:
+            params = replicate(host_copy(self.model.params), self.mesh)
+            state = replicate(host_copy(self.model.state), self.mesh)
+            opt_state = replicate(core.init_opt_state(params), self.mesh)
+            rng = jax.random.PRNGKey(self.seed)
         data_sh = batch_sharding(self.mesh)
         cols = [self.features_col, self.label_col]
 
         def run_window(params, state, opt_state, rng, batches):
+            t0 = time.perf_counter()
             xs, ys = stack_window(batches, self.features_col, self.label_col)
             xs = jax.device_put(xs, data_sh.update(spec=(None, "data")))
             ys = jax.device_put(ys, data_sh.update(spec=(None, "data")))
@@ -197,9 +321,12 @@ class SynchronousDistributedTrainer(Trainer):
                 params, state, opt_state, rng, xs, ys
             )
             self.history.extend(0, _metrics_to_records(mets))
+            self.history.record_window(
+                0, xs.shape[0] * xs.shape[1], time.perf_counter() - t0
+            )
             return params, state, opt_state, rng
 
-        for epoch in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
             pend = []
             for batch in ds.batches(global_batch, columns=cols):
@@ -213,6 +340,7 @@ class SynchronousDistributedTrainer(Trainer):
                 params, state, opt_state, rng = run_window(
                     params, state, opt_state, rng, pend
                 )
+            self._save_epoch_checkpoint(epoch + 1, params, state, opt_state, rng)
 
         self.history.record_training_end()
         return self._finish(params, state)
@@ -227,7 +355,7 @@ class EnsembleTrainer(Trainer):
         self.num_models = int(num_models)
         self.window = int(window)
 
-    def train(self, dataset, shuffle=False):
+    def _train(self, dataset, shuffle=False):
         self.history.record_training_start()
         parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
             self.num_models
@@ -256,6 +384,8 @@ class EnsembleTrainer(Trainer):
                 initial=(model_i.params, model_i.state),
             )
             self.history.extend(i, records)
+            for s, dt in worker.timings:
+                self.history.record_window(i, s, dt)
             model_i.params = jax.tree.map(np.asarray, params)
             model_i.state = jax.tree.map(np.asarray, state)
             results[i] = model_i
@@ -281,7 +411,7 @@ class AveragingTrainer(Trainer):
         self.num_workers = int(num_workers)
         self.window = int(window)
 
-    def train(self, dataset, shuffle=False):
+    def _train(self, dataset, shuffle=False):
         self.history.record_training_start()
         core = self._make_core()
         parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
@@ -309,6 +439,7 @@ class AveragingTrainer(Trainer):
                 ):
                     pend.append(batch)
                     if len(pend) == self.window:
+                        t0 = time.perf_counter()
                         xs, ys = stack_window(
                             pend, self.features_col, self.label_col
                         )
@@ -317,14 +448,21 @@ class AveragingTrainer(Trainer):
                             params_i, state_i, opt_i, rng, xs, ys
                         )
                         records.extend(_metrics_to_records(mets))
+                        self.history.record_window(
+                            i, xs.shape[0] * xs.shape[1], time.perf_counter() - t0
+                        )
                         pend = []
                 if pend:
+                    t0 = time.perf_counter()
                     xs, ys = stack_window(pend, self.features_col, self.label_col)
                     xs, ys = jax.device_put((xs, ys), dev)
                     params_i, state_i, opt_i, rng, mets = core.window(
                         params_i, state_i, opt_i, rng, xs, ys
                     )
                     records.extend(_metrics_to_records(mets))
+                    self.history.record_window(
+                        i, xs.shape[0] * xs.shape[1], time.perf_counter() - t0
+                    )
                 self.history.extend(i, records)
                 results[i] = (
                     jax.tree.map(np.asarray, params_i),
@@ -370,6 +508,9 @@ class DistributedTrainer(Trainer):
         communication_window=5,
         mode="threads",
         serve_socket=False,
+        checkpoint_dir=None,
+        checkpoint_every=0,
+        max_to_keep=3,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -379,6 +520,8 @@ class DistributedTrainer(Trainer):
         self.serve_socket = bool(serve_socket)
         self.parameter_server = None
         self.service = None
+        # checkpoint_every is in PS commits here (0 = final snapshot only)
+        self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
     # -- template hooks -----------------------------------------------------
 
@@ -415,10 +558,32 @@ class DistributedTrainer(Trainer):
 
     # -- run ----------------------------------------------------------------
 
-    def train(self, dataset, shuffle=False):
+    def _attach_checkpointing(self, ps):
+        """Wire per-N-commits snapshots onto the PS (center + meta, so
+        DynSGD's version counter survives a restart). The copy is taken
+        inside the commit's locked section — the checkpoint labelled n is
+        exactly the n-update center."""
+        if self.checkpointer is None:
+            return
+
+        def on_snapshot(n, center, meta):
+            self.checkpointer.save(n, {"center": center}, {"ps_meta": meta})
+
+        ps.snapshot_every = self.checkpoint_every
+        ps.on_snapshot = on_snapshot
+
+    def _train(self, dataset, shuffle=False, resume=False):
         self.history.record_training_start()
         core = self._make_core()
         self.parameter_server = self.allocate_parameter_server()
+        if resume:
+            restored = self._restore_latest()
+            if restored is not None:
+                _, trees, meta = restored
+                self.parameter_server.restore_snapshot(
+                    trees["center"], meta.get("ps_meta", {})
+                )
+        self._attach_checkpointing(self.parameter_server)
         self.start_service()
         parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
             self.num_workers
@@ -439,7 +604,14 @@ class DistributedTrainer(Trainer):
 
         for w in workers:
             self.history.extend(w.worker_id, w.records)
+            for s, dt in w.timings:
+                self.history.record_window(w.worker_id, s, dt)
         self.stop_service()
+        if self.checkpointer is not None:
+            center, meta = self.parameter_server.snapshot()
+            self.checkpointer.save(
+                meta.get("num_updates", 0), {"center": center}, {"ps_meta": meta}
+            )
         self.history.record_training_end()
         state = workers[0]._state
         return self._finish(self.parameter_server.get_params(), state)

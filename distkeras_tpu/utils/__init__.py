@@ -24,3 +24,4 @@ from distkeras_tpu.utils.serialization import (
 )
 from distkeras_tpu.utils.history import TrainingHistory
 from distkeras_tpu.utils.rng import RngSeq
+from distkeras_tpu.utils.checkpoint import Checkpointer

@@ -1,0 +1,120 @@
+"""Checkpoint / resume subsystem.
+
+The reference has NO checkpointing (reference: distkeras/parameter_servers.py
+-> ParameterServer holds weights only in memory; weights surface once, at
+``train()`` end — SURVEY §5.4), so mid-training failure loses everything.
+This module is the compensating addition the rebuild requires:
+
+- ``Checkpointer``: step-numbered, atomic, retained-N on-disk snapshots of
+  named pytrees plus a JSON metadata dict. Directory layout::
+
+      <dir>/ckpt_0000000042/
+          params.tree     (treedef + npz, via utils.serialization)
+          opt_state.tree
+          meta.json
+
+  Writes land in a temp dir first and are published with ``os.replace`` so a
+  crash mid-save never leaves a readable-but-corrupt checkpoint.
+
+- Trainer integration (see trainers.py): epoch-granular snapshots for
+  SingleTrainer / SynchronousDistributedTrainer (params, state, opt_state,
+  rng — resume is bit-identical to an uninterrupted run), and PS-update-
+  granular snapshots for the async PS trainers (center + PS meta, so DynSGD's
+  staleness version counter survives a restart).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+
+import jax
+import numpy as np
+
+from distkeras_tpu.utils.serialization import deserialize_params, serialize_params
+
+_PREFIX = "ckpt_"
+_WIDTH = 10
+
+
+class Checkpointer:
+    """Atomic on-disk checkpoints of named pytrees + JSON metadata."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = int(max_to_keep)
+        os.makedirs(self.directory, exist_ok=True)
+        self._lock = threading.Lock()
+
+    # -- paths ---------------------------------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_PREFIX}{step:0{_WIDTH}d}")
+
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_PREFIX):
+                try:
+                    steps.append(int(name[len(_PREFIX) :]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save / restore ------------------------------------------------------
+
+    def save(self, step: int, trees: dict | None = None, meta: dict | None = None):
+        """Write checkpoint ``step``. Returns False if it already exists
+        (concurrent committers may race to the same step; first wins)."""
+        step = int(step)
+        final = self._step_dir(step)
+        with self._lock:
+            if os.path.exists(final):
+                return False
+            tmp = os.path.join(
+                self.directory, f".tmp_{step}_{os.getpid()}_{threading.get_ident()}"
+            )
+            os.makedirs(tmp, exist_ok=True)
+            try:
+                for name, tree in (trees or {}).items():
+                    host = jax.tree.map(np.asarray, tree)
+                    with open(os.path.join(tmp, f"{name}.tree"), "wb") as f:
+                        f.write(serialize_params(host))
+                with open(os.path.join(tmp, "meta.json"), "w") as f:
+                    json.dump(meta or {}, f)
+                os.replace(tmp, final)
+            finally:
+                if os.path.isdir(tmp):
+                    shutil.rmtree(tmp, ignore_errors=True)
+            self._retain()
+        return True
+
+    def restore(self, step: int | None = None):
+        """Return ``(step, trees, meta)`` for ``step`` (default: latest).
+        Raises FileNotFoundError if there is nothing to restore."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        d = self._step_dir(int(step))
+        if not os.path.isdir(d):
+            raise FileNotFoundError(d)
+        trees = {}
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".tree"):
+                with open(os.path.join(d, name), "rb") as f:
+                    trees[name[: -len(".tree")]] = deserialize_params(f.read())
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        return int(step), trees, meta
+
+    def _retain(self):
+        steps = self.all_steps()
+        for step in steps[: -self.max_to_keep] if self.max_to_keep > 0 else []:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)

@@ -17,6 +17,7 @@ class TrainingHistory:
 
     def __init__(self):
         self._records = defaultdict(list)  # worker_id -> list of dict
+        self._windows = defaultdict(list)  # worker_id -> list of (samples, sec)
         self._t_start = None
         self._t_end = None
 
@@ -51,6 +52,30 @@ class TrainingHistory:
 
     def num_updates(self) -> int:
         return sum(len(v) for v in self._records.values())
+
+    # -- throughput bookkeeping (profiling subsystem; absent upstream) ------
+
+    def record_window(self, worker_id: int, samples: int, seconds: float):
+        """One dispatched window: how many samples, how long (wall)."""
+        self._windows[worker_id].append((int(samples), float(seconds)))
+
+    def get_timings(self, worker_id=None):
+        if worker_id is not None:
+            return list(self._windows[worker_id])
+        merged = []
+        for wid in sorted(self._windows):
+            merged.extend(self._windows[wid])
+        return merged
+
+    def total_samples(self) -> int:
+        return sum(s for s, _ in self.get_timings())
+
+    def samples_per_second(self) -> float:
+        """Aggregate throughput: total samples / total wall time. Windows
+        overlap across workers (async), so wall time, not summed window time,
+        is the honest denominator."""
+        t = self.get_training_time()
+        return self.total_samples() / t if t > 0 else 0.0
 
     def averages(self) -> dict:
         merged = self.get_history()

@@ -1,0 +1,80 @@
+"""Profiling, tracing, and structured metrics — the observability subsystem.
+
+The reference has essentially none of this (SURVEY §5.1/§5.5: wall-clock
+bookkeeping plus the Spark web UI; print-level logging; no structured sink).
+The rebuild adds the TPU-native equivalents:
+
+- ``trace(logdir)``: context manager around ``jax.profiler.trace`` — captures
+  an XLA/xprof device profile (MXU utilization, HBM traffic, per-op timing)
+  viewable in TensorBoard/Perfetto. Trainers expose it via ``profile_dir=``.
+- ``annotate(name)``: named trace span (``jax.profiler.TraceAnnotation``) so
+  host-side phases (pull/commit, data staging) show up in the timeline.
+- ``MetricsLogger``: append-only JSONL metrics sink (thread-safe) — the
+  structured-logging layer the reference lacks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+
+@contextmanager
+def trace(logdir: str):
+    """Capture a device profile for the enclosed block into ``logdir``."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def annotate(name: str):
+    """Named span on the profiler timeline (host-side phases)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class MetricsLogger:
+    """Thread-safe JSONL sink: one JSON object per line, ``ts`` added."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def log(self, **fields):
+        # open-append-close per record: no fd held between logs (a sweep can
+        # construct thousands of trainers without leaking handles), and a
+        # whole line lands per write so concurrent loggers never interleave
+        record = {"ts": time.time(), **fields}
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+        return record
+
+    def close(self):
+        pass  # nothing held open; kept for API compatibility
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_metrics(path: str):
+    """Read a JSONL metrics file back into a list of dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

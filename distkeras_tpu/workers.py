@@ -20,6 +20,8 @@ compute) and ``finish_window`` (fetch result + commit) so that
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -156,6 +158,9 @@ class SingleTrainerWorker:
         self.label_col = label_col
         self.rng = jax.random.PRNGKey(seed)
         self.device = device
+        # (samples, dispatch-to-dispatch seconds) per window; at steady state
+        # dispatch time tracks device time via queue backpressure
+        self.timings = []
 
     def train(
         self,
@@ -165,22 +170,38 @@ class SingleTrainerWorker:
         window=8,
         shuffle_seed=None,
         initial=None,
+        initial_full=None,
+        start_epoch=0,
+        on_epoch_end=None,
     ):
         """``initial``: optional (params, state) to start from instead of the
-        core model's (lets many workers share one compiled core)."""
-        if initial is not None:
-            params, state = host_copy(initial[0]), host_copy(initial[1])
+        core model's (lets many workers share one compiled core).
+        ``initial_full``: optional (params, state, opt_state, rng) — the full
+        restore point a checkpoint resume supplies; with ``start_epoch`` this
+        makes the continuation bit-identical to an uninterrupted run.
+        ``on_epoch_end(epoch, params, state, opt_state, rng)``: checkpoint
+        hook, called after each epoch's last window."""
+        if initial_full is not None:
+            params, state, opt_state, rng = (
+                host_copy(initial_full[0]),
+                host_copy(initial_full[1]),
+                initial_full[2],
+                initial_full[3],
+            )
         else:
-            params = host_copy(self.core.model.params)
-            state = host_copy(self.core.model.state)
-        opt_state = self.core.init_opt_state(params)
+            if initial is not None:
+                params, state = host_copy(initial[0]), host_copy(initial[1])
+            else:
+                params = host_copy(self.core.model.params)
+                state = host_copy(self.core.model.state)
+            opt_state = self.core.init_opt_state(params)
+            rng = self.rng
         if self.device is not None:
             params, state, opt_state = jax.device_put(
                 (params, state, opt_state), self.device
             )
-        rng = self.rng
         records = []
-        for epoch in range(num_epoch):
+        for epoch in range(start_epoch, num_epoch):
             ds = (
                 dataset.shuffle(shuffle_seed + epoch)
                 if shuffle_seed is not None
@@ -202,16 +223,21 @@ class SingleTrainerWorker:
                     params, state, opt_state, rng, pend
                 )
                 records.extend(records_w)
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, params, state, opt_state, rng)
         return params, state, records
 
     def _run(self, params, state, opt_state, rng, batches):
+        t0 = time.perf_counter()
         xs, ys = stack_window(batches, self.features_col, self.label_col)
         if self.device is not None:
             xs, ys = jax.device_put((xs, ys), self.device)
         params, state, opt_state, rng, mets = self.core.window(
             params, state, opt_state, rng, xs, ys
         )
-        return params, state, opt_state, rng, _metrics_to_records(mets)
+        records = _metrics_to_records(mets)  # forces mets -> window finished
+        self.timings.append((xs.shape[0] * xs.shape[1], time.perf_counter() - t0))
+        return params, state, opt_state, rng, records
 
 
 # -------------------------------------------------------------- async workers
@@ -250,6 +276,7 @@ class AsyncWorker:
         self.rng = jax.random.fold_in(jax.random.PRNGKey(seed), worker_id)
         self.device = device
         self.records = []
+        self.timings = []  # (samples, begin->commit seconds) per window
         # persistent local slots
         self._params = None
         self._state = None
@@ -295,7 +322,12 @@ class AsyncWorker:
         out = fn(self._params, self._state, self._opt_state, self.rng, xs, ys)
         # keep the host copy for delta computation: the device-side center may
         # be donated by the window call through self._params
-        self._pending = {"pulled": (center_host, tag), "out": out}
+        self._pending = {
+            "pulled": (center_host, tag),
+            "out": out,
+            "samples": xs.shape[0] * xs.shape[1],
+            "t0": time.perf_counter(),
+        }
 
     def finish_window(self):
         pend = self._pending
@@ -315,6 +347,9 @@ class AsyncWorker:
         self.records.extend(_metrics_to_records(mets))
         delta, tag = self.make_delta(pend["pulled"], result)
         self.ps.commit(jax.tree.map(np.asarray, delta), tag)
+        self.timings.append(
+            (pend["samples"], time.perf_counter() - pend["t0"])
+        )
 
     def train(self, dataset, batch_size, num_epoch=1, shuffle_seed=None):
         """Thread-mode entry: run all windows of this worker's partition."""

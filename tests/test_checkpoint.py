@@ -1,0 +1,232 @@
+"""Checkpoint/resume: atomic store, bit-identical resume, PS snapshots.
+
+The reference has no checkpointing at all (SURVEY §5.4); these tests define
+the rebuild's added contract: a resumed run continues exactly where an
+uninterrupted run would be.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import DOWNPOUR, DynSGD, SingleTrainer, SynchronousDistributedTrainer
+from distkeras_tpu.data import loaders
+from distkeras_tpu.data.transformers import MinMaxTransformer, OneHotTransformer
+from distkeras_tpu.models import zoo
+from distkeras_tpu.parameter_servers import DynSGDParameterServer
+from distkeras_tpu.utils.checkpoint import Checkpointer
+
+
+def make_data(n=512, seed=0):
+    ds = loaders.synthetic_mnist(n=n, seed=seed)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    return ds
+
+
+# ------------------------------------------------------------- Checkpointer
+
+
+def test_checkpointer_roundtrip(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=2)
+    assert ck.latest_step() is None
+
+    tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.ones(3)}
+    assert ck.save(1, {"params": tree}, {"epoch": 1})
+    assert ck.save(5, {"params": tree}, {"epoch": 5})
+    step, trees, meta = ck.restore()
+    assert step == 5 and meta == {"epoch": 5}
+    np.testing.assert_array_equal(trees["params"]["w"], tree["w"])
+
+    # explicit step restore
+    step, _, meta = ck.restore(1)
+    assert step == 1 and meta["epoch"] == 1
+
+    # duplicate step: first writer wins
+    assert not ck.save(5, {"params": tree}, {"epoch": 99})
+    _, _, meta = ck.restore(5)
+    assert meta["epoch"] == 5
+
+
+def test_checkpointer_retention(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        ck.save(s, {"t": {"x": np.zeros(1)}}, {})
+    assert ck.all_steps() == [3, 4]
+    # no stray temp dirs left behind
+    assert all(n.startswith("ckpt_") for n in os.listdir(tmp_path))
+
+
+def test_checkpointer_missing_restore(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        ck.restore()
+
+
+# ------------------------------------------------- epoch-granular trainers
+
+
+def test_single_trainer_resume_bit_identical(tmp_path):
+    """Interrupt after 2 of 3 epochs, resume — identical to uninterrupted."""
+    ds = make_data()
+    kw = dict(
+        worker_optimizer="sgd",
+        loss="categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=64,
+        label_col="label_onehot",
+        seed=3,
+    )
+
+    full = SingleTrainer(zoo.mnist_mlp(hidden=16, seed=7), num_epoch=3, **kw)
+    ref = full.train(ds, shuffle=True)
+
+    ck_dir = str(tmp_path / "single")
+    a = SingleTrainer(
+        zoo.mnist_mlp(hidden=16, seed=7), num_epoch=2, checkpoint_dir=ck_dir, **kw
+    )
+    a.train(ds, shuffle=True)
+    assert Checkpointer(ck_dir).latest_step() == 2
+
+    b = SingleTrainer(
+        zoo.mnist_mlp(hidden=16, seed=7), num_epoch=3, checkpoint_dir=ck_dir, **kw
+    )
+    out = b.train(ds, shuffle=True, resume=True)
+
+    for la, lb in zip(ref.get_weights(), out.get_weights()):
+        np.testing.assert_allclose(la, lb, rtol=0, atol=0)
+    # resume ran only the third epoch
+    assert len(b.get_history()) == len(ds) // 64
+
+
+def test_sync_dp_trainer_resume_bit_identical(tmp_path):
+    ds = make_data()
+    kw = dict(
+        worker_optimizer="sgd",
+        loss="categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=16,
+        num_workers=4,
+        label_col="label_onehot",
+        seed=3,
+    )
+
+    full = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=16, seed=7), num_epoch=2, **kw
+    )
+    ref = full.train(ds)
+
+    ck_dir = str(tmp_path / "sync")
+    a = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=16, seed=7), num_epoch=1, checkpoint_dir=ck_dir, **kw
+    )
+    a.train(ds)
+    b = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=16, seed=7), num_epoch=2, checkpoint_dir=ck_dir, **kw
+    )
+    out = b.train(ds, resume=True)
+
+    for la, lb in zip(ref.get_weights(), out.get_weights()):
+        np.testing.assert_allclose(la, lb, rtol=0, atol=0)
+
+
+def test_single_trainer_checkpoint_every_zero_means_final_only(tmp_path):
+    ds = make_data(n=256)
+    ck_dir = str(tmp_path / "final_only")
+    t = SingleTrainer(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=64,
+        num_epoch=2,
+        label_col="label_onehot",
+        checkpoint_dir=ck_dir,
+        checkpoint_every=0,
+    )
+    t.train(ds)
+    assert Checkpointer(ck_dir).all_steps() == [2]
+
+
+# --------------------------------------------------- PS-granular (async)
+
+
+def test_downpour_checkpoints_every_n_commits(tmp_path):
+    ds = make_data(n=640)
+    ck_dir = str(tmp_path / "dp")
+    t = DOWNPOUR(
+        zoo.mnist_mlp(hidden=16),
+        worker_optimizer="sgd",
+        loss="categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=32,
+        num_workers=2,
+        communication_window=2,
+        num_epoch=1,
+        mode="simulated",
+        label_col="label_onehot",
+        checkpoint_dir=ck_dir,
+        checkpoint_every=2,
+    )
+    t.train(ds)
+    ck = Checkpointer(ck_dir)
+    steps = ck.all_steps()
+    assert steps, "no checkpoints written"
+    final = t.parameter_server.num_updates
+    assert final in steps  # final snapshot always lands
+    import jax
+
+    _, trees, meta = ck.restore()
+    for a, b in zip(
+        jax.tree.leaves(trees["center"]),
+        jax.tree.leaves(t.parameter_server.get_params()),
+    ):
+        np.testing.assert_allclose(a, b)
+    assert meta["ps_meta"]["num_updates"] == final
+
+
+def test_dynsgd_resume_restores_version_counter(tmp_path):
+    ds = make_data(n=256)
+    ck_dir = str(tmp_path / "dyn")
+    t = DynSGD(
+        zoo.mnist_mlp(hidden=16),
+        worker_optimizer="sgd",
+        loss="categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=32,
+        num_workers=2,
+        communication_window=2,
+        num_epoch=1,
+        mode="simulated",
+        label_col="label_onehot",
+        checkpoint_dir=ck_dir,
+    )
+    t.train(ds)
+    version = t.parameter_server._meta["version"]
+    assert version > 0
+
+    # restore into a fresh PS: center and version counter both survive
+    _, trees, meta = Checkpointer(ck_dir).restore()
+    ps2 = DynSGDParameterServer(trees["center"])
+    ps2.restore_snapshot(trees["center"], meta["ps_meta"])
+    assert ps2._meta["version"] == version
+    _, tag = ps2.pull()
+    assert tag == version
+
+    # and a resumed trainer keeps training from the checkpoint
+    t2 = DynSGD(
+        zoo.mnist_mlp(hidden=16),
+        worker_optimizer="sgd",
+        loss="categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=32,
+        num_workers=2,
+        communication_window=2,
+        num_epoch=1,
+        mode="simulated",
+        label_col="label_onehot",
+        checkpoint_dir=ck_dir,
+    )
+    t2.train(ds, resume=True)
+    assert t2.parameter_server._meta["version"] > version

@@ -1,0 +1,129 @@
+"""Observability subsystem: JSONL metrics sink, throughput bookkeeping,
+xprof device traces (all absent upstream — SURVEY §5.1/§5.5)."""
+
+import glob
+import threading
+
+import pytest
+
+from distkeras_tpu import DOWNPOUR, SingleTrainer
+from distkeras_tpu.data import loaders
+from distkeras_tpu.data.transformers import MinMaxTransformer, OneHotTransformer
+from distkeras_tpu.models import zoo
+from distkeras_tpu.utils.history import TrainingHistory
+from distkeras_tpu.utils.profiling import MetricsLogger, annotate, read_metrics
+
+
+def make_data(n=512, seed=0):
+    ds = loaders.synthetic_mnist(n=n, seed=seed)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    return ds
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path) as log:
+        log.log(event="a", x=1)
+        log.log(event="b", y=2.5)
+    records = read_metrics(path)
+    assert [r["event"] for r in records] == ["a", "b"]
+    assert records[0]["x"] == 1 and "ts" in records[0]
+
+
+def test_metrics_logger_thread_safe(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    log = MetricsLogger(path)
+
+    def write(i):
+        for j in range(50):
+            log.log(event="tick", worker=i, j=j)
+
+    threads = [threading.Thread(target=write, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    records = read_metrics(path)  # every line parses — no interleaved writes
+    assert len(records) == 200
+
+
+def test_history_throughput():
+    h = TrainingHistory()
+    h.record_training_start()
+    h.record_window(0, 100, 0.5)
+    h.record_window(1, 300, 0.5)
+    h.record_training_end()
+    assert h.total_samples() == 400
+    assert len(h.get_timings()) == 2
+    assert len(h.get_timings(0)) == 1
+    assert h.samples_per_second() > 0
+
+
+def test_single_trainer_logs_summary(tmp_path):
+    ds = make_data()
+    path = str(tmp_path / "train.jsonl")
+    t = SingleTrainer(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=64,
+        num_epoch=1,
+        label_col="label_onehot",
+        metrics_path=path,
+    )
+    t.train(ds)
+    (rec,) = read_metrics(path)
+    assert rec["event"] == "train_end"
+    assert rec["trainer"] == "SingleTrainer"
+    assert rec["total_samples"] == (len(ds) // 64) * 64
+    assert rec["samples_per_sec"] > 0
+    assert "avg_loss" in rec and "avg_accuracy" in rec
+    assert t.history.total_samples() == rec["total_samples"]
+
+
+def test_downpour_records_per_worker_timings(tmp_path):
+    ds = make_data(n=256)
+    t = DOWNPOUR(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=32,
+        num_workers=2,
+        communication_window=2,
+        num_epoch=1,
+        mode="simulated",
+        label_col="label_onehot",
+        metrics_path=str(tmp_path / "dp.jsonl"),
+    )
+    t.train(ds)
+    assert t.history.get_timings(0) and t.history.get_timings(1)
+    (rec,) = read_metrics(str(tmp_path / "dp.jsonl"))
+    assert rec["trainer"] == "DOWNPOUR"
+    assert rec["total_samples"] == 256
+
+
+def test_profile_trace_writes_artifacts(tmp_path):
+    ds = make_data(n=128)
+    prof = str(tmp_path / "prof")
+    t = SingleTrainer(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=64,
+        num_epoch=1,
+        label_col="label_onehot",
+        profile_dir=prof,
+    )
+    t.train(ds)
+    artifacts = glob.glob(f"{prof}/**/*", recursive=True)
+    assert any("xplane" in a or a.endswith(".pb") for a in artifacts), artifacts
+
+
+def test_annotate_is_usable():
+    with annotate("pull"):
+        pass

