@@ -46,3 +46,6 @@ from distkeras_tpu.data.transformers import (
     LabelIndexTransformer,
 )
 from distkeras_tpu.models.sequential import Sequential, Model
+from distkeras_tpu.job_deployment import Job
+from distkeras_tpu.utils.checkpoint import Checkpointer
+from distkeras_tpu.utils.profiling import MetricsLogger

@@ -1,0 +1,61 @@
+"""Multi-host (multi-controller) runtime bootstrap.
+
+TPU-native replacement for the role Spark's cluster runtime plays in the
+reference (reference: distkeras/trainers.py -> DistributedTrainer launches
+workers across executors via the Spark driver). On TPU pods there is no
+driver JVM: every host runs the same program and joins a JAX distributed
+coordination service; XLA collectives then ride ICI between all chips.
+
+``initialize()`` reads the standard coordinator env vars (as emitted by
+``job_deployment.Job``) or explicit kwargs and calls
+``jax.distributed.initialize``. Safe to call on single-host (no-op).
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_COORDINATOR = "DKT_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "DKT_NUM_PROCESSES"
+ENV_PROCESS_ID = "DKT_PROCESS_ID"
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Join the multi-host job (no-op when single-process).
+
+    Resolution order: explicit kwargs > DKT_* env vars > single-process.
+    Returns True if ``jax.distributed.initialize`` was called.
+    """
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
+    if num_processes is None:
+        num_processes = int(os.environ.get(ENV_NUM_PROCESSES, "1"))
+    if process_id is None:
+        process_id = int(os.environ.get(ENV_PROCESS_ID, "0"))
+    if coordinator_address is None or int(num_processes) <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes),
+        process_id=int(process_id),
+    )
+    return True
+
+
+def process_id() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def num_processes() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the process that plays the reference's 'driver' role (rank 0
+    hosts the async PS; others connect over DCN via the socket protocol)."""
+    return process_id() == 0

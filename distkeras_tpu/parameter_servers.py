@@ -20,9 +20,13 @@ staleness/normalization semantics exactly (SURVEY §7.4).
 
 from __future__ import annotations
 
+import logging
 import pickle
 import socket
 import threading
+import time
+
+logger = logging.getLogger(__name__)
 
 import jax
 import numpy as np
@@ -85,19 +89,41 @@ class ParameterServer:
         # even while other workers keep committing (checkpointing uses this)
         self.snapshot_every = 0
         self.on_snapshot = None
+        # fault tolerance (absent upstream — SURVEY §5.3: Spark task retry
+        # silently re-trains a partition and the PS double-absorbs its
+        # commits): per-worker last-seen commit sequence numbers make commits
+        # exactly-once under retry, and last-activity timestamps give the
+        # trainer a heartbeat to detect dead workers.
+        self._seen_seq = {}  # worker_id -> highest committed seq
+        self._activity = {}  # worker_id -> last pull/commit wall time
 
     # -- protocol verbs -----------------------------------------------------
 
-    def pull(self):
+    def pull(self, worker_id=None):
         """Return (copy of center, tag). Tag is None unless versioned."""
         with self._lock:
             center = jax.tree.map(np.copy, self._center)
             tag = self._pull_tag()
+            if worker_id is not None:
+                self._activity[worker_id] = time.monotonic()
         return center, tag
 
-    def commit(self, delta, tag=None):
+    def commit(self, delta, tag=None, commit_id=None):
+        """Apply a delta. ``commit_id=(worker_id, seq)`` makes the commit
+        exactly-once: a retried worker re-sends seq numbers the PS has
+        already absorbed and they are dropped (counted in meta
+        ``num_duplicates``) instead of double-applied."""
         snap = None
         with self._lock:
+            if commit_id is not None:
+                wid, seq = commit_id
+                self._activity[wid] = time.monotonic()
+                if seq <= self._seen_seq.get(wid, -1):
+                    self._meta["num_duplicates"] = (
+                        self._meta.get("num_duplicates", 0) + 1
+                    )
+                    return
+                self._seen_seq[wid] = seq
             self._center, self._meta = type(self).commit_rule(
                 self._center, self._meta, delta, tag
             )
@@ -110,7 +136,31 @@ class ParameterServer:
             ):
                 snap = (jax.tree.map(np.copy, self._center), dict(self._meta))
         if snap is not None:
-            cb(n, *snap)  # heavy IO outside the lock; content still == step n
+            # heavy IO outside the lock; content still == step n. A snapshot
+            # failure (disk full, perms) must not surface as a *worker*
+            # failure — the committing thread is an arbitrary worker and
+            # retrying it would re-train a healthy partition.
+            try:
+                cb(n, *snap)
+            except Exception:
+                logger.exception("parameter-server snapshot at step %d failed", n)
+
+    # -- failure detection --------------------------------------------------
+
+    def suspected_failures(self, timeout: float, now=None):
+        """Worker ids whose last pull/commit is older than ``timeout``."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return sorted(
+                wid
+                for wid, last in self._activity.items()
+                if now - last > timeout
+            )
+
+    @property
+    def num_duplicates(self) -> int:
+        with self._lock:
+            return self._meta.get("num_duplicates", 0)
 
     def _pull_tag(self):
         return None
@@ -233,13 +283,21 @@ class SocketParameterServer:
                 if not action:
                     break
                 if action == b"p":
-                    center, tag = self.ps.pull()
+                    # pull payload: pickled worker_id (None for anonymous) —
+                    # keeps the heartbeat live for remote workers too
+                    worker_id = pickle.loads(networking.recv_data(conn))
+                    center, tag = self.ps.pull(worker_id=worker_id)
                     networking.send_data(
                         conn, pickle.dumps((serialize_params(center), tag))
                     )
                 elif action == b"c":
-                    blob, tag = pickle.loads(networking.recv_data(conn))
-                    self.ps.commit(deserialize_params(blob), tag)
+                    payload = pickle.loads(networking.recv_data(conn))
+                    # (blob, tag) legacy or (blob, tag, commit_id)
+                    blob, tag = payload[0], payload[1]
+                    commit_id = payload[2] if len(payload) > 2 else None
+                    self.ps.commit(
+                        deserialize_params(blob), tag, commit_id=commit_id
+                    )
                     conn.sendall(b"k")
                 elif action == b"s":
                     self.stop()
@@ -265,14 +323,17 @@ class RemoteParameterServerClient:
         self._sock = networking.connect(host, port)
         self._lock = threading.Lock()
 
-    def pull(self):
+    def pull(self, worker_id=None):
         with self._lock:
             self._sock.sendall(b"p")
+            networking.send_data(self._sock, pickle.dumps(worker_id))
             blob, tag = pickle.loads(networking.recv_data(self._sock))
         return deserialize_params(blob), tag
 
-    def commit(self, delta, tag=None):
-        payload = pickle.dumps((serialize_params(_to_host(delta)), tag))
+    def commit(self, delta, tag=None, commit_id=None):
+        payload = pickle.dumps(
+            (serialize_params(_to_host(delta)), tag, commit_id)
+        )
         with self._lock:
             self._sock.sendall(b"c")
             networking.send_data(self._sock, payload)

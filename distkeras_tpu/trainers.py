@@ -355,7 +355,9 @@ class EnsembleTrainer(Trainer):
         self.num_models = int(num_models)
         self.window = int(window)
 
-    def _train(self, dataset, shuffle=False):
+    def _train(self, dataset, shuffle=False, resume=False):
+        if resume:
+            raise ValueError("EnsembleTrainer does not support resume")
         self.history.record_training_start()
         parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
             self.num_models
@@ -411,7 +413,9 @@ class AveragingTrainer(Trainer):
         self.num_workers = int(num_workers)
         self.window = int(window)
 
-    def _train(self, dataset, shuffle=False):
+    def _train(self, dataset, shuffle=False, resume=False):
+        if resume:
+            raise ValueError("AveragingTrainer does not support resume")
         self.history.record_training_start()
         core = self._make_core()
         parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
@@ -511,6 +515,8 @@ class DistributedTrainer(Trainer):
         checkpoint_dir=None,
         checkpoint_every=0,
         max_to_keep=3,
+        worker_retries=1,
+        heartbeat_timeout=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -522,6 +528,14 @@ class DistributedTrainer(Trainer):
         self.service = None
         # checkpoint_every is in PS commits here (0 = final snapshot only)
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
+        # fault tolerance (SURVEY §5.3): crashed worker threads are retried
+        # up to worker_retries times; commit-seq dedup at the PS makes the
+        # replay exactly-once. heartbeat_timeout (seconds) turns on a monitor
+        # thread that flags workers gone silent.
+        self.worker_retries = int(worker_retries)
+        self.heartbeat_timeout = heartbeat_timeout
+        self.failures = []
+        self.suspicions = []
 
     # -- template hooks -----------------------------------------------------
 
@@ -574,6 +588,7 @@ class DistributedTrainer(Trainer):
 
     def _train(self, dataset, shuffle=False, resume=False):
         self.history.record_training_start()
+        self.failures, self.suspicions = [], []
         core = self._make_core()
         self.parameter_server = self.allocate_parameter_server()
         if resume:
@@ -643,13 +658,47 @@ class DistributedTrainer(Trainer):
         jax.block_until_ready(out)
 
     def _run_threads(self, workers, parts):
+        done = set()  # worker ids that exited (finished or gave up) — a
+        done_lock = threading.Lock()  # completed worker is not a failure
+
         def run(w, part):
-            w.train(
-                part,
-                self.batch_size,
-                num_epoch=self.num_epoch,
-                shuffle_seed=self.seed + w.worker_id,
+            try:
+                for attempt in range(self.worker_retries + 1):
+                    try:
+                        w.train(
+                            part,
+                            self.batch_size,
+                            num_epoch=self.num_epoch,
+                            shuffle_seed=self.seed + w.worker_id,
+                        )
+                        return
+                    except Exception as e:  # noqa: BLE001 — crash boundary
+                        failure = {
+                            "worker_id": w.worker_id,
+                            "attempt": attempt,
+                            "error": repr(e),
+                        }
+                        self.failures.append(failure)
+                        if self.metrics_logger is not None:
+                            self.metrics_logger.log(
+                                event="worker_failure", **failure
+                            )
+                        if attempt == self.worker_retries:
+                            return  # give up; others keep training
+                        w.reset_for_retry()
+            finally:
+                with done_lock:
+                    done.add(w.worker_id)
+
+        stop_monitor = threading.Event()
+        monitor = None
+        if self.heartbeat_timeout is not None:
+            monitor = threading.Thread(
+                target=self._monitor_heartbeats,
+                args=(stop_monitor, done, done_lock),
+                daemon=True,
             )
+            monitor.start()
 
         threads = [
             threading.Thread(target=run, args=(w, p))
@@ -659,6 +708,27 @@ class DistributedTrainer(Trainer):
             t.start()
         for t in threads:
             t.join()
+        stop_monitor.set()
+        if monitor is not None:
+            monitor.join()
+
+    def _monitor_heartbeats(self, stop: threading.Event, done, done_lock):
+        """Failure-detection loop: flag workers whose last PS pull/commit is
+        older than heartbeat_timeout (absent upstream — SURVEY §5.3).
+        Workers that already exited are not suspects."""
+        timeout = float(self.heartbeat_timeout)
+        while not stop.wait(timeout / 2):
+            suspects = self.parameter_server.suspected_failures(timeout)
+            with done_lock:
+                suspects = [wid for wid in suspects if wid not in done]
+            for wid in suspects:
+                suspicion = {"worker_id": wid, "timeout": timeout}
+                if suspicion not in self.suspicions:
+                    self.suspicions.append(suspicion)
+                    if self.metrics_logger is not None:
+                        self.metrics_logger.log(
+                            event="worker_suspected", **suspicion
+                        )
 
     def _run_simulated(self, workers, parts):
         """Deterministic async: per round, begin windows in one seeded order

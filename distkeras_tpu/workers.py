@@ -273,11 +273,29 @@ class AsyncWorker:
         self.features_col = features_col
         self.label_col = label_col
         self.window_size = int(communication_window)
-        self.rng = jax.random.fold_in(jax.random.PRNGKey(seed), worker_id)
+        self._rng0 = jax.random.fold_in(jax.random.PRNGKey(seed), worker_id)
+        self.rng = self._rng0
         self.device = device
         self.records = []
         self.timings = []  # (samples, begin->commit seconds) per window
+        self._seq = 0  # per-worker commit sequence (exactly-once at the PS)
         # persistent local slots
+        self._params = None
+        self._state = None
+        self._opt_state = None
+        self._pending = None
+
+    def reset_for_retry(self):
+        """Restart this worker's training from scratch after a failure.
+
+        The commit sequence restarts at 0 too: the PS has already absorbed
+        seqs 0..k, so the re-run's first k+1 commits are deduplicated — the
+        retry cannot double-apply work (the reference's Spark-retry
+        double-absorb weakness, SURVEY §5.3)."""
+        self.rng = self._rng0
+        self.records = []
+        self.timings = []
+        self._seq = 0
         self._params = None
         self._state = None
         self._opt_state = None
@@ -307,7 +325,8 @@ class AsyncWorker:
             )
 
     def begin_window(self, batches):
-        center_host, tag = self.ps.pull()  # owned host (numpy) copies
+        # owned host (numpy) copies; worker_id doubles as the PS heartbeat
+        center_host, tag = self.ps.pull(worker_id=self.worker_id)
         center = (
             jax.device_put(center_host, self.device)
             if self.device is not None
@@ -346,7 +365,12 @@ class AsyncWorker:
         )
         self.records.extend(_metrics_to_records(mets))
         delta, tag = self.make_delta(pend["pulled"], result)
-        self.ps.commit(jax.tree.map(np.asarray, delta), tag)
+        self.ps.commit(
+            jax.tree.map(np.asarray, delta),
+            tag,
+            commit_id=(self.worker_id, self._seq),
+        )
+        self._seq += 1
         self.timings.append(
             (pend["samples"], time.perf_counter() - pend["t0"])
         )

@@ -131,6 +131,23 @@ def test_sync_dp_trainer_resume_bit_identical(tmp_path):
         np.testing.assert_allclose(la, lb, rtol=0, atol=0)
 
 
+def test_unsupported_trainers_reject_resume():
+    from distkeras_tpu import AveragingTrainer, EnsembleTrainer
+
+    ds = make_data(n=128)
+    for cls in (EnsembleTrainer, AveragingTrainer):
+        t = cls(
+            zoo.mnist_mlp(hidden=16),
+            "sgd",
+            "categorical_crossentropy",
+            batch_size=32,
+            num_epoch=1,
+            label_col="label_onehot",
+        )
+        with pytest.raises(ValueError, match="resume"):
+            t.train(ds, resume=True)
+
+
 def test_single_trainer_checkpoint_every_zero_means_final_only(tmp_path):
     ds = make_data(n=256)
     ck_dir = str(tmp_path / "final_only")

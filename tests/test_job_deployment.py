@@ -1,0 +1,100 @@
+"""Job packaging/deployment (reference: distkeras/job_deployment.py -> Job,
+rebuilt as bundle + per-host JAX-coordinator launchers instead of
+ssh + spark-submit). Everything but the actual ssh hop is tested offline."""
+
+import os
+import tarfile
+
+import pytest
+
+from distkeras_tpu.job_deployment import Job
+from distkeras_tpu.parallel import multihost
+
+
+@pytest.fixture
+def script(tmp_path):
+    p = tmp_path / "train.py"
+    p.write_text(
+        "import sys\n"
+        "from distkeras_tpu.parallel import multihost\n"
+        "print('pid', multihost.initialize(), sys.argv[1:])\n"
+        "print('MARKER_OK')\n"
+    )
+    return str(p)
+
+
+def test_package_contents(tmp_path, script):
+    job = Job(script, num_hosts=4, coordinator_address="10.0.0.1:9999",
+              script_args=["--epochs", "3"])
+    bundle = job.package(str(tmp_path / "job.tar.gz"))
+    with tarfile.open(bundle) as tar:
+        names = tar.getnames()
+    assert "train.py" in names
+    assert "run.sh" in names
+    assert "distkeras_tpu/trainers.py" in names
+    assert not any("__pycache__" in n for n in names)
+
+    text = job.launcher_text()
+    assert "DKT_COORDINATOR_ADDRESS=10.0.0.1:9999" in text
+    assert "DKT_NUM_PROCESSES=4" in text
+    assert "train.py --epochs 3" in text
+
+
+def test_launch_commands_one_per_host(script):
+    job = Job(script, num_hosts=3)
+    cmds = job.launch_commands(remote_dir="/opt/job")
+    assert len(cmds) == 3
+    assert cmds[0].endswith("run.sh 0") and cmds[2].endswith("run.sh 2")
+
+
+def test_submit_dry_run_emits_scp_and_ssh(script):
+    job = Job(script, num_hosts=2)
+    plans = job.submit(["tpu-host-a", "tpu-host-b"], ssh_user="me", dry_run=True)
+    assert len(plans) == 2
+    scp, ssh = plans[1]
+    assert scp[0] == "scp" and scp[-1] == "me@tpu-host-b:dkt_job.tar.gz"
+    assert ssh[0] == "ssh" and "run.sh 1" in ssh[-1]
+
+
+def test_submit_host_count_mismatch(script):
+    job = Job(script, num_hosts=2)
+    with pytest.raises(ValueError):
+        job.submit(["only-one"], dry_run=True)
+
+
+def test_run_local_executes_bundle(tmp_path, script):
+    job = Job(script, num_hosts=1, script_args=["--flag"])
+    proc = job.run_local(workdir=str(tmp_path / "wd"))
+    assert proc.returncode == 0, proc.stderr
+    assert "MARKER_OK" in proc.stdout
+    # single host: multihost.initialize() must be a no-op
+    assert "pid False" in proc.stdout
+
+
+def test_missing_script_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        Job(str(tmp_path / "nope.py"))
+
+
+def test_multihost_env_resolution(monkeypatch):
+    calls = {}
+
+    def fake_init(**kw):
+        calls.update(kw)
+
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setenv(multihost.ENV_COORDINATOR, "c:1")
+    monkeypatch.setenv(multihost.ENV_NUM_PROCESSES, "4")
+    monkeypatch.setenv(multihost.ENV_PROCESS_ID, "2")
+    assert multihost.initialize() is True
+    assert calls == {
+        "coordinator_address": "c:1",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+
+    # single-process env: no-op
+    monkeypatch.setenv(multihost.ENV_NUM_PROCESSES, "1")
+    assert multihost.initialize() is False
