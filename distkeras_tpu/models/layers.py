@@ -309,6 +309,89 @@ class Activation(Layer):
 
 
 @register_layer
+class MultiHeadSelfAttention(Layer):
+    """Multi-head self-attention over (batch, seq, features).
+
+    No reference counterpart (SURVEY §5.7: the reference has no attention);
+    this is the long-context building block of the TPU rebuild. On one chip
+    it computes dense softmax attention; for sequences sharded across a mesh
+    the same math is served by ``parallel.ring_attention.ring_attention``
+    (set ``layer.attention_fn`` or use the functional API), which rotates
+    K/V blocks over ICI with an online softmax.
+
+    ``attention_fn`` is a process-local hook: it closes over a live Mesh, so
+    it is intentionally NOT part of ``get_config`` and does not survive
+    serialize_model / from_config — a deserialized layer computes dense
+    attention until the receiving process re-attaches its own mesh hook
+    (get_config warns when a hook would be dropped).
+    """
+
+    def __init__(self, num_heads, head_dim=None, causal=False, use_bias=True):
+        self.num_heads = int(num_heads)
+        self.head_dim = None if head_dim is None else int(head_dim)
+        self.causal = bool(causal)
+        self.use_bias = bool(use_bias)
+        self.attention_fn = None  # override to plug in ring attention
+
+    def init(self, rng, in_shape):
+        t, d = in_shape[-2], in_shape[-1]
+        hd = self.head_dim or d // self.num_heads
+        if self.head_dim is None and d % self.num_heads:
+            raise ValueError(
+                f"features {d} not divisible by num_heads {self.num_heads}"
+            )
+        inner = self.num_heads * hd
+        ks = jax.random.split(rng, 4)
+        params = {
+            name: _glorot_uniform(k, shape, shape[0], shape[1])
+            for name, k, shape in [
+                ("wq", ks[0], (d, inner)),
+                ("wk", ks[1], (d, inner)),
+                ("wv", ks[2], (d, inner)),
+                ("wo", ks[3], (inner, d)),
+            ]
+        }
+        if self.use_bias:
+            params["bo"] = jnp.zeros((d,), jnp.float32)
+        return params, {}, (*in_shape[:-1], d)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        from distkeras_tpu.parallel.ring_attention import dense_attention
+
+        b, t, d = x.shape
+        h = self.num_heads
+        hd = params["wq"].shape[1] // h
+
+        def proj(w):
+            return (x @ w.astype(x.dtype)).reshape(b, t, h, hd)
+
+        q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
+        attn = self.attention_fn or dense_attention
+        o = attn(q, k, v, causal=self.causal)
+        o = o.reshape(b, t, h * hd) @ params["wo"].astype(x.dtype)
+        if self.use_bias:
+            o = o + params["bo"].astype(x.dtype)
+        return o, state
+
+    def get_config(self):
+        if self.attention_fn is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "MultiHeadSelfAttention.attention_fn is process-local and is "
+                "not serialized; the deserialized layer will use dense "
+                "attention until a mesh hook is re-attached"
+            )
+        return {
+            "layer": "MultiHeadSelfAttention",
+            "num_heads": self.num_heads,
+            "head_dim": self.head_dim,
+            "causal": self.causal,
+            "use_bias": self.use_bias,
+        }
+
+
+@register_layer
 class BatchNorm(Layer):
     """Batch normalization over all but the channel axis.
 

@@ -18,8 +18,17 @@ def _sgd(learning_rate=0.01, momentum=0.0, nesterov=False):
     return optax.sgd(learning_rate)
 
 
+def _pallas_sgd(learning_rate=0.01, momentum=0.0, nesterov=False):
+    """Fused single-pass SGD update as a Pallas TPU kernel (see
+    ops/pallas_kernels.py); numerically identical to "sgd"."""
+    from distkeras_tpu.ops.pallas_kernels import FusedSGD
+
+    return FusedSGD(learning_rate, momentum=momentum, nesterov=nesterov)
+
+
 _OPTIMIZERS = {
     "sgd": _sgd,
+    "pallas_sgd": _pallas_sgd,
     "adam": optax.adam,
     "adamw": optax.adamw,
     "adagrad": optax.adagrad,
@@ -29,8 +38,9 @@ _OPTIMIZERS = {
     "lamb": optax.lamb,
 }
 
-_DEFAULT_LR = {"sgd": 0.01, "adam": 1e-3, "adamw": 1e-3, "adagrad": 1e-2,
-               "adadelta": 1e-3, "rmsprop": 1e-3, "nadam": 1e-3, "lamb": 1e-3}
+_DEFAULT_LR = {"sgd": 0.01, "pallas_sgd": 0.01, "adam": 1e-3, "adamw": 1e-3,
+               "adagrad": 1e-2, "adadelta": 1e-3, "rmsprop": 1e-3,
+               "nadam": 1e-3, "lamb": 1e-3}
 
 
 def effective_learning_rate(name, learning_rate=None) -> float:

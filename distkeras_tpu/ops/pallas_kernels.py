@@ -1,0 +1,173 @@
+"""Pallas TPU kernels — the framework's hand-written native tier.
+
+The reference has no native components of its own (SURVEY §3.4); its compute
+runs in the Keras backend. Here the equivalent tier is XLA-compiled JAX plus
+these Pallas kernels for ops worth owning:
+
+- ``fused_sgd``: the optimizer update applied in ONE pass over each
+  parameter buffer (p' = p - lr*u and m' = mu*m + g computed together in
+  VMEM), instead of the separate update/apply traffic of the generic
+  optax path (reference: the worker optimizer step inside
+  distkeras/workers.py -> Worker.train's ``train_on_batch``).
+
+Kernels compile with Mosaic on TPU and fall back to interpreter mode on
+CPU (tests run on the 8-device CPU mesh), chosen at trace time.
+
+Layout: each parameter leaf is raveled and tiled to (rows, 128) f32 blocks
+(lane width 128, sublane multiple 8 — see the Pallas TPU guide's tiling
+table); leaves smaller than one tile use plain VPU-fused jnp math, where a
+kernel launch would cost more than it saves.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+BLOCK_ROWS = 512  # (512, 128) f32 = 256 KiB per buffer — comfortably in VMEM
+_MIN_KERNEL_SIZE = 8 * LANE  # below one f32 tile, jnp is cheaper
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _block_rows_for(n: int) -> int:
+    """Per-leaf block height: the sublane-aligned row count, capped at
+    BLOCK_ROWS — a leaf slightly over one tile pads to its own size, not to
+    a full 512-row block (64x waste for small leaves otherwise)."""
+    rows = pl.cdiv(n, LANE)
+    return min(int(np.ceil(rows / 8)) * 8, BLOCK_ROWS)
+
+
+def _pad_to_blocks(flat, block_rows):
+    """(n,) -> (rows, LANE) with rows a multiple of ``block_rows``."""
+    n = flat.shape[0]
+    rows = pl.cdiv(n, LANE)
+    rows_padded = int(np.ceil(rows / block_rows)) * block_rows
+    flat = jnp.pad(flat, (0, rows_padded * LANE - n))
+    return flat.reshape(rows_padded, LANE)
+
+
+def _unpad(mat, shape, dtype):
+    n = int(np.prod(shape)) if shape else 1
+    return mat.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+# ----------------------------------------------------------------- kernels
+
+
+def _sgd_kernel(lr, p_ref, g_ref, out_ref):
+    out_ref[:] = p_ref[:] - lr * g_ref[:]
+
+
+def _sgd_momentum_kernel(lr, mu, nesterov, p_ref, g_ref, m_ref, op_ref, om_ref):
+    m_new = mu * m_ref[:] + g_ref[:]
+    update = g_ref[:] + mu * m_new if nesterov else m_new
+    op_ref[:] = p_ref[:] - lr * update
+    om_ref[:] = m_new
+
+
+def _block_specs(num, block_rows):
+    return [
+        pl.BlockSpec((block_rows, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        for _ in range(num)
+    ]
+
+
+def _leaf_sgd(p, g, lr, interpret):
+    shape, dtype = p.shape, p.dtype
+    if p.size < _MIN_KERNEL_SIZE:
+        return (p.astype(jnp.float32) - lr * g.astype(jnp.float32)).astype(dtype)
+    br = _block_rows_for(p.size)
+    pm = _pad_to_blocks(p.ravel().astype(jnp.float32), br)
+    gm = _pad_to_blocks(g.ravel().astype(jnp.float32), br)
+    out = pl.pallas_call(
+        functools.partial(_sgd_kernel, lr),
+        out_shape=jax.ShapeDtypeStruct(pm.shape, jnp.float32),
+        grid=(pm.shape[0] // br,),
+        in_specs=_block_specs(2, br),
+        out_specs=_block_specs(1, br)[0],
+        interpret=interpret,
+    )(pm, gm)
+    return _unpad(out, shape, dtype)
+
+
+def _leaf_sgd_momentum(p, g, m, lr, mu, nesterov, interpret):
+    shape, dtype = p.shape, p.dtype
+    if p.size < _MIN_KERNEL_SIZE:
+        p32, g32, m32 = (x.astype(jnp.float32) for x in (p, g, m))
+        m_new = mu * m32 + g32
+        update = g32 + mu * m_new if nesterov else m_new
+        return (p32 - lr * update).astype(dtype), m_new
+    br = _block_rows_for(p.size)
+    pm = _pad_to_blocks(p.ravel().astype(jnp.float32), br)
+    gm = _pad_to_blocks(g.ravel().astype(jnp.float32), br)
+    mm = _pad_to_blocks(m.ravel().astype(jnp.float32), br)
+    op, om = pl.pallas_call(
+        functools.partial(_sgd_momentum_kernel, lr, mu, nesterov),
+        out_shape=(
+            jax.ShapeDtypeStruct(pm.shape, jnp.float32),
+            jax.ShapeDtypeStruct(pm.shape, jnp.float32),
+        ),
+        grid=(pm.shape[0] // br,),
+        in_specs=_block_specs(3, br),
+        out_specs=tuple(_block_specs(2, br)),
+        interpret=interpret,
+    )(pm, gm, mm)
+    return _unpad(op, shape, dtype), _unpad(om, shape, jnp.float32)
+
+
+# ------------------------------------------------------------ optimizer API
+
+
+class FusedSGD:
+    """Fused-apply optimizer: one VMEM pass computes p' (and m') directly.
+
+    Exposes the ``init``/``fused_apply`` protocol WorkerCore prefers over
+    the two-step optax ``update``+``apply_updates`` when present.
+    """
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, nesterov=False):
+        self.learning_rate = float(learning_rate)
+        self.momentum = float(momentum)
+        self.nesterov = bool(nesterov)
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return ()
+        return jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+
+    def fused_apply(self, params, grads, state):
+        interpret = not _on_tpu()
+        if self.momentum == 0.0:
+            new_params = jax.tree.map(
+                lambda p, g: _leaf_sgd(p, g, self.learning_rate, interpret),
+                params,
+                grads,
+            )
+            return new_params, state
+        out = jax.tree.map(
+            lambda p, g, m: _leaf_sgd_momentum(
+                p, g, m, self.learning_rate, self.momentum,
+                self.nesterov, interpret,
+            ),
+            params,
+            grads,
+            state,
+        )
+        new_params = jax.tree.map(
+            lambda pair: pair[0], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        new_state = jax.tree.map(
+            lambda pair: pair[1], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        return new_params, new_state

@@ -1,0 +1,132 @@
+"""Ring attention — sequence/context parallelism over a TPU mesh axis.
+
+The reference has no attention and no sequence dimension anywhere
+(SURVEY §3.3/§5.7: MLP/CNN/tabular only), so this module has no reference
+counterpart; it is the long-context capability the TPU rebuild adds so the
+framework scales past single-chip sequence lengths.
+
+Design (blockwise/ring attention, Liu et al. 2023 pattern, built from XLA
+collectives rather than a port of anything):
+
+- the sequence axis is sharded across a mesh axis (``"seq"``): every device
+  holds a local block of Q, K, V;
+- each device computes attention of its Q block against the K/V block it
+  currently holds, accumulating with an **online softmax** (running max +
+  running normalizer, so the full score matrix never materializes);
+- K/V blocks rotate one hop around the ring per step via ``lax.ppermute``
+  (ICI neighbor exchange — bandwidth-optimal, latency hidden behind the
+  block matmuls); after ``axis_size`` steps every Q block has seen the full
+  sequence.
+
+Causal masking uses global block offsets, so device i's Q attends only to
+K positions <= its own even though K blocks arrive out of order.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attention(q, k, v, acc, m, l, q_off, k_off, scale, causal):
+    """One online-softmax accumulation step.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D); acc: (B, Tq, H, D) f32;
+    m, l: (B, H, Tq) running max / normalizer. Returns updated (acc, m, l).
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+
+    m_blk = jnp.max(s, axis=-1)  # (B, H, Tq)
+    m_new = jnp.maximum(m, m_blk)
+    # fully-masked rows (causal, early steps) keep m == -inf; exp(-inf - -inf)
+    # is nan, so guard the shift
+    shift = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - shift[..., None])  # (B, H, Tq, Tk)
+    correction = jnp.exp(jnp.where(jnp.isneginf(m), shift, m) - shift)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return acc_new, m_new, l_new
+
+
+def _ring_attention_local(q, k, v, axis_name, axis_size, scale, causal):
+    """Per-device body (runs under shard_map): rotate K/V around the ring."""
+    my_idx = jax.lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    acc = jnp.zeros((b, tq, h, d), jnp.float32)
+    m = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, tq), jnp.float32)
+    q_off = my_idx * tq
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    for step in range(axis_size):
+        src_idx = (my_idx - step) % axis_size  # whose block we hold now
+        acc, m, l = _block_attention(
+            q, k, v, acc, m, l, q_off, src_idx * k.shape[1], scale, causal
+        )
+        if step + 1 < axis_size:
+            k, v = jax.lax.ppermute((k, v), axis_name, perm)
+
+    # rows with no visible keys (can't happen for causal self-attn since a
+    # position always sees itself, but keep the division safe)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l[..., None].transpose(0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False):
+    """Multi-head attention with the sequence axis sharded over ``axis_name``.
+
+    q, k, v: (batch, seq, heads, head_dim), seq divisible by the axis size.
+    Returns (batch, seq, heads, head_dim) with the same sharding.
+    """
+    axis_size = mesh.shape[axis_name]
+    if q.shape[1] % axis_size:
+        raise ValueError(
+            f"seq length {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name}={axis_size}"
+        )
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=axis_name,
+            axis_size=axis_size,
+            scale=scale,
+            causal=causal,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return fn(q, k, v)
+
+
+def dense_attention(q, k, v, causal=False):
+    """Single-device reference: plain softmax attention, same layout."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1) <= (
+            jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        )
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)

@@ -70,14 +70,23 @@ class WorkerCore:
 
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
 
+        # fused-apply optimizers (ops/pallas_kernels.py) compute new params
+        # in one kernel pass; otherwise the standard optax two-step applies
+        if hasattr(optimizer, "fused_apply"):
+            def apply_opt(params, grads, opt_state):
+                return optimizer.fused_apply(params, grads, opt_state)
+        else:
+            def apply_opt(params, grads, opt_state):
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state
+
         def train_step(carry, batch):
             params, state, opt_state, rng = carry
             rng, sub = jax.random.split(rng)
             (loss, (state, y_pred)), grads = grad_fn(
                 params, state, sub, batch["x"], batch["y"]
             )
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            params, opt_state = apply_opt(params, grads, opt_state)
             mets = {"loss": loss}
             for name, fn in zip(self.metric_names, metric_fns):
                 mets[name] = fn(y_pred, batch["y"])
@@ -99,8 +108,7 @@ class WorkerCore:
                 (loss, (state, y_pred)), grads = grad_fn(
                     params, state, sub, batch["x"], batch["y"]
                 )
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                params, opt_state = apply_opt(params, grads, opt_state)
                 acc = jax.tree.map(jnp.add, acc, grads)
                 mets = {"loss": loss}
                 for name, fn in zip(self.metric_names, metric_fns):
