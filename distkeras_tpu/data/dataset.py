@@ -22,6 +22,17 @@ from __future__ import annotations
 import numpy as np
 
 
+def _take_rows(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Row gather behind shuffle/partition: the native C++ memcpy path for
+    contiguous float32 columns (distkeras_tpu/native), numpy otherwise."""
+    if col.dtype == np.float32 and col.flags["C_CONTIGUOUS"]:
+        from distkeras_tpu.data import native
+
+        if native.available():
+            return native.gather_rows(col, idx)
+    return col[idx]
+
+
 class Dataset:
     def __init__(self, columns: dict):
         if not columns:
@@ -43,7 +54,14 @@ class Dataset:
     def __getitem__(self, key):
         if isinstance(key, str):
             return self._cols[key]
-        if isinstance(key, (slice, np.ndarray, list)):
+        if isinstance(key, (np.ndarray, list)):
+            idx = np.asarray(key)
+            if idx.dtype.kind in "iu":  # row materialization (shuffle/partition)
+                return Dataset(
+                    {k: _take_rows(v, idx) for k, v in self._cols.items()}
+                )
+            return Dataset({k: v[idx] for k, v in self._cols.items()})
+        if isinstance(key, slice):
             return Dataset({k: v[key] for k, v in self._cols.items()})
         raise TypeError(f"bad key {key!r}")
 

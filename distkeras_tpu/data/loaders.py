@@ -21,11 +21,26 @@ from distkeras_tpu.data.dataset import Dataset
 
 
 def load_csv(path, label_col="label", dtype=np.float32) -> Dataset:
-    """CSV with a header row -> Dataset with 'features' + 'label' columns."""
+    """CSV with a header row -> Dataset with 'features' + 'label' columns.
+
+    The numeric body parses through the native C++ reader
+    (distkeras_tpu/native/dkt_data.cpp via data/native.py) when available;
+    a pure-Python csv loop is the fallback (DKT_NO_NATIVE=1 forces it).
+    """
+    from distkeras_tpu.data import native
+
     with open(path, newline="") as f:
-        reader = csv.reader(f)
-        header = next(reader)
-        rows = np.asarray([[float(v) for v in row] for row in reader], dtype)
+        header = next(csv.reader(f))
+    if native.available():
+        rows, had_header = native.read_csv(path)
+        if not had_header:
+            rows = rows[1:]  # contract: first line is always the header
+        rows = rows.astype(dtype, copy=False)
+    else:
+        with open(path, newline="") as f:
+            reader = csv.reader(f)
+            next(reader)  # header
+            rows = np.asarray([[float(v) for v in row] for row in reader], dtype)
     if label_col in header:
         li = header.index(label_col)
         label = rows[:, li].astype(np.int64)

@@ -1,0 +1,180 @@
+"""ctypes bridge to the native (C++) data-path library.
+
+Builds ``distkeras_tpu/native/dkt_data.cpp`` into a shared library on first
+use (g++ -O3 -shared -fPIC, compiled to a temp file and published
+atomically with os.replace, rebuilt when the source is newer) and exposes
+it with a pure-Python fallback contract: callers check ``available()`` and
+fall back when the toolchain is missing or ``DKT_NO_NATIVE=1``; calling an
+entry point while unavailable raises a clean RuntimeError.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native",
+    "dkt_data.cpp",
+)
+_SO = os.path.join(os.path.dirname(_SRC), "_dkt_data.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _disabled() -> bool:
+    return os.environ.get("DKT_NO_NATIVE", "") == "1"
+
+
+def _build() -> bool:
+    # compile to a private temp file, publish with an atomic rename:
+    # a concurrent process can never dlopen a half-written .so
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        logger.warning("native data library build failed (%s); using Python", e)
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return False
+
+
+def _load():
+    global _lib, _build_failed
+    if _disabled():
+        return None
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            _SRC
+        ):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            logger.warning("native data library load failed (%s)", e)
+            _build_failed = True
+            return None
+        lib.dkt_csv_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.dkt_csv_dims.restype = ctypes.c_int
+        lib.dkt_csv_load.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.dkt_csv_load.restype = ctypes.c_int
+        lib.dkt_free.argtypes = [ctypes.POINTER(ctypes.c_float)]
+        lib.dkt_free.restype = None
+        lib.dkt_gather_rows_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.dkt_gather_rows_f32.restype = None
+        _lib = lib
+        return _lib
+
+
+def _require():
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native data library unavailable (no C++ toolchain, build "
+            "failure, or DKT_NO_NATIVE=1) — use the Python fallback path"
+        )
+    return lib
+
+
+def available() -> bool:
+    """True when the native library is usable (built or buildable)."""
+    return _load() is not None
+
+
+def csv_dims(path: str):
+    """(rows, cols, has_header) for a numeric CSV."""
+    lib = _require()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    header = ctypes.c_int()
+    rc = lib.dkt_csv_dims(
+        path.encode(), ctypes.byref(rows), ctypes.byref(cols),
+        ctypes.byref(header),
+    )
+    if rc != 0:
+        raise OSError(f"native csv_dims failed for {path!r}")
+    return rows.value, cols.value, bool(header.value)
+
+
+def read_csv(path: str) -> tuple[np.ndarray, bool]:
+    """Single-pass parse of a numeric CSV -> (float32 (rows, cols) array,
+    had_header). One file read, one parse pass; quoted numeric fields OK;
+    empty/ragged fields raise (matching the Python fallback's strictness)."""
+    lib = _require()
+    data = ctypes.POINTER(ctypes.c_float)()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    header = ctypes.c_int()
+    rc = lib.dkt_csv_load(
+        path.encode(), ctypes.byref(data), ctypes.byref(rows),
+        ctypes.byref(cols), ctypes.byref(header),
+    )
+    if rc == -1:
+        raise OSError(f"native csv read failed for {path!r}")
+    if rc == -2:
+        raise ValueError(
+            f"native csv parse failed for {path!r}: malformed, empty, or "
+            "ragged field"
+        )
+    try:
+        n = rows.value * cols.value
+        out = np.ctypeslib.as_array(data, shape=(n,)).copy() if n else (
+            np.empty((0,), np.float32)
+        )
+    finally:
+        lib.dkt_free(data)
+    return out.reshape(rows.value, cols.value), bool(header.value)
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """dst[i] = src[idx[i]] along axis 0 for a float32 array (any rank)."""
+    lib = _require()
+    src = np.ascontiguousarray(src, np.float32)
+    idx = np.ascontiguousarray(idx, np.int64)
+    row_shape = src.shape[1:]
+    row_elems = int(np.prod(row_shape)) if row_shape else 1
+    out = np.empty((idx.shape[0], *row_shape), np.float32)
+    lib.dkt_gather_rows_f32(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        idx.shape[0],
+        row_elems,
+    )
+    return out

@@ -1,0 +1,6 @@
+"""Packaging shim (reference parity: the reference ships a setup.py; the
+actual metadata lives in pyproject.toml)."""
+
+from setuptools import setup
+
+setup()

@@ -1,0 +1,188 @@
+"""Native (C++) data-path library vs the pure-Python fallback."""
+
+import csv
+import importlib
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import loaders, native
+
+
+def write_csv(path, n=200, d=9, seed=0, header=True, label=True):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32) * 100
+    y = rng.integers(0, 10, n)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        if header:
+            w.writerow((["label"] if label else []) + [f"f{i}" for i in range(d)])
+        for i in range(n):
+            row = ([int(y[i])] if label else []) + [f"{v:.6g}" for v in x[i]]
+            w.writerow(row)
+    return x, y
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+@needs_native
+def test_csv_dims_and_header_detection(tmp_path):
+    p = str(tmp_path / "a.csv")
+    write_csv(p, n=50, d=4)
+    rows, cols, header = native.csv_dims(p)
+    assert (rows, cols, header) == (50, 5, True)
+
+    p2 = str(tmp_path / "b.csv")
+    with open(p2, "w") as f:
+        f.write("1.0,2.0\n3.0,4e-2\n\n5.0,-6.5\n")  # no header, blank line
+    rows, cols, header = native.csv_dims(p2)
+    assert (rows, cols, header) == (3, 2, False)
+
+
+@needs_native
+def test_native_read_matches_values(tmp_path):
+    p = str(tmp_path / "a.csv")
+    x, y = write_csv(p, n=123, d=7, seed=4)
+    out, header = native.read_csv(p)
+    assert header and out.shape == (123, 8)
+    np.testing.assert_array_equal(out[:, 0], y.astype(np.float32))
+    np.testing.assert_allclose(out[:, 1:], np.float32(x), rtol=1e-5)
+
+
+@needs_native
+def test_native_read_exponents_and_negatives(tmp_path):
+    p = str(tmp_path / "e.csv")
+    with open(p, "w") as f:
+        f.write("a,b,c\n-1.5e3,2E-4,+7\n0,-0.0,1e+2\n")
+    out, header = native.read_csv(p)
+    assert header
+    np.testing.assert_allclose(
+        out, [[-1500.0, 2e-4, 7.0], [0.0, -0.0, 100.0]], rtol=1e-6
+    )
+
+
+@needs_native
+def test_native_read_rejects_malformed(tmp_path):
+    p = str(tmp_path / "bad.csv")
+    with open(p, "w") as f:
+        f.write("a,b\n1.0,oops\n")
+    with pytest.raises(ValueError):
+        native.read_csv(p)
+
+
+@needs_native
+def test_native_read_rejects_empty_and_ragged_fields(tmp_path):
+    """A trailing empty field must be an error, not silently filled from
+    the next line (matches the Python fallback's strictness)."""
+    for body in ("a,b,c\n1,2,\n4,5,6\n",  # trailing empty field
+                 "a,b,c\n1,2\n",          # too few fields
+                 "a,b,c\n1,2,3,4\n"):      # extra field
+        p = str(tmp_path / "bad.csv")
+        with open(p, "w") as f:
+            f.write(body)
+        with pytest.raises(ValueError):
+            native.read_csv(p)
+
+
+@needs_native
+def test_native_read_quoted_fields(tmp_path):
+    """Quoted numeric fields load identically on both code paths."""
+    p = str(tmp_path / "q.csv")
+    with open(p, "w") as f:
+        f.write('label,f0\n"1","2.5"\n0,3.5\n')
+    out, header = native.read_csv(p)
+    assert header
+    np.testing.assert_allclose(out, [[1.0, 2.5], [0.0, 3.5]])
+
+    ds = loaders.load_csv(p)
+    np.testing.assert_allclose(ds["features"][:, 0], [2.5, 3.5])
+    np.testing.assert_array_equal(ds["label"], [1, 0])
+
+
+def test_entry_points_raise_cleanly_when_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("DKT_NO_NATIVE", "1")
+    assert not native.available()
+    with pytest.raises(RuntimeError, match="unavailable"):
+        native.read_csv(str(tmp_path / "x.csv"))
+    with pytest.raises(RuntimeError, match="unavailable"):
+        native.gather_rows(np.zeros((2, 2), np.float32), np.array([0]))
+
+
+@needs_native
+def test_dataset_shuffle_uses_native_gather():
+    """Dataset row materialization goes through the native gather for
+    contiguous float32 columns and stays value-identical to numpy."""
+    from distkeras_tpu.data.dataset import Dataset
+
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((300, 17)).astype(np.float32)
+    labels = rng.integers(0, 10, 300)
+    ds = Dataset({"features": feats, "label": labels})
+    shuffled = ds.shuffle(seed=42)
+    perm = np.random.default_rng(42).permutation(300)
+    np.testing.assert_array_equal(shuffled["features"], feats[perm])
+    np.testing.assert_array_equal(shuffled["label"], labels[perm])
+    # 4-D image columns too
+    imgs = rng.standard_normal((50, 8, 8, 3)).astype(np.float32)
+    ds2 = Dataset({"features": imgs, "label": labels[:50]})
+    out = ds2[np.arange(49, -1, -1)]
+    np.testing.assert_array_equal(out["features"], imgs[::-1])
+
+
+@needs_native
+def test_load_csv_native_vs_python_identical(tmp_path, monkeypatch):
+    p = str(tmp_path / "a.csv")
+    write_csv(p, n=100, d=5, seed=7)
+
+    ds_native = loaders.load_csv(p)
+
+    monkeypatch.setenv("DKT_NO_NATIVE", "1")
+    ds_python = loaders.load_csv(p)
+
+    np.testing.assert_allclose(
+        ds_native["features"], ds_python["features"], rtol=1e-5
+    )
+    np.testing.assert_array_equal(ds_native["label"], ds_python["label"])
+
+
+def test_load_csv_python_fallback(tmp_path, monkeypatch):
+    monkeypatch.setenv("DKT_NO_NATIVE", "1")
+    assert not native.available()
+    p = str(tmp_path / "a.csv")
+    x, y = write_csv(p, n=40, d=3)
+    ds = loaders.load_csv(p)
+    assert ds["features"].shape == (40, 3)
+    np.testing.assert_array_equal(ds["label"], y)
+
+
+@needs_native
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(0)
+    src = rng.standard_normal((500, 33)).astype(np.float32)
+    idx = rng.permutation(500)[:200]
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+@needs_native
+def test_native_csv_faster_than_python_loop(tmp_path):
+    """Not a strict benchmark — just assert the native path isn't slower on
+    a file big enough for parse cost to dominate."""
+    p = str(tmp_path / "big.csv")
+    write_csv(p, n=4000, d=50, seed=1)
+
+    t0 = time.perf_counter()
+    native.read_csv(p)
+    t_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    with open(p, newline="") as f:
+        reader = csv.reader(f)
+        next(reader)
+        np.asarray([[float(v) for v in row] for row in reader], np.float32)
+    t_python = time.perf_counter() - t0
+    assert t_native < t_python, (t_native, t_python)
