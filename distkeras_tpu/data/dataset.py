@@ -24,8 +24,16 @@ import numpy as np
 
 def _take_rows(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """Row gather behind shuffle/partition: the native C++ memcpy path for
-    contiguous float32 columns (distkeras_tpu/native), numpy otherwise."""
-    if col.dtype == np.float32 and col.flags["C_CONTIGUOUS"]:
+    contiguous float32 columns (distkeras_tpu/native), numpy otherwise.
+    Negative or out-of-range indices take the numpy path so semantics
+    (negative wrap, IndexError) never depend on the toolchain."""
+    if (
+        col.dtype == np.float32
+        and col.flags["C_CONTIGUOUS"]
+        and idx.size > 0
+        and 0 <= idx.min()
+        and idx.max() < col.shape[0]
+    ):
         from distkeras_tpu.data import native
 
         if native.available():

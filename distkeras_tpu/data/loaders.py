@@ -31,7 +31,9 @@ def load_csv(path, label_col="label", dtype=np.float32) -> Dataset:
 
     with open(path, newline="") as f:
         header = next(csv.reader(f))
-    if native.available():
+    # the native parser is float32; wider dtypes must keep full precision,
+    # so they always take the Python path
+    if np.dtype(dtype).itemsize <= 4 and native.available():
         rows, had_header = native.read_csv(path)
         if not had_header:
             rows = rows[1:]  # contract: first line is always the header

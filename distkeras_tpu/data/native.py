@@ -39,18 +39,24 @@ def _disabled() -> bool:
 
 def _build() -> bool:
     # compile to a private temp file, publish with an atomic rename:
-    # a concurrent process can never dlopen a half-written .so
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
-    os.close(fd)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
+    # a concurrent process can never dlopen a half-written .so. Any
+    # filesystem/toolchain failure (read-only package dir, missing g++)
+    # degrades to the Python fallback instead of raising.
+    tmp = None
     try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
+        os.close(fd)
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, _SO)
         return True
     except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
         logger.warning("native data library build failed (%s); using Python", e)
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+        if tmp is not None and os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 
@@ -61,9 +67,13 @@ def _load():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
-            _SRC
-        ):
+        try:
+            stale = not os.path.exists(_SO) or os.path.getmtime(
+                _SO
+            ) < os.path.getmtime(_SRC)
+        except OSError:  # e.g. source missing from a stripped install
+            stale = not os.path.exists(_SO)
+        if stale:
             if not _build():
                 _build_failed = True
                 return None

@@ -320,8 +320,21 @@ class RemoteParameterServerClient:
     """Worker-side proxy speaking the socket protocol; drop-in for a local PS."""
 
     def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
         self._sock = networking.connect(host, port)
         self._lock = threading.Lock()
+
+    def reconnect(self):
+        """Fresh connection — a retried worker must not reuse a stream that
+        may have died mid-message (half-written commit payloads would
+        desync the protocol)."""
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = networking.connect(self.host, self.port)
 
     def pull(self, worker_id=None):
         with self._lock:

@@ -40,6 +40,7 @@ from distkeras_tpu.parameter_servers import (
     ADAGParameterServer,
     DeltaParameterServer,
     DynSGDParameterServer,
+    RemoteParameterServerClient,
     SocketParameterServer,
 )
 from distkeras_tpu.utils.checkpoint import Checkpointer
@@ -78,6 +79,7 @@ class Trainer:
         num_epoch=1,
         seed=0,
         compute_dtype=None,
+        remat=False,
         profile_dir=None,
         metrics_path=None,
     ):
@@ -97,6 +99,7 @@ class Trainer:
         self.num_epoch = int(num_epoch)
         self.seed = int(seed)
         self.compute_dtype = compute_dtype
+        self.remat = bool(remat)
         self.history = TrainingHistory()
         # observability (absent upstream — SURVEY §5.1/§5.5 required addition)
         self.profile_dir = profile_dir
@@ -109,6 +112,7 @@ class Trainer:
             self.loss,
             metrics=self.metrics,
             compute_dtype=self.compute_dtype,
+            remat=self.remat,
         )
 
     def _finish(self, params, state=None):
@@ -512,6 +516,7 @@ class DistributedTrainer(Trainer):
         communication_window=5,
         mode="threads",
         serve_socket=False,
+        remote_ps=False,
         checkpoint_dir=None,
         checkpoint_every=0,
         max_to_keep=3,
@@ -523,7 +528,11 @@ class DistributedTrainer(Trainer):
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
         self.mode = mode
-        self.serve_socket = bool(serve_socket)
+        # remote_ps: workers reach the PS through the TCP socket protocol
+        # (the cross-host/DCN path) even on one host — the full multi-host
+        # wire topology, loopback-exercised (SURVEY §5.8 TPU mapping)
+        self.remote_ps = bool(remote_ps)
+        self.serve_socket = bool(serve_socket) or self.remote_ps
         self.parameter_server = None
         self.service = None
         # checkpoint_every is in PS commits here (0 = final snapshot only)
@@ -546,9 +555,12 @@ class DistributedTrainer(Trainer):
         return {}
 
     def allocate_worker(self, core, worker_id, device) -> AsyncWorker:
+        ps = self.parameter_server
+        if self.remote_ps:
+            ps = RemoteParameterServerClient("127.0.0.1", self.service.port)
         return self.worker_cls(
             core,
-            self.parameter_server,
+            ps,
             worker_id,
             self.features_col,
             self.label_col,
@@ -600,28 +612,36 @@ class DistributedTrainer(Trainer):
                 )
         self._attach_checkpointing(self.parameter_server)
         self.start_service()
-        parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
-            self.num_workers
-        )
-        devices = local_devices()
-        workers = [
-            self.allocate_worker(core, i, devices[i % len(devices)])
-            for i in range(self.num_workers)
-        ]
+        workers = []
+        try:
+            parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
+                self.num_workers
+            )
+            devices = local_devices()
+            workers = [
+                self.allocate_worker(core, i, devices[i % len(devices)])
+                for i in range(self.num_workers)
+            ]
 
-        if self.mode == "threads":
-            self._warmup(core, workers[0], parts[0])
-            self._run_threads(workers, parts)
-        elif self.mode == "simulated":
-            self._run_simulated(workers, parts)
-        else:
-            raise ValueError(f"unknown mode {self.mode!r}")
+            if self.mode == "threads":
+                self._warmup(core, workers[0], parts[0])
+                self._run_threads(workers, parts)
+            elif self.mode == "simulated":
+                self._run_simulated(workers, parts)
+            else:
+                raise ValueError(f"unknown mode {self.mode!r}")
 
-        for w in workers:
-            self.history.extend(w.worker_id, w.records)
-            for s, dt in w.timings:
-                self.history.record_window(w.worker_id, s, dt)
-        self.stop_service()
+            for w in workers:
+                self.history.extend(w.worker_id, w.records)
+                for s, dt in w.timings:
+                    self.history.record_window(w.worker_id, s, dt)
+        finally:
+            # sockets/threads must not outlive a failed train() — sweeps
+            # that catch errors would otherwise accumulate leaked fds
+            if self.remote_ps:
+                for w in workers:
+                    w.ps.close()
+            self.stop_service()
         if self.checkpointer is not None:
             center, meta = self.parameter_server.snapshot()
             self.checkpointer.save(

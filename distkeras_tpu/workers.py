@@ -48,6 +48,7 @@ class WorkerCore:
         loss,
         metrics=("accuracy",),
         compute_dtype=None,
+        remat=False,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -55,16 +56,25 @@ class WorkerCore:
         self.metric_names = list(metrics)
         self.metric_fns = [get_metric(m) for m in metrics]
         self.compute_dtype = compute_dtype
+        self.remat = bool(remat)
 
         model_apply = model.apply
         loss_fn = self.loss_fn
         metric_fns = self.metric_fns
         cdtype = compute_dtype
 
+        def train_fwd(params, state, rng, x):
+            return model_apply(params, state, x, train=True, rng=rng)
+
+        if remat:
+            # rematerialize activations in the backward pass: trades MXU
+            # FLOPs for HBM — lets bigger models / windows fit per chip
+            train_fwd = jax.checkpoint(train_fwd)
+
         def compute_loss(params, state, rng, x, y):
             if cdtype is not None:
                 x = x.astype(cdtype)
-            y_pred, new_state = model_apply(params, state, x, train=True, rng=rng)
+            y_pred, new_state = train_fwd(params, state, rng, x)
             y_pred = y_pred.astype(jnp.float32)
             return loss_fn(y_pred, y), (new_state, y_pred)
 
@@ -308,6 +318,8 @@ class AsyncWorker:
         self._state = None
         self._opt_state = None
         self._pending = None
+        if hasattr(self.ps, "reconnect"):
+            self.ps.reconnect()  # a crashed socket stream may be desynced
 
     # -- algorithm hooks ----------------------------------------------------
 

@@ -27,7 +27,14 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--n", type=int, default=8192)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (virtual multi-device mesh "
+                         "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     raw = synthetic_cifar10(n=args.n)
     ds = MinMaxTransformer(0.0, 1.0, 0.0, 255.0)(raw)

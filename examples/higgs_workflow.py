@@ -33,7 +33,14 @@ def main():
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--rho", type=float, default=5.0)
     ap.add_argument("--n", type=int, default=32768)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (virtual multi-device mesh "
+                         "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     raw = load_csv(args.csv) if args.csv else synthetic_higgs(n=args.n)
     num_features = raw["features"].shape[1]

@@ -35,7 +35,14 @@ def main():
     ap.add_argument("--classes", type=int, default=100)
     ap.add_argument("--size", type=int, default=64, help="image side length")
     ap.add_argument("--n", type=int, default=2048)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (virtual multi-device mesh "
+                         "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     raw = synthetic_imagenet(n=args.n, num_classes=args.classes, size=args.size)
     ds = MinMaxTransformer(0.0, 1.0, 0.0, 255.0)(raw)

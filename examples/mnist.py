@@ -41,7 +41,14 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--workers", type=int, default=8)
     ap.add_argument("--n", type=int, default=16384, help="synthetic rows if no CSV")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (virtual multi-device mesh "
+                         "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     # -- data pipeline (reference: examples/mnist.py transformer chain) ------
     raw = mnist(path=args.csv, n=args.n, flat=True)

@@ -84,6 +84,26 @@ def test_threads_mode_converges():
     assert worker_ids == {0, 1, 2, 3}
 
 
+def test_remote_ps_trains_over_the_wire():
+    """remote_ps=True: every pull/commit crosses the TCP socket protocol —
+    the loopback stand-in for the multi-host DCN topology (rank 0 hosts the
+    PS, remote hosts' workers connect as clients)."""
+    train, test = make_data(n=1024)
+    t = _trainer(
+        DOWNPOUR,
+        zoo.mnist_mlp(hidden=32),
+        mode="threads",
+        num_epoch=3,
+        remote_ps=True,
+    )
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.8
+    ps = t.parameter_server
+    assert ps.num_updates > 0
+    # remote pulls registered heartbeats for every worker over the wire
+    assert ps.suspected_failures(timeout=0.0) == [0, 1, 2, 3]
+
+
 def test_eamsgd_converges():
     train, test = make_data(n=1024)
     t = _trainer(
