@@ -1,0 +1,71 @@
+"""Tensor parallelism (TP) — shard parameters over a "model" mesh axis.
+
+The reference has no model sharding of any kind (SURVEY §3.3: weights are
+fully replicated; the model must fit one worker). TP is the TPU rebuild's
+stretch capability for models that don't: Dense/conv kernels shard their
+output dimension across the ``"model"`` axis and XLA's GSPMD partitioner
+inserts the activation collectives — no per-layer communication code, the
+sharding annotations ARE the parallelism (scaling-book recipe: pick a
+mesh, annotate, let XLA insert collectives).
+
+Composes with the sync data-parallel trainer: a 2-D ``Mesh(("data",
+"model"))`` shards batches over "data" (gradient psum) and parameters over
+"model" (activation all-gather/reduce-scatter), both over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.parallel.mesh import local_devices
+
+
+def make_dp_tp_mesh(data_parallel: int, model_parallel: int, devices=None) -> Mesh:
+    """2-D mesh: ``data_parallel * model_parallel`` devices as
+    ("data", "model")."""
+    n = data_parallel * model_parallel
+    devs = devices if devices is not None else local_devices(n)
+    return Mesh(
+        np.array(devs[:n]).reshape(data_parallel, model_parallel),
+        ("data", "model"),
+    )
+
+
+def leaf_partition_spec(shape, axis_size, axis_name="model", min_elems=2):
+    """Sharding rule for one parameter leaf: shard the trailing (output)
+    dimension over the model axis when divisible, else replicate.
+
+    Covers Dense kernels (in, out), conv kernels (H, W, in, out), and
+    matching bias vectors (out,) so layer outputs and their biases carry
+    the same sharding.
+    """
+    if len(shape) >= 2 and shape[-1] % axis_size == 0 and shape[-1] >= min_elems:
+        return P(*([None] * (len(shape) - 1)), axis_name)
+    if len(shape) == 1 and shape[0] % axis_size == 0 and shape[0] >= min_elems:
+        return P(axis_name)
+    return P()
+
+
+def shard_params(params, mesh: Mesh, axis_name: str = "model"):
+    """Place a parameter pytree on the mesh with TP shardings."""
+    axis_size = mesh.shape[axis_name]
+
+    def place(leaf):
+        spec = leaf_partition_spec(np.shape(leaf), axis_size, axis_name)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, params)
+
+
+def describe_shardings(params, mesh: Mesh, axis_name: str = "model"):
+    """{path: spec} map — introspection/tests."""
+    axis_size = mesh.shape[axis_name]
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return {
+        jax.tree_util.keystr(path): leaf_partition_spec(
+            np.shape(leaf), axis_size, axis_name
+        )
+        for path, leaf in flat
+    }

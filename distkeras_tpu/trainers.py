@@ -283,16 +283,76 @@ class SynchronousDistributedTrainer(Trainer):
         num_workers=None,
         window=8,
         mesh=None,
+        model_parallel=None,
         checkpoint_dir=None,
         checkpoint_every=1,
         max_to_keep=3,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
-        self.mesh = mesh if mesh is not None else make_mesh(num_workers)
-        self.num_workers = int(self.mesh.devices.size)
+        # model_parallel=k: 2-D ("data", "model") mesh — batches shard over
+        # "data" (gradient psum), Dense/conv output dims shard over "model"
+        # (GSPMD inserts the activation collectives). SURVEY §3.3: TP is
+        # absent upstream; this is the TPU stretch capability.
+        self.model_parallel = int(model_parallel) if model_parallel else None
+        if mesh is not None:
+            if self.model_parallel and (
+                mesh.shape.get("model") != self.model_parallel
+            ):
+                raise ValueError(
+                    f"mesh {dict(mesh.shape)} does not have a 'model' axis "
+                    f"of size model_parallel={self.model_parallel}"
+                )
+            self.mesh = mesh
+        elif self.model_parallel:
+            from distkeras_tpu.parallel.tensor_parallel import make_dp_tp_mesh
+
+            n_dev = len(local_devices())
+            if num_workers:
+                dp = int(num_workers)
+            else:
+                dp, rem = divmod(n_dev, self.model_parallel)
+                if rem:
+                    raise ValueError(
+                        f"model_parallel={self.model_parallel} does not "
+                        f"divide the {n_dev} available devices"
+                    )
+            if dp < 1 or dp * self.model_parallel > n_dev:
+                raise ValueError(
+                    f"need {max(dp, 1) * self.model_parallel} devices for "
+                    f"data={dp} x model={self.model_parallel}, have {n_dev}"
+                )
+            self.mesh = make_dp_tp_mesh(dp, self.model_parallel)
+        else:
+            self.mesh = make_mesh(num_workers)
+        self.num_workers = int(self.mesh.shape.get("data", self.mesh.devices.size))
         self.window = int(window)
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
+
+    def _place_params(self, params):
+        """Replicated placement, or TP shardings when model_parallel is on."""
+        if self.model_parallel:
+            from distkeras_tpu.parallel.tensor_parallel import shard_params
+
+            return shard_params(params, self.mesh)
+        return replicate(params, self.mesh)
+
+    def _place_opt_state(self, core, params, restored=None):
+        """Optimizer-state placement matching the params placement. Under
+        TP, init runs under jit so GSPMD propagates the params' shardings
+        into momentum buffers; a restored state adopts those shardings."""
+        if self.model_parallel:
+            opt_state = jax.jit(core.init_opt_state)(params)
+            if restored is not None:
+                opt_state = jax.tree.map(
+                    lambda r, placed: jax.device_put(r, placed.sharding),
+                    restored,
+                    opt_state,
+                )
+            return opt_state
+        if restored is not None:
+            return replicate(restored, self.mesh)
+        return replicate(core.init_opt_state(params), self.mesh)
 
     def _train(self, dataset, shuffle=False, resume=False):
         self.history.record_training_start()
@@ -303,15 +363,15 @@ class SynchronousDistributedTrainer(Trainer):
         restored = self._restore_latest() if resume else None
         if restored is not None:
             _, trees, meta = restored
-            params = replicate(trees["params"], self.mesh)
+            params = self._place_params(trees["params"])
             state = replicate(trees["state"], self.mesh)
-            opt_state = replicate(trees["opt_state"], self.mesh)
+            opt_state = self._place_opt_state(core, params, trees["opt_state"])
             rng = jax.device_put(trees["rng"])
             start_epoch = int(meta["epoch"])
         else:
-            params = replicate(host_copy(self.model.params), self.mesh)
+            params = self._place_params(host_copy(self.model.params))
             state = replicate(host_copy(self.model.state), self.mesh)
-            opt_state = replicate(core.init_opt_state(params), self.mesh)
+            opt_state = self._place_opt_state(core, params)
             rng = jax.random.PRNGKey(self.seed)
         data_sh = batch_sharding(self.mesh)
         cols = [self.features_col, self.label_col]
