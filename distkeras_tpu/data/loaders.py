@@ -94,6 +94,23 @@ def synthetic_imagenet(n=512, num_classes=1000, size=64, noise=0.5, seed=3) -> D
     return _prototype_classification(n, num_classes, (size, size, 3), noise, seed)
 
 
+def synthetic_sequences(
+    n=4096, seq_len=64, vocab=32, num_classes=2, markers=None, seed=0
+) -> Dataset:
+    """Token-sequence classification: random background tokens with the
+    class's marker token planted at random positions. Learnable by any
+    attention/embedding model; drives the transformer family tests."""
+    rng = np.random.default_rng(seed)
+    markers = markers if markers is not None else max(2, seq_len // 8)
+    if vocab <= num_classes:
+        raise ValueError("vocab must exceed num_classes (markers are 1..C)")
+    x = rng.integers(num_classes + 1, vocab, (n, seq_len))
+    labels = rng.integers(0, num_classes, n)
+    pos = rng.random((n, seq_len)).argsort(axis=1)[:, :markers]
+    x[np.arange(n)[:, None], pos] = (labels + 1)[:, None]
+    return Dataset({"features": x.astype(np.int32), "label": labels.astype(np.int64)})
+
+
 def mnist(path=None, n=8192, seed=0, flat=True) -> Dataset:
     """Real MNIST CSV if available (path or $DISTKERAS_MNIST_CSV), else synthetic."""
     path = path or os.environ.get("DISTKERAS_MNIST_CSV")

@@ -87,6 +87,11 @@ class Layer:
     def get_config(self) -> dict:
         return {"layer": type(self).__name__}
 
+    def sublayers(self):
+        """Nested Layer children (composite layers override) — lets model
+        walkers (e.g. ring-attention attachment) reach every layer."""
+        return []
+
     def __repr__(self):
         cfg = {k: v for k, v in self.get_config().items() if k != "layer"}
         args = ", ".join(f"{k}={v!r}" for k, v in cfg.items())
@@ -309,6 +314,87 @@ class Activation(Layer):
 
 
 @register_layer
+class Embedding(Layer):
+    """Token embedding (+ optional learned positions) for (B, T) int ids.
+
+    No reference counterpart (the reference has no sequence workloads,
+    SURVEY §5.7); the entry layer of the rebuild's transformer family.
+    """
+
+    def __init__(self, vocab_size, dim, with_positions=True):
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.with_positions = bool(with_positions)
+
+    def init(self, rng, in_shape):
+        (t,) = in_shape
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "tokens": 0.02
+            * jax.random.normal(k1, (self.vocab_size, self.dim), jnp.float32)
+        }
+        if self.with_positions:
+            params["positions"] = 0.02 * jax.random.normal(
+                k2, (t, self.dim), jnp.float32
+            )
+        return params, {}, (t, self.dim)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        y = params["tokens"][x.astype(jnp.int32)]
+        if self.with_positions:
+            y = y + params["positions"][None, : y.shape[1]]
+        return y, state
+
+    def get_config(self):
+        return {
+            "layer": "Embedding",
+            "vocab_size": self.vocab_size,
+            "dim": self.dim,
+            "with_positions": self.with_positions,
+        }
+
+
+@register_layer
+class LayerNorm(Layer):
+    """Normalize over the trailing feature axis with learned scale/shift."""
+
+    def __init__(self, epsilon=1e-5):
+        self.epsilon = float(epsilon)
+
+    def init(self, rng, in_shape):
+        d = in_shape[-1]
+        return (
+            {"gamma": jnp.ones((d,), jnp.float32),
+             "beta": jnp.zeros((d,), jnp.float32)},
+            {},
+            in_shape,
+        )
+
+    def apply(self, params, state, x, train=False, rng=None):
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * lax.rsqrt(var + self.epsilon)
+        y = y * params["gamma"] + params["beta"]
+        return y.astype(x.dtype), state
+
+    def get_config(self):
+        return {"layer": "LayerNorm", "epsilon": self.epsilon}
+
+
+@register_layer
+class GlobalAvgPool1D(Layer):
+    """(B, T, D) -> (B, D): mean over the sequence axis."""
+
+    def init(self, rng, in_shape):
+        t, d = in_shape
+        return {}, {}, (d,)
+
+    def apply(self, params, state, x, train=False, rng=None):
+        return jnp.mean(x, axis=1), state
+
+
+@register_layer
 class MultiHeadSelfAttention(Layer):
     """Multi-head self-attention over (batch, seq, features).
 
@@ -388,6 +474,71 @@ class MultiHeadSelfAttention(Layer):
             "head_dim": self.head_dim,
             "causal": self.causal,
             "use_bias": self.use_bias,
+        }
+
+
+@register_layer
+class TransformerBlock(Layer):
+    """Pre-LN transformer block: x + MHSA(LN(x)), then x + MLP(LN(x)).
+
+    The MLP is Dense(mlp_ratio*d, gelu) -> Dense(d). Composes the rebuild's
+    long-context vocabulary: with ``parallel.ring_attention`` attached to
+    the inner attention (see ``attach_ring_attention``) the block runs with
+    the sequence axis sharded over a mesh.
+    """
+
+    def __init__(self, num_heads, mlp_ratio=4, causal=False):
+        self.num_heads = int(num_heads)
+        self.mlp_ratio = int(mlp_ratio)
+        self.causal = bool(causal)
+        self.mhsa = MultiHeadSelfAttention(self.num_heads, causal=self.causal)
+        self.ln1 = LayerNorm()
+        self.ln2 = LayerNorm()
+        self._fc1 = None  # built in init (needs d)
+        self._fc2 = None
+
+    def sublayers(self):
+        parts = [self.mhsa, self.ln1, self.ln2]
+        if self._fc1 is not None:
+            parts += [self._fc1, self._fc2]
+        return parts
+
+    def init(self, rng, in_shape):
+        t, d = in_shape
+        self._fc1 = Dense(self.mlp_ratio * d, activation="gelu")
+        self._fc2 = Dense(d)
+        ks = jax.random.split(rng, 5)
+        params, state = {}, {}
+        for name, layer, k, shape in [
+            ("ln1", self.ln1, ks[0], in_shape),
+            ("mhsa", self.mhsa, ks[1], in_shape),
+            ("ln2", self.ln2, ks[2], in_shape),
+            ("fc1", self._fc1, ks[3], in_shape),
+        ]:
+            p, s, out_shape = layer.init(k, shape)
+            params[name], state[name] = p, s
+        p, s, _ = self._fc2.init(ks[4], (t, self.mlp_ratio * d))
+        params["fc2"], state["fc2"] = p, s
+        return params, state, in_shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        new_state = dict(state)
+        h, new_state["ln1"] = self.ln1.apply(params["ln1"], state["ln1"], x)
+        a, new_state["mhsa"] = self.mhsa.apply(
+            params["mhsa"], state["mhsa"], h, train, rng
+        )
+        x = x + a
+        h, new_state["ln2"] = self.ln2.apply(params["ln2"], state["ln2"], x)
+        h, new_state["fc1"] = self._fc1.apply(params["fc1"], state["fc1"], h)
+        h, new_state["fc2"] = self._fc2.apply(params["fc2"], state["fc2"], h)
+        return x + h, new_state
+
+    def get_config(self):
+        return {
+            "layer": "TransformerBlock",
+            "num_heads": self.num_heads,
+            "mlp_ratio": self.mlp_ratio,
+            "causal": self.causal,
         }
 
 

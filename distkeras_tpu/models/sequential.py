@@ -96,6 +96,9 @@ class Residual(Layer):
             "activation": self.activation,
         }
 
+    def sublayers(self):
+        return list(self.layers) + list(self.shortcut)
+
 
 class Model:
     """Built model handle: (apply_fn, params, state) + Keras-ish conveniences."""

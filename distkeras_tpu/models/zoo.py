@@ -94,6 +94,42 @@ def cifar10_cnn(num_classes=10, seed=0):
     ).build((32, 32, 3), seed=seed)
 
 
+def transformer_classifier(
+    vocab_size=64,
+    seq_len=64,
+    d_model=64,
+    num_heads=4,
+    depth=2,
+    num_classes=2,
+    seed=0,
+):
+    """Sequence classifier: Embedding -> TransformerBlock xN -> mean-pool
+    -> softmax head. No reference counterpart (SURVEY §5.7: no attention
+    upstream); the rebuild's long-context model family. Pair with
+    ``parallel.ring_attention.attach_ring_attention`` to shard the sequence
+    axis over a mesh."""
+    from distkeras_tpu.models.layers import (
+        Dense,
+        Embedding,
+        GlobalAvgPool1D,
+        LayerNorm,
+        TransformerBlock,
+    )
+    from distkeras_tpu.models.sequential import Sequential
+
+    model = Sequential(
+        [
+            Embedding(vocab_size, d_model),
+            *[TransformerBlock(num_heads) for _ in range(depth)],
+            LayerNorm(),
+            GlobalAvgPool1D(),
+            Dense(num_classes, activation="softmax"),
+        ]
+    )
+    model.build((seq_len,), seed=seed)
+    return model
+
+
 def _basic_block(filters, stride=1, downsample=False):
     shortcut = (
         [Conv2D(filters, 1, strides=stride, padding="SAME", use_bias=False), BatchNorm()]

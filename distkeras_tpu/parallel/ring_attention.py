@@ -114,6 +114,27 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False):
     return fn(q, k, v)
 
 
+def attach_ring_attention(model, mesh: Mesh, axis_name: str = "seq") -> int:
+    """Walk a model's layers and point every MultiHeadSelfAttention at the
+    ring implementation over ``mesh``. Returns how many were attached.
+    (Process-local: hooks close over the live mesh and are not serialized —
+    re-attach after deserializing on another host.)"""
+    import functools
+
+    from distkeras_tpu.models.layers import MultiHeadSelfAttention
+
+    fn = functools.partial(ring_attention, mesh=mesh, axis_name=axis_name)
+    count = 0
+    stack = list(getattr(model, "layers", []))
+    while stack:
+        layer = stack.pop()
+        if isinstance(layer, MultiHeadSelfAttention):
+            layer.attention_fn = fn
+            count += 1
+        stack.extend(layer.sublayers())
+    return count
+
+
 def dense_attention(q, k, v, causal=False):
     """Single-device reference: plain softmax attention, same layout."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
