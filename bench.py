@@ -1,7 +1,14 @@
 """Benchmark harness: north-star MNIST CNN throughput on the local chip(s).
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N,
+     "platform": ..., "mfu": ...}
+
+and ALWAYS prints it, even when the accelerator backend fails to initialize:
+the harness probes candidate backends in a subprocess (inherited env, then
+``JAX_PLATFORMS=''`` to let JAX auto-pick, then ``JAX_PLATFORMS=cpu``) before
+importing jax in-process, so a broken TPU tunnel degrades to a CPU-scaled
+measurement instead of rc=1 with no output.
 
 Baseline: `BASELINE.json.published` is `{}` (nothing citable exists for the
 reference), so per BASELINE.md the comparison point is a documented analytic
@@ -15,11 +22,19 @@ overheads put a well-tuned executor at ~2,000 samples/sec. We take
     SPARK_BASELINE_SAMPLES_PER_SEC_PER_EXECUTOR = 2000.0
 
 as the stand-in; `vs_baseline` = measured samples/sec/chip divided by it.
+This analytic constant is superseded by any measured number recorded in
+BENCHMARKS.md (VERDICT r1 weak #6).
+
+MFU: flops-per-window is taken from XLA's own cost model on the exact
+compiled training program (``compiled.cost_analysis()['flops']``), divided by
+the device generation's published bf16 peak. On platforms with no table entry
+(cpu), ``mfu`` is null but ``model_flops_per_sec`` is still reported.
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
@@ -27,22 +42,129 @@ import numpy as np
 
 SPARK_BASELINE = 2000.0  # samples/sec/executor, analytic estimate (see above)
 
-BATCH = 1024
-WINDOW = 16  # steps fused into one XLA program per dispatch
-WARMUP_WINDOWS = 2
-TIMED_WINDOWS = 8
+# Published peak bf16 FLOP/s per chip, keyed by substring of device_kind.
+TPU_PEAK_BF16 = {
+    "v6": 918e12,  # Trillium / v6e
+    "v5p": 459e12,
+    "v5 lite": 197e12,  # v5e ("TPU v5 lite")
+    "v5e": 197e12,
+    "v5": 459e12,
+    "v4 lite": 138e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 46e12,
+}
+
+def _probe_src(config_platform: str | None) -> str:
+    pin = (
+        f"jax.config.update('jax_platforms', {config_platform!r}); "
+        if config_platform
+        else ""
+    )
+    return f"import jax; {pin}d = jax.devices(); print('PLATFORM=' + d[0].platform)"
 
 
-def main():
+def _probe_backend(config_platform: str | None, timeout: float) -> str | None:
+    """Try initializing JAX in a subprocess; return the platform name on
+    success, None on failure/hang. Probing out-of-process matters because a
+    failed in-process backend init is sticky (VERDICT r1 weak #1: the axon
+    plugin can hang unless the platform is pinned before any backend touch).
+    The cpu pin uses ``jax.config.update`` rather than ``JAX_PLATFORMS``
+    because the sandbox's sitecustomize registers its TPU plugin in a way
+    that overrides the env var (same approach as tests/conftest.py)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _probe_src(config_platform)],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if out.returncode != 0:
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    return None
+
+
+def resolve_backend() -> tuple[str, str | None] | None:
+    """Pick a working backend before importing jax in-process.
+
+    Returns (platform, config_pin): apply ``jax.config.update('jax_platforms',
+    config_pin)`` after import when config_pin is not None."""
+    candidates = [
+        (None, 150.0),  # whatever the driver set (axon TPU when healthy)
+        ("cpu", 60.0),  # always-available fallback
+    ]
+    for config_platform, timeout in candidates:
+        platform = _probe_backend(config_platform, timeout)
+        if platform is not None:
+            return platform, config_platform
+    return None
+
+
+def _flops_per_call(compiled) -> float | None:
+    """XLA cost-model flops for one invocation of a compiled function."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost["flops"])
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in TPU_PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def emit(record: dict) -> None:
+    print(json.dumps(record))
+
+
+def main() -> None:
+    resolved = resolve_backend()
+    if resolved is None:
+        emit(
+            {
+                "metric": "mnist_cnn_train_samples_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "samples/sec/chip",
+                "vs_baseline": 0.0,
+                "platform": "none",
+                "error": "no JAX backend initialized (tpu probe and cpu fallback both failed)",
+            }
+        )
+        return
+    platform, config_pin = resolved
+
     import jax
+
+    if config_pin is not None:
+        jax.config.update("jax_platforms", config_pin)
 
     from distkeras_tpu.models.zoo import mnist_cnn
     from distkeras_tpu.ops.optimizers import get_optimizer
     from distkeras_tpu.workers import WorkerCore
 
-    n_chips = len(jax.devices())
+    on_cpu = platform == "cpu"
+    batch = 256 if on_cpu else 2048  # 2048 measured best on v5e (r2 sweep)
+    window = 4 if on_cpu else 16  # steps fused into one XLA program
+    warmup_windows = 1 if on_cpu else 2
+    timed_windows = 4 if on_cpu else 8
+
+    devices = jax.devices()
+    n_chips = len(devices)
     print(
-        f"devices: {n_chips} x {jax.devices()[0].platform}", file=sys.stderr
+        f"devices: {n_chips} x {devices[0].platform} ({devices[0].device_kind})",
+        file=sys.stderr,
     )
 
     model = mnist_cnn(seed=0)
@@ -54,43 +176,66 @@ def main():
     )
 
     rng = np.random.default_rng(0)
-    xs = rng.random((WINDOW, BATCH, 28, 28, 1), np.float32)
-    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, (WINDOW, BATCH))]
+    xs = rng.random((window, batch, 28, 28, 1), np.float32)
+    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, (window, batch))]
 
     params = model.params
     state = model.state
     opt_state = core.init_opt_state(params)
     key = jax.random.PRNGKey(0)
 
-    def run(params, state, opt_state, key):
+    flops_per_window = _flops_per_call(
+        core.window.lower(params, state, opt_state, key, xs, ys).compile()
+    )
+
+    for _ in range(warmup_windows):
         params, state, opt_state, key, mets = core.window(
             params, state, opt_state, key, xs, ys
         )
-        return params, state, opt_state, key, mets
-
-    for _ in range(WARMUP_WINDOWS):
-        params, state, opt_state, key, mets = run(params, state, opt_state, key)
     jax.block_until_ready(params)
 
     t0 = time.perf_counter()
-    for _ in range(TIMED_WINDOWS):
-        params, state, opt_state, key, mets = run(params, state, opt_state, key)
+    for _ in range(timed_windows):
+        params, state, opt_state, key, mets = core.window(
+            params, state, opt_state, key, xs, ys
+        )
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
 
-    samples = TIMED_WINDOWS * WINDOW * BATCH
+    samples = timed_windows * window * batch
     sps = samples / dt  # single-chip run: per-chip == total
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_cnn_train_samples_per_sec_per_chip",
-                "value": round(sps, 1),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(sps / SPARK_BASELINE, 2),
-            }
-        )
-    )
+
+    record = {
+        "metric": "mnist_cnn_train_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / SPARK_BASELINE, 2),
+        "platform": platform,
+        "device_kind": devices[0].device_kind,
+        "batch": batch,
+        "mfu": None,
+        "model_flops_per_sec": None,
+    }
+    if flops_per_window is not None:
+        flops_per_sec = flops_per_window * timed_windows / dt
+        record["model_flops_per_sec"] = round(flops_per_sec / 1e12, 3)  # TFLOP/s
+        peak = _peak_flops(devices[0])
+        if peak is not None:
+            record["mfu"] = round(flops_per_sec / peak, 4)
+    emit(record)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # the driver must always get its JSON line
+        emit(
+            {
+                "metric": "mnist_cnn_train_samples_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "samples/sec/chip",
+                "vs_baseline": 0.0,
+                "platform": "error",
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+        )
