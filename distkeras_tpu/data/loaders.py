@@ -102,8 +102,13 @@ def synthetic_sequences(
     attention/embedding model; drives the transformer family tests."""
     rng = np.random.default_rng(seed)
     markers = markers if markers is not None else max(2, seq_len // 8)
-    if vocab <= num_classes:
-        raise ValueError("vocab must exceed num_classes (markers are 1..C)")
+    if vocab <= num_classes + 1:
+        # markers occupy tokens 1..C and background tokens draw from
+        # [C+1, vocab) — vocab == C+1 leaves that range empty
+        raise ValueError(
+            "vocab must exceed num_classes + 1 (markers are 1..C, background "
+            "tokens need a non-empty [C+1, vocab) range)"
+        )
     x = rng.integers(num_classes + 1, vocab, (n, seq_len))
     labels = rng.integers(0, num_classes, n)
     pos = rng.random((n, seq_len)).argsort(axis=1)[:, :markers]

@@ -549,8 +549,11 @@ class BatchNorm(Layer):
     Train mode normalizes with batch statistics and updates moving stats in
     ``state``; eval mode uses the moving stats. Functional state threading —
     no in-place mutation — keeps this jit/shard_map-safe. Under the sync
-    data-parallel trainer, batch stats are per-shard (the common large-batch
-    approximation); the moving stats that ship home are the mean over shards.
+    data-parallel trainer the whole step is one jitted program over a GSPMD-
+    sharded batch, so ``jnp.mean``/``jnp.var`` here reduce over the GLOBAL
+    batch — XLA inserts the cross-device collective — and every replica holds
+    identical moving stats (sync-BatchNorm semantics; pinned by
+    tests/test_trainers_sync.py::test_sync_batchnorm_global_batch_stats).
     """
 
     def __init__(self, momentum=0.99, epsilon=1e-5, scale=True, center=True):

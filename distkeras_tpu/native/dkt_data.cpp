@@ -18,6 +18,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -65,22 +66,16 @@ int64_t count_cols(const char *p, const char *eol) {
   return cols;
 }
 
-// Does [p, eol) look like a header line (non-numeric words)?
-bool looks_like_header(const char *p, const char *eol) {
-  for (const char *q = p; q < eol; ++q) {
-    char c = *q;
-    if (std::isalpha(static_cast<unsigned char>(c)) && c != 'e' && c != 'E')
-      return true;
-    if (c == 'e' || c == 'E') {
-      bool prev_digit =
-          q > p && std::isdigit(static_cast<unsigned char>(q[-1]));
-      bool next_ok =
-          q + 1 < eol && (std::isdigit(static_cast<unsigned char>(q[1])) ||
-                          q[1] == '+' || q[1] == '-');
-      if (!prev_digit || !next_ok) return true;
-    }
-  }
-  return false;
+bool parse_line(const char *p, const char *eol, float *out, int64_t cols);
+
+// Does [p, eol) look like a header line? A line is a header iff it does
+// NOT parse as a full row of `cols` floats. strtof accepts nan/inf/-inf
+// tokens, so a headerless file whose first data row contains them is
+// correctly treated as data (the old alphabetic-character scan misdetected
+// such rows as headers and silently dropped them).
+bool looks_like_header(const char *p, const char *eol, int64_t cols) {
+  std::vector<float> scratch(static_cast<size_t>(cols > 0 ? cols : 1));
+  return !parse_line(p, eol, scratch.data(), cols);
 }
 
 // Parse one line of `cols` comma-separated floats into out. Returns true
@@ -138,7 +133,7 @@ int dkt_csv_dims(const char *path, int64_t *rows, int64_t *cols,
       if (first) {
         first = false;
         ncols = count_cols(p, eol);
-        header = looks_like_header(p, eol) ? 1 : 0;
+        header = looks_like_header(p, eol, ncols) ? 1 : 0;
       }
     }
     p = eol < end ? eol + 1 : end;
@@ -175,7 +170,7 @@ int dkt_csv_load(const char *path, float **out_data, int64_t *rows,
       if (first) {
         first = false;
         ncols = count_cols(p, eol);
-        header = looks_like_header(p, eol) ? 1 : 0;
+        header = looks_like_header(p, eol, ncols) ? 1 : 0;
         if (header) {
           p = eol < end ? eol + 1 : end;
           continue;

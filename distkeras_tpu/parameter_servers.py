@@ -21,7 +21,6 @@ staleness/normalization semantics exactly (SURVEY §7.4).
 from __future__ import annotations
 
 import logging
-import pickle
 import socket
 import threading
 import time
@@ -32,7 +31,12 @@ import jax
 import numpy as np
 
 from distkeras_tpu import networking
-from distkeras_tpu.utils.serialization import deserialize_params, serialize_params
+from distkeras_tpu.utils.serialization import (
+    deserialize_params,
+    pack_frame,
+    serialize_params,
+    unpack_frame,
+)
 
 
 def _to_host(tree):
@@ -239,9 +243,12 @@ class SocketParameterServer:
 
     Protocol (reference: distkeras/parameter_servers.py ->
     SocketParameterServer.run): connection sends a 1-byte action —
-    b"p": pull -> reply with serialized (center, tag);
-    b"c": commit -> payload of serialized (delta, tag), reply b"k";
+    b"p": pull -> request frame {"worker_id"} -> reply frame {"tag"} + center;
+    b"c": commit -> frame {"tag", "commit_id"} + delta, reply b"k";
     b"s": stop the server.
+    All frames are the pickle-free JSON-header + npz format from
+    ``utils.serialization`` — the reference pickled these payloads, which is
+    arbitrary-code-execution on whichever host unpickles them.
     One thread per connection; commits serialize on the PS lock.
     """
 
@@ -283,20 +290,23 @@ class SocketParameterServer:
                 if not action:
                     break
                 if action == b"p":
-                    # pull payload: pickled worker_id (None for anonymous) —
-                    # keeps the heartbeat live for remote workers too
-                    worker_id = pickle.loads(networking.recv_data(conn))
-                    center, tag = self.ps.pull(worker_id=worker_id)
+                    # pull request: JSON header {"worker_id": ...} (None for
+                    # anonymous) — keeps the heartbeat live for remote
+                    # workers too. No pickle anywhere on this path.
+                    header, _ = unpack_frame(networking.recv_data(conn))
+                    center, tag = self.ps.pull(worker_id=header.get("worker_id"))
                     networking.send_data(
-                        conn, pickle.dumps((serialize_params(center), tag))
+                        conn, pack_frame({"tag": tag}, serialize_params(center))
                     )
                 elif action == b"c":
-                    payload = pickle.loads(networking.recv_data(conn))
-                    # (blob, tag) legacy or (blob, tag, commit_id)
-                    blob, tag = payload[0], payload[1]
-                    commit_id = payload[2] if len(payload) > 2 else None
+                    header, blob = unpack_frame(networking.recv_data(conn))
+                    commit_id = header.get("commit_id")
+                    if commit_id is not None:
+                        commit_id = (commit_id[0], commit_id[1])
                     self.ps.commit(
-                        deserialize_params(blob), tag, commit_id=commit_id
+                        deserialize_params(blob),
+                        header.get("tag"),
+                        commit_id=commit_id,
                     )
                     conn.sendall(b"k")
                 elif action == b"s":
@@ -339,13 +349,16 @@ class RemoteParameterServerClient:
     def pull(self, worker_id=None):
         with self._lock:
             self._sock.sendall(b"p")
-            networking.send_data(self._sock, pickle.dumps(worker_id))
-            blob, tag = pickle.loads(networking.recv_data(self._sock))
-        return deserialize_params(blob), tag
+            networking.send_data(
+                self._sock, pack_frame({"worker_id": worker_id})
+            )
+            header, blob = unpack_frame(networking.recv_data(self._sock))
+        return deserialize_params(blob), header.get("tag")
 
     def commit(self, delta, tag=None, commit_id=None):
-        payload = pickle.dumps(
-            (serialize_params(_to_host(delta)), tag, commit_id)
+        payload = pack_frame(
+            {"tag": tag, "commit_id": list(commit_id) if commit_id else None},
+            serialize_params(_to_host(delta)),
         )
         with self._lock:
             self._sock.sendall(b"c")

@@ -170,19 +170,51 @@ def test_gather_rows_matches_numpy():
 
 @needs_native
 def test_native_csv_faster_than_python_loop(tmp_path):
-    """Not a strict benchmark — just assert the native path isn't slower on
-    a file big enough for parse cost to dominate."""
+    """Not a strict benchmark — assert the native path wins by a generous
+    margin over best-of-3 timings, so a loaded CI machine's scheduling
+    noise can't flip a single-run comparison."""
     p = str(tmp_path / "big.csv")
     write_csv(p, n=4000, d=50, seed=1)
 
-    t0 = time.perf_counter()
-    native.read_csv(p)
-    t_native = time.perf_counter() - t0
+    def python_parse():
+        with open(p, newline="") as f:
+            reader = csv.reader(f)
+            next(reader)
+            np.asarray([[float(v) for v in row] for row in reader], np.float32)
 
-    t0 = time.perf_counter()
-    with open(p, newline="") as f:
-        reader = csv.reader(f)
-        next(reader)
-        np.asarray([[float(v) for v in row] for row in reader], np.float32)
-    t_python = time.perf_counter() - t0
-    assert t_native < t_python, (t_native, t_python)
+    def timed(fn):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_native = timed(lambda: native.read_csv(p))
+    t_python = timed(python_parse)
+    # native is ~10x faster in practice; 2x is the flake-proof bar
+    assert t_native < t_python / 2, (t_native, t_python)
+
+
+@needs_native
+def test_headerless_nan_inf_first_row_not_dropped(tmp_path):
+    """strtof accepts nan/inf, so a headerless file whose FIRST data row
+    contains them must parse as 2 data rows — the old alphabetic-scan
+    heuristic misdetected that row as a header and silently dropped it."""
+    p = str(tmp_path / "n.csv")
+    with open(p, "w") as f:
+        f.write("nan,inf,-inf\n1.0,2.0,3.0\n")
+    out, header = native.read_csv(p)
+    assert not header and out.shape == (2, 3)
+    assert np.isnan(out[0, 0]) and np.isposinf(out[0, 1]) and np.isneginf(out[0, 2])
+    rows, cols, has_header = native.csv_dims(p)
+    assert (rows, cols, has_header) == (2, 3, False)
+
+
+def test_synthetic_sequences_vocab_guard():
+    """vocab == num_classes + 1 leaves no background-token range and must
+    raise the explicit guard, not an opaque numpy error."""
+    with pytest.raises(ValueError, match="num_classes"):
+        loaders.synthetic_sequences(n=8, seq_len=4, vocab=3, num_classes=2)
+    ds = loaders.synthetic_sequences(n=8, seq_len=4, vocab=4, num_classes=2)
+    assert ds["features"].shape == (8, 4)
