@@ -28,6 +28,7 @@ from distkeras_tpu.trainers import (
     DistributedTrainer,
     AsynchronousDistributedTrainer,
     SynchronousDistributedTrainer,
+    SequenceParallelTrainer,
     DOWNPOUR,
     AEASGD,
     EAMSGD,

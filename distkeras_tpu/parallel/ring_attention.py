@@ -135,6 +135,28 @@ def attach_ring_attention(model, mesh: Mesh, axis_name: str = "seq") -> int:
     return count
 
 
+def detach_ring_attention(model) -> int:
+    """Remove ring-attention hooks installed by ``attach_ring_attention``:
+    every MultiHeadSelfAttention reverts to dense attention. Returns how
+    many hooks were removed. Trainers call this when training ends so
+    neither the caller's model nor the returned copy keeps a closure over a
+    live (process-local) Mesh."""
+    from distkeras_tpu.models.layers import MultiHeadSelfAttention
+
+    count = 0
+    stack = list(getattr(model, "layers", []))
+    while stack:
+        layer = stack.pop()
+        if (
+            isinstance(layer, MultiHeadSelfAttention)
+            and layer.attention_fn is not None
+        ):
+            layer.attention_fn = None
+            count += 1
+        stack.extend(layer.sublayers())
+    return count
+
+
 def dense_attention(q, k, v, causal=False):
     """Single-device reference: plain softmax attention, same layout."""
     scale = 1.0 / (q.shape[-1] ** 0.5)

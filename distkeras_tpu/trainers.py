@@ -28,6 +28,7 @@ import time
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.ops.optimizers import effective_learning_rate, get_optimizer
 from distkeras_tpu.parallel.mesh import (
@@ -114,6 +115,37 @@ class Trainer:
             compute_dtype=self.compute_dtype,
             remat=self.remat,
         )
+
+    def _windowed_epochs(
+        self,
+        dataset,
+        shuffle,
+        cols,
+        global_batch,
+        window,
+        start_epoch,
+        carry,
+        run_window,
+        on_epoch_end=None,
+    ):
+        """Shared epoch pump for the one-compiled-program trainers: group
+        batches into windows of ``window`` steps, feed each to
+        ``run_window(carry, batches) -> carry``, flush the remainder at
+        epoch end, then fire ``on_epoch_end(epoch, carry)`` (checkpoint
+        hook)."""
+        for epoch in range(start_epoch, self.num_epoch):
+            ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
+            pend = []
+            for batch in ds.batches(global_batch, columns=cols):
+                pend.append(batch)
+                if len(pend) == window:
+                    carry = run_window(carry, pend)
+                    pend = []
+            if pend:
+                carry = run_window(carry, pend)
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, carry)
+        return carry
 
     def _finish(self, params, state=None):
         """Produce the result model (trained weights on a copy)."""
@@ -376,7 +408,8 @@ class SynchronousDistributedTrainer(Trainer):
         data_sh = batch_sharding(self.mesh)
         cols = [self.features_col, self.label_col]
 
-        def run_window(params, state, opt_state, rng, batches):
+        def run_window(carry, batches):
+            params, state, opt_state, rng = carry
             t0 = time.perf_counter()
             xs, ys = stack_window(batches, self.features_col, self.label_col)
             xs = jax.device_put(xs, data_sh.update(spec=(None, "data")))
@@ -390,21 +423,139 @@ class SynchronousDistributedTrainer(Trainer):
             )
             return params, state, opt_state, rng
 
-        for epoch in range(start_epoch, self.num_epoch):
-            ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
-            pend = []
-            for batch in ds.batches(global_batch, columns=cols):
-                pend.append(batch)
-                if len(pend) == self.window:
-                    params, state, opt_state, rng = run_window(
-                        params, state, opt_state, rng, pend
-                    )
-                    pend = []
-            if pend:
-                params, state, opt_state, rng = run_window(
-                    params, state, opt_state, rng, pend
-                )
-            self._save_epoch_checkpoint(epoch + 1, params, state, opt_state, rng)
+        params, state, opt_state, rng = self._windowed_epochs(
+            dataset,
+            shuffle,
+            cols,
+            global_batch,
+            self.window,
+            start_epoch,
+            (params, state, opt_state, rng),
+            run_window,
+            lambda epoch, carry: self._save_epoch_checkpoint(epoch + 1, *carry),
+        )
+
+        self.history.record_training_end()
+        return self._finish(params, state)
+
+
+class SequenceParallelTrainer(Trainer):
+    """Sequence/context-parallel training through ring attention.
+
+    No reference counterpart (SURVEY §5.7: the reference's workloads have no
+    sequence dimension); this trainer is the rebuild's long-context
+    capability. The TOKEN axis of every batch is sharded across a
+    ``Mesh(("seq",))`` — each device holds ``T / num_workers`` tokens —
+    and every ``MultiHeadSelfAttention`` in the model is pointed at
+    ``parallel.ring_attention``: K/V blocks rotate around the ring via
+    ``lax.ppermute`` with an online softmax, so the full score matrix never
+    materializes and per-device attention memory is O((T/N)^2).
+
+    Params are replicated; the loss reduces over batch AND token axes, so
+    GSPMD inserts the gradient reductions across the "seq" axis
+    automatically — the whole training step (including the ppermute ring
+    and its transpose in the backward pass) is ONE compiled XLA program.
+    Windows of W steps scan inside that program like every other trainer.
+
+    The returned model computes dense attention (the hook closes over a
+    live mesh and is process-local); call
+    ``parallel.ring_attention.attach_ring_attention`` again to serve
+    long-context inference sharded.
+    """
+
+    def __init__(
+        self,
+        *args,
+        num_workers=None,
+        window=8,
+        mesh=None,
+        checkpoint_dir=None,
+        checkpoint_every=1,
+        max_to_keep=3,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        if mesh is not None:
+            if "seq" not in mesh.axis_names:
+                raise ValueError(f"mesh {dict(mesh.shape)} has no 'seq' axis")
+            self.mesh = mesh
+        else:
+            devs = local_devices(num_workers)
+            self.mesh = make_mesh(axis_names=("seq",), devices=devs)
+        self.num_workers = int(self.mesh.shape["seq"])
+        self.window = int(window)
+        self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
+
+    def _train(self, dataset, shuffle=False, resume=False):
+        from distkeras_tpu.parallel.ring_attention import (
+            attach_ring_attention,
+            detach_ring_attention,
+        )
+
+        attached = attach_ring_attention(self.model, self.mesh, "seq")
+        if attached == 0:
+            raise ValueError(
+                "model has no MultiHeadSelfAttention layers — sequence "
+                "parallelism needs an attention model (zoo.transformer_classifier)"
+            )
+        self.history.record_training_start()
+        core = self._make_core()
+
+        start_epoch = 0
+        restored = self._restore_latest() if resume else None
+        if restored is not None:
+            _, trees, meta = restored
+            params = replicate(trees["params"], self.mesh)
+            state = replicate(trees["state"], self.mesh)
+            opt_state = replicate(trees["opt_state"], self.mesh)
+            rng = jax.device_put(trees["rng"])
+            start_epoch = int(meta["epoch"])
+        else:
+            params = replicate(host_copy(self.model.params), self.mesh)
+            state = replicate(host_copy(self.model.state), self.mesh)
+            opt_state = replicate(core.init_opt_state(params), self.mesh)
+            rng = jax.random.PRNGKey(self.seed)
+
+        # (W, B, T) token ids: shard the token axis; labels replicate
+        seq_sh = NamedSharding(self.mesh, P(None, None, "seq"))
+        repl = NamedSharding(self.mesh, P())
+        cols = [self.features_col, self.label_col]
+
+        def run_window(carry, batches):
+            params, state, opt_state, rng = carry
+            t0 = time.perf_counter()
+            xs, ys = stack_window(batches, self.features_col, self.label_col)
+            xs = jax.device_put(xs, seq_sh)
+            ys = jax.device_put(ys, repl)
+            params, state, opt_state, rng, mets = core.window(
+                params, state, opt_state, rng, xs, ys
+            )
+            self.history.extend(0, _metrics_to_records(mets))
+            self.history.record_window(
+                0, xs.shape[0] * xs.shape[1], time.perf_counter() - t0
+            )
+            return params, state, opt_state, rng
+
+        try:
+            params, state, opt_state, rng = self._windowed_epochs(
+                dataset,
+                shuffle,
+                cols,
+                self.batch_size,
+                self.window,
+                start_epoch,
+                (params, state, opt_state, rng),
+                run_window,
+                lambda epoch, carry: self._save_epoch_checkpoint(
+                    epoch + 1, *carry
+                ),
+            )
+        finally:
+            # the hook closes over a live process-local Mesh, and
+            # Model.copy() shares layer objects — detaching here keeps BOTH
+            # the caller's model and the returned copy on dense attention,
+            # as the class docstring promises
+            detach_ring_attention(self.model)
 
         self.history.record_training_end()
         return self._finish(params, state)

@@ -1,14 +1,16 @@
-"""Long-context sequence classification with ring attention.
+"""Long-context sequence classification — TRAINED with ring attention.
 
 No reference counterpart (the reference's workloads are MLP/CNN/tabular —
 SURVEY §5.7); this example shows the TPU rebuild's sequence-parallel path:
-a transformer classifier whose attention runs as ring attention over a
-``Mesh(("seq",))`` — K/V blocks rotate between devices via ppermute with an
-online softmax, so the per-device attention footprint is O(T/N · T/N)
-instead of O(T · T).
+a transformer classifier trained end-to-end at a sequence length sharded
+over a ``Mesh(("seq",))`` — K/V blocks rotate between devices via ppermute
+with an online softmax, gradients flow back through the ring, and the
+per-device attention footprint is O(T/N · T/N) instead of O(T · T).
 
 Usage:
     python examples/long_context.py [--seq 2048] [--cpu]
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context.py --seq 1024 --cpu   # 8-way sharded
 """
 
 from __future__ import annotations
@@ -28,7 +30,8 @@ def main():
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--depth", type=int, default=2)
     ap.add_argument("--batch", type=int, default=4)
-    ap.add_argument("--train-steps", type=int, default=20)
+    ap.add_argument("--rows", type=int, default=512)
+    ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (virtual multi-device mesh "
                          "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
@@ -39,11 +42,10 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     import jax
-    import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh
 
-    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu import SequenceParallelTrainer
     from distkeras_tpu.data import loaders
     from distkeras_tpu.data.transformers import OneHotTransformer
     from distkeras_tpu.evaluators import AccuracyEvaluator
@@ -54,54 +56,49 @@ def main():
     devices = jax.devices()
     n = len(devices)
     if args.seq % n:
-        raise SystemExit(f"--seq {args.seq} must divide the {n} devices")
+        raise SystemExit(
+            f"--seq {args.seq} must be divisible by the device count {n}"
+        )
     print(f"devices: {n} x {devices[0].platform}; seq {args.seq} "
           f"-> {args.seq // n} tokens/device")
 
-    # 1) train at a short length (dense attention) — position embeddings are
-    #    length-specific, so train and serve at the lengths you need
-    short = 128
+    # TRAIN at the full --seq length with the token axis sharded over the
+    # mesh: every gradient step back-propagates through the ppermute ring
+    # (per-device attention memory O((T/N)^2) instead of O(T^2))
     ds = loaders.synthetic_sequences(
-        n=2048, seq_len=short, vocab=args.vocab, seed=0
+        n=args.rows, seq_len=args.seq, vocab=args.vocab, seed=0
     )
     ds = OneHotTransformer(2, output_col="label_onehot").transform(ds)
     train, test = ds.split(0.85, seed=0)
     model = zoo.transformer_classifier(
-        vocab_size=args.vocab, seq_len=short, d_model=args.d_model,
-        num_heads=args.heads, depth=args.depth,
-    )
-    t = SingleTrainer(model, "adam", "categorical_crossentropy",
-                      batch_size=64, num_epoch=2, label_col="label_onehot")
-    trained = t.train(train, shuffle=True)
-    acc = AccuracyEvaluator(label_col="label").evaluate(
-        ModelPredictor(trained, batch_size=256).predict(test)
-    )
-    print(f"short-context ({short} tokens) test accuracy: {acc:.4f}")
-
-    # 2) long-context inference: same architecture at --seq tokens, ring
-    #    attention over the device mesh
-    mesh = Mesh(np.array(devices), ("seq",))
-    long_model = zoo.transformer_classifier(
         vocab_size=args.vocab, seq_len=args.seq, d_model=args.d_model,
         num_heads=args.heads, depth=args.depth,
     )
-    attached = attach_ring_attention(long_model, mesh)
-    print(f"ring attention attached to {attached} blocks")
-
-    long_ds = loaders.synthetic_sequences(
-        n=args.batch, seq_len=args.seq, vocab=args.vocab, seed=3
+    trainer = SequenceParallelTrainer(
+        model, "adam", "categorical_crossentropy",
+        batch_size=args.batch, num_epoch=args.epochs,
+        label_col="label_onehot",
     )
-    x = jnp.asarray(long_ds["features"])
     t0 = time.perf_counter()
-    y, _ = long_model.apply(long_model.params, long_model.state, x)
-    jax.block_until_ready(y)
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    y, _ = long_model.apply(long_model.params, long_model.state, x)
-    jax.block_until_ready(y)
-    print(f"long-context forward ({args.seq} tokens, batch {args.batch}): "
-          f"{time.perf_counter() - t0:.3f}s (first call {compile_s:.1f}s), "
-          f"output {y.shape}")
+    trained = trainer.train(train, shuffle=True)
+    train_s = time.perf_counter() - t0
+    hist = trainer.get_history()
+    # batches() drops the sub-batch remainder; count rows actually consumed
+    rows_per_epoch = (len(train) // args.batch) * args.batch
+    tokens_per_sec = rows_per_epoch * args.seq * args.epochs / train_s
+    print(f"sequence-parallel training at {args.seq} tokens over "
+          f"{trainer.num_workers} devices: {train_s:.1f}s "
+          f"({tokens_per_sec:,.0f} tokens/s), "
+          f"loss {hist[0]['loss']:.4f} -> {hist[-1]['loss']:.4f}")
+
+    # evaluate long-context: re-attach ring attention for sharded inference
+    mesh = Mesh(np.array(devices), ("seq",))
+    attached = attach_ring_attention(trained, mesh)
+    acc = AccuracyEvaluator(label_col="label").evaluate(
+        ModelPredictor(trained, batch_size=max(args.batch, 8)).predict(test)
+    )
+    print(f"long-context ({args.seq} tokens, ring attention on "
+          f"{attached} blocks) test accuracy: {acc:.4f}")
 
 
 if __name__ == "__main__":

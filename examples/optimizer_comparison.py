@@ -1,0 +1,174 @@
+"""Per-optimizer accuracy-vs-time comparison — the reference README's
+signature experiment (reference: README experiment plots + examples/*.ipynb
+per-optimizer notebooks, SURVEY §3.2/§6): train the same model on the same
+data under every distributed optimization scheme and compare wall-clock
+time against reached accuracy.
+
+Trainers covered: SingleTrainer (baseline), SynchronousDistributedTrainer
+(psum allreduce), DOWNPOUR, AEASGD, EAMSGD, ADAG, DynSGD (async PS zoo).
+
+Writes ``examples/experiments/optimizer_comparison.json`` (full curves) and
+``.md`` (summary table). Usage:
+
+    python examples/optimizer_comparison.py [--n 8192] [--rounds 5]
+        [--workers 4] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from distkeras_tpu import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    DynSGD,
+    EAMSGD,
+    AccuracyEvaluator,
+    MinMaxTransformer,
+    ModelPredictor,
+    OneHotTransformer,
+    SingleTrainer,
+    SynchronousDistributedTrainer,
+)
+from distkeras_tpu.data.loaders import mnist
+from distkeras_tpu.models.zoo import mnist_mlp
+
+
+def accuracy_of(model, test):
+    pred = ModelPredictor(model, batch_size=256).predict(test)
+    return AccuracyEvaluator(label_col="label").evaluate(pred)
+
+
+def run_scheme(name, make_trainer, model_seed, train, test, rounds, target):
+    """Train round-by-round (1 epoch per round), recording the cumulative
+    wall-clock and test accuracy after each — the accuracy-vs-time curve."""
+    model = mnist_mlp(hidden=64, seed=model_seed)
+    curve = []
+    elapsed = 0.0
+    samples = 0
+    for r in range(rounds):
+        trainer = make_trainer(model)
+        t0 = time.perf_counter()
+        model = trainer.train(train, shuffle=True)
+        elapsed += time.perf_counter() - t0
+        samples += len(train)
+        acc = accuracy_of(model, test)
+        curve.append({"round": r + 1, "seconds": round(elapsed, 2), "accuracy": acc})
+        print(f"  {name}: round {r + 1}  t={elapsed:.1f}s  acc={acc:.4f}")
+        if acc >= target:
+            break
+    time_to_target = next(
+        (c["seconds"] for c in curve if c["accuracy"] >= target), None
+    )
+    return {
+        "optimizer": name,
+        "curve": curve,
+        "final_accuracy": curve[-1]["accuracy"],
+        "seconds_total": curve[-1]["seconds"],
+        "time_to_target": time_to_target,
+        "samples_per_sec": round(samples / elapsed, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=8192)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--target", type=float, default=0.95)
+    ap.add_argument("--csv", default=None)
+    ap.add_argument("--out", default=os.path.join("examples", "experiments"))
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    raw = mnist(path=args.csv, n=args.n, flat=True)
+    ds = MinMaxTransformer(n_min=0.0, n_max=1.0, o_min=0.0, o_max=255.0)(raw)
+    ds = OneHotTransformer(10, input_col="label", output_col="label_onehot")(ds)
+    train, test = ds.split(0.9, seed=7)
+
+    common = dict(
+        loss="categorical_crossentropy",
+        label_col="label_onehot",
+        batch_size=32,
+        num_epoch=1,
+        seed=0,
+    )
+    dist = dict(
+        common, num_workers=args.workers, communication_window=4, mode="threads"
+    )
+
+    schemes = [
+        ("SingleTrainer", lambda m: SingleTrainer(
+            m, "sgd", learning_rate=0.05, **common)),
+        ("SyncDP", lambda m: SynchronousDistributedTrainer(
+            m, "sgd", learning_rate=0.05, num_workers=args.workers, **common)),
+        ("DOWNPOUR", lambda m: DOWNPOUR(
+            m, "sgd", learning_rate=0.02, **dist)),
+        ("AEASGD", lambda m: AEASGD(
+            m, "sgd", learning_rate=0.02, rho=10.0, **dist)),
+        ("EAMSGD", lambda m: EAMSGD(
+            m, "sgd", learning_rate=0.02, rho=10.0, momentum=0.3, **dist)),
+        ("ADAG", lambda m: ADAG(
+            m, "sgd", learning_rate=0.05, **dist)),
+        ("DynSGD", lambda m: DynSGD(
+            m, "sgd", learning_rate=0.02, **dist)),
+    ]
+
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform}, train={len(train)}, test={len(test)}")
+    results = []
+    for name, make in schemes:
+        print(f"== {name}")
+        results.append(
+            run_scheme(name, make, 0, train, test, args.rounds, args.target)
+        )
+
+    os.makedirs(args.out, exist_ok=True)
+    payload = {
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_train": len(train),
+        "workers": args.workers,
+        "target_accuracy": args.target,
+        "results": results,
+    }
+    with open(os.path.join(args.out, "optimizer_comparison.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+
+    lines = [
+        "# Optimizer comparison — accuracy vs time",
+        "",
+        f"MNIST MLP (hidden 64), {len(train)} train rows, "
+        f"{args.workers} workers, platform `{platform}` "
+        f"({jax.devices()[0].device_kind}). One epoch per round; "
+        f"target accuracy {args.target}. Reproduce: "
+        "`python examples/optimizer_comparison.py`.",
+        "",
+        "| optimizer | time to target (s) | final acc | total time (s) | samples/sec |",
+        "|---|---|---|---|---|",
+    ]
+    for r in results:
+        ttt = f"{r['time_to_target']:.1f}" if r["time_to_target"] else "—"
+        lines.append(
+            f"| {r['optimizer']} | {ttt} | {r['final_accuracy']:.4f} "
+            f"| {r['seconds_total']:.1f} | {r['samples_per_sec']:.0f} |"
+        )
+    with open(os.path.join(args.out, "optimizer_comparison.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {args.out}/optimizer_comparison.{{json,md}}")
+
+
+if __name__ == "__main__":
+    main()
