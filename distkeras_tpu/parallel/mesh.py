@@ -49,6 +49,24 @@ def _largest_factor(n):
     return n
 
 
+def force_cpu_mesh(num_devices: int = 8) -> None:
+    """Pin a ``num_devices``-virtual-device CPU platform. Must run before
+    the JAX backend initializes (the forced host device count is read from
+    XLA_FLAGS at backend init, and the platform pin must be a config update
+    because env-var selection can be overridden by pre-registered plugins).
+    This is the one supported way to exercise multi-device code paths
+    without accelerator hardware — tests/conftest.py and every example's
+    ``--cpu`` flag route through the same mechanism."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={int(num_devices)}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

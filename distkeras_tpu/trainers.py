@@ -127,22 +127,34 @@ class Trainer:
         carry,
         run_window,
         on_epoch_end=None,
+        prepare=None,
+        prefetch=0,
     ):
         """Shared epoch pump for the one-compiled-program trainers: group
-        batches into windows of ``window`` steps, feed each to
-        ``run_window(carry, batches) -> carry``, flush the remainder at
-        epoch end, then fire ``on_epoch_end(epoch, carry)`` (checkpoint
-        hook)."""
-        for epoch in range(start_epoch, self.num_epoch):
-            ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
+        batches into windows of ``window`` steps, feed each through
+        ``prepare`` (host staging: stack + device_put — run ``prefetch``
+        windows ahead on a background thread so input work overlaps device
+        compute) into ``run_window(carry, prepared) -> carry``; flush the
+        remainder at epoch end, then fire ``on_epoch_end(epoch, carry)``
+        (checkpoint hook). Window order is preserved, so trajectories are
+        bit-identical with prefetch on or off."""
+        from distkeras_tpu.data.prefetch import Prefetcher
+
+        def windows(ds):
             pend = []
             for batch in ds.batches(global_batch, columns=cols):
                 pend.append(batch)
                 if len(pend) == window:
-                    carry = run_window(carry, pend)
+                    yield pend
                     pend = []
             if pend:
-                carry = run_window(carry, pend)
+                yield pend
+
+        for epoch in range(start_epoch, self.num_epoch):
+            ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
+            with Prefetcher(windows(ds), prepare, depth=prefetch) as staged:
+                for prepared in staged:
+                    carry = run_window(carry, prepared)
             if on_epoch_end is not None:
                 on_epoch_end(epoch, carry)
         return carry
@@ -242,6 +254,7 @@ class SingleTrainer(Trainer):
         *args,
         window=8,
         device=None,
+        prefetch=2,
         checkpoint_dir=None,
         checkpoint_every=1,
         max_to_keep=3,
@@ -250,6 +263,7 @@ class SingleTrainer(Trainer):
         super().__init__(*args, **kwargs)
         self.window = int(window)
         self.device = device
+        self.prefetch = int(prefetch)
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
     def _train(self, dataset, shuffle=False, resume=False):
@@ -290,6 +304,7 @@ class SingleTrainer(Trainer):
             initial_full=initial_full,
             start_epoch=start_epoch,
             on_epoch_end=on_epoch_end,
+            prefetch=self.prefetch,
         )
         self.history.extend(0, records)
         for s, dt in worker.timings:
@@ -316,6 +331,7 @@ class SynchronousDistributedTrainer(Trainer):
         window=8,
         mesh=None,
         model_parallel=None,
+        prefetch=2,
         checkpoint_dir=None,
         checkpoint_every=1,
         max_to_keep=3,
@@ -359,6 +375,7 @@ class SynchronousDistributedTrainer(Trainer):
             self.mesh = make_mesh(num_workers)
         self.num_workers = int(self.mesh.shape.get("data", self.mesh.devices.size))
         self.window = int(window)
+        self.prefetch = int(prefetch)
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
     def _place_params(self, params):
@@ -408,12 +425,17 @@ class SynchronousDistributedTrainer(Trainer):
         data_sh = batch_sharding(self.mesh)
         cols = [self.features_col, self.label_col]
 
-        def run_window(carry, batches):
-            params, state, opt_state, rng = carry
-            t0 = time.perf_counter()
+        def prepare(batches):
+            # host staging (prefetch thread): batch shards along "data"
             xs, ys = stack_window(batches, self.features_col, self.label_col)
             xs = jax.device_put(xs, data_sh.update(spec=(None, "data")))
             ys = jax.device_put(ys, data_sh.update(spec=(None, "data")))
+            return xs, ys
+
+        def run_window(carry, prepared):
+            params, state, opt_state, rng = carry
+            xs, ys = prepared
+            t0 = time.perf_counter()
             params, state, opt_state, rng, mets = core.window(
                 params, state, opt_state, rng, xs, ys
             )
@@ -433,6 +455,8 @@ class SynchronousDistributedTrainer(Trainer):
             (params, state, opt_state, rng),
             run_window,
             lambda epoch, carry: self._save_epoch_checkpoint(epoch + 1, *carry),
+            prepare=prepare,
+            prefetch=self.prefetch,
         )
 
         self.history.record_training_end()
@@ -469,6 +493,7 @@ class SequenceParallelTrainer(Trainer):
         num_workers=None,
         window=8,
         mesh=None,
+        prefetch=2,
         checkpoint_dir=None,
         checkpoint_every=1,
         max_to_keep=3,
@@ -484,6 +509,7 @@ class SequenceParallelTrainer(Trainer):
             self.mesh = make_mesh(axis_names=("seq",), devices=devs)
         self.num_workers = int(self.mesh.shape["seq"])
         self.window = int(window)
+        self.prefetch = int(prefetch)
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
     def _train(self, dataset, shuffle=False, resume=False):
@@ -521,12 +547,23 @@ class SequenceParallelTrainer(Trainer):
         repl = NamedSharding(self.mesh, P())
         cols = [self.features_col, self.label_col]
 
-        def run_window(carry, batches):
-            params, state, opt_state, rng = carry
-            t0 = time.perf_counter()
+        def prepare(batches):
+            # host staging (prefetch thread): token axis shards along "seq"
             xs, ys = stack_window(batches, self.features_col, self.label_col)
+            if xs.shape[2] % self.num_workers:
+                raise ValueError(
+                    f"sequence length {xs.shape[2]} is not divisible by the "
+                    f"'seq' mesh size {self.num_workers} — pad the sequences "
+                    "or change num_workers"
+                )
             xs = jax.device_put(xs, seq_sh)
             ys = jax.device_put(ys, repl)
+            return xs, ys
+
+        def run_window(carry, prepared):
+            params, state, opt_state, rng = carry
+            xs, ys = prepared
+            t0 = time.perf_counter()
             params, state, opt_state, rng, mets = core.window(
                 params, state, opt_state, rng, xs, ys
             )
@@ -549,6 +586,8 @@ class SequenceParallelTrainer(Trainer):
                 lambda epoch, carry: self._save_epoch_checkpoint(
                     epoch + 1, *carry
                 ),
+                prepare=prepare,
+                prefetch=self.prefetch,
             )
         finally:
             # the hook closes over a live process-local Mesh, and

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from distkeras_tpu.data.prefetch import Prefetcher
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.metrics import get_metric
 from distkeras_tpu.utils.tree import host_copy, tree_scale, tree_sub
@@ -191,6 +192,7 @@ class SingleTrainerWorker:
         initial_full=None,
         start_epoch=0,
         on_epoch_end=None,
+        prefetch=2,
     ):
         """``initial``: optional (params, state) to start from instead of the
         core model's (lets many workers share one compiled core).
@@ -198,7 +200,11 @@ class SingleTrainerWorker:
         restore point a checkpoint resume supplies; with ``start_epoch`` this
         makes the continuation bit-identical to an uninterrupted run.
         ``on_epoch_end(epoch, params, state, opt_state, rng)``: checkpoint
-        hook, called after each epoch's last window."""
+        hook, called after each epoch's last window.
+        ``prefetch``: windows staged (stack + device_put) by a background
+        thread while the device computes the previous window — double
+        buffering; 0 restores the synchronous input path. Window order is
+        preserved either way, so results are bit-identical."""
         if initial_full is not None:
             params, state, opt_state, rng = (
                 host_copy(initial_full[0]),
@@ -219,37 +225,46 @@ class SingleTrainerWorker:
                 (params, state, opt_state), self.device
             )
         records = []
+        cols = [self.features_col, self.label_col]
+
+        def windows(ds):
+            pend = []
+            for batch in ds.batches(batch_size, columns=cols):
+                pend.append(batch)
+                if len(pend) == window:
+                    yield pend
+                    pend = []
+            if pend:
+                yield pend
+
         for epoch in range(start_epoch, num_epoch):
             ds = (
                 dataset.shuffle(shuffle_seed + epoch)
                 if shuffle_seed is not None
                 else dataset
             )
-            pend = []
-            for batch in ds.batches(
-                batch_size, columns=[self.features_col, self.label_col]
-            ):
-                pend.append(batch)
-                if len(pend) == window:
+            with Prefetcher(
+                windows(ds), self._stage_window, depth=prefetch
+            ) as staged:
+                for xs, ys in staged:
                     params, state, opt_state, rng, records_w = self._run(
-                        params, state, opt_state, rng, pend
+                        params, state, opt_state, rng, xs, ys
                     )
                     records.extend(records_w)
-                    pend = []
-            if pend:
-                params, state, opt_state, rng, records_w = self._run(
-                    params, state, opt_state, rng, pend
-                )
-                records.extend(records_w)
             if on_epoch_end is not None:
                 on_epoch_end(epoch, params, state, opt_state, rng)
         return params, state, records
 
-    def _run(self, params, state, opt_state, rng, batches):
-        t0 = time.perf_counter()
+    def _stage_window(self, batches):
+        """Host-side window prep (runs on the prefetch thread): stack the W
+        batch dicts and ship the buffers to the device ahead of compute."""
         xs, ys = stack_window(batches, self.features_col, self.label_col)
         if self.device is not None:
             xs, ys = jax.device_put((xs, ys), self.device)
+        return xs, ys
+
+    def _run(self, params, state, opt_state, rng, xs, ys):
+        t0 = time.perf_counter()
         params, state, opt_state, rng, mets = self.core.window(
             params, state, opt_state, rng, xs, ys
         )

@@ -46,9 +46,9 @@ def main():
                          "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     args = ap.parse_args()
     if args.cpu:
-        import jax
+        from distkeras_tpu.parallel.mesh import force_cpu_mesh
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_mesh(max(args.workers, 8))
 
     # -- data pipeline (reference: examples/mnist.py transformer chain) ------
     raw = mnist(path=args.csv, n=args.n, flat=True)

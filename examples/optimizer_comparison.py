@@ -88,9 +88,9 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
-        import jax
+        from distkeras_tpu.parallel.mesh import force_cpu_mesh
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_mesh(max(args.workers, 8))
     import jax
 
     raw = mnist(path=args.csv, n=args.n, flat=True)
