@@ -1,0 +1,318 @@
+"""Five-config BASELINE benchmark matrix (BASELINE.md; VERDICT r1 next-step 2).
+
+Runs the reference's five acceptance configurations and records BOTH metric
+axes for each:
+
+  1. SingleTrainer — MNIST MLP              (reference: examples/mnist.py)
+  2. DOWNPOUR      — MNIST CNN, 8 workers
+  3. AEASGD        — ATLAS-Higgs classifier (reference: examples/workflow.ipynb)
+  4. ADAG          — CIFAR-10 CNN
+  5. DynSGD        — ResNet-18, ImageNet-shaped
+
+Axes: steady-state **samples/sec/chip** (per-worker window timings with each
+worker's first, compile-bearing window dropped) and **epochs-to-target-
+accuracy** (1-epoch rounds until the held-out accuracy crosses the config's
+target). Data is the synthetic stand-in for each dataset (nothing real is on
+disk — BASELINE.md records `published: {}`), so the accuracy axis is
+comparable across rounds of THIS framework, not against upstream numbers.
+
+Writes BENCHMARKS.json and BENCHMARKS.md at the repo root:
+
+    python benchmarks.py [--configs 1,2,3,4,5] [--scale smoke|full] [--cpu]
+
+Backend selection mirrors bench.py: probe out-of-process, fall back to an
+8-virtual-device CPU mesh when no accelerator answers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def resolve_platform(force_cpu: bool) -> str:
+    if force_cpu:
+        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(8)
+        return "cpu"
+    from bench import resolve_backend
+
+    resolved = resolve_backend()
+    if resolved is None:
+        raise SystemExit("no JAX backend could be initialized")
+    platform, config_pin = resolved
+    if platform == "cpu":
+        # no accelerator: widen to the 8-device virtual mesh so the
+        # multi-worker configs actually exercise their sharding
+        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(8)
+    elif config_pin is not None:
+        import jax
+
+        jax.config.update("jax_platforms", config_pin)
+    return platform
+
+
+def steady_samples_per_sec(history) -> float:
+    """Aggregate steady-state throughput: per worker, drop the first window
+    (it carries the XLA compile) and sum samples/seconds; workers run
+    concurrently, so their rates add."""
+    total = 0.0
+    for wid in sorted(history._windows):
+        timings = history._windows[wid][1:]
+        secs = sum(dt for _, dt in timings)
+        if secs > 0:
+            total += sum(s for s, _ in timings) / secs
+    return total
+
+
+def run_config(cfg, scale, platform):
+    import jax
+
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.predictors import ModelPredictor
+
+    print(f"== config {cfg['id']}: {cfg['name']}")
+    train, test, label_col, pred_cols = cfg["data"](scale)
+    model = cfg["model"](scale)
+    rounds = cfg["max_epochs"][scale]
+    target = cfg["target"][scale]
+
+    curve = []
+    elapsed = 0.0
+    sps_rounds = []
+    epochs_to_target = None
+    for r in range(rounds):
+        trainer = cfg["trainer"](model, scale, label_col)
+        t0 = time.perf_counter()
+        model = trainer.train(train, shuffle=True)
+        elapsed += time.perf_counter() - t0
+        sps_rounds.append(steady_samples_per_sec(trainer.history))
+
+        pred = ModelPredictor(model, batch_size=256).predict(test)
+        for t in pred_cols:
+            pred = t(pred)
+        acc = AccuracyEvaluator(
+            label_col="label",
+            **({"prediction_col": "prediction_index"} if pred_cols else {}),
+        ).evaluate(pred)
+        curve.append({"epoch": r + 1, "seconds": round(elapsed, 2), "accuracy": acc})
+        print(f"   epoch {r + 1}: t={elapsed:.1f}s acc={acc:.4f}")
+        if epochs_to_target is None and acc >= target:
+            epochs_to_target = r + 1
+            break
+
+    n_chips = len(jax.devices()) if platform != "cpu" else 1
+    best_sps = max(sps_rounds)
+    return {
+        "config": cfg["id"],
+        "name": cfg["name"],
+        "trainer": cfg["trainer_name"],
+        "model": cfg["model_name"],
+        "scale": scale,
+        "samples_per_sec_per_chip": round(best_sps / max(n_chips, 1), 1),
+        "target_accuracy": target,
+        "epochs_to_target": epochs_to_target,
+        "final_accuracy": curve[-1]["accuracy"],
+        "train_rows": len(train),
+        "seconds_total": round(elapsed, 1),
+        "curve": curve,
+    }
+
+
+def build_configs():
+    from distkeras_tpu import (
+        ADAG,
+        AEASGD,
+        DOWNPOUR,
+        DynSGD,
+        LabelIndexTransformer,
+        MinMaxTransformer,
+        OneHotTransformer,
+        SingleTrainer,
+    )
+    from distkeras_tpu.data import loaders
+    from distkeras_tpu.models import zoo
+
+    def mnist_data(flat):
+        def make(scale):
+            n = 8192 if scale == "full" else 2048
+            ds = loaders.synthetic_mnist(n=n, seed=0, flat=flat)
+            ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+            ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+            train, test = ds.split(0.9, seed=7)
+            return train, test, "label_onehot", []
+
+        return make
+
+    def higgs_data(scale):
+        n = 16384 if scale == "full" else 4096
+        ds = loaders.synthetic_higgs(n=n, seed=1)
+        ds = OneHotTransformer(2, output_col="label_onehot").transform(ds)
+        train, test = ds.split(0.9, seed=7)
+        return train, test, "label_onehot", []
+
+    def cifar_data(scale):
+        n = 8192 if scale == "full" else 2048
+        ds = loaders.synthetic_cifar10(n=n, seed=2)
+        ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+        ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+        train, test = ds.split(0.9, seed=7)
+        return train, test, "label_onehot", []
+
+    def imagenet_data(scale):
+        from distkeras_tpu import LabelIndexTransformer
+
+        n = 4096 if scale == "full" else 768
+        classes = 100
+        size = 64
+        ds = loaders.synthetic_imagenet(n=n, num_classes=classes, size=size, seed=3)
+        ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+        ds = OneHotTransformer(classes, output_col="label_onehot").transform(ds)
+        train, test = ds.split(0.9, seed=7)
+        return train, test, "label_onehot", [LabelIndexTransformer(classes)]
+
+    common = dict(loss="categorical_crossentropy", seed=0)
+    dist = dict(common, communication_window=4, mode="threads")
+
+    return [
+        {
+            "id": 1,
+            "name": "SingleTrainer / MNIST MLP",
+            "trainer_name": "SingleTrainer",
+            "model_name": "mnist_mlp",
+            "data": mnist_data(flat=True),
+            "model": lambda scale: zoo.mnist_mlp(seed=0),
+            "trainer": lambda m, scale, lc: SingleTrainer(
+                m, "sgd", learning_rate=0.05, batch_size=64,
+                num_epoch=1, label_col=lc, **common,
+            ),
+            "target": {"smoke": 0.97, "full": 0.97},
+            "max_epochs": {"smoke": 5, "full": 10},
+        },
+        {
+            "id": 2,
+            "name": "DOWNPOUR / MNIST CNN / 8 workers",
+            "trainer_name": "DOWNPOUR",
+            "model_name": "mnist_cnn",
+            "data": mnist_data(flat=False),
+            "model": lambda scale: zoo.mnist_cnn(seed=0),
+            "trainer": lambda m, scale, lc: DOWNPOUR(
+                m, "adam", learning_rate=1e-3, batch_size=32, num_epoch=1,
+                num_workers=8, label_col=lc,
+                compute_dtype="bfloat16", **dist,
+            ),
+            "target": {"smoke": 0.95, "full": 0.97},
+            "max_epochs": {"smoke": 5, "full": 10},
+        },
+        {
+            "id": 3,
+            "name": "AEASGD / ATLAS-Higgs MLP",
+            "trainer_name": "AEASGD",
+            "model_name": "higgs_mlp",
+            "data": higgs_data,
+            "model": lambda scale: zoo.higgs_mlp(seed=0),
+            "trainer": lambda m, scale, lc: AEASGD(
+                m, "sgd", learning_rate=0.02, rho=10.0, batch_size=64,
+                num_epoch=1, num_workers=4, label_col=lc, **dist,
+            ),
+            "target": {"smoke": 0.85, "full": 0.85},
+            "max_epochs": {"smoke": 6, "full": 12},
+        },
+        {
+            "id": 4,
+            "name": "ADAG / CIFAR-10 CNN",
+            "trainer_name": "ADAG",
+            "model_name": "cifar10_cnn",
+            "data": cifar_data,
+            "model": lambda scale: zoo.cifar10_cnn(seed=0),
+            "trainer": lambda m, scale, lc: ADAG(
+                m, "adam", learning_rate=1e-3, batch_size=32, num_epoch=1,
+                num_workers=4, label_col=lc,
+                compute_dtype="bfloat16", **dist,
+            ),
+            "target": {"smoke": 0.80, "full": 0.90},
+            "max_epochs": {"smoke": 5, "full": 10},
+        },
+        {
+            "id": 5,
+            "name": "DynSGD / ResNet-18 / ImageNet-shaped",
+            "trainer_name": "DynSGD",
+            "model_name": "resnet18",
+            "data": imagenet_data,
+            "model": lambda scale: zoo.resnet18(
+                num_classes=100, input_shape=(64, 64, 3), seed=0
+            ),
+            "trainer": lambda m, scale, lc: DynSGD(
+                m, "adam", learning_rate=1e-3, batch_size=32, num_epoch=1,
+                num_workers=4, label_col=lc,
+                compute_dtype="bfloat16", **dist,
+            ),
+            "target": {"smoke": 0.50, "full": 0.70},
+            "max_epochs": {"smoke": 4, "full": 8},
+        },
+    ]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--scale", choices=["smoke", "full"], default="smoke")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--out", default=".")
+    args = ap.parse_args()
+
+    platform = resolve_platform(args.cpu)
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    print(f"platform: {platform} ({device_kind}), scale: {args.scale}")
+
+    want = {int(c) for c in args.configs.split(",")}
+    rows = [
+        run_config(cfg, args.scale, platform)
+        for cfg in build_configs()
+        if cfg["id"] in want
+    ]
+
+    payload = {
+        "platform": platform,
+        "device_kind": device_kind,
+        "scale": args.scale,
+        "results": rows,
+    }
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "BENCHMARKS.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+
+    lines = [
+        "# BASELINE benchmark matrix",
+        "",
+        f"Platform `{platform}` ({device_kind}), scale `{args.scale}`. "
+        "Synthetic stand-in datasets (BASELINE.md: `published: {}` — no "
+        "upstream numbers exist); both BASELINE metric axes per config. "
+        "samples/sec/chip is steady-state (compile window excluded). "
+        "Reproduce: `python benchmarks.py`.",
+        "",
+        "| # | config | samples/sec/chip | target acc | epochs to target "
+        "| final acc | total s |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        ett = r["epochs_to_target"] if r["epochs_to_target"] else "not reached"
+        lines.append(
+            f"| {r['config']} | {r['name']} | {r['samples_per_sec_per_chip']} "
+            f"| {r['target_accuracy']} | {ett} | {r['final_accuracy']:.4f} "
+            f"| {r['seconds_total']} |"
+        )
+    with open(os.path.join(args.out, "BENCHMARKS.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("wrote BENCHMARKS.json / BENCHMARKS.md")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,7 @@ class Prefetcher:
             self._thread = None
             return
         self._iter = None
+        self._terminal = None  # StopIteration or the propagated exception
         self._queue = queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -79,10 +80,17 @@ class Prefetcher:
     def __next__(self):
         if self._queue is None:  # synchronous fallback
             return self._prepare(next(self._iter))
+        if self._terminal is not None:
+            # the worker puts its sentinel exactly once and exits; without
+            # this latch a second next() after exhaustion/error would block
+            # on an empty queue forever
+            raise self._terminal
         item = self._queue.get()
         if item is _DONE:
-            raise StopIteration
+            self._terminal = StopIteration()
+            raise self._terminal
         if isinstance(item, BaseException):
+            self._terminal = item
             raise item
         return item
 

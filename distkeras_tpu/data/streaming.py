@@ -62,6 +62,9 @@ class ShardWriter:
             raise ValueError(
                 f"shard columns {names} != first shard's {self._columns}"
             )
+        lens = {k: len(v) for k, v in cols.items()}
+        if len(set(lens.values())) != 1:
+            raise ValueError(f"column length mismatch within shard: {lens}")
         path = os.path.join(self.out_dir, f"shard_{len(self._paths):05d}.npz")
         np.savez(path, **cols)
         self._paths.append(path)

@@ -59,6 +59,7 @@ from distkeras_tpu.workers import (
     SingleTrainerWorker,
     WorkerCore,
     _metrics_to_records,
+    iter_windows,
     stack_window,
 )
 
@@ -140,19 +141,13 @@ class Trainer:
         bit-identical with prefetch on or off."""
         from distkeras_tpu.data.prefetch import Prefetcher
 
-        def windows(ds):
-            pend = []
-            for batch in ds.batches(global_batch, columns=cols):
-                pend.append(batch)
-                if len(pend) == window:
-                    yield pend
-                    pend = []
-            if pend:
-                yield pend
-
         for epoch in range(start_epoch, self.num_epoch):
             ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
-            with Prefetcher(windows(ds), prepare, depth=prefetch) as staged:
+            with Prefetcher(
+                iter_windows(ds, global_batch, cols, window),
+                prepare,
+                depth=prefetch,
+            ) as staged:
                 for prepared in staged:
                     carry = run_window(carry, prepared)
             if on_epoch_end is not None:

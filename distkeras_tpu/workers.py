@@ -164,6 +164,21 @@ def stack_window(batches: list, features_col: str, label_col: str):
     return xs, ys
 
 
+def iter_windows(dataset, batch_size: int, columns: list, window: int):
+    """Group a dataset's batches into window-sized lists, flushing the
+    ragged remainder window at the end — THE windowing semantics for every
+    windowed trainer (SingleTrainerWorker and Trainer._windowed_epochs both
+    route through here so they cannot diverge)."""
+    pend = []
+    for batch in dataset.batches(batch_size, columns=columns):
+        pend.append(batch)
+        if len(pend) == window:
+            yield pend
+            pend = []
+    if pend:
+        yield pend
+
+
 # --------------------------------------------------------------- sync workers
 
 
@@ -227,16 +242,6 @@ class SingleTrainerWorker:
         records = []
         cols = [self.features_col, self.label_col]
 
-        def windows(ds):
-            pend = []
-            for batch in ds.batches(batch_size, columns=cols):
-                pend.append(batch)
-                if len(pend) == window:
-                    yield pend
-                    pend = []
-            if pend:
-                yield pend
-
         for epoch in range(start_epoch, num_epoch):
             ds = (
                 dataset.shuffle(shuffle_seed + epoch)
@@ -244,7 +249,9 @@ class SingleTrainerWorker:
                 else dataset
             )
             with Prefetcher(
-                windows(ds), self._stage_window, depth=prefetch
+                iter_windows(ds, batch_size, cols, window),
+                self._stage_window,
+                depth=prefetch,
             ) as staged:
                 for xs, ys in staged:
                     params, state, opt_state, rng, records_w = self._run(

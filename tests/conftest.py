@@ -7,18 +7,11 @@ sandbox's sitecustomize is overridden by selecting the cpu platform
 explicitly.
 """
 
-import os
+from distkeras_tpu.parallel.mesh import force_cpu_mesh
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_cpu_mesh(8)
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
+import jax  # noqa: E402
 import pytest  # noqa: E402
 
 

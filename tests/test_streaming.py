@@ -265,3 +265,30 @@ def test_sp_trainer_rejects_indivisible_seq_len():
     )
     with pytest.raises(ValueError, match="not divisible by the 'seq' mesh"):
         t.train(ds)
+
+
+def test_prefetcher_exhaustion_is_terminal():
+    """next() after exhaustion or after a propagated error must re-raise,
+    not block on the dead worker's queue."""
+    pf = Prefetcher(range(3), depth=2)
+    assert list(pf) == [0, 1, 2]
+    with pytest.raises(StopIteration):
+        next(pf)
+
+    def bad(x):
+        raise RuntimeError("boom")
+
+    pf = Prefetcher(range(3), bad, depth=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+
+
+def test_shard_writer_rejects_ragged_columns(tmp_path):
+    from distkeras_tpu.data.streaming import ShardWriter
+
+    with pytest.raises(ValueError, match="length mismatch"):
+        with ShardWriter(str(tmp_path / "w")) as w:
+            w.add({"features": np.zeros((40, 3), np.float32),
+                   "label": np.zeros((39,), np.int64)})
