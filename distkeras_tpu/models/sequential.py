@@ -100,6 +100,17 @@ class Residual(Layer):
         return list(self.layers) + list(self.shortcut)
 
 
+def walk_layers(model_or_layers):
+    """Depth-first generator over a model's layers including sublayers —
+    THE traversal for mesh-hook attach/detach helpers (ring attention, MoE)
+    so they cannot diverge."""
+    stack = list(getattr(model_or_layers, "layers", model_or_layers))
+    while stack:
+        layer = stack.pop()
+        yield layer
+        stack.extend(layer.sublayers())
+
+
 class Model:
     """Built model handle: (apply_fn, params, state) + Keras-ish conveniences."""
 

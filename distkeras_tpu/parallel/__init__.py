@@ -13,4 +13,24 @@ from distkeras_tpu.parallel.mesh import (
     batch_sharding,
     shard_batch,
     replicate,
+    force_cpu_mesh,
+)
+from distkeras_tpu.parallel.ring_attention import (
+    ring_attention,
+    attach_ring_attention,
+    detach_ring_attention,
+)
+from distkeras_tpu.parallel.pipeline_parallel import (
+    pipeline_apply,
+    stack_block_params,
+    unstack_block_params,
+    shard_stacked_params,
+)
+from distkeras_tpu.parallel.expert_parallel import (
+    MoE,
+    moe_ffn,
+    switch_route,
+    attach_expert_mesh,
+    detach_expert_mesh,
+    shard_moe_params,
 )

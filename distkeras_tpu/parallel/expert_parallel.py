@@ -1,0 +1,196 @@
+"""Expert parallelism — switch-routed mixture-of-experts over a mesh axis.
+
+No reference counterpart (SURVEY §3.3: EP absent upstream); this is the
+TPU-rebuild capability that completes the parallelism axes (DP / TP / SP /
+PP / EP). Built the Mesh-TensorFlow/GSPMD way rather than with manual
+point-to-point routing:
+
+- ``switch_route`` computes top-1 routing with a fixed per-expert
+  **capacity** (static shapes — an XLA requirement; overflowing tokens are
+  dropped by the dispatch mask and pass through the residual);
+- dispatch/combine are one-hot einsums: tokens (S, D) -> expert batches
+  (E, C, D) and back. Under ``jit`` over a mesh with an ``"expert"`` axis,
+  the expert-stacked FFN params and the (E, C, D) intermediate carry a
+  ``P("expert")`` sharding — **XLA inserts the all-to-all** between the
+  token-sharded and expert-sharded layouts; nothing here speaks collectives
+  directly (SURVEY's "let GSPMD insert the collectives" recipe);
+- the auxiliary load-balance loss (Shazeer/Fedus switch loss: E * sum of
+  fraction-routed x mean-router-prob) is returned for the trainer to add.
+
+``MoE`` is the layer-zoo wrapper (drop-in FFN replacement);
+``shard_moe_params`` places a built model's expert stacks over the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.models.layers import Layer, register_layer, _glorot_uniform
+
+
+def switch_route(router_logits, capacity: int):
+    """Top-1 (switch) routing with fixed capacity.
+
+    router_logits: (S, E). Returns (dispatch (S, E, C) one-hot, combine
+    (S, E, C) gate-weighted, aux_loss scalar).
+    """
+    s, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (S,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    expert_onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (S, E)
+    # position of each token within its expert's queue (exclusive cumsum)
+    position = jnp.cumsum(expert_onehot, axis=0) * expert_onehot - expert_onehot
+    keep = (position < capacity).astype(jnp.float32) * expert_onehot  # (S, E)
+    pos_onehot = jax.nn.one_hot(
+        position.sum(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32
+    )  # (S, C)
+    dispatch = keep[:, :, None] * pos_onehot[:, None, :]  # (S, E, C)
+    combine = dispatch * gate[:, None, None]
+
+    # switch load-balance loss: E * sum_e f_e * p_e
+    fraction = expert_onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux_loss = e * jnp.sum(fraction * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(params, x, capacity_factor=1.25, mesh=None, axis_name="expert"):
+    """Switch-MoE feed-forward over tokens.
+
+    params: {"router": (D, E), "wi": (E, D, H), "wo": (E, H, D)}.
+    x: (..., D) — leading axes are flattened into the token axis.
+    Returns (same shape as x, aux_loss).
+    """
+    d = x.shape[-1]
+    lead = x.shape[:-1]
+    tokens = x.reshape(-1, d)
+    s = tokens.shape[0]
+    e = params["router"].shape[1]
+    capacity = max(1, int(capacity_factor * s / e))
+
+    logits = tokens.astype(jnp.float32) @ params["router"]
+    dispatch, combine, aux = switch_route(logits, capacity)
+
+    expert_in = jnp.einsum(
+        "sec,sd->ecd", dispatch.astype(x.dtype), tokens
+    )  # (E, C, D)
+    if mesh is not None:
+        # pin the expert-major layout; GSPMD inserts the token<->expert
+        # all-to-all around this constraint
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P(axis_name))
+        )
+    h = jnp.einsum("ecd,edh->ech", expert_in, params["wi"].astype(x.dtype))
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ech,ehd->ecd", h, params["wo"].astype(x.dtype))
+    if mesh is not None:
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P(axis_name))
+        )
+    out = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), expert_out)
+    return out.reshape(*lead, d), aux
+
+
+@register_layer
+class MoE(Layer):
+    """Mixture-of-experts FFN layer (drop-in Dense-pair replacement).
+
+    ``attach_expert_mesh`` points the layer at a live mesh so the expert
+    dimension shards; without a mesh it computes the identical math on one
+    device. Each forward writes the switch load-balance loss to
+    ``state["aux_loss"]``; ``WorkerCore`` sums every ``aux_loss`` leaf into
+    the training loss with weight ``aux_loss_weight`` (Trainer kwarg,
+    default 0.01), so routing IS regularized by every shipped trainer.
+    """
+
+    def __init__(self, num_experts, hidden_ratio=4, capacity_factor=1.25):
+        self.num_experts = int(num_experts)
+        self.hidden_ratio = int(hidden_ratio)
+        self.capacity_factor = float(capacity_factor)
+        self.mesh = None  # process-local hook, like ring attention's
+        self.axis_name = "expert"
+
+    def init(self, rng, in_shape):
+        d = in_shape[-1]
+        h = self.hidden_ratio * d
+        ks = jax.random.split(rng, 3)
+        params = {
+            "router": _glorot_uniform(ks[0], (d, self.num_experts), d,
+                                      self.num_experts),
+            "wi": 0.02 * jax.random.normal(
+                ks[1], (self.num_experts, d, h), jnp.float32
+            ),
+            "wo": 0.02 * jax.random.normal(
+                ks[2], (self.num_experts, h, d), jnp.float32
+            ),
+        }
+        return params, {"aux_loss": jnp.zeros((), jnp.float32)}, in_shape
+
+    def apply(self, params, state, x, train=False, rng=None):
+        out, aux = moe_ffn(
+            params,
+            x,
+            capacity_factor=self.capacity_factor,
+            mesh=self.mesh,
+            axis_name=self.axis_name,
+        )
+        return x + out, {"aux_loss": aux}
+
+    def get_config(self):
+        return {
+            "layer": "MoE",
+            "num_experts": self.num_experts,
+            "hidden_ratio": self.hidden_ratio,
+            "capacity_factor": self.capacity_factor,
+        }
+
+
+def attach_expert_mesh(model, mesh: Mesh, axis_name: str = "expert") -> int:
+    """Point every MoE layer in ``model`` at ``mesh`` (sharded experts).
+    Returns how many layers were attached. Process-local, like
+    ``ring_attention.attach_ring_attention``."""
+    from distkeras_tpu.models.sequential import walk_layers
+
+    axis_size = mesh.shape[axis_name]
+    count = 0
+    for layer in walk_layers(model):
+        if isinstance(layer, MoE):
+            if layer.num_experts % axis_size:
+                raise ValueError(
+                    f"num_experts={layer.num_experts} is not divisible by "
+                    f"mesh axis {axis_name}={axis_size}"
+                )
+            layer.mesh = mesh
+            layer.axis_name = axis_name
+            count += 1
+    return count
+
+
+def detach_expert_mesh(model) -> int:
+    """Remove mesh hooks installed by :func:`attach_expert_mesh`."""
+    from distkeras_tpu.models.sequential import walk_layers
+
+    count = 0
+    for layer in walk_layers(model):
+        if isinstance(layer, MoE) and layer.mesh is not None:
+            layer.mesh = None
+            count += 1
+    return count
+
+
+def shard_moe_params(params, mesh: Mesh, axis_name: str = "expert"):
+    """Place a built model's params with every MoE expert stack (leading-E
+    arrays under keys wi/wo) sharded over ``axis_name``; everything else
+    replicated."""
+    repl = NamedSharding(mesh, P())
+    exp = NamedSharding(mesh, P(axis_name))
+
+    def place(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return jax.device_put(leaf, exp if name in ("wi", "wo") else repl)
+
+    return jax.tree_util.tree_map_with_path(place, params)

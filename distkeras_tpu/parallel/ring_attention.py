@@ -122,16 +122,14 @@ def attach_ring_attention(model, mesh: Mesh, axis_name: str = "seq") -> int:
     import functools
 
     from distkeras_tpu.models.layers import MultiHeadSelfAttention
+    from distkeras_tpu.models.sequential import walk_layers
 
     fn = functools.partial(ring_attention, mesh=mesh, axis_name=axis_name)
     count = 0
-    stack = list(getattr(model, "layers", []))
-    while stack:
-        layer = stack.pop()
+    for layer in walk_layers(model):
         if isinstance(layer, MultiHeadSelfAttention):
             layer.attention_fn = fn
             count += 1
-        stack.extend(layer.sublayers())
     return count
 
 
@@ -142,18 +140,16 @@ def detach_ring_attention(model) -> int:
     neither the caller's model nor the returned copy keeps a closure over a
     live (process-local) Mesh."""
     from distkeras_tpu.models.layers import MultiHeadSelfAttention
+    from distkeras_tpu.models.sequential import walk_layers
 
     count = 0
-    stack = list(getattr(model, "layers", []))
-    while stack:
-        layer = stack.pop()
+    for layer in walk_layers(model):
         if (
             isinstance(layer, MultiHeadSelfAttention)
             and layer.attention_fn is not None
         ):
             layer.attention_fn = None
             count += 1
-        stack.extend(layer.sublayers())
     return count
 
 

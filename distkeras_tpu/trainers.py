@@ -82,6 +82,7 @@ class Trainer:
         seed=0,
         compute_dtype=None,
         remat=False,
+        aux_loss_weight=0.01,
         profile_dir=None,
         metrics_path=None,
     ):
@@ -102,6 +103,8 @@ class Trainer:
         self.seed = int(seed)
         self.compute_dtype = compute_dtype
         self.remat = bool(remat)
+        # weight on layer-emitted "aux_loss" state leaves (MoE load balance)
+        self.aux_loss_weight = float(aux_loss_weight)
         self.history = TrainingHistory()
         # observability (absent upstream — SURVEY §5.1/§5.5 required addition)
         self.profile_dir = profile_dir
@@ -115,6 +118,7 @@ class Trainer:
             metrics=self.metrics,
             compute_dtype=self.compute_dtype,
             remat=self.remat,
+            aux_loss_weight=self.aux_loss_weight,
         )
 
     def _windowed_epochs(

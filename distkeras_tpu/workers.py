@@ -50,6 +50,7 @@ class WorkerCore:
         metrics=("accuracy",),
         compute_dtype=None,
         remat=False,
+        aux_loss_weight=0.01,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -58,11 +59,13 @@ class WorkerCore:
         self.metric_fns = [get_metric(m) for m in metrics]
         self.compute_dtype = compute_dtype
         self.remat = bool(remat)
+        self.aux_loss_weight = float(aux_loss_weight)
 
         model_apply = model.apply
         loss_fn = self.loss_fn
         metric_fns = self.metric_fns
         cdtype = compute_dtype
+        aux_w = self.aux_loss_weight
 
         def train_fwd(params, state, rng, x):
             return model_apply(params, state, x, train=True, rng=rng)
@@ -77,7 +80,11 @@ class WorkerCore:
                 x = x.astype(cdtype)
             y_pred, new_state = train_fwd(params, state, rng, x)
             y_pred = y_pred.astype(jnp.float32)
-            return loss_fn(y_pred, y), (new_state, y_pred)
+            # layers that emit regularizers through state (MoE routing's
+            # load-balance loss) contribute aux_w * sum of "aux_loss" leaves;
+            # constant-folded away for models without any
+            loss = loss_fn(y_pred, y) + aux_w * _collect_aux_losses(new_state)
+            return loss, (new_state, y_pred)
 
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
 
@@ -155,6 +162,19 @@ def _metrics_to_records(mets) -> list:
     host = {k: np.asarray(v) for k, v in mets.items()}
     w = len(next(iter(host.values())))
     return [{k: float(v[i]) for k, v in host.items()} for i in range(w)]
+
+
+def _collect_aux_losses(state):
+    """Sum of every leaf named "aux_loss" in a model-state pytree — the
+    channel layers use to surface differentiable regularizers (MoE's
+    switch load-balance loss) to the training loss."""
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        last = path[-1]
+        name = last.key if hasattr(last, "key") else str(last)
+        if name == "aux_loss":
+            total = total + jnp.sum(leaf).astype(jnp.float32)
+    return total
 
 
 def stack_window(batches: list, features_col: str, label_col: str):

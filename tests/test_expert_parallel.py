@@ -1,0 +1,214 @@
+"""Expert parallelism (switch-routed MoE over an "expert" mesh axis).
+No reference counterpart (SURVEY §3.3: EP absent upstream) — pinned like
+the other parallelism axes: exact routing semantics, sharded-vs-unsharded
+parity, end-to-end training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from distkeras_tpu.parallel.expert_parallel import (
+    MoE,
+    attach_expert_mesh,
+    detach_expert_mesh,
+    moe_ffn,
+    shard_moe_params,
+    switch_route,
+)
+
+D = 16
+
+
+def make_mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("expert",))
+
+
+def test_switch_route_semantics():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((32, 4)).astype(np.float32))
+    dispatch, combine, aux = switch_route(logits, capacity=16)
+    dispatch = np.asarray(dispatch)
+    # each token occupies at most one (expert, slot) cell
+    assert dispatch.sum(axis=(1, 2)).max() <= 1.0
+    # no slot double-booked
+    assert dispatch.sum(axis=0).max() <= 1.0
+    # every kept token landed on its argmax expert
+    kept = dispatch.sum(axis=2)  # (S, E)
+    arg = np.asarray(jnp.argmax(jax.nn.softmax(logits, -1), axis=-1))
+    for s in range(32):
+        if kept[s].sum() > 0:
+            assert kept[s, arg[s]] == 1.0
+    assert float(aux) > 0
+
+
+def test_capacity_drops_overflow():
+    # all tokens want expert 0; capacity 4 keeps exactly 4
+    logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (16, 1))
+    dispatch, _, _ = switch_route(logits, capacity=4)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == 4.0  # first 4 tokens kept, rest dropped
+    assert d[:, 1].sum() == 0.0
+    assert d[:4, 0].sum() == 4.0  # kept in arrival order
+
+
+def test_single_expert_equals_dense_ffn():
+    """E=1 with ample capacity routes every token with gate 1.0 -> the MoE
+    reduces exactly to the dense gelu FFN."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((4, 8, D)).astype(np.float32))
+    params = {
+        "router": jnp.zeros((D, 1), jnp.float32),
+        "wi": jnp.asarray(rng.standard_normal((1, D, 32)).astype(np.float32)),
+        "wo": jnp.asarray(rng.standard_normal((1, 32, D)).astype(np.float32)),
+    }
+    out, aux = moe_ffn(params, x, capacity_factor=1.25)
+    ref = jax.nn.gelu(x @ params["wi"][0]) @ params["wo"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(float(aux), 1.0, atol=1e-6)  # E * 1 * 1
+
+
+def test_sharded_equals_unsharded():
+    """8 experts sharded over the 8-device mesh (GSPMD all-to-all) must
+    produce the same outputs as the single-placement run."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((4, 16, D)).astype(np.float32))
+    params = {
+        "router": jnp.asarray(rng.standard_normal((D, 8)).astype(np.float32)),
+        "wi": jnp.asarray(0.1 * rng.standard_normal((8, D, 32)).astype(np.float32)),
+        "wo": jnp.asarray(0.1 * rng.standard_normal((8, 32, D)).astype(np.float32)),
+    }
+    ref, aux_ref = moe_ffn(params, x)
+
+    mesh = make_mesh(8)
+    sharded = shard_moe_params(params, mesh)
+    assert len(sharded["wi"].sharding.device_set) == 8
+    assert sharded["router"].sharding.is_fully_replicated
+
+    @jax.jit
+    def run(p, x):
+        return moe_ffn(p, x, mesh=mesh)
+
+    out, aux = run(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), atol=1e-5)
+
+
+def test_moe_layer_in_sequential_and_config_roundtrip():
+    from distkeras_tpu.models.layers import Dense, Embedding, GlobalAvgPool1D
+    from distkeras_tpu.models.sequential import Sequential
+
+    model = Sequential(
+        [
+            Embedding(16, D),
+            MoE(num_experts=4),
+            GlobalAvgPool1D(),
+            Dense(2, activation="softmax"),
+        ]
+    ).build((8,), seed=0)
+    x = np.random.default_rng(3).integers(0, 16, (4, 8))
+    y, state = model.apply(model.params, model.state, jnp.asarray(x))
+    assert y.shape == (4, 2)
+    assert float(state["1"]["aux_loss"]) > 0
+
+    clone = Sequential.from_config(model.get_config())
+    clone.build((8,), seed=0)
+    y2, _ = clone.apply(clone.params, clone.state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2), atol=1e-6)
+
+
+def test_moe_model_trains_expert_parallel():
+    """End-to-end: MoE classifier with experts sharded over the 8-device
+    mesh trains to the task target through the GSPMD all-to-all."""
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.data import loaders
+    from distkeras_tpu.data.transformers import OneHotTransformer
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.models.layers import (
+        Dense,
+        Embedding,
+        GlobalAvgPool1D,
+        LayerNorm,
+        TransformerBlock,
+    )
+    from distkeras_tpu.models.sequential import Sequential
+    from distkeras_tpu.predictors import ModelPredictor
+
+    ds = loaders.synthetic_sequences(n=1024, seq_len=32, vocab=16, seed=0)
+    ds = OneHotTransformer(2, output_col="label_onehot").transform(ds)
+    train, test = ds.split(0.85, seed=0)
+
+    model = Sequential(
+        [
+            Embedding(16, 32),
+            TransformerBlock(num_heads=2),
+            MoE(num_experts=8),
+            LayerNorm(),
+            GlobalAvgPool1D(),
+            Dense(2, activation="softmax"),
+        ]
+    ).build((32,), seed=0)
+    mesh = make_mesh(8)
+    assert attach_expert_mesh(model, mesh) == 1
+
+    t = SingleTrainer(
+        model, "adam", "categorical_crossentropy",
+        batch_size=32, num_epoch=3, label_col="label_onehot",
+    )
+    trained = t.train(train, shuffle=True)
+    pred = ModelPredictor(trained, batch_size=256).predict(test)
+    acc = AccuracyEvaluator(label_col="label").evaluate(pred)
+    assert acc > 0.9, acc
+    assert detach_expert_mesh(model) == 1
+
+
+def test_aux_loss_reaches_training_gradient():
+    """WorkerCore adds aux_loss_weight * sum(state aux_loss leaves) to the
+    training loss, so the router weight receives load-balance gradient (not
+    just the top-1 gate's)."""
+    from distkeras_tpu.models.layers import Dense, Embedding, GlobalAvgPool1D
+    from distkeras_tpu.models.sequential import Sequential
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.workers import WorkerCore
+
+    model = Sequential(
+        [Embedding(16, D), MoE(num_experts=4), GlobalAvgPool1D(),
+         Dense(2, activation="softmax")]
+    ).build((8,), seed=0)
+    rng = np.random.default_rng(4)
+    xs = rng.integers(0, 16, (1, 16, 8))
+    ys = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (1, 16))]
+
+    from distkeras_tpu.utils.tree import host_copy
+
+    outs = {}
+    for w in (0.0, 1.0):
+        core = WorkerCore(
+            model, get_optimizer("sgd", 0.1), "categorical_crossentropy",
+            aux_loss_weight=w,
+        )
+        # owned copies: the compiled window donates its inputs
+        params = host_copy(model.params)
+        params, state, opt_state, key, mets = core.window(
+            params,
+            host_copy(model.state),
+            core.init_opt_state(params),
+            jax.random.PRNGKey(0), xs, ys,
+        )
+        outs[w] = (np.asarray(params["1"]["router"]), float(mets["loss"][0]))
+    # weight 1.0 shifts both the reported loss and the router update
+    assert outs[1.0][1] > outs[0.0][1]
+    assert not np.allclose(outs[1.0][0], outs[0.0][0])
+
+
+def test_attach_rejects_indivisible_experts():
+    from distkeras_tpu.models.layers import Dense, Embedding, GlobalAvgPool1D
+    from distkeras_tpu.models.sequential import Sequential
+
+    model = Sequential(
+        [Embedding(16, D), MoE(num_experts=3), GlobalAvgPool1D(),
+         Dense(2, activation="softmax")]
+    ).build((8,), seed=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        attach_expert_mesh(model, make_mesh(8))
