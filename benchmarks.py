@@ -88,6 +88,9 @@ def run_config(cfg, scale, platform):
     epochs_to_target = None
     for r in range(rounds):
         trainer = cfg["trainer"](model, scale, label_col)
+        # per-round seed: each 1-epoch round must see a fresh shuffle order
+        # (a fixed seed would replay the identical order every round)
+        trainer.seed = trainer.seed + r
         t0 = time.perf_counter()
         model = trainer.train(train, shuffle=True)
         elapsed += time.perf_counter() - t0

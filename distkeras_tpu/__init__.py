@@ -29,6 +29,7 @@ from distkeras_tpu.trainers import (
     AsynchronousDistributedTrainer,
     SynchronousDistributedTrainer,
     SequenceParallelTrainer,
+    PipelineParallelTrainer,
     DOWNPOUR,
     AEASGD,
     EAMSGD,

@@ -78,6 +78,11 @@ def _glorot_uniform(rng, shape, fan_in, fan_out, dtype=jnp.float32):
 class Layer:
     """Base declarative layer. Subclasses override init/apply/get_config."""
 
+    # True for layers that consume an rng in train mode (Dropout) — the
+    # pipeline trainer's block-run discovery excludes such blocks because
+    # the GPipe schedule does not thread per-block rngs
+    uses_train_rng = False
+
     def init(self, rng, in_shape):
         return {}, {}, in_shape
 
@@ -284,6 +289,8 @@ class Flatten(Layer):
 @register_layer
 class Dropout(Layer):
     """Inverted dropout; identity in eval mode. Needs an rng when train=True."""
+
+    uses_train_rng = True
 
     def __init__(self, rate):
         self.rate = float(rate)

@@ -130,6 +130,42 @@ def transformer_classifier(
     return model
 
 
+def moe_transformer_classifier(
+    vocab_size=64,
+    seq_len=64,
+    d_model=64,
+    num_heads=4,
+    depth=2,
+    num_experts=8,
+    num_classes=2,
+    seed=0,
+):
+    """Sequence classifier with switch-MoE feed-forwards after each
+    transformer block — the expert-parallel model family. Pair with
+    ``parallel.expert_parallel.attach_expert_mesh`` to shard the experts
+    over a mesh (GSPMD inserts the token<->expert all-to-all); the MoE
+    load-balance aux loss reaches the training loss through WorkerCore's
+    aux_loss_weight. No reference counterpart (SURVEY §3.3: EP absent
+    upstream)."""
+    from distkeras_tpu.models.layers import (
+        Dense,
+        Embedding,
+        GlobalAvgPool1D,
+        LayerNorm,
+        TransformerBlock,
+    )
+    from distkeras_tpu.models.sequential import Sequential
+    from distkeras_tpu.parallel.expert_parallel import MoE
+
+    layers = [Embedding(vocab_size, d_model)]
+    for _ in range(depth):
+        layers += [TransformerBlock(num_heads), MoE(num_experts)]
+    layers += [LayerNorm(), GlobalAvgPool1D(), Dense(num_classes, activation="softmax")]
+    model = Sequential(layers)
+    model.build((seq_len,), seed=seed)
+    return model
+
+
 def _basic_block(filters, stride=1, downsample=False):
     shortcut = (
         [Conv2D(filters, 1, strides=stride, padding="SAME", use_bias=False), BatchNorm()]
@@ -182,4 +218,6 @@ ZOO = {
     "higgs_mlp": higgs_mlp,
     "cifar10_cnn": cifar10_cnn,
     "resnet18": resnet18,
+    "transformer_classifier": transformer_classifier,
+    "moe_transformer_classifier": moe_transformer_classifier,
 }

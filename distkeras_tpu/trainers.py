@@ -23,12 +23,15 @@ TPU-native mapping (SURVEY §7.1):
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 from distkeras_tpu.ops.optimizers import effective_learning_rate, get_optimizer
 from distkeras_tpu.parallel.mesh import (
@@ -196,14 +199,18 @@ class Trainer:
             return None
         return self.checkpointer.restore()
 
+    def _should_checkpoint(self, done: int) -> bool:
+        """THE epoch-snapshot policy: every `checkpoint_every` epochs
+        (0 = final only) and always at the last epoch."""
+        every = self.checkpoint_every
+        return (every > 0 and done % every == 0) or done == self.num_epoch
+
     def _save_epoch_checkpoint(self, done, params, state, opt_state, rng):
-        """Epoch-granular snapshot policy shared by SingleTrainer and the
-        sync-DP trainer: every `checkpoint_every` epochs (0 = final only)
-        and always at the last epoch."""
+        """Epoch-granular snapshots shared by SingleTrainer and the sync-DP
+        trainer (policy: ``_should_checkpoint``)."""
         if self.checkpointer is None:
             return
-        every = self.checkpoint_every
-        if (every > 0 and done % every == 0) or done == self.num_epoch:
+        if self._should_checkpoint(done):
             self.checkpointer.save(
                 done,
                 {
@@ -597,6 +604,294 @@ class SequenceParallelTrainer(Trainer):
 
         self.history.record_training_end()
         return self._finish(params, state)
+
+
+class _PipelineModelShim:
+    """Model-shaped adapter whose apply() runs the block tower through
+    ``pipeline_apply`` — lets WorkerCore compile a pipelined train step
+    without knowing about pipelining."""
+
+    def __init__(self, model, pre_idx, block_idx, post_idx, mesh, num_micro):
+        from distkeras_tpu.parallel.pipeline_parallel import pipeline_apply
+
+        self._pipeline_apply = pipeline_apply
+        self.layers = model.layers
+        self.pre_idx = list(pre_idx)
+        self.block_idx = list(block_idx)
+        self.post_idx = list(post_idx)
+        self.block_layer = model.layers[block_idx[0]]
+        # blocks are stateless + rng-free (enforced by _find_block_run):
+        # the scanned schedule threads neither state nor per-block rngs
+        self.block_state = model.state[str(block_idx[0])]
+        self.mesh = mesh
+        self.num_micro = num_micro
+
+    def apply(self, params, state, x, train=False, rng=None):
+        rngs = (
+            jax.random.split(rng, len(self.layers))
+            if rng is not None
+            else [None] * len(self.layers)
+        )
+        new_state = dict(state)
+        h = x
+        for i in self.pre_idx:
+            h, new_state[str(i)] = self.layers[i].apply(
+                params[str(i)], state[str(i)], h, train, rngs[i]
+            )
+
+        def block_apply(p, hh):
+            out, _ = self.block_layer.apply(p, self.block_state, hh, train, None)
+            return out
+
+        h = self._pipeline_apply(
+            params["__blocks__"], h, block_apply, self.mesh,
+            num_micro=self.num_micro,
+        )
+        for i in self.post_idx:
+            h, new_state[str(i)] = self.layers[i].apply(
+                params[str(i)], state[str(i)], h, train, rngs[i]
+            )
+        return h, new_state
+
+
+class PipelineParallelTrainer(Trainer):
+    """Pipeline-parallel training: GPipe microbatching over a ``("pipe",)``
+    mesh.
+
+    No reference counterpart (SURVEY §3.3: no model sharding upstream).
+    The model must contain a contiguous run of identically-configured,
+    stateless, rng-free blocks (``zoo.transformer_classifier``'s
+    TransformerBlock tower is the canonical case) whose length divides the
+    mesh size. The trainer re-layouts those blocks' params onto a stacked
+    leading stage axis sharded over ``"pipe"`` — each device holds
+    ``depth/S`` blocks, so block memory scales 1/S — and the compiled
+    window runs the GPipe schedule (activations hop stages via ppermute;
+    the backward pass retraces the ring). Pre/post layers and the batch
+    are replicated. The returned model is a NORMAL model with the blocks
+    unstacked: pipelining is an execution-layout concern, invisible in the
+    result (and in checkpoints, which store the unstacked layout).
+    """
+
+    def __init__(
+        self,
+        *args,
+        num_workers=None,
+        window=8,
+        mesh=None,
+        num_micro=None,
+        prefetch=2,
+        checkpoint_dir=None,
+        checkpoint_every=1,
+        max_to_keep=3,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        if mesh is not None:
+            if "pipe" not in mesh.axis_names:
+                raise ValueError(f"mesh {dict(mesh.shape)} has no 'pipe' axis")
+            self.mesh = mesh
+        else:
+            devs = local_devices(num_workers)
+            self.mesh = make_mesh(axis_names=("pipe",), devices=devs)
+        self.num_workers = int(self.mesh.shape["pipe"])
+        self.num_micro = int(num_micro) if num_micro else self.num_workers
+        self.window = int(window)
+        self.prefetch = int(prefetch)
+        self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
+
+    # -- block-run discovery -------------------------------------------------
+
+    def _find_block_run(self):
+        """Longest contiguous run of identically-configured layers whose
+        length divides the pipe mesh size; must also be stateless."""
+        layers = self.model.layers
+        runs = []
+        start = 0
+        for i in range(1, len(layers) + 1):
+            if i == len(layers) or (
+                layers[i].get_config() != layers[start].get_config()
+            ):
+                runs.append((start, i))
+                start = i
+        runs.sort(key=lambda r: r[1] - r[0], reverse=True)
+        from distkeras_tpu.models.sequential import walk_layers
+
+        for s, e in runs:
+            depth = e - s
+            if depth >= self.num_workers and depth % self.num_workers == 0:
+                stateless = all(
+                    not jax.tree.leaves(self.model.state[str(i)])
+                    for i in range(s, e)
+                )
+                # the scanned schedule threads neither state nor per-block
+                # rngs: rng-consuming blocks (Dropout towers) are excluded
+                rng_free = all(
+                    not sub.uses_train_rng
+                    for sub in walk_layers(layers[s:e])
+                )
+                if stateless and rng_free:
+                    return list(range(s, e))
+        raise ValueError(
+            "no contiguous run of >= num_workers identically-configured "
+            "stateless blocks divisible by the pipe mesh size "
+            f"({self.num_workers}) — pipeline parallelism needs a "
+            "homogeneous block tower (zoo.transformer_classifier)"
+        )
+
+    def _stack(self, params_by_layer, block_idx):
+        from distkeras_tpu.parallel.pipeline_parallel import stack_block_params
+
+        return stack_block_params([params_by_layer[str(i)] for i in block_idx])
+
+    def _unstack_into(self, pipe_params, block_idx):
+        """Pipelined layout -> normal per-layer params dict (host arrays)."""
+        from distkeras_tpu.parallel.pipeline_parallel import unstack_block_params
+
+        out = {}
+        blocks = unstack_block_params(pipe_params["__blocks__"])
+        for i in range(len(self.model.layers)):
+            if i in block_idx:
+                out[str(i)] = jax.tree.map(
+                    np.asarray, blocks[block_idx.index(i)]
+                )
+            else:
+                out[str(i)] = jax.tree.map(np.asarray, pipe_params[str(i)])
+        return out
+
+    # -- train ---------------------------------------------------------------
+
+    def _train(self, dataset, shuffle=False, resume=False):
+        self.history.record_training_start()
+        block_idx = self._find_block_run()
+        other_idx = [
+            i for i in range(len(self.model.layers)) if i not in block_idx
+        ]
+        pre_idx = [i for i in other_idx if i < block_idx[0]]
+        post_idx = [i for i in other_idx if i > block_idx[-1]]
+
+        shim = _PipelineModelShim(
+            self.model, pre_idx, block_idx, post_idx, self.mesh, self.num_micro
+        )
+
+        start_epoch = 0
+        restored = self._restore_latest() if resume else None
+        source_params = (
+            restored[1]["params"] if restored is not None else host_copy(self.model.params)
+        )
+        source_state = (
+            restored[1]["state"] if restored is not None else host_copy(self.model.state)
+        )
+        if restored is not None:
+            start_epoch = int(restored[2]["epoch"])
+
+        repl = NamedSharding(self.mesh, P())
+        pipe_sh = NamedSharding(self.mesh, P("pipe"))
+        params = {
+            "__blocks__": jax.tree.map(
+                lambda a: jax.device_put(a, pipe_sh),
+                self._stack(source_params, block_idx),
+            ),
+            **{
+                str(i): jax.device_put(source_params[str(i)], repl)
+                for i in other_idx
+            },
+        }
+        state = {
+            str(i): jax.device_put(source_state[str(i)], repl)
+            for i in range(len(self.model.layers))
+        }
+
+        core = WorkerCore(
+            shim,
+            self.optimizer,
+            self.loss,
+            metrics=self.metrics,
+            compute_dtype=self.compute_dtype,
+            remat=self.remat,
+            aux_loss_weight=self.aux_loss_weight,
+        )
+        # jitted init lets GSPMD propagate the blocks' pipe sharding into
+        # the optimizer moments
+        opt_state = jax.jit(core.init_opt_state)(params)
+        if restored is not None and "opt_state" in restored[1]:
+            candidate = restored[1]["opt_state"]
+            if jax.tree.structure(candidate) == jax.tree.structure(opt_state):
+                # same pipeline geometry: adopt the restored moments. The
+                # host leaves stay UNCOMMITTED (no device_put) — the
+                # compiled window lays them out to match the params'
+                # shardings; a fixed placement would conflict with the
+                # mesh-committed params.
+                opt_state = candidate
+            else:
+                # checkpoint written by a different trainer/geometry
+                # (per-layer layout): params/state still restore — only the
+                # optimizer moments restart
+                logger.warning(
+                    "checkpoint opt_state layout does not match this "
+                    "pipeline geometry; reinitializing optimizer state"
+                )
+        rng = (
+            jax.device_put(restored[1]["rng"])
+            if restored is not None
+            else jax.random.PRNGKey(self.seed)
+        )
+
+        cols = [self.features_col, self.label_col]
+
+        def prepare(batches):
+            xs, ys = stack_window(batches, self.features_col, self.label_col)
+            return jax.device_put(xs, repl), jax.device_put(ys, repl)
+
+        def run_window(carry, prepared):
+            params, state, opt_state, rng = carry
+            xs, ys = prepared
+            t0 = time.perf_counter()
+            params, state, opt_state, rng, mets = core.window(
+                params, state, opt_state, rng, xs, ys
+            )
+            self.history.extend(0, _metrics_to_records(mets))
+            self.history.record_window(
+                0, xs.shape[0] * xs.shape[1], time.perf_counter() - t0
+            )
+            return params, state, opt_state, rng
+
+        def on_epoch_end(epoch, carry):
+            if self.checkpointer is None:
+                return
+            done = epoch + 1
+            if not self._should_checkpoint(done):
+                return
+            params, state, opt_state, rng = carry
+            # checkpoints store the NORMAL layout for interop; opt_state
+            # stays in pipeline layout (it only matters to resumed pipeline
+            # runs with the same geometry)
+            self.checkpointer.save(
+                done,
+                {
+                    "params": self._unstack_into(params, block_idx),
+                    "state": jax.tree.map(np.asarray, state),
+                    "opt_state": jax.tree.map(np.asarray, opt_state),
+                    "rng": np.asarray(rng),
+                },
+                {"epoch": done},
+            )
+
+        params, state, opt_state, rng = self._windowed_epochs(
+            dataset,
+            shuffle,
+            cols,
+            self.batch_size,
+            self.window,
+            start_epoch,
+            (params, state, opt_state, rng),
+            run_window,
+            on_epoch_end,
+            prepare=prepare,
+            prefetch=self.prefetch,
+        )
+
+        self.history.record_training_end()
+        return self._finish(self._unstack_into(params, block_idx), state)
 
 
 class EnsembleTrainer(Trainer):
