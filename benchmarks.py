@@ -33,6 +33,9 @@ import time
 
 
 def resolve_platform(force_cpu: bool) -> str:
+    from distkeras_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     if force_cpu:
         from distkeras_tpu.parallel.mesh import force_cpu_mesh
 
@@ -104,7 +107,7 @@ def run_config(cfg, scale, platform):
             **({"prediction_col": "prediction_index"} if pred_cols else {}),
         ).evaluate(pred)
         curve.append({"epoch": r + 1, "seconds": round(elapsed, 2), "accuracy": acc})
-        print(f"   epoch {r + 1}: t={elapsed:.1f}s acc={acc:.4f}")
+        print(f"   epoch {r + 1}: t={elapsed:.1f}s acc={acc:.4f}", flush=True)
         if epochs_to_target is None and acc >= target:
             epochs_to_target = r + 1
             break
@@ -282,11 +285,21 @@ def main():
     print(f"platform: {platform} ({device_kind}), scale: {args.scale}")
 
     want = {int(c) for c in args.configs.split(",")}
-    rows = [
-        run_config(cfg, args.scale, platform)
-        for cfg in build_configs(platform)
-        if cfg["id"] in want
-    ]
+    rows = []
+    for cfg in build_configs(platform):
+        if cfg["id"] not in want:
+            continue
+        try:
+            rows.append(run_config(cfg, args.scale, platform))
+        except Exception as exc:  # one bad config must not lose the others
+            print(f"   config {cfg['id']} FAILED: {exc}", flush=True)
+            rows.append(
+                {
+                    "config": cfg["id"],
+                    "name": cfg["name"],
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+            )
 
     payload = {
         "platform": platform,
@@ -312,6 +325,11 @@ def main():
         "|---|---|---|---|---|---|---|",
     ]
     for r in rows:
+        if "error" in r:
+            lines.append(
+                f"| {r['config']} | {r['name']} | error: {r['error']} | | | | |"
+            )
+            continue
         ett = r["epochs_to_target"] if r["epochs_to_target"] else "not reached"
         lines.append(
             f"| {r['config']} | {r['name']} | {r['samples_per_sec_per_chip']} "

@@ -261,6 +261,7 @@ class SingleTrainer(Trainer):
         window=8,
         device=None,
         prefetch=2,
+        device_resident=False,
         checkpoint_dir=None,
         checkpoint_every=1,
         max_to_keep=3,
@@ -270,6 +271,9 @@ class SingleTrainer(Trainer):
         self.window = int(window)
         self.device = device
         self.prefetch = int(prefetch)
+        # dataset fits in HBM -> ship it once, stream only indices
+        # (bit-identical to the streamed path; see WorkerCore.indexed_window)
+        self.device_resident = bool(device_resident)
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
     def _train(self, dataset, shuffle=False, resume=False):
@@ -311,6 +315,7 @@ class SingleTrainer(Trainer):
             start_epoch=start_epoch,
             on_epoch_end=on_epoch_end,
             prefetch=self.prefetch,
+            device_resident=self.device_resident,
         )
         self.history.extend(0, records)
         for s, dt in worker.timings:
@@ -338,6 +343,7 @@ class SynchronousDistributedTrainer(Trainer):
         mesh=None,
         model_parallel=None,
         prefetch=2,
+        device_resident=False,
         checkpoint_dir=None,
         checkpoint_every=1,
         max_to_keep=3,
@@ -382,6 +388,10 @@ class SynchronousDistributedTrainer(Trainer):
         self.num_workers = int(self.mesh.shape.get("data", self.mesh.devices.size))
         self.window = int(window)
         self.prefetch = int(prefetch)
+        # dataset replicated into every chip's HBM once; per-window the host
+        # ships only the (W, B_global) index matrix, sharded over "data" so
+        # each shard gathers its own rows (see WorkerCore.indexed_window)
+        self.device_resident = bool(device_resident)
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
     def _place_params(self, params):
@@ -431,6 +441,17 @@ class SynchronousDistributedTrainer(Trainer):
         data_sh = batch_sharding(self.mesh)
         cols = [self.features_col, self.label_col]
 
+        if self.device_resident:
+            return self._train_resident(
+                dataset,
+                shuffle,
+                core,
+                global_batch,
+                (params, state, opt_state, rng),
+                start_epoch,
+                data_sh,
+            )
+
         def prepare(batches):
             # host staging (prefetch thread): batch shards along "data"
             xs, ys = stack_window(batches, self.features_col, self.label_col)
@@ -464,6 +485,45 @@ class SynchronousDistributedTrainer(Trainer):
             prepare=prepare,
             prefetch=self.prefetch,
         )
+
+        self.history.record_training_end()
+        return self._finish(params, state)
+
+    def _train_resident(
+        self, dataset, shuffle, core, global_batch, carry, start_epoch, data_sh
+    ):
+        """HBM-resident sync-DP epochs: the dataset is replicated into every
+        chip's HBM once; per window the host ships only the (W, B_global)
+        int32 index matrix, sharded along "data" — each shard gathers its
+        own batch rows on-device, so the gather is collective-free and the
+        step's gradient ``psum`` is unchanged. Batch assembly matches the
+        streamed path permutation-for-permutation (bit-identical)."""
+        from distkeras_tpu.parallel.mesh import replicated_sharding
+        from distkeras_tpu.workers import epoch_index_windows, resident_arrays
+
+        params, state, opt_state, rng = carry
+        n = len(dataset)
+        data_x, data_y = resident_arrays(dataset, self.features_col, self.label_col)
+        if n // global_batch > 0:
+            repl = replicated_sharding(self.mesh)
+            data_x = jax.device_put(data_x, repl)
+            data_y = jax.device_put(data_y, repl)
+        idx_sh = data_sh.update(spec=(None, "data"))
+
+        for epoch in range(start_epoch, self.num_epoch):
+            for idx_host in epoch_index_windows(
+                n, global_batch, self.window, self.seed if shuffle else None, epoch
+            ):
+                idx = jax.device_put(idx_host, idx_sh)
+                t0 = time.perf_counter()
+                params, state, opt_state, rng, mets = core.indexed_window(
+                    params, state, opt_state, rng, data_x, data_y, idx
+                )
+                self.history.extend(0, _metrics_to_records(mets))
+                self.history.record_window(0, idx.size, time.perf_counter() - t0)
+            self._save_epoch_checkpoint(
+                epoch + 1, params, state, opt_state, rng
+            )
 
         self.history.record_training_end()
         return self._finish(params, state)

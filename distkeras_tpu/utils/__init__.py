@@ -22,6 +22,7 @@ from distkeras_tpu.utils.serialization import (
     save_params,
     load_params,
 )
+from distkeras_tpu.utils.compile_cache import enable_compile_cache
 from distkeras_tpu.utils.history import TrainingHistory
 from distkeras_tpu.utils.rng import RngSeq
 from distkeras_tpu.utils.checkpoint import Checkpointer

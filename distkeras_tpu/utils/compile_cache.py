@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache.
+
+The reference pays its per-executor startup cost in Keras model
+deserialization + TF graph construction (reference: distkeras/workers.py ->
+Worker.prepare_model, re-run in every Spark task). The TPU-shaped analog of
+that cost is XLA compilation (~20-40s per program on a v5e), and the
+TPU-shaped fix is the persistent compilation cache: compiled executables are
+keyed by HLO hash on disk, so re-creating a trainer (new jit closures, same
+program) or re-running a harness hits the cache instead of the compiler.
+
+Used by bench.py / benchmarks.py / tests/conftest.py; call before the first
+compilation (any time after import works — the cache is consulted per
+compile).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def _default_dir() -> str:
+    # user-scoped: a fixed world-shared /tmp name would collide (and be
+    # plantable) on multi-user hosts
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    return os.path.join(tempfile.gettempdir(), f"dkt_jax_cache_{uid}")
+
+
+def enable_compile_cache(path: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing). Returns the cache directory. Safe to call repeatedly."""
+    import jax
+
+    path = path or os.environ.get("DKT_COMPILE_CACHE") or _default_dir()
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every program that takes meaningful compile time; the default
+    # threshold (1s+) skips the small-but-numerous ragged-window variants
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    return path

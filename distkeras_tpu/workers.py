@@ -117,6 +117,29 @@ class WorkerCore:
             )
             return params, state, opt_state, rng, mets
 
+        def indexed_window(params, state, opt_state, rng, data_x, data_y, idx):
+            """Device-resident window: the full dataset lives in HBM and each
+            scan step gathers its minibatch by index (``idx``: (W, B) int32).
+            The host ships ~4 bytes/sample of indices per window instead of
+            the samples themselves, so steady-state throughput is
+            compute-bound, not host-link-bound — the TPU-shaped answer to the
+            reference's per-row Python iterator feed (reference:
+            distkeras/workers.py -> SingleTrainerWorker minibatch assembly).
+            Batch contents match the streamed path exactly for the same
+            permutation, so trajectories are bit-identical either way."""
+
+            def step(carry, ix):
+                batch = {
+                    "x": jnp.take(data_x, ix, axis=0),
+                    "y": jnp.take(data_y, ix, axis=0),
+                }
+                return train_step(carry, batch)
+
+            (params, state, opt_state, rng), mets = jax.lax.scan(
+                step, (params, state, opt_state, rng), idx
+            )
+            return params, state, opt_state, rng, mets
+
         def grad_window(params, state, opt_state, rng, xs, ys):
             """Like window, but also accumulates raw gradients (ADAG)."""
 
@@ -150,6 +173,7 @@ class WorkerCore:
             return mets
 
         self.window = jax.jit(window, donate_argnums=(0, 1, 2))
+        self.indexed_window = jax.jit(indexed_window, donate_argnums=(0, 1, 2))
         self.grad_window = jax.jit(grad_window, donate_argnums=(0, 1, 2))
         self.eval_step = jax.jit(eval_step)
 
@@ -199,6 +223,45 @@ def iter_windows(dataset, batch_size: int, columns: list, window: int):
         yield pend
 
 
+def epoch_index_windows(n, batch_size, window, shuffle_seed, epoch):
+    """(W, B) int32 index matrices for one epoch of device-resident training.
+
+    THE single encoding of the resident paths' batch-assembly contract: the
+    row order is exactly ``Dataset.shuffle(seed + epoch)``'s permutation
+    (``np.random.default_rng`` — data/dataset.py), batches cut sequentially,
+    remainder rows dropped (``Dataset.batches`` drop_remainder semantics).
+    Both SingleTrainerWorker and the sync-DP trainer route through here, so
+    the bit-identity guarantee against the streamed path cannot diverge
+    between them."""
+    perm = (
+        np.random.default_rng(shuffle_seed + epoch).permutation(n)
+        if shuffle_seed is not None
+        else np.arange(n)
+    )
+    nb = n // batch_size
+    idx_all = perm[: nb * batch_size].astype(np.int32).reshape(nb, batch_size)
+    for w0 in range(0, nb, window):
+        yield idx_all[w0 : w0 + window]
+
+
+def resident_arrays(dataset, features_col, label_col):
+    """Materialize the two training columns for HBM residency, with a clear
+    boundary error for datasets that cannot be indexed by column (e.g.
+    StreamingDataset, which exists precisely for data that does NOT fit in
+    memory — stream those with device_resident=False)."""
+    try:
+        return (
+            np.asarray(dataset[features_col]),
+            np.asarray(dataset[label_col]),
+        )
+    except TypeError as exc:
+        raise TypeError(
+            "device_resident=True requires an in-memory Dataset whose "
+            f"columns can be materialized; got {type(dataset).__name__}. "
+            "Use device_resident=False to stream it."
+        ) from exc
+
+
 # --------------------------------------------------------------- sync workers
 
 
@@ -228,6 +291,7 @@ class SingleTrainerWorker:
         start_epoch=0,
         on_epoch_end=None,
         prefetch=2,
+        device_resident=False,
     ):
         """``initial``: optional (params, state) to start from instead of the
         core model's (lets many workers share one compiled core).
@@ -239,7 +303,13 @@ class SingleTrainerWorker:
         ``prefetch``: windows staged (stack + device_put) by a background
         thread while the device computes the previous window — double
         buffering; 0 restores the synchronous input path. Window order is
-        preserved either way, so results are bit-identical."""
+        preserved either way, so results are bit-identical.
+        ``device_resident``: ship the whole dataset to HBM once and drive
+        ``WorkerCore.indexed_window`` with per-epoch shuffled index matrices
+        instead of streaming sample windows from the host. Same permutation,
+        same batch contents — trajectories stay bit-identical with the
+        streamed path — but the per-window host traffic drops from the
+        samples themselves to 4 bytes/sample of indices."""
         if initial_full is not None:
             params, state, opt_state, rng = (
                 host_copy(initial_full[0]),
@@ -259,6 +329,21 @@ class SingleTrainerWorker:
             params, state, opt_state = jax.device_put(
                 (params, state, opt_state), self.device
             )
+        if device_resident:
+            return self._train_resident(
+                dataset,
+                batch_size,
+                num_epoch,
+                window,
+                shuffle_seed,
+                params,
+                state,
+                opt_state,
+                rng,
+                start_epoch,
+                on_epoch_end,
+            )
+
         records = []
         cols = [self.features_col, self.label_col]
 
@@ -278,6 +363,51 @@ class SingleTrainerWorker:
                         params, state, opt_state, rng, xs, ys
                     )
                     records.extend(records_w)
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, params, state, opt_state, rng)
+        return params, state, records
+
+    def _train_resident(
+        self,
+        dataset,
+        batch_size,
+        num_epoch,
+        window,
+        shuffle_seed,
+        params,
+        state,
+        opt_state,
+        rng,
+        start_epoch,
+        on_epoch_end,
+    ):
+        """Device-resident epoch loop: dataset in HBM, indices from the host.
+
+        Batch assembly mirrors the streamed path exactly — per epoch the same
+        ``default_rng(seed + epoch).permutation`` order, batches cut
+        sequentially, remainder rows dropped (``Dataset.batches``
+        drop_remainder semantics) — so the two paths produce bit-identical
+        parameter trajectories."""
+        n = len(dataset)
+        data_x, data_y = resident_arrays(dataset, self.features_col, self.label_col)
+        if n // batch_size > 0:  # don't ship a dataset no window will touch
+            if self.device is not None:
+                data_x, data_y = jax.device_put((data_x, data_y), self.device)
+            else:
+                data_x, data_y = jax.device_put((data_x, data_y))
+
+        records = []
+        for epoch in range(start_epoch, num_epoch):
+            for idx in epoch_index_windows(
+                n, batch_size, window, shuffle_seed, epoch
+            ):
+                t0 = time.perf_counter()
+                params, state, opt_state, rng, mets = self.core.indexed_window(
+                    params, state, opt_state, rng, data_x, data_y, idx
+                )
+                records_w = _metrics_to_records(mets)
+                self.timings.append((idx.size, time.perf_counter() - t0))
+                records.extend(records_w)
             if on_epoch_end is not None:
                 on_epoch_end(epoch, params, state, opt_state, rng)
         return params, state, records

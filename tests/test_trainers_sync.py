@@ -1,5 +1,6 @@
 """Sync trainers: convergence anchors + DP-vs-single parity (SURVEY §7.4)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -47,6 +48,101 @@ def test_single_trainer_converges():
     assert len(hist) == 3 * (len(train) // 64)
     assert hist[0]["loss"] > hist[-1]["loss"]
     assert t.get_training_time() > 0
+
+
+def test_device_resident_bitwise_matches_streamed():
+    """The HBM-resident index-gather path must reproduce the streamed host
+    path exactly: same permutation -> same batch contents -> bit-identical
+    parameters (WorkerCore.indexed_window contract)."""
+    train, _ = make_data(n=1100)  # non-divisible: remainder rows dropped
+    kwargs = dict(
+        learning_rate=0.05,
+        batch_size=64,
+        num_epoch=2,
+        window=3,  # 17 batches/epoch -> ragged tail window too
+        label_col="label_onehot",
+    )
+    streamed = SingleTrainer(
+        zoo.mnist_mlp(hidden=32, seed=3), "sgd", "categorical_crossentropy", **kwargs
+    ).train(train, shuffle=True)
+    resident = SingleTrainer(
+        zoo.mnist_mlp(hidden=32, seed=3),
+        "sgd",
+        "categorical_crossentropy",
+        device_resident=True,
+        **kwargs,
+    ).train(train, shuffle=True)
+    for a, b in zip(
+        jax.tree.leaves(streamed.params), jax.tree.leaves(resident.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_device_resident_converges_no_shuffle():
+    train, test = make_data(n=2048)
+    t = SingleTrainer(
+        zoo.mnist_mlp(hidden=64),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=64,
+        num_epoch=3,
+        device_resident=True,
+        label_col="label_onehot",
+    )
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.95
+    hist = t.get_history()
+    assert len(hist) == 3 * (len(train) // 64)
+
+
+def test_sync_dp_device_resident_matches_streamed():
+    """Resident sync-DP (replicated HBM dataset + "data"-sharded index
+    gather) must be bit-identical to the streamed sync-DP path."""
+    train, _ = make_data(n=1024)
+    kwargs = dict(
+        learning_rate=0.05,
+        batch_size=16,  # global batch 128 over 8 devices
+        num_epoch=2,
+        window=3,
+        num_workers=8,
+        label_col="label_onehot",
+    )
+    streamed = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=32, seed=5), "sgd", "categorical_crossentropy", **kwargs
+    ).train(train, shuffle=True)
+    resident = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=32, seed=5),
+        "sgd",
+        "categorical_crossentropy",
+        device_resident=True,
+        **kwargs,
+    ).train(train, shuffle=True)
+    for a, b in zip(
+        jax.tree.leaves(streamed.params), jax.tree.leaves(resident.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_device_resident_rejects_streaming_dataset(tmp_path):
+    from distkeras_tpu.data.streaming import ShardWriter, open_shards
+
+    w = ShardWriter(str(tmp_path))
+    ds = loaders.synthetic_mnist(n=128, seed=0)
+    w.add({"features": ds["features"], "label": ds["label"]})
+    w.close()
+    sds = open_shards(str(tmp_path))
+    t = SingleTrainer(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        "categorical_crossentropy",
+        batch_size=32,
+        num_epoch=1,
+        device_resident=True,
+        label_col="label",
+    )
+    with pytest.raises(TypeError, match="device_resident"):
+        t.train(sds)
 
 
 def test_single_trainer_adam_and_callable_loss():
