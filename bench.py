@@ -155,7 +155,7 @@ def main() -> None:
 
     from distkeras_tpu.utils.compile_cache import enable_compile_cache
 
-    enable_compile_cache()
+    enable_compile_cache(platform=platform)
 
     from distkeras_tpu.models.zoo import mnist_cnn
     from distkeras_tpu.ops.optimizers import get_optimizer

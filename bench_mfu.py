@@ -1,0 +1,145 @@
+"""MXU utilization benchmark: transformer-LM training step, bf16, resident data.
+
+The north-star MNIST CNN (bench.py) is host-history-faithful but tiny — its
+FLOPs can't fill a systolic array, so its MFU says nothing about the
+framework's ceiling. This harness measures the framework on an MXU-shaped
+workload: a transformer classifier (d_model 512, depth 8, seq 512) trained
+through the same ``WorkerCore.indexed_window`` device-resident path, bf16
+compute, window-scanned. FLOPs come from XLA's cost model on the exact
+compiled program; peak is the device generation's published bf16 number
+(bench.py's table).
+
+Writes BENCH_MFU.json and prints one JSON line:
+    {"metric": "transformer_train_mfu", "value": ..., "unit": "fraction",
+     "samples_per_sec": ..., "tflops_per_sec": ..., "platform": ...}
+
+Usage: python bench_mfu.py [--cpu]  (CPU fallback scales shapes down and
+reports model_flops_per_sec with mfu=null — no published CPU peak.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from bench import _flops_per_call, _peak_flops, resolve_backend
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(1)
+        platform = "cpu"
+    else:
+        resolved = resolve_backend()
+        if resolved is None:
+            raise SystemExit("no JAX backend could be initialized")
+        platform, config_pin = resolved
+        import jax
+
+        if config_pin is not None:
+            jax.config.update("jax_platforms", config_pin)
+
+    import jax
+
+    from distkeras_tpu.models.zoo import transformer_classifier
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.utils.compile_cache import enable_compile_cache
+    from distkeras_tpu.workers import WorkerCore
+
+    enable_compile_cache(platform=platform)
+    on_cpu = platform == "cpu"
+
+    seq, d_model, depth, heads = (64, 128, 2, 4) if on_cpu else (512, 512, 8, 8)
+    batch = 8 if on_cpu else 64
+    window = 2 if on_cpu else 8
+    vocab, n_classes = 8192, 16
+    warmup, timed = (1, 2) if on_cpu else (2, 6)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+
+    model = transformer_classifier(
+        vocab_size=vocab,
+        seq_len=seq,
+        d_model=d_model,
+        num_heads=heads,
+        depth=depth,
+        num_classes=n_classes,
+        seed=0,
+    )
+    core = WorkerCore(
+        model,
+        get_optimizer("adam", 1e-3),
+        "categorical_crossentropy",
+        compute_dtype="bfloat16",
+    )
+
+    n_data = batch * 8
+    rng = np.random.default_rng(0)
+    data_x = jax.device_put(rng.integers(0, vocab, (n_data, seq)).astype(np.int32))
+    data_y = jax.device_put(
+        np.eye(n_classes, dtype=np.float32)[rng.integers(0, n_classes, n_data)]
+    )
+
+    def fresh_idx():
+        return rng.integers(0, n_data, (window, batch)).astype(np.int32)
+
+    params = model.params
+    state = model.state
+    opt_state = core.init_opt_state(params)
+    key = jax.random.PRNGKey(0)
+
+    flops_per_window = _flops_per_call(
+        core.indexed_window.lower(
+            params, state, opt_state, key, data_x, data_y, fresh_idx()
+        ).compile()
+    )
+
+    for _ in range(warmup):
+        params, state, opt_state, key, _m = core.indexed_window(
+            params, state, opt_state, key, data_x, data_y, fresh_idx()
+        )
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        params, state, opt_state, key, _m = core.indexed_window(
+            params, state, opt_state, key, data_x, data_y, fresh_idx()
+        )
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    sps = timed * window * batch / dt
+    record = {
+        "metric": "transformer_train_mfu",
+        "value": None,
+        "unit": "fraction",
+        "platform": platform,
+        "device_kind": dev.device_kind,
+        "model": f"transformer d{d_model} L{depth} seq{seq} bf16",
+        "batch": batch,
+        "samples_per_sec": round(sps, 1),
+        "tflops_per_sec": None,
+    }
+    if flops_per_window is not None:
+        fps = flops_per_window * timed / dt
+        record["tflops_per_sec"] = round(fps / 1e12, 2)
+        peak = _peak_flops(dev)
+        if peak is not None:
+            record["value"] = round(fps / peak, 4)
+    with open("BENCH_MFU.json", "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -205,6 +205,21 @@ class Trainer:
         every = self.checkpoint_every
         return (every > 0 and done % every == 0) or done == self.num_epoch
 
+    def _reconcile_opt_state(self, candidate, core, params):
+        """Restored optimizer moments, or None when the checkpoint was
+        written in another layout (a pipeline trainer's '__blocks__'-stacked
+        moments, a different optax chain) — THE cross-trainer resume policy,
+        shared by every trainer that reads the common checkpoint format.
+        Structure comes from ``eval_shape`` (no moment allocation)."""
+        reference = jax.eval_shape(core.init_opt_state, params)
+        if jax.tree.structure(candidate) == jax.tree.structure(reference):
+            return candidate
+        logger.warning(
+            "checkpoint opt_state layout does not match this trainer; "
+            "reinitializing optimizer state"
+        )
+        return None
+
     def _save_epoch_checkpoint(self, done, params, state, opt_state, rng):
         """Epoch-granular snapshots shared by SingleTrainer and the sync-DP
         trainer (policy: ``_should_checkpoint``)."""
@@ -292,10 +307,15 @@ class SingleTrainer(Trainer):
             restored = self._restore_latest()
             if restored is not None:
                 _, trees, meta = restored
+                opt_state = self._reconcile_opt_state(
+                    trees["opt_state"], core, trees["params"]
+                )
+                if opt_state is None:  # foreign layout: moments restart
+                    opt_state = core.init_opt_state(trees["params"])
                 initial_full = (
                     trees["params"],
                     trees["state"],
-                    trees["opt_state"],
+                    opt_state,
                     trees["rng"],
                 )
                 start_epoch = int(meta["epoch"])
@@ -405,7 +425,16 @@ class SynchronousDistributedTrainer(Trainer):
     def _place_opt_state(self, core, params, restored=None):
         """Optimizer-state placement matching the params placement. Under
         TP, init runs under jit so GSPMD propagates the params' shardings
-        into momentum buffers; a restored state adopts those shardings."""
+        into momentum buffers; a restored state adopts those shardings.
+
+        A restored state written in another layout (a pipeline trainer's
+        '__blocks__'-stacked moments, or a different optax chain) is
+        detected by tree structure and reinitialized instead of crashing
+        the first window — params/state still restore, only the moments
+        restart (mirrors PipelineParallelTrainer's guard for the reverse
+        direction)."""
+        if restored is not None:
+            restored = self._reconcile_opt_state(restored, core, params)
         if self.model_parallel:
             opt_state = jax.jit(core.init_opt_state)(params)
             if restored is not None:
@@ -599,7 +628,13 @@ class SequenceParallelTrainer(Trainer):
             _, trees, meta = restored
             params = replicate(trees["params"], self.mesh)
             state = replicate(trees["state"], self.mesh)
-            opt_state = replicate(trees["opt_state"], self.mesh)
+            moments = self._reconcile_opt_state(
+                trees["opt_state"], core, trees["params"]
+            )
+            opt_state = replicate(
+                moments if moments is not None else core.init_opt_state(params),
+                self.mesh,
+            )
             rng = jax.device_put(trees["rng"])
             start_epoch = int(meta["epoch"])
         else:
@@ -844,12 +879,12 @@ class PipelineParallelTrainer(Trainer):
         if restored is not None:
             start_epoch = int(restored[2]["epoch"])
 
+        from distkeras_tpu.parallel.pipeline_parallel import shard_stacked_params
+
         repl = NamedSharding(self.mesh, P())
-        pipe_sh = NamedSharding(self.mesh, P("pipe"))
         params = {
-            "__blocks__": jax.tree.map(
-                lambda a: jax.device_put(a, pipe_sh),
-                self._stack(source_params, block_idx),
+            "__blocks__": shard_stacked_params(
+                self._stack(source_params, block_idx), self.mesh
             ),
             **{
                 str(i): jax.device_put(source_params[str(i)], repl)
@@ -874,22 +909,17 @@ class PipelineParallelTrainer(Trainer):
         # the optimizer moments
         opt_state = jax.jit(core.init_opt_state)(params)
         if restored is not None and "opt_state" in restored[1]:
-            candidate = restored[1]["opt_state"]
-            if jax.tree.structure(candidate) == jax.tree.structure(opt_state):
+            candidate = self._reconcile_opt_state(
+                restored[1]["opt_state"], core, params
+            )
+            if candidate is not None:
                 # same pipeline geometry: adopt the restored moments. The
                 # host leaves stay UNCOMMITTED (no device_put) — the
                 # compiled window lays them out to match the params'
                 # shardings; a fixed placement would conflict with the
-                # mesh-committed params.
+                # mesh-committed params. A foreign layout (per-layer
+                # checkpoint from another trainer) keeps the fresh init.
                 opt_state = candidate
-            else:
-                # checkpoint written by a different trainer/geometry
-                # (per-layer layout): params/state still restore — only the
-                # optimizer moments restart
-                logger.warning(
-                    "checkpoint opt_state layout does not match this "
-                    "pipeline geometry; reinitializing optimizer state"
-                )
         rng = (
             jax.device_put(restored[1]["rng"])
             if restored is not None

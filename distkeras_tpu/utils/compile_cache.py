@@ -26,10 +26,22 @@ def _default_dir() -> str:
     return os.path.join(tempfile.gettempdir(), f"dkt_jax_cache_{uid}")
 
 
-def enable_compile_cache(path: str | None = None) -> str:
+def enable_compile_cache(path: str | None = None, platform: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at ``path`` (created if
-    missing). Returns the cache directory. Safe to call repeatedly."""
+    missing). Returns the cache directory, or None when skipped. Safe to
+    call repeatedly.
+
+    ``platform``: the resolved backend name, or None to ask JAX (which
+    initializes the backend). The cache is skipped for "cpu": XLA:CPU AOT
+    entries embed compile-machine feature lists that warn (and can SIGILL)
+    on reload, and CPU compiles of these programs are seconds, not the
+    20-40s a TPU compile costs."""
     import jax
+
+    if platform is None:
+        platform = jax.default_backend()
+    if platform == "cpu":
+        return None
 
     path = path or os.environ.get("DKT_COMPILE_CACHE") or _default_dir()
     os.makedirs(path, exist_ok=True)

@@ -8,10 +8,8 @@ explicitly.
 """
 
 from distkeras_tpu.parallel.mesh import force_cpu_mesh
-from distkeras_tpu.utils.compile_cache import enable_compile_cache
 
 force_cpu_mesh(8)
-enable_compile_cache()
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

@@ -276,6 +276,32 @@ def test_pipeline_trainer_checkpoint_resume(tmp_path):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
 
 
+def test_single_trainer_resumes_pipeline_checkpoint(tmp_path):
+    """Cross-trainer interop: pipeline checkpoints store params/state in
+    the NORMAL layout but opt_state in the pipeline-stacked layout; other
+    trainers must detect the mismatch and reinitialize the moments instead
+    of crashing inside jit."""
+    from distkeras_tpu import PipelineParallelTrainer, SingleTrainer
+
+    train, test = _pp_data()
+    kw = dict(
+        loss="categorical_crossentropy",
+        batch_size=32,
+        label_col="label_onehot",
+        seed=0,
+    )
+    PipelineParallelTrainer(
+        _pp_model(), "adam", num_epoch=1, num_workers=4,
+        checkpoint_dir=str(tmp_path), **kw
+    ).train(train)
+    resumed = SingleTrainer(
+        _pp_model(), "adam", num_epoch=2, checkpoint_dir=str(tmp_path), **kw
+    ).train(train, resume=True)  # params restore; moments reinit with warning
+    assert sorted(resumed.params.keys()) == sorted(
+        str(i) for i in range(len(resumed.layers))
+    )
+
+
 def test_pipeline_trainer_requires_block_tower():
     from distkeras_tpu import PipelineParallelTrainer
     from distkeras_tpu.models import zoo
