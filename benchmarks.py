@@ -319,6 +319,45 @@ def main():
 
 
 def write_outputs(rows, platform, device_kind, scale, out):
+    # merge with rows already on disk (same platform+scale): a partial rerun
+    # (--configs 2) refreshes its rows without clobbering the others
+    path = os.path.join(out, "BENCHMARKS.json")
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prior = json.load(f)
+            if (
+                prior.get("platform") == platform
+                and prior.get("device_kind") == device_kind
+                and prior.get("scale") == scale
+            ):
+                prior_good = {
+                    r["config"]: r
+                    for r in prior["results"]
+                    if "error" not in r
+                }
+                # keep a prior good row over a fresh error row (a flaky
+                # rerun must not evict a valid measurement), otherwise the
+                # fresh row wins
+                fresh = {
+                    r["config"]: (
+                        prior_good[r["config"]]
+                        if "error" in r and r["config"] in prior_good
+                        else r
+                    )
+                    for r in rows
+                }
+                rows = sorted(
+                    list(fresh.values())
+                    + [
+                        r
+                        for r in prior["results"]
+                        if r["config"] not in fresh
+                    ],
+                    key=lambda r: r["config"],
+                )
+        except (json.JSONDecodeError, KeyError, TypeError, AttributeError):
+            pass  # unreadable prior file: overwrite it
     payload = {
         "platform": platform,
         "device_kind": device_kind,

@@ -17,6 +17,8 @@ from distkeras_tpu.parallel.mesh import (
 )
 from distkeras_tpu.parallel.ring_attention import (
     ring_attention,
+    blockwise_attention,
+    attach_blockwise_attention,
     attach_ring_attention,
     detach_ring_attention,
 )

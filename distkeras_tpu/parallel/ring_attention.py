@@ -59,13 +59,29 @@ def _block_attention(q, k, v, acc, m, l, q_off, k_off, scale, causal):
     return acc_new, m_new, l_new
 
 
+def _init_carry(b, tq, h, d):
+    """Fresh online-softmax carry: zero accumulator, -inf running max,
+    zero normalizer. Shared by the ring body and blockwise_attention."""
+    return (
+        jnp.zeros((b, tq, h, d), jnp.float32),
+        jnp.full((b, h, tq), -jnp.inf, jnp.float32),
+        jnp.zeros((b, h, tq), jnp.float32),
+    )
+
+
+def _finalize(acc, l, out_dtype):
+    """Normalize the accumulator. Rows with no visible keys (can't happen
+    for causal self-attn since a position always sees itself) keep the
+    division safe."""
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l[..., None].transpose(0, 2, 1, 3)).astype(out_dtype)
+
+
 def _ring_attention_local(q, k, v, axis_name, axis_size, scale, causal):
     """Per-device body (runs under shard_map): rotate K/V around the ring."""
     my_idx = jax.lax.axis_index(axis_name)
     b, tq, h, d = q.shape
-    acc = jnp.zeros((b, tq, h, d), jnp.float32)
-    m = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, tq), jnp.float32)
+    acc, m, l = _init_carry(b, tq, h, d)
     q_off = my_idx * tq
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -77,10 +93,7 @@ def _ring_attention_local(q, k, v, axis_name, axis_size, scale, causal):
         if step + 1 < axis_size:
             k, v = jax.lax.ppermute((k, v), axis_name, perm)
 
-    # rows with no visible keys (can't happen for causal self-attn since a
-    # position always sees itself, but keep the division safe)
-    l = jnp.where(l == 0.0, 1.0, l)
-    return (acc / l[..., None].transpose(0, 2, 1, 3)).astype(q.dtype)
+    return _finalize(acc, l, q.dtype)
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False):
@@ -112,6 +125,62 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False):
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return fn(q, k, v)
+
+
+def blockwise_attention(q, k, v, causal=False, block_size=512):
+    """Single-device memory-efficient attention: ``lax.scan`` over K/V
+    blocks with the same online softmax the ring uses (`_block_attention`),
+    so the full (Tq, Tk) score matrix never materializes — peak score
+    memory is (Tq, block_size). The single-chip face of the long-context
+    design: past one chip, shard the sequence and use :func:`ring_attention`
+    (same accumulation math, blocks arriving over ICI instead of a scan).
+
+    q, k, v: (batch, seq, heads, head_dim); seq divisible by ``block_size``
+    (pass a smaller block for short sequences, e.g. tests). Matches
+    :func:`dense_attention` numerically.
+    """
+    b, t, h, d = q.shape
+    if t <= block_size:  # one (possibly partial) block IS the dense case
+        return dense_attention(q, k, v, causal=causal)
+    if t % block_size:
+        raise ValueError(
+            f"seq length {t} not divisible by block_size {block_size}"
+        )
+    nb = t // block_size
+    scale = 1.0 / (d**0.5)
+    # (nb, B, block, H, D) so scan slices one K/V block per step
+    kb = jnp.moveaxis(k.reshape(b, nb, block_size, h, d), 1, 0)
+    vb = jnp.moveaxis(v.reshape(b, nb, block_size, h, d), 1, 0)
+    offs = jnp.arange(nb, dtype=jnp.int32) * block_size
+
+    def step(carry, xs):
+        acc, m, l = carry
+        k_blk, v_blk, k_off = xs
+        acc, m, l = _block_attention(
+            q, k_blk, v_blk, acc, m, l, 0, k_off, scale, causal
+        )
+        return (acc, m, l), None
+
+    (acc, m, l), _ = jax.lax.scan(step, _init_carry(b, t, h, d), (kb, vb, offs))
+    return _finalize(acc, l, q.dtype)
+
+
+def attach_blockwise_attention(model, block_size=512) -> int:
+    """Point every MultiHeadSelfAttention at :func:`blockwise_attention`
+    (single-device long-context mode). Returns how many were attached.
+    Unlike the ring hook this closes over no mesh, but it is still a
+    process-local hook and is not serialized."""
+    from distkeras_tpu.models.layers import MultiHeadSelfAttention
+    from distkeras_tpu.models.sequential import walk_layers
+
+    n = 0
+    for layer in walk_layers(model):
+        if isinstance(layer, MultiHeadSelfAttention):
+            layer.attention_fn = functools.partial(
+                blockwise_attention, block_size=block_size
+            )
+            n += 1
+    return n
 
 
 def attach_ring_attention(model, mesh: Mesh, axis_name: str = "seq") -> int:
