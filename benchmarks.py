@@ -246,7 +246,10 @@ def build_configs(platform):
             "trainer_name": "ADAG",
             "model_name": "cifar10_cnn",
             "data": cifar_data,
-            "model": lambda scale: zoo.cifar10_cnn(seed=0),
+            # bn_momentum 0.9: smoke epochs are ~57 steps; the 0.99 default
+            # leaves eval-mode BN stats stale for hundreds of steps, so
+            # held-out accuracy lags training by epochs (r2 calibration)
+            "model": lambda scale: zoo.cifar10_cnn(seed=0, bn_momentum=0.9),
             # sgd lr 0.05: the ADAG convergence calibration from
             # tests/test_trainers_async.py (async + adam is fragile — the
             # adaptive step does not shrink near the optimum)
@@ -265,7 +268,8 @@ def build_configs(platform):
             "model_name": "resnet18",
             "data": imagenet_data,
             "model": lambda scale: zoo.resnet18(
-                num_classes=100, input_shape=(64, 64, 3), seed=0
+                num_classes=100, input_shape=(64, 64, 3), seed=0,
+                bn_momentum=0.9,
             ),
             # sgd lr 0.02: the DynSGD convergence calibration from
             # tests/test_trainers_async.py

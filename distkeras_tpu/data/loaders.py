@@ -84,14 +84,63 @@ def synthetic_higgs(n=8192, num_features=30, noise=1.5, seed=1) -> Dataset:
     return Dataset({"features": x, "label": label})
 
 
-def synthetic_cifar10(n=4096, noise=1.0, seed=2) -> Dataset:
-    """CIFAR-shaped: features (32, 32, 3) in [0,255], labels 0..9."""
-    return _prototype_classification(n, 10, (32, 32, 3), noise, seed)
+def _coarse_grid(h, w, coarse):
+    """Largest pattern-grid size <= ``coarse`` dividing both h and w (>=1),
+    so any image size upsamples cleanly."""
+    g = coarse
+    while g > 1 and (h % g or w % g):
+        g -= 1
+    return g
 
 
-def synthetic_imagenet(n=512, num_classes=1000, size=64, noise=0.5, seed=3) -> Dataset:
-    """ImageNet-shaped smoke data (reduced spatial size by default)."""
-    return _prototype_classification(n, num_classes, (size, size, 3), noise, seed)
+def _spatial_prototype_classification(
+    n, num_classes, feature_shape, noise, seed, coarse=4, proto_seed=None
+):
+    """Image-shaped prototype task with SPATIAL structure: each class is a
+    random ``coarse x coarse`` pattern upsampled to the full resolution, so
+    class evidence lives in low spatial frequencies — the statistics conv
+    + pooling stacks are built to exploit. (The iid-pixel prototypes of
+    `_prototype_classification` are adversarial to conv weight sharing: an
+    MLP aces them while a VGG/ResNet sits at chance for epochs — r2
+    calibration.) Separable but noisy, like its flat counterpart.
+
+    ``proto_seed``: seed of the label->pattern mapping, defaulting to
+    ``seed``. Callers generating one logical dataset in several chunks
+    (shard writers, separate train/eval draws) MUST pin proto_seed across
+    chunks while varying ``seed`` — otherwise every chunk defines class k
+    as a different pattern and the combined task is unlearnable."""
+    proto_rng = np.random.default_rng(seed if proto_seed is None else proto_seed)
+    rng = np.random.default_rng(seed)
+    h, w, c = feature_shape
+    g = _coarse_grid(h, w, coarse)
+    protos = proto_rng.normal(0.0, 1.0, (num_classes, g, g, c)).astype(np.float32)
+    protos = np.repeat(np.repeat(protos, h // g, axis=1), w // g, axis=2)
+    labels = rng.integers(0, num_classes, n)
+    x = protos[labels] + rng.normal(0.0, noise, (n, h, w, c)).astype(np.float32)
+    x = (255.0 / (1.0 + np.exp(-x))).astype(np.float32)
+    return Dataset({"features": x, "label": labels.astype(np.int64)})
+
+
+def synthetic_cifar10(n=4096, noise=1.0, seed=2, proto_seed=None) -> Dataset:
+    """CIFAR-shaped: features (32, 32, 3) in [0,255], labels 0..9.
+    Class signal is low-spatial-frequency (see
+    `_spatial_prototype_classification`; pin ``proto_seed`` when drawing
+    one logical dataset with several seeds)."""
+    return _spatial_prototype_classification(
+        n, 10, (32, 32, 3), noise, seed, proto_seed=proto_seed
+    )
+
+
+def synthetic_imagenet(
+    n=512, num_classes=1000, size=64, noise=0.5, seed=3, proto_seed=None
+) -> Dataset:
+    """ImageNet-shaped smoke data (reduced spatial size by default).
+    Class signal is low-spatial-frequency (see
+    `_spatial_prototype_classification`; pin ``proto_seed`` when drawing
+    one logical dataset with several seeds)."""
+    return _spatial_prototype_classification(
+        n, num_classes, (size, size, 3), noise, seed, proto_seed=proto_seed
+    )
 
 
 def synthetic_sequences(

@@ -68,22 +68,27 @@ def higgs_mlp(num_features=30, hidden=600, num_classes=2, seed=0):
     ).build((num_features,), seed=seed)
 
 
-def cifar10_cnn(num_classes=10, seed=0):
-    """VGG-ish convnet over (32, 32, 3)."""
+def cifar10_cnn(num_classes=10, seed=0, bn_momentum=0.99):
+    """VGG-ish convnet over (32, 32, 3).
+
+    ``bn_momentum``: BatchNorm moving-stats momentum. The 0.99 default needs
+    hundreds of steps before eval-mode stats track the batch stats; short
+    runs (benchmark smoke epochs) should pass ~0.9."""
+    bn = lambda: BatchNorm(momentum=bn_momentum)
     return Sequential(
         [
             Conv2D(64, 3, padding="SAME", use_bias=False),
-            BatchNorm(),
+            bn(),
             Activation("relu"),
             Conv2D(64, 3, padding="SAME", use_bias=False),
-            BatchNorm(),
+            bn(),
             Activation("relu"),
             MaxPool2D(2),
             Conv2D(128, 3, padding="SAME", use_bias=False),
-            BatchNorm(),
+            bn(),
             Activation("relu"),
             Conv2D(128, 3, padding="SAME", use_bias=False),
-            BatchNorm(),
+            bn(),
             Activation("relu"),
             MaxPool2D(2),
             Flatten(),
@@ -166,47 +171,54 @@ def moe_transformer_classifier(
     return model
 
 
-def _basic_block(filters, stride=1, downsample=False):
+def _basic_block(filters, stride=1, downsample=False, bn_momentum=0.99):
+    bn = lambda: BatchNorm(momentum=bn_momentum)
     shortcut = (
-        [Conv2D(filters, 1, strides=stride, padding="SAME", use_bias=False), BatchNorm()]
+        [Conv2D(filters, 1, strides=stride, padding="SAME", use_bias=False), bn()]
         if downsample
         else None
     )
     return Residual(
         [
             Conv2D(filters, 3, strides=stride, padding="SAME", use_bias=False),
-            BatchNorm(),
+            bn(),
             Activation("relu"),
             Conv2D(filters, 3, padding="SAME", use_bias=False),
-            BatchNorm(),
+            bn(),
         ],
         shortcut=shortcut,
         activation="relu",
     )
 
 
-def resnet18(num_classes=1000, input_shape=(224, 224, 3), small_stem=False, seed=0):
+def resnet18(
+    num_classes=1000, input_shape=(224, 224, 3), small_stem=False, seed=0,
+    bn_momentum=0.99,
+):
     """ResNet-18 (NHWC). ``small_stem=True`` swaps the 7x7/s2+maxpool stem for
-    a 3x3/s1 stem, the standard CIFAR-scale variant used in smoke tests."""
+    a 3x3/s1 stem, the standard CIFAR-scale variant used in smoke tests.
+    ``bn_momentum``: see :func:`cifar10_cnn`."""
+    bn = lambda: BatchNorm(momentum=bn_momentum)
     stem = (
-        [Conv2D(64, 3, strides=1, padding="SAME", use_bias=False), BatchNorm(), Activation("relu")]
+        [Conv2D(64, 3, strides=1, padding="SAME", use_bias=False), bn(), Activation("relu")]
         if small_stem
         else [
             Conv2D(64, 7, strides=2, padding="SAME", use_bias=False),
-            BatchNorm(),
+            bn(),
             Activation("relu"),
             MaxPool2D(3, strides=2, padding="SAME"),
         ]
     )
+    blk = lambda *a, **kw: _basic_block(*a, bn_momentum=bn_momentum, **kw)
     body = [
-        _basic_block(64),
-        _basic_block(64),
-        _basic_block(128, stride=2, downsample=True),
-        _basic_block(128),
-        _basic_block(256, stride=2, downsample=True),
-        _basic_block(256),
-        _basic_block(512, stride=2, downsample=True),
-        _basic_block(512),
+        blk(64),
+        blk(64),
+        blk(128, stride=2, downsample=True),
+        blk(128),
+        blk(256, stride=2, downsample=True),
+        blk(256),
+        blk(512, stride=2, downsample=True),
+        blk(512),
     ]
     head = [GlobalAvgPool2D(), Dense(num_classes, activation="softmax")]
     return Sequential(stem + body + head).build(input_shape, seed=seed)

@@ -44,8 +44,11 @@ def write_synthetic_shards(out_dir, n, num_classes, size, rows_per_shard, seed=7
         chunk_i = 0
         while written < n:
             rows = min(rows_per_shard, n - written)
+            # proto_seed pinned: every chunk (and the eval split) must
+            # agree on the label->pattern mapping or the task is unlearnable
             chunk = synthetic_imagenet(
-                n=rows, num_classes=num_classes, size=size, seed=seed + chunk_i
+                n=rows, num_classes=num_classes, size=size,
+                seed=seed + chunk_i, proto_seed=seed,
             )
             # uint8 on disk (as real image shards would be): 4x smaller files
             writer.add(
@@ -101,7 +104,7 @@ def main():
 
     test_raw = synthetic_imagenet(
         n=max(args.n // 10, args.batch), num_classes=args.classes,
-        size=args.size, seed=99,
+        size=args.size, seed=99, proto_seed=7,
     )
     test = Dataset(
         {

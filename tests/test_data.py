@@ -120,6 +120,30 @@ def test_synthetic_loaders_deterministic():
     assert c["features"].shape == (8, 32, 32, 3)
 
 
+def test_spatial_prototypes_pin_across_seeds():
+    """proto_seed fixes the label->pattern mapping while seed varies the
+    samples — the contract chunked shard writers rely on (one logical task
+    across many chunk seeds)."""
+    a = loaders.synthetic_cifar10(n=256, seed=1, proto_seed=42)
+    b = loaders.synthetic_cifar10(n=256, seed=2, proto_seed=42)
+    # different samples...
+    assert not np.array_equal(a["features"], b["features"])
+    # ...but the same class patterns: per-class means correlate strongly
+    for cls in range(3):
+        ma = a["features"][a["label"] == cls].mean(axis=0).ravel()
+        mb = b["features"][b["label"] == cls].mean(axis=0).ravel()
+        r = np.corrcoef(ma, mb)[0, 1]
+        assert r > 0.5, f"class {cls} pattern correlation {r}"
+
+
+def test_spatial_prototypes_any_size():
+    # sizes not divisible by the default 4x4 grid fall back to a coarser
+    # divisor instead of crashing
+    for size in (50, 3, 7):
+        ds = loaders.synthetic_imagenet(n=4, num_classes=3, size=size, seed=0)
+        assert ds["features"].shape == (4, size, size, 3)
+
+
 def test_load_csv(tmp_path):
     p = tmp_path / "d.csv"
     p.write_text("label,p0,p1\n1,0.5,0.25\n0,1.0,0.0\n")

@@ -1,0 +1,60 @@
+#!/bin/sh
+# One-shot TPU measurement sweep — run when the axon tunnel is healthy.
+# Captures, in order of value-per-second (the tunnel can die mid-sweep):
+#   1. bench.py           — north-star MNIST CNN via the device-resident path
+#   2. bench_mfu.py       — transformer MXU utilization (writes BENCH_MFU.json)
+#   3. prefetch A/B       — host-staged input path (stack+device_put),
+#                           prefetch=0 vs prefetch=2
+# Each step is independently timeout-boxed; results append to TPU_CAPTURE.log.
+set -x
+cd "$(dirname "$0")/.."
+LOG=TPU_CAPTURE.log
+date >> "$LOG"
+
+timeout 600 python bench.py 2>>"$LOG.err" | tail -1 >> "$LOG"
+
+timeout 900 python bench_mfu.py 2>>"$LOG.err" | tail -1 >> "$LOG"
+
+timeout 900 python - >> "$LOG" 2>>"$LOG.err" <<'EOF'
+# prefetch A/B on the host-staged input path (in-memory Dataset, per-window
+# stack + device_put): the overlap win shows when the host link is the
+# bottleneck. This measures input staging, NOT the npz shard pipeline.
+import json, time
+import numpy as np
+from bench import resolve_backend
+
+resolved = resolve_backend()
+if resolved is None or resolved[0] == "cpu":
+    print(json.dumps({"metric": "prefetch_ab", "error": "no TPU"}))
+    raise SystemExit(0)
+import jax
+from distkeras_tpu import SingleTrainer, MinMaxTransformer, OneHotTransformer
+from distkeras_tpu.data import loaders
+from distkeras_tpu.models import zoo
+
+ds = loaders.synthetic_mnist(n=32768, seed=0, flat=False)
+ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+
+def run(prefetch):
+    t = SingleTrainer(
+        zoo.mnist_cnn(seed=0), "sgd", "categorical_crossentropy",
+        learning_rate=0.01, batch_size=1024, num_epoch=1, window=8,
+        prefetch=prefetch, compute_dtype="bfloat16",
+        label_col="label_onehot",
+    )
+    t0 = time.perf_counter()
+    t.train(ds)
+    return len(ds) / (time.perf_counter() - t0)
+
+run(0)  # warm the compile cache so both timed runs are compile-free
+a = run(0)
+b = run(2)
+print(json.dumps({
+    "metric": "prefetch_overlap_win", "prefetch0_sps": round(a, 1),
+    "prefetch2_sps": round(b, 1), "speedup": round(b / a, 3),
+    "platform": jax.devices()[0].platform,
+}))
+EOF
+
+tail -4 "$LOG"
