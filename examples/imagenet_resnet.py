@@ -34,8 +34,15 @@ from distkeras_tpu.data.loaders import synthetic_imagenet
 from distkeras_tpu.data.streaming import ShardWriter, open_shards
 from distkeras_tpu.models.zoo import resnet18
 
+# one label->pattern mapping for every draw of the synthetic task: shards
+# and the eval split must agree or the task is unlearnable (see
+# loaders._spatial_prototype_classification)
+PROTO_SEED = 7
 
-def write_synthetic_shards(out_dir, n, num_classes, size, rows_per_shard, seed=7):
+
+def write_synthetic_shards(
+    out_dir, n, num_classes, size, rows_per_shard, seed=PROTO_SEED
+):
     """Generate shard files chunk by chunk — peak host memory is one chunk,
     so the on-disk dataset can exceed RAM. All shards land in ONE directory
     with one sidecar, so ``open_shards(out_dir)`` round-trips."""
@@ -48,7 +55,7 @@ def write_synthetic_shards(out_dir, n, num_classes, size, rows_per_shard, seed=7
             # agree on the label->pattern mapping or the task is unlearnable
             chunk = synthetic_imagenet(
                 n=rows, num_classes=num_classes, size=size,
-                seed=seed + chunk_i, proto_seed=seed,
+                seed=seed + chunk_i, proto_seed=PROTO_SEED,
             )
             # uint8 on disk (as real image shards would be): 4x smaller files
             writer.add(
@@ -104,7 +111,7 @@ def main():
 
     test_raw = synthetic_imagenet(
         n=max(args.n // 10, args.batch), num_classes=args.classes,
-        size=args.size, seed=99, proto_seed=7,
+        size=args.size, seed=99, proto_seed=PROTO_SEED,
     )
     test = Dataset(
         {

@@ -28,6 +28,11 @@ if resolved is None or resolved[0] == "cpu":
     print(json.dumps({"metric": "prefetch_ab", "error": "no TPU"}))
     raise SystemExit(0)
 import jax
+from distkeras_tpu.utils.compile_cache import enable_compile_cache
+
+# each run() builds a fresh trainer (fresh jit closures): the persistent
+# cache is what lets the warm-up run actually warm the timed runs
+enable_compile_cache(platform=resolved[0])
 from distkeras_tpu import SingleTrainer, MinMaxTransformer, OneHotTransformer
 from distkeras_tpu.data import loaders
 from distkeras_tpu.models import zoo
@@ -47,7 +52,7 @@ def run(prefetch):
     t.train(ds)
     return len(ds) / (time.perf_counter() - t0)
 
-run(0)  # warm the compile cache so both timed runs are compile-free
+run(0)  # populates the persistent compile cache for the timed runs
 a = run(0)
 b = run(2)
 print(json.dumps({
