@@ -2,4 +2,4 @@
 
 from distkeras_tpu.ops.losses import get_loss, categorical_crossentropy, mse
 from distkeras_tpu.ops.metrics import accuracy
-from distkeras_tpu.ops.optimizers import get_optimizer
+from distkeras_tpu.ops.optimizers import get_optimizer, get_schedule

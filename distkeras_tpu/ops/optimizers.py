@@ -43,16 +43,49 @@ _DEFAULT_LR = {"sgd": 0.01, "pallas_sgd": 0.01, "adam": 1e-3, "adamw": 1e-3,
                "nadam": 1e-3, "lamb": 1e-3}
 
 
+_SCHEDULES = {
+    "constant": optax.constant_schedule,
+    "exponential_decay": optax.exponential_decay,
+    "cosine_decay": optax.cosine_decay_schedule,
+    "linear": optax.linear_schedule,
+    "warmup_cosine": optax.warmup_cosine_decay_schedule,
+}
+
+
+def get_schedule(name, **kwargs):
+    """A named optax learning-rate schedule (pass the result as a trainer's
+    ``learning_rate``; optax optimizers accept schedules wherever they
+    accept a float). No reference counterpart (the reference's Keras-era
+    optimizers carry a fixed lr); schedules are standard TPU-era practice
+    (warmup tames bf16 early training).
+
+        get_schedule("warmup_cosine", init_value=0.0, peak_value=1e-3,
+                     warmup_steps=100, decay_steps=2000)
+    """
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _SCHEDULES:
+        raise ValueError(
+            f"unknown schedule {name!r}; available: {sorted(_SCHEDULES)}"
+        )
+    return _SCHEDULES[key](**kwargs)
+
+
 def effective_learning_rate(name, learning_rate=None) -> float:
     """The lr the resolved optimizer will actually run with.
 
     Algorithms whose PS/elastic rules scale by the learning rate (AEASGD's
     alpha = rho*lr, ADAG's commit -lr/W) must use the same value the local
-    optimizer steps with. For callables/ready-made transforms the lr cannot
-    be introspected; fall back to 0.01 (callers should pass learning_rate
-    explicitly in that case).
+    optimizer steps with. A schedule contributes its step-0 value (the
+    elastic/commit scaling stays constant over training — document in the
+    trainer if you need otherwise). For callables/ready-made transforms the
+    lr cannot be introspected; fall back to 0.01 (callers should pass
+    learning_rate explicitly in that case).
     """
     if learning_rate is not None:
+        if callable(learning_rate):  # optax schedule
+            return float(learning_rate(0))
         return float(learning_rate)
     if isinstance(name, str) and name.lower() in _DEFAULT_LR:
         return _DEFAULT_LR[name.lower()]

@@ -135,6 +135,12 @@ class FusedSGD:
     """
 
     def __init__(self, learning_rate=0.01, momentum=0.0, nesterov=False):
+        if callable(learning_rate):
+            raise TypeError(
+                "pallas_sgd bakes the learning rate into the kernel and "
+                "does not accept schedules; use optimizer 'sgd' with a "
+                "schedule instead"
+            )
         self.learning_rate = float(learning_rate)
         self.momentum = float(momentum)
         self.nesterov = bool(nesterov)

@@ -1449,6 +1449,21 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     ``communication_window`` commit cadence lives on DistributedTrainer."""
 
 
+def _reject_schedule_lr(kwargs, trainer_name):
+    """Algorithms whose update rules consume the lr as a SCALAR (AEASGD's
+    elastic force rho*lr, EAMSGD likewise, ADAG's -lr/W commit) cannot run
+    a schedule — `effective_learning_rate` would freeze it at step 0, which
+    for a warmup schedule is 0.0 and silently trains nothing. Fail loudly
+    instead; schedules work with the other trainers."""
+    if callable(kwargs.get("learning_rate")):
+        raise TypeError(
+            f"{trainer_name} consumes the learning rate as a scalar in its "
+            "update rule and does not accept schedules; pass a float (or "
+            "use SingleTrainer / the sync trainer / DOWNPOUR / DynSGD, "
+            "which run schedules inside the local optimizer)"
+        )
+
+
 class DOWNPOUR(AsynchronousDistributedTrainer):
     """Downpour-SGD (Dean et al.): workers restart from the pulled center
     every window and commit weight deltas; PS adds them
@@ -1466,6 +1481,7 @@ class AEASGD(AsynchronousDistributedTrainer):
     ps_cls = DeltaParameterServer
 
     def __init__(self, *args, rho=5.0, **kwargs):
+        _reject_schedule_lr(kwargs, type(self).__name__)
         super().__init__(*args, **kwargs)
         self.rho = float(rho)
 
@@ -1493,6 +1509,10 @@ class ADAG(AsynchronousDistributedTrainer):
 
     worker_cls = ADAGWorker
     ps_cls = ADAGParameterServer
+
+    def __init__(self, *args, **kwargs):
+        _reject_schedule_lr(kwargs, type(self).__name__)
+        super().__init__(*args, **kwargs)
 
     def worker_kwargs(self):
         return {"learning_rate": self.learning_rate}

@@ -96,6 +96,65 @@ def test_device_resident_converges_no_shuffle():
     assert len(hist) == 3 * (len(train) // 64)
 
 
+def test_learning_rate_schedule_trains():
+    """A named optax schedule passed as learning_rate drives the optimizer
+    (warmup tames bf16 early training — TPU-era practice absent upstream)."""
+    from distkeras_tpu.ops.optimizers import get_schedule
+
+    sched = get_schedule(
+        "warmup_cosine", init_value=0.0, peak_value=5e-3,
+        warmup_steps=20, decay_steps=200,
+    )
+    assert float(sched(0)) == 0.0 and float(sched(20)) > 4e-3
+    train, test = make_data(n=2048)
+    t = SingleTrainer(
+        zoo.mnist_mlp(hidden=64),
+        "adam",
+        "categorical_crossentropy",
+        learning_rate=sched,
+        batch_size=64,
+        num_epoch=3,
+        label_col="label_onehot",
+    )
+    assert t.learning_rate == 0.0  # schedule's step-0 value for PS scaling
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.95
+
+
+def test_schedule_name_errors():
+    from distkeras_tpu.ops.optimizers import get_schedule
+
+    with pytest.raises(ValueError, match="unknown schedule"):
+        get_schedule("bogus")
+    from distkeras_tpu.ops.optimizers import get_optimizer
+
+    with pytest.raises(TypeError, match="does not accept schedules"):
+        get_optimizer(
+            "pallas_sgd", get_schedule("constant", value=0.1)
+        )
+
+
+def test_scalar_lr_trainers_reject_schedules():
+    """AEASGD/EAMSGD/ADAG consume lr as a scalar in their update rules
+    (elastic force, -lr/W commit); a schedule would freeze at step 0 —
+    for warmup that is 0.0, silently training nothing. They must refuse."""
+    from distkeras_tpu import ADAG, AEASGD, EAMSGD
+    from distkeras_tpu.ops.optimizers import get_schedule
+
+    sched = get_schedule(
+        "warmup_cosine", init_value=0.0, peak_value=1e-2,
+        warmup_steps=10, decay_steps=100,
+    )
+    m = zoo.mnist_mlp(hidden=16)
+    for cls in (AEASGD, EAMSGD, ADAG):
+        with pytest.raises(TypeError, match="does not accept schedules"):
+            cls(
+                m, "sgd", "categorical_crossentropy",
+                learning_rate=sched, num_workers=2,
+                label_col="label_onehot",
+            )
+
+
 def test_sync_dp_device_resident_matches_streamed():
     """Resident sync-DP (replicated HBM dataset + "data"-sharded index
     gather) must be bit-identical to the streamed sync-DP path."""
