@@ -71,6 +71,8 @@ class Trainer:
     """Base trainer: model + optimizer/loss spec + history bookkeeping
     (reference: distkeras/trainers.py -> Trainer)."""
 
+    supports_validation = True  # see validation_data handling in __init__
+
     def __init__(
         self,
         model,
@@ -88,6 +90,7 @@ class Trainer:
         aux_loss_weight=0.01,
         profile_dir=None,
         metrics_path=None,
+        validation_data=None,
     ):
         if model.params is None:
             raise ValueError("model must be built (call model.build(input_shape))")
@@ -109,6 +112,19 @@ class Trainer:
         # weight on layer-emitted "aux_loss" state leaves (MoE load balance)
         self.aux_loss_weight = float(aux_loss_weight)
         self.history = TrainingHistory()
+        # held-out set evaluated at each epoch end (Keras-style val_*
+        # metrics in the history); None disables. Trainers without a
+        # global epoch boundary (async: workers own their partitions for
+        # all epochs) or without a single live params tree per epoch
+        # (ensemble/averaging/pipeline) set supports_validation = False
+        # and reject it loudly rather than silently recording nothing.
+        if validation_data is not None and not self.supports_validation:
+            raise TypeError(
+                f"{type(self).__name__} does not support per-epoch "
+                "validation_data — evaluate the returned model with "
+                "ModelPredictor/AccuracyEvaluator instead"
+            )
+        self.validation_data = validation_data
         # observability (absent upstream — SURVEY §5.1/§5.5 required addition)
         self.profile_dir = profile_dir
         self.metrics_logger = MetricsLogger(metrics_path) if metrics_path else None
@@ -204,6 +220,36 @@ class Trainer:
         (0 = final only) and always at the last epoch."""
         every = self.checkpoint_every
         return (every > 0 and done % every == 0) or done == self.num_epoch
+
+    def _run_validation(self, core, params, state, epoch):
+        """Evaluate ``validation_data`` with the current params/state and
+        record Keras-style ``val_*`` metrics for this epoch. Metrics are
+        sample-weighted means over all validation batches (ragged tail
+        included)."""
+        if self.validation_data is None:
+            return None
+        totals, n = {}, 0
+        for batch in self.validation_data.batches(
+            self.batch_size,
+            columns=[self.features_col, self.label_col],
+            drop_remainder=False,
+        ):
+            x, y = batch[self.features_col], batch[self.label_col]
+            mets = core.eval_step(params, state, x, y)
+            b = len(x)
+            for k, v in mets.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * b
+            n += b
+        if n == 0:
+            return None
+        avg = {f"val_{k}": v / n for k, v in totals.items()}
+        self.history.record_validation(epoch, avg)
+        if self.metrics_logger is not None:
+            self.metrics_logger.log(event="validation", epoch=epoch, **avg)
+        return avg
+
+    def get_validation_history(self):
+        return self.history.get_validation_history()
 
     def _reconcile_opt_state(self, candidate, core, params):
         """Restored optimizer moments, or None when the checkpoint was
@@ -321,8 +367,9 @@ class SingleTrainer(Trainer):
                 start_epoch = int(meta["epoch"])
 
         on_epoch_end = None
-        if self.checkpointer is not None:
+        if self.checkpointer is not None or self.validation_data is not None:
             def on_epoch_end(epoch, params, state, opt_state, rng):
+                self._run_validation(core, params, state, epoch + 1)
                 self._save_epoch_checkpoint(epoch + 1, params, state, opt_state, rng)
 
         params, state, records = worker.train(
@@ -510,7 +557,10 @@ class SynchronousDistributedTrainer(Trainer):
             start_epoch,
             (params, state, opt_state, rng),
             run_window,
-            lambda epoch, carry: self._save_epoch_checkpoint(epoch + 1, *carry),
+            lambda epoch, carry: (
+                self._run_validation(core, carry[0], carry[1], epoch + 1),
+                self._save_epoch_checkpoint(epoch + 1, *carry),
+            ),
             prepare=prepare,
             prefetch=self.prefetch,
         )
@@ -550,6 +600,7 @@ class SynchronousDistributedTrainer(Trainer):
                 )
                 self.history.extend(0, _metrics_to_records(mets))
                 self.history.record_window(0, idx.size, time.perf_counter() - t0)
+            self._run_validation(core, params, state, epoch + 1)
             self._save_epoch_checkpoint(
                 epoch + 1, params, state, opt_state, rng
             )
@@ -684,8 +735,9 @@ class SequenceParallelTrainer(Trainer):
                 start_epoch,
                 (params, state, opt_state, rng),
                 run_window,
-                lambda epoch, carry: self._save_epoch_checkpoint(
-                    epoch + 1, *carry
+                lambda epoch, carry: (
+                    self._run_validation(core, carry[0], carry[1], epoch + 1),
+                    self._save_epoch_checkpoint(epoch + 1, *carry),
                 ),
                 prepare=prepare,
                 prefetch=self.prefetch,
@@ -766,6 +818,8 @@ class PipelineParallelTrainer(Trainer):
     unstacked: pipelining is an execution-layout concern, invisible in the
     result (and in checkpoints, which store the unstacked layout).
     """
+
+    supports_validation = False
 
     def __init__(
         self,
@@ -988,6 +1042,8 @@ class EnsembleTrainer(Trainer):
     """Train ``num_models`` independent models on disjoint partitions; return
     the list (reference: distkeras/trainers.py -> EnsembleTrainer)."""
 
+    supports_validation = False
+
     def __init__(self, *args, num_models=2, window=8, **kwargs):
         super().__init__(*args, **kwargs)
         self.num_models = int(num_models)
@@ -1045,6 +1101,8 @@ class AveragingTrainer(Trainer):
     """Per epoch: train a replica per partition from the current center, then
     average the replicas' weights (reference: distkeras/trainers.py ->
     AveragingTrainer)."""
+
+    supports_validation = False
 
     def __init__(self, *args, num_workers=2, window=8, **kwargs):
         super().__init__(*args, **kwargs)
@@ -1139,6 +1197,8 @@ class DistributedTrainer(Trainer):
     interleaving of pull/commit across workers — reproducible staleness for
     tests; SURVEY §7.3).
     """
+
+    supports_validation = False
 
     worker_cls = None
     ps_cls = DeltaParameterServer
@@ -1449,13 +1509,18 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     ``communication_window`` commit cadence lives on DistributedTrainer."""
 
 
-def _reject_schedule_lr(kwargs, trainer_name):
+def _reject_schedule_lr(args, kwargs, trainer_name):
     """Algorithms whose update rules consume the lr as a SCALAR (AEASGD's
     elastic force rho*lr, EAMSGD likewise, ADAG's -lr/W commit) cannot run
     a schedule — `effective_learning_rate` would freeze it at step 0, which
     for a warmup schedule is 0.0 and silently trains nothing. Fail loudly
-    instead; schedules work with the other trainers."""
-    if callable(kwargs.get("learning_rate")):
+    instead; schedules work with the other trainers. ``args`` covers the
+    positional spelling (learning_rate is Trainer.__init__'s 5th
+    parameter)."""
+    lr = kwargs.get("learning_rate")
+    if lr is None and len(args) >= 5:
+        lr = args[4]
+    if callable(lr):
         raise TypeError(
             f"{trainer_name} consumes the learning rate as a scalar in its "
             "update rule and does not accept schedules; pass a float (or "
@@ -1481,7 +1546,7 @@ class AEASGD(AsynchronousDistributedTrainer):
     ps_cls = DeltaParameterServer
 
     def __init__(self, *args, rho=5.0, **kwargs):
-        _reject_schedule_lr(kwargs, type(self).__name__)
+        _reject_schedule_lr(args, kwargs, type(self).__name__)
         super().__init__(*args, **kwargs)
         self.rho = float(rho)
 
@@ -1511,7 +1576,7 @@ class ADAG(AsynchronousDistributedTrainer):
     ps_cls = ADAGParameterServer
 
     def __init__(self, *args, **kwargs):
-        _reject_schedule_lr(kwargs, type(self).__name__)
+        _reject_schedule_lr(args, kwargs, type(self).__name__)
         super().__init__(*args, **kwargs)
 
     def worker_kwargs(self):

@@ -18,6 +18,7 @@ class TrainingHistory:
     def __init__(self):
         self._records = defaultdict(list)  # worker_id -> list of dict
         self._windows = defaultdict(list)  # worker_id -> list of (samples, sec)
+        self._validation = []  # per-epoch val_* metric dicts
         self._t_start = None
         self._t_end = None
 
@@ -52,6 +53,16 @@ class TrainingHistory:
 
     def num_updates(self) -> int:
         return sum(len(v) for v in self._records.values())
+
+    # -- per-epoch validation (Keras-style val_* metrics) -------------------
+
+    def record_validation(self, epoch: int, metrics: dict):
+        self._validation.append(
+            {"epoch": int(epoch), **{k: float(v) for k, v in metrics.items()}}
+        )
+
+    def get_validation_history(self):
+        return list(self._validation)
 
     # -- throughput bookkeeping (profiling subsystem; absent upstream) ------
 

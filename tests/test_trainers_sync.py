@@ -153,6 +153,63 @@ def test_scalar_lr_trainers_reject_schedules():
                 learning_rate=sched, num_workers=2,
                 label_col="label_onehot",
             )
+    # the positional spelling must not bypass the guard
+    with pytest.raises(TypeError, match="does not accept schedules"):
+        AEASGD(m, "sgd", "categorical_crossentropy", ("accuracy",), sched)
+
+
+def test_validation_data_records_val_metrics():
+    """Keras-style per-epoch validation: val_* metrics recorded at every
+    epoch end, improving as training progresses."""
+    train, test = make_data(n=2048)
+    t = SingleTrainer(
+        zoo.mnist_mlp(hidden=64),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=64,
+        num_epoch=3,
+        label_col="label_onehot",
+        validation_data=test,
+    )
+    t.train(train)
+    val = t.get_validation_history()
+    assert [v["epoch"] for v in val] == [1, 2, 3]
+    assert set(val[0]) >= {"epoch", "val_loss", "val_accuracy"}
+    assert val[-1]["val_accuracy"] > 0.95
+    assert val[-1]["val_loss"] < val[0]["val_loss"]
+
+
+def test_validation_on_sync_dp_and_resident():
+    train, test = make_data(n=2048)
+    for resident in (False, True):
+        t = SynchronousDistributedTrainer(
+            zoo.mnist_mlp(hidden=64, seed=2),
+            "sgd",
+            "categorical_crossentropy",
+            learning_rate=0.05,
+            batch_size=16,
+            num_workers=8,
+            num_epoch=3,
+            device_resident=resident,
+            label_col="label_onehot",
+            validation_data=test,
+        )
+        t.train(train, shuffle=True)
+        val = t.get_validation_history()
+        assert [v["epoch"] for v in val] == [1, 2, 3]
+        assert val[-1]["val_accuracy"] > 0.9
+
+
+def test_async_trainers_reject_validation_data():
+    from distkeras_tpu import DOWNPOUR
+
+    train, test = make_data(n=256)
+    with pytest.raises(TypeError, match="validation_data"):
+        DOWNPOUR(
+            zoo.mnist_mlp(hidden=16), "sgd", "categorical_crossentropy",
+            num_workers=2, label_col="label_onehot", validation_data=test,
+        )
 
 
 def test_sync_dp_device_resident_matches_streamed():
