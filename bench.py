@@ -98,7 +98,7 @@ def resolve_backend() -> tuple[str, str | None] | None:
     Returns (platform, config_pin): apply ``jax.config.update('jax_platforms',
     config_pin)`` after import when config_pin is not None."""
     candidates = [
-        (None, 150.0),  # whatever the driver set (axon TPU when healthy)
+        (None, 75.0),  # whatever the driver set (axon TPU when healthy)
         ("cpu", 60.0),  # always-available fallback
     ]
     for config_platform, timeout in candidates:
@@ -162,10 +162,12 @@ def main() -> None:
     from distkeras_tpu.workers import WorkerCore
 
     on_cpu = platform == "cpu"
-    batch = 256 if on_cpu else 2048  # 2048 measured best on v5e (r2 sweep)
-    window = 4 if on_cpu else 16  # steps fused into one XLA program
+    # CPU fallback sizes are chosen to finish in ~1 min on one core: the
+    # number only proves the harness runs end-to-end, it is not a perf claim
+    batch = 128 if on_cpu else 2048  # 2048 measured best on v5e (r2 sweep)
+    window = 2 if on_cpu else 16  # steps fused into one XLA program
     warmup_windows = 1 if on_cpu else 2
-    timed_windows = 4 if on_cpu else 16
+    timed_windows = 3 if on_cpu else 16
     n_data = batch * 8  # HBM-resident pool the windows gather from
 
     devices = jax.devices()
@@ -180,7 +182,8 @@ def main() -> None:
         model,
         get_optimizer("sgd", 0.01),
         "categorical_crossentropy",
-        compute_dtype="bfloat16",
+        # XLA:CPU emulates bf16 slowly; the fallback measures in f32
+        compute_dtype=None if on_cpu else "bfloat16",
     )
 
     # Device-resident feed (the framework's `device_resident=True` training
@@ -237,7 +240,7 @@ def main() -> None:
     }
     if flops_per_window is not None:
         flops_per_sec = flops_per_window * timed_windows / dt
-        record["model_flops_per_sec"] = round(flops_per_sec / 1e12, 3)  # TFLOP/s
+        record["model_flops_per_sec"] = round(flops_per_sec / 1e12, 4)  # TFLOP/s
         peak = _peak_flops(devices[0])
         if peak is not None:
             record["mfu"] = round(flops_per_sec / peak, 4)

@@ -221,27 +221,35 @@ class Trainer:
         every = self.checkpoint_every
         return (every > 0 and done % every == 0) or done == self.num_epoch
 
+    def _epoch_end(self, core, epoch, params, state, opt_state, rng):
+        """THE per-epoch finalization shared by every windowed trainer:
+        validate, then checkpoint (both no-ops when unconfigured)."""
+        self._run_validation(core, params, state, epoch + 1)
+        self._save_epoch_checkpoint(epoch + 1, params, state, opt_state, rng)
+
     def _run_validation(self, core, params, state, epoch):
         """Evaluate ``validation_data`` with the current params/state and
         record Keras-style ``val_*`` metrics for this epoch. Metrics are
         sample-weighted means over all validation batches (ragged tail
-        included)."""
+        included). Per-batch results stay on device until the end so
+        eval dispatches pipeline instead of syncing every batch."""
         if self.validation_data is None:
             return None
-        totals, n = {}, 0
+        results = []
         for batch in self.validation_data.batches(
             self.batch_size,
             columns=[self.features_col, self.label_col],
             drop_remainder=False,
         ):
             x, y = batch[self.features_col], batch[self.label_col]
-            mets = core.eval_step(params, state, x, y)
-            b = len(x)
+            results.append((core.eval_step(params, state, x, y), len(x)))
+        if not results:
+            return None
+        totals, n = {}, 0
+        for mets, b in results:
             for k, v in mets.items():
                 totals[k] = totals.get(k, 0.0) + float(v) * b
             n += b
-        if n == 0:
-            return None
         avg = {f"val_{k}": v / n for k, v in totals.items()}
         self.history.record_validation(epoch, avg)
         if self.metrics_logger is not None:
@@ -369,8 +377,7 @@ class SingleTrainer(Trainer):
         on_epoch_end = None
         if self.checkpointer is not None or self.validation_data is not None:
             def on_epoch_end(epoch, params, state, opt_state, rng):
-                self._run_validation(core, params, state, epoch + 1)
-                self._save_epoch_checkpoint(epoch + 1, params, state, opt_state, rng)
+                self._epoch_end(core, epoch, params, state, opt_state, rng)
 
         params, state, records = worker.train(
             dataset,
@@ -557,10 +564,7 @@ class SynchronousDistributedTrainer(Trainer):
             start_epoch,
             (params, state, opt_state, rng),
             run_window,
-            lambda epoch, carry: (
-                self._run_validation(core, carry[0], carry[1], epoch + 1),
-                self._save_epoch_checkpoint(epoch + 1, *carry),
-            ),
+            lambda epoch, carry: self._epoch_end(core, epoch, *carry),
             prepare=prepare,
             prefetch=self.prefetch,
         )
@@ -600,10 +604,7 @@ class SynchronousDistributedTrainer(Trainer):
                 )
                 self.history.extend(0, _metrics_to_records(mets))
                 self.history.record_window(0, idx.size, time.perf_counter() - t0)
-            self._run_validation(core, params, state, epoch + 1)
-            self._save_epoch_checkpoint(
-                epoch + 1, params, state, opt_state, rng
-            )
+            self._epoch_end(core, epoch, params, state, opt_state, rng)
 
         self.history.record_training_end()
         return self._finish(params, state)
@@ -735,10 +736,7 @@ class SequenceParallelTrainer(Trainer):
                 start_epoch,
                 (params, state, opt_state, rng),
                 run_window,
-                lambda epoch, carry: (
-                    self._run_validation(core, carry[0], carry[1], epoch + 1),
-                    self._save_epoch_checkpoint(epoch + 1, *carry),
-                ),
+                lambda epoch, carry: self._epoch_end(core, epoch, *carry),
                 prepare=prepare,
                 prefetch=self.prefetch,
             )

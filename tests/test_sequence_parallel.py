@@ -126,6 +126,22 @@ def test_sp_checkpoint_resume_bit_identical(tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+def test_sp_validation_data_records_val_metrics():
+    """Per-epoch validation with ring-attention hooks attached: eval_step
+    runs the ring shard_map on host-unsharded (B, T) inputs (README
+    advertises validation_data on the SP trainer)."""
+    train, val = make_data(n=1024)
+    t = SequenceParallelTrainer(
+        make_model(), "adam", "categorical_crossentropy",
+        batch_size=32, num_epoch=2, num_workers=8,
+        label_col="label_onehot", validation_data=val,
+    )
+    t.train(train, shuffle=True)
+    hist = t.get_validation_history()
+    assert [v["epoch"] for v in hist] == [1, 2]
+    assert hist[-1]["val_accuracy"] > 0.9
+
+
 def test_sp_requires_attention_model():
     train, _ = make_data(n=128)
     t = SequenceParallelTrainer(
