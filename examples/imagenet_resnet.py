@@ -124,11 +124,16 @@ def main():
     )(test)
 
     model = resnet18(
-        num_classes=args.classes, input_shape=(args.size, args.size, 3), seed=0
+        num_classes=args.classes, input_shape=(args.size, args.size, 3),
+        seed=0, bn_momentum=0.9,  # short demo runs: eval stats must track
     )
+    # adam lr 1e-3 (benchmarks.py config-5 calibration): a from-scratch
+    # ResNet under DynSGD stays at a constant prediction with plain sgd;
+    # the 1/(staleness+1) delta scaling already provides the per-worker
+    # division
     trainer = DynSGD(
-        model, worker_optimizer="sgd", loss="categorical_crossentropy",
-        learning_rate=0.1, label_col="label_onehot", batch_size=args.batch,
+        model, worker_optimizer="adam", loss="categorical_crossentropy",
+        learning_rate=1e-3, label_col="label_onehot", batch_size=args.batch,
         num_epoch=args.epochs, num_workers=args.workers,
         communication_window=4, compute_dtype="bfloat16",
     )
