@@ -69,8 +69,13 @@ def main():
         test = ReshapeTransformer("features", "features", (28, 28, 1))(test)
         model = mnist_cnn(seed=0)
         cls = DOWNPOUR if args.mode == "downpour" else SynchronousDistributedTrainer
+        # DOWNPOUR: N workers' window deltas sum at the PS -> local adam lr
+        # scales by 1/N (benchmarks.py config-2 calibration); the sync
+        # trainer means the global-batch loss, so full lr is right there
+        lr = 1e-3 / args.workers if cls is DOWNPOUR else 1e-3
         trainer = cls(
-            model, worker_optimizer="adam", loss="categorical_crossentropy",
+            model, worker_optimizer="adam", learning_rate=lr,
+            loss="categorical_crossentropy",
             label_col="label_onehot", batch_size=args.batch,
             num_epoch=args.epochs, num_workers=args.workers,
         )
