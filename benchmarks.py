@@ -330,81 +330,162 @@ def main():
         print(f"no configs matched {sorted(want)}; nothing written")
 
 
+def config_stamp() -> str:
+    """Fingerprint of what defines the five configurations: the source of
+    ``build_configs`` (trainer classes, lrs, batch sizes, targets) plus the
+    synthetic-loader and model-zoo sources. Rows carry the stamp so a
+    partial rerun after a calibration change (lr, class counts,
+    bn_momentum, ...) cannot silently merge with rows measured under the
+    old definitions (ADVICE r2 #2). Deliberately NOT a hash of this whole
+    file: a reporting/harness edit must not invalidate measured TPU rows
+    that a CPU box cannot re-produce. Memoized: the stamp cannot change
+    mid-run, and write_outputs runs once per config."""
+    import hashlib
+    import inspect
+
+    if _CONFIG_STAMP:
+        return _CONFIG_STAMP[0]
+
+    h = hashlib.sha256(inspect.getsource(build_configs).encode())
+    base = os.path.dirname(os.path.abspath(__file__))
+    for rel in (
+        os.path.join("distkeras_tpu", "data", "loaders.py"),
+        os.path.join("distkeras_tpu", "models", "zoo.py"),
+    ):
+        try:
+            with open(os.path.join(base, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(rel.encode())
+    _CONFIG_STAMP.append(h.hexdigest()[:12])
+    return _CONFIG_STAMP[0]
+
+
+_CONFIG_STAMP = []
+
+
+def _merge_rows(fresh_rows, prior_rows):
+    """Per-config merge: the fresh row wins, except a prior GOOD row beats a
+    fresh ERROR row (a flaky rerun must not evict a valid measurement)."""
+    prior_good = {r["config"]: r for r in prior_rows if "error" not in r}
+    fresh = {
+        r["config"]: (
+            prior_good[r["config"]]
+            if "error" in r and r["config"] in prior_good
+            else r
+        )
+        for r in fresh_rows
+    }
+    return sorted(
+        list(fresh.values())
+        + [r for r in prior_rows if r["config"] not in fresh],
+        key=lambda r: r["config"],
+    )
+
+
 def write_outputs(rows, platform, device_kind, scale, out):
-    # merge with rows already on disk (same platform+scale): a partial rerun
-    # (--configs 2) refreshes its rows without clobbering the others
+    """Persist the matrix. BENCHMARKS.json holds one run section per
+    (platform, scale) — a TPU harvest lands NEXT TO the CPU regression rows
+    instead of clobbering them (VERDICT r2 task 8: both columns in the
+    matrix). Within a section, a partial rerun (--configs 2) refreshes its
+    rows without clobbering the others; a calibration change (config_stamp
+    mismatch, ADVICE r2 #2) invalidates every prior section."""
+    stamp = config_stamp()
     path = os.path.join(out, "BENCHMARKS.json")
+    runs = []
     if os.path.exists(path):
         try:
             with open(path) as f:
                 prior = json.load(f)
-            if (
-                prior.get("platform") == platform
-                and prior.get("device_kind") == device_kind
-                and prior.get("scale") == scale
-            ):
-                prior_good = {
-                    r["config"]: r
-                    for r in prior["results"]
-                    if "error" not in r
-                }
-                # keep a prior good row over a fresh error row (a flaky
-                # rerun must not evict a valid measurement), otherwise the
-                # fresh row wins
-                fresh = {
-                    r["config"]: (
-                        prior_good[r["config"]]
-                        if "error" in r and r["config"] in prior_good
-                        else r
-                    )
-                    for r in rows
-                }
-                rows = sorted(
-                    list(fresh.values())
-                    + [
-                        r
-                        for r in prior["results"]
-                        if r["config"] not in fresh
-                    ],
-                    key=lambda r: r["config"],
+            if prior.get("config_stamp") != stamp:
+                # a stampless (pre-stamp) prior is just as untrustworthy as
+                # a mismatched one: drop it rather than relabel its rows
+                print(
+                    f"prior BENCHMARKS.json stamp {prior.get('config_stamp')}"
+                    f" != current {stamp}; dropping stale rows"
                 )
+            else:
+                if "runs" in prior:
+                    cand = list(prior["runs"])
+                elif "results" in prior:  # one-run layout, the stamp's debut
+                    cand = [prior]
+                else:
+                    cand = []
+                # keep only well-formed sections: a malformed entry must
+                # degrade to "overwrite", not crash the benchmark run
+                runs = [
+                    {
+                        "platform": r["platform"],
+                        "device_kind": r["device_kind"],
+                        "scale": r["scale"],
+                        "results": list(r["results"]),
+                    }
+                    for r in cand
+                    if isinstance(r, dict)
+                    and all(
+                        k in r
+                        for k in ("platform", "device_kind", "scale", "results")
+                    )
+                ]
         except (json.JSONDecodeError, KeyError, TypeError, AttributeError):
             pass  # unreadable prior file: overwrite it
-    payload = {
+    mine = {
         "platform": platform,
         "device_kind": device_kind,
         "scale": scale,
         "results": rows,
     }
+    merged = False
+    for i, run in enumerate(runs):
+        if (
+            run["platform"] == platform
+            and run["device_kind"] == device_kind
+            and run["scale"] == scale
+        ):
+            mine["results"] = _merge_rows(rows, run["results"])
+            runs[i] = mine
+            merged = True
+            break
+    if not merged:
+        runs.append(mine)
+    runs.sort(key=lambda r: (r["platform"] != "tpu", r["scale"]))
+
     os.makedirs(out, exist_ok=True)
     with open(os.path.join(out, "BENCHMARKS.json"), "w") as f:
-        json.dump(payload, f, indent=2)
+        json.dump({"config_stamp": stamp, "runs": runs}, f, indent=2)
 
     lines = [
         "# BASELINE benchmark matrix",
         "",
-        f"Platform `{platform}` ({device_kind}), scale `{scale}`. "
         "Synthetic stand-in datasets (BASELINE.md: `published: {}` — no "
         "upstream numbers exist); both BASELINE metric axes per config. "
         "samples/sec/chip is steady-state (compile window excluded). "
-        "Reproduce: `python benchmarks.py`.",
-        "",
-        "| # | config | samples/sec/chip | target acc | epochs to target "
-        "| final acc | total s |",
-        "|---|---|---|---|---|---|---|",
+        f"Config stamp `{stamp}` (sections from older calibrations are "
+        "dropped automatically). Reproduce: `python benchmarks.py`.",
     ]
-    for r in rows:
-        if "error" in r:
+    for run in runs:
+        lines += [
+            "",
+            f"## Platform `{run['platform']}` ({run['device_kind']}), "
+            f"scale `{run['scale']}`",
+            "",
+            "| # | config | samples/sec/chip | target acc | epochs to target "
+            "| final acc | total s |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for r in run["results"]:
+            if "error" in r:
+                lines.append(
+                    f"| {r['config']} | {r['name']} | error: {r['error']} "
+                    "| | | | |"
+                )
+                continue
+            ett = r["epochs_to_target"] if r["epochs_to_target"] else "not reached"
             lines.append(
-                f"| {r['config']} | {r['name']} | error: {r['error']} | | | | |"
+                f"| {r['config']} | {r['name']} | {r['samples_per_sec_per_chip']} "
+                f"| {r['target_accuracy']} | {ett} | {r['final_accuracy']:.4f} "
+                f"| {r['seconds_total']} |"
             )
-            continue
-        ett = r["epochs_to_target"] if r["epochs_to_target"] else "not reached"
-        lines.append(
-            f"| {r['config']} | {r['name']} | {r['samples_per_sec_per_chip']} "
-            f"| {r['target_accuracy']} | {ett} | {r['final_accuracy']:.4f} "
-            f"| {r['seconds_total']} |"
-        )
     with open(os.path.join(out, "BENCHMARKS.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
 

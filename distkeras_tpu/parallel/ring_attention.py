@@ -140,6 +140,15 @@ def blockwise_attention(q, k, v, causal=False, block_size=512):
     :func:`dense_attention` numerically.
     """
     b, t, h, d = q.shape
+    if k.shape[1] != t or v.shape[1] != t:
+        # Self-attention only: the block reshape below derives the K/V block
+        # count from q's length, and the causal offsets assume Tq == Tk.
+        # With Tq <= block_size this used to silently hit the dense path
+        # (correct) but blow up in the reshape past it (ADVICE r2 #1).
+        raise ValueError(
+            "blockwise_attention is self-attention only: expected "
+            f"k/v seq length {t} (q's), got k={k.shape[1]}, v={v.shape[1]}"
+        )
     if t <= block_size:  # one (possibly partial) block IS the dense case
         return dense_attention(q, k, v, causal=causal)
     if t % block_size:

@@ -188,15 +188,25 @@ def _metrics_to_records(mets) -> list:
     return [{k: float(v[i]) for k, v in host.items()} for i in range(w)]
 
 
+def state_leaf_name(path):
+    """Name of a model-state pytree leaf from its tree_flatten_with_path
+    path: the last path entry's key (dict trees — the model-state layout),
+    else its string form. THE definition of which leaves count as
+    "aux_loss", shared by the loss collection here and the trainer's
+    worker-state aggregation policy."""
+    if not path:
+        return None
+    last = path[-1]
+    return last.key if hasattr(last, "key") else str(last)
+
+
 def _collect_aux_losses(state):
     """Sum of every leaf named "aux_loss" in a model-state pytree — the
     channel layers use to surface differentiable regularizers (MoE's
     switch load-balance loss) to the training loss."""
     total = jnp.zeros((), jnp.float32)
     for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
-        last = path[-1]
-        name = last.key if hasattr(last, "key") else str(last)
-        if name == "aux_loss":
+        if state_leaf_name(path) == "aux_loss":
             total = total + jnp.sum(leaf).astype(jnp.float32)
     return total
 

@@ -79,6 +79,16 @@ def test_blockwise_rejects_indivisible_block():
         blockwise_attention(q, k, v, block_size=48)
 
 
+def test_blockwise_rejects_cross_attention():
+    """Tq != Tk must fail loudly up front (self-attention only), not fall
+    back to dense for short q and reshape-crash for long q (ADVICE r2 #1)."""
+    from distkeras_tpu.parallel.ring_attention import blockwise_attention
+
+    q, k, v = (jnp.asarray(a) for a in qkv())
+    with pytest.raises(ValueError, match="self-attention only"):
+        blockwise_attention(q, k[:, : k.shape[1] // 2], v, block_size=16)
+
+
 def test_blockwise_short_seq_falls_back_to_dense():
     """seq <= block_size (the default 512 vs a short model) must compute,
     not raise — one partial block IS the dense case."""

@@ -1,0 +1,40 @@
+#!/bin/sh
+# Session-long TPU-window watcher (VERDICT r2 "Next round" task 1).
+#
+# The sandbox tunnel historically gives ~1 healthy hour in ~10; waiting to
+# notice it by hand loses the window. This loop probes cheaply (subprocess,
+# hard-killed on hang) every ~5 minutes and, the moment `jax.devices()`
+# answers with a TPU, harvests the full capture sweep (bench.py device-
+# resident north-star, bench_mfu.py transformer MFU, prefetch A/B) plus the
+# TPU column of the BENCHMARKS matrix, then commits the artifacts.
+cd "$(dirname "$0")/.." || exit 1
+LOG=TPU_WATCH.log
+
+while true; do
+  if timeout -k 10 75 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel HEALTHY - starting capture" >> "$LOG"
+    sh tools/tpu_capture.sh >> "$LOG" 2>&1
+    grep '"metric": "mnist_cnn_train' TPU_CAPTURE.log | tail -1 > BENCH_TPU.json
+    timeout -k 30 2400 python benchmarks.py --configs 1,2,3 >> "$LOG" 2>&1
+    # Commit only the artifact paths (git add first: several are untracked
+    # on first harvest, and `git commit -- <path>` rejects untracked paths);
+    # retry around a possibly-held index.lock
+    for _ in 1 2 3 4 5; do
+      git add -- TPU_CAPTURE.log TPU_CAPTURE.log.err BENCH_TPU.json \
+        BENCH_MFU.json BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1
+      if git commit -m "Harvest TPU window: capture sweep + TPU benchmark rows
+
+No-Verification-Needed: benchmark artifact capture only" \
+          -- TPU_CAPTURE.log TPU_CAPTURE.log.err BENCH_TPU.json BENCH_MFU.json \
+             BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1; then
+        break
+      fi
+      sleep 20
+    done
+    echo "$(date -u +%FT%TZ) capture cycle done" >> "$LOG"
+    sleep 120
+  else
+    echo "$(date -u +%FT%TZ) tunnel down" >> "$LOG"
+    sleep 240
+  fi
+done
