@@ -47,6 +47,15 @@ def _to_host(tree):
 # Pure commit rules (testable without threads; reference: §4.2/§4.3 semantics)
 
 
+def _wid_key(k):
+    """Worker ids round-trip through JSON meta / str-keyed trees as strings;
+    normalize back to int where possible."""
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
 def delta_rule(center, meta, delta, tag=None):
     """center += delta (DOWNPOUR / AEASGD / EAMSGD / ADAG commits)."""
     new_center = jax.tree.map(lambda c, d: c + np.asarray(d), center, delta)
@@ -100,6 +109,13 @@ class ParameterServer:
         # trainer a heartbeat to detect dead workers.
         self._seen_seq = {}  # worker_id -> highest committed seq
         self._activity = {}  # worker_id -> last pull/commit wall time
+        # worker-local checkpoint custody: committers hand their host-copied
+        # local state to commit(local_snap=...), stored here IN-LOCK. A
+        # checkpoint therefore never holds a worker snapshot that is AHEAD
+        # of the center it is saved with (the snap lands in the same locked
+        # section as its own commit) — behind is fine (the replayed windows
+        # dedup), ahead would silently lose commits on resume.
+        self._worker_snaps = {}  # worker_id -> host-copy state dict
 
     # -- protocol verbs -----------------------------------------------------
 
@@ -112,16 +128,24 @@ class ParameterServer:
                 self._activity[worker_id] = time.monotonic()
         return center, tag
 
-    def commit(self, delta, tag=None, commit_id=None):
+    def commit(self, delta, tag=None, commit_id=None, local_snap=None):
         """Apply a delta. ``commit_id=(worker_id, seq)`` makes the commit
         exactly-once: a retried worker re-sends seq numbers the PS has
         already absorbed and they are dropped (counted in meta
-        ``num_duplicates``) instead of double-applied."""
+        ``num_duplicates``) instead of double-applied.
+
+        ``local_snap``: the committer's host-copied local state (see
+        ``AsyncWorker.finish_window``), stored in the same locked section
+        as the commit so checkpoints capture worker states consistent with
+        (never ahead of) the center. Stored even for a deduped replay —
+        the replayed state is at-or-behind the center, which is safe."""
         snap = None
         with self._lock:
             if commit_id is not None:
                 wid, seq = commit_id
                 self._activity[wid] = time.monotonic()
+                if local_snap is not None:
+                    self._worker_snaps[wid] = local_snap
                 if seq <= self._seen_seq.get(wid, -1):
                     self._meta["num_duplicates"] = (
                         self._meta.get("num_duplicates", 0) + 1
@@ -138,7 +162,11 @@ class ParameterServer:
                 and self.snapshot_every > 0
                 and n % self.snapshot_every == 0
             ):
-                snap = (jax.tree.map(np.copy, self._center), dict(self._meta))
+                snap = (
+                    jax.tree.map(np.copy, self._center),
+                    self._meta_copy(),
+                    dict(self._worker_snaps),
+                )
         if snap is not None:
             # heavy IO outside the lock; content still == step n. A snapshot
             # failure (disk full, perms) must not surface as a *worker*
@@ -185,17 +213,45 @@ class ParameterServer:
         with self._lock:
             self._center = _to_host(params)
 
+    def _meta_copy(self):
+        """Checkpoint-bound meta: the commit-rule meta plus the exactly-once
+        dedup table (worker_id -> highest absorbed seq). Persisting the
+        table means a worker that restarts from scratch AFTER a resume
+        still cannot double-apply pre-checkpoint commits. Keys go to str
+        (the table rides in meta.json); restore normalizes them back.
+        Caller must hold the lock."""
+        meta = dict(self._meta)
+        meta["seen_seq"] = {str(k): int(v) for k, v in self._seen_seq.items()}
+        return meta
+
     def snapshot(self):
         """Consistent (center copy, meta copy) — the checkpoint payload.
-        Meta includes the DynSGD version counter, so staleness bookkeeping
-        survives a restore."""
+        Meta includes the DynSGD version counter and the commit dedup
+        table, so staleness and exactly-once bookkeeping survive a
+        restore."""
         with self._lock:
-            return jax.tree.map(np.copy, self._center), dict(self._meta)
+            return jax.tree.map(np.copy, self._center), self._meta_copy()
 
     def restore_snapshot(self, center, meta):
+        meta = dict(meta)
+        seen = meta.pop("seen_seq", {})
         with self._lock:
             self._center = _to_host(center)
-            self._meta = dict(meta)
+            self._meta = meta
+            self._seen_seq = {_wid_key(k): int(v) for k, v in seen.items()}
+
+    def worker_snapshots(self):
+        """In-lock copy of the committers' local-state snapshots (the
+        end-of-run checkpoint payload)."""
+        with self._lock:
+            return dict(self._worker_snaps)
+
+    def restore_worker_snapshots(self, snaps: dict):
+        """Seed the custody table from a restored checkpoint, so snapshots
+        taken BEFORE every worker's first post-resume commit still carry
+        the restored worker states instead of silently dropping them."""
+        with self._lock:
+            self._worker_snaps = {_wid_key(k): v for k, v in snaps.items()}
 
     @property
     def num_updates(self) -> int:
@@ -303,10 +359,16 @@ class SocketParameterServer:
                     commit_id = header.get("commit_id")
                     if commit_id is not None:
                         commit_id = (commit_id[0], commit_id[1])
+                    tree = deserialize_params(blob)
+                    local_snap = None
+                    if header.get("wrapped"):
+                        local_snap = tree.get("snap")
+                        tree = tree["delta"]
                     self.ps.commit(
-                        deserialize_params(blob),
+                        tree,
                         header.get("tag"),
                         commit_id=commit_id,
+                        local_snap=local_snap,
                     )
                     conn.sendall(b"k")
                 elif action == b"s":
@@ -355,11 +417,17 @@ class RemoteParameterServerClient:
             header, blob = unpack_frame(networking.recv_data(self._sock))
         return deserialize_params(blob), header.get("tag")
 
-    def commit(self, delta, tag=None, commit_id=None):
-        payload = pack_frame(
-            {"tag": tag, "commit_id": list(commit_id) if commit_id else None},
-            serialize_params(_to_host(delta)),
-        )
+    def commit(self, delta, tag=None, commit_id=None, local_snap=None):
+        header = {"tag": tag, "commit_id": list(commit_id) if commit_id else None}
+        tree = _to_host(delta)
+        if local_snap is not None:
+            # worker-local checkpoint state rides the same frame ("wrapped"
+            # layout) so remote/DCN workers keep full resume parity with
+            # in-process ones; costs one extra params+opt_state per
+            # communication window, only when checkpointing is on
+            header["wrapped"] = True
+            tree = {"delta": tree, "snap": local_snap}
+        payload = pack_frame(header, serialize_params(tree))
         with self._lock:
             self._sock.sendall(b"c")
             networking.send_data(self._sock, payload)

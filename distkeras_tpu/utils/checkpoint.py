@@ -19,8 +19,13 @@ This module is the compensating addition the rebuild requires:
 - Trainer integration (see trainers.py): epoch-granular snapshots for
   SingleTrainer / SynchronousDistributedTrainer (params, state, opt_state,
   rng — resume is bit-identical to an uninterrupted run), and PS-update-
-  granular snapshots for the async PS trainers (center + PS meta, so DynSGD's
-  staleness version counter survives a restart).
+  granular snapshots for the async PS trainers: center + PS meta (DynSGD's
+  staleness version counter and the exactly-once dedup table) + each
+  worker's latest committed local state (elastic replica params, model
+  state, optimizer moments, rng, commit seq). An async resume therefore
+  restores a reachable configuration of the whole async system; workers
+  skip the windows the restored center already absorbed and continue their
+  replicas rather than re-adopting the center.
 """
 
 from __future__ import annotations

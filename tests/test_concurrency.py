@@ -74,7 +74,7 @@ def test_snapshot_consistency_under_contention():
     ps = DeltaParameterServer({"w": np.zeros((8,), np.float32)})
     seen = []
 
-    def on_snapshot(n, center, meta):
+    def on_snapshot(n, center, meta, worker_snaps):
         seen.append((n, float(center["w"][0]), meta["num_updates"]))
 
     ps.snapshot_every = 10

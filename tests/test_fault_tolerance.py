@@ -258,7 +258,7 @@ def test_snapshot_failure_does_not_crash_committing_worker():
     ps = DeltaParameterServer(PARAMS)
     ps.snapshot_every = 1
 
-    def exploding_snapshot(n, center, meta):
+    def exploding_snapshot(n, center, meta, worker_snaps):
         raise OSError("disk full")
 
     ps.on_snapshot = exploding_snapshot

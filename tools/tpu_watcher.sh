@@ -16,17 +16,22 @@ while true; do
     sh tools/tpu_capture.sh >> "$LOG" 2>&1
     grep '"metric": "mnist_cnn_train' TPU_CAPTURE.log | tail -1 > BENCH_TPU.json
     timeout -k 30 2400 python benchmarks.py --configs 1,2,3 >> "$LOG" 2>&1
-    # Commit only the artifact paths (git add first: several are untracked
-    # on first harvest, and `git commit -- <path>` rejects untracked paths);
-    # retry around a possibly-held index.lock
+    # Commit only the artifact paths that exist (git add/commit are
+    # all-or-nothing on an unmatched pathspec, and a tunnel that dies
+    # mid-sweep leaves later artifacts unwritten — the partial harvest
+    # must still land); git add first since several are untracked on the
+    # first harvest; retry around a possibly-held index.lock
+    ARTIFACTS=""
+    for f in TPU_CAPTURE.log TPU_CAPTURE.log.err BENCH_TPU.json \
+             BENCH_MFU.json BENCHMARKS.json BENCHMARKS.md "$LOG"; do
+      [ -e "$f" ] && ARTIFACTS="$ARTIFACTS $f"
+    done
     for _ in 1 2 3 4 5; do
-      git add -- TPU_CAPTURE.log TPU_CAPTURE.log.err BENCH_TPU.json \
-        BENCH_MFU.json BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1
+      git add -- $ARTIFACTS >> "$LOG" 2>&1
       if git commit -m "Harvest TPU window: capture sweep + TPU benchmark rows
 
 No-Verification-Needed: benchmark artifact capture only" \
-          -- TPU_CAPTURE.log TPU_CAPTURE.log.err BENCH_TPU.json BENCH_MFU.json \
-             BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1; then
+          -- $ARTIFACTS >> "$LOG" 2>&1; then
         break
       fi
       sleep 20
