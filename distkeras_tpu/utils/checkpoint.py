@@ -74,14 +74,25 @@ class Checkpointer:
 
     # -- save / restore ------------------------------------------------------
 
-    def save(self, step: int, trees: dict | None = None, meta: dict | None = None):
+    def save(
+        self,
+        step: int,
+        trees: dict | None = None,
+        meta: dict | None = None,
+        overwrite: bool = False,
+    ):
         """Write checkpoint ``step``. Returns False if it already exists
-        (concurrent committers may race to the same step; first wins)."""
+        (concurrent committers may race to the same step; first wins).
+        ``overwrite=True`` replaces an existing step instead — for the
+        end-of-run save, whose payload supersedes a same-numbered periodic
+        snapshot (fresher worker states, identical center)."""
         step = int(step)
         final = self._step_dir(step)
         with self._lock:
             if os.path.exists(final):
-                return False
+                if not overwrite:
+                    return False
+                shutil.rmtree(final, ignore_errors=True)
             tmp = os.path.join(
                 self.directory, f".tmp_{step}_{os.getpid()}_{threading.get_ident()}"
             )

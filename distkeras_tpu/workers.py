@@ -640,11 +640,14 @@ class AsyncWorker:
             self._snap = local_snap
 
     def _make_snap(self, seq: int) -> dict:
+        # host_copy, NOT np.asarray: asarray may alias device buffers on
+        # CPU, and these trees are the next window call's DONATED inputs —
+        # an aliased long-lived snapshot would be corrupted in place
         return {
-            "params": jax.tree.map(np.asarray, self._params),
-            "state": jax.tree.map(np.asarray, self._state),
-            "opt_state": jax.tree.map(np.asarray, self._opt_state),
-            "rng": np.asarray(self.rng),
+            "params": host_copy(self._params),
+            "state": host_copy(self._state),
+            "opt_state": host_copy(self._opt_state),
+            "rng": host_copy(self.rng),
             "seq": np.int64(seq),
         }
 

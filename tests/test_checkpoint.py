@@ -49,6 +49,22 @@ def test_checkpointer_roundtrip(tmp_path):
     assert meta["epoch"] == 5
 
 
+def test_checkpointer_overwrite_supersedes_same_step(tmp_path):
+    """First-wins by default; overwrite=True replaces the step — the
+    end-of-run save must beat a periodic snapshot that landed on the same
+    commit count with staler worker states."""
+    ck = Checkpointer(str(tmp_path))
+    assert ck.save(8, {"t": {"x": np.zeros(2, np.float32)}}, {"v": 1})
+    assert not ck.save(8, {"t": {"x": np.ones(2, np.float32)}}, {"v": 2})
+    _, trees, meta = ck.restore()
+    assert meta["v"] == 1
+    assert ck.save(8, {"t": {"x": np.ones(2, np.float32)}}, {"v": 2},
+                   overwrite=True)
+    _, trees, meta = ck.restore()
+    assert meta["v"] == 2
+    np.testing.assert_allclose(trees["t"]["x"], 1.0)
+
+
 def test_checkpointer_retention(tmp_path):
     ck = Checkpointer(str(tmp_path), max_to_keep=2)
     for s in (1, 2, 3, 4):

@@ -1,0 +1,140 @@
+"""Real multi-OS-process integration (VERDICT r2 missing #2): the DCN
+topology executes across process boundaries, not just loopback-in-process.
+
+Two separate Python processes are launched through
+``job_deployment.Job.run_local`` (so the DKT_* env plumbing is the thing
+under test), join a real ``jax.distributed`` coordination service on CPU,
+run a cross-process collective, and then exercise the reference's
+driver/worker split (SURVEY §5.8 TPU mapping): rank 0 hosts the
+``SocketParameterServer``, rank 1 trains DOWNPOUR windows against it over
+a real TCP socket via ``RemoteParameterServerClient``.
+"""
+
+import socket
+import textwrap
+from concurrent.futures import ThreadPoolExecutor
+
+from distkeras_tpu.job_deployment import Job
+
+# 256 rows / batch 16 = 16 batches; communication_window 4 -> 4 commits
+_EXPECT_COMMITS = 4
+
+_SCRIPT = textwrap.dedent(
+    """
+    import os, sys, time
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from distkeras_tpu.parallel import multihost
+
+    assert multihost.initialize() is True, "DKT env plumbing failed"
+    assert multihost.num_processes() == 2
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    pid = multihost.process_id()
+    ps_port = int(sys.argv[1])
+
+    # cross-process collective: both ranks see both contributions
+    g = multihost_utils.process_allgather(np.array([float(pid + 1)]))
+    assert sorted(np.asarray(g).reshape(-1).tolist()) == [1.0, 2.0], g
+    print("ALLGATHER_OK", flush=True)
+
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.parameter_servers import (
+        DeltaParameterServer,
+        RemoteParameterServerClient,
+        SocketParameterServer,
+    )
+    from distkeras_tpu.workers import DOWNPOURWorker, WorkerCore
+
+    model = zoo.mnist_mlp(hidden=8)
+    EXPECT = {expect}
+
+    if multihost.is_coordinator():
+        init = [np.copy(x) for x in jax.tree.leaves(model.params)]
+        ps = DeltaParameterServer(model.params)
+        srv = SocketParameterServer(ps, port=ps_port)
+        srv.start()
+        deadline = time.time() + 180
+        while ps.num_updates < EXPECT and time.time() < deadline:
+            time.sleep(0.2)
+        n = ps.num_updates
+        final = jax.tree.leaves(ps.get_params())
+        srv.stop()
+        assert n == EXPECT, "expected {{}} commits, saw {{}}".format(EXPECT, n)
+        assert any(
+            not np.allclose(a, np.asarray(b)) for a, b in zip(init, final)
+        ), "center never moved"
+        print("PS_DONE", n, flush=True)
+    else:
+        from distkeras_tpu.data import loaders
+        from distkeras_tpu.data.transformers import (
+            MinMaxTransformer,
+            OneHotTransformer,
+        )
+
+        ds = loaders.synthetic_mnist(n=256, seed=0)
+        ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+        ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+        client = None
+        for _ in range(300):  # the PS comes up when rank 0 gets there
+            try:
+                client = RemoteParameterServerClient("127.0.0.1", ps_port)
+                break
+            except (ConnectionError, OSError):
+                time.sleep(0.2)
+        assert client is not None, "PS never came up"
+        core = WorkerCore(
+            model, get_optimizer("sgd", 0.05), "categorical_crossentropy"
+        )
+        w = DOWNPOURWorker(core, client, 0, "features", "label_onehot", 4)
+        w.train(ds, batch_size=16, num_epoch=1)
+        client.close()
+        assert w._seq == EXPECT, w._seq
+        print("WORKER_DONE", w._seq, flush=True)
+    print("MARKER_OK", flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_ps_training_over_real_sockets(tmp_path):
+    script = tmp_path / "train2proc.py"
+    script.write_text(_SCRIPT.format(expect=_EXPECT_COMMITS))
+    coord_port, ps_port = _free_port(), _free_port()
+    job = Job(
+        str(script),
+        num_hosts=2,
+        coordinator_address=f"localhost:{coord_port}",
+        script_args=[str(ps_port)],
+    )
+    with ThreadPoolExecutor(2) as ex:
+        futs = [
+            ex.submit(
+                job.run_local,
+                workdir=str(tmp_path / f"rank{i}"),
+                process_id=i,
+                timeout=300,
+            )
+            for i in range(2)
+        ]
+        rank0, rank1 = (f.result(timeout=360) for f in futs)
+
+    assert rank0.returncode == 0, f"rank0:\n{rank0.stdout}\n{rank0.stderr}"
+    assert rank1.returncode == 0, f"rank1:\n{rank1.stdout}\n{rank1.stderr}"
+    for proc in (rank0, rank1):
+        assert "ALLGATHER_OK" in proc.stdout
+        assert "MARKER_OK" in proc.stdout
+    assert f"PS_DONE {_EXPECT_COMMITS}" in rank0.stdout
+    assert f"WORKER_DONE {_EXPECT_COMMITS}" in rank1.stdout
