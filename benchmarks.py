@@ -12,13 +12,16 @@ axes for each:
 Axes: steady-state **samples/sec/chip** (per-worker window timings with each
 worker's first, compile-bearing window dropped) and **epochs-to-target-
 accuracy** (1-epoch rounds until the held-out accuracy crosses the config's
-target). Data is the synthetic stand-in for each dataset (nothing real is on
-disk — BASELINE.md records `published: {}`), so the accuracy axis is
+target). Configs 1-5 run the synthetic stand-ins (BASELINE.md records
+`published: {}` — nothing real was downloadable), so their accuracy axis is
 comparable across rounds of THIS framework, not against upstream numbers.
+Config 6 runs the REAL handwritten-digit set shipped in-repo
+(distkeras_tpu/data/digits.csv via load_csv + the native parser), so its
+accuracy axis is measured against real-world data.
 
 Writes BENCHMARKS.json and BENCHMARKS.md at the repo root:
 
-    python benchmarks.py [--configs 1,2,3,4,5] [--scale smoke|full] [--cpu]
+    python benchmarks.py [--configs 1,2,3,4,5,6] [--scale smoke|full] [--cpu]
 
 Backend selection mirrors bench.py: probe out-of-process, fall back to an
 8-virtual-device CPU mesh when no accelerator answers.
@@ -170,6 +173,13 @@ def build_configs(platform):
         train, test = ds.split(0.9, seed=7)
         return train, test, "label_onehot", []
 
+    def digits_data(scale):
+        ds = loaders.digits()
+        ds = MinMaxTransformer(0, 1, o_min=0, o_max=16).transform(ds)
+        ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+        train, test = ds.split(0.85, seed=7)
+        return train, test, "label_onehot", []
+
     def imagenet_data(scale):
         from distkeras_tpu import LabelIndexTransformer
 
@@ -289,12 +299,31 @@ def build_configs(platform):
             "target": {"smoke": 0.50, "full": 0.70},
             "max_epochs": {"smoke": 8, "full": 8},
         },
+        {
+            "id": 6,
+            "name": "SingleTrainer / REAL digits (in-repo CSV)",
+            "trainer_name": "SingleTrainer",
+            "model_name": "digits_mlp",
+            # REAL data (VERDICT r2 missing #1): 1,797 8x8 handwritten
+            # digits shipped in-repo, parsed through load_csv + the native
+            # C++ reader — the one matrix row whose accuracy axis is
+            # measured against data the builder did not design. Same rows
+            # at both scales (the set is what it is).
+            "data": digits_data,
+            "model": lambda scale: zoo.digits_mlp(seed=0),
+            "trainer": lambda m, scale, lc: SingleTrainer(
+                m, "adam", learning_rate=1e-3, batch_size=32,
+                num_epoch=1, label_col=lc, **common,
+            ),
+            "target": {"smoke": 0.93, "full": 0.95},
+            "max_epochs": {"smoke": 15, "full": 30},
+        },
     ]
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--configs", default="1,2,3,4,5,6")
     ap.add_argument("--scale", choices=["smoke", "full"], default="smoke")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--out", default=".")
@@ -331,14 +360,15 @@ def main():
 
 
 def config_stamp() -> str:
-    """Fingerprint of what defines the five configurations: the source of
-    ``build_configs`` (trainer classes, lrs, batch sizes, targets) plus the
-    synthetic-loader and model-zoo sources. Rows carry the stamp so a
-    partial rerun after a calibration change (lr, class counts,
-    bn_momentum, ...) cannot silently merge with rows measured under the
-    old definitions (ADVICE r2 #2). Deliberately NOT a hash of this whole
-    file: a reporting/harness edit must not invalidate measured TPU rows
-    that a CPU box cannot re-produce. Memoized: the stamp cannot change
+    """Fingerprint of what defines the six configurations: the source of
+    ``build_configs`` (trainer classes, lrs, batch sizes, targets) plus
+    the SPECIFIC loader and model-zoo functions the configs call. Rows
+    carry the stamp so a partial rerun after a calibration change (lr,
+    class counts, bn_momentum, ...) cannot silently merge with rows
+    measured under the old definitions (ADVICE r2 #2). Deliberately
+    function-scoped, not whole-file: a reporting/harness edit — or ADDING
+    an unrelated loader/model — must not invalidate measured TPU rows that
+    a CPU box cannot re-produce. Memoized: the stamp cannot change
     mid-run, and write_outputs runs once per config."""
     import hashlib
     import inspect
@@ -346,17 +376,29 @@ def config_stamp() -> str:
     if _CONFIG_STAMP:
         return _CONFIG_STAMP[0]
 
+    from distkeras_tpu.data import loaders
+    from distkeras_tpu.models import zoo
+
     h = hashlib.sha256(inspect.getsource(build_configs).encode())
-    base = os.path.dirname(os.path.abspath(__file__))
-    for rel in (
-        os.path.join("distkeras_tpu", "data", "loaders.py"),
-        os.path.join("distkeras_tpu", "models", "zoo.py"),
+    for fn in (
+        loaders._prototype_classification,
+        loaders._spatial_prototype_classification,
+        loaders._coarse_grid,
+        loaders.synthetic_mnist,
+        loaders.synthetic_higgs,
+        loaders.synthetic_cifar10,
+        loaders.synthetic_imagenet,
+        loaders.digits,
+        loaders.load_csv,
+        zoo.mnist_mlp,
+        zoo.mnist_cnn,
+        zoo.higgs_mlp,
+        zoo.cifar10_cnn,
+        zoo._basic_block,
+        zoo.resnet18,
+        zoo.digits_mlp,
     ):
-        try:
-            with open(os.path.join(base, rel), "rb") as f:
-                h.update(f.read())
-        except OSError:
-            h.update(rel.encode())
+        h.update(inspect.getsource(fn).encode())
     _CONFIG_STAMP.append(h.hexdigest()[:12])
     return _CONFIG_STAMP[0]
 

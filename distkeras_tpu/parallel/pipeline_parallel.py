@@ -91,12 +91,13 @@ def _pipeline_local(stage_params, x_micro, block_apply, axis_name, axis_size,
         h_next = jax.lax.ppermute(h_next, axis_name, perm)
         return (h_next, out), None
 
-    # adding 0*stage marks the carries as varying over the pipe axis (their
-    # updated values depend on axis_index, and scan requires carry-in/out
-    # types — including manual-axis variance — to match)
-    vary = (stage * 0).astype(x_micro.dtype)
+    # adding 0*stage marks the carries as varying over the pipe axis, and
+    # 0*x_micro[0] picks up whatever OTHER manual axes the input varies
+    # over (the "data" axis under 2-D pipeline x data sharding) — scan
+    # requires carry-in/out types, including manual-axis variance, to match
+    vary = (stage * 0).astype(x_micro.dtype) + x_micro[0] * 0
     h0 = jnp.zeros(mb_shape, x_micro.dtype) + vary
-    out0 = jnp.zeros((num_micro, *mb_shape), x_micro.dtype) + vary
+    out0 = jnp.zeros((num_micro, *mb_shape), x_micro.dtype) + vary[None]
     (_, out), _ = jax.lax.scan(tick, (h0, out0), jnp.arange(ticks))
     # only the last stage holds real outputs; psum over the axis recovers
     # them replicated (other stages contribute zeros)
@@ -105,7 +106,8 @@ def _pipeline_local(stage_params, x_micro, block_apply, axis_name, axis_size,
 
 
 def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
-                   axis_name: str = "pipe", num_micro: int | None = None):
+                   axis_name: str = "pipe", num_micro: int | None = None,
+                   batch_axis: str | None = None):
     """Run ``x`` through the stacked block tower, pipelined over the mesh.
 
     stacked_params: pytree with leading block axis ``depth`` (depth must be
@@ -114,6 +116,11 @@ def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
     block_apply: ``block_apply(one_block_params, h) -> h`` pure function.
     Returns (batch, ...) with the same values as applying the blocks
     sequentially (GPipe is an execution schedule, not an approximation).
+
+    ``batch_axis``: optional mesh axis the MICROBATCH dim is sharded over
+    (2-D pipeline x data parallelism): each data slice runs its own GPipe
+    ring over ``axis_name`` on its batch shard — the schedule body is
+    unchanged; only the specs keep the shards in place.
     """
     axis_size = mesh.shape[axis_name]
     depth = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -129,10 +136,17 @@ def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
             f"batch {batch} not divisible by num_micro={num_micro}"
         )
     mb = batch // num_micro
+    if batch_axis is not None and mb % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"microbatch {mb} not divisible by mesh axis "
+            f"{batch_axis}={mesh.shape[batch_axis]}"
+        )
     x_micro = x.reshape(num_micro, mb, *x.shape[1:])
 
-    # params: leading block axis sharded over "pipe"; input replicated
+    # params: leading block axis sharded over "pipe" (replicated over any
+    # data axis); input microbatches shard over batch_axis when given
     param_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    x_spec = P(None, batch_axis)
     fn = jax.shard_map(
         functools.partial(
             _pipeline_local,
@@ -142,8 +156,8 @@ def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
             num_micro=num_micro,
         ),
         mesh=mesh,
-        in_specs=(param_spec, P()),
-        out_specs=P(),
+        in_specs=(param_spec, x_spec),
+        out_specs=x_spec,
     )
     out = fn(stacked_params, x_micro)
     return out.reshape(batch, *out.shape[2:])

@@ -96,11 +96,19 @@ def _ring_attention_local(q, k, v, axis_name, axis_size, scale, causal):
     return _finalize(acc, l, q.dtype)
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False):
+def ring_attention(
+    q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False, batch_axis=None
+):
     """Multi-head attention with the sequence axis sharded over ``axis_name``.
 
     q, k, v: (batch, seq, heads, head_dim), seq divisible by the axis size.
     Returns (batch, seq, heads, head_dim) with the same sharding.
+
+    ``batch_axis``: optional mesh axis the BATCH dim is sharded over (2-D
+    data x sequence parallelism). Attention is independent per batch
+    element, so the ring body is unchanged — each data slice runs its own
+    ring over ``axis_name``; the spec just keeps the batch shards in place
+    instead of forcing an all-gather.
     """
     axis_size = mesh.shape[axis_name]
     if q.shape[1] % axis_size:
@@ -109,7 +117,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False):
             f"{axis_name}={axis_size}"
         )
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(
             _ring_attention_local,
@@ -192,7 +200,9 @@ def attach_blockwise_attention(model, block_size=512) -> int:
     return n
 
 
-def attach_ring_attention(model, mesh: Mesh, axis_name: str = "seq") -> int:
+def attach_ring_attention(
+    model, mesh: Mesh, axis_name: str = "seq", batch_axis=None
+) -> int:
     """Walk a model's layers and point every MultiHeadSelfAttention at the
     ring implementation over ``mesh``. Returns how many were attached.
     (Process-local: hooks close over the live mesh and are not serialized —
@@ -202,7 +212,9 @@ def attach_ring_attention(model, mesh: Mesh, axis_name: str = "seq") -> int:
     from distkeras_tpu.models.layers import MultiHeadSelfAttention
     from distkeras_tpu.models.sequential import walk_layers
 
-    fn = functools.partial(ring_attention, mesh=mesh, axis_name=axis_name)
+    fn = functools.partial(
+        ring_attention, mesh=mesh, axis_name=axis_name, batch_axis=batch_axis
+    )
     count = 0
     for layer in walk_layers(model):
         if isinstance(layer, MultiHeadSelfAttention):

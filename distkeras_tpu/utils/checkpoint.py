@@ -89,21 +89,25 @@ class Checkpointer:
         step = int(step)
         final = self._step_dir(step)
         with self._lock:
-            if os.path.exists(final):
-                if not overwrite:
-                    return False
-                shutil.rmtree(final, ignore_errors=True)
+            if os.path.exists(final) and not overwrite:
+                return False
             tmp = os.path.join(
                 self.directory, f".tmp_{step}_{os.getpid()}_{threading.get_ident()}"
             )
             os.makedirs(tmp, exist_ok=True)
             try:
+                # serialize FULLY into tmp before touching an existing
+                # checkpoint: a failure here must never destroy a prior
+                # valid step (the old dir is removed only once the
+                # replacement is completely on disk)
                 for name, tree in (trees or {}).items():
                     host = jax.tree.map(np.asarray, tree)
                     with open(os.path.join(tmp, f"{name}.tree"), "wb") as f:
                         f.write(serialize_params(host))
                 with open(os.path.join(tmp, "meta.json"), "w") as f:
                     json.dump(meta or {}, f)
+                if os.path.exists(final):
+                    shutil.rmtree(final, ignore_errors=True)
                 os.replace(tmp, final)
             finally:
                 if os.path.isdir(tmp):

@@ -15,7 +15,7 @@ while true; do
     echo "$(date -u +%FT%TZ) tunnel HEALTHY - starting capture" >> "$LOG"
     sh tools/tpu_capture.sh >> "$LOG" 2>&1
     grep '"metric": "mnist_cnn_train' TPU_CAPTURE.log | tail -1 > BENCH_TPU.json
-    timeout -k 30 2400 python benchmarks.py --configs 1,2,3 >> "$LOG" 2>&1
+    timeout -k 30 2400 python benchmarks.py --configs 1,2,3,6 >> "$LOG" 2>&1
     # Commit only the artifact paths that exist (git add/commit are
     # all-or-nothing on an unmatched pathspec, and a tunnel that dies
     # mid-sweep leaves later artifacts unwritten — the partial harvest
