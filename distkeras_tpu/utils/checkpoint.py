@@ -107,6 +107,13 @@ class Checkpointer:
                 with open(os.path.join(tmp, "meta.json"), "w") as f:
                     json.dump(meta or {}, f)
                 if os.path.exists(final):
+                    if not overwrite:
+                        # cross-process race (the threading lock is
+                        # per-process): another committer won — keep
+                        # first-wins instead of clobbering its checkpoint
+                        return False
+                    # overwrite is the end-of-run single-writer path; the
+                    # replacement is already fully serialized in tmp
                     shutil.rmtree(final, ignore_errors=True)
                 os.replace(tmp, final)
             finally:

@@ -1,0 +1,110 @@
+"""FlashAttention Pallas kernels (ops/flash_attention) vs the XLA dense
+path — values AND gradients, causal and bidirectional (VERDICT r2 task 6:
+the fused single-chip attention tier). CPU runs the kernels in interpreter
+mode; the math is identical on TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.flash_attention import (
+    attach_flash_attention,
+    flash_attention,
+)
+from distkeras_tpu.parallel.ring_attention import dense_attention
+
+
+def qkv(b=2, t=128, h=2, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal((b, t, h, d)).astype(np.float32))
+        for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense_values(causal):
+    q, k, v = qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense_gradients(causal):
+    """The custom VJP (dq/dkv kernels, FlashAttention-2 split) must agree
+    with XLA's autodiff through the dense path for all three inputs."""
+    q, k, v = qkv(b=1, t=64, h=2, d=16)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=causal) ** 2)
+
+    flash = lambda q, k, v, causal: flash_attention(  # noqa: E731
+        q, k, v, causal=causal, block_q=32, block_k=32
+    )
+    gf = jax.grad(lambda *a: loss(flash, *a), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: loss(dense_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-4, rtol=1e-3
+        )
+
+
+def test_flash_uneven_seq_falls_back_to_dense():
+    """T that does not tile must still compute correctly (dense fallback),
+    never crash or pad silently."""
+    q, k, v = qkv(t=96)  # 96 % 64 != 0
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_rejects_cross_attention():
+    q, k, v = qkv()
+    with pytest.raises(ValueError, match="self-attention only"):
+        flash_attention(q, k[:, :64], v)
+
+
+def test_flash_block_larger_than_seq_clamps():
+    """Default 128-blocks on a 64-token sequence must clamp, not fail."""
+    q, k, v = qkv(t=64)
+    out = flash_attention(q, k, v, causal=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_attach_flash_trains_transformer():
+    """The hook face: a transformer classifier trains end-to-end with the
+    fused kernels in the training graph (fwd + custom VJP under jit/scan),
+    matching the dense-trained weights."""
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.data import loaders
+    from distkeras_tpu.data.transformers import OneHotTransformer
+    from distkeras_tpu.models import zoo
+
+    ds = loaders.synthetic_sequences(n=256, seq_len=64, vocab=16, seed=0)
+    ds = OneHotTransformer(2, output_col="label_onehot").transform(ds)
+
+    def make_model():
+        return zoo.transformer_classifier(
+            vocab_size=16, seq_len=64, d_model=32, num_heads=2, depth=1,
+            seed=0,
+        )
+
+    kw = dict(
+        loss="categorical_crossentropy",
+        batch_size=32,
+        num_epoch=1,
+        label_col="label_onehot",
+        seed=0,
+    )
+    m_dense = SingleTrainer(make_model(), "adam", **kw).train(ds)
+
+    model = make_model()
+    assert attach_flash_attention(model, block_q=32, block_k=32) == 1
+    m_flash = SingleTrainer(model, "adam", **kw).train(ds)
+    for a, b in zip(m_dense.get_weights(), m_flash.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
