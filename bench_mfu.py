@@ -5,16 +5,22 @@ FLOPs can't fill a systolic array, so its MFU says nothing about the
 framework's ceiling. This harness measures the framework on an MXU-shaped
 workload: a transformer classifier (d_model 512, depth 8, seq 512) trained
 through the same ``WorkerCore.indexed_window`` device-resident path, bf16
-compute, window-scanned. FLOPs come from XLA's cost model on the exact
-compiled program; peak is the device generation's published bf16 number
-(bench.py's table).
+compute, window-scanned. MFU and tflops_per_sec come from the ANALYTIC
+model-flops count (24*T*d^2 + 4*T^2*d per layer forward, x3 for the train
+step) — the conventional definition, and the only one comparable across
+attention paths, since XLA's cost model cannot see inside Pallas custom
+calls; the cost-model number is reported alongside as
+``xla_cost_tflops_per_sec`` for the dense-path cross-check. Peak is the
+device generation's published bf16 number (bench.py's table).
 
 Writes BENCH_MFU.json and prints one JSON line:
     {"metric": "transformer_train_mfu", "value": ..., "unit": "fraction",
-     "samples_per_sec": ..., "tflops_per_sec": ..., "platform": ...}
+     "attention": "flash"|"dense", "samples_per_sec": ...,
+     "tflops_per_sec": ..., "xla_cost_tflops_per_sec": ..., ...}
 
-Usage: python bench_mfu.py [--cpu]  (CPU fallback scales shapes down and
-reports model_flops_per_sec with mfu=null — no published CPU peak.)
+Usage: python bench_mfu.py [--cpu] [--attention auto|flash|dense]
+(CPU fallback scales shapes down and reports tflops with mfu=null — no
+published CPU peak; auto runs flash only on TPU.)
 """
 
 from __future__ import annotations

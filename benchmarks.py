@@ -359,61 +359,59 @@ def main():
         print(f"no configs matched {sorted(want)}; nothing written")
 
 
-def config_stamp() -> str:
-    """Fingerprint of what defines the six configurations: the source of
-    ``build_configs`` (trainer classes, lrs, batch sizes, targets) plus
-    the SPECIFIC loader and model-zoo functions the configs call. Rows
-    carry the stamp so a partial rerun after a calibration change (lr,
-    class counts, bn_momentum, ...) cannot silently merge with rows
-    measured under the old definitions (ADVICE r2 #2). Deliberately
-    function-scoped, not whole-file: a reporting/harness edit — or ADDING
-    an unrelated loader/model — must not invalidate measured TPU rows that
-    a CPU box cannot re-produce. Memoized: the stamp cannot change
-    mid-run, and write_outputs runs once per config."""
+def config_stamp(cfg_id: int) -> str:
+    """PER-CONFIG calibration fingerprint: the source of ``build_configs``
+    (trainer classes, lrs, batch sizes, targets) plus the specific loader
+    and model-zoo functions THAT config calls (and, for the real-data
+    config, the shipped csv bytes). Rows carry their config's stamp so a
+    partial rerun after a calibration change cannot silently merge with
+    rows measured under the old definitions (ADVICE r2 #2) — while edits
+    scoped to one config (regenerating digits.csv, retuning one model)
+    invalidate only that config's rows, never TPU measurements of the
+    others that a CPU box cannot re-produce. Memoized: stamps cannot
+    change mid-run."""
     import hashlib
     import inspect
 
-    if _CONFIG_STAMP:
-        return _CONFIG_STAMP[0]
+    if not _CONFIG_STAMPS:
+        from distkeras_tpu.data import loaders
+        from distkeras_tpu.models import zoo
 
-    from distkeras_tpu.data import loaders
-    from distkeras_tpu.models import zoo
-
-    h = hashlib.sha256(inspect.getsource(build_configs).encode())
-    for fn in (
-        loaders._prototype_classification,
-        loaders._spatial_prototype_classification,
-        loaders._coarse_grid,
-        loaders.synthetic_mnist,
-        loaders.synthetic_higgs,
-        loaders.synthetic_cifar10,
-        loaders.synthetic_imagenet,
-        loaders.digits,
-        loaders.load_csv,
-        zoo.mnist_mlp,
-        zoo.mnist_cnn,
-        zoo.higgs_mlp,
-        zoo.cifar10_cnn,
-        zoo._basic_block,
-        zoo.resnet18,
-        zoo.digits_mlp,
-    ):
-        h.update(inspect.getsource(fn).encode())
-    # config 6's accuracy axis is DEFINED by the shipped real dataset, not
-    # just the loader code — hash the csv bytes too
-    digits_csv = os.path.join(
-        os.path.dirname(os.path.abspath(loaders.__file__)), "digits.csv"
-    )
-    try:
-        with open(digits_csv, "rb") as f:
-            h.update(f.read())
-    except OSError:
-        h.update(b"digits.csv-missing")
-    _CONFIG_STAMP.append(h.hexdigest()[:12])
-    return _CONFIG_STAMP[0]
+        synth = (
+            loaders._prototype_classification,
+            loaders._spatial_prototype_classification,
+            loaders._coarse_grid,
+        )
+        sources = {
+            1: synth + (loaders.synthetic_mnist, zoo.mnist_mlp),
+            2: synth + (loaders.synthetic_mnist, zoo.mnist_cnn),
+            3: synth + (loaders.synthetic_higgs, zoo.higgs_mlp),
+            4: synth + (loaders.synthetic_cifar10, zoo.cifar10_cnn),
+            5: synth
+            + (loaders.synthetic_imagenet, zoo._basic_block, zoo.resnet18),
+            6: (loaders.digits, loaders.load_csv, zoo.digits_mlp),
+        }
+        digits_csv = os.path.join(
+            os.path.dirname(os.path.abspath(loaders.__file__)), "digits.csv"
+        )
+        for cid, fns in sources.items():
+            h = hashlib.sha256(inspect.getsource(build_configs).encode())
+            for fn in fns:
+                h.update(inspect.getsource(fn).encode())
+            if cid == 6:
+                # the real config's accuracy axis is DEFINED by the
+                # shipped dataset, not just the loader code
+                try:
+                    with open(digits_csv, "rb") as f:
+                        h.update(f.read())
+                except OSError:
+                    h.update(b"digits.csv-missing")
+            _CONFIG_STAMPS[cid] = h.hexdigest()[:12]
+    # unknown config id (older/newer file formats): never matches
+    return _CONFIG_STAMPS.get(int(cfg_id), "unknown-config")
 
 
-_CONFIG_STAMP = []
+_CONFIG_STAMPS = {}
 
 
 def _merge_rows(fresh_rows, prior_rows):
@@ -440,45 +438,59 @@ def write_outputs(rows, platform, device_kind, scale, out):
     (platform, scale) — a TPU harvest lands NEXT TO the CPU regression rows
     instead of clobbering them (VERDICT r2 task 8: both columns in the
     matrix). Within a section, a partial rerun (--configs 2) refreshes its
-    rows without clobbering the others; a calibration change (config_stamp
-    mismatch, ADVICE r2 #2) invalidates every prior section."""
-    stamp = config_stamp()
+    rows without clobbering the others; a calibration change invalidates
+    exactly the affected config's prior rows (per-row config stamps,
+    ADVICE r2 #2)."""
+    for r in rows:
+        r.setdefault("stamp", config_stamp(r["config"]))
     path = os.path.join(out, "BENCHMARKS.json")
     runs = []
     if os.path.exists(path):
         try:
             with open(path) as f:
                 prior = json.load(f)
-            if prior.get("config_stamp") != stamp:
-                # a stampless (pre-stamp) prior is just as untrustworthy as
-                # a mismatched one: drop it rather than relabel its rows
-                print(
-                    f"prior BENCHMARKS.json stamp {prior.get('config_stamp')}"
-                    f" != current {stamp}; dropping stale rows"
-                )
+            if "runs" in prior:
+                cand = list(prior["runs"])
+            elif "results" in prior:  # one-run layout, the stamp's debut
+                cand = [prior]
             else:
-                if "runs" in prior:
-                    cand = list(prior["runs"])
-                elif "results" in prior:  # one-run layout, the stamp's debut
-                    cand = [prior]
-                else:
-                    cand = []
-                # keep only well-formed sections: a malformed entry must
-                # degrade to "overwrite", not crash the benchmark run
-                runs = [
-                    {
-                        "platform": r["platform"],
-                        "device_kind": r["device_kind"],
-                        "scale": r["scale"],
-                        "results": list(r["results"]),
-                    }
-                    for r in cand
-                    if isinstance(r, dict)
+                cand = []
+            # keep only well-formed sections (a malformed entry must
+            # degrade to "overwrite", not crash the benchmark run), and
+            # within each, only rows whose per-config stamp still matches
+            # the current calibration — stampless or mismatched rows are
+            # untrustworthy and drop; rows of OTHER configs survive
+            dropped = 0
+            for sec in cand:
+                if not (
+                    isinstance(sec, dict)
                     and all(
-                        k in r
+                        k in sec
                         for k in ("platform", "device_kind", "scale", "results")
                     )
+                ):
+                    continue
+                kept = [
+                    r
+                    for r in sec["results"]
+                    if isinstance(r, dict)
+                    and r.get("stamp") == config_stamp(r.get("config", -1))
                 ]
+                dropped += len(sec["results"]) - len(kept)
+                if kept:
+                    runs.append(
+                        {
+                            "platform": sec["platform"],
+                            "device_kind": sec["device_kind"],
+                            "scale": sec["scale"],
+                            "results": kept,
+                        }
+                    )
+            if dropped:
+                print(
+                    f"dropped {dropped} prior BENCHMARKS row(s) whose "
+                    "config stamp no longer matches the current calibration"
+                )
         except (json.JSONDecodeError, KeyError, TypeError, AttributeError):
             pass  # unreadable prior file: overwrite it
     mine = {
@@ -504,16 +516,18 @@ def write_outputs(rows, platform, device_kind, scale, out):
 
     os.makedirs(out, exist_ok=True)
     with open(os.path.join(out, "BENCHMARKS.json"), "w") as f:
-        json.dump({"config_stamp": stamp, "runs": runs}, f, indent=2)
+        json.dump({"runs": runs}, f, indent=2)
 
     lines = [
         "# BASELINE benchmark matrix",
         "",
-        "Synthetic stand-in datasets (BASELINE.md: `published: {}` — no "
-        "upstream numbers exist); both BASELINE metric axes per config. "
+        "Configs 1-5 run synthetic stand-ins (BASELINE.md: `published: {}`"
+        " — no upstream numbers exist); config 6 runs the REAL in-repo "
+        "digits CSV. Both BASELINE metric axes per config. "
         "samples/sec/chip is steady-state (compile window excluded). "
-        f"Config stamp `{stamp}` (sections from older calibrations are "
-        "dropped automatically). Reproduce: `python benchmarks.py`.",
+        "Rows carry per-config calibration stamps; rows from older "
+        "calibrations are dropped automatically. "
+        "Reproduce: `python benchmarks.py`.",
     ]
     for run in runs:
         lines += [

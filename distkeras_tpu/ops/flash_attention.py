@@ -34,6 +34,8 @@ from jax.experimental import pallas as pl
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+# full K+V per (batch, head) program must fit comfortably in ~16 MB VMEM
+_VMEM_KV_BUDGET_BYTES = 8 * 1024 * 1024
 
 
 def _on_tpu() -> bool:
@@ -285,14 +287,22 @@ def flash_attention(
     path rather than padding — the transformer zoo's lengths are powers of
     two, and correctness must not depend on the fast path.
     """
-    from distkeras_tpu.parallel.ring_attention import dense_attention
+    from distkeras_tpu.parallel.ring_attention import (
+        blockwise_attention,
+        dense_attention,
+    )
 
     if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
         raise ValueError(
             "flash_attention is self-attention only: expected k/v seq "
             f"length {q.shape[1]} (q's), got k={k.shape[1]}, v={v.shape[1]}"
         )
-    t = q.shape[1]
+    t, d = q.shape[1], q.shape[3]
+    # each program holds the full K+V (f32) in VMEM; past ~8 MB of the
+    # ~16 MB/core the Mosaic lowering fails, so long contexts take the
+    # lax.scan blockwise path (same online softmax, HBM-streamed) instead
+    if 2 * t * d * 4 > _VMEM_KV_BUDGET_BYTES:
+        return blockwise_attention(q, k, v, causal=causal)
     bq = min(block_q, t)
     bk = min(block_k, t)
     if t % bq or t % bk:

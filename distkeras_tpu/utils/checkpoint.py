@@ -115,7 +115,16 @@ class Checkpointer:
                     # overwrite is the end-of-run single-writer path; the
                     # replacement is already fully serialized in tmp
                     shutil.rmtree(final, ignore_errors=True)
-                os.replace(tmp, final)
+                try:
+                    os.replace(tmp, final)
+                except OSError:
+                    if overwrite:
+                        raise
+                    # exists() -> replace() is not atomic: a concurrent
+                    # committer can publish in between, and replace onto a
+                    # non-empty dir raises — that is the same first-wins
+                    # outcome, reported the same way
+                    return False
             finally:
                 if os.path.isdir(tmp):
                     shutil.rmtree(tmp, ignore_errors=True)

@@ -62,6 +62,19 @@ def test_flash_uneven_seq_falls_back_to_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_long_context_falls_back_to_blockwise(monkeypatch):
+    """Sequences whose full K/V would overflow VMEM must route to the
+    lax.scan blockwise path (same math, HBM-streamed), not crash in the
+    Mosaic lowering."""
+    import distkeras_tpu.ops.flash_attention as fa
+
+    monkeypatch.setattr(fa, "_VMEM_KV_BUDGET_BYTES", 1024)
+    q, k, v = qkv(t=128)
+    out = fa.flash_attention(q, k, v, causal=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_flash_rejects_cross_attention():
     q, k, v = qkv()
     with pytest.raises(ValueError, match="self-attention only"):
