@@ -2,7 +2,11 @@
 ModelPredictor.predict appends a prediction column via mapPartitions).
 
 Here prediction is a jit-compiled batched forward pass; the ragged final
-batch is padded to the batch size so XLA sees one static shape (one compile).
+batch is padded to the batch size so XLA sees one static shape (one
+compile). ``data_parallel=True`` is the TPU face of the reference's
+all-executors mapPartitions inference: params replicate over a
+``Mesh(("data",))`` and each batch shards across the chips — GSPMD runs
+the same compiled forward on every device's shard.
 """
 
 from __future__ import annotations
@@ -25,11 +29,47 @@ class ModelPredictor(Predictor):
         features_col="features",
         output_col="prediction",
         batch_size=1024,
+        data_parallel=False,
+        num_workers=None,
+        mesh=None,
     ):
+        """``data_parallel``: shard each inference batch across the local
+        devices (or an explicit ``mesh`` with a "data" axis; ``num_workers``
+        limits the device count). ``batch_size`` rounds up to a multiple of
+        the mesh size so every shard is equal (the pad rows are sliced off
+        the output, same as the ragged-tail pad)."""
         self.model = model
         self.features_col = features_col
         self.output_col = output_col
         self.batch_size = int(batch_size)
+        self._in_sh = None
+        if data_parallel or mesh is not None:
+            from distkeras_tpu.parallel.mesh import (
+                batch_sharding,
+                local_devices,
+                make_mesh,
+                replicated_sharding,
+            )
+
+            if mesh is None:
+                mesh = make_mesh(axis_names=("data",),
+                                 devices=local_devices(num_workers))
+            else:
+                if "data" not in mesh.axis_names:
+                    raise ValueError(
+                        f"mesh {dict(mesh.shape)} has no 'data' axis"
+                    )
+                if num_workers is not None:
+                    raise ValueError(
+                        "num_workers conflicts with an explicit mesh — size "
+                        "the mesh itself"
+                    )
+            n_dev = int(mesh.shape["data"])
+            self.batch_size = -(-self.batch_size // n_dev) * n_dev
+            self._in_sh = batch_sharding(mesh)
+            self._param_sh = replicated_sharding(mesh)
+        elif num_workers is not None:
+            raise ValueError("num_workers requires data_parallel=True")
         self._fn = jax.jit(
             lambda p, s, x: self.model.apply(p, s, x, train=False)[0]
         )
@@ -37,12 +77,18 @@ class ModelPredictor(Predictor):
     def predict(self, ds: Dataset) -> Dataset:
         x = ds[self.features_col]
         n = len(x)
+        params, state = self.model.params, self.model.state
+        if self._in_sh is not None:
+            params = jax.device_put(params, self._param_sh)
+            state = jax.device_put(state, self._param_sh)
         outs = []
         for i in range(0, n, self.batch_size):
             chunk = x[i : i + self.batch_size]
             pad = self.batch_size - len(chunk)
             if pad:
                 chunk = np.concatenate([chunk, np.zeros((pad, *chunk.shape[1:]), chunk.dtype)])
-            y = np.asarray(self._fn(self.model.params, self.model.state, chunk))
+            if self._in_sh is not None:
+                chunk = jax.device_put(chunk, self._in_sh)
+            y = np.asarray(self._fn(params, state, chunk))
             outs.append(y[: self.batch_size - pad] if pad else y)
         return ds.with_column(self.output_col, np.concatenate(outs, axis=0))
