@@ -62,6 +62,36 @@ def test_flash_uneven_seq_falls_back_to_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_bf16_matches_dense_and_keeps_dtype():
+    """bf16 is the TPU compute dtype (bench_mfu runs flash under it):
+    kernels accumulate f32 internally, outputs and grads come back bf16
+    and finite, values track the dense path at bf16 tolerance."""
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(
+            rng.standard_normal((2, 128, 2, 32)).astype(np.float32),
+            dtype=jnp.bfloat16,
+        )
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = dense_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
+    )
+    g = jax.grad(
+        lambda q: jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64
+            ).astype(jnp.float32)
+            ** 2
+        )
+    )(q)
+    assert g.dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
 def test_flash_long_context_falls_back_to_blockwise(monkeypatch):
     """Sequences whose full K/V would overflow VMEM must route to the
     lax.scan blockwise path (same math, HBM-streamed), not crash in the
