@@ -183,14 +183,30 @@ def detach_expert_mesh(model) -> int:
 
 
 def shard_moe_params(params, mesh: Mesh, axis_name: str = "expert"):
-    """Place a built model's params with every MoE expert stack (leading-E
-    arrays under keys wi/wo) sharded over ``axis_name``; everything else
-    replicated."""
+    """Place a built model's params with every MoE expert stack sharded
+    over ``axis_name``; everything else replicated.
+
+    An expert stack is identified STRUCTURALLY — a ``wi``/``wo`` leaf whose
+    parent dict is an MoE param group ({"router", "wi", "wo"}, the layout
+    ``MoE.init`` emits) — not by leaf name alone: other layers also name
+    weights ``wo`` (TransformerBlock's attention output projection), and
+    sharding those over the expert axis would be wrong."""
     repl = NamedSharding(mesh, P())
     exp = NamedSharding(mesh, P(axis_name))
 
-    def place(path, leaf):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        return jax.device_put(leaf, exp if name in ("wi", "wo") else repl)
+    def is_moe_group(node):
+        return isinstance(node, dict) and {"router", "wi", "wo"} <= set(node)
 
-    return jax.tree_util.tree_map_with_path(place, params)
+    def place_tree(node):
+        if is_moe_group(node):
+            return {
+                k: jax.device_put(v, exp if k in ("wi", "wo") else repl)
+                for k, v in node.items()
+            }
+        if isinstance(node, dict):
+            return {k: place_tree(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(place_tree(v) for v in node)
+        return jax.device_put(node, repl)
+
+    return place_tree(params)

@@ -423,6 +423,7 @@ class SynchronousDistributedTrainer(Trainer):
         window=8,
         mesh=None,
         model_parallel=None,
+        expert_parallel=None,
         prefetch=2,
         device_resident=False,
         checkpoint_dir=None,
@@ -435,35 +436,52 @@ class SynchronousDistributedTrainer(Trainer):
         # "data" (gradient psum), Dense/conv output dims shard over "model"
         # (GSPMD inserts the activation collectives). SURVEY §3.3: TP is
         # absent upstream; this is the TPU stretch capability.
+        # expert_parallel=k: 2-D ("data", "expert") mesh — MoE expert
+        # stacks shard over "expert" (GSPMD inserts the token<->expert
+        # all-to-all), everything else replicates; batches shard over
+        # "data" as usual.
         self.model_parallel = int(model_parallel) if model_parallel else None
+        self.expert_parallel = int(expert_parallel) if expert_parallel else None
+        if self.model_parallel and self.expert_parallel:
+            raise ValueError(
+                "model_parallel and expert_parallel cannot combine on this "
+                "trainer (their parameter sharding rules conflict); pick one"
+            )
+        sharded_axis = (
+            ("model", self.model_parallel)
+            if self.model_parallel
+            else ("expert", self.expert_parallel)
+            if self.expert_parallel
+            else None
+        )
         if mesh is not None:
-            if self.model_parallel and (
-                mesh.shape.get("model") != self.model_parallel
-            ):
+            if sharded_axis and mesh.shape.get(sharded_axis[0]) != sharded_axis[1]:
                 raise ValueError(
-                    f"mesh {dict(mesh.shape)} does not have a 'model' axis "
-                    f"of size model_parallel={self.model_parallel}"
+                    f"mesh {dict(mesh.shape)} does not have a "
+                    f"'{sharded_axis[0]}' axis of size {sharded_axis[1]}"
                 )
             self.mesh = mesh
-        elif self.model_parallel:
-            from distkeras_tpu.parallel.tensor_parallel import make_dp_tp_mesh
-
+        elif sharded_axis:
+            axis_name, k = sharded_axis
             n_dev = len(local_devices())
             if num_workers:
                 dp = int(num_workers)
             else:
-                dp, rem = divmod(n_dev, self.model_parallel)
+                dp, rem = divmod(n_dev, k)
                 if rem:
                     raise ValueError(
-                        f"model_parallel={self.model_parallel} does not "
-                        f"divide the {n_dev} available devices"
+                        f"{axis_name}_parallel={k} does not divide the "
+                        f"{n_dev} available devices"
                     )
-            if dp < 1 or dp * self.model_parallel > n_dev:
+            if dp < 1 or dp * k > n_dev:
                 raise ValueError(
-                    f"need {max(dp, 1) * self.model_parallel} devices for "
-                    f"data={dp} x model={self.model_parallel}, have {n_dev}"
+                    f"need {max(dp, 1) * k} devices for "
+                    f"data={dp} x {axis_name}={k}, have {n_dev}"
                 )
-            self.mesh = make_dp_tp_mesh(dp, self.model_parallel)
+            devs = local_devices(dp * k)
+            self.mesh = Mesh(
+                np.array(devs).reshape(dp, k), ("data", axis_name)
+            )
         else:
             self.mesh = make_mesh(num_workers)
         self.num_workers = int(self.mesh.shape.get("data", self.mesh.devices.size))
@@ -476,11 +494,15 @@ class SynchronousDistributedTrainer(Trainer):
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
 
     def _place_params(self, params):
-        """Replicated placement, or TP shardings when model_parallel is on."""
+        """Replicated placement, or TP/EP shardings when enabled."""
         if self.model_parallel:
             from distkeras_tpu.parallel.tensor_parallel import shard_params
 
             return shard_params(params, self.mesh)
+        if self.expert_parallel:
+            from distkeras_tpu.parallel.expert_parallel import shard_moe_params
+
+            return shard_moe_params(params, self.mesh)
         return replicate(params, self.mesh)
 
     def _place_opt_state(self, core, params, restored=None):
@@ -496,7 +518,7 @@ class SynchronousDistributedTrainer(Trainer):
         direction)."""
         if restored is not None:
             restored = self._reconcile_opt_state(restored, core, params)
-        if self.model_parallel:
+        if self.model_parallel or self.expert_parallel:
             opt_state = jax.jit(core.init_opt_state)(params)
             if restored is not None:
                 opt_state = jax.tree.map(
@@ -510,6 +532,30 @@ class SynchronousDistributedTrainer(Trainer):
         return replicate(core.init_opt_state(params), self.mesh)
 
     def _train(self, dataset, shuffle=False, resume=False):
+        if not self.expert_parallel:
+            return self._train_impl(dataset, shuffle, resume)
+        # expert sharding is a process-local layer hook (like the ring
+        # attention hook): attach for the run, detach so neither the
+        # caller's model nor the returned copy closes over a live mesh
+        from distkeras_tpu.parallel.expert_parallel import (
+            attach_expert_mesh,
+            detach_expert_mesh,
+        )
+
+        try:
+            # inside the try: a mid-attach failure (e.g. a second MoE layer
+            # whose num_experts doesn't divide the axis) must still detach
+            # the layers already attached
+            if attach_expert_mesh(self.model, self.mesh) == 0:
+                raise ValueError(
+                    "expert_parallel needs a model with MoE layers "
+                    "(zoo.moe_transformer_classifier)"
+                )
+            return self._train_impl(dataset, shuffle, resume)
+        finally:
+            detach_expert_mesh(self.model)
+
+    def _train_impl(self, dataset, shuffle=False, resume=False):
         self.history.record_training_start()
         core = self._make_core()
         global_batch = self.batch_size * self.num_workers

@@ -163,6 +163,118 @@ def test_moe_model_trains_expert_parallel():
     assert detach_expert_mesh(model) == 1
 
 
+def _moe_classifier(seed=0):
+    from distkeras_tpu.models.layers import (
+        Dense,
+        Embedding,
+        GlobalAvgPool1D,
+        LayerNorm,
+        TransformerBlock,
+    )
+    from distkeras_tpu.models.sequential import Sequential
+
+    return Sequential(
+        [
+            Embedding(16, 32),
+            TransformerBlock(num_heads=2),
+            MoE(num_experts=8),
+            LayerNorm(),
+            GlobalAvgPool1D(),
+            Dense(2, activation="softmax"),
+        ]
+    ).build((32,), seed=seed)
+
+
+def test_sync_trainer_expert_parallel_kwarg():
+    """Trainer-level EP: SynchronousDistributedTrainer(expert_parallel=4)
+    builds the ("data", "expert") mesh, shards the expert stacks, attaches
+    and detaches the layer hook, and — at equal global batch — tracks the
+    pure-DP run (expert sharding is an execution layout, not different
+    math)."""
+    from distkeras_tpu import SynchronousDistributedTrainer
+    from distkeras_tpu.data import loaders
+    from distkeras_tpu.data.transformers import OneHotTransformer
+
+    ds = loaders.synthetic_sequences(n=512, seq_len=32, vocab=16, seed=0)
+    ds = OneHotTransformer(2, output_col="label_onehot").transform(ds)
+    kw = dict(
+        loss="categorical_crossentropy",
+        learning_rate=1e-3,
+        num_epoch=1,
+        label_col="label_onehot",
+        seed=0,
+    )
+    # pure DP over 8 devices: global batch 4*8 = 32
+    m_dp = SynchronousDistributedTrainer(
+        _moe_classifier(), "adam", batch_size=4, num_workers=8, **kw
+    ).train(ds)
+    # 2-D data x expert: 2 data slices x 4 expert shards, global 16*2 = 32
+    t = SynchronousDistributedTrainer(
+        _moe_classifier(), "adam", batch_size=16, num_workers=2,
+        expert_parallel=4, **kw
+    )
+    assert dict(t.mesh.shape) == {"data": 2, "expert": 4}
+    m_ep = t.train(ds)
+    for a, b in zip(m_dp.get_weights(), m_ep.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+    # the hook must not leak past train()
+    from distkeras_tpu.models.sequential import walk_layers
+
+    assert all(
+        layer.mesh is None
+        for layer in walk_layers(t.model)
+        if isinstance(layer, MoE)
+    )
+
+
+def test_shard_moe_params_only_touches_moe_groups():
+    """Structural identification: a TransformerBlock's attention output
+    projection is ALSO named 'wo' — it must stay replicated; only leaves
+    inside a {"router","wi","wo"} MoE param group shard over "expert"."""
+    from jax.sharding import PartitionSpec as P
+
+    model = _moe_classifier()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "expert"))
+    placed = shard_moe_params(model.params, mesh)
+
+    def spec_of(leaf):
+        return leaf.sharding.spec
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(placed)
+    seen_expert, seen_attn_wo = 0, 0
+    for path, leaf in flat:
+        keys = [getattr(p, "key", str(p)) for p in path]
+        if keys[-1] in ("wi", "wo") and "router" not in keys:
+            # every wi/wo leaf: sharded iff its parent group has a router
+            parent = placed
+            for k in keys[:-1]:
+                parent = parent[k]
+            if {"router", "wi", "wo"} <= set(parent):
+                assert spec_of(leaf) == P("expert"), keys
+                seen_expert += 1
+            else:
+                assert spec_of(leaf) == P(), keys
+                seen_attn_wo += 1
+    assert seen_expert == 2  # the MoE layer's wi + wo
+    assert seen_attn_wo >= 1  # the attention wo stayed replicated
+
+
+def test_sync_trainer_expert_parallel_rejects_moe_free_model():
+    from distkeras_tpu import SynchronousDistributedTrainer
+    from distkeras_tpu.data import loaders
+    from distkeras_tpu.data.transformers import OneHotTransformer
+    from distkeras_tpu.models import zoo
+
+    ds = loaders.synthetic_mnist(n=128, seed=0)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    t = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=16), "sgd", batch_size=32,
+        label_col="label_onehot", expert_parallel=4,
+    )
+    with pytest.raises(ValueError, match="MoE"):
+        t.train(ds)
+
+
 def test_aux_loss_reaches_training_gradient():
     """WorkerCore adds aux_loss_weight * sum(state aux_loss leaves) to the
     training loss, so the router weight receives load-balance gradient (not
