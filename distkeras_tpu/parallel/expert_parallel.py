@@ -207,6 +207,13 @@ def shard_moe_params(params, mesh: Mesh, axis_name: str = "expert"):
             return {k: place_tree(v) for k, v in node.items()}
         if isinstance(node, (list, tuple)):
             return type(node)(place_tree(v) for v in node)
+        if not jax.tree_util.all_leaves([node]):
+            # an unrecognized pytree container could hide an expert stack;
+            # fail loudly rather than silently replicating it
+            raise TypeError(
+                "shard_moe_params only understands dict/list/tuple param "
+                f"trees; got container {type(node).__name__}"
+            )
         return jax.device_put(node, repl)
 
     return place_tree(params)

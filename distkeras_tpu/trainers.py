@@ -1168,18 +1168,36 @@ class PipelineParallelTrainer(Trainer):
 
 class EnsembleTrainer(Trainer):
     """Train ``num_models`` independent models on disjoint partitions; return
-    the list (reference: distkeras/trainers.py -> EnsembleTrainer)."""
+    the list (reference: distkeras/trainers.py -> EnsembleTrainer).
+
+    ``vmapped=True`` is the TPU-shaped execution (SURVEY §3.3: ensemble
+    parallelism "trivial under pmap over the model axis"): every member's
+    params/opt-state stack on a leading member axis sharded over an
+    ``("ensemble",)`` mesh, and ONE jitted ``vmap`` of the window program
+    trains all members per step — one compile per window length, no Python
+    threads, members ride devices via sharding. Members see the same
+    per-partition window streams as the threaded path; each joint step
+    truncates to the SHORTEST member's window (members must step with
+    identical shapes), so batches past the shortest tail are dropped —
+    size partitions to tile evenly for exact thread-mode parity."""
 
     supports_validation = False
 
-    def __init__(self, *args, num_models=2, window=8, **kwargs):
+    def __init__(
+        self, *args, num_models=2, window=8, vmapped=False, prefetch=2,
+        **kwargs,
+    ):
         super().__init__(*args, **kwargs)
         self.num_models = int(num_models)
         self.window = int(window)
+        self.vmapped = bool(vmapped)
+        self.prefetch = int(prefetch)
 
     def _train(self, dataset, shuffle=False, resume=False):
         if resume:
             raise ValueError("EnsembleTrainer does not support resume")
+        if self.vmapped:
+            return self._train_vmapped(dataset, shuffle)
         self.history.record_training_start()
         parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
             self.num_models
@@ -1223,6 +1241,111 @@ class EnsembleTrainer(Trainer):
             t.join()
         self.history.record_training_end()
         return results
+
+    def _train_vmapped(self, dataset, shuffle=False):
+        self.history.record_training_start()
+        m = self.num_models
+        core = self._make_core()
+        parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(m)
+
+        # member axis shards over as many devices as divide it evenly
+        n_dev = len(local_devices())
+        n = min(m, n_dev)
+        while m % n:
+            n -= 1
+        if n < min(m, n_dev):
+            logger.warning(
+                "EnsembleTrainer(vmapped=True): %d members only shard over "
+                "%d of %d devices (the member axis must divide evenly); "
+                "pick num_models as a multiple of the device count for "
+                "full utilization",
+                m, n, n_dev,
+            )
+        mesh = Mesh(np.array(local_devices(n)), ("ensemble",))
+        member_sh = NamedSharding(mesh, P("ensemble"))
+
+        # independent init per member (same contract as the threaded path),
+        # stacked on the leading member axis
+        members = []
+        for i in range(m):
+            model_i = self.model.copy()
+            model_i.build(self.model.input_shape, seed=self.seed + i)
+            members.append(model_i)
+        params = jax.device_put(
+            jax.tree.map(lambda *xs: np.stack(xs), *[mm.params for mm in members]),
+            member_sh,
+        )
+        state = jax.device_put(
+            jax.tree.map(lambda *xs: np.stack(xs), *[mm.state for mm in members]),
+            member_sh,
+        )
+        opt_state = jax.device_put(
+            jax.jit(jax.vmap(core.init_opt_state))(params), member_sh
+        )
+        rngs = jax.device_put(
+            np.stack(
+                [np.asarray(jax.random.PRNGKey(self.seed + i)) for i in range(m)]
+            ),
+            member_sh,
+        )
+
+        vm_window = jax.jit(jax.vmap(core.window_fn), donate_argnums=(0, 1, 2))
+        cols = [self.features_col, self.label_col]
+
+        from distkeras_tpu.data.prefetch import Prefetcher
+
+        def joint_windows():
+            streams = [
+                iter_windows(parts[i], self.batch_size, cols, self.window)
+                for i in range(m)
+            ]
+            while True:
+                wnds = [next(s, None) for s in streams]
+                if any(w is None for w in wnds):
+                    return
+                # every member must step with identical shapes: truncate
+                # the joint step to the shortest member's window (tails
+                # differ by at most one batch across near-equal partitions)
+                depth = min(len(w) for w in wnds)
+                yield [w[:depth] for w in wnds]
+
+        def prepare(wnds):
+            # host staging (prefetch thread): stack the member axis and
+            # ship with the member sharding while the device computes
+            staged = [stack_window(w, *cols) for w in wnds]
+            xs = jax.device_put(np.stack([a for a, _ in staged]), member_sh)
+            ys = jax.device_put(np.stack([b for _, b in staged]), member_sh)
+            return xs, ys
+
+        for _epoch in range(self.num_epoch):
+            with Prefetcher(
+                joint_windows(), prepare, depth=self.prefetch
+            ) as staged_windows:
+                for xs, ys in staged_windows:
+                    t0 = time.perf_counter()
+                    params, state, opt_state, rngs, mets = vm_window(
+                        params, state, opt_state, rngs, xs, ys
+                    )
+                    dt = time.perf_counter() - t0
+                    host_mets = {k: np.asarray(v) for k, v in mets.items()}
+                    for i in range(m):
+                        self.history.extend(
+                            i,
+                            _metrics_to_records(
+                                {k: v[i] for k, v in host_mets.items()}
+                            ),
+                        )
+                        self.history.record_window(
+                            i, xs.shape[1] * xs.shape[2], dt / m
+                        )
+
+        params_host = jax.tree.map(np.asarray, params)
+        state_host = jax.tree.map(np.asarray, state)
+        for i, model_i in enumerate(members):
+            model_i.params = jax.tree.map(lambda a: a[i], params_host)
+            model_i.state = jax.tree.map(lambda a: a[i], state_host)
+        self.history.record_training_end()
+        return members
 
 
 class AveragingTrainer(Trainer):

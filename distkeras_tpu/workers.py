@@ -176,6 +176,9 @@ class WorkerCore:
         self.indexed_window = jax.jit(indexed_window, donate_argnums=(0, 1, 2))
         self.grad_window = jax.jit(grad_window, donate_argnums=(0, 1, 2))
         self.eval_step = jax.jit(eval_step)
+        # unjitted handle for transform composition (the vmapped ensemble
+        # jits vmap(window_fn) as ONE program over a stacked member axis)
+        self.window_fn = window
 
     def init_opt_state(self, params):
         return self.optimizer.init(params)
