@@ -198,6 +198,8 @@ def shard_moe_params(params, mesh: Mesh, axis_name: str = "expert"):
         return isinstance(node, dict) and {"router", "wi", "wo"} <= set(node)
 
     def place_tree(node):
+        if node is None:
+            return None  # no-param convention: zero leaves, nothing to place
         if is_moe_group(node):
             return {
                 k: jax.device_put(v, exp if k in ("wi", "wo") else repl)

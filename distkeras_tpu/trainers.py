@@ -28,6 +28,7 @@ import threading
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -1166,6 +1167,63 @@ class PipelineParallelTrainer(Trainer):
         return self._finish(self._unstack_into(params, block_idx), state)
 
 
+def _member_mesh(m: int) -> Mesh:
+    """1-D ("ensemble",) mesh over as many devices as divide the member
+    count evenly (vmapped member-axis sharding needs equal shards)."""
+    n_dev = len(local_devices())
+    n = min(m, n_dev)
+    while m % n:
+        n -= 1
+    if n < min(m, n_dev):
+        logger.warning(
+            "vmapped member training: %d members only shard over %d of %d "
+            "devices (the member axis must divide evenly); pick a member "
+            "count that is a multiple of the device count for full "
+            "utilization",
+            m, n, n_dev,
+        )
+    return Mesh(np.array(local_devices(n)), ("ensemble",))
+
+
+def _joint_member_windows(parts, batch_size, cols, window):
+    """Joint window stream for vmapped member training: per step, one
+    window from EVERY member's partition, truncated to the shortest
+    (members must step with identical shapes; tails differ by at most one
+    batch across near-equal partitions)."""
+    streams = [iter_windows(p, batch_size, cols, window) for p in parts]
+    while True:
+        wnds = [next(s, None) for s in streams]
+        if any(w is None for w in wnds):
+            return
+        depth = min(len(w) for w in wnds)
+        yield [w[:depth] for w in wnds]
+
+
+def _member_prepare(cols, member_sh):
+    """Host-staging closure for the prefetch thread: stack the member axis
+    and ship with the member sharding while the device computes."""
+
+    def prepare(wnds):
+        staged = [stack_window(w, *cols) for w in wnds]
+        xs = jax.device_put(np.stack([a for a, _ in staged]), member_sh)
+        ys = jax.device_put(np.stack([b for _, b in staged]), member_sh)
+        return xs, ys
+
+    return prepare
+
+
+def _record_member_step(history, m, mets, xs, dt):
+    """Per-joint-step bookkeeping shared by the vmapped member trainers:
+    split the (member, window) metric arrays into per-member history
+    records and attribute the step's wall time across members."""
+    host_mets = {k: np.asarray(v) for k, v in mets.items()}
+    for i in range(m):
+        history.extend(
+            i, _metrics_to_records({k: v[i] for k, v in host_mets.items()})
+        )
+        history.record_window(i, xs.shape[1] * xs.shape[2], dt / m)
+
+
 class EnsembleTrainer(Trainer):
     """Train ``num_models`` independent models on disjoint partitions; return
     the list (reference: distkeras/trainers.py -> EnsembleTrainer).
@@ -1247,22 +1305,7 @@ class EnsembleTrainer(Trainer):
         m = self.num_models
         core = self._make_core()
         parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(m)
-
-        # member axis shards over as many devices as divide it evenly
-        n_dev = len(local_devices())
-        n = min(m, n_dev)
-        while m % n:
-            n -= 1
-        if n < min(m, n_dev):
-            logger.warning(
-                "EnsembleTrainer(vmapped=True): %d members only shard over "
-                "%d of %d devices (the member axis must divide evenly); "
-                "pick num_models as a multiple of the device count for "
-                "full utilization",
-                m, n, n_dev,
-            )
-        mesh = Mesh(np.array(local_devices(n)), ("ensemble",))
-        member_sh = NamedSharding(mesh, P("ensemble"))
+        member_sh = NamedSharding(_member_mesh(m), P("ensemble"))
 
         # independent init per member (same contract as the threaded path),
         # stacked on the leading member axis
@@ -1294,50 +1337,20 @@ class EnsembleTrainer(Trainer):
 
         from distkeras_tpu.data.prefetch import Prefetcher
 
-        def joint_windows():
-            streams = [
-                iter_windows(parts[i], self.batch_size, cols, self.window)
-                for i in range(m)
-            ]
-            while True:
-                wnds = [next(s, None) for s in streams]
-                if any(w is None for w in wnds):
-                    return
-                # every member must step with identical shapes: truncate
-                # the joint step to the shortest member's window (tails
-                # differ by at most one batch across near-equal partitions)
-                depth = min(len(w) for w in wnds)
-                yield [w[:depth] for w in wnds]
-
-        def prepare(wnds):
-            # host staging (prefetch thread): stack the member axis and
-            # ship with the member sharding while the device computes
-            staged = [stack_window(w, *cols) for w in wnds]
-            xs = jax.device_put(np.stack([a for a, _ in staged]), member_sh)
-            ys = jax.device_put(np.stack([b for _, b in staged]), member_sh)
-            return xs, ys
-
         for _epoch in range(self.num_epoch):
             with Prefetcher(
-                joint_windows(), prepare, depth=self.prefetch
+                _joint_member_windows(parts, self.batch_size, cols, self.window),
+                _member_prepare(cols, member_sh),
+                depth=self.prefetch,
             ) as staged_windows:
                 for xs, ys in staged_windows:
                     t0 = time.perf_counter()
                     params, state, opt_state, rngs, mets = vm_window(
                         params, state, opt_state, rngs, xs, ys
                     )
-                    dt = time.perf_counter() - t0
-                    host_mets = {k: np.asarray(v) for k, v in mets.items()}
-                    for i in range(m):
-                        self.history.extend(
-                            i,
-                            _metrics_to_records(
-                                {k: v[i] for k, v in host_mets.items()}
-                            ),
-                        )
-                        self.history.record_window(
-                            i, xs.shape[1] * xs.shape[2], dt / m
-                        )
+                    _record_member_step(
+                        self.history, m, mets, xs, time.perf_counter() - t0
+                    )
 
         params_host = jax.tree.map(np.asarray, params)
         state_host = jax.tree.map(np.asarray, state)
@@ -1351,18 +1364,32 @@ class EnsembleTrainer(Trainer):
 class AveragingTrainer(Trainer):
     """Per epoch: train a replica per partition from the current center, then
     average the replicas' weights (reference: distkeras/trainers.py ->
-    AveragingTrainer)."""
+    AveragingTrainer).
+
+    ``vmapped=True`` runs all replicas in ONE jitted ``vmap`` of the window
+    program per joint step (replica axis sharded over an ``("ensemble",)``
+    mesh) and takes the epoch-end average on device — same shape contract
+    as ``EnsembleTrainer(vmapped=True)``: joint steps truncate to the
+    shortest replica window, so size partitions to tile evenly for exact
+    thread-mode parity."""
 
     supports_validation = False
 
-    def __init__(self, *args, num_workers=2, window=8, **kwargs):
+    def __init__(
+        self, *args, num_workers=2, window=8, vmapped=False, prefetch=2,
+        **kwargs,
+    ):
         super().__init__(*args, **kwargs)
         self.num_workers = int(num_workers)
         self.window = int(window)
+        self.vmapped = bool(vmapped)
+        self.prefetch = int(prefetch)
 
     def _train(self, dataset, shuffle=False, resume=False):
         if resume:
             raise ValueError("AveragingTrainer does not support resume")
+        if self.vmapped:
+            return self._train_vmapped(dataset, shuffle)
         self.history.record_training_start()
         core = self._make_core()
         parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(
@@ -1436,6 +1463,70 @@ class AveragingTrainer(Trainer):
 
         self.history.record_training_end()
         return self._finish(center, state)
+
+    def _train_vmapped(self, dataset, shuffle=False):
+        self.history.record_training_start()
+        m = self.num_workers
+        core = self._make_core()
+        parts = (dataset.shuffle(self.seed) if shuffle else dataset).partition(m)
+        member_sh = NamedSharding(_member_mesh(m), P("ensemble"))
+
+        vm_window = jax.jit(jax.vmap(core.window_fn), donate_argnums=(0, 1, 2))
+        vm_init = jax.jit(jax.vmap(core.init_opt_state))
+        cols = [self.features_col, self.label_col]
+
+        from distkeras_tpu.data.prefetch import Prefetcher
+
+        center = host_copy(self.model.params)
+        center_state = host_copy(self.model.state)
+
+        for epoch in range(self.num_epoch):
+            # every replica restarts the epoch from the shared center with
+            # a fresh optimizer, exactly like the threaded path
+            params = jax.device_put(
+                jax.tree.map(lambda a: np.stack([a] * m), center), member_sh
+            )
+            state = jax.device_put(
+                jax.tree.map(lambda a: np.stack([a] * m), center_state),
+                member_sh,
+            )
+            opt_state = jax.device_put(vm_init(params), member_sh)
+            rngs = jax.device_put(
+                np.stack(
+                    [
+                        np.asarray(
+                            jax.random.fold_in(
+                                jax.random.PRNGKey(self.seed + epoch), i
+                            )
+                        )
+                        for i in range(m)
+                    ]
+                ),
+                member_sh,
+            )
+            with Prefetcher(
+                _joint_member_windows(parts, self.batch_size, cols, self.window),
+                _member_prepare(cols, member_sh),
+                depth=self.prefetch,
+            ) as staged_windows:
+                for xs, ys in staged_windows:
+                    t0 = time.perf_counter()
+                    params, state, opt_state, rngs, mets = vm_window(
+                        params, state, opt_state, rngs, xs, ys
+                    )
+                    _record_member_step(
+                        self.history, m, mets, xs, time.perf_counter() - t0
+                    )
+            # epoch-end averaging: reduce on DEVICE, transfer only the
+            # 1/m-sized result; state follows the threaded path's
+            # convention (replica 0's)
+            center = jax.tree.map(
+                lambda a: np.asarray(jnp.mean(a, axis=0)), params
+            )
+            center_state = jax.tree.map(lambda a: np.asarray(a[0]), state)
+
+        self.history.record_training_end()
+        return self._finish(center, center_state)
 
 
 def _maybe_len(dataset):

@@ -388,6 +388,47 @@ def test_ensemble_vmapped_converges():
     assert t.get_history(worker_id=3), "member 3 history missing"
 
 
+def test_averaging_vmapped_matches_threaded():
+    """AveragingTrainer(vmapped=True): replicas train in one vmap program
+    and average on the member axis at epoch end — matches the threaded
+    path at partition sizes that tile into full windows."""
+    ds = loaders.synthetic_mnist(n=1024, seed=0)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    train = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    kw = dict(
+        loss="categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=32,
+        num_epoch=2,
+        num_workers=4,
+        window=4,
+        label_col="label_onehot",
+        seed=0,
+    )
+    mt = AveragingTrainer(zoo.mnist_mlp(hidden=16), "sgd", **kw).train(train)
+    mv = AveragingTrainer(
+        zoo.mnist_mlp(hidden=16), "sgd", vmapped=True, **kw
+    ).train(train)
+    for a, b in zip(mt.get_weights(), mv.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_averaging_vmapped_converges():
+    train, test = make_data(n=1024)
+    t = AveragingTrainer(
+        zoo.mnist_mlp(hidden=64),
+        "sgd",
+        learning_rate=0.05,
+        batch_size=32,
+        num_epoch=8,
+        num_workers=4,
+        vmapped=True,
+        label_col="label_onehot",
+    )
+    trained = t.train(train)
+    assert accuracy_of(trained, test) > 0.9
+
+
 def test_averaging_trainer_converges():
     train, test = make_data(n=1024)
     t = AveragingTrainer(
