@@ -18,7 +18,6 @@ exposes:
 
 from __future__ import annotations
 
-import copy as _copy
 
 import jax
 import jax.numpy as jnp

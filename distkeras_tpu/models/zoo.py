@@ -14,7 +14,6 @@ from __future__ import annotations
 
 from distkeras_tpu.models.layers import (
     Activation,
-    AvgPool2D,
     BatchNorm,
     Conv2D,
     Dense,
