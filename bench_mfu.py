@@ -27,11 +27,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import time
 
 import numpy as np
 
-from bench import _flops_per_call, _peak_flops, resolve_backend
+from bench import _flops_per_call, _peak_flops, resolve_backend, sync_fetch
 
 
 def main() -> None:
@@ -93,11 +94,20 @@ def main() -> None:
     )
     if args.attention == "auto":
         args.attention = "dense" if on_cpu else "flash"
+    fused_ln = 0
     if args.attention == "flash":
         from distkeras_tpu.ops.flash_attention import attach_flash_attention
+        from distkeras_tpu.ops.fused_layernorm import attach_fused_layernorm
 
         attached = attach_flash_attention(model)
-        print(f"flash attention attached to {attached} layers", flush=True)
+        # the fused path is measured as a unit: flash attention + one-pass
+        # LayerNorm (off-TPU both would measure the Pallas interpreter)
+        fused_ln = attach_fused_layernorm(model)
+        print(
+            f"flash attention attached to {attached} layers, "
+            f"fused layernorm to {fused_ln}",
+            flush=True,
+        )
     core = WorkerCore(
         model,
         get_optimizer("adam", 1e-3),
@@ -138,14 +148,16 @@ def main() -> None:
         params, state, opt_state, key, _m = core.indexed_window(
             params, state, opt_state, key, data_x, data_y, fresh_idx()
         )
-    jax.block_until_ready(params)
+    # host-fetch barrier, NOT block_until_ready: see bench.sync_fetch — on
+    # the axon tunnel block_until_ready returns before remote execution
+    sync_fetch(_m["loss"])
 
     t0 = time.perf_counter()
     for _ in range(timed):
         params, state, opt_state, key, _m = core.indexed_window(
             params, state, opt_state, key, data_x, data_y, fresh_idx()
         )
-    jax.block_until_ready(params)
+    final_loss = sync_fetch(_m["loss"])
     dt = time.perf_counter() - t0
 
     sps = timed * window * batch / dt
@@ -158,7 +170,14 @@ def main() -> None:
         "device_kind": dev.device_kind,
         "model": f"transformer d{d_model} L{depth} seq{seq} bf16",
         "attention": args.attention,
+        "fused_layernorm_layers": fused_ln,
         "batch": batch,
+        # finite => real compute happened; non-finite goes out as a string
+        # so the artifact stays strictly-valid JSON
+        "final_loss": (
+            round(final_loss, 4) if math.isfinite(final_loss)
+            else repr(final_loss)
+        ),
         "samples_per_sec": round(sps, 1),
         "tflops_per_sec": round(fps / 1e12, 2),
         "xla_cost_tflops_per_sec": (

@@ -363,10 +363,18 @@ class Embedding(Layer):
 
 @register_layer
 class LayerNorm(Layer):
-    """Normalize over the trailing feature axis with learned scale/shift."""
+    """Normalize over the trailing feature axis with learned scale/shift.
+
+    ``norm_fn`` is a process-local hook (same contract as
+    ``MultiHeadSelfAttention.attention_fn``): point it at
+    ``ops.fused_layernorm.fused_layer_norm`` to run the one-pass Pallas
+    kernel instead of the three-pass XLA path. Not serialized — a
+    deserialized layer computes the plain path until the receiving
+    process re-attaches the hook."""
 
     def __init__(self, epsilon=1e-5):
         self.epsilon = float(epsilon)
+        self.norm_fn = None  # override to plug in the fused kernel
 
     def init(self, rng, in_shape):
         d = in_shape[-1]
@@ -378,6 +386,9 @@ class LayerNorm(Layer):
         )
 
     def apply(self, params, state, x, train=False, rng=None):
+        if self.norm_fn is not None:
+            y = self.norm_fn(x, params["gamma"], params["beta"], self.epsilon)
+            return y, state
         x32 = x.astype(jnp.float32)
         mean = jnp.mean(x32, axis=-1, keepdims=True)
         var = jnp.var(x32, axis=-1, keepdims=True)
@@ -386,6 +397,14 @@ class LayerNorm(Layer):
         return y.astype(x.dtype), state
 
     def get_config(self):
+        if self.norm_fn is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "LayerNorm.norm_fn is process-local and is not serialized; "
+                "the deserialized layer will use the plain XLA path until "
+                "the fused kernel is re-attached"
+            )
         return {"layer": "LayerNorm", "epsilon": self.epsilon}
 
 

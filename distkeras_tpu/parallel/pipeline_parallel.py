@@ -107,7 +107,7 @@ def _pipeline_local(stage_params, x_micro, block_apply, axis_name, axis_size,
 
 def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
                    axis_name: str = "pipe", num_micro: int | None = None,
-                   batch_axis: str | None = None):
+                   batch_axis: str | None = None, param_specs=None):
     """Run ``x`` through the stacked block tower, pipelined over the mesh.
 
     stacked_params: pytree with leading block axis ``depth`` (depth must be
@@ -121,6 +121,16 @@ def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
     (2-D pipeline x data parallelism): each data slice runs its own GPipe
     ring over ``axis_name`` on its batch shard — the schedule body is
     unchanged; only the specs keep the shards in place.
+
+    ``param_specs``: optional pytree of ``PartitionSpec`` matching
+    ``stacked_params`` for 3-D composition (pipeline x data x tensor):
+    every spec must lead with ``axis_name`` (the block axis stays
+    pipeline-sharded) and may shard trailing weight dims over a tensor
+    axis — ``block_apply`` then sees LOCAL weight shards and owns the
+    matching collectives (e.g. the Megatron pattern: column-shard w_in,
+    row-shard w_out, ``lax.psum`` over the tensor axis after w_out).
+    Default: every leaf ``P(axis_name)`` (weights replicated over all
+    other axes).
     """
     axis_size = mesh.shape[axis_name]
     depth = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -145,7 +155,18 @@ def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
 
     # params: leading block axis sharded over "pipe" (replicated over any
     # data axis); input microbatches shard over batch_axis when given
-    param_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        param_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    else:
+        for spec in jax.tree.leaves(
+            param_specs, is_leaf=lambda s: isinstance(s, P)
+        ):
+            if not spec or spec[0] != axis_name:
+                raise ValueError(
+                    f"param_specs must lead with the {axis_name!r} block "
+                    f"axis, got {spec}"
+                )
+        param_spec = param_specs
     x_spec = P(None, batch_axis)
     fn = jax.shard_map(
         functools.partial(
@@ -163,8 +184,19 @@ def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
     return out.reshape(batch, *out.shape[2:])
 
 
-def shard_stacked_params(stacked_params, mesh: Mesh, axis_name: str = "pipe"):
+def shard_stacked_params(stacked_params, mesh: Mesh, axis_name: str = "pipe",
+                         param_specs=None):
     """Place a stacked block pytree with its leading axis sharded over the
-    pipeline mesh axis (device i holds blocks [i*depth/S, (i+1)*depth/S))."""
-    sharding = NamedSharding(mesh, P(axis_name))
-    return jax.tree.map(lambda a: jax.device_put(a, sharding), stacked_params)
+    pipeline mesh axis (device i holds blocks [i*depth/S, (i+1)*depth/S)).
+    ``param_specs`` optionally gives per-leaf specs (3-D composition — see
+    :func:`pipeline_apply`)."""
+    if param_specs is None:
+        sharding = NamedSharding(mesh, P(axis_name))
+        return jax.tree.map(
+            lambda a: jax.device_put(a, sharding), stacked_params
+        )
+    return jax.tree.map(
+        lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec)),
+        stacked_params,
+        param_specs,
+    )

@@ -1,0 +1,135 @@
+"""Fused LayerNorm kernel parity (ops/fused_layernorm.py).
+
+The kernels run in interpreter mode on the CPU test mesh; the contract is
+bit-level-close parity with the plain-XLA LayerNorm math for values AND
+gradients, across dtypes, shapes that tile the kernel, and shapes that
+must fall back.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models.layers import LayerNorm
+from distkeras_tpu.ops.fused_layernorm import (
+    attach_fused_layernorm,
+    fused_layer_norm,
+)
+
+
+def _reference(x, gamma, beta, eps=1e-5):
+    ln = LayerNorm(epsilon=eps)
+    params = {"gamma": gamma, "beta": beta}
+    y, _ = ln.apply(params, {}, x)
+    return y
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape) * 2.0 + 0.5).astype(dtype)
+
+
+@pytest.mark.parametrize("shape", [(4, 16, 128), (32, 256), (3, 8, 384)])
+def test_forward_matches_reference(shape):
+    d = shape[-1]
+    x = _rand(shape)
+    gamma = _rand((d,), seed=1) * 0.1 + 1.0
+    beta = _rand((d,), seed=2) * 0.1
+    got = fused_layer_norm(x, gamma, beta)
+    want = _reference(x, gamma, beta)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_gradients_match_reference():
+    shape, d = (2, 24, 128), 128
+    x = _rand(shape)
+    gamma = _rand((d,), seed=1) * 0.1 + 1.0
+    beta = _rand((d,), seed=2) * 0.1
+    w = _rand(shape, seed=3)
+
+    def loss_fused(x, g, b):
+        return jnp.sum(fused_layer_norm(x, g, b) * w)
+
+    def loss_ref(x, g, b):
+        return jnp.sum(_reference(x, g, b) * w)
+
+    got = jax.grad(loss_fused, argnums=(0, 1, 2))(x, gamma, beta)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for g1, g2, name in zip(got, want, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(
+            np.asarray(g1), np.asarray(g2), atol=2e-4, err_msg=name
+        )
+
+
+def test_bfloat16_roundtrip_and_grads():
+    x = _rand((4, 16, 128)).astype(jnp.bfloat16)
+    gamma = jnp.ones((128,), jnp.float32)
+    beta = jnp.zeros((128,), jnp.float32)
+    y = fused_layer_norm(x, gamma, beta)
+    assert y.dtype == jnp.bfloat16
+    want = _reference(x, gamma, beta)
+    np.testing.assert_allclose(
+        np.asarray(y, dtype=np.float32),
+        np.asarray(want, dtype=np.float32),
+        atol=2e-2,
+    )
+    dx = jax.grad(lambda x: jnp.sum(fused_layer_norm(x, gamma, beta)
+                                    .astype(jnp.float32)))(x)
+    assert dx.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("shape", [(4, 100), (8, 130), (6,), (2, 3, 64)])
+def test_non_tiling_shapes_fall_back_correctly(shape):
+    # D not a lane multiple (or too few rows): must still be exactly right
+    d = shape[-1]
+    x = _rand(shape)
+    gamma = _rand((d,), seed=1) * 0.1 + 1.0
+    beta = _rand((d,), seed=2)
+    got = fused_layer_norm(x, gamma, beta)
+    want = _reference(x, gamma, beta)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_row_padding_partial_final_block():
+    # 9 rows with block_rows >= 8: final block is partially padded; padded
+    # rows must not leak into dgamma/dbeta
+    x = _rand((9, 128))
+    gamma = _rand((128,), seed=1) * 0.1 + 1.0
+    beta = jnp.zeros((128,), jnp.float32)
+
+    def loss(g):
+        return jnp.sum(fused_layer_norm(x, g, beta) ** 2)
+
+    got = jax.grad(loss)(gamma)
+    want = jax.grad(lambda g: jnp.sum(_reference(x, g, beta) ** 2))(gamma)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_attach_hooks_every_layernorm():
+    from distkeras_tpu.models.zoo import transformer_classifier
+
+    model = transformer_classifier(depth=2, seq_len=16, d_model=128)
+    n = attach_fused_layernorm(model)
+    # 2 per block (ln1, ln2) + the final pre-pool LayerNorm
+    assert n == 5
+
+    x = np.arange(2 * 16).reshape(2, 16) % 64
+    y_fused, _ = model.apply(model.params, model.state, x, train=False)
+
+    plain = transformer_classifier(depth=2, seq_len=16, d_model=128)
+    y_plain, _ = plain.apply(plain.params, plain.state, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(y_fused), np.asarray(y_plain), atol=1e-5
+    )
+
+
+def test_hook_not_serialized(caplog):
+    import logging
+
+    ln = LayerNorm()
+    ln.norm_fn = fused_layer_norm
+    with caplog.at_level(logging.WARNING):
+        cfg = ln.get_config()
+    assert "norm_fn" not in cfg
+    assert any("process-local" in r.message for r in caplog.records)

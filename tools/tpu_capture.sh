@@ -6,18 +6,62 @@
 #   3. prefetch A/B       — host-staged input path (stack+device_put),
 #                           prefetch=0 vs prefetch=2
 # Each step is independently timeout-boxed; results append to TPU_CAPTURE.log.
+# Artifacts COMMIT AFTER EVERY STEP: the 2026-07-31 01:02 window lasted only
+# minutes — a sweep that commits once at the end can lose its one good
+# number to a tunnel that dies mid-sweep.
 set -x
 cd "$(dirname "$0")/.."
 LOG=TPU_CAPTURE.log
 date >> "$LOG"
 
-timeout 600 python bench.py 2>>"$LOG.err" | tail -1 >> "$LOG"
+# commit_snap <msg> <file...> — commit whichever of the files exist, with
+# retries around a possibly-held index.lock (the build session commits too)
+commit_snap() {
+  _msg="$1"; shift
+  _files=""
+  for _f in "$@"; do [ -e "$_f" ] && _files="$_files $_f"; done
+  [ -n "$_files" ] || return 0
+  for _ in 1 2 3 4 5; do
+    git add -- $_files
+    if git commit -m "$_msg" \
+        -m "No-Verification-Needed: benchmark artifact capture only" \
+        -- $_files; then
+      return 0
+    fi
+    sleep 10
+  done
+}
 
-# dense first, flash second: both lines land in the log for the A/B, and
-# BENCH_MFU.json keeps the flash (headline fast-path) number
+# --- 1. north-star bench (device-resident MNIST CNN) ---------------------
+timeout 600 python bench.py 2>>"$LOG.err" | tail -1 >> "$LOG"
+# only a tpu-platform measurement is the artifact of record (the harness
+# degrades to a CPU-scaled line when the tunnel dies; never ship that as
+# the TPU number)
+grep '"metric": "mnist_cnn_train' "$LOG" | grep '"platform": "tpu"' \
+  | tail -1 > BENCH_TPU.json.new
+if [ -s BENCH_TPU.json.new ]; then
+  mv BENCH_TPU.json.new BENCH_TPU.json
+else
+  # no tpu line this sweep: restore any previously committed number
+  # rather than truncating/deleting the artifact of record
+  rm -f BENCH_TPU.json.new
+  git checkout -- BENCH_TPU.json 2>/dev/null || true
+fi
+commit_snap "Harvest TPU window: north-star device-resident bench" \
+  BENCH_TPU.json "$LOG" "$LOG.err"
+
+# --- 2. transformer MFU, dense then flash (A/B in the log) ---------------
 timeout 900 python bench_mfu.py --attention dense 2>>"$LOG.err" | tail -1 >> "$LOG"
 timeout 900 python bench_mfu.py --attention flash 2>>"$LOG.err" | tail -1 >> "$LOG"
+if grep -q '"platform": "tpu"' BENCH_MFU.json 2>/dev/null; then
+  commit_snap "Harvest TPU window: transformer MFU (dense + flash A/B)" \
+    BENCH_MFU.json "$LOG" "$LOG.err"
+else
+  # a CPU-fallback run must not clobber a previously committed TPU number
+  git checkout -- BENCH_MFU.json 2>/dev/null || true
+fi
 
+# --- 3. prefetch A/B on the host-staged input path -----------------------
 timeout 900 python - >> "$LOG" 2>>"$LOG.err" <<'EOF'
 # prefetch A/B on the host-staged input path (in-memory Dataset, per-window
 # stack + device_put): the overlap win shows when the host link is the
@@ -64,5 +108,6 @@ print(json.dumps({
     "platform": jax.devices()[0].platform,
 }))
 EOF
+commit_snap "Harvest TPU window: prefetch A/B" "$LOG" "$LOG.err"
 
 tail -4 "$LOG"

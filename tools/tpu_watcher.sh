@@ -13,22 +13,18 @@ LOG=TPU_WATCH.log
 while true; do
   if timeout -k 10 75 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel HEALTHY - starting capture" >> "$LOG"
+    # tpu_capture.sh commits each artifact as soon as it exists (the
+    # 01:02 window died mid-sweep; end-of-sweep commits lose the harvest)
     sh tools/tpu_capture.sh >> "$LOG" 2>&1
-    grep '"metric": "mnist_cnn_train' TPU_CAPTURE.log | tail -1 > BENCH_TPU.json
     timeout -k 30 2400 python benchmarks.py --configs 1,2,3,6 >> "$LOG" 2>&1
-    # Commit only the artifact paths that exist (git add/commit are
-    # all-or-nothing on an unmatched pathspec, and a tunnel that dies
-    # mid-sweep leaves later artifacts unwritten — the partial harvest
-    # must still land); git add first since several are untracked on the
-    # first harvest; retry around a possibly-held index.lock
     ARTIFACTS=""
-    for f in TPU_CAPTURE.log TPU_CAPTURE.log.err BENCH_TPU.json \
-             BENCH_MFU.json BENCHMARKS.json BENCHMARKS.md "$LOG"; do
+    for f in TPU_CAPTURE.log TPU_CAPTURE.log.err BENCHMARKS.json \
+             BENCHMARKS.md "$LOG"; do
       [ -e "$f" ] && ARTIFACTS="$ARTIFACTS $f"
     done
     for _ in 1 2 3 4 5; do
       git add -- $ARTIFACTS >> "$LOG" 2>&1
-      if git commit -m "Harvest TPU window: capture sweep + TPU benchmark rows
+      if git commit -m "Harvest TPU window: TPU benchmark matrix rows
 
 No-Verification-Needed: benchmark artifact capture only" \
           -- $ARTIFACTS >> "$LOG" 2>&1; then
