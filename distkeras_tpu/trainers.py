@@ -1566,11 +1566,17 @@ class DistributedTrainer(Trainer):
         worker_snapshot_stride=1,
         worker_retries=1,
         heartbeat_timeout=None,
+        device_resident=False,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
+        # device_resident: each worker ships its partition to HBM once and
+        # streams only (W, B) index matrices per window — the async face of
+        # the device-resident input path (window stream bit-identical to the
+        # streamed one, so resume/dedup alignment is unchanged)
+        self.device_resident = bool(device_resident)
         # every k-th commit hands worker-local state to the PS for
         # checkpoints (device-to-host copy amortization; resume replays at
         # most k-1 deduped windows per worker)
@@ -1817,30 +1823,10 @@ class DistributedTrainer(Trainer):
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def _warmup(self, core, worker, part):
-        """Compile the window program before launching worker threads.
-
-        Without this, every worker's first window dispatches into the XLA
-        compile gap: all of them pull the identical initial center and later
-        commit full deltas on top of each other — a maximal-staleness burst
-        that measurably hurts early training. One throwaway window on zero
-        data populates the jit cache first.
-        """
-        batch = next(
-            part.batches(self.batch_size, columns=[self.features_col, self.label_col]),
-            None,
-        )
-        if batch is None:  # partition smaller than one batch: nothing to warm
-            return
-        zeros = {k: np.zeros_like(v) for k, v in batch.items()}
-        batches = [zeros] * self.communication_window
-        xs, ys = stack_window(batches, self.features_col, self.label_col)
-        params = host_copy(self.model.params)
-        state = host_copy(self.model.state)
-        opt_state = core.init_opt_state(params)
-        rng = jax.random.PRNGKey(0)
-        fn = core.grad_window if worker.uses_grad_window else core.window
-        out = fn(params, state, opt_state, rng, xs, ys)
-        jax.block_until_ready(out)
+        """Compile the window program before launching worker threads (the
+        program dispatch lives on the worker — ``AsyncWorker.warmup`` — so
+        streamed/indexed selection has exactly one owner)."""
+        worker.warmup(part, self.batch_size, self.device_resident)
 
     def _run_threads(self, workers, parts):
         done = set()  # worker ids that exited (finished or gave up) — a
@@ -1855,6 +1841,7 @@ class DistributedTrainer(Trainer):
                             self.batch_size,
                             num_epoch=self.num_epoch,
                             shuffle_seed=self.seed + w.worker_id,
+                            device_resident=self.device_resident,
                         )
                         return
                     except Exception as e:  # noqa: BLE001 — crash boundary
@@ -1922,25 +1909,36 @@ class DistributedTrainer(Trainer):
         queues = []
         for w, part in zip(workers, parts):
             # THE window stream definition lives on the worker
-            # (iter_window_batches) — thread mode consumes it directly, so
-            # reusing it here keeps cross-mode determinism and the
-            # resume-skip alignment in one place. The resume slice drops
-            # the windows whose commits the restored center already
-            # contains (same seeded shuffles -> same stream).
-            windows = list(
-                w.iter_window_batches(
-                    part,
-                    self.batch_size,
-                    self.num_epoch,
-                    self.seed + w.worker_id,
+            # (iter_window_batches / iter_index_windows) — thread mode
+            # consumes it directly, so reusing it here keeps cross-mode
+            # determinism and the resume-skip alignment in one place. The
+            # resume slice drops the windows whose commits the restored
+            # center already contains (same seeded shuffles -> same stream).
+            if self.device_resident:
+                w.stage_resident(part)
+                windows = list(
+                    w.iter_index_windows(
+                        self.num_epoch, self.batch_size,
+                        self.seed + w.worker_id,
+                    )
                 )
-            )
+            else:
+                windows = list(
+                    w.iter_window_batches(
+                        part,
+                        self.batch_size,
+                        self.num_epoch,
+                        self.seed + w.worker_id,
+                    )
+                )
             queues.append(windows[w._start_seq :])
 
         # Event-driven schedule: repeatedly pick a worker at random; begin its
         # next window if idle, else finish the in-flight one. Staleness varies
         # 0..num_workers-1 exactly as thread interleavings produce, but the
-        # seed makes every run bit-identical.
+        # seed makes every run bit-identical. The schedule depends only on
+        # queue lengths — identical streamed vs resident — so the two feeds
+        # replay the same interleaving and the centers match bit for bit.
         rng = np.random.default_rng(self.seed)
         inflight = [False] * len(workers)
         while any(queues) or any(inflight):
@@ -1953,6 +1951,9 @@ class DistributedTrainer(Trainer):
             if inflight[i]:
                 workers[i].finish_window()
                 inflight[i] = False
+            elif self.device_resident:
+                workers[i].begin_window_indexed(queues[i].pop(0))
+                inflight[i] = True
             else:
                 workers[i].begin_window(queues[i].pop(0))
                 inflight[i] = True

@@ -140,25 +140,44 @@ class WorkerCore:
             )
             return params, state, opt_state, rng, mets
 
+        def grad_step(carry, batch):
+            params, state, opt_state, rng, acc = carry
+            rng, sub = jax.random.split(rng)
+            (loss, (state, y_pred)), grads = grad_fn(
+                params, state, sub, batch["x"], batch["y"]
+            )
+            params, opt_state = apply_opt(params, grads, opt_state)
+            acc = jax.tree.map(jnp.add, acc, grads)
+            mets = {"loss": loss}
+            for name, fn in zip(self.metric_names, metric_fns):
+                mets[name] = fn(y_pred, batch["y"])
+            return (params, state, opt_state, rng, acc), mets
+
         def grad_window(params, state, opt_state, rng, xs, ys):
             """Like window, but also accumulates raw gradients (ADAG)."""
+            acc0 = jax.tree.map(jnp.zeros_like, params)
+            (params, state, opt_state, rng, acc), mets = jax.lax.scan(
+                grad_step, (params, state, opt_state, rng, acc0),
+                {"x": xs, "y": ys},
+            )
+            return params, state, opt_state, rng, acc, mets
 
-            def step(carry, batch):
-                params, state, opt_state, rng, acc = carry
-                rng, sub = jax.random.split(rng)
-                (loss, (state, y_pred)), grads = grad_fn(
-                    params, state, sub, batch["x"], batch["y"]
-                )
-                params, opt_state = apply_opt(params, grads, opt_state)
-                acc = jax.tree.map(jnp.add, acc, grads)
-                mets = {"loss": loss}
-                for name, fn in zip(self.metric_names, metric_fns):
-                    mets[name] = fn(y_pred, batch["y"])
-                return (params, state, opt_state, rng, acc), mets
+        def indexed_grad_window(params, state, opt_state, rng, data_x, data_y, idx):
+            """grad_window over the device-resident feed: same contract as
+            ``indexed_window`` (HBM-resident pool, (W, B) int32 gather per
+            step), same accumulated-gradient output as ``grad_window`` —
+            the resident path for the grad-committing async family (ADAG)."""
+
+            def step(carry, ix):
+                batch = {
+                    "x": jnp.take(data_x, ix, axis=0),
+                    "y": jnp.take(data_y, ix, axis=0),
+                }
+                return grad_step(carry, batch)
 
             acc0 = jax.tree.map(jnp.zeros_like, params)
             (params, state, opt_state, rng, acc), mets = jax.lax.scan(
-                step, (params, state, opt_state, rng, acc0), {"x": xs, "y": ys}
+                step, (params, state, opt_state, rng, acc0), idx
             )
             return params, state, opt_state, rng, acc, mets
 
@@ -175,6 +194,9 @@ class WorkerCore:
         self.window = jax.jit(window, donate_argnums=(0, 1, 2))
         self.indexed_window = jax.jit(indexed_window, donate_argnums=(0, 1, 2))
         self.grad_window = jax.jit(grad_window, donate_argnums=(0, 1, 2))
+        self.indexed_grad_window = jax.jit(
+            indexed_grad_window, donate_argnums=(0, 1, 2)
+        )
         self.eval_step = jax.jit(eval_step)
         # unjitted handle for transform composition (the vmapped ensemble
         # jits vmap(window_fn) as ONE program over a stacked member axis)
@@ -502,6 +524,9 @@ class AsyncWorker:
         self.snapshot_stride = 1
         self._snap = None  # latest committed local state (host copies)
         self._restore_point = None  # snapshot adopted at resume, if any
+        # device-resident feed (stage_resident): partition pool in HBM
+        self._resident = None
+        self._resident_n = 0
 
     def reset_for_retry(self):
         """Restart this worker's training after a failure: from its resume
@@ -604,6 +629,111 @@ class AsyncWorker:
             "t0": time.perf_counter(),
         }
 
+    def warmup(self, part, batch_size, device_resident=False):
+        """Compile this worker's window program before training starts, on
+        throwaway state (the trainer's pre-thread warmup: without it every
+        worker's first window dispatches into the XLA compile gap, pulls
+        the identical initial center, and commits full deltas on top of
+        each other — a maximal-staleness burst). Lives on the worker so
+        the streamed/indexed program dispatch has exactly one owner
+        (mirrors ``begin_window``/``begin_window_indexed``)."""
+        batch = next(
+            part.batches(
+                batch_size, columns=[self.features_col, self.label_col]
+            ),
+            None,
+        )
+        if batch is None:  # partition smaller than one batch: nothing to warm
+            return
+        params = host_copy(self.core.model.params)
+        state = host_copy(self.core.model.state)
+        opt_state = self.core.init_opt_state(params)
+        rng = jax.random.PRNGKey(0)
+        if device_resident:
+            # the compile keys on the staged pool's shape, so warm against
+            # this worker's own pool (stage_resident dedups the re-stage
+            # when train() runs)
+            self.stage_resident(part)
+            idx = np.zeros((self.window_size, batch_size), np.int32)
+            fn = (
+                self.core.indexed_grad_window
+                if self.uses_grad_window
+                else self.core.indexed_window
+            )
+            out = fn(params, state, opt_state, rng, *self._resident, idx)
+        else:
+            zeros = {k: np.zeros_like(v) for k, v in batch.items()}
+            xs, ys = stack_window(
+                [zeros] * self.window_size, self.features_col, self.label_col
+            )
+            fn = (
+                self.core.grad_window
+                if self.uses_grad_window
+                else self.core.window
+            )
+            out = fn(params, state, opt_state, rng, xs, ys)
+        jax.block_until_ready(out)
+
+    def stage_resident(self, dataset):
+        """Ship this worker's partition to device memory ONCE; subsequent
+        windows stream only the (W, B) int32 index matrices
+        (``begin_window_indexed``) — the async face of the device-resident
+        input path (same 4-bytes/sample/window host-traffic contract as
+        ``SingleTrainerWorker._train_resident``)."""
+        if self._resident is not None and self._resident_n == len(dataset):
+            return  # already staged (warmup or a retry): the pool is the same
+        data_x, data_y = resident_arrays(
+            dataset, self.features_col, self.label_col
+        )
+        self._resident_n = data_x.shape[0]
+        if self.device is not None:
+            self._resident = jax.device_put((data_x, data_y), self.device)
+        else:
+            self._resident = jax.device_put((data_x, data_y))
+
+    def iter_index_windows(self, num_epoch, batch_size, shuffle_seed):
+        """The resident twin of ``iter_window_batches``: (W, B) index
+        matrices, one per commit, across all epochs. Routed through
+        ``epoch_index_windows`` so the batch-assembly contract (and with it
+        the resume-skip stream alignment) is bit-identical to the streamed
+        window stream."""
+        for epoch in range(num_epoch):
+            yield from epoch_index_windows(
+                self._resident_n, batch_size, self.window_size,
+                shuffle_seed, epoch,
+            )
+
+    def begin_window_indexed(self, idx):
+        """``begin_window`` over the device-resident pool: pull + launch,
+        shipping only the index matrix for this window."""
+        center_host, tag = self.ps.pull(worker_id=self.worker_id)
+        center = (
+            jax.device_put(center_host, self.device)
+            if self.device is not None
+            else center_host
+        )
+        self._ensure_initialized(center)
+        self.on_pull(center, tag)
+        data_x, data_y = self._resident
+        samples = int(idx.size)
+        if self.device is not None:
+            idx = jax.device_put(np.ascontiguousarray(idx), self.device)
+        fn = (
+            self.core.indexed_grad_window
+            if self.uses_grad_window
+            else self.core.indexed_window
+        )
+        out = fn(
+            self._params, self._state, self._opt_state, self.rng,
+            data_x, data_y, idx,
+        )
+        self._pending = {
+            "pulled": (center_host, tag),
+            "out": out,
+            "samples": samples,
+            "t0": time.perf_counter(),
+        }
+
     def finish_window(self):
         pend = self._pending
         self._pending = None
@@ -682,10 +812,27 @@ class AsyncWorker:
             if pend:
                 yield pend
 
-    def train(self, dataset, batch_size, num_epoch=1, shuffle_seed=None):
+    def train(self, dataset, batch_size, num_epoch=1, shuffle_seed=None,
+              device_resident=False):
         """Thread-mode entry: run all windows of this worker's partition,
         skipping the first ``_start_seq`` after a resume (their commits are
-        already in the restored center)."""
+        already in the restored center).
+
+        ``device_resident``: ship the partition to HBM once and drive the
+        indexed window programs with per-epoch index matrices. The window
+        stream (same shuffles, same batch contents, same ragged tails) is
+        bit-identical to the streamed path, so commit seqs — and with them
+        resume skipping and PS dedup — stay aligned across the two modes."""
+        if device_resident:
+            self.stage_resident(dataset)
+            for i, idx in enumerate(
+                self.iter_index_windows(num_epoch, batch_size, shuffle_seed)
+            ):
+                if i < self._start_seq:
+                    continue
+                self.begin_window_indexed(idx)
+                self.finish_window()
+            return self.records
         for i, pend in enumerate(
             self.iter_window_batches(dataset, batch_size, num_epoch, shuffle_seed)
         ):

@@ -1,0 +1,124 @@
+"""Device-resident feed for the ASYNC trainer family.
+
+The async algorithms are the reference's heart (SURVEY §3.3: async PS data
+parallelism is "the entire framework"); round 3 gives them the same
+HBM-resident input path SingleTrainer has. The parity bar is strict: the
+resident window stream is defined to be bit-identical to the streamed one
+(same shuffles, same batch contents, same ragged tails), and the simulated
+scheduler depends only on queue lengths — so a seeded simulated run must
+produce the SAME center, bit for bit, through either feed.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import ADAG, AEASGD, DOWNPOUR, DynSGD, EAMSGD
+from distkeras_tpu.data import loaders
+from distkeras_tpu.data.transformers import MinMaxTransformer, OneHotTransformer
+from distkeras_tpu.evaluators import AccuracyEvaluator
+from distkeras_tpu.models import zoo
+from distkeras_tpu.predictors import ModelPredictor
+
+
+def make_data(n=1024, seed=0):
+    ds = loaders.synthetic_mnist(n=n, seed=seed)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    return ds.split(0.85, seed=seed)
+
+
+def _trainer(cls, model, **extra):
+    kw = dict(
+        loss="categorical_crossentropy",
+        learning_rate=0.02,
+        batch_size=32,
+        num_epoch=2,
+        num_workers=4,
+        communication_window=4,
+        label_col="label_onehot",
+        mode="simulated",
+        seed=0,
+    )
+    kw.update(extra)
+    return cls(model, "sgd", **kw)
+
+
+@pytest.mark.parametrize(
+    "cls,extra",
+    [
+        (DOWNPOUR, {}),
+        (ADAG, {"learning_rate": 0.05}),  # exercises indexed_grad_window
+        (AEASGD, {"rho": 10.0}),
+        (EAMSGD, {"rho": 10.0, "momentum": 0.9}),  # momentum opt_state
+        (DynSGD, {}),
+    ],
+    ids=lambda v: v.__name__ if isinstance(v, type) else "",
+)
+def test_simulated_resident_bitequals_streamed(cls, extra):
+    train, _ = make_data()
+    streamed = _trainer(cls, zoo.mnist_mlp(hidden=32), **extra).train(train)
+    resident = _trainer(
+        cls, zoo.mnist_mlp(hidden=32), device_resident=True, **extra
+    ).train(train)
+    for ws, wr in zip(streamed.get_weights(), resident.get_weights()):
+        np.testing.assert_array_equal(ws, wr)
+
+
+def test_threads_resident_converges():
+    train, test = make_data()
+    t = _trainer(
+        DOWNPOUR, zoo.mnist_mlp(hidden=32),
+        mode="threads", num_epoch=3, device_resident=True,
+    )
+    trained = t.train(train)
+    pred = ModelPredictor(trained, batch_size=256).predict(test)
+    acc = AccuracyEvaluator(label_col="label").evaluate(pred)
+    assert acc > 0.8, acc
+    # every worker committed through the indexed path
+    assert {wid for wid in range(4) if t.get_history(wid)} == {0, 1, 2, 3}
+
+
+def test_resident_resume_stream_alignment(tmp_path):
+    """A checkpoint written by a STREAMED run resumes through the RESIDENT
+    feed (and trains further) — the two feeds share one window-stream
+    definition, so commit seqs map to the same positions."""
+    train, _ = make_data(n=512)
+
+    t1 = _trainer(
+        DOWNPOUR, zoo.mnist_mlp(hidden=32),
+        checkpoint_dir=str(tmp_path), checkpoint_every=3, num_epoch=1,
+    )
+    t1.train(train)
+    updates_before = t1.parameter_server.num_updates
+
+    t2 = _trainer(
+        DOWNPOUR, zoo.mnist_mlp(hidden=32),
+        checkpoint_dir=str(tmp_path), device_resident=True, num_epoch=2,
+    )
+    t2.train(train, resume=True)
+    assert t2.parameter_server.num_updates >= updates_before
+
+
+def test_streaming_dataset_rejected():
+    """StreamingDataset exists for data that does NOT fit in memory; the
+    resident path must refuse it loudly, not crash obscurely."""
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.workers import DOWNPOURWorker, WorkerCore
+
+    class _FakeStream:
+        def __len__(self):
+            return 128
+
+        def __getitem__(self, key):
+            raise TypeError("streaming datasets cannot be column-indexed")
+
+    model = zoo.mnist_mlp(hidden=8)
+    core = WorkerCore(model, get_optimizer("sgd", 0.01), "categorical_crossentropy")
+
+    class _NullPS:
+        def pull(self, worker_id=None):
+            raise AssertionError("should fail before any pull")
+
+    w = DOWNPOURWorker(core, _NullPS(), 0, "features", "label_onehot", 4)
+    with pytest.raises(TypeError, match="device_resident=True requires"):
+        w.train(_FakeStream(), 32, device_resident=True)
