@@ -108,12 +108,20 @@ def main() -> None:
             f"fused layernorm to {fused_ln}",
             flush=True,
         )
-    core = WorkerCore(
-        model,
-        get_optimizer("adam", 1e-3),
-        "categorical_crossentropy",
-        compute_dtype="bfloat16",
-    )
+    # the fused path is one unit: flash attention + one-pass LayerNorm +
+    # single-VMEM-pass Adam; dense keeps the generic optax adam it is
+    # judged against (both are numerically the same update)
+    opt_name = "pallas_adam" if args.attention == "flash" else "adam"
+
+    def make_core(name):
+        return WorkerCore(
+            model,
+            get_optimizer(name, 1e-3),
+            "categorical_crossentropy",
+            compute_dtype="bfloat16",
+        )
+
+    core = make_core(opt_name)
 
     n_data = batch * 8
     rng = np.random.default_rng(0)
@@ -130,11 +138,24 @@ def main() -> None:
     opt_state = core.init_opt_state(params)
     key = jax.random.PRNGKey(0)
 
-    xla_flops_per_window = _flops_per_call(
-        core.indexed_window.lower(
+    try:
+        compiled = core.indexed_window.lower(
             params, state, opt_state, key, data_x, data_y, fresh_idx()
         ).compile()
-    )
+    except Exception as e:
+        if opt_name == "adam":
+            raise
+        # a fused-optimizer lowering failure must not cost the window the
+        # attention A/B — fall back to the generic adam and keep measuring
+        print(f"{opt_name} failed to compile ({type(e).__name__}); "
+              "falling back to adam", flush=True)
+        opt_name = "adam"
+        core = make_core(opt_name)
+        opt_state = core.init_opt_state(params)
+        compiled = core.indexed_window.lower(
+            params, state, opt_state, key, data_x, data_y, fresh_idx()
+        ).compile()
+    xla_flops_per_window = _flops_per_call(compiled)
     # MFU uses the ANALYTIC model-flops count (the conventional definition,
     # and the only one that stays comparable across attention paths: XLA's
     # cost model cannot see inside Pallas custom calls, so the flash path
@@ -170,6 +191,7 @@ def main() -> None:
         "device_kind": dev.device_kind,
         "model": f"transformer d{d_model} L{depth} seq{seq} bf16",
         "attention": args.attention,
+        "optimizer": opt_name,
         "fused_layernorm_layers": fused_ln,
         "batch": batch,
         # finite => real compute happened; non-finite goes out as a string

@@ -511,12 +511,21 @@ class TransformerBlock(Layer):
     long-context vocabulary: with ``parallel.ring_attention`` attached to
     the inner attention (see ``attach_ring_attention``) the block runs with
     the sequence axis sharded over a mesh.
+
+    ``remat=True`` wraps the block in ``jax.checkpoint``: the backward pass
+    recomputes the block's activations instead of holding them through the
+    whole forward — activation memory drops from O(depth) blocks to O(1)
+    per block at ~1/3 extra FLOPs, the standard TPU HBM<->FLOPs trade that
+    makes deep/long-sequence training fit. Numerics are unchanged (pinned
+    by test). No reference counterpart (the reference has no attention and
+    delegates memory to the Keras backend).
     """
 
-    def __init__(self, num_heads, mlp_ratio=4, causal=False):
+    def __init__(self, num_heads, mlp_ratio=4, causal=False, remat=False):
         self.num_heads = int(num_heads)
         self.mlp_ratio = int(mlp_ratio)
         self.causal = bool(causal)
+        self.remat = bool(remat)
         self.mhsa = MultiHeadSelfAttention(self.num_heads, causal=self.causal)
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
@@ -548,6 +557,14 @@ class TransformerBlock(Layer):
         return params, state, in_shape
 
     def apply(self, params, state, x, train=False, rng=None):
+        if self.remat:
+            import functools
+
+            fn = jax.checkpoint(functools.partial(self._apply, train=train))
+            return fn(params, state, x, rng)
+        return self._apply(params, state, x, rng, train=train)
+
+    def _apply(self, params, state, x, rng, train=False):
         new_state = dict(state)
         h, new_state["ln1"] = self.ln1.apply(params["ln1"], state["ln1"], x)
         a, new_state["mhsa"] = self.mhsa.apply(
@@ -565,6 +582,7 @@ class TransformerBlock(Layer):
             "num_heads": self.num_heads,
             "mlp_ratio": self.mlp_ratio,
             "causal": self.causal,
+            "remat": self.remat,
         }
 
 

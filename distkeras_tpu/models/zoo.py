@@ -120,12 +120,14 @@ def transformer_classifier(
     depth=2,
     num_classes=2,
     seed=0,
+    remat=False,
 ):
     """Sequence classifier: Embedding -> TransformerBlock xN -> mean-pool
     -> softmax head. No reference counterpart (SURVEY §5.7: no attention
     upstream); the rebuild's long-context model family. Pair with
     ``parallel.ring_attention.attach_ring_attention`` to shard the sequence
-    axis over a mesh."""
+    axis over a mesh; ``remat=True`` checkpoints each block so activation
+    memory stays O(1) in depth (the long-context HBM trade)."""
     from distkeras_tpu.models.layers import (
         Dense,
         Embedding,
@@ -138,7 +140,7 @@ def transformer_classifier(
     model = Sequential(
         [
             Embedding(vocab_size, d_model),
-            *[TransformerBlock(num_heads) for _ in range(depth)],
+            *[TransformerBlock(num_heads, remat=remat) for _ in range(depth)],
             LayerNorm(),
             GlobalAvgPool1D(),
             Dense(num_classes, activation="softmax"),

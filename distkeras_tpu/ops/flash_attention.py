@@ -98,11 +98,20 @@ def _fwd_kernel(causal, scale, bk, q_ref, k_ref, v_ref, o_ref, lse_ref):
     )
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = jnp.where(jnp.isneginf(m), -jnp.inf, m + jnp.log(l_safe))
+    lse = jnp.where(jnp.isneginf(m), -jnp.inf, m + jnp.log(l_safe))
+    lse_ref[0, 0] = lse[:, None]
 
 
 def _fwd(q, k, v, causal, bq, bk, interpret):
-    """(B, H, T, D) -> (out, lse). lse is the scaled-score logsumexp."""
+    """(B, H, T, D) -> (out, lse). lse is the scaled-score logsumexp.
+
+    Row statistics (lse, and delta in the backward) travel as
+    (B, H, T, 1): Mosaic requires a block's last two dims to be divisible
+    by (8, 128) or equal to the array's — a (1, 1, bq) block on a
+    (B, H, T) array has block[-2] == 1 != H and fails to lower on real
+    TPU (the CPU interpreter never checks). With a trailing singleton the
+    row block is (bq, 1): bq % 8 == 0 and 1 == array's last dim.
+    """
     b, h, t, d = q.shape
     scale = 1.0 / (d**0.5)
     grid = (b, h, t // bq)
@@ -112,13 +121,13 @@ def _fwd(q, k, v, causal, bq, bk, interpret):
         functools.partial(_fwd_kernel, causal, scale, bk),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
         ),
         grid=grid,
         in_specs=[qspec, kvspec, kvspec],
         out_specs=(
             qspec,
-            pl.BlockSpec((1, 1, bq), lambda i, j, iq: (i, j, iq)),
+            pl.BlockSpec((1, 1, bq, 1), lambda i, j, iq: (i, j, iq, 0)),
         ),
         interpret=interpret,
     )(q, k, v)
@@ -135,8 +144,8 @@ def _dq_kernel(
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)  # (bq, D)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]  # (bq,)
-    delta = delta_ref[0, 0]
+    lse = lse_ref[0, 0][:, 0]  # (bq, 1) block -> (bq,)
+    delta = delta_ref[0, 0][:, 0]
     bq, d = q.shape
     nk = k_ref.shape[2] // bk
     if causal:
@@ -184,8 +193,8 @@ def _dkv_kernel(
         dk, dv = carry
         q_blk = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
         do_blk = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, 0, pl.ds(i * bq, bq)]
-        delta_blk = delta_ref[0, 0, pl.ds(i * bq, bq)]
+        lse_blk = lse_ref[0, 0, pl.ds(i * bq, bq), :][:, 0]
+        delta_blk = delta_ref[0, 0, pl.ds(i * bq, bq), :][:, 0]
         shift = jnp.where(jnp.isneginf(lse_blk), 0.0, lse_blk)
         s = scale * jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
@@ -222,13 +231,17 @@ def _bwd(causal, bq, bk, interpret, residuals, dout):
     b, h, t, d = q.shape
     scale = 1.0 / (d**0.5)
     # delta_i = sum_d do_i * o_i — rowwise, cheap in XLA, shared by both
-    # backward kernels (the FlashAttention-2 trick that removes dp row sums)
-    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # backward kernels (the FlashAttention-2 trick that removes dp row sums);
+    # keepdims: row stats travel as (B, H, T, 1), see _fwd's layout note
+    delta = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
 
     qspec = pl.BlockSpec((1, 1, bq, d), lambda i, j, g: (i, j, g, 0))
     full = pl.BlockSpec((1, 1, t, d), lambda i, j, g: (i, j, 0, 0))
-    rowq = pl.BlockSpec((1, 1, bq), lambda i, j, g: (i, j, g))
-    rowf = pl.BlockSpec((1, 1, t), lambda i, j, g: (i, j, 0))
+    rowq = pl.BlockSpec((1, 1, bq, 1), lambda i, j, g: (i, j, g, 0))
+    rowf = pl.BlockSpec((1, 1, t, 1), lambda i, j, g: (i, j, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal, scale, bk),

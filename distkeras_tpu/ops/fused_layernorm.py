@@ -82,9 +82,11 @@ def _bwd_kernel(eps, x_ref, g_ref, dy_ref, dx_ref, dg_ref, db_ref):
     m1 = jnp.mean(a, axis=1, keepdims=True)
     m2 = jnp.mean(a * xhat, axis=1, keepdims=True)
     dx_ref[:] = (rstd * (a - m1 - xhat * m2)).astype(dx_ref.dtype)
-    # per-block partial sums; XLA reduces the block axis afterwards
-    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+    # per-block partial sums; XLA reduces the block axis afterwards. The
+    # refs are (1, 1, d) blocks — see _bwd's layout note on why the block
+    # axis needs its own leading dim on real TPU.
+    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)[None]
+    db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)[None]
 
 
 def _pad_rows(mat, block_rows):
@@ -124,12 +126,18 @@ def _bwd(x2, gamma, dy2, eps, block_rows, interpret):
     xp = _pad_rows(x2, block_rows)
     dyp = _pad_rows(dy2, block_rows)  # zero rows: zero dx and zero partials
     nblocks = xp.shape[0] // block_rows
+    # dgamma/dbeta partials are (nblocks, 1, d) with (1, 1, d) blocks:
+    # Mosaic requires a block's last two dims divisible by (8, 128) or
+    # equal to the array's — a (1, d) block on a (nblocks, d) array has
+    # block[-2] == 1 != nblocks and fails to lower on real TPU (the CPU
+    # interpreter never checks). With the block axis leading, the last two
+    # dims are (1, d) == the array's own (1, d).
     dx, dg_part, db_part = pl.pallas_call(
         functools.partial(_bwd_kernel, eps),
         out_shape=(
             jax.ShapeDtypeStruct(xp.shape, x2.dtype),
-            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
-            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, 1, d), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, 1, d), jnp.float32),
         ),
         grid=(nblocks,),
         in_specs=_row_specs(1, block_rows, d)
@@ -137,12 +145,12 @@ def _bwd(x2, gamma, dy2, eps, block_rows, interpret):
         + _row_specs(1, block_rows, d),
         out_specs=(
             _row_specs(1, block_rows, d)[0],
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, d), lambda i: (i, 0, 0)),
         ),
         interpret=interpret,
     )(xp, gamma.astype(jnp.float32)[None], dyp)
-    return dx[:n], jnp.sum(dg_part, axis=0), jnp.sum(db_part, axis=0)
+    return dx[:n], jnp.sum(dg_part, axis=(0, 1)), jnp.sum(db_part, axis=(0, 1))
 
 
 # -------------------------------------------------------------- custom VJP

@@ -26,9 +26,18 @@ def _pallas_sgd(learning_rate=0.01, momentum=0.0, nesterov=False):
     return FusedSGD(learning_rate, momentum=momentum, nesterov=nesterov)
 
 
+def _pallas_adam(learning_rate=1e-3, **kwargs):
+    """Fused single-pass Adam update as a Pallas TPU kernel (see
+    ops/pallas_kernels.py); numerically identical to "adam"."""
+    from distkeras_tpu.ops.pallas_kernels import FusedAdam
+
+    return FusedAdam(learning_rate, **kwargs)
+
+
 _OPTIMIZERS = {
     "sgd": _sgd,
     "pallas_sgd": _pallas_sgd,
+    "pallas_adam": _pallas_adam,
     "adam": optax.adam,
     "adamw": optax.adamw,
     "adagrad": optax.adagrad,
@@ -38,7 +47,8 @@ _OPTIMIZERS = {
     "lamb": optax.lamb,
 }
 
-_DEFAULT_LR = {"sgd": 0.01, "pallas_sgd": 0.01, "adam": 1e-3, "adamw": 1e-3,
+_DEFAULT_LR = {"sgd": 0.01, "pallas_sgd": 0.01, "pallas_adam": 1e-3,
+               "adam": 1e-3, "adamw": 1e-3,
                "adagrad": 1e-2, "adadelta": 1e-3, "rmsprop": 1e-3,
                "nadam": 1e-3, "lamb": 1e-3}
 

@@ -100,3 +100,40 @@ def test_residual_shape_mismatch_raises():
 
     with pytest.raises(ValueError):
         Sequential([Residual([Dense(8)])]).build((4,))
+
+
+def test_transformer_remat_matches_dense_training():
+    """remat=True must be a pure memory/FLOPs trade: one training window
+    produces (numerically) the same params and losses as remat=False."""
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.workers import WorkerCore
+
+    out = {}
+    for remat in (False, True):
+        m = zoo.transformer_classifier(
+            seq_len=16, d_model=32, depth=2, num_classes=4, seed=0,
+            remat=remat,
+        )
+        core = WorkerCore(
+            m, get_optimizer("adam", 1e-3), "categorical_crossentropy"
+        )
+        rng = np.random.default_rng(0)
+        xs = rng.integers(0, 64, (4, 8, 16)).astype(np.int32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (4, 8))]
+        p, s, o = m.params, m.state, core.init_opt_state(m.params)
+        p, s, o, _, metr = core.window(p, s, o, jax.random.PRNGKey(0), xs, ys)
+        out[remat] = (jax.tree.leaves(p), np.asarray(metr["loss"]))
+    np.testing.assert_allclose(out[False][1], out[True][1], atol=1e-6)
+    for a, b in zip(out[False][0], out[True][0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_transformer_remat_config_roundtrip():
+    m = zoo.transformer_classifier(
+        seq_len=8, d_model=16, depth=1, num_classes=2, remat=True
+    )
+    m2 = deserialize_model(serialize_model(m))
+    blocks = [l for l in m2.layers if type(l).__name__ == "TransformerBlock"]
+    assert blocks and all(b.remat for b in blocks)
+    x = np.random.default_rng(0).integers(0, 64, (2, 8)).astype(np.int32)
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)), atol=1e-6)
