@@ -13,7 +13,9 @@ calls; the cost-model number is reported alongside as
 ``xla_cost_tflops_per_sec`` for the dense-path cross-check. Peak is the
 device generation's published bf16 number (bench.py's table).
 
-Writes BENCH_MFU.json and prints one JSON line:
+``measure()`` is the reusable harness (``tools/mfu_attrib.py`` sweeps it to
+attribute the fused-path pieces one at a time); ``main()`` is the capture
+entry that writes BENCH_MFU.json and prints one JSON line:
     {"metric": "transformer_train_mfu", "value": ..., "unit": "fraction",
      "attention": "flash"|"dense", "samples_per_sec": ...,
      "tflops_per_sec": ..., "xla_cost_tflops_per_sec": ..., ...}
@@ -35,53 +37,44 @@ import numpy as np
 from bench import _flops_per_call, _peak_flops, resolve_backend, sync_fetch
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--cpu", action="store_true")
-    ap.add_argument(
-        "--attention",
-        choices=["auto", "flash", "dense"],
-        default="auto",
-        help="flash = fused Pallas kernels (ops/flash_attention); dense = "
-        "XLA dense attention (the baseline the kernel is judged against). "
-        "auto picks flash on TPU and dense elsewhere — off-TPU the Pallas "
-        "interpreter would measure interpreter overhead, not the framework",
-    )
-    args = ap.parse_args()
+def measure(
+    platform,
+    attention="dense",
+    fused_ln=None,
+    opt_name=None,
+    block_q=None,
+    block_k=None,
+    seq=None,
+    d_model=None,
+    depth=None,
+    batch=None,
+):
+    """One MFU measurement on the current backend; returns the record dict.
 
-    if args.cpu:
-        from distkeras_tpu.parallel.mesh import force_cpu_mesh
-
-        force_cpu_mesh(1)
-        platform = "cpu"
-    else:
-        resolved = resolve_backend()
-        if resolved is None:
-            raise SystemExit("no JAX backend could be initialized")
-        platform, config_pin = resolved
-        import jax
-
-        if config_pin is not None:
-            jax.config.update("jax_platforms", config_pin)
-
+    ``fused_ln``/``opt_name`` default to the measured-best configuration
+    (MFU_ATTRIB.jsonl on v5e: XLA's fused LayerNorm and optax adam beat
+    the hand kernels at this size — only the attention kernel pays, once
+    its blocks are MXU-sized). Pass them explicitly to measure the other
+    pieces. Shape overrides exist for scaling studies; the defaults are
+    the round-comparable config.
+    """
     import jax
 
     from distkeras_tpu.models.zoo import transformer_classifier
     from distkeras_tpu.ops.optimizers import get_optimizer
-    from distkeras_tpu.utils.compile_cache import enable_compile_cache
     from distkeras_tpu.workers import WorkerCore
 
-    enable_compile_cache(platform=platform)
     on_cpu = platform == "cpu"
-
-    seq, d_model, depth, heads = (64, 128, 2, 4) if on_cpu else (512, 512, 8, 8)
-    batch = 8 if on_cpu else 64
+    dseq, dd, ddepth, heads = (64, 128, 2, 4) if on_cpu else (512, 512, 8, 8)
+    seq = dseq if seq is None else seq
+    d_model = dd if d_model is None else d_model
+    depth = ddepth if depth is None else depth
+    batch = (8 if on_cpu else 64) if batch is None else batch
     window = 2 if on_cpu else 8
     vocab, n_classes = 8192, 16
     warmup, timed = (1, 2) if on_cpu else (2, 6)
 
     dev = jax.devices()[0]
-    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
 
     model = transformer_classifier(
         vocab_size=vocab,
@@ -92,26 +85,27 @@ def main() -> None:
         num_classes=n_classes,
         seed=0,
     )
-    if args.attention == "auto":
-        args.attention = "dense" if on_cpu else "flash"
-    fused_ln = 0
-    if args.attention == "flash":
-        from distkeras_tpu.ops.flash_attention import attach_flash_attention
+    if fused_ln is None:
+        fused_ln = False
+    if opt_name is None:
+        opt_name = "adam"
+    attached_ln = 0
+    if attention == "flash":
+        from distkeras_tpu.ops.flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+            attach_flash_attention,
+        )
+
+        # None -> the module's tuned defaults (512 as of MFU_ATTRIB.jsonl);
+        # a measure() default here would silently shadow future retuning
+        block_q = DEFAULT_BLOCK_Q if block_q is None else block_q
+        block_k = DEFAULT_BLOCK_K if block_k is None else block_k
+        attach_flash_attention(model, block_q=block_q, block_k=block_k)
+    if fused_ln:
         from distkeras_tpu.ops.fused_layernorm import attach_fused_layernorm
 
-        attached = attach_flash_attention(model)
-        # the fused path is measured as a unit: flash attention + one-pass
-        # LayerNorm (off-TPU both would measure the Pallas interpreter)
-        fused_ln = attach_fused_layernorm(model)
-        print(
-            f"flash attention attached to {attached} layers, "
-            f"fused layernorm to {fused_ln}",
-            flush=True,
-        )
-    # the fused path is one unit: flash attention + one-pass LayerNorm +
-    # single-VMEM-pass Adam; dense keeps the generic optax adam it is
-    # judged against (both are numerically the same update)
-    opt_name = "pallas_adam" if args.attention == "flash" else "adam"
+        attached_ln = attach_fused_layernorm(model)
 
     def make_core(name):
         return WorkerCore(
@@ -190,9 +184,9 @@ def main() -> None:
         "platform": platform,
         "device_kind": dev.device_kind,
         "model": f"transformer d{d_model} L{depth} seq{seq} bf16",
-        "attention": args.attention,
+        "attention": attention,
         "optimizer": opt_name,
-        "fused_layernorm_layers": fused_ln,
+        "fused_layernorm_layers": attached_ln,
         "batch": batch,
         # finite => real compute happened; non-finite goes out as a string
         # so the artifact stays strictly-valid JSON
@@ -208,9 +202,56 @@ def main() -> None:
             else None
         ),
     }
+    if attention == "flash":
+        # always recorded: an artifact must say which kernel config it
+        # measured (blocks clamp to seq inside flash_attention for short T)
+        record["block_q"], record["block_k"] = block_q, block_k
     peak = _peak_flops(dev)
     if peak is not None:
         record["value"] = round(fps / peak, 4)
+    return record
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument(
+        "--attention",
+        choices=["auto", "flash", "dense"],
+        default="auto",
+        help="flash = fused Pallas kernels (ops/flash_attention); dense = "
+        "XLA dense attention (the baseline the kernel is judged against). "
+        "auto picks flash on TPU and dense elsewhere — off-TPU the Pallas "
+        "interpreter would measure interpreter overhead, not the framework",
+    )
+    args = ap.parse_args()
+
+    if args.cpu:
+        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(1)
+        platform = "cpu"
+    else:
+        resolved = resolve_backend()
+        if resolved is None:
+            raise SystemExit("no JAX backend could be initialized")
+        platform, config_pin = resolved
+        import jax
+
+        if config_pin is not None:
+            jax.config.update("jax_platforms", config_pin)
+
+    import jax
+
+    from distkeras_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(platform=platform)
+    if args.attention == "auto":
+        args.attention = "dense" if platform == "cpu" else "flash"
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+    record = measure(platform, attention=args.attention)
     with open("BENCH_MFU.json", "w") as f:
         json.dump(record, f, indent=2)
     print(json.dumps(record))

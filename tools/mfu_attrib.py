@@ -1,0 +1,88 @@
+"""Attribute the fused-path MFU delta one piece at a time (on-chip sweep).
+
+The capture sweep's flash number changes three things at once (flash
+attention + fused LayerNorm + pallas_adam), so a regression in any one of
+them hides inside the bundle. This tool measures each attachment in
+isolation against the dense/adam baseline, plus flash block-size variants,
+and appends one JSON line per configuration to MFU_ATTRIB.jsonl.
+
+Run from the repo root when the tunnel is healthy:
+    python tools/mfu_attrib.py [--quick]
+(--quick drops the block-size variants.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import resolve_backend  # noqa: E402
+from bench_mfu import measure  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--long", action="store_true",
+        help="long-sequence A/B instead: seq 2048, depth 4, batch 8 — "
+        "where dense attention's (B,H,T,T) HBM scores stop being free",
+    )
+    args = ap.parse_args()
+
+    resolved = resolve_backend()
+    if resolved is None or resolved[0] != "tpu":
+        raise SystemExit("attribution sweep needs the real TPU")
+    platform, config_pin = resolved
+    import jax
+
+    if config_pin is not None:
+        jax.config.update("jax_platforms", config_pin)
+    from distkeras_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(platform=platform)
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+
+    configs = [
+        ("baseline dense+adam", {}),
+        ("pallas_adam only", {"opt_name": "pallas_adam"}),
+        ("fused_ln only", {"fused_ln": True}),
+        ("flash only", {"attention": "flash", "fused_ln": False,
+                        "opt_name": "adam"}),
+        ("flash bundle", {"attention": "flash", "fused_ln": True,
+                          "opt_name": "pallas_adam"}),
+    ]
+    if not args.quick:
+        configs += [
+            (f"flash only bq{bq} bk{bk}",
+             {"attention": "flash", "fused_ln": False, "opt_name": "adam",
+              "block_q": bq, "block_k": bk})
+            for bq, bk in [(256, 256), (512, 512), (256, 512)]
+        ]
+    if args.long:
+        shape = {"seq": 2048, "depth": 4, "batch": 8}
+        configs = [
+            ("dense seq2048", dict(shape)),
+            ("flash seq2048", {"attention": "flash", **shape}),
+        ]
+
+    with open("MFU_ATTRIB.jsonl", "a") as f:
+        for label, kw in configs:
+            try:
+                rec = measure(platform, **kw)
+            except Exception as e:  # tunnel death mid-sweep: keep the rest
+                rec = {"label": label, "error": f"{type(e).__name__}: {e}"}
+            else:
+                rec["label"] = label
+            print(json.dumps(rec), flush=True)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+
+
+if __name__ == "__main__":
+    main()

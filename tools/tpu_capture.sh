@@ -61,6 +61,13 @@ else
   git checkout -- BENCH_MFU.json 2>/dev/null || true
 fi
 
+# --- 2b. long-context A/B: flash vs dense at seq 2048 --------------------
+# (where dense attention's (B,H,T,T) HBM scores stop being free; rows
+# append to MFU_ATTRIB.jsonl with labels "dense seq2048"/"flash seq2048")
+timeout 900 python tools/mfu_attrib.py --long >> "$LOG" 2>>"$LOG.err"
+commit_snap "Harvest TPU window: long-context attention A/B" \
+  MFU_ATTRIB.jsonl "$LOG" "$LOG.err"
+
 # --- 3. prefetch A/B on the host-staged input path -----------------------
 timeout 900 python - >> "$LOG" 2>>"$LOG.err" <<'EOF'
 # prefetch A/B on the host-staged input path (in-memory Dataset, per-window
