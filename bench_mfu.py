@@ -203,9 +203,17 @@ def measure(
         ),
     }
     if attention == "flash":
+        from distkeras_tpu.ops.flash_attention import effective_path
+
         # always recorded: an artifact must say which kernel config it
-        # measured (blocks clamp to seq inside flash_attention for short T)
+        # measured (blocks clamp to seq for short T), and which path the
+        # dispatch ACTUALLY ran — flash silently falls back to blockwise
+        # (VMEM budget) or dense (non-tiling T) at some shapes, and an
+        # A/B row must not attribute a fallback's numbers to the kernel
         record["block_q"], record["block_k"] = block_q, block_k
+        record["effective_attention"] = effective_path(
+            seq, d_model // heads, block_q, block_k
+        )
     peak = _peak_flops(dev)
     if peak is not None:
         record["value"] = round(fps / peak, 4)

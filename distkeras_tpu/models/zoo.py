@@ -150,6 +150,46 @@ def transformer_classifier(
     return model
 
 
+def transformer_lm(
+    vocab_size=256,
+    seq_len=128,
+    d_model=128,
+    num_heads=4,
+    depth=2,
+    seed=0,
+    remat=False,
+):
+    """Causal language model: Embedding -> causal TransformerBlock xN ->
+    LayerNorm -> logits over the vocabulary (no softmax; pair with the
+    ``next_token_crossentropy`` loss, which shifts targets by one). No
+    reference counterpart (SURVEY §5.7: no sequence models upstream); this
+    is the rebuild's autoregressive long-context family — causal blocks
+    compose with ``attach_flash_attention`` (masked-block skipping),
+    ``attach_blockwise_attention``, and the ring-attention SP trainer the
+    same way the classifier does."""
+    from distkeras_tpu.models.layers import (
+        Dense,
+        Embedding,
+        LayerNorm,
+        TransformerBlock,
+    )
+    from distkeras_tpu.models.sequential import Sequential
+
+    model = Sequential(
+        [
+            Embedding(vocab_size, d_model),
+            *[
+                TransformerBlock(num_heads, causal=True, remat=remat)
+                for _ in range(depth)
+            ],
+            LayerNorm(),
+            Dense(vocab_size),
+        ]
+    )
+    model.build((seq_len,), seed=seed)
+    return model
+
+
 def moe_transformer_classifier(
     vocab_size=64,
     seq_len=64,

@@ -37,6 +37,19 @@ def sparse_categorical_crossentropy(y_pred, y_true):
     return -jnp.mean(ll)
 
 
+def next_token_crossentropy(y_pred, y_true):
+    """Causal-LM loss: y_pred = logits (B, T, V); y_true = token ids (B, T).
+
+    Position t's logits predict token t+1 (the standard shift); the last
+    position has no target and is dropped. Mean over B*(T-1) predictions.
+    No reference counterpart (no sequence models upstream — SURVEY §5.7);
+    pairs with ``zoo.transformer_lm``'s causal blocks."""
+    logp = nn.log_softmax(y_pred[:, :-1].astype(jnp.float32), axis=-1)
+    targets = y_true[:, 1:].astype(jnp.int32)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
 def binary_crossentropy(y_pred, y_true):
     p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
     return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
@@ -54,6 +67,7 @@ _LOSSES = {
     "categorical_crossentropy": categorical_crossentropy,
     "categorical_crossentropy_from_logits": categorical_crossentropy_from_logits,
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "next_token_crossentropy": next_token_crossentropy,
     "binary_crossentropy": binary_crossentropy,
     "mse": mse,
     "mean_squared_error": mse,

@@ -12,7 +12,19 @@ def accuracy(y_pred, y_true):
     return jnp.mean((pred_ids == true_ids).astype(jnp.float32))
 
 
-_METRICS = {"accuracy": accuracy, "acc": accuracy}
+def next_token_accuracy(y_pred, y_true):
+    """Causal-LM companion to ``losses.next_token_crossentropy``: position
+    t's logits (B, T, V) are scored against token t+1 (B, T)."""
+    pred_ids = jnp.argmax(y_pred[:, :-1], axis=-1)
+    return jnp.mean((pred_ids == y_true[:, 1:].astype(pred_ids.dtype))
+                    .astype(jnp.float32))
+
+
+_METRICS = {
+    "accuracy": accuracy,
+    "acc": accuracy,
+    "next_token_accuracy": next_token_accuracy,
+}
 
 
 def get_metric(name):

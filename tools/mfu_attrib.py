@@ -32,6 +32,11 @@ def main() -> None:
         help="long-sequence A/B instead: seq 2048, depth 4, batch 8 — "
         "where dense attention's (B,H,T,T) HBM scores stop being free",
     )
+    ap.add_argument(
+        "--scale", action="store_true",
+        help="MXU scaling rows instead: d_model 1024 and batch 128 — "
+        "how MFU moves when the matmuls widen / batch fills the array",
+    )
     args = ap.parse_args()
 
     resolved = resolve_backend()
@@ -52,8 +57,11 @@ def main() -> None:
         ("baseline dense+adam", {}),
         ("pallas_adam only", {"opt_name": "pallas_adam"}),
         ("fused_ln only", {"fused_ln": True}),
-        ("flash only", {"attention": "flash", "fused_ln": False,
-                        "opt_name": "adam"}),
+        # blocks pinned explicitly so a label always means one config,
+        # independent of DEFAULT_BLOCK_Q/K retuning (512 since d7707a8)
+        ("flash only bq512 bk512", {"attention": "flash", "fused_ln": False,
+                                    "opt_name": "adam",
+                                    "block_q": 512, "block_k": 512}),
         ("flash bundle", {"attention": "flash", "fused_ln": True,
                           "opt_name": "pallas_adam"}),
     ]
@@ -62,13 +70,20 @@ def main() -> None:
             (f"flash only bq{bq} bk{bk}",
              {"attention": "flash", "fused_ln": False, "opt_name": "adam",
               "block_q": bq, "block_k": bk})
-            for bq, bk in [(256, 256), (512, 512), (256, 512)]
+            for bq, bk in [(128, 128), (256, 256)]
         ]
     if args.long:
         shape = {"seq": 2048, "depth": 4, "batch": 8}
         configs = [
             ("dense seq2048", dict(shape)),
             ("flash seq2048", {"attention": "flash", **shape}),
+        ]
+    elif args.scale:
+        wide = {"d_model": 1024, "depth": 4}
+        configs = [
+            ("dense d1024 L4", dict(wide)),
+            ("flash d1024 L4", {"attention": "flash", **wide}),
+            ("flash batch128", {"attention": "flash", "batch": 128}),
         ]
 
     with open("MFU_ATTRIB.jsonl", "a") as f:

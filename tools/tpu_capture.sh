@@ -68,6 +68,11 @@ timeout 900 python tools/mfu_attrib.py --long >> "$LOG" 2>>"$LOG.err"
 commit_snap "Harvest TPU window: long-context attention A/B" \
   MFU_ATTRIB.jsonl "$LOG" "$LOG.err"
 
+# --- 2c. MXU scaling rows: d_model 1024 / batch 128 ----------------------
+timeout 900 python tools/mfu_attrib.py --scale >> "$LOG" 2>>"$LOG.err"
+commit_snap "Harvest TPU window: MFU scaling rows (d1024, batch128)" \
+  MFU_ATTRIB.jsonl "$LOG" "$LOG.err"
+
 # --- 3. prefetch A/B on the host-staged input path -----------------------
 timeout 900 python - >> "$LOG" 2>>"$LOG.err" <<'EOF'
 # prefetch A/B on the host-staged input path (in-memory Dataset, per-window
