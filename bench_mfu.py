@@ -213,7 +213,7 @@ def measure(
         record["block_q"], record["block_k"] = block_q, block_k
         record["effective_attention"] = effective_path(
             seq, d_model // heads, block_q, block_k
-        )
+        )[0]
     peak = _peak_flops(dev)
     if peak is not None:
         record["value"] = round(fps / peak, 4)

@@ -292,19 +292,20 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def effective_path(t, head_dim, block_q=DEFAULT_BLOCK_Q,
-                   block_k=DEFAULT_BLOCK_K) -> str:
-    """Which attention implementation ``flash_attention`` will actually run
-    for sequence length ``t``: "flash", "blockwise" (K+V past the VMEM
-    budget), or "dense" (T does not tile the blocks). The dispatch below
-    uses this; benchmark harnesses record it so an artifact can never
-    claim a kernel that silently fell back."""
-    if 2 * t * head_dim * 4 > _VMEM_KV_BUDGET_BYTES:
-        return "blockwise"
+                   block_k=DEFAULT_BLOCK_K):
+    """(path, bq, bk) that ``flash_attention`` will actually run for
+    sequence length ``t``: path is "flash", "blockwise" (K+V past the
+    VMEM budget), or "dense" (T does not tile the clamped blocks); bq/bk
+    are the clamped block sizes. The single source of the dispatch
+    decision — the dispatch below and the benchmark harnesses both read
+    it, so an artifact can never claim a kernel that silently fell back."""
     bq = min(block_q, t)
     bk = min(block_k, t)
+    if 2 * t * head_dim * 4 > _VMEM_KV_BUDGET_BYTES:
+        return "blockwise", bq, bk
     if t % bq or t % bk:
-        return "dense"
-    return "flash"
+        return "dense", bq, bk
+    return "flash", bq, bk
 
 
 def flash_attention(
@@ -330,7 +331,7 @@ def flash_attention(
             f"length {q.shape[1]} (q's), got k={k.shape[1]}, v={v.shape[1]}"
         )
     t, d = q.shape[1], q.shape[3]
-    path = effective_path(t, d, block_q, block_k)
+    path, bq, bk = effective_path(t, d, block_q, block_k)
     # each program holds the full K+V (f32) in VMEM; past ~8 MB of the
     # ~16 MB/core the Mosaic lowering fails, so long contexts take the
     # lax.scan blockwise path (same online softmax, HBM-streamed); T that
@@ -339,8 +340,6 @@ def flash_attention(
         return blockwise_attention(q, k, v, causal=causal)
     if path == "dense":
         return dense_attention(q, k, v, causal=causal)
-    bq = min(block_q, t)
-    bk = min(block_k, t)
     # (B, T, H, D) -> (B, H, T, D) for the kernels, and back
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     out = _flash(qt, kt, vt, causal, bq, bk, not _on_tpu())

@@ -26,13 +26,16 @@ from bench_mfu import measure  # noqa: E402
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true")
-    ap.add_argument(
+    ap.add_argument("--quick", action="store_true",
+                    help="default sweep only: drop the block-size variants "
+                    "(no effect with --long/--scale)")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument(
         "--long", action="store_true",
         help="long-sequence A/B instead: seq 2048, depth 4, batch 8 — "
         "where dense attention's (B,H,T,T) HBM scores stop being free",
     )
-    ap.add_argument(
+    mode.add_argument(
         "--scale", action="store_true",
         help="MXU scaling rows instead: d_model 1024 and batch 128 — "
         "how MFU moves when the matmuls widen / batch fills the array",
