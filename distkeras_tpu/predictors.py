@@ -12,6 +12,7 @@ the same compiled forward on every device's shard.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
@@ -92,3 +93,92 @@ class ModelPredictor(Predictor):
             y = np.asarray(self._fn(params, state, chunk))
             outs.append(y[: self.batch_size - pad] if pad else y)
         return ds.with_column(self.output_col, np.concatenate(outs, axis=0))
+
+
+class SequenceGenerator:
+    """Autoregressive decoding for the causal-LM family
+    (``zoo.transformer_lm``): the inference-tier counterpart of
+    ``ModelPredictor`` for sequence models. No reference counterpart
+    (SURVEY §5.7: no sequence models upstream).
+
+    The whole decode is ONE compiled program: a ``lax.scan`` over the
+    generated positions, each step running the model's static-shape
+    forward on the fixed (B, T) context buffer and writing the next token
+    in place — XLA sees one shape, compiles once per (prompt_len, steps).
+    Each step recomputes the full prefix (O(T^2 d) per token); at the
+    zoo's context lengths that is cheaper than threading a KV cache
+    through the layer API, and the compiled scan keeps it on-device with
+    zero per-token dispatch.
+
+    ``temperature=0`` decodes greedily; otherwise tokens sample from
+    ``softmax(logits / temperature)`` seeded by ``seed`` (same seed, same
+    output).
+    """
+
+    def __init__(self, model, temperature=0.0, seed=0):
+        self.model = model
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self._fns = {}  # (prompt_len, steps) -> compiled scan
+
+    def _decode_fn(self, prompt_len, steps, temp):
+        apply = self.model.apply
+
+        def decode(params, state, ctx, key):
+            def step(carry, i):
+                ctx, key = carry
+                logits, _ = apply(params, state, ctx, train=False)
+                pos = prompt_len - 1 + i
+                logit = jax.lax.dynamic_index_in_dim(
+                    logits, pos, axis=1, keepdims=False
+                )  # (B, V)
+                if temp == 0.0:
+                    tok = jnp.argmax(logit, axis=-1)
+                else:
+                    key, sub = jax.random.split(key)
+                    tok = jax.random.categorical(sub, logit / temp, axis=-1)
+                tok = tok.astype(ctx.dtype)
+                ctx = ctx.at[:, pos + 1].set(tok)
+                return (ctx, key), tok
+
+            (ctx, _), _ = jax.lax.scan(
+                step, (ctx, key), jnp.arange(steps)
+            )
+            return ctx
+
+        return jax.jit(decode)
+
+    def generate(self, prompts, steps):
+        """``prompts``: (B, P) int tokens, one shared prompt length P.
+        Returns (B, P + steps) — the prompts continued ``steps`` tokens.
+        P + steps must fit the model's built sequence length."""
+        prompts = np.asarray(prompts)
+        if prompts.ndim != 2 or prompts.shape[1] < 1:
+            raise ValueError(
+                f"prompts must be (B, P) with P >= 1; got {prompts.shape}"
+            )
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1; got {steps}")
+        b, p = prompts.shape
+        seq_len = self.model.input_shape[0]
+        if p + steps > seq_len:
+            raise ValueError(
+                f"prompt ({p}) + steps ({steps}) exceeds the model's "
+                f"sequence length ({seq_len})"
+            )
+        ctx = np.zeros((b, seq_len), prompts.dtype)
+        ctx[:, :p] = prompts
+        # temperature is baked into the compiled scan, so it keys the
+        # cache — mutating gen.temperature between calls must recompile,
+        # not silently reuse the old sampling mode
+        key = (p, steps, self.temperature)
+        if key not in self._fns:
+            self._fns[key] = self._decode_fn(p, steps, self.temperature)
+        out = self._fns[key](
+            self.model.params,
+            self.model.state,
+            jnp.asarray(ctx),
+            jax.random.PRNGKey(self.seed),
+        )
+        return np.asarray(out)[:, : p + steps]

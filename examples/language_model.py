@@ -86,14 +86,14 @@ def main():
           f"next-token accuracy {float(hist[0]['next_token_accuracy']):.3f} "
           f"-> {float(hist[-1]['next_token_accuracy']):.3f}")
 
+    from distkeras_tpu.predictors import SequenceGenerator
+
     seed_tok = 3
-    ctx = np.zeros((1, args.seq), np.int32)
-    ctx[0, 0] = seed_tok
     steps = min(12, args.seq - 1)
-    for i in range(1, steps + 1):
-        logits = np.asarray(trained(ctx))
-        ctx[0, i] = int(logits[0, i - 1].argmax())
-    print("greedy decode from", seed_tok, "->", ctx[0, : steps + 1].tolist())
+    out = SequenceGenerator(trained).generate(
+        np.array([[seed_tok]], np.int32), steps=steps
+    )
+    print("greedy decode from", seed_tok, "->", out[0].tolist())
 
 
 if __name__ == "__main__":

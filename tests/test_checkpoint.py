@@ -371,3 +371,35 @@ def _leaves(tree):
     import jax
 
     return jax.tree.leaves(tree)
+
+
+def test_single_trainer_resume_bit_identical_pallas_adam(tmp_path):
+    """The fused-Adam opt state is a plain (m, v, count) pytree, not an
+    optax NamedTuple — resume must round-trip it (moments AND the int32
+    bias-correction counter) bit-identically through the checkpoint."""
+    ds = make_data()
+    kw = dict(
+        worker_optimizer="pallas_adam",
+        loss="categorical_crossentropy",
+        learning_rate=1e-3,
+        batch_size=64,
+        label_col="label_onehot",
+        seed=3,
+    )
+
+    full = SingleTrainer(zoo.mnist_mlp(hidden=16, seed=7), num_epoch=3, **kw)
+    ref = full.train(ds, shuffle=True)
+
+    ck_dir = str(tmp_path / "fused_adam")
+    a = SingleTrainer(
+        zoo.mnist_mlp(hidden=16, seed=7), num_epoch=2, checkpoint_dir=ck_dir, **kw
+    )
+    a.train(ds, shuffle=True)
+
+    b = SingleTrainer(
+        zoo.mnist_mlp(hidden=16, seed=7), num_epoch=3, checkpoint_dir=ck_dir, **kw
+    )
+    out = b.train(ds, shuffle=True, resume=True)
+
+    for la, lb in zip(ref.get_weights(), out.get_weights()):
+        np.testing.assert_allclose(la, lb, rtol=0, atol=0)

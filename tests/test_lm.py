@@ -131,3 +131,69 @@ def test_transformer_lm_sequence_parallel_matches_dense():
     m_sp = SequenceParallelTrainer(make(), "adam", num_workers=8, **kw).train(ds)
     for a, b in zip(m_dense.get_weights(), m_sp.get_weights()):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+
+def test_sequence_generator_matches_manual_greedy():
+    """The compiled scan decode must reproduce the hand-rolled
+    one-position-at-a-time numpy loop exactly."""
+    from distkeras_tpu.predictors import SequenceGenerator
+
+    m = zoo.transformer_lm(vocab_size=32, seq_len=24, d_model=32,
+                           num_heads=4, depth=2, seed=0)
+    rng = np.random.default_rng(5)
+    prompts = rng.integers(0, 32, (3, 6)).astype(np.int32)
+
+    out = SequenceGenerator(m).generate(prompts, steps=8)
+    assert out.shape == (3, 14)
+    np.testing.assert_array_equal(out[:, :6], prompts)
+
+    ctx = np.zeros((3, 24), np.int32)
+    ctx[:, :6] = prompts
+    for i in range(8):
+        logits = np.asarray(m(ctx))
+        ctx[:, 6 + i] = logits[:, 5 + i].argmax(axis=-1)
+    np.testing.assert_array_equal(out, ctx[:, :14])
+
+
+def test_sequence_generator_sampling_deterministic_and_bounded():
+    from distkeras_tpu.predictors import SequenceGenerator
+
+    m = zoo.transformer_lm(vocab_size=16, seq_len=16, d_model=32,
+                           num_heads=2, depth=1, seed=0)
+    prompts = np.array([[1, 2], [3, 4]], np.int32)
+    a = SequenceGenerator(m, temperature=1.0, seed=7).generate(prompts, 6)
+    b = SequenceGenerator(m, temperature=1.0, seed=7).generate(prompts, 6)
+    c = SequenceGenerator(m, temperature=1.0, seed=8).generate(prompts, 6)
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).any()
+    assert a.min() >= 0 and a.max() < 16
+
+    with np.testing.assert_raises(ValueError):
+        SequenceGenerator(m).generate(prompts, steps=15)
+
+
+def test_sequence_generator_continues_trained_lm():
+    """On the trained successor LM, generation continues the arithmetic
+    sequence — the user-facing proof the decode uses the model causally."""
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.predictors import SequenceGenerator
+
+    rng = np.random.default_rng(6)
+    n, seq, vocab = 512, 16, 16
+    starts = rng.integers(0, vocab, n)
+    xs = ((starts[:, None] + np.arange(seq)[None, :]) % vocab).astype(np.int32)
+    ds = Dataset({"features": xs, "label": xs})
+    m = zoo.transformer_lm(vocab_size=vocab, seq_len=seq, d_model=32,
+                           num_heads=4, depth=1, seed=0)
+    t = SingleTrainer(m, "adam", "next_token_crossentropy",
+                      learning_rate=5e-3, batch_size=64, num_epoch=6,
+                      metrics=())
+    trained = t.train(ds)
+    out = SequenceGenerator(trained).generate(
+        np.array([[2, 3, 4], [9, 10, 11]], np.int32), steps=5
+    )
+    np.testing.assert_array_equal(
+        out,
+        [[2, 3, 4, 5, 6, 7, 8, 9], [9, 10, 11, 12, 13, 14, 15, 0]],
+    )
