@@ -36,7 +36,11 @@ from distkeras_tpu.trainers import (
     ADAG,
     DynSGD,
 )
-from distkeras_tpu.predictors import ModelPredictor, SequenceGenerator
+from distkeras_tpu.predictors import (
+    CachedSequenceGenerator,
+    ModelPredictor,
+    SequenceGenerator,
+)
 from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.data.transformers import (

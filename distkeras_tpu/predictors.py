@@ -148,10 +148,7 @@ class SequenceGenerator:
 
         return jax.jit(decode)
 
-    def generate(self, prompts, steps):
-        """``prompts``: (B, P) int tokens, one shared prompt length P.
-        Returns (B, P + steps) — the prompts continued ``steps`` tokens.
-        P + steps must fit the model's built sequence length."""
+    def _validate_generate_args(self, prompts, steps):
         prompts = np.asarray(prompts)
         if prompts.ndim != 2 or prompts.shape[1] < 1:
             raise ValueError(
@@ -160,13 +157,21 @@ class SequenceGenerator:
         steps = int(steps)
         if steps < 1:
             raise ValueError(f"steps must be >= 1; got {steps}")
-        b, p = prompts.shape
+        p = prompts.shape[1]
         seq_len = self.model.input_shape[0]
         if p + steps > seq_len:
             raise ValueError(
                 f"prompt ({p}) + steps ({steps}) exceeds the model's "
                 f"sequence length ({seq_len})"
             )
+        return prompts, steps, seq_len
+
+    def generate(self, prompts, steps):
+        """``prompts``: (B, P) int tokens, one shared prompt length P.
+        Returns (B, P + steps) — the prompts continued ``steps`` tokens.
+        P + steps must fit the model's built sequence length."""
+        prompts, steps, seq_len = self._validate_generate_args(prompts, steps)
+        b, p = prompts.shape
         ctx = np.zeros((b, seq_len), prompts.dtype)
         ctx[:, :p] = prompts
         # temperature is baked into the compiled scan, so it keys the
@@ -182,3 +187,189 @@ class SequenceGenerator:
             jax.random.PRNGKey(self.seed),
         )
         return np.asarray(out)[:, : p + steps]
+
+
+class CachedSequenceGenerator(SequenceGenerator):
+    """KV-cache decoding for ``zoo.transformer_lm``-shaped models: the
+    TPU-native serving path. No reference counterpart (SURVEY §5.7).
+
+    ``SequenceGenerator`` re-runs the full (B, T) forward per token —
+    O(T^2 d) a step, fine for training-time spot checks. Decode on real
+    hardware is memory-bound, so this subclass keeps each block's K/V in
+    a (B, T, H, Dh) cache: the prompt prefills the caches in one
+    vectorized pass, then every generated token computes ONE row of
+    attention against the cache — O(T d) a step, the whole prefill+scan
+    a single compiled program. Greedy output is pinned equal to the
+    uncached generator's.
+
+    Supports the LM family's exact layer shape (Embedding -> causal
+    TransformerBlock xN -> LayerNorm -> Dense); anything else (MoE
+    blocks, attention hooks) raises rather than decoding incorrectly.
+    """
+
+    def __init__(self, model, temperature=0.0, seed=0):
+        super().__init__(model, temperature=temperature, seed=seed)
+        from distkeras_tpu.models.layers import (
+            Dense,
+            Embedding,
+            LayerNorm,
+            TransformerBlock,
+        )
+
+        layers = list(model.layers)
+        ok = (
+            len(layers) >= 4
+            and isinstance(layers[0], Embedding)
+            and all(isinstance(l, TransformerBlock) for l in layers[1:-2])
+            and isinstance(layers[-2], LayerNorm)
+            and isinstance(layers[-1], Dense)
+            and all(l.causal for l in layers[1:-2])
+        )
+        if not ok:
+            raise ValueError(
+                "CachedSequenceGenerator supports Embedding -> causal "
+                "TransformerBlock xN (N >= 1) -> LayerNorm -> Dense models "
+                f"(zoo.transformer_lm); got {[type(l).__name__ for l in layers]}"
+            )
+        head_shapes = {
+            (l.mhsa.num_heads, l.mhsa.head_dim) for l in layers[1:-2]
+        }
+        if len(head_shapes) != 1:
+            raise ValueError(
+                "cached decode derives its cache shape from the first "
+                f"block; blocks must share (num_heads, head_dim), got "
+                f"{sorted(head_shapes)}"
+            )
+        for blk in layers[1:-2]:
+            if blk.mhsa.attention_fn is not None:
+                raise ValueError(
+                    "cached decode computes attention itself; detach the "
+                    "attention_fn hook (flash/ring) before decoding"
+                )
+        self._emb = layers[0]
+        self._blocks = layers[1:-2]
+        self._final_ln = layers[-2]
+        self._head = layers[-1]
+
+    def _block_decode(self, blk, p, x, cache_k, cache_v, pos, t_mask):
+        """One token through one block against its cache. x: (B, d);
+        caches: (B, T, H, Dh); t_mask: (T,) bool, True for t <= pos."""
+        mh = p["mhsa"]
+        h_, _ = blk.ln1.apply(p["ln1"], {}, x)
+        bsz = x.shape[0]
+        nh = blk.mhsa.num_heads
+        hd = mh["wq"].shape[1] // nh
+        q = (h_ @ mh["wq"]).reshape(bsz, nh, hd)
+        k_new = (h_ @ mh["wk"]).reshape(bsz, nh, hd)
+        v_new = (h_ @ mh["wv"]).reshape(bsz, nh, hd)
+        cache_k = jax.lax.dynamic_update_slice_in_dim(
+            cache_k, k_new[:, None], pos, axis=1
+        )
+        cache_v = jax.lax.dynamic_update_slice_in_dim(
+            cache_v, v_new[:, None], pos, axis=1
+        )
+        scores = jnp.einsum("bhd,bthd->bht", q, cache_k) / np.sqrt(hd)
+        scores = jnp.where(t_mask[None, None, :], scores, -jnp.inf)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bht,bthd->bhd", w, cache_v).reshape(bsz, nh * hd)
+        o = o @ mh["wo"]
+        if "bo" in mh:
+            o = o + mh["bo"]
+        x = x + o
+        h_, _ = blk.ln2.apply(p["ln2"], {}, x)
+        h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
+        h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
+        return x + h_, cache_k, cache_v
+
+    def _decode_fn(self, prompt_len, steps, temp):
+        from distkeras_tpu.parallel.ring_attention import dense_attention
+
+        blocks = self._blocks
+        final_ln, head = self._final_ln, self._head
+        seq_len = self.model.input_shape[0]
+        n_blocks = len(blocks)
+
+        def decode(params, state, ctx, key):
+            del state  # the LM family carries no mutable state
+            bp = [params[str(1 + i)] for i in range(n_blocks)]
+            p_emb = params["0"]
+            p_ln = params[str(1 + n_blocks)]
+            p_head = params[str(2 + n_blocks)]
+            bsz = ctx.shape[0]
+            nh = blocks[0].mhsa.num_heads
+            hd = bp[0]["mhsa"]["wq"].shape[1] // nh
+
+            def embed(tok, pos):
+                x = p_emb["tokens"][tok]
+                if "positions" in p_emb:
+                    x = x + p_emb["positions"][pos]
+                return x
+
+            caches = [
+                (
+                    jnp.zeros((bsz, seq_len, nh, hd), jnp.float32),
+                    jnp.zeros((bsz, seq_len, nh, hd), jnp.float32),
+                )
+                for _ in range(n_blocks)
+            ]
+            # ---- prefill positions 0..P-2 in one vectorized pass -------
+            if prompt_len > 1:
+                pp = prompt_len - 1
+                x = p_emb["tokens"][ctx[:, :pp]]
+                if "positions" in p_emb:
+                    x = x + p_emb["positions"][:pp]
+                new_caches = []
+                for blk, p, (ck, cv) in zip(blocks, bp, caches):
+                    mh = p["mhsa"]
+                    h_, _ = blk.ln1.apply(p["ln1"], {}, x)
+                    q = (h_ @ mh["wq"]).reshape(bsz, pp, nh, hd)
+                    k = (h_ @ mh["wk"]).reshape(bsz, pp, nh, hd)
+                    v = (h_ @ mh["wv"]).reshape(bsz, pp, nh, hd)
+                    ck = ck.at[:, :pp].set(k)
+                    cv = cv.at[:, :pp].set(v)
+                    o = dense_attention(q, k, v, causal=True)
+                    o = o.reshape(bsz, pp, nh * hd) @ mh["wo"]
+                    if "bo" in mh:
+                        o = o + mh["bo"]
+                    x = x + o
+                    h_, _ = blk.ln2.apply(p["ln2"], {}, x)
+                    h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
+                    h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
+                    x = x + h_
+                    new_caches.append((ck, cv))
+                caches = new_caches
+
+            # ---- scan: one cached-attention row per generated token ----
+            def step(carry, i):
+                tok, caches, key = carry
+                pos = prompt_len - 1 + i
+                x = embed(tok, pos)
+                t_mask = jnp.arange(seq_len) <= pos
+                new_caches = []
+                for blk, p, (ck, cv) in zip(blocks, bp, caches):
+                    x, ck, cv = self._block_decode(
+                        blk, p, x, ck, cv, pos, t_mask
+                    )
+                    new_caches.append((ck, cv))
+                x, _ = final_ln.apply(p_ln, {}, x)
+                logit, _ = head.apply(p_head, {}, x)  # (B, V)
+                if temp == 0.0:
+                    nxt = jnp.argmax(logit, axis=-1)
+                else:
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub, logit / temp, axis=-1)
+                return (nxt.astype(tok.dtype), new_caches, key), nxt
+
+            tok0 = ctx[:, prompt_len - 1]
+            (_, _, _), toks = jax.lax.scan(
+                step, (tok0, caches, key), jnp.arange(steps)
+            )
+            # toks: (steps, B) generated tokens for positions P..P+steps-1
+            out = ctx
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, jnp.swapaxes(toks, 0, 1).astype(ctx.dtype),
+                prompt_len, axis=1,
+            )
+            return out
+
+        return jax.jit(decode)
