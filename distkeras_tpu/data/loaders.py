@@ -193,3 +193,40 @@ def mnist(path=None, n=8192, seed=0, flat=True) -> Dataset:
             ds = ds.with_column("features", x)
         return ds
     return synthetic_mnist(n=n, seed=seed, flat=flat)
+
+
+def text_corpus(path=None, seq_len=128, stride=None, vocab_size=256) -> Dataset:
+    """Byte-level LM windows from a REAL text file — the causal-LM
+    family's data path. No reference counterpart (no sequence workloads
+    upstream, SURVEY §5.7).
+
+    The file's bytes become tokens 0..255 (``vocab_size`` must be >= 256
+    and matches ``zoo.transformer_lm(vocab_size=...)``); overlapping
+    windows of ``seq_len`` bytes (default stride seq_len // 2) form the
+    rows, with ``label`` == ``features`` (the next-token loss shifts
+    targets internally). Defaults to the repository's own LICENSE text —
+    real prose shipped in-repo, in the same spirit as ``digits()``.
+    """
+    if path is None:
+        path = default_corpus_path()
+    if vocab_size < 256:
+        raise ValueError(f"byte-level corpus needs vocab_size >= 256; "
+                         f"got {vocab_size}")
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    if len(data) < seq_len + 1:
+        raise ValueError(
+            f"corpus {path!r} has {len(data)} bytes < seq_len+1 ({seq_len + 1})"
+        )
+    stride = stride or max(1, seq_len // 2)
+    x = np.lib.stride_tricks.sliding_window_view(data, seq_len)[::stride]
+    x = np.ascontiguousarray(x).astype(np.int32)
+    return Dataset({"features": x, "label": x})
+
+
+def default_corpus_path() -> str:
+    """The in-repo real-text default for ``text_corpus`` (the LICENSE)."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "LICENSE"
+    )

@@ -42,6 +42,10 @@ def main():
     ap.add_argument("--remat", action="store_true",
                     help="per-block jax.checkpoint: activation memory O(1) "
                          "in depth at ~1/3 extra FLOPs")
+    ap.add_argument("--text", metavar="PATH", nargs="?", const="", default=None,
+                    help="train on a real text file, byte-level (default: "
+                         "the repository's LICENSE) instead of the toy "
+                         "successor corpus")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
@@ -53,11 +57,21 @@ def main():
     from distkeras_tpu.data.dataset import Dataset
     from distkeras_tpu.models import zoo
 
-    rng = np.random.default_rng(0)
-    starts = rng.integers(0, args.vocab, args.rows)
-    xs = ((starts[:, None] + np.arange(args.seq)[None, :]) % args.vocab
-          ).astype(np.int32)
-    ds = Dataset({"features": xs, "label": xs})
+    if args.text is not None:
+        from distkeras_tpu.data import loaders
+
+        ds = loaders.text_corpus(args.text or None, seq_len=args.seq)
+        if args.vocab != 32 or args.rows != 1024:
+            print("note: --text is byte-level; --vocab is forced to 256 and "
+                  "--rows to the corpus window count")
+        args.vocab = 256
+        print(f"byte-level corpus: {len(ds)} windows of {args.seq}")
+    else:
+        rng = np.random.default_rng(0)
+        starts = rng.integers(0, args.vocab, args.rows)
+        xs = ((starts[:, None] + np.arange(args.seq)[None, :]) % args.vocab
+              ).astype(np.int32)
+        ds = Dataset({"features": xs, "label": xs})
 
     model = zoo.transformer_lm(
         vocab_size=args.vocab, seq_len=args.seq, d_model=args.d_model,
@@ -82,18 +96,25 @@ def main():
     trained = trainer.train(ds)
     dt = time.time() - t0
     hist = [h for h in trainer.get_history() if "next_token_accuracy" in h]
-    print(f"trained {args.rows} rows x {args.epochs} epochs in {dt:.1f}s; "
+    print(f"trained {len(ds)} rows x {args.epochs} epochs in {dt:.1f}s; "
           f"next-token accuracy {float(hist[0]['next_token_accuracy']):.3f} "
           f"-> {float(hist[-1]['next_token_accuracy']):.3f}")
 
-    from distkeras_tpu.predictors import SequenceGenerator
+    from distkeras_tpu.predictors import CachedSequenceGenerator
 
-    seed_tok = 3
-    steps = min(12, args.seq - 1)
-    out = SequenceGenerator(trained).generate(
-        np.array([[seed_tok]], np.int32), steps=steps
-    )
-    print("greedy decode from", seed_tok, "->", out[0].tolist())
+    gen = CachedSequenceGenerator(trained)
+    if args.text is not None:
+        p_len = min(16, max(1, args.seq // 2))
+        prompt = ds["features"][len(ds) // 2 : len(ds) // 2 + 1, :p_len]
+        steps = max(1, min(48, args.seq - p_len))
+        out = gen.generate(prompt, steps=steps)
+        txt = bytes(out[0].tolist()).decode("latin-1")
+        print(f"decode from {txt[:p_len]!r} -> {txt[p_len:]!r}")
+    else:
+        seed_tok = 3
+        steps = min(12, args.seq - 1)
+        out = gen.generate(np.array([[seed_tok]], np.int32), steps=steps)
+        print("greedy decode from", seed_tok, "->", out[0].tolist())
 
 
 if __name__ == "__main__":
