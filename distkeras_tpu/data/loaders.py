@@ -218,15 +218,18 @@ def text_corpus(path=None, seq_len=128, stride=None, vocab_size=256) -> Dataset:
         raise ValueError(
             f"corpus {path!r} has {len(data)} bytes < seq_len+1 ({seq_len + 1})"
         )
-    stride = stride or max(1, seq_len // 2)
+    stride = stride if stride is not None else max(1, seq_len // 2)
+    if stride < 1:
+        raise ValueError(f"stride must be >= 1; got {stride}")
     x = np.lib.stride_tricks.sliding_window_view(data, seq_len)[::stride]
     x = np.ascontiguousarray(x).astype(np.int32)
     return Dataset({"features": x, "label": x})
 
 
 def default_corpus_path() -> str:
-    """The in-repo real-text default for ``text_corpus`` (the LICENSE)."""
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "LICENSE"
-    )
+    """The real-text default for ``text_corpus``: the packaged GPL text
+    (``data/corpus.txt``, a copy of the repository LICENSE declared in
+    package-data like ``digits.csv``), so the no-path default works from
+    an installed wheel, not just a source checkout."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "corpus.txt")

@@ -17,6 +17,9 @@ while true; do
     # 01:02 window died mid-sweep; end-of-sweep commits lose the harvest)
     sh tools/tpu_capture.sh >> "$LOG" 2>&1
     timeout -k 30 2400 python benchmarks.py --configs 1,2,3,6 >> "$LOG" 2>&1
+    # the remaining matrix rows (CIFAR ADAG, ResNet DynSGD) ride a second
+    # invocation so a dying tunnel cannot cost the cheap rows above
+    timeout -k 30 2400 python benchmarks.py --configs 4,5 >> "$LOG" 2>&1
     ARTIFACTS=""
     for f in TPU_CAPTURE.log TPU_CAPTURE.log.err BENCHMARKS.json \
              BENCHMARKS.md "$LOG"; do
