@@ -109,6 +109,27 @@ def resolve_backend() -> tuple[str, str | None] | None:
     return None
 
 
+def setup_backend(cpu: bool = False) -> str:
+    """The harness bootstrap shared by bench_mfu/bench_decode: force the
+    CPU mesh when asked, otherwise probe out-of-process (a dead tunnel
+    must not hang in-process init) and pin the surviving platform.
+    Returns the platform string."""
+    if cpu:
+        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(1)
+        return "cpu"
+    resolved = resolve_backend()
+    if resolved is None:
+        raise SystemExit("no JAX backend could be initialized")
+    platform, config_pin = resolved
+    import jax
+
+    if config_pin is not None:
+        jax.config.update("jax_platforms", config_pin)
+    return platform
+
+
 def sync_fetch(array) -> float:
     """Barrier for timing: fetch ``array``'s bytes to the host and return its
     last element. ``jax.block_until_ready`` is NOT a trustworthy barrier on

@@ -34,7 +34,7 @@ import time
 
 import numpy as np
 
-from bench import _flops_per_call, _peak_flops, resolve_backend, sync_fetch
+from bench import _flops_per_call, _peak_flops, setup_backend, sync_fetch
 
 
 def measure(
@@ -234,20 +234,7 @@ def main() -> None:
     )
     args = ap.parse_args()
 
-    if args.cpu:
-        from distkeras_tpu.parallel.mesh import force_cpu_mesh
-
-        force_cpu_mesh(1)
-        platform = "cpu"
-    else:
-        resolved = resolve_backend()
-        if resolved is None:
-            raise SystemExit("no JAX backend could be initialized")
-        platform, config_pin = resolved
-        import jax
-
-        if config_pin is not None:
-            jax.config.update("jax_platforms", config_pin)
+    platform = setup_backend(cpu=args.cpu)
 
     import jax
 

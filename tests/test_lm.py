@@ -266,3 +266,37 @@ def test_text_corpus_windows_and_training_smoke():
     first = np.mean(losses[: 5])
     last = np.mean(losses[-5:])
     assert last < first * 0.8, (first, last)
+
+
+def test_transformer_lm_pipeline_parallel_matches_dense():
+    """Causal LM trained with its block tower stage-sharded over a
+    4-deep GPipe pipeline must track dense single-device training —
+    the LM family composes with pipeline parallelism like the
+    classifier does."""
+    from distkeras_tpu import PipelineParallelTrainer, SingleTrainer
+    from distkeras_tpu.data.dataset import Dataset
+
+    rng = np.random.default_rng(8)
+    n, seq, vocab = 256, 16, 16
+    starts = rng.integers(0, vocab, n)
+    xs = ((starts[:, None] + np.arange(seq)[None, :]) % vocab).astype(np.int32)
+    ds = Dataset({"features": xs, "label": xs})
+
+    kw = dict(
+        loss="next_token_crossentropy",
+        batch_size=32,
+        num_epoch=1,
+        metrics=(),
+        seed=0,
+    )
+
+    def make():
+        return zoo.transformer_lm(vocab_size=vocab, seq_len=seq, d_model=32,
+                                  num_heads=2, depth=4, seed=0)
+
+    m_dense = SingleTrainer(make(), "adam", **kw).train(ds)
+    m_pp = PipelineParallelTrainer(
+        make(), "adam", num_workers=4, num_micro=4, **kw
+    ).train(ds)
+    for a, b in zip(m_dense.get_weights(), m_pp.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)

@@ -122,4 +122,13 @@ print(json.dumps({
 EOF
 commit_snap "Harvest TPU window: prefetch A/B" "$LOG" "$LOG.err"
 
+# --- 4. serving-path decode tokens/sec (KV cache vs full recompute) ------
+timeout 900 python bench_decode.py 2>>"$LOG.err" | tail -1 >> "$LOG"
+if grep -q '"platform": "tpu"' BENCH_DECODE.json 2>/dev/null; then
+  commit_snap "Harvest TPU window: LM decode throughput (KV cache A/B)" \
+    BENCH_DECODE.json "$LOG" "$LOG.err"
+else
+  git checkout -- BENCH_DECODE.json 2>/dev/null || true
+fi
+
 tail -4 "$LOG"
