@@ -109,15 +109,15 @@ def resolve_backend() -> tuple[str, str | None] | None:
     return None
 
 
-def setup_backend(cpu: bool = False) -> str:
-    """The harness bootstrap shared by bench_mfu/bench_decode: force the
-    CPU mesh when asked, otherwise probe out-of-process (a dead tunnel
-    must not hang in-process init) and pin the surviving platform.
-    Returns the platform string."""
+def setup_backend(cpu: bool = False, cpu_devices: int = 1) -> str:
+    """The harness bootstrap shared by bench_mfu/bench_decode/benchmarks:
+    force a ``cpu_devices``-wide CPU mesh when asked, otherwise probe
+    out-of-process (a dead tunnel must not hang in-process init) and pin
+    the surviving platform. Returns the platform string."""
     if cpu:
         from distkeras_tpu.parallel.mesh import force_cpu_mesh
 
-        force_cpu_mesh(1)
+        force_cpu_mesh(cpu_devices)
         return "cpu"
     resolved = resolve_backend()
     if resolved is None:

@@ -36,19 +36,12 @@ import time
 
 
 def resolve_platform(force_cpu: bool) -> str:
+    from bench import setup_backend
     from distkeras_tpu.utils.compile_cache import enable_compile_cache
 
+    platform = setup_backend(cpu=force_cpu, cpu_devices=8)
     if force_cpu:
-        from distkeras_tpu.parallel.mesh import force_cpu_mesh
-
-        force_cpu_mesh(8)
-        return "cpu"
-    from bench import resolve_backend
-
-    resolved = resolve_backend()
-    if resolved is None:
-        raise SystemExit("no JAX backend could be initialized")
-    platform, config_pin = resolved
+        return platform
     enable_compile_cache(platform=platform)
     if platform == "cpu":
         # no accelerator: widen to the 8-device virtual mesh so the
@@ -56,10 +49,6 @@ def resolve_platform(force_cpu: bool) -> str:
         from distkeras_tpu.parallel.mesh import force_cpu_mesh
 
         force_cpu_mesh(8)
-    elif config_pin is not None:
-        import jax
-
-        jax.config.update("jax_platforms", config_pin)
     return platform
 
 
