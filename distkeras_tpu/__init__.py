@@ -41,7 +41,11 @@ from distkeras_tpu.predictors import (
     ModelPredictor,
     SequenceGenerator,
 )
-from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
+from distkeras_tpu.evaluators import (
+    AccuracyEvaluator,
+    LossEvaluator,
+    PerplexityEvaluator,
+)
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.data.transformers import (
     Transformer,

@@ -53,3 +53,30 @@ class LossEvaluator(Evaluator):
                 jnp.asarray(ds[self.label_col]),
             )
         )
+
+
+class PerplexityEvaluator(Evaluator):
+    """Causal-LM perplexity: exp(mean next-token cross-entropy) of an LM's
+    logits column against the token column. No reference counterpart
+    (SURVEY §5.7: no sequence models upstream); pairs with
+    ``zoo.transformer_lm`` + ``ModelPredictor`` (the prediction column
+    holds (T, V) logits per row) the way AccuracyEvaluator pairs with the
+    classifier families.
+    """
+
+    def __init__(self, prediction_col="prediction", label_col="label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, ds: Dataset) -> float:
+        logits = np.asarray(ds[self.prediction_col])
+        tokens = np.asarray(ds[self.label_col])
+        if logits.ndim != 3 or tokens.ndim != 2:
+            raise ValueError(
+                "perplexity expects logits (N, T, V) and tokens (N, T); "
+                f"got {logits.shape} and {tokens.shape}"
+            )
+        ce = LossEvaluator(
+            "next_token_crossentropy", self.prediction_col, self.label_col
+        ).evaluate(ds)
+        return float(np.exp(ce))

@@ -190,6 +190,43 @@ def transformer_lm(
     return model
 
 
+def moe_transformer_lm(
+    vocab_size=256,
+    seq_len=128,
+    d_model=128,
+    num_heads=4,
+    depth=2,
+    num_experts=8,
+    seed=0,
+    remat=False,
+):
+    """Causal language model with switch-MoE feed-forwards after each
+    block — the expert-parallel autoregressive family. Routing is
+    per-token (no cross-token mixing), so causality is preserved; pair
+    with ``next_token_crossentropy`` and
+    ``parallel.expert_parallel.attach_expert_mesh`` to shard the experts.
+    No reference counterpart (SURVEY §3.3/§5.7)."""
+    from distkeras_tpu.models.layers import (
+        Dense,
+        Embedding,
+        LayerNorm,
+        TransformerBlock,
+    )
+    from distkeras_tpu.models.sequential import Sequential
+    from distkeras_tpu.parallel.expert_parallel import MoE
+
+    layers = [Embedding(vocab_size, d_model)]
+    for _ in range(depth):
+        layers += [
+            TransformerBlock(num_heads, causal=True, remat=remat),
+            MoE(num_experts),
+        ]
+    layers += [LayerNorm(), Dense(vocab_size)]
+    model = Sequential(layers)
+    model.build((seq_len,), seed=seed)
+    return model
+
+
 def moe_transformer_classifier(
     vocab_size=64,
     seq_len=64,

@@ -300,3 +300,57 @@ def test_transformer_lm_pipeline_parallel_matches_dense():
     ).train(ds)
     for a, b in zip(m_dense.get_weights(), m_pp.get_weights()):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+
+def test_moe_transformer_lm_is_causal_and_learns():
+    """Switch-MoE feed-forwards route per token, so the MoE LM must stay
+    strictly causal; it must also learn the successor language through
+    the normal trainer surface (aux load-balance loss riding along)."""
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.data.dataset import Dataset
+
+    m = zoo.moe_transformer_lm(vocab_size=32, seq_len=16, d_model=32,
+                               num_heads=2, depth=1, num_experts=4, seed=0)
+    rng = np.random.default_rng(9)
+    x = rng.integers(0, 32, (1, 16)).astype(np.int32)
+    base = np.asarray(m(x))
+    j = 9
+    x2 = x.copy()
+    x2[0, j] = (x2[0, j] + 1) % 32
+    out2 = np.asarray(m(x2))
+    np.testing.assert_allclose(base[0, :j], out2[0, :j], atol=1e-5)
+
+    n, seq, vocab = 512, 16, 16
+    starts = rng.integers(0, vocab, n)
+    xs = ((starts[:, None] + np.arange(seq)[None, :]) % vocab).astype(np.int32)
+    ds = Dataset({"features": xs, "label": xs})
+    lm = zoo.moe_transformer_lm(vocab_size=vocab, seq_len=seq, d_model=32,
+                                num_heads=2, depth=1, num_experts=4, seed=0)
+    t = SingleTrainer(lm, "adam", "next_token_crossentropy",
+                      learning_rate=5e-3, batch_size=64, num_epoch=6,
+                      metrics=["next_token_accuracy"])
+    t.train(ds)
+    hist = [h for h in t.get_history() if "next_token_accuracy" in h]
+    assert float(hist[-1]["next_token_accuracy"]) > 0.9
+
+
+def test_perplexity_evaluator_matches_loss():
+    """exp(next-token CE) — pinned against the loss on predictor output,
+    and ~vocab for a uniform-logits model."""
+    from distkeras_tpu.evaluators import PerplexityEvaluator
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.predictors import ModelPredictor
+    from distkeras_tpu.ops.losses import next_token_crossentropy
+
+    rng = np.random.default_rng(10)
+    xs = rng.integers(0, 16, (32, 12)).astype(np.int32)
+    ds = Dataset({"features": xs, "label": xs})
+    m = zoo.transformer_lm(vocab_size=16, seq_len=12, d_model=16,
+                           num_heads=2, depth=1, seed=0)
+    pred = ModelPredictor(m, batch_size=32).predict(ds)
+    ppl = PerplexityEvaluator().evaluate(pred)
+    want = float(np.exp(next_token_crossentropy(
+        jnp.asarray(pred["prediction"]), jnp.asarray(xs))))
+    np.testing.assert_allclose(ppl, want, rtol=1e-6)
+    # fresh-init logits are near-uniform: perplexity ~ vocab
+    assert 8 < ppl < 32, ppl

@@ -14,23 +14,7 @@ cd "$(dirname "$0")/.."
 LOG=TPU_CAPTURE.log
 date >> "$LOG"
 
-# commit_snap <msg> <file...> — commit whichever of the files exist, with
-# retries around a possibly-held index.lock (the build session commits too)
-commit_snap() {
-  _msg="$1"; shift
-  _files=""
-  for _f in "$@"; do [ -e "$_f" ] && _files="$_files $_f"; done
-  [ -n "$_files" ] || return 0
-  for _ in 1 2 3 4 5; do
-    git add -- $_files
-    if git commit -m "$_msg" \
-        -m "No-Verification-Needed: benchmark artifact capture only" \
-        -- $_files; then
-      return 0
-    fi
-    sleep 10
-  done
-}
+. tools/git_snap.sh
 
 # --- 1. north-star bench (device-resident MNIST CNN) ---------------------
 timeout 600 python bench.py 2>>"$LOG.err" | tail -1 >> "$LOG"

@@ -8,6 +8,7 @@
 # resident north-star, bench_mfu.py transformer MFU, prefetch A/B) plus the
 # TPU column of the BENCHMARKS matrix, then commits the artifacts.
 cd "$(dirname "$0")/.." || exit 1
+. tools/git_snap.sh
 LOG=TPU_WATCH.log
 
 while true; do
@@ -18,36 +19,15 @@ while true; do
     sh tools/tpu_capture.sh >> "$LOG" 2>&1
     timeout -k 30 2400 python benchmarks.py --configs 1,2,3,6 >> "$LOG" 2>&1
     # commit the cheap rows BEFORE the expensive ones: a tunnel dying in
-    # the configs-4,5 run must not cost the 1,2,3,6 harvest (retry the
-    # index.lock like every other commit site in these scripts)
-    for _ in 1 2 3 4 5; do
-      git add BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1
-      if git commit -m \
-        "Harvest TPU window: benchmark matrix rows (configs 1,2,3,6)" -m \
-        "No-Verification-Needed: benchmark artifact capture only" \
-        -- BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1; then
-        break
-      fi
-      sleep 10
-    done
+    # the configs-4,5 run must not cost the 1,2,3,6 harvest
+    commit_snap "Harvest TPU window: benchmark matrix rows (configs 1,2,3,6)" \
+      BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1
     # the remaining matrix rows (CIFAR ADAG, ResNet DynSGD) ride a second
     # invocation so a dying tunnel cannot cost the cheap rows above
     timeout -k 30 2400 python benchmarks.py --configs 4,5 >> "$LOG" 2>&1
-    ARTIFACTS=""
-    for f in TPU_CAPTURE.log TPU_CAPTURE.log.err BENCHMARKS.json \
-             BENCHMARKS.md "$LOG"; do
-      [ -e "$f" ] && ARTIFACTS="$ARTIFACTS $f"
-    done
-    for _ in 1 2 3 4 5; do
-      git add -- $ARTIFACTS >> "$LOG" 2>&1
-      if git commit -m "Harvest TPU window: TPU benchmark matrix rows
-
-No-Verification-Needed: benchmark artifact capture only" \
-          -- $ARTIFACTS >> "$LOG" 2>&1; then
-        break
-      fi
-      sleep 20
-    done
+    commit_snap "Harvest TPU window: TPU benchmark matrix rows" \
+      TPU_CAPTURE.log TPU_CAPTURE.log.err BENCHMARKS.json BENCHMARKS.md \
+      "$LOG" >> "$LOG" 2>&1
     echo "$(date -u +%FT%TZ) capture cycle done" >> "$LOG"
     sleep 120
   else
