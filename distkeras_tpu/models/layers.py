@@ -519,13 +519,23 @@ class TransformerBlock(Layer):
     makes deep/long-sequence training fit. Numerics are unchanged (pinned
     by test). No reference counterpart (the reference has no attention and
     delegates memory to the Keras backend).
+
+    ``dropout`` applies inverted residual dropout to the attention and MLP
+    branch outputs in train mode (identity in eval; rng required when
+    live). A dropout block consumes the train rng, so pipeline towers
+    exclude it (``uses_train_rng``).
     """
 
-    def __init__(self, num_heads, mlp_ratio=4, causal=False, remat=False):
+    def __init__(self, num_heads, mlp_ratio=4, causal=False, remat=False,
+                 dropout=0.0):
         self.num_heads = int(num_heads)
         self.mlp_ratio = int(mlp_ratio)
         self.causal = bool(causal)
         self.remat = bool(remat)
+        self.dropout = float(dropout)
+        # rng-consuming blocks are excluded from pipeline towers
+        # (trainers._find_block_run) — declare only when dropout is live
+        self.uses_train_rng = self.dropout > 0.0
         self.mhsa = MultiHeadSelfAttention(self.num_heads, causal=self.causal)
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
@@ -565,16 +575,33 @@ class TransformerBlock(Layer):
         return self._apply(params, state, x, rng, train=train)
 
     def _apply(self, params, state, x, rng, train=False):
+        drop = train and self.dropout > 0.0
+        if drop:
+            if rng is None:
+                raise ValueError(
+                    "TransformerBlock(dropout>0).apply(train=True) "
+                    "requires an rng"
+                )
+            r1, r2 = jax.random.split(rng)
+        # reuse the Dropout layer's mask logic (stateless, param-free) so
+        # the two inverted-dropout implementations cannot drift
+        _dropper = Dropout(self.dropout)
+
+        def residual_drop(h, r):
+            if not drop:
+                return h
+            return _dropper.apply({}, {}, h, train=True, rng=r)[0]
+
         new_state = dict(state)
         h, new_state["ln1"] = self.ln1.apply(params["ln1"], state["ln1"], x)
         a, new_state["mhsa"] = self.mhsa.apply(
             params["mhsa"], state["mhsa"], h, train, rng
         )
-        x = x + a
+        x = x + residual_drop(a, r1 if drop else None)
         h, new_state["ln2"] = self.ln2.apply(params["ln2"], state["ln2"], x)
         h, new_state["fc1"] = self._fc1.apply(params["fc1"], state["fc1"], h)
         h, new_state["fc2"] = self._fc2.apply(params["fc2"], state["fc2"], h)
-        return x + h, new_state
+        return x + residual_drop(h, r2 if drop else None), new_state
 
     def get_config(self):
         return {
@@ -583,6 +610,7 @@ class TransformerBlock(Layer):
             "mlp_ratio": self.mlp_ratio,
             "causal": self.causal,
             "remat": self.remat,
+            "dropout": self.dropout,
         }
 
 

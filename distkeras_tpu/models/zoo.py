@@ -158,6 +158,7 @@ def transformer_lm(
     depth=2,
     seed=0,
     remat=False,
+    dropout=0.0,
 ):
     """Causal language model: Embedding -> causal TransformerBlock xN ->
     LayerNorm -> logits over the vocabulary (no softmax; pair with the
@@ -179,7 +180,8 @@ def transformer_lm(
         [
             Embedding(vocab_size, d_model),
             *[
-                TransformerBlock(num_heads, causal=True, remat=remat)
+                TransformerBlock(num_heads, causal=True, remat=remat,
+                                 dropout=dropout)
                 for _ in range(depth)
             ],
             LayerNorm(),
@@ -201,9 +203,14 @@ def moe_transformer_lm(
     remat=False,
 ):
     """Causal language model with switch-MoE feed-forwards after each
-    block — the expert-parallel autoregressive family. Routing is
-    per-token (no cross-token mixing), so causality is preserved; pair
-    with ``next_token_crossentropy`` and
+    block — the expert-parallel autoregressive family. Within a row,
+    causality is preserved: routing mixes no information across tokens,
+    and the capacity cumsum's priority is positional, so a position's
+    keep/drop never depends on later tokens. (Capacity is a global
+    budget, though — whether a token is dropped can depend on the OTHER
+    rows in the batch, so eval logits are batch-composition-dependent,
+    as in any capacity-dropped switch MoE.) Pair with
+    ``next_token_crossentropy`` and
     ``parallel.expert_parallel.attach_expert_mesh`` to shard the experts.
     No reference counterpart (SURVEY §3.3/§5.7)."""
     from distkeras_tpu.models.layers import (

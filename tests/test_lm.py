@@ -354,3 +354,54 @@ def test_perplexity_evaluator_matches_loss():
     np.testing.assert_allclose(ppl, want, rtol=1e-6)
     # fresh-init logits are near-uniform: perplexity ~ vocab
     assert 8 < ppl < 32, ppl
+
+
+def test_transformer_block_dropout():
+    """dropout>0: eval mode is identity (equals the dropout-0 model on the
+    same init), train mode is stochastic per rng, training still learns,
+    and pipeline towers reject rng-consuming blocks."""
+    from distkeras_tpu import PipelineParallelTrainer, SingleTrainer
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.utils.serialization import deserialize_model, serialize_model
+
+    rng = np.random.default_rng(11)
+    x = rng.integers(0, 32, (2, 16)).astype(np.int32)
+    m0 = zoo.transformer_lm(vocab_size=32, seq_len=16, d_model=32,
+                            num_heads=2, depth=2, seed=0)
+    md = zoo.transformer_lm(vocab_size=32, seq_len=16, d_model=32,
+                            num_heads=2, depth=2, seed=0, dropout=0.2)
+    # eval: dropout is identity
+    np.testing.assert_allclose(np.asarray(md(x)), np.asarray(m0(x)), atol=1e-6)
+    # train: stochastic per rng
+    out_a, _ = md.apply(md.params, md.state, x, train=True,
+                        rng=jax.random.PRNGKey(0))
+    out_b, _ = md.apply(md.params, md.state, x, train=True,
+                        rng=jax.random.PRNGKey(1))
+    assert np.abs(np.asarray(out_a) - np.asarray(out_b)).max() > 1e-4
+    # config round-trip keeps the rate
+    md2 = deserialize_model(serialize_model(md))
+    blocks = [l for l in md2.layers if type(l).__name__ == "TransformerBlock"]
+    assert all(b.dropout == 0.2 and b.uses_train_rng for b in blocks)
+
+    # learns the successor language with dropout live
+    n, seq, vocab = 512, 16, 16
+    starts = rng.integers(0, vocab, n)
+    xs = ((starts[:, None] + np.arange(seq)[None, :]) % vocab).astype(np.int32)
+    ds = Dataset({"features": xs, "label": xs})
+    lm = zoo.transformer_lm(vocab_size=vocab, seq_len=seq, d_model=32,
+                            num_heads=2, depth=1, seed=0, dropout=0.1)
+    t = SingleTrainer(lm, "adam", "next_token_crossentropy",
+                      learning_rate=5e-3, batch_size=64, num_epoch=6,
+                      metrics=["next_token_accuracy"])
+    t.train(ds)
+    hist = [h for h in t.get_history() if "next_token_accuracy" in h]
+    assert float(hist[-1]["next_token_accuracy"]) > 0.8
+
+    # dropout towers are rng-consuming: the pipeline trainer must reject
+    lm4 = zoo.transformer_lm(vocab_size=vocab, seq_len=seq, d_model=32,
+                             num_heads=2, depth=4, seed=0, dropout=0.1)
+    pp = PipelineParallelTrainer(lm4, "adam", loss="next_token_crossentropy",
+                                 num_workers=4, num_micro=4, batch_size=32,
+                                 num_epoch=1, metrics=(), seed=0)
+    with np.testing.assert_raises(ValueError):
+        pp.train(ds)
