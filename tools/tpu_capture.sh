@@ -57,7 +57,16 @@ timeout 900 python tools/mfu_attrib.py --scale >> "$LOG" 2>>"$LOG.err"
 commit_snap "Harvest TPU window: MFU scaling rows (d1024, batch128)" \
   MFU_ATTRIB.jsonl "$LOG" "$LOG.err"
 
-# --- 3. prefetch A/B on the host-staged input path -----------------------
+# --- 3. serving-path decode tokens/sec (KV cache vs full recompute) ------
+timeout 900 python bench_decode.py 2>>"$LOG.err" | tail -1 >> "$LOG"
+if grep -q '"platform": "tpu"' BENCH_DECODE.json 2>/dev/null; then
+  commit_snap "Harvest TPU window: LM decode throughput (KV cache A/B)" \
+    BENCH_DECODE.json "$LOG" "$LOG.err"
+else
+  git checkout -- BENCH_DECODE.json 2>/dev/null || true
+fi
+
+# --- 4. prefetch A/B on the host-staged input path -----------------------
 timeout 900 python - >> "$LOG" 2>>"$LOG.err" <<'EOF'
 # prefetch A/B on the host-staged input path (in-memory Dataset, per-window
 # stack + device_put): the overlap win shows when the host link is the
@@ -105,14 +114,5 @@ print(json.dumps({
 }))
 EOF
 commit_snap "Harvest TPU window: prefetch A/B" "$LOG" "$LOG.err"
-
-# --- 4. serving-path decode tokens/sec (KV cache vs full recompute) ------
-timeout 900 python bench_decode.py 2>>"$LOG.err" | tail -1 >> "$LOG"
-if grep -q '"platform": "tpu"' BENCH_DECODE.json 2>/dev/null; then
-  commit_snap "Harvest TPU window: LM decode throughput (KV cache A/B)" \
-    BENCH_DECODE.json "$LOG" "$LOG.err"
-else
-  git checkout -- BENCH_DECODE.json 2>/dev/null || true
-fi
 
 tail -4 "$LOG"
