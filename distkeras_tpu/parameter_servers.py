@@ -138,7 +138,14 @@ class ParameterServer:
         ``AsyncWorker.finish_window``), stored in the same locked section
         as the commit so checkpoints capture worker states consistent with
         (never ahead of) the center. Stored even for a deduped replay —
-        the replayed state is at-or-behind the center, which is safe."""
+        the replayed state is at-or-behind the center, which is safe.
+
+        Int8-compressed deltas (``utils.compression``, the workers'
+        ``compress="int8"`` wire format) are reconstructed here, before
+        the rule — every PS rule and transport sees plain float trees."""
+        from distkeras_tpu.utils.compression import maybe_decompress
+
+        delta = maybe_decompress(delta)
         snap = None
         with self._lock:
             if commit_id is not None:

@@ -1,0 +1,76 @@
+"""Int8 delta compression with error feedback — the async DCN path's
+bandwidth tier.
+
+The reference ships full pickled float32 weight sets on every pull/commit
+(reference: distkeras/networking.py -> send_data/recv_data; SURVEY §5.8:
+"no compression"); its scalability ceiling is the driver link. Here the
+async algorithms' COMMIT payloads (gradient deltas, elastic
+displacements) can ride the wire as int8: per-leaf symmetric linear
+quantization (scale = max|x| / 127) cuts commit bytes ~4x, and the worker
+keeps the quantization error as a residual added to its NEXT delta
+(error feedback, a la 1-bit SGD / EF-SGD) so the error is carried, not
+lost — cumulative drift stays bounded by one quantization step instead of
+growing with the step count.
+
+Wire format: ``{"__dkt_q8__": {"q": int8 tree, "s": float32 scale tree}}``
+— plain arrays, so the pickle-free DKT1 frame (utils/serialization)
+carries it unchanged. ``ParameterServer.commit`` transparently
+dequantizes, so every PS rule (Delta/ADAG/DynSGD) and both transports
+(in-process, socket/DCN) work with compression on.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+Q8_KEY = "__dkt_q8__"
+
+
+def _quantize_leaf(a):
+    a = np.asarray(a, np.float32)
+    scale = np.float32(np.max(np.abs(a)) / 127.0) if a.size else np.float32(0)
+    if scale == 0.0:
+        return np.zeros(a.shape, np.int8), scale
+    return np.clip(np.round(a / scale), -127, 127).astype(np.int8), scale
+
+
+def _dequantize_leaf(q, scale):
+    return q.astype(np.float32) * np.float32(scale)
+
+
+def quantize_tree(tree):
+    """-> (payload, dequantized tree). The payload is what goes on the
+    wire; the dequantized tree is what the PS will reconstruct (callers
+    use it to compute the error-feedback residual without a round trip)."""
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    pairs = [_quantize_leaf(a) for a in flat]
+    unflat = jax.tree_util.tree_unflatten
+    qs = unflat(treedef, [q for q, _ in pairs])
+    ss = unflat(treedef, [s for _, s in pairs])
+    deq = jax.tree.map(_dequantize_leaf, qs, ss)
+    return {Q8_KEY: {"q": qs, "s": ss}}, deq
+
+
+def dequantize_tree(payload):
+    body = payload[Q8_KEY]
+    return jax.tree.map(_dequantize_leaf, body["q"], body["s"])
+
+
+def is_compressed(delta) -> bool:
+    return isinstance(delta, dict) and set(delta.keys()) == {Q8_KEY}
+
+
+def maybe_decompress(delta):
+    """PS-side entry: pass raw deltas through, reconstruct compressed ones."""
+    return dequantize_tree(delta) if is_compressed(delta) else delta
+
+
+def compress_with_feedback(delta, residual):
+    """Worker-side entry: fold the previous residual into this delta,
+    quantize, and return (wire payload, next residual)."""
+    if residual is not None:
+        delta = jax.tree.map(lambda d, r: d + r, delta, residual)
+    payload, deq = quantize_tree(delta)
+    new_residual = jax.tree.map(lambda d, x: d - x, delta, deq)
+    return payload, new_residual

@@ -491,6 +491,7 @@ class AsyncWorker:
         communication_window: int,
         seed=0,
         device=None,
+        compress=None,
     ):
         self.core = core
         self.ps = ps
@@ -498,6 +499,10 @@ class AsyncWorker:
         self.features_col = features_col
         self.label_col = label_col
         self.window_size = int(communication_window)
+        if compress not in (None, "int8"):
+            raise ValueError(f"compress must be None or 'int8'; got {compress!r}")
+        self.compress = compress
+        self._q_residual = None  # error-feedback state (utils/compression)
         self._rng0 = jax.random.fold_in(jax.random.PRNGKey(seed), worker_id)
         self.rng = self._rng0
         self.device = device
@@ -550,6 +555,7 @@ class AsyncWorker:
             self._params = None
             self._state = None
             self._opt_state = None
+            self._q_residual = None
         if hasattr(self.ps, "reconnect"):
             self.ps.reconnect()  # a crashed socket stream may be desynced
 
@@ -581,6 +587,8 @@ class AsyncWorker:
         self.rng = jnp.asarray(np.asarray(snap["rng"]))
         self._seq = int(snap["seq"])
         self._start_seq = int(snap["seq"])
+        # residual stays host-side (commit-path state, never donated)
+        self._q_residual = host_copy(snap.get("q_residual"))
 
     # -- algorithm hooks ----------------------------------------------------
 
@@ -751,6 +759,25 @@ class AsyncWorker:
         )
         self.records.extend(_metrics_to_records(mets))
         delta, tag = self.make_delta(pend["pulled"], result)
+        delta_np = jax.tree.map(np.asarray, delta)
+        if self.compress == "int8":
+            from distkeras_tpu.utils.compression import (
+                compress_with_feedback,
+                is_compressed,
+            )
+
+            # fold last window's quantization error in, quantize, keep the
+            # new residual for the next commit (error feedback). Elastic
+            # workers quantize inside make_delta instead (the displacement
+            # must match what they subtracted locally) and arrive here
+            # already compressed. This runs BEFORE the snapshot below so a
+            # checkpoint carries THIS commit's residual — a snapshot of the
+            # pre-commit residual would make a resume re-apply the previous
+            # window's error and drop this one's.
+            if not is_compressed(delta_np):
+                delta_np, self._q_residual = compress_with_feedback(
+                    delta_np, self._q_residual
+                )
         local_snap = None
         if self.keep_snapshot and (self._seq + 1) % self.snapshot_stride == 0:
             # host copies of this commit's local state, handed to the PS so
@@ -760,7 +787,7 @@ class AsyncWorker:
             # replayed windows dedup at the PS)
             local_snap = self._make_snap(self._seq + 1)
         self.ps.commit(
-            jax.tree.map(np.asarray, delta),
+            delta_np,
             tag,
             commit_id=(self.worker_id, self._seq),
             local_snap=local_snap,
@@ -776,13 +803,18 @@ class AsyncWorker:
         # host_copy, NOT np.asarray: asarray may alias device buffers on
         # CPU, and these trees are the next window call's DONATED inputs —
         # an aliased long-lived snapshot would be corrupted in place
-        return {
+        snap = {
             "params": host_copy(self._params),
             "state": host_copy(self._state),
             "opt_state": host_copy(self._opt_state),
             "rng": host_copy(self.rng),
             "seq": np.int64(seq),
         }
+        if self._q_residual is not None:
+            # error-feedback residual rides the snapshot: a resumed
+            # compressed run keeps carrying the same quantization error
+            snap["q_residual"] = host_copy(self._q_residual)
+        return snap
 
     def final_snapshot(self):
         """Fresh host-copy snapshot of the worker's end-of-run state (the
@@ -903,6 +935,20 @@ class AEASGDWorker(AsyncWorker):
         center, tag = pulled
         alpha = self.rho * self.learning_rate
         elastic = tree_scale(tree_sub(result["params"], center), alpha)
+        if self.compress == "int8":
+            # the elastic rule applies the displacement on BOTH sides
+            # (x_local -= e, center += e); quantize BEFORE the local
+            # subtraction so both apply the identical dequantized value —
+            # error-feedback-style asymmetry (raw locally, dequantized at
+            # the PS) makes replica and center drift apart and diverges.
+            # No residual is kept: the un-shipped remainder stays in
+            # x_local and re-enters the next elastic difference, which is
+            # its own feedback loop.
+            from distkeras_tpu.utils.compression import quantize_tree
+
+            payload, deq = quantize_tree(jax.tree.map(np.asarray, elastic))
+            self._params = tree_sub(result["params"], deq)
+            return payload, tag
         self._params = tree_sub(result["params"], elastic)
         return elastic, tag
 
