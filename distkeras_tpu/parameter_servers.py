@@ -91,7 +91,13 @@ class ParameterServer:
 
     commit_rule = staticmethod(delta_rule)
 
-    def __init__(self, params):
+    def __init__(self, params, pull_compress=None):
+        if pull_compress not in (None, "bfloat16"):
+            raise ValueError(
+                f"pull_compress must be None or 'bfloat16'; got "
+                f"{pull_compress!r}"
+            )
+        self.pull_compress = pull_compress
         self._center = _to_host(params)
         self._meta = {"num_updates": 0}
         self._lock = threading.Lock()
@@ -120,12 +126,22 @@ class ParameterServer:
     # -- protocol verbs -----------------------------------------------------
 
     def pull(self, worker_id=None):
-        """Return (copy of center, tag). Tag is None unless versioned."""
+        """Return (copy of center, tag). Tag is None unless versioned.
+
+        With ``pull_compress="bfloat16"`` (set by the trainer) the center
+        goes out bf16-encoded — half the pull bytes on the DCN path;
+        workers decode via ``utils.compression.maybe_decode_pull``. The
+        encode happens here, transport-independently, so simulated and
+        socket runs see identical pulled values."""
         with self._lock:
             center = jax.tree.map(np.copy, self._center)
             tag = self._pull_tag()
             if worker_id is not None:
                 self._activity[worker_id] = time.monotonic()
+        if self.pull_compress == "bfloat16":
+            from distkeras_tpu.utils.compression import bf16_encode_tree
+
+            center = bf16_encode_tree(center)
         return center, tag
 
     def commit(self, delta, tag=None, commit_id=None, local_snap=None):
@@ -290,8 +306,8 @@ class DynSGDParameterServer(ParameterServer):
 
     commit_rule = staticmethod(dynsgd_rule)
 
-    def __init__(self, params):
-        super().__init__(params)
+    def __init__(self, params, pull_compress=None):
+        super().__init__(params, pull_compress=pull_compress)
         self._meta["version"] = 0
 
     def _pull_tag(self):

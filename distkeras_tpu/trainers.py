@@ -1568,6 +1568,7 @@ class DistributedTrainer(Trainer):
         heartbeat_timeout=None,
         device_resident=False,
         compress=None,
+        pull_compress=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -1581,6 +1582,15 @@ class DistributedTrainer(Trainer):
                 f"compress must be None or 'int8'; got {compress!r}"
             )
         self.compress = compress
+        # pull_compress="bfloat16": the pulled center ships bf16-encoded
+        # (half the pull bytes); workers decode on receipt. bf16 matches
+        # the precision the compute path already runs activations at.
+        if pull_compress not in (None, "bfloat16"):
+            raise ValueError(
+                f"pull_compress must be None or 'bfloat16'; got "
+                f"{pull_compress!r}"
+            )
+        self.pull_compress = pull_compress
         # device_resident: each worker ships its partition to HBM once and
         # streams only (W, B) index matrices per window — the async face of
         # the device-resident input path (window stream bit-identical to the
@@ -1613,7 +1623,8 @@ class DistributedTrainer(Trainer):
     # -- template hooks -----------------------------------------------------
 
     def allocate_parameter_server(self):
-        return self.ps_cls(self.model.params)
+        return self.ps_cls(self.model.params,
+                           pull_compress=self.pull_compress)
 
     def worker_kwargs(self) -> dict:
         return {}

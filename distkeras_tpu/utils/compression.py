@@ -66,6 +66,66 @@ def maybe_decompress(delta):
     return dequantize_tree(delta) if is_compressed(delta) else delta
 
 
+BF16_KEY = "__dkt_bf16__"
+
+
+def _bf16_encode_leaf(a):
+    a = np.asarray(a)
+    if a.dtype != np.float32:
+        return a, np.int8(0)  # non-f32 leaves ride raw (flagged)
+    u = a.view(np.uint32)
+    # round-to-nearest-even on the truncated mantissa — EXCEPT for
+    # exponent 0xFF lanes (inf/NaN): the rounding add would carry through
+    # the exponent and turn a NaN center into inf (or wrap to 0.0),
+    # silently masking a diverged run; truncation preserves the payload
+    rounded = (u + np.uint32(0x7FFF) + ((u >> 16) & np.uint32(1))) >> 16
+    nonfinite = (u & np.uint32(0x7F800000)) == np.uint32(0x7F800000)
+    out = np.where(nonfinite, u >> 16, rounded)
+    # a NaN whose payload lives only in the truncated bits must stay NaN
+    out = np.where(
+        nonfinite & ((u & np.uint32(0x007FFFFF)) != 0),
+        out | np.uint32(0x0040),
+        out,
+    )
+    return out.astype(np.uint16), np.int8(1)
+
+
+def _bf16_decode_leaf(v, flag):
+    if not int(flag):
+        return v
+    return (v.astype(np.uint32) << 16).view(np.float32)
+
+
+def bf16_encode_tree(tree):
+    """Truncate float32 leaves to bfloat16-on-the-wire (uint16 payload,
+    round-to-nearest); non-f32 leaves pass through, flagged. Halves pull
+    bytes at bf16's 8-bit-mantissa precision — the same precision the
+    compute path already runs activations at (compute_dtype)."""
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    pairs = [_bf16_encode_leaf(a) for a in flat]
+    unflat = jax.tree_util.tree_unflatten
+    return {
+        BF16_KEY: {
+            "v": unflat(treedef, [v for v, _ in pairs]),
+            "m": unflat(treedef, [m for _, m in pairs]),
+        }
+    }
+
+
+def bf16_decode_tree(payload):
+    body = payload[BF16_KEY]
+    return jax.tree.map(_bf16_decode_leaf, body["v"], body["m"])
+
+
+def is_bf16(tree) -> bool:
+    return isinstance(tree, dict) and set(tree.keys()) == {BF16_KEY}
+
+
+def maybe_decode_pull(center):
+    """Worker-side entry: reconstruct a bf16-encoded pulled center."""
+    return bf16_decode_tree(center) if is_bf16(center) else center
+
+
 def compress_with_feedback(delta, residual):
     """Worker-side entry: fold the previous residual into this delta,
     quantize, and return (wire payload, next residual)."""

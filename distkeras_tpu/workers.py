@@ -30,6 +30,7 @@ import optax
 from distkeras_tpu.data.prefetch import Prefetcher
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.metrics import get_metric
+from distkeras_tpu.utils.compression import maybe_decode_pull
 from distkeras_tpu.utils.tree import host_copy, tree_scale, tree_sub
 
 # ------------------------------------------------------------------ core step
@@ -616,6 +617,7 @@ class AsyncWorker:
     def begin_window(self, batches):
         # owned host (numpy) copies; worker_id doubles as the PS heartbeat
         center_host, tag = self.ps.pull(worker_id=self.worker_id)
+        center_host = maybe_decode_pull(center_host)
         center = (
             jax.device_put(center_host, self.device)
             if self.device is not None
@@ -715,6 +717,7 @@ class AsyncWorker:
         """``begin_window`` over the device-resident pool: pull + launch,
         shipping only the index matrix for this window."""
         center_host, tag = self.ps.pull(worker_id=self.worker_id)
+        center_host = maybe_decode_pull(center_host)
         center = (
             jax.device_put(center_host, self.device)
             if self.device is not None

@@ -222,3 +222,85 @@ def test_downpour_int8_resume_restores_residual(tmp_path):
         assert w._start_seq > 0
         assert w._q_residual is not None  # restored AND maintained
     assert t2.parameter_server.num_updates == 2 * n1
+
+
+def test_bf16_roundtrip_precision_and_passthrough():
+    from distkeras_tpu.utils.compression import (
+        bf16_decode_tree,
+        bf16_encode_tree,
+        is_bf16,
+        maybe_decode_pull,
+    )
+
+    rng = np.random.default_rng(3)
+    tree = {
+        "w": rng.standard_normal((64, 32)).astype(np.float32),
+        "step": np.int64(7),  # non-f32 leaf passes through untouched
+    }
+    payload = bf16_encode_tree(tree)
+    assert is_bf16(payload)
+    out = bf16_decode_tree(payload)
+    # bf16 keeps an 8-bit mantissa: relative error < 2^-8
+    np.testing.assert_allclose(out["w"], tree["w"], rtol=2**-8)
+    assert out["step"] == 7 and out["step"].dtype == np.int64
+    # non-finite values survive the wire: a diverged center must arrive
+    # as NaN/inf, not be rounded into a finite lie
+    spec = np.array([np.nan, np.inf, -np.inf], np.float32)
+    got = bf16_decode_tree(bf16_encode_tree({"s": spec}))["s"]
+    assert np.isnan(got[0]) and got[1] == np.inf and got[2] == -np.inf
+    # wire bytes halve for the float leaves
+    from distkeras_tpu.utils.serialization import serialize_params
+
+    big = {"w": tree["w"]}
+    assert len(serialize_params(bf16_encode_tree(big))) < (
+        len(serialize_params(big)) * 0.62
+    )
+    # raw trees pass through the worker-side decode untouched
+    assert maybe_decode_pull(tree) is tree
+
+
+def test_downpour_bf16_pull_converges_over_socket():
+    """Half-width pulls (bf16 center) + int8 commits together: the full
+    DCN bandwidth configuration still reaches the accuracy target over
+    the real socket transport."""
+    from distkeras_tpu import DOWNPOUR, MinMaxTransformer, OneHotTransformer
+    from distkeras_tpu.data import loaders
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.predictors import ModelPredictor
+
+    ds = loaders.synthetic_mnist(n=2048, seed=0)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    train, test = ds.split(0.85, seed=0)
+
+    t = DOWNPOUR(
+        zoo.mnist_mlp(hidden=32),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.02,
+        num_workers=4,
+        batch_size=64,
+        communication_window=4,
+        num_epoch=3,
+        mode="simulated",
+        compress="int8",
+        pull_compress="bfloat16",
+        remote_ps=True,
+        label_col="label_onehot",
+        seed=0,
+    )
+    trained = t.train(train)
+    acc = AccuracyEvaluator(label_col="label").evaluate(
+        ModelPredictor(trained, batch_size=256).predict(test)
+    )
+    assert acc > 0.9, acc
+
+
+def test_pull_compress_rejected_values():
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu.models import zoo
+
+    with pytest.raises(ValueError, match="pull_compress"):
+        DOWNPOUR(zoo.mnist_mlp(hidden=8), "sgd",
+                 "categorical_crossentropy", pull_compress="fp16")
