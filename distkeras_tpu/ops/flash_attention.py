@@ -351,15 +351,9 @@ def attach_flash_attention(model, block_q=DEFAULT_BLOCK_Q,
     """Point every MultiHeadSelfAttention at the fused kernel (single-chip
     fast path). Returns how many were attached. Process-local, like the
     ring/blockwise hooks — not serialized."""
-    from distkeras_tpu.models.layers import MultiHeadSelfAttention
-    from distkeras_tpu.models.sequential import walk_layers
+    from distkeras_tpu.parallel.ring_attention import attach_attention_fn
 
-    fn = functools.partial(
-        flash_attention, block_q=block_q, block_k=block_k
+    return attach_attention_fn(
+        model, functools.partial(flash_attention, block_q=block_q,
+                                 block_k=block_k)
     )
-    n = 0
-    for layer in walk_layers(model):
-        if isinstance(layer, MultiHeadSelfAttention):
-            layer.attention_fn = fn
-            n += 1
-    return n

@@ -182,22 +182,30 @@ def blockwise_attention(q, k, v, causal=False, block_size=512):
     return _finalize(acc, l, q.dtype)
 
 
-def attach_blockwise_attention(model, block_size=512) -> int:
-    """Point every MultiHeadSelfAttention at :func:`blockwise_attention`
-    (single-device long-context mode). Returns how many were attached.
-    Unlike the ring hook this closes over no mesh, but it is still a
-    process-local hook and is not serialized."""
+def attach_attention_fn(model, fn) -> int:
+    """The one attach loop shared by every attention hook (blockwise,
+    ring, ulysses, flash): point every MultiHeadSelfAttention's
+    ``attention_fn`` at ``fn``; returns how many were attached. All such
+    hooks are process-local and not serialized."""
     from distkeras_tpu.models.layers import MultiHeadSelfAttention
     from distkeras_tpu.models.sequential import walk_layers
 
     n = 0
     for layer in walk_layers(model):
         if isinstance(layer, MultiHeadSelfAttention):
-            layer.attention_fn = functools.partial(
-                blockwise_attention, block_size=block_size
-            )
+            layer.attention_fn = fn
             n += 1
     return n
+
+
+def attach_blockwise_attention(model, block_size=512) -> int:
+    """Point every MultiHeadSelfAttention at :func:`blockwise_attention`
+    (single-device long-context mode). Returns how many were attached.
+    Unlike the ring hook this closes over no mesh, but it is still a
+    process-local hook and is not serialized."""
+    return attach_attention_fn(
+        model, functools.partial(blockwise_attention, block_size=block_size)
+    )
 
 
 def attach_ring_attention(
@@ -207,20 +215,13 @@ def attach_ring_attention(
     ring implementation over ``mesh``. Returns how many were attached.
     (Process-local: hooks close over the live mesh and are not serialized —
     re-attach after deserializing on another host.)"""
-    import functools
-
-    from distkeras_tpu.models.layers import MultiHeadSelfAttention
-    from distkeras_tpu.models.sequential import walk_layers
-
-    fn = functools.partial(
-        ring_attention, mesh=mesh, axis_name=axis_name, batch_axis=batch_axis
+    return attach_attention_fn(
+        model,
+        functools.partial(
+            ring_attention, mesh=mesh, axis_name=axis_name,
+            batch_axis=batch_axis,
+        ),
     )
-    count = 0
-    for layer in walk_layers(model):
-        if isinstance(layer, MultiHeadSelfAttention):
-            layer.attention_fn = fn
-            count += 1
-    return count
 
 
 def detach_ring_attention(model) -> int:

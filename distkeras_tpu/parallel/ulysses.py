@@ -1,0 +1,110 @@
+"""Ulysses-style sequence parallelism — all-to-all head-sharded attention.
+
+The second canonical long-context scheme next to the ppermute ring
+(`parallel.ring_attention`): instead of rotating K/V blocks N-1 times,
+ONE `all_to_all` re-shards (batch, seq/N, heads, d) to
+(batch, seq, heads/N, d), every device runs full-sequence attention over
+its head slice, and a second `all_to_all` restores the token sharding
+(DeepSpeed-Ulysses; no reference counterpart — SURVEY §5.7: no attention
+upstream). Trade-offs vs the ring, both first-class here:
+
+- communication: 2 all-to-alls of activation size vs N-1 K/V ppermutes —
+  Ulysses wins when N is large and ICI all-to-all bandwidth is good;
+  the ring wins when heads are few or attention must stay blockwise.
+- constraint: num_heads must be divisible by the mesh-axis size
+  (head-sharded attention); the ring has no head constraint.
+- memory: each device sees the FULL sequence for its head slice —
+  ``inner="blockwise"`` streams K/V blocks through the online softmax
+  (`ring_attention.blockwise_attention`'s math) so score memory stays
+  (seq, block) instead of (seq, seq).
+
+Layouts match the ring exactly: (batch, seq, heads, head_dim) with the
+seq axis sharded over ``axis_name``, optional ``batch_axis`` for 2-D
+batch x token meshes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ulysses_local(q, k, v, axis_name, causal, inner):
+    from distkeras_tpu.parallel.ring_attention import (
+        blockwise_attention,
+        dense_attention,
+    )
+
+    # (b, t/N, h, d) -> (b, t, h/N, d): one all-to-all per tensor
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2,
+        concat_axis=1, tiled=True,
+    )
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)
+    if inner == "blockwise":
+        out = blockwise_attention(qh, kh, vh, causal=causal)
+    else:
+        out = dense_attention(qh, kh, vh, causal=causal)
+    # (b, t, h/N, d) -> (b, t/N, h, d)
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False,
+    batch_axis=None, inner="dense",
+):
+    """Attention with the sequence axis sharded over ``axis_name`` via
+    head-sharding all-to-alls. Same contract as ``ring_attention``:
+    q, k, v (batch, seq, heads, head_dim), seq AND num_heads both
+    divisible by the axis size. ``inner`` picks the
+    per-device attention over the full sequence: "dense" or "blockwise"
+    (online-softmax scan, long-context memory)."""
+    axis_size = mesh.shape[axis_name]
+    if q.shape[1] % axis_size:
+        raise ValueError(
+            f"seq length {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name}={axis_size}"
+        )
+    if q.shape[2] % axis_size:
+        raise ValueError(
+            f"ulysses shards heads over {axis_name}: num_heads "
+            f"{q.shape[2]} not divisible by {axis_size} (use the ring for "
+            "head counts below the mesh size)"
+        )
+    if inner not in ("dense", "blockwise"):
+        raise ValueError(f"inner must be 'dense' or 'blockwise'; got {inner!r}")
+    spec = P(batch_axis, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ulysses_local, axis_name=axis_name, causal=causal, inner=inner
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return fn(q, k, v)
+
+
+def attach_ulysses_attention(
+    model, mesh: Mesh, axis_name: str = "seq", batch_axis=None,
+    inner="dense",
+) -> int:
+    """Point every MultiHeadSelfAttention at the Ulysses implementation
+    over ``mesh``. Returns how many were attached. Process-local, like
+    the ring hook (closes over a live mesh; not serialized) —
+    ``ring_attention.detach_ring_attention`` removes these too."""
+    from distkeras_tpu.parallel.ring_attention import attach_attention_fn
+
+    return attach_attention_fn(
+        model,
+        functools.partial(
+            ulysses_attention, mesh=mesh, axis_name=axis_name,
+            batch_axis=batch_axis, inner=inner,
+        ),
+    )

@@ -665,26 +665,36 @@ class SynchronousDistributedTrainer(Trainer):
 
 
 class SequenceParallelTrainer(Trainer):
-    """Sequence/context-parallel training through ring attention.
+    """Sequence/context-parallel training — ring OR Ulysses attention.
 
     No reference counterpart (SURVEY §5.7: the reference's workloads have no
     sequence dimension); this trainer is the rebuild's long-context
     capability. The TOKEN axis of every batch is sharded across a
     ``Mesh(("seq",))`` — each device holds ``T / num_workers`` tokens —
-    and every ``MultiHeadSelfAttention`` in the model is pointed at
-    ``parallel.ring_attention``: K/V blocks rotate around the ring via
-    ``lax.ppermute`` with an online softmax, so the full score matrix never
-    materializes and per-device attention memory is O((T/N)^2).
+    and every ``MultiHeadSelfAttention`` is pointed at the scheme chosen
+    by ``sp_mode``:
+
+    - ``"ring"`` (default, ``parallel.ring_attention``): K/V blocks
+      rotate around the ring via ``lax.ppermute`` with an online softmax,
+      so the full score matrix never materializes and per-device
+      attention memory is O((T/N)^2). No head-count constraint.
+    - ``"ulysses"`` (``parallel.ulysses``): one ``all_to_all`` re-shards
+      tokens into head slices, each device attends over the FULL sequence
+      for its heads (``sp_inner="dense"`` or ``"blockwise"``), a second
+      ``all_to_all`` restores the token sharding. Two collectives per
+      attention instead of N-1; num_heads must be divisible by the
+      seq-axis size.
 
     Params are replicated; the loss reduces over batch AND token axes, so
     GSPMD inserts the gradient reductions across the "seq" axis
-    automatically — the whole training step (including the ppermute ring
-    and its transpose in the backward pass) is ONE compiled XLA program.
+    automatically — the whole training step (including the collectives'
+    transposes in the backward pass) is ONE compiled XLA program.
     Windows of W steps scan inside that program like every other trainer.
 
-    The returned model computes dense attention (the hook closes over a
-    live mesh and is process-local); call
-    ``parallel.ring_attention.attach_ring_attention`` again to serve
+    The returned model computes dense attention (the hooks close over a
+    live mesh and are process-local); call
+    ``parallel.ring_attention.attach_ring_attention`` /
+    ``parallel.ulysses.attach_ulysses_attention`` again to serve
     long-context inference sharded.
     """
 
@@ -699,9 +709,25 @@ class SequenceParallelTrainer(Trainer):
         checkpoint_dir=None,
         checkpoint_every=1,
         max_to_keep=3,
+        sp_mode="ring",
+        sp_inner="dense",
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
+        # sp_mode: how attention crosses the sequence shards — "ring"
+        # (K/V ppermute rotation, no head constraint) or "ulysses"
+        # (all-to-all head sharding, 2 collectives instead of N-1;
+        # num_heads must be divisible by the seq-axis size). sp_inner
+        # picks ulysses' per-device attention over the full sequence:
+        # "dense" or "blockwise" (online-softmax scan — (seq, block) score
+        # memory, the long-context setting). See parallel/ulysses.py for
+        # the trade-offs.
+        if sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_mode must be 'ring' or 'ulysses'; got {sp_mode!r}"
+            )
+        self.sp_mode = sp_mode
+        self.sp_inner = sp_inner
         if mesh is not None:
             if "seq" not in mesh.axis_names:
                 raise ValueError(f"mesh {dict(mesh.shape)} has no 'seq' axis")
@@ -746,9 +772,17 @@ class SequenceParallelTrainer(Trainer):
         )
 
         batch_axis = "data" if self.data_size > 1 else None
-        attached = attach_ring_attention(
-            self.model, self.mesh, "seq", batch_axis=batch_axis
-        )
+        if self.sp_mode == "ulysses":
+            from distkeras_tpu.parallel.ulysses import attach_ulysses_attention
+
+            attached = attach_ulysses_attention(
+                self.model, self.mesh, "seq", batch_axis=batch_axis,
+                inner=self.sp_inner,
+            )
+        else:
+            attached = attach_ring_attention(
+                self.model, self.mesh, "seq", batch_axis=batch_axis
+            )
         if attached == 0:
             raise ValueError(
                 "model has no MultiHeadSelfAttention layers — sequence "

@@ -1,0 +1,148 @@
+"""Ulysses all-to-all sequence parallelism (parallel/ulysses.py).
+
+The second SP scheme next to the ppermute ring: head-sharded attention
+between two all-to-alls. Pins value parity against dense attention on the
+8-device mesh (causal and not, 1-D and 2-D meshes, dense and blockwise
+inner), trainer parity for classifier and causal-LM training, and the
+heads-divisibility contract.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from distkeras_tpu.parallel.ring_attention import dense_attention
+from distkeras_tpu.parallel.ulysses import ulysses_attention
+
+
+def make_qkv(b=2, t=64, h=8, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        rng.standard_normal((b, t, h, d)).astype(np.float32) for _ in range(3)
+    )
+
+
+def seq_mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), ("seq",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("inner", ["dense", "blockwise"])
+def test_ulysses_matches_dense(causal, inner):
+    q, k, v = make_qkv()
+    want = np.asarray(dense_attention(q, k, v, causal=causal))
+    got = np.asarray(
+        ulysses_attention(q, k, v, seq_mesh(), causal=causal, inner=inner)
+    )
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_ulysses_2d_batch_by_token_mesh():
+    q, k, v = make_qkv(b=4, t=32, h=4, d=8)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "seq"))
+    want = np.asarray(dense_attention(q, k, v, causal=True))
+    got = np.asarray(
+        ulysses_attention(
+            q, k, v, mesh, causal=True, batch_axis="data"
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_ulysses_heads_must_divide():
+    q, k, v = make_qkv(h=4)  # 4 heads on an 8-way axis
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, seq_mesh())
+
+
+def test_ulysses_gradients_match_dense():
+    q, k, v = make_qkv(t=32)
+    mesh = seq_mesh()
+
+    def loss_u(q, k, v):
+        return (ulysses_attention(q, k, v, mesh, causal=True) ** 2).sum()
+
+    def loss_d(q, k, v):
+        return (dense_attention(q, k, v, causal=True) ** 2).sum()
+
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+def test_sp_trainer_ulysses_matches_dense_single_trainer():
+    """SequenceParallelTrainer(sp_mode="ulysses") must track dense
+    single-device training like the ring mode does — same contract,
+    different collectives."""
+    from distkeras_tpu import SequenceParallelTrainer, SingleTrainer
+    from distkeras_tpu.data import loaders
+    from distkeras_tpu.data.transformers import OneHotTransformer
+    from distkeras_tpu.models import zoo
+
+    ds = loaders.synthetic_sequences(n=512, seq_len=64, vocab=16, seed=0)
+    ds = OneHotTransformer(2, output_col="label_onehot").transform(ds)
+    kw = dict(
+        loss="categorical_crossentropy",
+        batch_size=32,
+        num_epoch=1,
+        label_col="label_onehot",
+        seed=0,
+    )
+
+    def make():
+        return zoo.transformer_classifier(
+            vocab_size=16, seq_len=64, d_model=32, num_heads=8, depth=2,
+            seed=0,
+        )
+
+    m_dense = SingleTrainer(make(), "adam", **kw).train(ds)
+    m_sp = SequenceParallelTrainer(
+        make(), "adam", num_workers=8, sp_mode="ulysses", **kw
+    ).train(ds)
+    for a, b in zip(m_dense.get_weights(), m_sp.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+
+def test_sp_trainer_ulysses_causal_lm():
+    """Ulysses SP training of the causal LM (token axis sharded, heads
+    sharded inside attention) matches dense single-device training."""
+    from distkeras_tpu import SequenceParallelTrainer, SingleTrainer
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models import zoo
+
+    rng = np.random.default_rng(4)
+    n, seq, vocab = 256, 64, 16
+    starts = rng.integers(0, vocab, n)
+    xs = ((starts[:, None] + np.arange(seq)[None, :]) % vocab).astype(np.int32)
+    ds = Dataset({"features": xs, "label": xs})
+    kw = dict(
+        loss="next_token_crossentropy",
+        batch_size=32,
+        num_epoch=1,
+        metrics=(),
+        seed=0,
+    )
+
+    def make():
+        return zoo.transformer_lm(vocab_size=vocab, seq_len=seq, d_model=32,
+                                  num_heads=8, depth=2, seed=0)
+
+    m_dense = SingleTrainer(make(), "adam", **kw).train(ds)
+    m_sp = SequenceParallelTrainer(
+        make(), "adam", num_workers=8, sp_mode="ulysses", **kw
+    ).train(ds)
+    for a, b in zip(m_dense.get_weights(), m_sp.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+
+def test_sp_mode_rejected_values():
+    from distkeras_tpu import SequenceParallelTrainer
+    from distkeras_tpu.models import zoo
+
+    with pytest.raises(ValueError, match="sp_mode"):
+        SequenceParallelTrainer(
+            zoo.transformer_classifier(), "adam",
+            loss="categorical_crossentropy", sp_mode="megatron",
+        )
