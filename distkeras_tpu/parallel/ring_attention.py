@@ -178,7 +178,14 @@ def blockwise_attention(q, k, v, causal=False, block_size=512):
         )
         return (acc, m, l), None
 
-    (acc, m, l), _ = jax.lax.scan(step, _init_carry(b, t, h, d), (kb, vb, offs))
+    # the init carry derives from q (not fresh zeros) so that under
+    # shard_map it inherits q's varying manual axes — a replicated init
+    # vs a varying output fails lax.scan's carry-type check when this
+    # runs as the Ulysses inner attention
+    acc0 = (q * 0.0).astype(jnp.float32)
+    row0 = jnp.swapaxes(acc0[..., 0], 1, 2)  # (B, H, Tq) of zeros
+    init = (acc0, row0 - jnp.inf, row0)
+    (acc, m, l), _ = jax.lax.scan(step, init, (kb, vb, offs))
     return _finalize(acc, l, q.dtype)
 
 

@@ -31,20 +31,25 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _ulysses_local(q, k, v, axis_name, causal, inner):
+def _ulysses_local(q, k, v, axis_name, causal, inner, block_size):
     from distkeras_tpu.parallel.ring_attention import (
         blockwise_attention,
         dense_attention,
     )
 
-    # (b, t/N, h, d) -> (b, t, h/N, d): one all-to-all per tensor
-    a2a = functools.partial(
-        jax.lax.all_to_all, axis_name=axis_name, split_axis=2,
-        concat_axis=1, tiled=True,
+    import jax.numpy as jnp
+
+    # (3, b, t/N, h, d) -> (3, b, t, h/N, d): q/k/v stacked so the
+    # re-shard really is ONE collective (the "2 per attention" count)
+    qkv = jnp.stack((q, k, v))
+    qkv = jax.lax.all_to_all(
+        qkv, axis_name=axis_name, split_axis=3, concat_axis=2, tiled=True
     )
-    qh, kh, vh = a2a(q), a2a(k), a2a(v)
+    qh, kh, vh = qkv[0], qkv[1], qkv[2]
     if inner == "blockwise":
-        out = blockwise_attention(qh, kh, vh, causal=causal)
+        out = blockwise_attention(
+            qh, kh, vh, causal=causal, block_size=block_size
+        )
     else:
         out = dense_attention(qh, kh, vh, causal=causal)
     # (b, t, h/N, d) -> (b, t/N, h, d)
@@ -55,14 +60,15 @@ def _ulysses_local(q, k, v, axis_name, causal, inner):
 
 def ulysses_attention(
     q, k, v, mesh: Mesh, axis_name: str = "seq", causal=False,
-    batch_axis=None, inner="dense",
+    batch_axis=None, inner="dense", inner_block_size=512,
 ):
     """Attention with the sequence axis sharded over ``axis_name`` via
     head-sharding all-to-alls. Same contract as ``ring_attention``:
     q, k, v (batch, seq, heads, head_dim), seq AND num_heads both
     divisible by the axis size. ``inner`` picks the
     per-device attention over the full sequence: "dense" or "blockwise"
-    (online-softmax scan, long-context memory)."""
+    (online-softmax scan, long-context memory; ``inner_block_size`` is
+    its K/V block — the FULL seq length must divide it)."""
     axis_size = mesh.shape[axis_name]
     if q.shape[1] % axis_size:
         raise ValueError(
@@ -80,7 +86,8 @@ def ulysses_attention(
     spec = P(batch_axis, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(
-            _ulysses_local, axis_name=axis_name, causal=causal, inner=inner
+            _ulysses_local, axis_name=axis_name, causal=causal,
+            inner=inner, block_size=inner_block_size,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -93,7 +100,7 @@ def ulysses_attention(
 
 def attach_ulysses_attention(
     model, mesh: Mesh, axis_name: str = "seq", batch_axis=None,
-    inner="dense",
+    inner="dense", inner_block_size=512,
 ) -> int:
     """Point every MultiHeadSelfAttention at the Ulysses implementation
     over ``mesh``. Returns how many were attached. Process-local, like
@@ -106,5 +113,6 @@ def attach_ulysses_attention(
         functools.partial(
             ulysses_attention, mesh=mesh, axis_name=axis_name,
             batch_axis=batch_axis, inner=inner,
+            inner_block_size=inner_block_size,
         ),
     )

@@ -726,6 +726,10 @@ class SequenceParallelTrainer(Trainer):
             raise ValueError(
                 f"sp_mode must be 'ring' or 'ulysses'; got {sp_mode!r}"
             )
+        if sp_inner not in ("dense", "blockwise"):
+            raise ValueError(
+                f"sp_inner must be 'dense' or 'blockwise'; got {sp_inner!r}"
+            )
         self.sp_mode = sp_mode
         self.sp_inner = sp_inner
         if mesh is not None:

@@ -32,8 +32,11 @@ def seq_mesh(n=8):
 def test_ulysses_matches_dense(causal, inner):
     q, k, v = make_qkv()
     want = np.asarray(dense_attention(q, k, v, causal=causal))
+    # inner_block_size 16 << seq 64 so the blockwise case really runs the
+    # online-softmax scan (the default 512 would short-circuit to dense)
     got = np.asarray(
-        ulysses_attention(q, k, v, seq_mesh(), causal=causal, inner=inner)
+        ulysses_attention(q, k, v, seq_mesh(), causal=causal, inner=inner,
+                          inner_block_size=16)
     )
     np.testing.assert_allclose(got, want, atol=2e-5)
 
