@@ -112,14 +112,64 @@ class SequenceGenerator:
 
     ``temperature=0`` decodes greedily; otherwise tokens sample from
     ``softmax(logits / temperature)`` seeded by ``seed`` (same seed, same
-    output).
+    output). ``top_k`` keeps only the k highest logits per step;
+    ``top_p`` keeps the smallest nucleus whose probability mass reaches
+    p (both static, compiled into the scan; combinable — k first, then
+    the nucleus within it).
     """
 
-    def __init__(self, model, temperature=0.0, seed=0):
+    def __init__(self, model, temperature=0.0, seed=0, top_k=None,
+                 top_p=None):
         self.model = model
         self.temperature = float(temperature)
         self.seed = int(seed)
-        self._fns = {}  # (prompt_len, steps) -> compiled scan
+        self.top_k = None if top_k is None else int(top_k)
+        self.top_p = None if top_p is None else float(top_p)
+        self._validate_sampling()
+        self._fns = {}  # decode-config key -> compiled scan
+
+    def _validate_sampling(self):
+        """Re-checked at every generate(): the sampling config is mutable
+        between calls (it keys the compiled-fn cache), so mutation must
+        hit the same validation the constructor applies."""
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1; got {self.top_k}")
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1]; got {self.top_p}")
+        if (
+            (self.top_k is not None or self.top_p is not None)
+            and self.temperature == 0
+        ):
+            raise ValueError(
+                "top_k/top_p filter SAMPLING; temperature=0 is greedy "
+                "argmax — pass a temperature > 0"
+            )
+
+    def _filter_logits(self, logit):
+        """Apply top-k / nucleus filtering to (B, V) logits (-inf out the
+        excluded tokens; jax.random.categorical renormalizes). When both
+        are set the nucleus runs over the renormalized top-k values
+        (B, k) — no full-vocab sort on the per-token serving path."""
+        sorted_desc = None
+        if self.top_k is not None and self.top_k < logit.shape[-1]:
+            topv = jax.lax.top_k(logit, self.top_k)[0]  # (B, k), desc
+            logit = jnp.where(logit < topv[..., -1:], -jnp.inf, logit)
+            sorted_desc = topv
+        if self.top_p is not None and self.top_p < 1.0:
+            if sorted_desc is None:
+                sorted_desc = jnp.sort(logit, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep tokens while the mass BEFORE them is < p (the first
+            # token is always kept)
+            keep_sorted = (cum - probs) < self.top_p
+            # threshold = smallest kept logit
+            thresh = jnp.min(
+                jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1,
+                keepdims=True,
+            )
+            logit = jnp.where(logit < thresh, -jnp.inf, logit)
+        return logit
 
     def _decode_fn(self, prompt_len, steps, temp):
         apply = self.model.apply
@@ -136,7 +186,9 @@ class SequenceGenerator:
                     tok = jnp.argmax(logit, axis=-1)
                 else:
                     key, sub = jax.random.split(key)
-                    tok = jax.random.categorical(sub, logit / temp, axis=-1)
+                    tok = jax.random.categorical(
+                        sub, self._filter_logits(logit / temp), axis=-1
+                    )
                 tok = tok.astype(ctx.dtype)
                 ctx = ctx.at[:, pos + 1].set(tok)
                 return (ctx, key), tok
@@ -171,13 +223,14 @@ class SequenceGenerator:
         Returns (B, P + steps) — the prompts continued ``steps`` tokens.
         P + steps must fit the model's built sequence length."""
         prompts, steps, seq_len = self._validate_generate_args(prompts, steps)
+        self._validate_sampling()
         b, p = prompts.shape
         ctx = np.zeros((b, seq_len), prompts.dtype)
         ctx[:, :p] = prompts
-        # temperature is baked into the compiled scan, so it keys the
-        # cache — mutating gen.temperature between calls must recompile,
-        # not silently reuse the old sampling mode
-        key = (p, steps, self.temperature)
+        # the sampling config is baked into the compiled scan, so it keys
+        # the cache — mutating gen.temperature/top_k/top_p between calls
+        # must recompile, not silently reuse the old sampling mode
+        key = (p, steps, self.temperature, self.top_k, self.top_p)
         if key not in self._fns:
             self._fns[key] = self._decode_fn(p, steps, self.temperature)
         out = self._fns[key](
@@ -207,8 +260,10 @@ class CachedSequenceGenerator(SequenceGenerator):
     blocks, attention hooks) raises rather than decoding incorrectly.
     """
 
-    def __init__(self, model, temperature=0.0, seed=0):
-        super().__init__(model, temperature=temperature, seed=seed)
+    def __init__(self, model, temperature=0.0, seed=0, top_k=None,
+                 top_p=None):
+        super().__init__(model, temperature=temperature, seed=seed,
+                         top_k=top_k, top_p=top_p)
         from distkeras_tpu.models.layers import (
             Dense,
             Embedding,
@@ -357,7 +412,9 @@ class CachedSequenceGenerator(SequenceGenerator):
                     nxt = jnp.argmax(logit, axis=-1)
                 else:
                     key, sub = jax.random.split(key)
-                    nxt = jax.random.categorical(sub, logit / temp, axis=-1)
+                    nxt = jax.random.categorical(
+                        sub, self._filter_logits(logit / temp), axis=-1
+                    )
                 return (nxt.astype(tok.dtype), new_caches, key), nxt
 
             tok0 = ctx[:, prompt_len - 1]

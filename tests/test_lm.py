@@ -441,3 +441,59 @@ def test_transformer_lm_tensor_parallel_matches_dense():
     ).train(ds)
     for a, b in zip(m_dp.get_weights(), m_tp.get_weights()):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
+
+
+def test_generator_top_k_top_p_sampling():
+    """top-k / nucleus filtering: sampled tokens stay inside the allowed
+    set (checked against numpy-computed filters on the same logits), the
+    filters compose, cached == uncached under the same seed, and greedy
+    + filters is rejected."""
+    from distkeras_tpu.predictors import CachedSequenceGenerator, SequenceGenerator
+
+    vocab = 16
+    m = zoo.transformer_lm(vocab_size=vocab, seq_len=16, d_model=32,
+                           num_heads=2, depth=1, seed=0)
+    prompts = np.array([[1, 2], [3, 4]], np.int32)
+
+    def allowed_sets(gen):
+        """Per (row, position) allowed-token sets from the model's own
+        logits along the sampled path (teacher-forcing the output)."""
+        out = gen.generate(prompts, 6)
+        logits = np.asarray(m(np.pad(out, ((0, 0), (0, 16 - out.shape[1])))))
+        ok = True
+        for b in range(out.shape[0]):
+            for i in range(2, out.shape[1]):
+                l = logits[b, i - 1] / gen.temperature
+                keep = np.full(vocab, True)
+                if gen.top_k:
+                    kth = np.sort(l)[-gen.top_k]
+                    keep &= l >= kth
+                if gen.top_p:
+                    order = np.argsort(-l)
+                    p = np.exp(l[order] - l[order].max())
+                    p = p / p.sum()
+                    cum = np.cumsum(p) - p
+                    keep_sorted = cum < gen.top_p
+                    allowed = set(order[keep_sorted])
+                    keep &= np.isin(np.arange(vocab), list(allowed))
+                ok = ok and keep[out[b, i]]
+        return ok, out
+
+    gk = SequenceGenerator(m, temperature=1.0, seed=3, top_k=3)
+    ok, _ = allowed_sets(gk)
+    assert ok
+    gp = SequenceGenerator(m, temperature=1.0, seed=3, top_p=0.5)
+    ok, _ = allowed_sets(gp)
+    assert ok
+    gkp = SequenceGenerator(m, temperature=1.0, seed=3, top_k=5, top_p=0.8)
+    ok, out = allowed_sets(gkp)
+    assert ok
+
+    cached = CachedSequenceGenerator(m, temperature=1.0, seed=3, top_k=5,
+                                     top_p=0.8).generate(prompts, 6)
+    np.testing.assert_array_equal(cached, out)
+
+    with np.testing.assert_raises(ValueError):
+        SequenceGenerator(m, top_k=3)  # greedy + filter
+    with np.testing.assert_raises(ValueError):
+        SequenceGenerator(m, temperature=1.0, top_p=1.5)
