@@ -469,8 +469,12 @@ def test_generator_top_k_top_p_sampling():
                     kth = np.sort(l)[-gen.top_k]
                     keep &= l >= kth
                 if gen.top_p:
-                    order = np.argsort(-l)
-                    p = np.exp(l[order] - l[order].max())
+                    # nucleus over the distribution that SURVIVED top-k
+                    # (renormalized) — pins the documented combined
+                    # semantics, not the full-vocab superset
+                    l_masked = np.where(keep, l, -np.inf)
+                    order = np.argsort(-l_masked)
+                    p = np.exp(l_masked[order] - l_masked[order].max())
                     p = p / p.sum()
                     cum = np.cumsum(p) - p
                     keep_sorted = cum < gen.top_p
@@ -497,3 +501,38 @@ def test_generator_top_k_top_p_sampling():
         SequenceGenerator(m, top_k=3)  # greedy + filter
     with np.testing.assert_raises(ValueError):
         SequenceGenerator(m, temperature=1.0, top_p=1.5)
+
+
+def test_moe_lm_expert_parallel_matches_dp():
+    """The MoE causal LM under trainer-level expert parallelism
+    (("data","expert") mesh) tracks the pure-DP run at equal global
+    batch — EP x LM composes like EP x classifier."""
+    from distkeras_tpu import SynchronousDistributedTrainer
+    from distkeras_tpu.data.dataset import Dataset
+
+    rng = np.random.default_rng(13)
+    n, seq, vocab = 256, 16, 16
+    starts = rng.integers(0, vocab, n)
+    xs = ((starts[:, None] + np.arange(seq)[None, :]) % vocab).astype(np.int32)
+    ds = Dataset({"features": xs, "label": xs})
+    kw = dict(
+        loss="next_token_crossentropy",
+        learning_rate=1e-3,
+        num_epoch=1,
+        metrics=(),
+        seed=0,
+    )
+
+    def make():
+        return zoo.moe_transformer_lm(vocab_size=vocab, seq_len=seq,
+                                      d_model=32, num_heads=2, depth=1,
+                                      num_experts=4, seed=0)
+
+    m_dp = SynchronousDistributedTrainer(
+        make(), "adam", batch_size=4, num_workers=8, **kw
+    ).train(ds)
+    m_ep = SynchronousDistributedTrainer(
+        make(), "adam", batch_size=16, num_workers=2, expert_parallel=4, **kw
+    ).train(ds)
+    for a, b in zip(m_dp.get_weights(), m_ep.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=3e-4)
