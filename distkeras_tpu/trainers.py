@@ -89,6 +89,7 @@ class Trainer:
         seed=0,
         compute_dtype=None,
         remat=False,
+        accum_steps=1,
         aux_loss_weight=0.01,
         profile_dir=None,
         metrics_path=None,
@@ -96,6 +97,18 @@ class Trainer:
     ):
         if model.params is None:
             raise ValueError("model must be built (call model.build(input_shape))")
+        # accum_steps=k: each optimizer step processes its batch as k
+        # sequential microbatches of B/k, averaging the gradients — ~k x
+        # less activation memory at (BN aside) full-batch numerics. B must
+        # divide by k.
+        self.accum_steps = int(accum_steps)
+        if self.accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1; got {accum_steps}")
+        if batch_size % self.accum_steps:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by accum_steps "
+                f"{accum_steps}"
+            )
         self.model = model
         # the lr the optimizer actually runs with — PS/elastic rules that
         # scale by lr (AEASGD, ADAG) must see the same value
@@ -145,6 +158,7 @@ class Trainer:
             metrics=self.metrics,
             compute_dtype=self.compute_dtype,
             remat=self.remat,
+            accum_steps=self.accum_steps,
             aux_loss_weight=self.aux_loss_weight,
         )
 
@@ -1113,6 +1127,10 @@ class PipelineParallelTrainer(Trainer):
             metrics=self.metrics,
             compute_dtype=self.compute_dtype,
             remat=self.remat,
+            # composes: each accumulation microbatch runs the full GPipe
+            # schedule over its B/accum rows (the schedule's own num_micro
+            # subdivides those further)
+            accum_steps=self.accum_steps,
             aux_loss_weight=self.aux_loss_weight,
         )
         # jitted init lets GSPMD propagate the blocks' pipe sharding into

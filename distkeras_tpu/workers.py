@@ -51,6 +51,7 @@ class WorkerCore:
         metrics=("accuracy",),
         compute_dtype=None,
         remat=False,
+        accum_steps=1,
         aux_loss_weight=0.01,
     ):
         self.model = model
@@ -60,6 +61,12 @@ class WorkerCore:
         self.metric_fns = [get_metric(m) for m in metrics]
         self.compute_dtype = compute_dtype
         self.remat = bool(remat)
+        # gradient accumulation: each optimizer step runs its batch as
+        # accum_steps sequential microbatches (inner lax.scan), averaging
+        # gradients — ~k x less activation memory at full-batch numerics
+        # (BatchNorm running stats update per microbatch, the standard
+        # grad-accum semantics)
+        self.accum_steps = int(accum_steps)
         self.aux_loss_weight = float(aux_loss_weight)
 
         model_apply = model.apply
@@ -99,10 +106,44 @@ class WorkerCore:
                 updates, opt_state = optimizer.update(grads, opt_state, params)
                 return optax.apply_updates(params, updates), opt_state
 
+        accum = self.accum_steps
+
+        def batch_grads(params, state, sub, bx, by):
+            """(loss, state, y_pred, grads) for one optimizer step — the
+            whole batch at once, or accumulated over ``accum``
+            microbatches (inner scan; grads averaged, so numerics match
+            the full-batch step up to summation order)."""
+            if accum == 1:
+                (loss, (state, y_pred)), grads = grad_fn(
+                    params, state, sub, bx, by
+                )
+                return loss, state, y_pred, grads
+            b = bx.shape[0]
+            xs_m = bx.reshape(accum, b // accum, *bx.shape[1:])
+            ys_m = by.reshape(accum, b // accum, *by.shape[1:])
+            subs = jax.random.split(sub, accum)
+
+            def micro(carry, mb):
+                state, gacc, lacc = carry
+                (loss, (state, y_pred)), grads = grad_fn(
+                    params, state, mb["r"], mb["x"], mb["y"]
+                )
+                gacc = jax.tree.map(jnp.add, gacc, grads)
+                return (state, gacc, lacc + loss), y_pred
+
+            g0 = jax.tree.map(jnp.zeros_like, params)
+            (state, gacc, lsum), y_preds = jax.lax.scan(
+                micro, (state, g0, jnp.float32(0.0)),
+                {"x": xs_m, "y": ys_m, "r": subs},
+            )
+            grads = jax.tree.map(lambda g: g / accum, gacc)
+            y_pred = y_preds.reshape(b, *y_preds.shape[2:])
+            return lsum / accum, state, y_pred, grads
+
         def train_step(carry, batch):
             params, state, opt_state, rng = carry
             rng, sub = jax.random.split(rng)
-            (loss, (state, y_pred)), grads = grad_fn(
+            loss, state, y_pred, grads = batch_grads(
                 params, state, sub, batch["x"], batch["y"]
             )
             params, opt_state = apply_opt(params, grads, opt_state)
@@ -144,7 +185,7 @@ class WorkerCore:
         def grad_step(carry, batch):
             params, state, opt_state, rng, acc = carry
             rng, sub = jax.random.split(rng)
-            (loss, (state, y_pred)), grads = grad_fn(
+            loss, state, y_pred, grads = batch_grads(
                 params, state, sub, batch["x"], batch["y"]
             )
             params, opt_state = apply_opt(params, grads, opt_state)

@@ -409,3 +409,28 @@ def test_pipeline_trainer_resumes_foreign_checkpoint_params(tmp_path):
         not np.allclose(a, b)
         for a, b in zip(m_single.get_weights(), resumed.get_weights())
     )
+
+
+def test_pipeline_trainer_accum_steps_matches():
+    """accum_steps composes with the GPipe schedule: each accumulation
+    microbatch runs the full pipeline; weights match the accum=1 run."""
+    from distkeras_tpu import PipelineParallelTrainer
+
+    train, _ = _pp_data()
+    kw = dict(
+        loss="categorical_crossentropy",
+        learning_rate=0.02,
+        batch_size=32,
+        num_epoch=1,
+        label_col="label_onehot",
+        num_workers=4,
+        seed=0,
+    )
+    outs = []
+    for accum in (1, 2):
+        t = PipelineParallelTrainer(
+            _pp_model(), "sgd", accum_steps=accum, **kw
+        )
+        outs.append(t.train(train))
+    for a, b in zip(outs[0].get_weights(), outs[1].get_weights()):
+        np.testing.assert_allclose(a, b, atol=5e-6)

@@ -485,3 +485,44 @@ def test_sync_batchnorm_global_batch_stats():
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
     for a, b in zip(m_single.get_weights(), m_dp.get_weights()):
         np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=k (k sequential microbatches per optimizer step,
+    gradients averaged) must match the full-batch step numerically on a
+    BN-free model — memory knob, not an algorithm change — for both
+    SingleTrainer and the sync-DP trainer, and reject non-dividing k."""
+    import pytest
+
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.trainers import SynchronousDistributedTrainer
+
+    ds = make_data(n=512)[0]
+    kw = dict(
+        loss="categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=64,
+        num_epoch=2,
+        label_col="label_onehot",
+        seed=0,
+    )
+    outs = []
+    for accum in (1, 4):
+        t = SingleTrainer(zoo.mnist_mlp(hidden=16, seed=7), "sgd",
+                          accum_steps=accum, **kw)
+        outs.append(t.train(ds))
+    for a, b in zip(outs[0].get_weights(), outs[1].get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-6)
+
+    outs = []
+    for accum in (1, 2):
+        t = SynchronousDistributedTrainer(
+            zoo.mnist_mlp(hidden=16, seed=7), "sgd", num_workers=4,
+            accum_steps=accum, **kw
+        )
+        outs.append(t.train(ds))
+    for a, b in zip(outs[0].get_weights(), outs[1].get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-6)
+
+    with pytest.raises(ValueError, match="divisible"):
+        SingleTrainer(zoo.mnist_mlp(hidden=16), "sgd", accum_steps=3, **kw)
