@@ -526,3 +526,22 @@ def test_gradient_accumulation_matches_full_batch():
 
     with pytest.raises(ValueError, match="divisible"):
         SingleTrainer(zoo.mnist_mlp(hidden=16), "sgd", accum_steps=3, **kw)
+
+
+def test_gradient_accumulation_on_resident_feed():
+    """accum_steps flows through the device-resident indexed window too
+    (same train_step): resident accum=2 equals resident accum=1."""
+    from distkeras_tpu import SingleTrainer
+
+    ds = make_data(n=512)[0]
+    outs = []
+    for accum in (1, 2):
+        t = SingleTrainer(
+            zoo.mnist_mlp(hidden=16, seed=7), "sgd",
+            loss="categorical_crossentropy", learning_rate=0.05,
+            batch_size=64, num_epoch=1, label_col="label_onehot",
+            device_resident=True, accum_steps=accum, seed=0,
+        )
+        outs.append(t.train(ds))
+    for a, b in zip(outs[0].get_weights(), outs[1].get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-6)
