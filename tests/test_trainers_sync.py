@@ -530,7 +530,10 @@ def test_gradient_accumulation_matches_full_batch():
 
 def test_gradient_accumulation_on_resident_feed():
     """accum_steps flows through the device-resident indexed window too
-    (same train_step): resident accum=2 equals resident accum=1."""
+    (same train_step): resident accum=2 equals resident accum=1 within
+    float tolerance but NOT bit-for-bit — mean-of-microbatch-sums
+    changes the f32 summation order, so bit-identity would mean the
+    accumulation path was silently skipped."""
     from distkeras_tpu import SingleTrainer
 
     ds = make_data(n=512)[0]
@@ -545,3 +548,7 @@ def test_gradient_accumulation_on_resident_feed():
         outs.append(t.train(ds))
     for a, b in zip(outs[0].get_weights(), outs[1].get_weights()):
         np.testing.assert_allclose(a, b, atol=2e-6)
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(outs[0].get_weights(), outs[1].get_weights())
+    ), "accum=2 bit-identical to accum=1: accumulation was not applied"
