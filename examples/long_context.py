@@ -32,6 +32,10 @@ def main():
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--rows", type=int, default=512)
     ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--sp-mode", choices=["ring", "ulysses"], default="ring",
+                    help="how attention crosses the sequence shards: the "
+                         "K/V ppermute ring, or Ulysses all-to-all head "
+                         "sharding (heads divisible by the device count)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (virtual multi-device mesh "
                          "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
@@ -59,6 +63,11 @@ def main():
         raise SystemExit(
             f"--seq {args.seq} must be divisible by the device count {n}"
         )
+    if args.sp_mode == "ulysses" and args.heads % n:
+        raise SystemExit(
+            f"--sp-mode ulysses shards heads: --heads {args.heads} must be "
+            f"divisible by the device count {n}"
+        )
     print(f"devices: {n} x {devices[0].platform}; seq {args.seq} "
           f"-> {args.seq // n} tokens/device")
 
@@ -77,7 +86,7 @@ def main():
     trainer = SequenceParallelTrainer(
         model, "adam", "categorical_crossentropy",
         batch_size=args.batch, num_epoch=args.epochs,
-        label_col="label_onehot",
+        label_col="label_onehot", sp_mode=args.sp_mode,
     )
     t0 = time.perf_counter()
     trained = trainer.train(train, shuffle=True)
@@ -91,13 +100,19 @@ def main():
           f"({tokens_per_sec:,.0f} tokens/s), "
           f"loss {hist[0]['loss']:.4f} -> {hist[-1]['loss']:.4f}")
 
-    # evaluate long-context: re-attach ring attention for sharded inference
+    # evaluate long-context: re-attach sharded attention for inference
+    # (training detaches its hook; the returned model is dense by default)
     mesh = Mesh(np.array(devices), ("seq",))
-    attached = attach_ring_attention(trained, mesh)
+    if args.sp_mode == "ulysses":
+        from distkeras_tpu.parallel.ulysses import attach_ulysses_attention
+
+        attached = attach_ulysses_attention(trained, mesh)
+    else:
+        attached = attach_ring_attention(trained, mesh)
     acc = AccuracyEvaluator(label_col="label").evaluate(
         ModelPredictor(trained, batch_size=max(args.batch, 8)).predict(test)
     )
-    print(f"long-context ({args.seq} tokens, ring attention on "
+    print(f"long-context ({args.seq} tokens, {args.sp_mode} attention on "
           f"{attached} blocks) test accuracy: {acc:.4f}")
 
 

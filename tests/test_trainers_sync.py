@@ -531,10 +531,14 @@ def test_gradient_accumulation_matches_full_batch():
 def test_gradient_accumulation_on_resident_feed():
     """accum_steps flows through the device-resident indexed window too
     (same train_step): resident accum=2 equals resident accum=1 within
-    float tolerance but NOT bit-for-bit — mean-of-microbatch-sums
-    changes the f32 summation order, so bit-identity would mean the
-    accumulation path was silently skipped."""
+    float tolerance. The accumulation-really-ran guard uses BatchNorm:
+    its running stats update PER MICROBATCH (documented semantics), so a
+    one-step accum=2 run must produce materially different BN state than
+    accum=1 — a semantic observable, not a float-summation-order
+    artifact."""
     from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.models.layers import BatchNorm, Dense
+    from distkeras_tpu.models.sequential import Sequential
 
     ds = make_data(n=512)[0]
     outs = []
@@ -548,7 +552,25 @@ def test_gradient_accumulation_on_resident_feed():
         outs.append(t.train(ds))
     for a, b in zip(outs[0].get_weights(), outs[1].get_weights()):
         np.testing.assert_allclose(a, b, atol=2e-6)
-    assert any(
-        not np.array_equal(a, b)
-        for a, b in zip(outs[0].get_weights(), outs[1].get_weights())
-    ), "accum=2 bit-identical to accum=1: accumulation was not applied"
+
+    # the guard: per-microbatch BN statistics diverge from the full-batch
+    # ones if (and only if) the microbatch scan actually ran
+    def bn_model():
+        return Sequential(
+            [Dense(16), BatchNorm(momentum=0.5), Dense(10, activation="softmax")]
+        ).build((784,), seed=7)
+
+    states = []
+    for accum in (1, 2):
+        t = SingleTrainer(
+            bn_model(), "sgd", loss="categorical_crossentropy",
+            learning_rate=0.05, batch_size=64, num_epoch=1,
+            label_col="label_onehot", device_resident=True,
+            accum_steps=accum, seed=0,
+        )
+        trained = t.train(ds)
+        states.append(np.asarray(jax.tree.leaves(trained.state)[0]))
+    assert np.abs(states[0] - states[1]).max() > 1e-5, (
+        "BN running stats identical across accum settings: the "
+        "microbatch scan did not run"
+    )
