@@ -20,6 +20,18 @@ from distkeras_tpu.utils.compression import (
 )
 
 
+def mnist_splits(n=2048, frac=0.85):
+    """The shared synthetic-MNIST pipeline every convergence test here
+    uses: load -> MinMax -> OneHot -> split (one copy; five call sites)."""
+    from distkeras_tpu import MinMaxTransformer, OneHotTransformer
+    from distkeras_tpu.data import loaders
+
+    ds = loaders.synthetic_mnist(n=n, seed=0)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    return ds.split(frac, seed=0)
+
+
 def make_tree(seed=0):
     rng = np.random.default_rng(seed)
     return {
@@ -91,16 +103,12 @@ def test_wire_bytes_shrink_4x():
 def test_downpour_int8_converges(remote):
     """Compressed DOWNPOUR reaches the accuracy target — in-process and
     over the real socket transport (the DCN wire format end to end)."""
-    from distkeras_tpu import DOWNPOUR, MinMaxTransformer, OneHotTransformer
-    from distkeras_tpu.data import loaders
+    from distkeras_tpu import DOWNPOUR
     from distkeras_tpu.evaluators import AccuracyEvaluator
     from distkeras_tpu.models import zoo
     from distkeras_tpu.predictors import ModelPredictor
 
-    ds = loaders.synthetic_mnist(n=2048, seed=0)
-    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
-    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
-    train, test = ds.split(0.85, seed=0)
+    train, test = mnist_splits()
 
     t = DOWNPOUR(
         zoo.mnist_mlp(hidden=32),
@@ -138,16 +146,12 @@ def test_aeasgd_int8_converges_over_socket():
     dequantized-remote asymmetry diverges — found by driving this exact
     flow); compressed elastic averaging over the real socket must reach
     the same target as the uncompressed suite config."""
-    from distkeras_tpu import AEASGD, MinMaxTransformer, OneHotTransformer
-    from distkeras_tpu.data import loaders
+    from distkeras_tpu import AEASGD
     from distkeras_tpu.evaluators import AccuracyEvaluator
     from distkeras_tpu.models import zoo
     from distkeras_tpu.predictors import ModelPredictor
 
-    ds = loaders.synthetic_mnist(n=4096, seed=0)
-    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
-    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
-    train, test = ds.split(0.9, seed=0)
+    train, test = mnist_splits(n=4096, frac=0.9)
     t = AEASGD(
         zoo.mnist_mlp(hidden=64),
         "sgd",
@@ -177,14 +181,11 @@ def test_downpour_int8_resume_restores_residual(tmp_path):
     carrying the same quantization error (async resume fidelity is
     structural, matching the uncompressed contract: restored local state,
     absorbed windows skipped, exactly-once commit counts)."""
-    from distkeras_tpu import DOWNPOUR, MinMaxTransformer, OneHotTransformer
-    from distkeras_tpu.data import loaders
+    from distkeras_tpu import DOWNPOUR
     from distkeras_tpu.models import zoo
     from distkeras_tpu.utils.checkpoint import Checkpointer
 
-    ds = loaders.synthetic_mnist(n=512, seed=0)
-    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
-    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    ds, _ = mnist_splits(n=512, frac=1.0)
 
     ck = str(tmp_path / "int8")
 
@@ -263,16 +264,12 @@ def test_downpour_bf16_pull_converges_over_socket():
     """Half-width pulls (bf16 center) + int8 commits together: the full
     DCN bandwidth configuration still reaches the accuracy target over
     the real socket transport."""
-    from distkeras_tpu import DOWNPOUR, MinMaxTransformer, OneHotTransformer
-    from distkeras_tpu.data import loaders
+    from distkeras_tpu import DOWNPOUR
     from distkeras_tpu.evaluators import AccuracyEvaluator
     from distkeras_tpu.models import zoo
     from distkeras_tpu.predictors import ModelPredictor
 
-    ds = loaders.synthetic_mnist(n=2048, seed=0)
-    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
-    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
-    train, test = ds.split(0.85, seed=0)
+    train, test = mnist_splits()
 
     t = DOWNPOUR(
         zoo.mnist_mlp(hidden=32),
@@ -304,3 +301,43 @@ def test_pull_compress_rejected_values():
     with pytest.raises(ValueError, match="pull_compress"):
         DOWNPOUR(zoo.mnist_mlp(hidden=8), "sgd",
                  "categorical_crossentropy", pull_compress="fp16")
+
+
+@pytest.mark.parametrize("cls_name", ["DynSGD", "EAMSGD", "ADAG"])
+def test_remaining_algorithms_int8_converge(cls_name):
+    """int8 commits + bf16 pulls on the algorithms the other tests don't
+    cover (staleness-scaled DynSGD, elastic-momentum EAMSGD, and ADAG's
+    lr-scaled accumulated-gradient commits): all reach the suite's
+    accuracy target under the combined wire compression — the full
+    5-algorithm x compression matrix is pinned."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.predictors import ModelPredictor
+
+    train, test = mnist_splits(n=4096, frac=0.9)
+
+    extra = {
+        "EAMSGD": {"momentum": 0.3, "rho": 10.0, "num_epoch": 6},
+        "ADAG": {"num_epoch": 4, "learning_rate": 0.05},
+        "DynSGD": {"num_epoch": 3},
+    }[cls_name]
+    t = getattr(dk, cls_name)(
+        zoo.mnist_mlp(hidden=64),
+        "sgd",
+        "categorical_crossentropy",
+        num_workers=4,
+        batch_size=32,
+        communication_window=4,
+        mode="simulated",
+        compress="int8",
+        pull_compress="bfloat16",
+        label_col="label_onehot",
+        seed=0,
+        **{"learning_rate": 0.02, **extra},
+    )
+    trained = t.train(train)
+    acc = AccuracyEvaluator(label_col="label").evaluate(
+        ModelPredictor(trained, batch_size=256).predict(test)
+    )
+    assert acc > 0.9, acc
