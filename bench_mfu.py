@@ -211,9 +211,14 @@ def measure(
         # (VMEM budget) or dense (non-tiling T) at some shapes, and an
         # A/B row must not attribute a fallback's numbers to the kernel
         record["block_q"], record["block_k"] = block_q, block_k
-        record["effective_attention"] = effective_path(
+        # the dispatch may shrink blocks to tile T (ADVICE r3 #1): record
+        # the blocks that actually RAN, not just the requested ones
+        eff_path, eff_bq, eff_bk = effective_path(
             seq, d_model // heads, block_q, block_k
-        )[0]
+        )
+        record["effective_attention"] = eff_path
+        record["effective_block_q"] = eff_bq
+        record["effective_block_k"] = eff_bk
     peak = _peak_flops(dev)
     if peak is not None:
         record["value"] = round(fps / peak, 4)
@@ -225,12 +230,15 @@ def main() -> None:
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument(
         "--attention",
-        choices=["auto", "flash", "dense"],
+        choices=["auto", "flash", "dense", "best"],
         default="auto",
         help="flash = fused Pallas kernels (ops/flash_attention); dense = "
         "XLA dense attention (the baseline the kernel is judged against). "
         "auto picks flash on TPU and dense elsewhere — off-TPU the Pallas "
-        "interpreter would measure interpreter overhead, not the framework",
+        "interpreter would measure interpreter overhead, not the framework. "
+        "best measures BOTH and records the winner as the headline "
+        "artifact (VERDICT r3 weak #1: the committed BENCH_MFU.json must "
+        "never document the losing bundle while the README cites the win)",
     )
     args = ap.parse_args()
 
@@ -243,12 +251,40 @@ def main() -> None:
     enable_compile_cache(platform=platform)
     if args.attention == "auto":
         args.attention = "dense" if platform == "cpu" else "flash"
+    if args.attention == "best" and platform == "cpu":
+        args.attention = "dense"  # flash off-TPU measures the interpreter
 
     dev = jax.devices()[0]
     print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
-    record = measure(platform, attention=args.attention)
-    with open("BENCH_MFU.json", "w") as f:
-        json.dump(record, f, indent=2)
+    def write_artifact(rec):
+        with open("BENCH_MFU.json", "w") as f:
+            json.dump(rec, f, indent=2)
+
+    if args.attention == "best":
+        # winner by MFU (falls back to tflops when no published peak)
+        def score(r):
+            return r["value"] if r["value"] is not None else r["tflops_per_sec"]
+
+        record = None
+        for attn in ("dense", "flash"):
+            rec = measure(platform, attention=attn)
+            print(json.dumps(rec), flush=True)
+            if record is None or score(rec) > score(record):
+                loser, record = record, rec
+            else:
+                loser = rec
+            if loser is not None:
+                # the A/B loser rides along: the artifact documents the margin
+                record["ab_loser"] = {
+                    k: loser.get(k) for k in
+                    ("attention", "value", "tflops_per_sec", "samples_per_sec")
+                }
+            # artifact written after EVERY measure (mid-sweep tunnel death
+            # must not cost the finished dense row its place on disk)
+            write_artifact(record)
+    else:
+        record = measure(platform, attention=args.attention)
+        write_artifact(record)
     print(json.dumps(record))
 
 

@@ -303,9 +303,29 @@ def effective_path(t, head_dim, block_q=DEFAULT_BLOCK_Q,
     bk = min(block_k, t)
     if 2 * t * head_dim * 4 > _VMEM_KV_BUDGET_BYTES:
         return "blockwise", bq, bk
-    if t % bq or t % bk:
-        return "dense", bq, bk
+    # T that does not tile the requested blocks first tries smaller blocks
+    # (halving, floor 128 — the MXU tile) before surrendering to dense:
+    # seq 640/768/1152 etc. should run the kernel at 128/256, not pay the
+    # O(T^2) HBM score materialization (ADVICE r3 #1)
+    bq = _largest_tiling_block(t, bq)
+    bk = _largest_tiling_block(t, bk)
+    if bq is None or bk is None:
+        return "dense", min(block_q, t), min(block_k, t)
     return "flash", bq, bk
+
+
+def _largest_tiling_block(t, block):
+    """Largest candidate in {block, block/2, ..., 128} ∪ {t} that divides
+    ``t``, or None. Mosaic wants q-blocks a multiple of 8; halving from a
+    power-of-two default keeps that invariant."""
+    if t % block == 0:  # covers the clamped block == t short-seq case
+        return block
+    cand = block // 2
+    while cand >= 128:
+        if t % cand == 0:
+            return cand
+        cand //= 2
+    return None
 
 
 def flash_attention(
@@ -316,9 +336,10 @@ def flash_attention(
 
     Numerically matches ``parallel.ring_attention.dense_attention`` (same
     online-softmax math) for values and gradients; self-attention only.
-    Sequences that do not tile (T % block != 0) fall back to the XLA dense
-    path rather than padding — the transformer zoo's lengths are powers of
-    two, and correctness must not depend on the fast path.
+    Sequences that do not tile (T % block != 0) first retry smaller blocks
+    (halving, floor 128 — see ``effective_path``), and only fall back to
+    the XLA dense path when no block tiles; never pads — correctness must
+    not depend on the fast path.
     """
     from distkeras_tpu.parallel.ring_attention import (
         blockwise_attention,

@@ -43,7 +43,14 @@ def next_token_crossentropy(y_pred, y_true):
     Position t's logits predict token t+1 (the standard shift); the last
     position has no target and is dropped. Mean over B*(T-1) predictions.
     No reference counterpart (no sequence models upstream — SURVEY §5.7);
-    pairs with ``zoo.transformer_lm``'s causal blocks."""
+    pairs with ``zoo.transformer_lm``'s causal blocks. Requires T >= 2:
+    with a single position there is no (input, next-token) pair and the
+    mean would silently reduce an empty slice to NaN (ADVICE r3 #4)."""
+    if y_pred.shape[1] < 2:
+        raise ValueError(
+            "next_token_crossentropy needs seq_len >= 2 (got "
+            f"{y_pred.shape[1]}): the shifted loss has no targets at T=1"
+        )
     logp = nn.log_softmax(y_pred[:, :-1].astype(jnp.float32), axis=-1)
     targets = y_true[:, 1:].astype(jnp.int32)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)

@@ -29,7 +29,17 @@ Q8_KEY = "__dkt_q8__"
 
 def _quantize_leaf(a):
     a = np.asarray(a, np.float32)
-    scale = np.float32(np.max(np.abs(a)) / 127.0) if a.size else np.float32(0)
+    amax = np.max(np.abs(a)) if a.size else np.float32(0)
+    if not np.isfinite(amax):
+        # a NaN/Inf delta means the worker diverged; quantizing it would
+        # poison the error-feedback residual for every later commit
+        # (np.round(nan) -> undefined int8), so fail loudly at the commit
+        # boundary instead (ADVICE r3 #3)
+        raise FloatingPointError(
+            "non-finite delta leaf (max|x| = %r): refusing to quantize a "
+            "diverged update" % amax
+        )
+    scale = np.float32(amax / 127.0)
     if scale == 0.0:
         return np.zeros(a.shape, np.int8), scale
     return np.clip(np.round(a / scale), -127, 127).astype(np.int8), scale

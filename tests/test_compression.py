@@ -60,6 +60,21 @@ def test_quantize_roundtrip_error_bound():
     np.testing.assert_array_equal(dequantize_tree(payload)["zero"], 0.0)
 
 
+def test_quantize_nonfinite_delta_fails_loudly():
+    """A NaN/Inf delta (diverged worker) must raise at the commit boundary,
+    not poison the error-feedback residual forever (ADVICE r3 #3)."""
+    import pytest
+
+    from distkeras_tpu.utils.compression import compress_with_feedback
+
+    bad = {"w": np.array([1.0, np.nan], np.float32)}
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        quantize_tree(bad)
+    inf = {"w": np.array([np.inf, 0.0], np.float32)}
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        compress_with_feedback(inf, None)
+
+
 def test_maybe_decompress_passthrough():
     tree = make_tree()
     assert maybe_decompress(tree) is tree  # raw deltas untouched

@@ -62,6 +62,31 @@ def test_flash_uneven_seq_falls_back_to_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_effective_path_clamps_blocks_before_dense():
+    """T > 512 that does not tile the 512 default must shrink the block
+    (halving, floor 128) instead of surrendering to the O(T^2) dense path
+    (ADVICE r3 #1): 640 -> 128, 768 -> 256; truly non-tiling T stays
+    dense; short T keeps its clamped-to-T block."""
+    from distkeras_tpu.ops.flash_attention import effective_path
+
+    assert effective_path(640, 64) == ("flash", 128, 128)
+    assert effective_path(768, 64) == ("flash", 256, 256)
+    assert effective_path(1152, 64) == ("flash", 128, 128)
+    assert effective_path(96, 64, 64, 64) == ("dense", 64, 64)
+    assert effective_path(64, 64) == ("flash", 64, 64)
+
+
+def test_flash_clamped_block_matches_dense():
+    """The clamped-block path (T=640 rerouted to bq=bk=128) computes the
+    same values as dense attention."""
+    q, k, v = qkv(t=640)
+    out = flash_attention(q, k, v, causal=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=3e-5, rtol=1e-4
+    )
+
+
 def test_flash_bf16_matches_dense_and_keeps_dtype():
     """bf16 is the TPU compute dtype (bench_mfu runs flash under it):
     kernels accumulate f32 internally, outputs and grads come back bf16

@@ -31,6 +31,17 @@ def test_next_token_crossentropy_matches_manual():
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
+def test_next_token_crossentropy_rejects_t1():
+    """T=1 has no (input, next-token) pair; the loss must fail loudly
+    instead of mean-reducing an empty slice to NaN (ADVICE r3 #4)."""
+    import pytest
+
+    logits = jnp.zeros((2, 1, 7), jnp.float32)
+    tokens = jnp.zeros((2, 1), jnp.int32)
+    with pytest.raises(ValueError, match="seq_len >= 2"):
+        next_token_crossentropy(logits, tokens)
+
+
 def test_transformer_lm_is_causal():
     """Perturbing token j must leave logits at positions < j unchanged."""
     m = zoo.transformer_lm(vocab_size=32, seq_len=16, d_model=32,

@@ -40,6 +40,14 @@ def main() -> None:
         help="MXU scaling rows instead: d_model 1024 and batch 128 — "
         "how MFU moves when the matmuls widen / batch fills the array",
     )
+    mode.add_argument(
+        "--retire", action="store_true",
+        help="retire-or-win rows for the losing kernels (VERDICT r3 task "
+        "7): fused_layernorm and pallas_adam re-measured at d_model 1024 "
+        "(wider rows = more memory-bound LN; 4x the optimizer tree) "
+        "against the same-shape baseline — a positive row keeps the "
+        "kernel, a negative one retires it in PERF.md",
+    )
     args = ap.parse_args()
 
     resolved = resolve_backend()
@@ -87,6 +95,13 @@ def main() -> None:
             ("dense d1024 L4", dict(wide)),
             ("flash d1024 L4", {"attention": "flash", **wide}),
             ("flash batch128", {"attention": "flash", "batch": 128}),
+        ]
+    elif args.retire:
+        wide = {"d_model": 1024, "depth": 4}
+        configs = [
+            ("retire baseline d1024", dict(wide)),
+            ("retire fused_ln d1024", {"fused_ln": True, **wide}),
+            ("retire pallas_adam d1024", {"opt_name": "pallas_adam", **wide}),
         ]
 
     with open("MFU_ATTRIB.jsonl", "a") as f:

@@ -26,7 +26,7 @@ while true; do
     # invocation so a dying tunnel cannot cost the cheap rows above
     timeout -k 30 2400 python benchmarks.py --configs 4,5 >> "$LOG" 2>&1
     commit_snap "Harvest TPU window: TPU benchmark matrix rows" \
-      TPU_CAPTURE.log TPU_CAPTURE.log.err BENCHMARKS.json BENCHMARKS.md \
+      TPU_CAPTURE.log BENCHMARKS.json BENCHMARKS.md \
       "$LOG" >> "$LOG" 2>&1
     echo "$(date -u +%FT%TZ) capture cycle done" >> "$LOG"
     sleep 120
