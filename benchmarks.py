@@ -55,10 +55,16 @@ def resolve_platform(force_cpu: bool) -> str:
 def steady_samples_per_sec(history) -> float:
     """Aggregate steady-state throughput: per worker, drop the first window
     (it carries the XLA compile) and sum samples/seconds; workers run
-    concurrently, so their rates add."""
+    concurrently, so their rates add. Datasets so small that a worker's
+    epoch fits in ONE window (config 7's 569 real rows) would measure 0
+    after the drop — fall back to the all-windows rate there (marked by
+    the caller's row being dominated by compile, which the per-epoch
+    loop's later rounds amortize)."""
     total = 0.0
     for wid in sorted(history._windows):
         timings = history._windows[wid][1:]
+        if not timings:
+            timings = history._windows[wid]
         secs = sum(dt for _, dt in timings)
         if secs > 0:
             total += sum(s for s, _ in timings) / secs
@@ -139,7 +145,15 @@ def build_configs(platform):
     def mnist_data(flat):
         def make(scale):
             n = 8192 if scale == "full" else 2048
-            ds = loaders.synthetic_mnist(n=n, seed=0, flat=flat)
+            # hardened r4 (VERDICT r3 weak #6): 4-prototype mixture per
+            # class + 10% resampled labels -> Bayes ceiling ~0.91, curve
+            # spread over ~7 epochs (r4 CPU calibration: single-trainer
+            # sgd hits .47/.57/.67/.77/.82/.89/.91) — the epochs-to-target
+            # axis discriminates instead of saturating at 1.0000
+            ds = loaders.synthetic_mnist(
+                n=n, seed=0, flat=flat,
+                protos_per_class=4, label_noise=0.1, noise=1.5,
+            )
             ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
             ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
             train, test = ds.split(0.9, seed=7)
@@ -156,7 +170,10 @@ def build_configs(platform):
 
     def cifar_data(scale):
         n = 8192 if scale == "full" else 2048
-        ds = loaders.synthetic_cifar10(n=n, seed=2)
+        # hardened r4: 3-pattern mixture + 10% label noise (see mnist_data)
+        ds = loaders.synthetic_cifar10(
+            n=n, seed=2, protos_per_class=3, label_noise=0.1,
+        )
         ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
         ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
         train, test = ds.split(0.9, seed=7)
@@ -169,6 +186,19 @@ def build_configs(platform):
         train, test = ds.split(0.85, seed=7)
         return train, test, "label_onehot", []
 
+    def breast_cancer_data(scale):
+        from distkeras_tpu import StandardScaleTransformer
+
+        # REAL tabular data at both scales (569 rows are what they are).
+        # Split BEFORE fitting the scaler: held-out statistics must not
+        # shape the normalization the accuracy axis is judged under.
+        train, test = loaders.breast_cancer().split(0.85, seed=7)
+        scaler = StandardScaleTransformer().fit(train)
+        onehot = OneHotTransformer(2, output_col="label_onehot")
+        train = onehot.transform(scaler.transform(train))
+        test = onehot.transform(scaler.transform(test))
+        return train, test, "label_onehot", []
+
     def imagenet_data(scale):
         from distkeras_tpu import LabelIndexTransformer
 
@@ -178,7 +208,11 @@ def build_configs(platform):
         # trainer (r2 calibration: acc plateaued at ~2x chance)
         classes = 100 if scale == "full" else 10
         size = 64
-        ds = loaders.synthetic_imagenet(n=n, num_classes=classes, size=size, seed=3)
+        # 10% label noise for the <1.0 ceiling (VERDICT r3 task 4); the
+        # class count already keeps this config data-starved at smoke
+        ds = loaders.synthetic_imagenet(
+            n=n, num_classes=classes, size=size, seed=3, label_noise=0.1,
+        )
         ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
         ds = OneHotTransformer(classes, output_col="label_onehot").transform(ds)
         train, test = ds.split(0.9, seed=7)
@@ -208,8 +242,11 @@ def build_configs(platform):
                 m, "sgd", learning_rate=0.05, batch_size=64,
                 num_epoch=1, label_col=lc, **common,
             ),
-            "target": {"smoke": 0.97, "full": 0.97},
-            "max_epochs": {"smoke": 5, "full": 10},
+            # ceiling ~0.91 under the hardened generator (r4): targets sit
+            # a learnable margin below it; r4 CPU calibration reaches 0.80
+            # at epoch ~5 (smoke scale)
+            "target": {"smoke": 0.80, "full": 0.85},
+            "max_epochs": {"smoke": 8, "full": 10},
         },
         {
             "id": 2,
@@ -225,8 +262,10 @@ def build_configs(platform):
                 num_workers=8, label_col=lc,
                 compute_dtype=dtype, **dist,
             ),
-            "target": {"smoke": 0.95, "full": 0.97},
-            "max_epochs": {"smoke": 5, "full": 10},
+            # hardened-generator ceiling ~0.91; async + lr/8 learns slower
+            # than the single trainer, so the target sits lower still
+            "target": {"smoke": 0.78, "full": 0.82},
+            "max_epochs": {"smoke": 8, "full": 10},
         },
         {
             "id": 3,
@@ -260,8 +299,10 @@ def build_configs(platform):
                 num_workers=4, label_col=lc,
                 compute_dtype=dtype, **dist,
             ),
-            "target": {"smoke": 0.80, "full": 0.90},
-            "max_epochs": {"smoke": 5, "full": 10},
+            # hardened-generator ceiling ~0.91 (3-pattern mixture + 10%
+            # label noise)
+            "target": {"smoke": 0.70, "full": 0.78},
+            "max_epochs": {"smoke": 8, "full": 10},
         },
         {
             "id": 5,
@@ -285,7 +326,9 @@ def build_configs(platform):
                 num_workers=4, label_col=lc,
                 compute_dtype=dtype, **dist,
             ),
-            "target": {"smoke": 0.50, "full": 0.70},
+            # 10% label noise caps the ceiling ~0.90; smoke stays
+            # data-starved (768 rows / 10 classes) so the bar is low
+            "target": {"smoke": 0.45, "full": 0.60},
             "max_epochs": {"smoke": 8, "full": 8},
         },
         {
@@ -307,18 +350,49 @@ def build_configs(platform):
             "target": {"smoke": 0.93, "full": 0.95},
             "max_epochs": {"smoke": 15, "full": 30},
         },
+        {
+            "id": 7,
+            "name": "AEASGD / REAL breast-cancer (in-repo CSV)",
+            "trainer_name": "AEASGD",
+            "model_name": "higgs_mlp",
+            # REAL tabular data (VERDICT r3 missing #1): the 569-row
+            # Wisconsin diagnostic set shipped in-repo — the real
+            # counterpart of config 3's ATLAS-Higgs-shaped task (30
+            # features, binary target, reference: examples/workflow.ipynb)
+            # giving the async-PS family a row measured against data the
+            # builder did not design. Ceiling ~0.97 (real-data Bayes
+            # floor); r4 CPU calibration (leak-free scaler): .884/.942.
+            "data": breast_cancer_data,
+            "model": lambda scale: zoo.higgs_mlp(seed=0),
+            "trainer": lambda m, scale, lc: AEASGD(
+                m, "sgd", learning_rate=0.02, rho=10.0, batch_size=32,
+                num_epoch=1, num_workers=4, label_col=lc, **dist,
+            ),
+            "target": {"smoke": 0.93, "full": 0.93},
+            "max_epochs": {"smoke": 8, "full": 8},
+        },
     ]
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7")
     ap.add_argument("--scale", choices=["smoke", "full"], default="smoke")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument(
+        "--cpu-full", action="store_true",
+        help="allow --scale full on the CPU fallback (VERDICT r3 weak #6: "
+        "an unintended full-scale CPU pass burned 73 min on one config; "
+        "full scale on CPU must be asked for, not stumbled into)",
+    )
     ap.add_argument("--out", default=".")
     args = ap.parse_args()
 
     platform = resolve_platform(args.cpu)
+    if platform == "cpu" and args.scale == "full" and not args.cpu_full:
+        print("scale 'full' on the CPU fallback downgraded to 'smoke' "
+              "(pass --cpu-full to force; see --help)")
+        args.scale = "smoke"
     import jax
 
     device_kind = jax.devices()[0].device_kind
@@ -370,6 +444,7 @@ def config_stamp(cfg_id: int) -> str:
             loaders._prototype_classification,
             loaders._spatial_prototype_classification,
             loaders._coarse_grid,
+            loaders._apply_label_noise,
         )
         sources = {
             1: synth + (loaders.synthetic_mnist, zoo.mnist_mlp),
@@ -379,22 +454,22 @@ def config_stamp(cfg_id: int) -> str:
             5: synth
             + (loaders.synthetic_imagenet, zoo._basic_block, zoo.resnet18),
             6: (loaders.digits, loaders.load_csv, zoo.digits_mlp),
+            7: (loaders.breast_cancer, loaders.load_csv, zoo.higgs_mlp),
         }
-        digits_csv = os.path.join(
-            os.path.dirname(os.path.abspath(loaders.__file__)), "digits.csv"
-        )
+        data_dir = os.path.dirname(os.path.abspath(loaders.__file__))
+        # the real configs' accuracy axes are DEFINED by the shipped
+        # dataset bytes, not just the loader code
+        real_csvs = {6: "digits.csv", 7: "breast_cancer.csv"}
         for cid, fns in sources.items():
             h = hashlib.sha256(inspect.getsource(build_configs).encode())
             for fn in fns:
                 h.update(inspect.getsource(fn).encode())
-            if cid == 6:
-                # the real config's accuracy axis is DEFINED by the
-                # shipped dataset, not just the loader code
+            if cid in real_csvs:
                 try:
-                    with open(digits_csv, "rb") as f:
+                    with open(os.path.join(data_dir, real_csvs[cid]), "rb") as f:
                         h.update(f.read())
                 except OSError:
-                    h.update(b"digits.csv-missing")
+                    h.update(real_csvs[cid].encode() + b"-missing")
             _CONFIG_STAMPS[cid] = h.hexdigest()[:12]
     # unknown config id (older/newer file formats): never matches
     return _CONFIG_STAMPS.get(int(cfg_id), "unknown-config")
@@ -510,9 +585,12 @@ def write_outputs(rows, platform, device_kind, scale, out):
     lines = [
         "# BASELINE benchmark matrix",
         "",
-        "Configs 1-5 run synthetic stand-ins (BASELINE.md: `published: {}`"
-        " — no upstream numbers exist); config 6 runs the REAL in-repo "
-        "digits CSV. Both BASELINE metric axes per config. "
+        "Configs 1-5 run hardened synthetic stand-ins — prototype "
+        "mixtures + 10% resampled labels give a Bayes ceiling < 1.0, so "
+        "the accuracy axis cannot saturate (BASELINE.md: `published: {}` "
+        "— no upstream numbers exist); configs 6 and 7 run REAL in-repo "
+        "CSVs (1,797-row digits, 569-row breast-cancer). Both BASELINE "
+        "metric axes per config. "
         "samples/sec/chip is steady-state (compile window excluded). "
         "Rows carry per-config calibration stamps; rows from older "
         "calibrations are dropped automatically. "

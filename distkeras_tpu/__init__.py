@@ -54,6 +54,7 @@ from distkeras_tpu.data.transformers import (
     DenseTransformer,
     ReshapeTransformer,
     LabelIndexTransformer,
+    StandardScaleTransformer,
 )
 from distkeras_tpu.models.sequential import Sequential, Model
 from distkeras_tpu.job_deployment import Job

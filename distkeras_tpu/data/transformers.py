@@ -129,15 +129,32 @@ class LabelIndexTransformer(Transformer):
 
 
 class StandardScaleTransformer(Transformer):
-    """(x - mean) / std per feature, stats fit on the data (Higgs pipeline)."""
+    """(x - mean) / std per feature (Higgs pipeline).
+
+    By default the stats are fit on the data being transformed. For a
+    leak-free train/test pipeline, ``fit(train)`` first — the stored
+    train statistics are then applied to every later ``transform`` (the
+    held-out rows must not shape the normalization they are judged
+    under)."""
 
     def __init__(self, input_col="features", output_col=None, epsilon=1e-8):
         self.input_col = input_col
         self.output_col = output_col or input_col
         self.epsilon = float(epsilon)
+        self._mean = None
+        self._std = None
+
+    def fit(self, ds: Dataset) -> "StandardScaleTransformer":
+        x = ds[self.input_col].astype(np.float32)
+        self._mean = x.mean(axis=0, keepdims=True)
+        self._std = x.std(axis=0, keepdims=True)
+        return self
 
     def transform(self, ds: Dataset) -> Dataset:
         x = ds[self.input_col].astype(np.float32)
-        mean = x.mean(axis=0, keepdims=True)
-        std = x.std(axis=0, keepdims=True)
+        if self._mean is None:
+            mean = x.mean(axis=0, keepdims=True)
+            std = x.std(axis=0, keepdims=True)
+        else:
+            mean, std = self._mean, self._std
         return ds.with_column(self.output_col, (x - mean) / (std + self.epsilon))
