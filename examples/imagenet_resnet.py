@@ -6,9 +6,11 @@ With no ImageNet on disk, the script WRITES synthetic ImageNet-shaped data
 to ``.npz`` shards chunk by chunk (uint8, never holding the full dataset in
 one array) and trains from :class:`StreamingDataset`: one shard resident
 per worker at a time, preprocessing applied per chunk via ``.map``, window
-staging (stack + device_put) prefetched on a background thread. This is the
-input-pipeline shape that feeds real ImageNet: swap the synthetic writer
-for shards of decoded images.
+staging (stack + device_put) optionally prefetched on a background thread
+(``prefetch=N``; off by default — the committed v5e A/Bs measured overlap
+as a median loss, see PERF.md). This is the input-pipeline shape that
+feeds real ImageNet: swap the synthetic writer for shards of decoded
+images.
 """
 
 from __future__ import annotations
