@@ -17,10 +17,10 @@ while true; do
     # tpu_capture.sh commits each artifact as soon as it exists (the
     # 01:02 window died mid-sweep; end-of-sweep commits lose the harvest)
     sh tools/tpu_capture.sh >> "$LOG" 2>&1
-    timeout -k 30 2400 python benchmarks.py --configs 1,2,3,6 >> "$LOG" 2>&1
+    timeout -k 30 2400 python benchmarks.py --configs 1,2,3,6,7 >> "$LOG" 2>&1
     # commit the cheap rows BEFORE the expensive ones: a tunnel dying in
-    # the configs-4,5 run must not cost the 1,2,3,6 harvest
-    commit_snap "Harvest TPU window: benchmark matrix rows (configs 1,2,3,6)" \
+    # the configs-4,5 run must not cost the 1,2,3,6,7 harvest
+    commit_snap "Harvest TPU window: benchmark matrix rows (configs 1,2,3,6,7)" \
       BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1
     # the remaining matrix rows (CIFAR ADAG, ResNet DynSGD) ride a second
     # invocation so a dying tunnel cannot cost the cheap rows above
