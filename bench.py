@@ -165,6 +165,22 @@ def _peak_flops(device) -> float | None:
     return None
 
 
+def _read_json_artifact(name: str) -> dict | None:
+    """Committed-artifact reader anchored to THIS file's directory (repo
+    root), never the CWD. Returns None unless the file parses to a dict —
+    a dying tunnel can truncate an artifact to valid-but-not-object JSON,
+    and emit() must never crash over it (the driver needs its line)."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
 def last_known_tpu() -> dict | None:
     """The last COMMITTED TPU measurement (BENCH_TPU.json, written only
     from on-chip runs by tools/tpu_capture.sh), summarized for embedding.
@@ -177,16 +193,8 @@ def last_known_tpu() -> dict | None:
     tunnel state."""
     import os
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_TPU.json")
-    try:
-        with open(path) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
-        return None
-    # a dying tunnel can truncate the artifact to valid-but-not-object
-    # JSON; emit() must never crash over it (the driver needs its line)
-    if not isinstance(rec, dict) or rec.get("platform") != "tpu":
+    rec = _read_json_artifact("BENCH_TPU.json")
+    if rec is None or rec.get("platform") != "tpu":
         return None
     out = {k: rec.get(k) for k in ("value", "unit", "mfu", "device_kind",
                                    "final_loss", "vs_baseline")}
@@ -195,7 +203,7 @@ def last_known_tpu() -> dict | None:
         ts = subprocess.run(
             ["git", "log", "-1", "--format=%cI", "--", "BENCH_TPU.json"],
             capture_output=True, text=True, timeout=30,
-            cwd=os.path.dirname(path),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
         ).stdout.strip()
         if ts:
             out["captured_at"] = ts
@@ -212,16 +220,8 @@ def measured_reference_pattern() -> dict | None:
     divided by an analytic constant; this puts a measurement behind the
     denominator. Both ratios are reported — the analytic stand-in stays
     for cross-round continuity."""
-    import os
-
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "REFERENCE_PATTERN.json")
-    try:
-        with open(path) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
-        return None
-    if not isinstance(rec, dict) or not rec.get("value"):
+    rec = _read_json_artifact("REFERENCE_PATTERN.json")
+    if rec is None or not rec.get("value"):
         return None
     return {
         "value": rec["value"],

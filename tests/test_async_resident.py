@@ -54,6 +54,7 @@ def _trainer(cls, model, **extra):
     ],
     ids=lambda v: v.__name__ if isinstance(v, type) else "",
 )
+@pytest.mark.slow
 def test_simulated_resident_bitequals_streamed(cls, extra):
     train, _ = make_data()
     streamed = _trainer(cls, zoo.mnist_mlp(hidden=32), **extra).train(train)
@@ -64,6 +65,7 @@ def test_simulated_resident_bitequals_streamed(cls, extra):
         np.testing.assert_array_equal(ws, wr)
 
 
+@pytest.mark.slow
 def test_threads_resident_converges():
     train, test = make_data()
     t = _trainer(

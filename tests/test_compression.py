@@ -115,6 +115,7 @@ def test_wire_bytes_shrink_4x():
 
 
 @pytest.mark.parametrize("remote", [False, True])
+@pytest.mark.slow
 def test_downpour_int8_converges(remote):
     """Compressed DOWNPOUR reaches the accuracy target — in-process and
     over the real socket transport (the DCN wire format end to end)."""
@@ -190,6 +191,7 @@ def test_aeasgd_int8_converges_over_socket():
     assert acc > 0.9, acc
 
 
+@pytest.mark.slow
 def test_downpour_int8_resume_restores_residual(tmp_path):
     """The error-feedback residual rides worker snapshots AS OF its
     commit and is restored on resume — a compressed run continues
@@ -275,6 +277,7 @@ def test_bf16_roundtrip_precision_and_passthrough():
     assert maybe_decode_pull(tree) is tree
 
 
+@pytest.mark.slow
 def test_downpour_bf16_pull_converges_over_socket():
     """Half-width pulls (bf16 center) + int8 commits together: the full
     DCN bandwidth configuration still reaches the accuracy target over
@@ -319,6 +322,7 @@ def test_pull_compress_rejected_values():
 
 
 @pytest.mark.parametrize("cls_name", ["DynSGD", "EAMSGD", "ADAG"])
+@pytest.mark.slow
 def test_remaining_algorithms_int8_converge(cls_name):
     """int8 commits + bf16 pulls on the algorithms the other tests don't
     cover (staleness-scaled DynSGD, elastic-momentum EAMSGD, and ADAG's

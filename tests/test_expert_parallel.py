@@ -118,6 +118,7 @@ def test_moe_layer_in_sequential_and_config_roundtrip():
     np.testing.assert_allclose(np.asarray(y), np.asarray(y2), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_moe_model_trains_expert_parallel():
     """End-to-end: MoE classifier with experts sharded over the 8-device
     mesh trains to the task target through the GSPMD all-to-all."""
@@ -185,6 +186,7 @@ def _moe_classifier(seed=0):
     ).build((32,), seed=seed)
 
 
+@pytest.mark.slow
 def test_sync_trainer_expert_parallel_kwarg():
     """Trainer-level EP: SynchronousDistributedTrainer(expert_parallel=4)
     builds the ("data", "expert") mesh, shards the expert stacks, attaches

@@ -177,6 +177,7 @@ def test_worker_exhausted_retries_gives_up_others_continue():
     assert t.parameter_server.num_updates == 4  # worker 1's 4 windows landed
 
 
+@pytest.mark.slow
 def test_heartbeat_monitor_flags_silent_worker(tmp_path):
     ds = make_data(n=512)
 

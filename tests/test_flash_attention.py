@@ -87,6 +87,7 @@ def test_flash_clamped_block_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_flash_bf16_matches_dense_and_keeps_dtype():
     """bf16 is the TPU compute dtype (bench_mfu runs flash under it):
     kernels accumulate f32 internally, outputs and grads come back bf16
@@ -144,6 +145,7 @@ def test_flash_block_larger_than_seq_clamps():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_attach_flash_trains_transformer():
     """The hook face: a transformer classifier trains end-to-end with the
     fused kernels in the training graph (fwd + custom VJP under jit/scan),

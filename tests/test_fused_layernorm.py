@@ -106,6 +106,7 @@ def test_row_padding_partial_final_block():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_attach_hooks_every_layernorm():
     from distkeras_tpu.models.zoo import transformer_classifier
 

@@ -9,6 +9,7 @@ the normal trainer surface.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distkeras_tpu.models import zoo
 from distkeras_tpu.ops.losses import next_token_crossentropy
@@ -34,8 +35,6 @@ def test_next_token_crossentropy_matches_manual():
 def test_next_token_crossentropy_rejects_t1():
     """T=1 has no (input, next-token) pair; the loss must fail loudly
     instead of mean-reducing an empty slice to NaN (ADVICE r3 #4)."""
-    import pytest
-
     logits = jnp.zeros((2, 1, 7), jnp.float32)
     tokens = jnp.zeros((2, 1), jnp.int32)
     with pytest.raises(ValueError, match="seq_len >= 2"):
@@ -57,6 +56,7 @@ def test_transformer_lm_is_causal():
     assert np.abs(base[0, j:] - out2[0, j:]).max() > 1e-6
 
 
+@pytest.mark.slow
 def test_transformer_lm_learns_successor_language():
     """Token t+1 = (token t + 1) mod V is learnable from one step of
     context; the LM should drive next-token accuracy ~1 through the
@@ -112,6 +112,7 @@ def test_transformer_lm_flash_blockwise_parity():
     np.testing.assert_allclose(np.asarray(m3(x)), base, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_transformer_lm_sequence_parallel_matches_dense():
     """Causal LM trained with the token axis sharded 8 ways (ring
     attention, GSPMD-sharded loss shift) must track dense single-device
@@ -183,6 +184,7 @@ def test_sequence_generator_sampling_deterministic_and_bounded():
         SequenceGenerator(m).generate(prompts, steps=15)
 
 
+@pytest.mark.slow
 def test_sequence_generator_continues_trained_lm():
     """On the trained successor LM, generation continues the arithmetic
     sequence — the user-facing proof the decode uses the model causally."""
@@ -253,6 +255,7 @@ def test_cached_generator_rejects_unsupported_models():
         CachedSequenceGenerator(lm)  # live attention hook
 
 
+@pytest.mark.slow
 def test_text_corpus_windows_and_training_smoke():
     """Byte-level windows from real in-repo text (the LICENSE), trained a
     few steps: loss must drop (real prose has learnable byte statistics)."""
@@ -279,6 +282,7 @@ def test_text_corpus_windows_and_training_smoke():
     assert last < first * 0.8, (first, last)
 
 
+@pytest.mark.slow
 def test_transformer_lm_pipeline_parallel_matches_dense():
     """Causal LM trained with its block tower stage-sharded over a
     4-deep GPipe pipeline must track dense single-device training —
@@ -313,6 +317,7 @@ def test_transformer_lm_pipeline_parallel_matches_dense():
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_moe_transformer_lm_is_causal_and_learns():
     """Switch-MoE feed-forwards route per token, so the MoE LM must stay
     strictly causal; it must also learn the successor language through
@@ -367,6 +372,7 @@ def test_perplexity_evaluator_matches_loss():
     assert 8 < ppl < 32, ppl
 
 
+@pytest.mark.slow
 def test_transformer_block_dropout():
     """dropout>0: eval mode is identity (equals the dropout-0 model on the
     same init), train mode is stochastic per rng, training still learns,
@@ -418,6 +424,7 @@ def test_transformer_block_dropout():
         pp.train(ds)
 
 
+@pytest.mark.slow
 def test_transformer_lm_tensor_parallel_matches_dense():
     """Causal LM trained DP x TP (batch over "data", Dense/attention
     projection outputs over "model") must match pure sync-DP at the same
@@ -454,6 +461,7 @@ def test_transformer_lm_tensor_parallel_matches_dense():
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_generator_top_k_top_p_sampling():
     """top-k / nucleus filtering: sampled tokens stay inside the allowed
     set (checked against numpy-computed filters on the same logits), the
@@ -514,6 +522,7 @@ def test_generator_top_k_top_p_sampling():
         SequenceGenerator(m, temperature=1.0, top_p=1.5)
 
 
+@pytest.mark.slow
 def test_moe_lm_expert_parallel_matches_dp():
     """The MoE causal LM under trainer-level expert parallelism
     (("data","expert") mesh) tracks the pure-DP run at equal global

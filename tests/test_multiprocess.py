@@ -14,6 +14,8 @@ import socket
 import textwrap
 from concurrent.futures import ThreadPoolExecutor
 
+import pytest
+
 from distkeras_tpu.job_deployment import Job
 
 # 256 rows / batch 16 = 16 batches; communication_window 4 -> 4 commits
@@ -186,6 +188,7 @@ def _single_process_sync_digest() -> float:
     ))
 
 
+@pytest.mark.slow
 def test_two_process_sync_dp_matches_single_process(tmp_path):
     """SynchronousDistributedTrainer trains across 2 OS processes (psum
     over the process boundary) and lands the single-process trajectory."""
@@ -230,6 +233,7 @@ def test_two_process_sync_dp_matches_single_process(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_two_process_ps_training_over_real_sockets(tmp_path):
     script = tmp_path / "train2proc.py"
     script.write_text(_SCRIPT.format(expect=_EXPECT_COMMITS))

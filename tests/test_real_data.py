@@ -112,6 +112,7 @@ def test_sync_dp_matches_single_on_real_data():
         np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_downpour_trains_real_data():
     train, test = real_digits()
     t = DOWNPOUR(

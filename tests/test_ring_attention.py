@@ -24,6 +24,7 @@ def qkv(seed=0):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_matches_dense(causal):
     q, k, v = qkv()
     mesh = make_mesh()
@@ -52,6 +53,7 @@ def test_blockwise_matches_dense(causal):
     )
 
 
+@pytest.mark.slow
 def test_blockwise_gradients_match_dense():
     from distkeras_tpu.parallel.ring_attention import blockwise_attention
 
@@ -103,6 +105,7 @@ def test_blockwise_short_seq_falls_back_to_dense():
     )
 
 
+@pytest.mark.slow
 def test_attach_blockwise_trains_long_context():
     """The hook face: a transformer classifier trains with blockwise
     attention attached and matches the dense trajectory within float32
@@ -136,6 +139,7 @@ def test_attach_blockwise_trains_long_context():
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_output_stays_sequence_sharded():
     q, k, v = qkv()
     mesh = make_mesh()
@@ -146,6 +150,7 @@ def test_ring_output_stays_sequence_sharded():
     assert shard_shape == (B, T // 8, H, D)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense():
     q, k, v = qkv(seed=3)
     mesh = make_mesh()
@@ -173,6 +178,7 @@ def test_seq_not_divisible_raises():
         ring_attention(q, k, v, mesh)
 
 
+@pytest.mark.slow
 def test_long_sequence_smoke():
     """Longer-than-single-block sequence: 1024 tokens over 8 devices."""
     rng = np.random.default_rng(0)
@@ -211,6 +217,7 @@ def test_attention_layer_in_sequential():
     np.testing.assert_allclose(np.asarray(y), np.asarray(y2), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_attention_layer_with_ring_fn():
     """The layer's attention_fn hook serves the sequence-parallel path."""
     import functools

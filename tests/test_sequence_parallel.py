@@ -39,6 +39,7 @@ def accuracy_of(model, test):
     return AccuracyEvaluator(label_col="label").evaluate(pred)
 
 
+@pytest.mark.slow
 def test_sp_training_matches_dense_single_trainer():
     """Same data order, same init, same optimizer: training with the token
     axis sharded 8 ways through the ppermute ring must track dense
@@ -60,6 +61,7 @@ def test_sp_training_matches_dense_single_trainer():
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_sp_training_converges_sharded():
     """End-to-end: gradient steps through ppermute on a sequence-sharded
     batch reach the task target (loss falls, accuracy > 0.9)."""
@@ -81,6 +83,7 @@ def test_sp_training_converges_sharded():
     assert t.num_workers == 8
 
 
+@pytest.mark.slow
 def test_sp_dp_2x4_matches_dense_single_trainer():
     """2-D composition (VERDICT r2 weak #5): batch shards 2-way over "data"
     while tokens shard 4-way over "seq". Same init, same data order, same
@@ -104,6 +107,7 @@ def test_sp_dp_2x4_matches_dense_single_trainer():
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_sp_dp_converges_sharded():
     """End-to-end 2-D: the batch x token sharded run reaches the task
     target, and its inputs really shard both axes."""
@@ -151,6 +155,7 @@ def test_sp_dp_rejects_indivisible_batch():
         t.train(train)
 
 
+@pytest.mark.slow
 def test_sp_training_longer_than_one_device_block():
     """128 tokens over 8 devices = 16 tokens/device: the sequence spans
     multiple ring hops and still trains."""
@@ -168,6 +173,7 @@ def test_sp_training_longer_than_one_device_block():
     assert accuracy_of(trained, test) > 0.9
 
 
+@pytest.mark.slow
 def test_sp_checkpoint_resume_bit_identical(tmp_path):
     """Interrupt after epoch 1, resume: the continuation must equal an
     uninterrupted 2-epoch run exactly (same contract as the other
@@ -196,6 +202,7 @@ def test_sp_checkpoint_resume_bit_identical(tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_sp_validation_data_records_val_metrics():
     """Per-epoch validation with ring-attention hooks attached: eval_step
     runs the ring shard_map on host-unsharded (B, T) inputs (README
@@ -243,6 +250,7 @@ def test_sp_batch_is_token_sharded():
     assert placed.sharding.shard_shape(placed.shape) == (1, 4, SEQ // 8)
 
 
+@pytest.mark.slow
 def test_sp_detaches_ring_hook_after_training():
     """Neither the caller's model nor the returned copy may keep the
     mesh-bound ring hook after train() — both compute dense attention, as

@@ -177,6 +177,7 @@ def test_sync_dp_trains_from_shards(tmp_path):
     assert acc > 0.95, acc
 
 
+@pytest.mark.slow
 def test_async_trainer_partitions_shards(tmp_path):
     """Async PS trainers partition a StreamingDataset at shard granularity
     and converge."""

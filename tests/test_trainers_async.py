@@ -54,6 +54,7 @@ def _trainer(cls, model, **extra):
     ],
     ids=lambda v: v.__name__ if isinstance(v, type) else "",
 )
+@pytest.mark.slow
 def test_async_converges_simulated(cls, extra):
     train, test = make_data()
     t = _trainer(cls, zoo.mnist_mlp(hidden=64), **extra)
@@ -64,6 +65,7 @@ def test_async_converges_simulated(cls, extra):
     assert len(t.get_history()) > 0
 
 
+@pytest.mark.slow
 def test_simulated_mode_is_deterministic():
     train, _ = make_data(n=1024)
     a = _trainer(DOWNPOUR, zoo.mnist_mlp(hidden=32)).train(train)
@@ -72,6 +74,7 @@ def test_simulated_mode_is_deterministic():
         np.testing.assert_array_equal(wa, wb)
 
 
+@pytest.mark.slow
 def test_threads_mode_converges():
     train, test = make_data(n=1024)
     t = _trainer(DOWNPOUR, zoo.mnist_mlp(hidden=32), mode="threads", num_epoch=3)
@@ -84,6 +87,7 @@ def test_threads_mode_converges():
     assert worker_ids == {0, 1, 2, 3}
 
 
+@pytest.mark.slow
 def test_remote_ps_trains_over_the_wire():
     """remote_ps=True: every pull/commit crosses the TCP socket protocol —
     the loopback stand-in for the multi-host DCN topology (rank 0 hosts the
@@ -104,6 +108,7 @@ def test_remote_ps_trains_over_the_wire():
     assert ps.suspected_failures(timeout=0.0) == [0, 1, 2, 3]
 
 
+@pytest.mark.slow
 def test_eamsgd_converges():
     train, test = make_data(n=1024)
     t = _trainer(
@@ -240,6 +245,7 @@ def test_async_state_aggregation_per_leaf_dtypes():
     np.testing.assert_allclose(agg["aux_loss"], 0.5)  # first worker's, unmixed
 
 
+@pytest.mark.slow
 def test_async_batchnorm_model_trains_and_returns_stats():
     """BatchNorm + async PS: the trained model must come back with finite,
     updated moving stats (the aggregate over workers), and eval through

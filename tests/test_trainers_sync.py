@@ -319,6 +319,7 @@ def test_sync_dp_converges_on_8_devices():
     assert accuracy_of(trained, test) > 0.95
 
 
+@pytest.mark.slow
 def test_ensemble_trainer_returns_n_models():
     train, test = make_data(n=1024)
     t = EnsembleTrainer(
@@ -339,6 +340,7 @@ def test_ensemble_trainer_returns_n_models():
     assert not np.allclose(w0, w1)
 
 
+@pytest.mark.slow
 def test_ensemble_vmapped_matches_threaded():
     """vmapped=True trains all members in ONE compiled vmap program with
     the member axis sharded over the mesh; at partition sizes that tile
@@ -388,6 +390,7 @@ def test_ensemble_vmapped_converges():
     assert t.get_history(worker_id=3), "member 3 history missing"
 
 
+@pytest.mark.slow
 def test_averaging_vmapped_matches_threaded():
     """AveragingTrainer(vmapped=True): replicas train in one vmap program
     and average on the member axis at epoch end — matches the threaded

@@ -71,6 +71,7 @@ def test_transformer_classifier_converges():
     assert acc > 0.95, acc
 
 
+@pytest.mark.slow
 def test_attach_ring_attention_walks_blocks():
     model = zoo.transformer_classifier(
         vocab_size=16, seq_len=64, d_model=32, num_heads=2, depth=3

@@ -59,6 +59,7 @@ def test_ulysses_heads_must_divide():
         ulysses_attention(q, k, v, seq_mesh())
 
 
+@pytest.mark.slow
 def test_ulysses_gradients_match_dense():
     q, k, v = make_qkv(t=32)
     mesh = seq_mesh()
@@ -75,6 +76,7 @@ def test_ulysses_gradients_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
 
 
+@pytest.mark.slow
 def test_sp_trainer_ulysses_matches_dense_single_trainer():
     """SequenceParallelTrainer(sp_mode="ulysses") must track dense
     single-device training like the ring mode does — same contract,
@@ -108,6 +110,7 @@ def test_sp_trainer_ulysses_matches_dense_single_trainer():
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_sp_trainer_ulysses_causal_lm():
     """Ulysses SP training of the causal LM (token axis sharded, heads
     sharded inside attention) matches dense single-device training."""

@@ -102,6 +102,10 @@ def main() -> None:
         loss = model.train_on_batch(*next_batch())
     dt = time.perf_counter() - t0
 
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "REFERENCE_PATTERN.json",
+    )
     record = {
         "metric": "reference_pattern_train_samples_per_sec",
         "value": round(TIMED_BATCHES * BATCH / dt, 1),
@@ -114,7 +118,8 @@ def main() -> None:
         "final_loss": round(float(np.asarray(loss).ravel()[0]), 4),
         "host": os.uname().nodename,
     }
-    with open("REFERENCE_PATTERN.json", "w") as f:
+    # anchored to the repo root (where bench.py reads it), never the CWD
+    with open(out_path, "w") as f:
         json.dump(record, f, indent=2)
     print(json.dumps(record))
 
