@@ -577,3 +577,111 @@ def test_gradient_accumulation_on_resident_feed():
         "BN running stats identical across accum settings: the "
         "microbatch scan did not run"
     )
+
+
+# --------------------------------------------------- ZeRO-1 (r4 stretch)
+
+
+def test_zero_leaf_sharding_rule():
+    """Moments shard their first data-divisible dim; undividable leaves
+    replicate."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.parallel.mesh import make_mesh, zero_leaf_sharding
+
+    mesh = make_mesh(8)
+    assert tuple(zero_leaf_sharding(mesh, jnp.zeros((784, 32))).spec) == (
+        "data", None,
+    )
+    assert tuple(zero_leaf_sharding(mesh, jnp.zeros((10, 256))).spec) == (
+        None, "data",
+    )
+    assert tuple(zero_leaf_sharding(mesh, jnp.zeros((10,))).spec) == ()
+    assert tuple(zero_leaf_sharding(mesh, jnp.zeros(())).spec) == ()
+
+
+def test_zero_shard_opt_state_stays_sharded_through_window():
+    """The compiled window must hand back moments with their ZeRO
+    shardings intact — otherwise the memory win silently evaporates on
+    the second window."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.parallel.mesh import (
+        batch_sharding,
+        make_mesh,
+        replicate,
+        shard_opt_state_zero,
+    )
+    from distkeras_tpu.workers import WorkerCore
+
+    mesh = make_mesh(8)
+    model = zoo.mnist_mlp(hidden=32, seed=0)
+    core = WorkerCore(model, get_optimizer("adam", 1e-3),
+                      "categorical_crossentropy")
+    params = replicate(model.params, mesh)
+    state = replicate(model.state, mesh)
+    opt_state = shard_opt_state_zero(core.init_opt_state(params), mesh)
+    rng = jax.random.PRNGKey(0)
+    rng = jax.device_put(rng, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()))
+
+    train, _ = make_data(n=512)
+    xs = np.stack([train["features"][:64].reshape(64, -1)])
+    ys = np.stack([train["label_onehot"][:64]])
+    xs = jax.device_put(xs, batch_sharding(mesh).update(spec=(None, "data")))
+    ys = jax.device_put(ys, batch_sharding(mesh).update(spec=(None, "data")))
+
+    p2, s2, opt2, rng2, _m = core.window(params, state, opt_state, rng, xs, ys)
+    before = jax.tree.leaves(opt_state)
+    after = jax.tree.leaves(opt2)
+    assert len(before) == len(after)
+    n_sharded = 0
+    for a, b in zip(before, after):
+        if getattr(a.sharding, "spec", None) and any(
+            s is not None for s in a.sharding.spec
+        ):
+            # XLA trims trailing Nones from the spec; compare semantics
+            assert b.sharding.is_equivalent_to(a.sharding, b.ndim), (
+                a.sharding, b.sharding,
+            )
+            n_sharded += 1
+    assert n_sharded >= 4, n_sharded  # w/b moments for 2 dense layers x2
+    # params stay materializable and finite — GSPMD is free to keep the
+    # steady-state params sharded too (gathering at use) or replicate
+    # them; either way the host can always rebuild the full tree
+    for leaf in jax.tree.leaves(p2):
+        assert bool(np.isfinite(np.asarray(leaf)).all())
+
+
+@pytest.mark.slow
+def test_zero_sync_dp_matches_replicated_trainer():
+    """shard_opt_state=True is a memory layout, not a different
+    algorithm: the trained weights must match the replicated-state
+    trainer."""
+    train, _ = make_data(n=1024)
+    kw = dict(
+        loss="categorical_crossentropy",
+        learning_rate=1e-3,
+        batch_size=16,
+        num_workers=8,
+        num_epoch=2,
+        label_col="label_onehot",
+        seed=0,
+    )
+    base = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=32), "adam", **kw
+    ).train(train)
+    zero = SynchronousDistributedTrainer(
+        zoo.mnist_mlp(hidden=32), "adam", shard_opt_state=True, **kw
+    ).train(train)
+    for a, b in zip(base.get_weights(), zero.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_zero_rejects_model_parallel_combination():
+    with pytest.raises(ValueError, match="ZeRO-1"):
+        SynchronousDistributedTrainer(
+            zoo.mnist_mlp(hidden=32), "adam", "categorical_crossentropy",
+            shard_opt_state=True, model_parallel=2,
+        )
