@@ -87,6 +87,25 @@ def replicate(tree, mesh: Mesh):
     return jax.device_put(tree, sh)
 
 
+def host_gather(tree):
+    """Make every array leaf host-fetchable. In multi-controller runs a
+    leaf sharded across processes spans non-addressable devices and
+    ``np.asarray`` refuses it; such leaves are all-gathered to a full
+    host array first (fully-replicated leaves fetch directly even when
+    their device set spans processes)."""
+
+    def fix(x):
+        if not isinstance(x, jax.Array):
+            return x
+        if x.is_fully_addressable or x.sharding.is_fully_replicated:
+            return x
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(x, tiled=True)
+
+    return jax.tree.map(fix, tree)
+
+
 def zero_leaf_sharding(mesh: Mesh, leaf, axis: str = "data") -> NamedSharding:
     """ZeRO-1 placement rule for one optimizer-state leaf: shard the
     FIRST dimension divisible by the ``axis`` size; leaves with no such

@@ -37,9 +37,12 @@ logger = logging.getLogger(__name__)
 from distkeras_tpu.ops.optimizers import effective_learning_rate, get_optimizer
 from distkeras_tpu.parallel.mesh import (
     batch_sharding,
+    host_gather,
     local_devices,
     make_mesh,
     replicate,
+    shard_opt_state_zero,
+    zero_leaf_sharding,
 )
 from distkeras_tpu.parameter_servers import (
     ADAGParameterServer,
@@ -200,11 +203,16 @@ class Trainer:
         return carry
 
     def _finish(self, params, state=None):
-        """Produce the result model (trained weights on a copy)."""
+        """Produce the result model (trained weights on a copy).
+
+        In multi-controller runs a tree can come back sharded across
+        processes (ZeRO moments; GSPMD sometimes leaves steady-state
+        params data-sharded too) — ``np.asarray`` cannot fetch
+        non-addressable shards, so such leaves are gathered first."""
         result = self.model.copy()
-        result.params = jax.tree.map(np.asarray, params)
+        result.params = jax.tree.map(np.asarray, host_gather(params))
         if state is not None:
-            result.state = jax.tree.map(np.asarray, state)
+            result.state = jax.tree.map(np.asarray, host_gather(state))
         return result
 
     # -- bookkeeping parity -------------------------------------------------
@@ -302,12 +310,14 @@ class Trainer:
         if self.checkpointer is None:
             return
         if self._should_checkpoint(done):
+            # cross-process-sharded trees (ZeRO moments) gather to full
+            # host arrays first — the snapshot format is a full tree
             self.checkpointer.save(
                 done,
                 {
-                    "params": params,
-                    "state": state,
-                    "opt_state": opt_state,
+                    "params": host_gather(params),
+                    "state": host_gather(state),
+                    "opt_state": host_gather(opt_state),
                     "rng": rng,
                 },
                 {"epoch": done},
@@ -573,11 +583,6 @@ class SynchronousDistributedTrainer(Trainer):
                 )
             return opt_state
         if self.shard_opt_state:
-            from distkeras_tpu.parallel.mesh import (
-                shard_opt_state_zero,
-                zero_leaf_sharding,
-            )
-
             if restored is not None:
                 # host arrays shard straight to their slices (device_put
                 # never materializes the full tree per device)
