@@ -40,6 +40,10 @@ def main():
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--zero", action="store_true",
+                    help="sync mode: ZeRO-1 — shard optimizer moments "
+                    "over the data axis (~workers-fold less per-device "
+                    "optimizer memory, same trajectory)")
     ap.add_argument("--n", type=int, default=16384, help="synthetic rows if no CSV")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (virtual multi-device mesh "
@@ -73,11 +77,16 @@ def main():
         # scales by 1/N (benchmarks.py config-2 calibration); the sync
         # trainer means the global-batch loss, so full lr is right there
         lr = 1e-3 / args.workers if cls is DOWNPOUR else 1e-3
+        extra = (
+            {"shard_opt_state": True}
+            if args.zero and cls is SynchronousDistributedTrainer
+            else {}
+        )
         trainer = cls(
             model, worker_optimizer="adam", learning_rate=lr,
             loss="categorical_crossentropy",
             label_col="label_onehot", batch_size=args.batch,
-            num_epoch=args.epochs, num_workers=args.workers,
+            num_epoch=args.epochs, num_workers=args.workers, **extra,
         )
 
     t0 = time.time()
