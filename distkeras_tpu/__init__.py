@@ -45,6 +45,7 @@ from distkeras_tpu.evaluators import (
     AccuracyEvaluator,
     LossEvaluator,
     PerplexityEvaluator,
+    RSquaredEvaluator,
 )
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.data.transformers import (

@@ -20,12 +20,15 @@ import numpy as np
 from distkeras_tpu.data.dataset import Dataset
 
 
-def load_csv(path, label_col="label", dtype=np.float32) -> Dataset:
+def load_csv(path, label_col="label", dtype=np.float32,
+             label_dtype=np.int64) -> Dataset:
     """CSV with a header row -> Dataset with 'features' + 'label' columns.
 
     The numeric body parses through the native C++ reader
     (distkeras_tpu/native/dkt_data.cpp via data/native.py) when available;
     a pure-Python csv loop is the fallback (DKT_NO_NATIVE=1 forces it).
+    ``label_dtype`` defaults to int64 (classification ids); regression
+    CSVs pass a float dtype to keep continuous targets.
     """
     from distkeras_tpu.data import native
 
@@ -45,10 +48,10 @@ def load_csv(path, label_col="label", dtype=np.float32) -> Dataset:
             rows = np.asarray([[float(v) for v in row] for row in reader], dtype)
     if label_col in header:
         li = header.index(label_col)
-        label = rows[:, li].astype(np.int64)
+        label = rows[:, li].astype(label_dtype)
         feats = np.delete(rows, li, axis=1)
     else:
-        label = rows[:, 0].astype(np.int64)
+        label = rows[:, 0].astype(label_dtype)
         feats = rows[:, 1:]
     return Dataset({"features": feats.astype(dtype), "label": label})
 
@@ -245,6 +248,22 @@ def breast_cancer(path=None) -> Dataset:
     ``StandardScaleTransformer``."""
     path = path or os.path.join(os.path.dirname(__file__), "breast_cancer.csv")
     return load_csv(path)
+
+
+def diabetes(path=None) -> Dataset:
+    """REAL regression data, shipped in-repo: the 442-row sklearn
+    diabetes set (10 standardized clinical features, continuous disease-
+    progression target 25..346) as ``diabetes.csv``, parsed through the
+    same ``load_csv`` path as ``digits``/``breast_cancer``. The
+    regression face of the reference's arbitrary-Keras-model support
+    (reference: distkeras/trainers.py trains whatever model/loss the
+    user compiled — including regressors); pairs with ``loss="mse"``,
+    ``zoo.tabular_regressor`` and ``RSquaredEvaluator``. The target
+    comes back as a (n, 1) float32 column so it broadcasts correctly
+    against the regressor's (B, 1) predictions."""
+    path = path or os.path.join(os.path.dirname(__file__), "diabetes.csv")
+    ds = load_csv(path, label_dtype=np.float32)
+    return ds.with_column("label", ds["label"].reshape(-1, 1))
 
 
 def mnist(path=None, n=8192, seed=0, flat=True) -> Dataset:

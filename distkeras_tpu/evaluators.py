@@ -55,6 +55,28 @@ class LossEvaluator(Evaluator):
         )
 
 
+class RSquaredEvaluator(Evaluator):
+    """Coefficient of determination R² = 1 - SS_res/SS_tot of a
+    continuous prediction column against a continuous target — the
+    regression counterpart of ``AccuracyEvaluator`` (the reference
+    evaluated whatever its compiled Keras model emitted; reference:
+    distkeras/evaluators.py). 1.0 is a perfect fit; 0.0 is the
+    predict-the-mean baseline; negative is worse than that baseline."""
+
+    def __init__(self, prediction_col="prediction", label_col="label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, ds: Dataset) -> float:
+        pred = np.asarray(ds[self.prediction_col], np.float64).reshape(-1)
+        y = np.asarray(ds[self.label_col], np.float64).reshape(-1)
+        ss_res = float(np.sum((y - pred) ** 2))
+        ss_tot = float(np.sum((y - np.mean(y)) ** 2))
+        if ss_tot == 0.0:
+            return 1.0 if ss_res == 0.0 else 0.0
+        return 1.0 - ss_res / ss_tot
+
+
 class PerplexityEvaluator(Evaluator):
     """Causal-LM perplexity: exp(mean next-token cross-entropy) of an LM's
     logits column against the token column. No reference counterpart

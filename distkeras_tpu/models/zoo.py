@@ -68,6 +68,22 @@ def digits_mlp(hidden=64, num_classes=10, seed=0):
     ).build((64,), seed=seed)
 
 
+def tabular_regressor(num_features=10, hidden=64, seed=0):
+    """MLP regressor with a linear (B, 1) output head — the regression
+    face of the reference's arbitrary-model support (reference:
+    distkeras/trainers.py accepts whatever compiled Keras model the user
+    hands it, regressors included). Pairs with ``loss="mse"``/"mae" and
+    ``RSquaredEvaluator``; the real acceptance data is
+    ``loaders.diabetes()``."""
+    return Sequential(
+        [
+            Dense(hidden, activation="relu"),
+            Dense(hidden, activation="relu"),
+            Dense(1),
+        ]
+    ).build((num_features,), seed=seed)
+
+
 def higgs_mlp(num_features=30, hidden=600, num_classes=2, seed=0):
     """ATLAS-Higgs-style tabular classifier (wide MLP over ~30 features)."""
     return Sequential(

@@ -62,11 +62,26 @@ def binary_crossentropy(y_pred, y_true):
     return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
 
 
+def _check_regression_shapes(y_pred, y_true):
+    """(B, 1) predictions against a (B,) target silently broadcast to a
+    (B, B) residual matrix — a wrong loss with no error (the classic
+    Keras regression footgun). Require identical shapes."""
+    if y_pred.shape != y_true.shape:
+        raise ValueError(
+            f"regression loss needs matching shapes; got y_pred "
+            f"{y_pred.shape} vs y_true {y_true.shape} — reshape the "
+            "target to the prediction's shape (loaders.diabetes ships "
+            "its target as (n, 1))"
+        )
+
+
 def mse(y_pred, y_true):
+    _check_regression_shapes(y_pred, y_true)
     return jnp.mean((y_pred - y_true) ** 2)
 
 
 def mae(y_pred, y_true):
+    _check_regression_shapes(y_pred, y_true)
     return jnp.mean(jnp.abs(y_pred - y_true))
 
 

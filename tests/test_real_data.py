@@ -124,3 +124,61 @@ def test_downpour_trains_real_data():
     trained = t.train(train)
     acc = accuracy_of(trained, test)
     assert acc >= 0.9, f"async real-data accuracy {acc}"
+
+
+def test_diabetes_loads_and_is_real_shaped():
+    """The in-repo diabetes regression CSV (r4): real 442-row continuous-
+    target data through load_csv with a float label dtype."""
+    ds = loaders.diabetes()
+    assert len(ds) == 442
+    x, y = ds["features"], ds["label"]
+    assert x.shape == (442, 10)
+    assert y.shape == (442, 1) and y.dtype == np.float32
+    assert float(y.min()) == 25.0 and float(y.max()) == 346.0
+    # sklearn ships the features pre-standardized to unit *sum of
+    # squares* per column (not unit variance): each column's norm is 1
+    np.testing.assert_allclose(
+        np.sum(x.astype(np.float64) ** 2, axis=0), 1.0, rtol=1e-3
+    )
+
+
+def test_regression_tier_fits_real_diabetes():
+    """SingleTrainer + mse + tabular_regressor reach R^2 > 0.4 held-out
+    on real data (predict-the-mean scores 0; r4 calibration: 0.538), and
+    the R^2 evaluator agrees with a hand computation."""
+    from distkeras_tpu import RSquaredEvaluator, StandardScaleTransformer
+
+    train, test = loaders.diabetes().split(0.85, seed=7)
+    fs = StandardScaleTransformer().fit(train)
+    ys = StandardScaleTransformer(input_col="label").fit(train)
+    train, test = (ys.transform(fs.transform(d)) for d in (train, test))
+
+    t = SingleTrainer(
+        zoo.tabular_regressor(seed=0), "adam", "mse",
+        learning_rate=1e-3, batch_size=32, num_epoch=40, seed=0,
+    )
+    m = t.train(train, shuffle=True)
+    pred = ModelPredictor(m).predict(test)
+    r2 = RSquaredEvaluator().evaluate(pred)
+    assert r2 > 0.4, r2
+
+    p = pred["prediction"].reshape(-1).astype(np.float64)
+    y = pred["label"].reshape(-1).astype(np.float64)
+    want = 1.0 - np.sum((y - p) ** 2) / np.sum((y - y.mean()) ** 2)
+    np.testing.assert_allclose(r2, want, rtol=1e-12)
+
+
+def test_regression_loss_rejects_shape_mismatch():
+    """(B, 1) vs (B,) would silently broadcast to a (B, B) residual —
+    the loss must refuse (the classic regression footgun)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from distkeras_tpu.ops.losses import mae, mse
+
+    with pytest.raises(ValueError, match="matching shapes"):
+        mse(jnp.zeros((8, 1)), jnp.zeros((8,)))
+    with pytest.raises(ValueError, match="matching shapes"):
+        mae(jnp.zeros((8, 1)), jnp.zeros((8,)))
+    assert float(mse(jnp.ones((4, 1)), jnp.zeros((4, 1)))) == 1.0
+    assert float(mae(jnp.full((4, 1), -2.0), jnp.zeros((4, 1)))) == 2.0
