@@ -1,22 +1,23 @@
 #!/bin/sh
 # One-shot TPU measurement sweep — run when the axon tunnel is healthy.
-# Captures, in order of value-per-second (the tunnel can die mid-sweep):
-#   1. bench.py           — north-star MNIST CNN via the device-resident path
-#   2. bench_mfu.py       — transformer MXU utilization, dense-vs-flash A/B;
-#                           the WINNER is the committed headline
-#                           (VERDICT r3 weak #1)
-#   2b/2c/2d. mfu_attrib  — long-context multi-block rows, MXU scaling rows,
-#                           retire-or-win rows for the losing kernels
-#   3. bench_decode.py    — serving-path decode tokens/sec
-#   4. prefetch A/B       — interleaved 3x pairs, median speedup
-#                           (VERDICT r3 weak #4: short single pairs drifted
-#                           0.74-1.12x between captures)
-# Each step is independently timeout-boxed; results append to TPU_CAPTURE.log.
-# stderr goes to TPU_CAPTURE.log.err which is NOT committed (ADVICE r3 #2:
-# a 34k-line raw stderr capture bloats history); distilled artifacts only.
-# Artifacts COMMIT AFTER EVERY STEP: the 2026-07-31 01:02 window lasted only
-# minutes — a sweep that commits once at the end can lose its one good
-# number to a tunnel that dies mid-sweep.
+# ORDERED BY VALUE-PER-SECOND for a possibly-short window (r3's lasted
+# ~25-40 min; the r4 queue is ordered so the VERDICT-critical artifacts
+# land first — a north-star TPU number is already committed, so it runs
+# near the end as a refresh):
+#   1. bench_mfu --attention best  — the MFU headline, winner committed
+#                                    (VERDICT r3 weak #1)
+#   2. mfu_attrib --long           — seq-2048 multi-block proof (weak #2)
+#   3. mfu_attrib --retire         — fused_ln / pallas_adam at d1024
+#                                    (task 7)
+#   4. bench_decode                — LM decode tokens/sec on chip (task 2)
+#   5. mfu_attrib --scale          — d1024 ceiling-target rows
+#   6. bench.py                    — north-star refresh
+#   7. prefetch A/B                — interleaved 3-pair median (weak #4)
+# Each step is independently timeout-boxed; results append to
+# TPU_CAPTURE.log. stderr goes to TPU_CAPTURE.log.err which is NOT
+# committed (ADVICE r3 #2). Artifacts COMMIT AFTER EVERY STEP: a sweep
+# that commits once at the end can lose its one good number to a tunnel
+# that dies mid-sweep.
 set -x
 cd "$(dirname "$0")/.."
 LOG=TPU_CAPTURE.log
@@ -24,7 +25,43 @@ date >> "$LOG"
 
 . tools/git_snap.sh
 
-# --- 1. north-star bench (device-resident MNIST CNN) ---------------------
+# --- 1. transformer MFU: dense-vs-flash A/B, winner is the headline ------
+timeout 1800 python bench_mfu.py --attention best 2>>"$LOG.err" | tail -3 >> "$LOG"
+if grep -q '"platform": "tpu"' BENCH_MFU.json 2>/dev/null; then
+  commit_snap "Harvest TPU window: transformer MFU headline (A/B winner)" \
+    BENCH_MFU.json "$LOG"
+else
+  # a CPU-fallback run must not clobber a previously committed TPU number
+  git checkout -- BENCH_MFU.json 2>/dev/null || true
+fi
+
+# --- 2. long-context A/B: flash vs dense at seq 2048 ---------------------
+# (the multi-block regime — 2048/512 = 4 K/V blocks per program — where
+# the streaming online softmax must prove itself; VERDICT r3 weak #2)
+timeout 900 python tools/mfu_attrib.py --long >> "$LOG" 2>>"$LOG.err"
+commit_snap "Harvest TPU window: long-context attention A/B" \
+  MFU_ATTRIB.jsonl "$LOG"
+
+# --- 3. retire-or-win rows for fused_layernorm / pallas_adam -------------
+timeout 900 python tools/mfu_attrib.py --retire >> "$LOG" 2>>"$LOG.err"
+commit_snap "Harvest TPU window: kernel retire-or-win rows (d1024)" \
+  MFU_ATTRIB.jsonl "$LOG"
+
+# --- 4. serving-path decode tokens/sec (KV cache vs full recompute) ------
+timeout 900 python bench_decode.py 2>>"$LOG.err" | tail -1 >> "$LOG"
+if grep -q '"platform": "tpu"' BENCH_DECODE.json 2>/dev/null; then
+  commit_snap "Harvest TPU window: LM decode throughput (KV cache A/B)" \
+    BENCH_DECODE.json "$LOG"
+else
+  git checkout -- BENCH_DECODE.json 2>/dev/null || true
+fi
+
+# --- 5. MXU scaling rows: d_model 1024 / batch 128 -----------------------
+timeout 900 python tools/mfu_attrib.py --scale >> "$LOG" 2>>"$LOG.err"
+commit_snap "Harvest TPU window: MFU scaling rows (d1024, batch128)" \
+  MFU_ATTRIB.jsonl "$LOG"
+
+# --- 6. north-star bench refresh (device-resident MNIST CNN) -------------
 timeout 600 python bench.py 2>>"$LOG.err" | tail -1 >> "$LOG"
 # only a tpu-platform measurement is the artifact of record (the harness
 # degrades to a CPU-scaled line when the tunnel dies; never ship that as
@@ -42,44 +79,8 @@ fi
 commit_snap "Harvest TPU window: north-star device-resident bench" \
   BENCH_TPU.json "$LOG"
 
-# --- 2. transformer MFU: dense-vs-flash A/B, winner is the headline ------
-timeout 1800 python bench_mfu.py --attention best 2>>"$LOG.err" | tail -3 >> "$LOG"
-if grep -q '"platform": "tpu"' BENCH_MFU.json 2>/dev/null; then
-  commit_snap "Harvest TPU window: transformer MFU headline (A/B winner)" \
-    BENCH_MFU.json "$LOG"
-else
-  # a CPU-fallback run must not clobber a previously committed TPU number
-  git checkout -- BENCH_MFU.json 2>/dev/null || true
-fi
-
-# --- 2b. long-context A/B: flash vs dense at seq 2048 --------------------
-# (the multi-block regime — 2048/512 = 4 K/V blocks per program — where
-# the streaming online softmax must prove itself; VERDICT r3 weak #2)
-timeout 900 python tools/mfu_attrib.py --long >> "$LOG" 2>>"$LOG.err"
-commit_snap "Harvest TPU window: long-context attention A/B" \
-  MFU_ATTRIB.jsonl "$LOG"
-
-# --- 2c. MXU scaling rows: d_model 1024 / batch 128 ----------------------
-timeout 900 python tools/mfu_attrib.py --scale >> "$LOG" 2>>"$LOG.err"
-commit_snap "Harvest TPU window: MFU scaling rows (d1024, batch128)" \
-  MFU_ATTRIB.jsonl "$LOG"
-
-# --- 2d. retire-or-win rows for fused_layernorm / pallas_adam ------------
-timeout 900 python tools/mfu_attrib.py --retire >> "$LOG" 2>>"$LOG.err"
-commit_snap "Harvest TPU window: kernel retire-or-win rows (d1024)" \
-  MFU_ATTRIB.jsonl "$LOG"
-
-# --- 3. serving-path decode tokens/sec (KV cache vs full recompute) ------
-timeout 900 python bench_decode.py 2>>"$LOG.err" | tail -1 >> "$LOG"
-if grep -q '"platform": "tpu"' BENCH_DECODE.json 2>/dev/null; then
-  commit_snap "Harvest TPU window: LM decode throughput (KV cache A/B)" \
-    BENCH_DECODE.json "$LOG"
-else
-  git checkout -- BENCH_DECODE.json 2>/dev/null || true
-fi
-
-# --- 4. prefetch A/B: interleaved pairs, median speedup ------------------
+# --- 7. prefetch A/B: interleaved pairs, median speedup ------------------
 timeout 1800 python tools/prefetch_ab.py >> "$LOG" 2>>"$LOG.err"
 commit_snap "Harvest TPU window: prefetch A/B (interleaved medians)" "$LOG"
 
-tail -6 "$LOG"
+tail -8 "$LOG"
