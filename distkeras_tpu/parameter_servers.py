@@ -40,7 +40,21 @@ from distkeras_tpu.utils.serialization import (
 
 
 def _to_host(tree):
-    return jax.tree.map(lambda a: np.asarray(a, dtype=np.float32), tree)
+    """Host numpy copies with float leaves normalized to float32.
+
+    Integer/bool leaves keep their dtype: the compressed wire formats
+    (int8 ``q`` trees, uint16 bf16 payloads, int32 top-k indices) must
+    not be silently re-inflated to 4-byte floats — the old unconditional
+    float32 coercion cost the remote-PS path most of its compression
+    byte savings and turned top-k index arrays into floats (r4)."""
+
+    def conv(a):
+        a = np.asarray(a)
+        if np.issubdtype(a.dtype, np.integer) or a.dtype == np.bool_:
+            return a
+        return a.astype(np.float32, copy=False)
+
+    return jax.tree.map(conv, tree)
 
 
 # --------------------------------------------------------------------- rules

@@ -72,8 +72,13 @@ def is_compressed(delta) -> bool:
 
 
 def maybe_decompress(delta):
-    """PS-side entry: pass raw deltas through, reconstruct compressed ones."""
-    return dequantize_tree(delta) if is_compressed(delta) else delta
+    """PS-side entry: pass raw deltas through, reconstruct compressed ones
+    (int8-quantized or top-k-sparsified)."""
+    if is_compressed(delta):
+        return dequantize_tree(delta)
+    if is_topk(delta):
+        return topk_decompress(delta)
+    return delta
 
 
 BF16_KEY = "__dkt_bf16__"
@@ -142,5 +147,105 @@ def compress_with_feedback(delta, residual):
     if residual is not None:
         delta = jax.tree.map(lambda d, r: d + r, delta, residual)
     payload, deq = quantize_tree(delta)
+    new_residual = jax.tree.map(lambda d, x: d - x, delta, deq)
+    return payload, new_residual
+
+
+# --------------------------------------------------------------- top-k tier
+
+TOPK_KEY = "__dkt_topk__"
+DEFAULT_TOPK_FRAC = 0.01
+
+
+def parse_compress_spec(spec):
+    """``None | "int8" | "topk" | "topk:<frac>"`` -> (kind, frac|None).
+
+    The fraction rides the spec string so the knob needs no extra kwarg
+    through the trainer/worker constructors: ``compress="topk:0.05"``
+    ships the largest 5% of each leaf's entries per commit."""
+    if spec is None:
+        return None, None
+    if spec == "int8":
+        return "int8", None
+    if spec == "topk":
+        return "topk", DEFAULT_TOPK_FRAC
+    if isinstance(spec, str) and spec.startswith("topk:"):
+        frac = float(spec.split(":", 1)[1])
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(f"topk fraction must be in (0, 1]; got {frac}")
+        return "topk", frac
+    raise ValueError(
+        f"compress must be None, 'int8', 'topk' or 'topk:<frac>'; got {spec!r}"
+    )
+
+
+def _topk_leaf(a, frac):
+    a = np.asarray(a, np.float32)
+    if a.size and not np.isfinite(a).all():
+        raise FloatingPointError(
+            "non-finite delta leaf: refusing to sparsify a diverged update"
+        )
+    flat = a.ravel()
+    n = flat.size
+    if n == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.float32)
+    k = max(1, int(np.ceil(frac * n)))
+    if k >= n:
+        idx = np.arange(n, dtype=np.int32)
+    else:
+        idx = np.argpartition(np.abs(flat), n - k)[n - k:].astype(np.int32)
+    return idx, flat[idx]
+
+
+def _topk_dense(idx, vals, shape):
+    out = np.zeros(int(np.prod(shape)) if len(shape) else 1, np.float32)
+    out[idx] = vals
+    return out.reshape(tuple(int(d) for d in shape))
+
+
+def topk_compress(tree, frac=DEFAULT_TOPK_FRAC):
+    """-> (payload, dense reconstruction). Per leaf, ship only the k =
+    ceil(frac * n) largest-|x| entries as (int32 index, float32 value)
+    pairs — ~frac * 2 of the dense bytes (Deep-Gradient-Compression-style
+    sparsification; the un-shipped mass is the caller's error-feedback
+    residual). Wire format mirrors the int8 tier: plain arrays under one
+    marker key, so the pickle-free DKT1 frame carries it unchanged."""
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    pairs = [_topk_leaf(a, frac) for a in flat]
+    shapes = [np.asarray(np.shape(a), np.int64) for a in flat]
+    unflat = jax.tree_util.tree_unflatten
+    payload = {
+        TOPK_KEY: {
+            "i": unflat(treedef, [i for i, _ in pairs]),
+            "v": unflat(treedef, [v for _, v in pairs]),
+            "s": unflat(treedef, shapes),
+        }
+    }
+    deq = unflat(
+        treedef,
+        [_topk_dense(i, v, s) for (i, v), s in zip(pairs, shapes)],
+    )
+    return payload, deq
+
+
+def topk_decompress(payload):
+    body = payload[TOPK_KEY]
+    return jax.tree.map(
+        lambda i, v, s: _topk_dense(i, v, s), body["i"], body["v"], body["s"]
+    )
+
+
+def is_topk(delta) -> bool:
+    return isinstance(delta, dict) and set(delta.keys()) == {TOPK_KEY}
+
+
+def topk_compress_with_feedback(delta, residual, frac=DEFAULT_TOPK_FRAC):
+    """Worker-side entry: fold the previous residual in, sparsify, return
+    (wire payload, next residual). Unshipped entries carry over entirely
+    — momentum-free error feedback, the same conservation contract the
+    int8 tier pins (sum of shipped + residual == sum of raw deltas)."""
+    if residual is not None:
+        delta = jax.tree.map(lambda d, r: d + r, delta, residual)
+    payload, deq = topk_compress(delta, frac)
     new_residual = jax.tree.map(lambda d, x: d - x, delta, deq)
     return payload, new_residual

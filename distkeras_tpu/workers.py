@@ -541,8 +541,11 @@ class AsyncWorker:
         self.features_col = features_col
         self.label_col = label_col
         self.window_size = int(communication_window)
-        if compress not in (None, "int8"):
-            raise ValueError(f"compress must be None or 'int8'; got {compress!r}")
+        from distkeras_tpu.utils.compression import parse_compress_spec
+
+        # kinds: None | "int8" | "topk" (frac rides the spec string,
+        # e.g. "topk:0.05" — see utils/compression.parse_compress_spec)
+        self._compress_kind, self._compress_frac = parse_compress_spec(compress)
         self.compress = compress
         self._q_residual = None  # error-feedback state (utils/compression)
         self._rng0 = jax.random.fold_in(jax.random.PRNGKey(seed), worker_id)
@@ -804,24 +807,31 @@ class AsyncWorker:
         self.records.extend(_metrics_to_records(mets))
         delta, tag = self.make_delta(pend["pulled"], result)
         delta_np = jax.tree.map(np.asarray, delta)
-        if self.compress == "int8":
+        if self._compress_kind is not None:
             from distkeras_tpu.utils.compression import (
                 compress_with_feedback,
                 is_compressed,
+                is_topk,
+                topk_compress_with_feedback,
             )
 
-            # fold last window's quantization error in, quantize, keep the
+            # fold last window's compression error in, compress, keep the
             # new residual for the next commit (error feedback). Elastic
-            # workers quantize inside make_delta instead (the displacement
+            # workers compress inside make_delta instead (the displacement
             # must match what they subtracted locally) and arrive here
             # already compressed. This runs BEFORE the snapshot below so a
             # checkpoint carries THIS commit's residual — a snapshot of the
             # pre-commit residual would make a resume re-apply the previous
             # window's error and drop this one's.
-            if not is_compressed(delta_np):
-                delta_np, self._q_residual = compress_with_feedback(
-                    delta_np, self._q_residual
-                )
+            if not (is_compressed(delta_np) or is_topk(delta_np)):
+                if self._compress_kind == "topk":
+                    delta_np, self._q_residual = topk_compress_with_feedback(
+                        delta_np, self._q_residual, self._compress_frac
+                    )
+                else:
+                    delta_np, self._q_residual = compress_with_feedback(
+                        delta_np, self._q_residual
+                    )
         local_snap = None
         if self.keep_snapshot and (self._seq + 1) % self.snapshot_stride == 0:
             # host copies of this commit's local state, handed to the PS so
@@ -979,18 +989,25 @@ class AEASGDWorker(AsyncWorker):
         center, tag = pulled
         alpha = self.rho * self.learning_rate
         elastic = tree_scale(tree_sub(result["params"], center), alpha)
-        if self.compress == "int8":
+        if self._compress_kind is not None:
             # the elastic rule applies the displacement on BOTH sides
-            # (x_local -= e, center += e); quantize BEFORE the local
-            # subtraction so both apply the identical dequantized value —
-            # error-feedback-style asymmetry (raw locally, dequantized at
+            # (x_local -= e, center += e); compress BEFORE the local
+            # subtraction so both apply the identical reconstructed value —
+            # error-feedback-style asymmetry (raw locally, reconstructed at
             # the PS) makes replica and center drift apart and diverges.
             # No residual is kept: the un-shipped remainder stays in
             # x_local and re-enters the next elastic difference, which is
             # its own feedback loop.
-            from distkeras_tpu.utils.compression import quantize_tree
+            from distkeras_tpu.utils.compression import (
+                quantize_tree,
+                topk_compress,
+            )
 
-            payload, deq = quantize_tree(jax.tree.map(np.asarray, elastic))
+            host = jax.tree.map(np.asarray, elastic)
+            if self._compress_kind == "topk":
+                payload, deq = topk_compress(host, self._compress_frac)
+            else:
+                payload, deq = quantize_tree(host)
             self._params = tree_sub(result["params"], deq)
             return payload, tag
         self._params = tree_sub(result["params"], elastic)
