@@ -146,13 +146,15 @@ def build_configs(platform):
         def make(scale):
             n = 8192 if scale == "full" else 2048
             # hardened r4 (VERDICT r3 weak #6): 4-prototype mixture per
-            # class + 10% resampled labels -> Bayes ceiling ~0.91, curve
-            # spread over ~7 epochs (r4 CPU calibration: single-trainer
-            # sgd hits .47/.57/.67/.77/.82/.89/.91) — the epochs-to-target
-            # axis discriminates instead of saturating at 1.0000
+            # class + 10% resampled labels -> Bayes ceiling ~0.91 — the
+            # epochs-to-target axis discriminates instead of saturating
+            # at 1.0000. SPATIAL patterns (like real MNIST, and like the
+            # CIFAR config): the iid-pixel variant is adversarial to
+            # conv weight sharing — the CNN config sat at chance for 6
+            # epochs on it while spatial tasks learn healthily
             ds = loaders.synthetic_mnist(
-                n=n, seed=0, flat=flat,
-                protos_per_class=4, label_noise=0.1, noise=1.5,
+                n=n, seed=0, flat=flat, spatial=True,
+                protos_per_class=4, label_noise=0.1, noise=1.2,
             )
             ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
             ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
@@ -243,10 +245,10 @@ def build_configs(platform):
                 num_epoch=1, label_col=lc, **common,
             ),
             # ceiling ~0.91 under the hardened generator (r4): targets sit
-            # a learnable margin below it; r4 CPU calibration reaches 0.80
-            # at epoch ~5 (smoke scale)
-            "target": {"smoke": 0.80, "full": 0.85},
-            "max_epochs": {"smoke": 8, "full": 10},
+            # a learnable margin below it; r4 CPU calibration on the
+            # spatial task (noise 1.2): .34/.32/.43/.74/.72/.80/.71/.84
+            "target": {"smoke": 0.78, "full": 0.82},
+            "max_epochs": {"smoke": 10, "full": 10},
         },
         {
             "id": 2,
