@@ -118,6 +118,19 @@ class Trainer:
         self.learning_rate = effective_learning_rate(worker_optimizer, learning_rate)
         self.worker_optimizer = worker_optimizer
         self.optimizer = get_optimizer(worker_optimizer, learning_rate)
+        # structural spec for the WorkerCore program cache, derived from the
+        # RAW constructor args: self.learning_rate is flattened to a
+        # schedule's step-0 float above, so keying on it would collide two
+        # different schedules (or a schedule with a constant) that share a
+        # step-0 value — schedules and custom optax objects bypass the
+        # cache instead. Subclasses that replace self.optimizer (EAMSGD)
+        # must update this spec to match what they install.
+        self._core_spec = (
+            (worker_optimizer, repr(learning_rate))
+            if isinstance(worker_optimizer, str)
+            and isinstance(learning_rate, (int, float, type(None)))
+            else None
+        )
         self.loss = loss
         self.metrics = tuple(metrics)
         self.features_col = features_col
@@ -154,10 +167,16 @@ class Trainer:
         self.metrics_logger = MetricsLogger(metrics_path) if metrics_path else None
 
     def _make_core(self, optimizer=None) -> WorkerCore:
-        return WorkerCore(
+        # _core_spec fingerprints the optimizer the programs will close
+        # over (set from raw ctor args in __init__; updated by subclasses
+        # that swap self.optimizer); an explicit optimizer override is
+        # never cached
+        spec = self._core_spec if optimizer is None else None
+        return WorkerCore.cached(
             self.model,
             optimizer or self.optimizer,
             self.loss,
+            optimizer_spec=spec,
             metrics=self.metrics,
             compute_dtype=self.compute_dtype,
             remat=self.remat,
@@ -2159,6 +2178,14 @@ class EAMSGD(AEASGD):
         self.optimizer = get_optimizer(
             "sgd", self.learning_rate, momentum=self.momentum, nesterov=True
         )
+        # the installed optimizer is no longer (worker_optimizer, lr): a
+        # spec that ignored the momentum/nesterov swap would collide with
+        # plain-SGD trainers in the core cache and silently trade
+        # optimizers (r5 review finding)
+        if self._core_spec is not None:
+            self._core_spec = (
+                "sgd-nesterov", repr(self.learning_rate), repr(self.momentum)
+            )
 
 
 class ADAG(AsynchronousDistributedTrainer):

@@ -33,6 +33,104 @@ from distkeras_tpu.ops.metrics import get_metric
 from distkeras_tpu.utils.compression import maybe_decode_pull
 from distkeras_tpu.utils.tree import host_copy, tree_scale, tree_sub
 
+
+def _window_unroll(model) -> bool:
+    """Whether this model's window scans should fully unroll.
+
+    XLA:CPU executes CONVOLUTION-bearing ``while``-loop bodies ~33x slower
+    than the identical ops compiled at top level (measured r5 on the
+    north-star CNN window, 1 core: scan 11.1 vs unrolled 373.1 samples/sec;
+    partial unroll keeps the loop and stays at ~10 — PERF.md r5). Dense
+    models show the OPPOSITE trade: the config-1 MLP measured ~2x FASTER
+    under the loop (1,226 vs 603 samples/sec) — so unroll only when a
+    Conv2D is actually in the stack. Windows are small by design (default
+    8 steps, the communication window), so full unroll costs bounded
+    compile time. TPU always keeps the real loop: XLA:TPU loop bodies run
+    at full speed, and unrolling would only bloat programs."""
+    try:
+        if jax.default_backend() != "cpu":
+            return False
+    except RuntimeError:  # backend not initialized yet: assume accelerator
+        return False
+    from distkeras_tpu.models.layers import Conv2D
+
+    # _walk_layers (not a local re-walk): attribute-held conv sublayers in
+    # composite layers must trigger the unroll too (r5 review finding)
+    return any(isinstance(layer, Conv2D) for layer in _walk_layers(model))
+
+
+# ---------------------------------------------------------------- core cache
+
+
+def _walk_layers(model):
+    """Every layer reachable from ``model`` — delegates to THE canonical
+    traversal (``models.sequential.walk_layers``, driven by the
+    ``Layer.sublayers()`` contract) rather than re-implementing one: a
+    second walker with its own reachability heuristic would silently
+    diverge on future composite layers (r5 review finding)."""
+    from distkeras_tpu.models.sequential import walk_layers
+
+    return walk_layers(getattr(model, "layers", None) or [])
+
+
+# Process-local, trace-affecting layer hooks that ``get_config`` cannot
+# see: ring/ulysses/flash attachment, the fused-layernorm kernel, and the
+# MoE expert mesh. A model carrying ANY of these must bypass the core
+# cache — and a cached donor that GROWS one must invalidate its entry —
+# or same-config trainers silently trade compiled programs across hook
+# states (r5 review findings, two rounds of them).
+_RUNTIME_HOOK_ATTRS = ("attention_fn", "norm_fn", "mesh")
+
+
+def _has_runtime_hooks(model) -> bool:
+    return any(
+        getattr(layer, attr, None) is not None
+        for layer in _walk_layers(model)
+        for attr in _RUNTIME_HOOK_ATTRS
+    )
+
+
+def _core_cache_key(model, optimizer_spec, loss, metrics, compute_dtype,
+                    remat, accum_steps, aux_loss_weight):
+    """Structural fingerprint of everything WorkerCore's compiled programs
+    depend on — or None when the core is not safely cacheable (custom optax
+    objects, callable losses/metrics, or models with runtime-attached
+    attention hooks, which ``get_config`` cannot see)."""
+    if optimizer_spec is None or not isinstance(loss, str):
+        return None
+    if not all(isinstance(m, str) for m in metrics):
+        return None
+    if getattr(model, "params", None) is None or not hasattr(model, "get_config"):
+        return None
+    if _has_runtime_hooks(model):
+        return None
+    import json
+
+    try:
+        cfg = json.dumps(model.get_config(), sort_keys=True, default=repr)
+    except (TypeError, ValueError):
+        return None
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        backend = "uninitialized"
+    return (
+        cfg,
+        tuple(getattr(model, "input_shape", None) or ()),
+        tuple(optimizer_spec),
+        loss,
+        tuple(metrics),
+        compute_dtype,
+        bool(remat),
+        int(accum_steps),
+        float(aux_loss_weight),
+        backend,
+    )
+
+
+_CORE_CACHE: dict = {}
+_CORE_CACHE_MAX = 32
+
 # ------------------------------------------------------------------ core step
 
 
@@ -68,6 +166,13 @@ class WorkerCore:
         # grad-accum semantics)
         self.accum_steps = int(accum_steps)
         self.aux_loss_weight = float(aux_loss_weight)
+
+        # platform/model-dependent window-scan unroll (see _window_unroll);
+        # decided once here, host-side, after the backend is pinned
+        unroll = _window_unroll(model)
+
+        def _wscan(f, init, xs):
+            return jax.lax.scan(f, init, xs, unroll=unroll or 1)
 
         model_apply = model.apply
         loss_fn = self.loss_fn
@@ -132,6 +237,11 @@ class WorkerCore:
                 return (state, gacc, lacc + loss), y_pred
 
             g0 = jax.tree.map(jnp.zeros_like, params)
+            # a REAL scan on purpose, never _wscan: unrolling here would
+            # multiply — window_steps x accum_steps inlined conv graphs in
+            # one CPU program (8 x 16 ResNet steps = hours of compile).
+            # CPU conv accum pays the while-loop cost; bounded compile
+            # beats the throughput win at this nesting (r5 review finding)
             (state, gacc, lsum), y_preds = jax.lax.scan(
                 micro, (state, g0, jnp.float32(0.0)),
                 {"x": xs_m, "y": ys_m, "r": subs},
@@ -154,7 +264,7 @@ class WorkerCore:
 
         def window(params, state, opt_state, rng, xs, ys):
             """Run a scan over W stacked minibatches; returns per-step metrics."""
-            (params, state, opt_state, rng), mets = jax.lax.scan(
+            (params, state, opt_state, rng), mets = _wscan(
                 train_step, (params, state, opt_state, rng), {"x": xs, "y": ys}
             )
             return params, state, opt_state, rng, mets
@@ -177,7 +287,7 @@ class WorkerCore:
                 }
                 return train_step(carry, batch)
 
-            (params, state, opt_state, rng), mets = jax.lax.scan(
+            (params, state, opt_state, rng), mets = _wscan(
                 step, (params, state, opt_state, rng), idx
             )
             return params, state, opt_state, rng, mets
@@ -198,7 +308,7 @@ class WorkerCore:
         def grad_window(params, state, opt_state, rng, xs, ys):
             """Like window, but also accumulates raw gradients (ADAG)."""
             acc0 = jax.tree.map(jnp.zeros_like, params)
-            (params, state, opt_state, rng, acc), mets = jax.lax.scan(
+            (params, state, opt_state, rng, acc), mets = _wscan(
                 grad_step, (params, state, opt_state, rng, acc0),
                 {"x": xs, "y": ys},
             )
@@ -218,7 +328,7 @@ class WorkerCore:
                 return grad_step(carry, batch)
 
             acc0 = jax.tree.map(jnp.zeros_like, params)
-            (params, state, opt_state, rng, acc), mets = jax.lax.scan(
+            (params, state, opt_state, rng, acc), mets = _wscan(
                 step, (params, state, opt_state, rng, acc0), idx
             )
             return params, state, opt_state, rng, acc, mets
@@ -246,6 +356,97 @@ class WorkerCore:
 
     def init_opt_state(self, params):
         return self.optimizer.init(params)
+
+    @classmethod
+    def cached(
+        cls,
+        model,
+        optimizer,
+        loss,
+        *,
+        optimizer_spec=None,
+        metrics=("accuracy",),
+        compute_dtype=None,
+        remat=False,
+        accum_steps=1,
+        aux_loss_weight=0.01,
+    ):
+        """A WorkerCore whose compiled programs are shared across every
+        same-structure construction in the process.
+
+        Constructing a trainer per round (the benchmark matrix's
+        epochs-to-target loop; any user retuning in a notebook) used to
+        re-trace and re-lower every window program each time — with the r5
+        CPU conv-unroll (``_window_unroll``) that cost ~90 s/round on the
+        1-core sandbox, dwarfing the actual training. Programs depend only
+        on the model's STRUCTURE (apply is pure in params), the optimizer
+        spec, loss/metrics names, and the dtype/remat/accum flags — the
+        cache key (``_core_cache_key``); anything it cannot fingerprint
+        (custom optax objects, callable losses, runtime-attached attention
+        hooks) constructs an uncached core exactly as before. The returned
+        core carries the CALLER's model object, so ``core.model.params``
+        starts (SingleTrainerWorker with ``initial=None``) see the fresh
+        weights, never a cache donor's."""
+        import os
+
+        key = (
+            None
+            if os.environ.get("DKT_DISABLE_CORE_CACHE")  # debug kill-switch
+            else _core_cache_key(
+                model, optimizer_spec, loss, metrics, compute_dtype, remat,
+                accum_steps, aux_loss_weight,
+            )
+        )
+        if key is None:
+            return cls(
+                model, optimizer, loss, metrics=metrics,
+                compute_dtype=compute_dtype, remat=remat,
+                accum_steps=accum_steps, aux_loss_weight=aux_loss_weight,
+            )
+        core = _CORE_CACHE.get(key)
+        if core is not None:
+            # the cached programs traced the donor model's apply; a runtime
+            # hook grown SINCE caching would poison future retraces for
+            # new shapes — drop the entry instead of trusting it
+            if _has_runtime_hooks(core.model):
+                del _CORE_CACHE[key]
+            else:
+                return core._rebound(model)
+        # build the programs around a params-stripped structural shell of
+        # the model (shared layer objects, no weight arrays): the closures
+        # capture the donor's bound ``apply``, so caching a core built on
+        # the caller's model would pin that model's full parameter arrays
+        # for the cache entry's lifetime (r5 review finding). ``apply``
+        # reads structure from ``self.layers`` and takes params explicitly,
+        # so the shell traces identically.
+        import copy
+
+        shell = copy.copy(model)
+        shell.params = None
+        shell.state = None
+        # model.predict() memoizes a jitted lambda that closes over the
+        # DONOR model — carried into the shell it would pin the donor's
+        # full parameter arrays, the exact leak the shell prevents
+        shell.__dict__.pop("_predict_fn", None)
+        core = cls(
+            shell, optimizer, loss, metrics=metrics,
+            compute_dtype=compute_dtype, remat=remat,
+            accum_steps=accum_steps, aux_loss_weight=aux_loss_weight,
+        )
+        if len(_CORE_CACHE) >= _CORE_CACHE_MAX:  # FIFO bound
+            _CORE_CACHE.pop(next(iter(_CORE_CACHE)))
+        _CORE_CACHE[key] = core
+        return core._rebound(model)
+
+    def _rebound(self, model):
+        """Shallow clone sharing the compiled programs, with ``model``
+        swapped to the caller's instance (same architecture by key
+        construction; ``apply`` is pure, so the traced programs transfer)."""
+        import copy
+
+        clone = copy.copy(self)
+        clone.model = model
+        return clone
 
 
 def _metrics_to_records(mets) -> list:

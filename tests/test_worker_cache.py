@@ -1,0 +1,211 @@
+"""WorkerCore program cache (r5): same-structure trainers share compiled
+window programs; anything the structural key cannot fingerprint bypasses.
+
+Motivation (PERF.md r5): the benchmark matrix's epochs-to-target loop
+constructs a fresh trainer per 1-epoch round; each construction re-traced
+and re-lowered every window program, which the CPU conv-unroll made ~90 s
+per round on the 1-core sandbox. Programs depend only on model STRUCTURE +
+optimizer spec + loss/metrics + flags, so they are shared process-wide.
+"""
+
+import jax
+import numpy as np
+import optax
+
+from distkeras_tpu import SingleTrainer
+from distkeras_tpu.data import loaders
+from distkeras_tpu.data.transformers import MinMaxTransformer, OneHotTransformer
+from distkeras_tpu.models import zoo
+from distkeras_tpu.workers import _CORE_CACHE
+
+
+def _small_ds(n=64):
+    ds = loaders.synthetic_mnist(n=n, seed=0)
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    return OneHotTransformer(10, output_col="label_onehot").transform(ds)
+
+
+def _trainer(model, lr=0.05):
+    return SingleTrainer(
+        model, "sgd", "categorical_crossentropy", learning_rate=lr,
+        batch_size=16, num_epoch=1, label_col="label_onehot", seed=0,
+    )
+
+
+def test_same_structure_shares_programs_but_not_model():
+    m1 = zoo.mnist_mlp(hidden=16, seed=0)
+    m2 = zoo.mnist_mlp(hidden=16, seed=1)  # same structure, different init
+    c1 = _trainer(m1)._make_core()
+    c2 = _trainer(m2)._make_core()
+    assert c1.window is c2.window  # shared compiled program
+    assert c1.model is m1 and c2.model is m2  # caller's weights, not donor's
+    diff = sum(
+        float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+        for a, b in zip(
+            jax.tree.leaves(c1.model.params), jax.tree.leaves(c2.model.params)
+        )
+    )
+    assert diff > 0  # different seeds -> different weights survived rebind
+
+
+def test_different_spec_gets_different_programs():
+    m1 = zoo.mnist_mlp(hidden=16, seed=0)
+    m2 = zoo.mnist_mlp(hidden=16, seed=0)
+    m3 = zoo.mnist_mlp(hidden=24, seed=0)
+    base = _trainer(m1)._make_core()
+    assert _trainer(m2, lr=0.01)._make_core().window is not base.window
+    assert _trainer(m3)._make_core().window is not base.window
+
+
+def test_custom_optax_object_bypasses_cache():
+    m1 = zoo.mnist_mlp(hidden=16, seed=0)
+    m2 = zoo.mnist_mlp(hidden=16, seed=0)
+    c1 = _trainer(m1)._make_core()
+    t = SingleTrainer(
+        m2, optax.sgd(0.05), "categorical_crossentropy",
+        batch_size=16, num_epoch=1, label_col="label_onehot", seed=0,
+    )
+    assert t._make_core().window is not c1.window
+
+
+def test_cached_core_trains_from_fresh_params():
+    """Round-style reuse: train once, rebuild a trainer on the RETURNED
+    model — the cached core must continue from the trained weights (the
+    r5 staleness hazard the rebound-model design exists to prevent)."""
+    ds = _small_ds()
+    m = zoo.mnist_mlp(hidden=16, seed=0)
+    trained1 = _trainer(m).train(ds)
+    w1 = trained1.get_weights()
+    trained2 = _trainer(trained1).train(ds)  # cache hit; must start from w1
+    w2 = trained2.get_weights()
+    assert any(
+        not np.allclose(a, b) for a, b in zip(w1, w2)
+    ), "second round did not train"
+    # a fresh same-seed model through the same two rounds lands the same
+    # trajectory — i.e. round 2 really started from round 1's weights
+    mb = zoo.mnist_mlp(hidden=16, seed=0)
+    ref = _trainer(_trainer(mb).train(ds)).train(ds)
+    for a, b in zip(w2, ref.get_weights()):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_eamsgd_momentum_optimizer_never_collides_with_plain_sgd():
+    """EAMSGD swaps self.optimizer for Nesterov-momentum SGD AFTER the
+    base ctor; a cache key built only from (worker_optimizer, lr) would
+    hand its windows plain SGD — or hand plain-SGD trainers momentum
+    (r5 review finding)."""
+    from distkeras_tpu import EAMSGD
+
+    plain = _trainer(zoo.mnist_mlp(hidden=16, seed=0), lr=0.02)._make_core()
+    e = EAMSGD(
+        zoo.mnist_mlp(hidden=16, seed=0), "sgd", "categorical_crossentropy",
+        learning_rate=0.02, batch_size=16, num_epoch=1, num_workers=2,
+        label_col="label_onehot", seed=0,
+    )
+    ecore = e._make_core()
+    assert ecore.window is not plain.window
+    assert ecore.optimizer is e.optimizer  # the momentum one, not plain
+
+
+def test_lr_schedule_bypasses_cache():
+    """self.learning_rate flattens a schedule to its step-0 float; keying
+    the cache on it would collide two different schedules (or a schedule
+    with a constant) that share a step-0 value (r5 review finding)."""
+    sched = optax.linear_schedule(0.05, 0.001, 100)
+    m1 = zoo.mnist_mlp(hidden=16, seed=0)
+    m2 = zoo.mnist_mlp(hidden=16, seed=0)
+    c_const = _trainer(m1, lr=0.05)._make_core()
+    t_sched = SingleTrainer(
+        m2, "sgd", "categorical_crossentropy", learning_rate=sched,
+        batch_size=16, num_epoch=1, label_col="label_onehot", seed=0,
+    )
+    # step-0 flattening (to f32), the trap: the float alone cannot
+    # distinguish this schedule from the 0.05 constant
+    assert abs(t_sched.learning_rate - 0.05) < 1e-6
+    assert t_sched._make_core().window is not c_const.window
+
+
+def test_attached_attention_bypasses_cache():
+    from distkeras_tpu.parallel.ring_attention import attach_blockwise_attention
+
+    def make():
+        return zoo.transformer_classifier(
+            vocab_size=8, seq_len=16, d_model=16, num_heads=2, depth=1, seed=0
+        )
+
+    plain = make()
+    c_plain = _trainer(plain)._make_core()
+    hooked = make()
+    assert attach_blockwise_attention(hooked, block_size=8) == 1
+    c_hooked = _trainer(hooked)._make_core()
+    assert c_hooked.window is not c_plain.window
+
+
+def test_fused_layernorm_hook_bypasses_cache():
+    """norm_fn is as trace-affecting and config-invisible as attention_fn
+    (r5 review finding: the bypass must cover ALL runtime hooks)."""
+    from distkeras_tpu.ops.fused_layernorm import attach_fused_layernorm
+
+    def make():
+        return zoo.transformer_classifier(
+            vocab_size=8, seq_len=16, d_model=16, num_heads=2, depth=1, seed=0
+        )
+
+    c_plain = _trainer(make(), lr=0.027)._make_core()
+    hooked = make()
+    assert attach_fused_layernorm(hooked) > 0
+    assert _trainer(hooked, lr=0.027)._make_core().window is not c_plain.window
+
+
+def test_expert_mesh_hook_bypasses_cache():
+    from jax.sharding import Mesh
+
+    from distkeras_tpu.parallel.expert_parallel import attach_expert_mesh
+
+    def make():
+        return zoo.moe_transformer_classifier(
+            vocab_size=8, seq_len=16, d_model=16, num_heads=2, depth=1,
+            num_experts=2, seed=0,
+        )
+
+    c_plain = _trainer(make(), lr=0.029)._make_core()
+    hooked = make()
+    mesh = Mesh(np.array(jax.devices()[:2]), ("expert",))
+    assert attach_expert_mesh(hooked, mesh) > 0
+    assert _trainer(hooked, lr=0.029)._make_core().window is not c_plain.window
+
+
+def test_shell_entry_does_not_pin_donor_params():
+    """The cache entry must hold a params-stripped shell, and predict()'s
+    memoized jitted lambda must not ride the shell back to the donor
+    (r5 review finding: _predict_fn closes over the donor model)."""
+    ds = _small_ds(n=32)
+    m = zoo.mnist_mlp(hidden=16, seed=0)
+    feats = np.asarray(ds["features"][:4], dtype=np.float32)
+    m.predict(feats)  # memoizes _predict_fn on the model
+    c = _trainer(m, lr=0.033)._make_core()
+    entry = next(
+        core for core in _CORE_CACHE.values() if core.window is c.window
+    )
+    assert entry.model.params is None and entry.model.state is None
+    assert "_predict_fn" not in entry.model.__dict__
+
+
+def test_donor_mutation_drops_cache_entry():
+    """Attaching a hook to the DONOR model after caching must invalidate
+    the entry: later same-config constructions rebuild instead of trusting
+    programs whose future retraces would see the hooked apply."""
+    from distkeras_tpu.parallel.ring_attention import attach_blockwise_attention
+
+    def make():
+        return zoo.transformer_classifier(
+            vocab_size=8, seq_len=16, d_model=16, num_heads=2, depth=1, seed=3
+        )
+
+    donor = make()
+    c1 = _trainer(donor, lr=0.031)._make_core()  # unique spec => fresh entry
+    # the entry is the params-stripped shell core sharing c1's programs
+    assert any(core.window is c1.window for core in _CORE_CACHE.values())
+    attach_blockwise_attention(donor, block_size=8)
+    c2 = _trainer(make(), lr=0.031)._make_core()
+    assert c2.window is not c1.window
