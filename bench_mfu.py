@@ -263,7 +263,10 @@ def main() -> None:
     if args.attention == "best":
         # winner by MFU (falls back to tflops when no published peak)
         def score(r):
-            return r["value"] if r["value"] is not None else r["tflops_per_sec"]
+            # .get: never KeyError mid-sweep on a record shape drift — an
+            # unknown TPU generation must still finish the A/B (ADVICE r4 #1)
+            v = r.get("value")
+            return v if v is not None else r["tflops_per_sec"]
 
         record = None
         for attn in ("dense", "flash"):

@@ -21,10 +21,16 @@ accuracy axis is measured against real-world data.
 
 Writes BENCHMARKS.json and BENCHMARKS.md at the repo root:
 
-    python benchmarks.py [--configs 1,2,3,4,5,6] [--scale smoke|full] [--cpu]
+    python benchmarks.py [--configs 1,2,3,4,5,6,7] [--scale smoke|full]
+                         [--cpu] [--all]
 
-Backend selection mirrors bench.py: probe out-of-process, fall back to an
-8-virtual-device CPU mesh when no accelerator answers.
+By default only configs WITHOUT a current-calibration row for this
+(platform, device, scale) are measured — a calibration edit re-measures
+exactly the rows it invalidated (VERDICT r4 weak #5: a full CPU refresh
+burns hours on this 1-core sandbox). ``--all`` (or an explicit --configs
+list) forces re-measurement. Backend selection mirrors bench.py: probe
+out-of-process, fall back to an 8-virtual-device CPU mesh when no
+accelerator answers.
 """
 
 from __future__ import annotations
@@ -52,23 +58,27 @@ def resolve_platform(force_cpu: bool) -> str:
     return platform
 
 
-def steady_samples_per_sec(history) -> float:
+def steady_samples_per_sec(history):
     """Aggregate steady-state throughput: per worker, drop the first window
     (it carries the XLA compile) and sum samples/seconds; workers run
     concurrently, so their rates add. Datasets so small that a worker's
     epoch fits in ONE window (config 7's 569 real rows) would measure 0
-    after the drop — fall back to the all-windows rate there (marked by
-    the caller's row being dominated by compile, which the per-epoch
-    loop's later rounds amortize)."""
+    after the drop — fall back to the all-windows rate there. Returns
+    ``(samples_per_sec, compile_in_window)``: the flag is True when any
+    worker took the fallback, so the caller can mark the row as including
+    compile time instead of silently contradicting the steady-state
+    methodology (ADVICE r4 #2)."""
     total = 0.0
+    fallback = False
     for wid in sorted(history._windows):
         timings = history._windows[wid][1:]
         if not timings:
             timings = history._windows[wid]
+            fallback = True
         secs = sum(dt for _, dt in timings)
         if secs > 0:
             total += sum(s for s, _ in timings) / secs
-    return total
+    return total, fallback
 
 
 def run_config(cfg, scale, platform):
@@ -111,8 +121,8 @@ def run_config(cfg, scale, platform):
             break
 
     n_chips = len(jax.devices()) if platform != "cpu" else 1
-    best_sps = max(sps_rounds)
-    return {
+    best_sps, compile_in_window = max(sps_rounds, key=lambda t: t[0])
+    row = {
         "config": cfg["id"],
         "name": cfg["name"],
         "trainer": cfg["trainer_name"],
@@ -126,100 +136,24 @@ def run_config(cfg, scale, platform):
         "seconds_total": round(elapsed, 1),
         "curve": curve,
     }
+    if compile_in_window:
+        row["compile_in_window"] = True
+    return row
 
 
-def build_configs(platform):
-    from distkeras_tpu import (
-        ADAG,
-        AEASGD,
-        DOWNPOUR,
-        DynSGD,
-        LabelIndexTransformer,
-        MinMaxTransformer,
-        OneHotTransformer,
-        SingleTrainer,
-    )
-    from distkeras_tpu.data import loaders
-    from distkeras_tpu.models import zoo
+# ---------------------------------------------------------------------------
+# Config definitions. ONE FUNCTION PER CONFIG: each config's calibration
+# stamp hashes its own builder's source (plus its data helper and the
+# loader/zoo functions it calls), so retuning one config invalidates only
+# that config's rows — r4's single build_configs() hashed its whole source
+# into every stamp, and a one-line target tweak silently deleted every TPU
+# row in the matrix (VERDICT r4 weak #2 / task 8).
+# ---------------------------------------------------------------------------
 
-    def mnist_data(flat):
-        def make(scale):
-            n = 8192 if scale == "full" else 2048
-            # hardened r4 (VERDICT r3 weak #6): 4-prototype mixture per
-            # class + 10% resampled labels -> Bayes ceiling ~0.91 — the
-            # epochs-to-target axis discriminates instead of saturating
-            # at 1.0000. SPATIAL patterns (like real MNIST, and like the
-            # CIFAR config): the iid-pixel variant is adversarial to
-            # conv weight sharing — the CNN config sat at chance for 6
-            # epochs on it while spatial tasks learn healthily
-            ds = loaders.synthetic_mnist(
-                n=n, seed=0, flat=flat, spatial=True,
-                protos_per_class=4, label_noise=0.1, noise=1.2,
-            )
-            ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
-            ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
-            train, test = ds.split(0.9, seed=7)
-            return train, test, "label_onehot", []
 
-        return make
-
-    def higgs_data(scale):
-        n = 16384 if scale == "full" else 4096
-        ds = loaders.synthetic_higgs(n=n, seed=1)
-        ds = OneHotTransformer(2, output_col="label_onehot").transform(ds)
-        train, test = ds.split(0.9, seed=7)
-        return train, test, "label_onehot", []
-
-    def cifar_data(scale):
-        n = 8192 if scale == "full" else 2048
-        # hardened r4: 3-pattern mixture + 10% label noise (see mnist_data)
-        ds = loaders.synthetic_cifar10(
-            n=n, seed=2, protos_per_class=3, label_noise=0.1,
-        )
-        ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
-        ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
-        train, test = ds.split(0.9, seed=7)
-        return train, test, "label_onehot", []
-
-    def digits_data(scale):
-        ds = loaders.digits()
-        ds = MinMaxTransformer(0, 1, o_min=0, o_max=16).transform(ds)
-        ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
-        train, test = ds.split(0.85, seed=7)
-        return train, test, "label_onehot", []
-
-    def breast_cancer_data(scale):
-        from distkeras_tpu import StandardScaleTransformer
-
-        # REAL tabular data at both scales (569 rows are what they are).
-        # Split BEFORE fitting the scaler: held-out statistics must not
-        # shape the normalization the accuracy axis is judged under.
-        train, test = loaders.breast_cancer().split(0.85, seed=7)
-        scaler = StandardScaleTransformer().fit(train)
-        onehot = OneHotTransformer(2, output_col="label_onehot")
-        train = onehot.transform(scaler.transform(train))
-        test = onehot.transform(scaler.transform(test))
-        return train, test, "label_onehot", []
-
-    def imagenet_data(scale):
-        from distkeras_tpu import LabelIndexTransformer
-
-        n = 4096 if scale == "full" else 768
-        # smoke keeps the model/image shape but 10 classes: 768 rows over
-        # 100 classes is ~7 samples/class — data-starved regardless of
-        # trainer (r2 calibration: acc plateaued at ~2x chance)
-        classes = 100 if scale == "full" else 10
-        size = 64
-        # 10% label noise for the <1.0 ceiling (VERDICT r3 task 4); the
-        # class count already keeps this config data-starved at smoke
-        ds = loaders.synthetic_imagenet(
-            n=n, num_classes=classes, size=size, seed=3, label_noise=0.1,
-        )
-        ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
-        ds = OneHotTransformer(classes, output_col="label_onehot").transform(ds)
-        train, test = ds.split(0.9, seed=7)
-        return train, test, "label_onehot", [LabelIndexTransformer(classes)]
-
+def _shared(platform):
+    """Knobs every config shares; hashed into every stamp (editing them
+    genuinely recalibrates the whole matrix)."""
     common = dict(loss="categorical_crossentropy", seed=0)
     # simulated mode: the deterministic seeded interleaving of worker
     # begins/finishes. Thread mode's staleness profile depends on host core
@@ -231,213 +165,333 @@ def build_configs(platform):
     # bf16 is the TPU compute dtype; XLA CPU emulates it slowly, so the CPU
     # fallback measures in f32
     dtype = None if platform == "cpu" else "bfloat16"
-
-    return [
-        {
-            "id": 1,
-            "name": "SingleTrainer / MNIST MLP",
-            "trainer_name": "SingleTrainer",
-            "model_name": "mnist_mlp",
-            "data": mnist_data(flat=True),
-            "model": lambda scale: zoo.mnist_mlp(seed=0),
-            "trainer": lambda m, scale, lc: SingleTrainer(
-                m, "sgd", learning_rate=0.05, batch_size=64,
-                num_epoch=1, label_col=lc, **common,
-            ),
-            # ceiling ~0.91 under the hardened generator (r4): targets sit
-            # a learnable margin below it; r4 CPU calibration on the
-            # spatial task (noise 1.2): .34/.32/.43/.74/.72/.80/.71/.84
-            "target": {"smoke": 0.78, "full": 0.82},
-            "max_epochs": {"smoke": 10, "full": 10},
-        },
-        {
-            "id": 2,
-            "name": "DOWNPOUR / MNIST CNN / 8 workers",
-            "trainer_name": "DOWNPOUR",
-            "model_name": "mnist_cnn",
-            "data": mnist_data(flat=False),
-            "model": lambda scale: zoo.mnist_cnn(seed=0),
-            # 8 workers' window deltas sum at the PS -> local adam lr
-            # scaled down from 1e-3 (r2: full lr oscillates). r4: the
-            # hardened mixture task needs more signal than the r2 easy
-            # task — lr/8 (1.25e-4) sat at chance for 6 of 8 epochs
-            # (0.29 @ epoch 8, still rising); 2.5e-4 = lr/4 is the
-            # recalibrated point
-            "trainer": lambda m, scale, lc: DOWNPOUR(
-                m, "adam", learning_rate=2.5e-4, batch_size=32, num_epoch=1,
-                num_workers=8, label_col=lc,
-                compute_dtype=dtype, **dist,
-            ),
-            # hardened-generator ceiling ~0.91; async learns slower than
-            # the single trainer, so the target sits lower still
-            "target": {"smoke": 0.75, "full": 0.80},
-            "max_epochs": {"smoke": 12, "full": 12},
-        },
-        {
-            "id": 3,
-            "name": "AEASGD / ATLAS-Higgs MLP",
-            "trainer_name": "AEASGD",
-            "model_name": "higgs_mlp",
-            "data": higgs_data,
-            "model": lambda scale: zoo.higgs_mlp(seed=0),
-            "trainer": lambda m, scale, lc: AEASGD(
-                m, "sgd", learning_rate=0.02, rho=10.0, batch_size=64,
-                num_epoch=1, num_workers=4, label_col=lc, **dist,
-            ),
-            "target": {"smoke": 0.85, "full": 0.85},
-            "max_epochs": {"smoke": 6, "full": 12},
-        },
-        {
-            "id": 4,
-            "name": "ADAG / CIFAR-10 CNN",
-            "trainer_name": "ADAG",
-            "model_name": "cifar10_cnn",
-            "data": cifar_data,
-            # bn_momentum 0.9: smoke epochs are ~57 steps; the 0.99 default
-            # leaves eval-mode BN stats stale for hundreds of steps, so
-            # held-out accuracy lags training by epochs (r2 calibration)
-            "model": lambda scale: zoo.cifar10_cnn(seed=0, bn_momentum=0.9),
-            # sgd lr 0.05: the ADAG convergence calibration from
-            # tests/test_trainers_async.py (async + adam is fragile — the
-            # adaptive step does not shrink near the optimum)
-            "trainer": lambda m, scale, lc: ADAG(
-                m, "sgd", learning_rate=0.05, batch_size=32, num_epoch=1,
-                num_workers=4, label_col=lc,
-                compute_dtype=dtype, **dist,
-            ),
-            # hardened-generator ceiling ~0.91 (3-pattern mixture + 10%
-            # label noise)
-            "target": {"smoke": 0.70, "full": 0.78},
-            "max_epochs": {"smoke": 8, "full": 10},
-        },
-        {
-            "id": 5,
-            "name": "DynSGD / ResNet-18 / ImageNet-shaped",
-            "trainer_name": "DynSGD",
-            "model_name": "resnet18",
-            "data": imagenet_data,
-            "model": lambda scale: zoo.resnet18(
-                num_classes=100 if scale == "full" else 10,
-                input_shape=(64, 64, 3), seed=0,
-                bn_momentum=0.9,
-            ),
-            # adam lr 1e-3 (r2 calibration): a from-scratch ResNet needs
-            # adam here — plain sgd at 0.02/0.1 left it at a constant
-            # prediction, while single-trainer adam hits 1.0 by epoch 2.
-            # No lr/num_workers division: DynSGD's 1/(staleness+1) scaling
-            # already divides the summed deltas by ~num_workers under the
-            # round-robin schedule.
-            "trainer": lambda m, scale, lc: DynSGD(
-                m, "adam", learning_rate=1e-3, batch_size=32, num_epoch=1,
-                num_workers=4, label_col=lc,
-                compute_dtype=dtype, **dist,
-            ),
-            # 10% label noise caps the ceiling ~0.90; smoke stays
-            # data-starved (768 rows / 10 classes) so the bar is low
-            "target": {"smoke": 0.45, "full": 0.60},
-            "max_epochs": {"smoke": 8, "full": 8},
-        },
-        {
-            "id": 6,
-            "name": "SingleTrainer / REAL digits (in-repo CSV)",
-            "trainer_name": "SingleTrainer",
-            "model_name": "digits_mlp",
-            # REAL data (VERDICT r2 missing #1): 1,797 8x8 handwritten
-            # digits shipped in-repo, parsed through load_csv + the native
-            # C++ reader — the one matrix row whose accuracy axis is
-            # measured against data the builder did not design. Same rows
-            # at both scales (the set is what it is).
-            "data": digits_data,
-            "model": lambda scale: zoo.digits_mlp(seed=0),
-            "trainer": lambda m, scale, lc: SingleTrainer(
-                m, "adam", learning_rate=1e-3, batch_size=32,
-                num_epoch=1, label_col=lc, **common,
-            ),
-            "target": {"smoke": 0.93, "full": 0.95},
-            "max_epochs": {"smoke": 15, "full": 30},
-        },
-        {
-            "id": 7,
-            "name": "AEASGD / REAL breast-cancer (in-repo CSV)",
-            "trainer_name": "AEASGD",
-            "model_name": "higgs_mlp",
-            # REAL tabular data (VERDICT r3 missing #1): the 569-row
-            # Wisconsin diagnostic set shipped in-repo — the real
-            # counterpart of config 3's ATLAS-Higgs-shaped task (30
-            # features, binary target, reference: examples/workflow.ipynb)
-            # giving the async-PS family a row measured against data the
-            # builder did not design. Ceiling ~0.97 (real-data Bayes
-            # floor); r4 CPU calibration (leak-free scaler): .884/.942.
-            "data": breast_cancer_data,
-            "model": lambda scale: zoo.higgs_mlp(seed=0),
-            "trainer": lambda m, scale, lc: AEASGD(
-                m, "sgd", learning_rate=0.02, rho=10.0, batch_size=32,
-                num_epoch=1, num_workers=4, label_col=lc, **dist,
-            ),
-            "target": {"smoke": 0.93, "full": 0.93},
-            "max_epochs": {"smoke": 8, "full": 8},
-        },
-    ]
+    return common, dist, dtype
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7")
-    ap.add_argument("--scale", choices=["smoke", "full"], default="smoke")
-    ap.add_argument("--cpu", action="store_true")
-    ap.add_argument(
-        "--cpu-full", action="store_true",
-        help="allow --scale full on the CPU fallback (VERDICT r3 weak #6: "
-        "an unintended full-scale CPU pass burned 73 min on one config; "
-        "full scale on CPU must be asked for, not stumbled into)",
+def _mnist_data(scale, flat):
+    from distkeras_tpu import MinMaxTransformer, OneHotTransformer
+    from distkeras_tpu.data import loaders
+
+    n = 8192 if scale == "full" else 2048
+    # hardened r4 (VERDICT r3 weak #6): 4-prototype mixture per class + 10%
+    # resampled labels -> Bayes ceiling ~0.91 — the epochs-to-target axis
+    # discriminates instead of saturating at 1.0000. SPATIAL patterns (like
+    # real MNIST, and like the CIFAR config): the iid-pixel variant is
+    # adversarial to conv weight sharing — the CNN config sat at chance for
+    # 6 epochs on it while spatial tasks learn healthily
+    ds = loaders.synthetic_mnist(
+        n=n, seed=0, flat=flat, spatial=True,
+        protos_per_class=4, label_noise=0.1, noise=1.2,
     )
-    ap.add_argument("--out", default=".")
-    args = ap.parse_args()
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    train, test = ds.split(0.9, seed=7)
+    return train, test, "label_onehot", []
 
-    platform = resolve_platform(args.cpu)
-    if platform == "cpu" and args.scale == "full" and not args.cpu_full:
-        print("scale 'full' on the CPU fallback downgraded to 'smoke' "
-              "(pass --cpu-full to force; see --help)")
-        args.scale = "smoke"
-    import jax
 
-    device_kind = jax.devices()[0].device_kind
-    print(f"platform: {platform} ({device_kind}), scale: {args.scale}")
+def _higgs_data(scale):
+    from distkeras_tpu import OneHotTransformer
+    from distkeras_tpu.data import loaders
 
-    want = {int(c) for c in args.configs.split(",")}
-    rows = []
-    for cfg in build_configs(platform):
-        if cfg["id"] not in want:
-            continue
-        try:
-            rows.append(run_config(cfg, args.scale, platform))
-        except Exception as exc:  # one bad config must not lose the others
-            print(f"   config {cfg['id']} FAILED: {exc}", flush=True)
-            rows.append(
-                {
-                    "config": cfg["id"],
-                    "name": cfg["name"],
-                    "error": f"{type(exc).__name__}: {exc}",
-                }
-            )
-        # write after every config: a killed/timed-out run keeps its rows
-        write_outputs(rows, platform, device_kind, args.scale, args.out)
-    if rows:
-        print("wrote BENCHMARKS.json / BENCHMARKS.md")
-    else:
-        print(f"no configs matched {sorted(want)}; nothing written")
+    n = 16384 if scale == "full" else 4096
+    ds = loaders.synthetic_higgs(n=n, seed=1)
+    ds = OneHotTransformer(2, output_col="label_onehot").transform(ds)
+    train, test = ds.split(0.9, seed=7)
+    return train, test, "label_onehot", []
+
+
+def _cifar_data(scale):
+    from distkeras_tpu import MinMaxTransformer, OneHotTransformer
+    from distkeras_tpu.data import loaders
+
+    n = 8192 if scale == "full" else 2048
+    # hardened r4: 3-pattern mixture + 10% label noise (see _mnist_data)
+    ds = loaders.synthetic_cifar10(
+        n=n, seed=2, protos_per_class=3, label_noise=0.1,
+    )
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    train, test = ds.split(0.9, seed=7)
+    return train, test, "label_onehot", []
+
+
+def _digits_data(scale):
+    from distkeras_tpu import MinMaxTransformer, OneHotTransformer
+    from distkeras_tpu.data import loaders
+
+    ds = loaders.digits()
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=16).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    train, test = ds.split(0.85, seed=7)
+    return train, test, "label_onehot", []
+
+
+def _breast_cancer_data(scale):
+    from distkeras_tpu import OneHotTransformer, StandardScaleTransformer
+    from distkeras_tpu.data import loaders
+
+    # REAL tabular data at both scales (569 rows are what they are).
+    # Split BEFORE fitting the scaler: held-out statistics must not
+    # shape the normalization the accuracy axis is judged under.
+    train, test = loaders.breast_cancer().split(0.85, seed=7)
+    scaler = StandardScaleTransformer().fit(train)
+    onehot = OneHotTransformer(2, output_col="label_onehot")
+    train = onehot.transform(scaler.transform(train))
+    test = onehot.transform(scaler.transform(test))
+    return train, test, "label_onehot", []
+
+
+def _imagenet_data(scale):
+    from distkeras_tpu import (
+        LabelIndexTransformer,
+        MinMaxTransformer,
+        OneHotTransformer,
+    )
+    from distkeras_tpu.data import loaders
+
+    n = 4096 if scale == "full" else 768
+    # smoke keeps the model/image shape but 10 classes: 768 rows over
+    # 100 classes is ~7 samples/class — data-starved regardless of
+    # trainer (r2 calibration: acc plateaued at ~2x chance)
+    classes = 100 if scale == "full" else 10
+    size = 64
+    # 10% label noise for the <1.0 ceiling (VERDICT r3 task 4); the
+    # class count already keeps this config data-starved at smoke
+    ds = loaders.synthetic_imagenet(
+        n=n, num_classes=classes, size=size, seed=3, label_noise=0.1,
+    )
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=255).transform(ds)
+    ds = OneHotTransformer(classes, output_col="label_onehot").transform(ds)
+    train, test = ds.split(0.9, seed=7)
+    return train, test, "label_onehot", [LabelIndexTransformer(classes)]
+
+
+def _cfg1(platform):
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.models import zoo
+
+    common, _, _ = _shared(platform)
+    return {
+        "id": 1,
+        "name": "SingleTrainer / MNIST MLP",
+        "trainer_name": "SingleTrainer",
+        "model_name": "mnist_mlp",
+        "data": lambda scale: _mnist_data(scale, flat=True),
+        "model": lambda scale: zoo.mnist_mlp(seed=0),
+        "trainer": lambda m, scale, lc: SingleTrainer(
+            m, "sgd", learning_rate=0.05, batch_size=64,
+            num_epoch=1, label_col=lc, **common,
+        ),
+        # ceiling ~0.91 under the hardened generator (r4): targets sit
+        # a learnable margin below it; r4 CPU calibration on the
+        # spatial task (noise 1.2): .34/.32/.43/.74/.72/.80/.71/.84
+        "target": {"smoke": 0.78, "full": 0.82},
+        "max_epochs": {"smoke": 10, "full": 10},
+    }
+
+
+def _cfg2(platform):
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu.models import zoo
+
+    _, dist, dtype = _shared(platform)
+    return {
+        "id": 2,
+        "name": "DOWNPOUR / MNIST CNN / 8 workers",
+        "trainer_name": "DOWNPOUR",
+        "model_name": "mnist_cnn",
+        "data": lambda scale: _mnist_data(scale, flat=False),
+        # full-width CNN at BOTH scales: the r5 window-unroll fix
+        # (workers._window_unroll — XLA:CPU ran conv windows inside while
+        # loops ~33x slow) brought the full model's epoch from ~240 s back
+        # under ~10 s on this sandbox, so the smoke row measures the REAL
+        # BASELINE model again (r5 interim used width 0.5 to fit the
+        # budget; zoo.mnist_cnn keeps the knob)
+        "model": lambda scale: zoo.mnist_cnn(seed=0),
+        # 8 workers' window deltas sum at the PS -> local adam lr
+        # scaled down from 1e-3 (r2: full lr oscillates). r4: the
+        # hardened mixture task needs more signal than the r2 easy
+        # task — lr/8 (1.25e-4) sat at chance for 6 of 8 epochs
+        # (0.29 @ epoch 8, still rising); 2.5e-4 = lr/4 is the
+        # recalibrated point
+        "trainer": lambda m, scale, lc: DOWNPOUR(
+            m, "adam", learning_rate=2.5e-4, batch_size=32, num_epoch=1,
+            num_workers=8, label_col=lc,
+            compute_dtype=dtype, **dist,
+        ),
+        # hardened-generator ceiling ~0.91; async learns slower than
+        # the single trainer, so the target sits lower still (r4
+        # full-width calibration: hit .756 at epoch 6)
+        "target": {"smoke": 0.75, "full": 0.80},
+        "max_epochs": {"smoke": 12, "full": 12},
+    }
+
+
+def _cfg3(platform):
+    from distkeras_tpu import AEASGD
+    from distkeras_tpu.models import zoo
+
+    _, dist, _ = _shared(platform)
+    return {
+        "id": 3,
+        "name": "AEASGD / ATLAS-Higgs MLP",
+        "trainer_name": "AEASGD",
+        "model_name": "higgs_mlp",
+        "data": _higgs_data,
+        "model": lambda scale: zoo.higgs_mlp(seed=0),
+        "trainer": lambda m, scale, lc: AEASGD(
+            m, "sgd", learning_rate=0.02, rho=10.0, batch_size=64,
+            num_epoch=1, num_workers=4, label_col=lc, **dist,
+        ),
+        "target": {"smoke": 0.85, "full": 0.85},
+        "max_epochs": {"smoke": 6, "full": 12},
+    }
+
+
+def _cfg4(platform):
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.models import zoo
+
+    _, dist, dtype = _shared(platform)
+    return {
+        "id": 4,
+        "name": "ADAG / CIFAR-10 CNN",
+        "trainer_name": "ADAG",
+        "model_name": "cifar10_cnn",
+        "data": _cifar_data,
+        # bn_momentum 0.9: smoke epochs are ~57 steps; the 0.99 default
+        # leaves eval-mode BN stats stale for hundreds of steps, so
+        # held-out accuracy lags training by epochs (r2 calibration).
+        # Full width at both scales since the r5 window-unroll fix — see
+        # _cfg2 (r4's 1,700 s/epoch was the XLA:CPU while-loop pathology)
+        "model": lambda scale: zoo.cifar10_cnn(seed=0, bn_momentum=0.9),
+        # sgd lr 0.05: the ADAG convergence calibration from
+        # tests/test_trainers_async.py (async + adam is fragile — the
+        # adaptive step does not shrink near the optimum)
+        "trainer": lambda m, scale, lc: ADAG(
+            m, "sgd", learning_rate=0.05, batch_size=32, num_epoch=1,
+            num_workers=4, label_col=lc,
+            compute_dtype=dtype, **dist,
+        ),
+        # hardened-generator ceiling ~0.91 (3-pattern mixture + 10%
+        # label noise); r4 full-width calibration hit .70 at epoch 3
+        "target": {"smoke": 0.70, "full": 0.78},
+        "max_epochs": {"smoke": 8, "full": 10},
+    }
+
+
+def _cfg5(platform):
+    from distkeras_tpu import DynSGD
+    from distkeras_tpu.models import zoo
+
+    _, dist, dtype = _shared(platform)
+    return {
+        "id": 5,
+        "name": "DynSGD / ResNet-18 / ImageNet-shaped",
+        "trainer_name": "DynSGD",
+        "model_name": "resnet18",
+        "data": _imagenet_data,
+        # adam lr 1e-3 (r2 calibration): a from-scratch ResNet needs
+        # adam here — plain sgd at 0.02/0.1 left it at a constant
+        # prediction, while single-trainer adam hits 1.0 by epoch 2.
+        # No lr/num_workers division: DynSGD's 1/(staleness+1) scaling
+        # already divides the summed deltas by ~num_workers under the
+        # round-robin schedule.
+        # Full width at both scales since the r5 window-unroll fix — see
+        # _cfg2 (r4's 430 s/epoch was the XLA:CPU while-loop pathology)
+        "model": lambda scale: zoo.resnet18(
+            num_classes=100 if scale == "full" else 10,
+            input_shape=(64, 64, 3), seed=0,
+            bn_momentum=0.9,
+        ),
+        "trainer": lambda m, scale, lc: DynSGD(
+            m, "adam", learning_rate=1e-3, batch_size=32, num_epoch=1,
+            num_workers=4, label_col=lc,
+            compute_dtype=dtype, **dist,
+        ),
+        # 10% label noise caps the ceiling ~0.90; smoke stays
+        # data-starved (768 rows / 10 classes) so the bar is low
+        "target": {"smoke": 0.45, "full": 0.60},
+        "max_epochs": {"smoke": 8, "full": 8},
+    }
+
+
+def _cfg6(platform):
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.models import zoo
+
+    common, _, _ = _shared(platform)
+    return {
+        "id": 6,
+        "name": "SingleTrainer / REAL digits (in-repo CSV)",
+        "trainer_name": "SingleTrainer",
+        "model_name": "digits_mlp",
+        # REAL data (VERDICT r2 missing #1): 1,797 8x8 handwritten
+        # digits shipped in-repo, parsed through load_csv + the native
+        # C++ reader — the one matrix row whose accuracy axis is
+        # measured against data the builder did not design. Same rows
+        # at both scales (the set is what it is).
+        "data": _digits_data,
+        "model": lambda scale: zoo.digits_mlp(seed=0),
+        "trainer": lambda m, scale, lc: SingleTrainer(
+            m, "adam", learning_rate=1e-3, batch_size=32,
+            num_epoch=1, label_col=lc, **common,
+        ),
+        "target": {"smoke": 0.93, "full": 0.95},
+        "max_epochs": {"smoke": 15, "full": 30},
+    }
+
+
+def _cfg7(platform):
+    from distkeras_tpu import AEASGD
+    from distkeras_tpu.models import zoo
+
+    _, dist, _ = _shared(platform)
+    return {
+        "id": 7,
+        "name": "AEASGD / REAL breast-cancer (in-repo CSV)",
+        "trainer_name": "AEASGD",
+        "model_name": "higgs_mlp",
+        # REAL tabular data (VERDICT r3 missing #1): the 569-row
+        # Wisconsin diagnostic set shipped in-repo — the real
+        # counterpart of config 3's ATLAS-Higgs-shaped task (30
+        # features, binary target, reference: examples/workflow.ipynb)
+        # giving the async-PS family a row measured against data the
+        # builder did not design. Ceiling ~0.97 (real-data Bayes
+        # floor); r4 CPU calibration (leak-free scaler): .884/.942.
+        "data": _breast_cancer_data,
+        "model": lambda scale: zoo.higgs_mlp(seed=0),
+        "trainer": lambda m, scale, lc: AEASGD(
+            m, "sgd", learning_rate=0.02, rho=10.0, batch_size=32,
+            num_epoch=1, num_workers=4, label_col=lc, **dist,
+        ),
+        # 0.87 sits at/below the WEAKER of the two committed calibration
+        # runs (.884/.942) — the r4 target of 0.93 was above one of them,
+        # i.e. seed-sensitive (ADVICE r4 #5)
+        "target": {"smoke": 0.87, "full": 0.87},
+        "max_epochs": {"smoke": 8, "full": 8},
+    }
+
+
+_CONFIG_BUILDERS = {
+    1: _cfg1, 2: _cfg2, 3: _cfg3, 4: _cfg4, 5: _cfg5, 6: _cfg6, 7: _cfg7,
+}
+
+
+def build_configs(platform):
+    return [_CONFIG_BUILDERS[i](platform) for i in sorted(_CONFIG_BUILDERS)]
 
 
 def config_stamp(cfg_id: int) -> str:
-    """PER-CONFIG calibration fingerprint: the source of ``build_configs``
-    (trainer classes, lrs, batch sizes, targets) plus the specific loader
-    and model-zoo functions THAT config calls (and, for the real-data
-    config, the shipped csv bytes). Rows carry their config's stamp so a
-    partial rerun after a calibration change cannot silently merge with
-    rows measured under the old definitions (ADVICE r2 #2) — while edits
-    scoped to one config (regenerating digits.csv, retuning one model)
-    invalidate only that config's rows, never TPU measurements of the
-    others that a CPU box cannot re-produce. Memoized: stamps cannot
+    """PER-CONFIG calibration fingerprint: the source of THAT config's
+    builder function, the shared-knob helper, the config's data helper, and
+    the specific loader and model-zoo functions it calls (and, for the
+    real-data configs, the shipped csv bytes). Rows carry their config's
+    stamp so a partial rerun after a calibration change cannot silently
+    merge with rows measured under the old definitions (ADVICE r2 #2) —
+    while edits scoped to one config (regenerating digits.csv, retuning one
+    model) invalidate only that config's rows, never TPU measurements of
+    the others that a CPU box cannot re-produce. Memoized: stamps cannot
     change mid-run."""
     import hashlib
     import inspect
@@ -453,21 +507,28 @@ def config_stamp(cfg_id: int) -> str:
             loaders._apply_label_noise,
         )
         sources = {
-            1: synth + (loaders.synthetic_mnist, zoo.mnist_mlp),
-            2: synth + (loaders.synthetic_mnist, zoo.mnist_cnn),
-            3: synth + (loaders.synthetic_higgs, zoo.higgs_mlp),
-            4: synth + (loaders.synthetic_cifar10, zoo.cifar10_cnn),
-            5: synth
-            + (loaders.synthetic_imagenet, zoo._basic_block, zoo.resnet18),
-            6: (loaders.digits, loaders.load_csv, zoo.digits_mlp),
-            7: (loaders.breast_cancer, loaders.load_csv, zoo.higgs_mlp),
+            1: (_cfg1, _mnist_data) + synth
+            + (loaders.synthetic_mnist, zoo.mnist_mlp),
+            2: (_cfg2, _mnist_data) + synth
+            + (loaders.synthetic_mnist, zoo._scaled, zoo.mnist_cnn),
+            3: (_cfg3, _higgs_data) + synth
+            + (loaders.synthetic_higgs, zoo.higgs_mlp),
+            4: (_cfg4, _cifar_data) + synth
+            + (loaders.synthetic_cifar10, zoo._scaled, zoo.cifar10_cnn),
+            5: (_cfg5, _imagenet_data) + synth
+            + (loaders.synthetic_imagenet, zoo._scaled, zoo._basic_block,
+               zoo.resnet18),
+            6: (_cfg6, _digits_data, loaders.digits, loaders.load_csv,
+                zoo.digits_mlp),
+            7: (_cfg7, _breast_cancer_data, loaders.breast_cancer,
+                loaders.load_csv, zoo.higgs_mlp),
         }
         data_dir = os.path.dirname(os.path.abspath(loaders.__file__))
         # the real configs' accuracy axes are DEFINED by the shipped
         # dataset bytes, not just the loader code
         real_csvs = {6: "digits.csv", 7: "breast_cancer.csv"}
         for cid, fns in sources.items():
-            h = hashlib.sha256(inspect.getsource(build_configs).encode())
+            h = hashlib.sha256(inspect.getsource(_shared).encode())
             for fn in fns:
                 h.update(inspect.getsource(fn).encode())
             if cid in real_csvs:
@@ -477,8 +538,15 @@ def config_stamp(cfg_id: int) -> str:
                 except OSError:
                     h.update(real_csvs[cid].encode() + b"-missing")
             _CONFIG_STAMPS[cid] = h.hexdigest()[:12]
-    # unknown config id (older/newer file formats): never matches
-    return _CONFIG_STAMPS.get(int(cfg_id), "unknown-config")
+    # unknown/garbage config id (older/newer/hand-edited file formats):
+    # never matches, never raises — one malformed row aborting the load
+    # loop would silently delete every section after it, including the
+    # chip evidence this machinery exists to preserve (r5 review finding)
+    try:
+        cid = int(cfg_id)
+    except (TypeError, ValueError):
+        return "unknown-config"
+    return _CONFIG_STAMPS.get(cid, "unknown-config")
 
 
 _CONFIG_STAMPS = {}
@@ -503,6 +571,164 @@ def _merge_rows(fresh_rows, prior_rows):
     )
 
 
+def load_prior_runs(path):
+    """Read BENCHMARKS.json and re-validate every row against the CURRENT
+    calibration stamps. Rows that still match stay in ``results``. CHIP rows
+    that no longer match move to the section's ``stale_results`` instead of
+    dropping — a calibration bump on a CPU-only sandbox must never delete
+    the matrix's only TPU evidence (VERDICT r4 weak #2: r3's four chip rows
+    vanished this way); they are retained, clearly labelled, until a fresh
+    on-chip measurement of the same config supersedes them. Stale CPU rows
+    still drop (this box can always re-measure them)."""
+    runs = []
+    dropped = 0
+    if not os.path.exists(path):
+        return runs, dropped
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+        if "runs" in prior:
+            cand = list(prior["runs"])
+        elif "results" in prior:  # one-run layout, the stamp's debut
+            cand = [prior]
+        else:
+            cand = []
+        # keep only well-formed sections (a malformed entry must degrade to
+        # "overwrite", not crash the benchmark run)
+        for sec in cand:
+            if not (
+                isinstance(sec, dict)
+                and all(
+                    k in sec
+                    for k in ("platform", "device_kind", "scale", "results")
+                )
+            ):
+                continue
+            is_chip = sec["platform"] != "cpu"
+            # a stale row is only worth retaining if it can still render in
+            # the evidence table — a hand-edited/truncated dict must not
+            # crash every later run's render_md
+            renderable = lambda r: isinstance(r, dict) and all(
+                k in r
+                for k in (
+                    "config", "name", "samples_per_sec_per_chip",
+                    "target_accuracy", "epochs_to_target",
+                    "final_accuracy", "seconds_total",
+                )
+            )
+            kept, stale = [], []
+            for r in sec["results"]:
+                if not isinstance(r, dict):
+                    continue
+                if r.get("stamp") == config_stamp(r.get("config", -1)):
+                    kept.append(r)
+                elif is_chip and "error" not in r and renderable(r):
+                    stale.append(dict(r, stale_calibration=True))
+                else:
+                    dropped += 1
+            if is_chip:
+                for r in sec.get("stale_results", []):
+                    if renderable(r):
+                        stale.append(dict(r, stale_calibration=True))
+            # a config measured under the current calibration no longer
+            # needs its stale copy; dedupe stale copies per config (newest
+            # first: fresh-section rows precede carried-over ones). Error
+            # rows are NOT measurements — they must never evict the
+            # last-known chip evidence they failed to replace
+            fresh_ids = {
+                r.get("config") for r in kept if "error" not in r
+            }
+            seen, deduped = set(), []
+            for r in stale:
+                cid = r.get("config")
+                if cid in fresh_ids or cid in seen:
+                    continue
+                seen.add(cid)
+                deduped.append(r)
+            if kept or deduped:
+                sec_out = {
+                    "platform": sec["platform"],
+                    "device_kind": sec["device_kind"],
+                    "scale": sec["scale"],
+                    "results": kept,
+                }
+                if deduped:
+                    sec_out["stale_results"] = deduped
+                runs.append(sec_out)
+    except (json.JSONDecodeError, KeyError, TypeError, AttributeError):
+        pass  # unreadable prior file: overwrite it
+    return runs, dropped
+
+
+def render_md(runs, out):
+    lines = [
+        "# BASELINE benchmark matrix",
+        "",
+        "Configs 1-5 run hardened synthetic stand-ins — prototype "
+        "mixtures + 10% resampled labels give a Bayes ceiling < 1.0, so "
+        "the accuracy axis cannot saturate (BASELINE.md: `published: {}` "
+        "— no upstream numbers exist); configs 6 and 7 run REAL in-repo "
+        "CSVs (1,797-row digits, 569-row breast-cancer). Both BASELINE "
+        "metric axes per config. "
+        "samples/sec/chip is steady-state (compile window excluded); "
+        "rows marked `*` had an epoch fit inside one timing window, so "
+        "their rate could not exclude compile. "
+        "Rows carry per-config calibration stamps; CPU rows from older "
+        "calibrations are dropped automatically, while chip rows are "
+        "retained in a labelled stale section until re-captured. "
+        "Reproduce: `python benchmarks.py` (changed rows only; `--all` "
+        "for a full refresh).",
+    ]
+
+    def table(rows):
+        t = [
+            "| # | config | samples/sec/chip | target acc | epochs to target "
+            "| final acc | total s |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for r in rows:
+            if "error" in r:
+                t.append(
+                    f"| {r['config']} | {r['name']} | error: {r['error']} "
+                    "| | | | |"
+                )
+                continue
+            ett = r["epochs_to_target"] if r["epochs_to_target"] else "not reached"
+            star = " \\*" if r.get("compile_in_window") else ""
+            t.append(
+                f"| {r['config']} | {r['name']} "
+                f"| {r['samples_per_sec_per_chip']}{star} "
+                f"| {r['target_accuracy']} | {ett} | {r['final_accuracy']:.4f} "
+                f"| {r['seconds_total']} |"
+            )
+        return t
+
+    for run in runs:
+        lines += [
+            "",
+            f"## Platform `{run['platform']}` ({run['device_kind']}), "
+            f"scale `{run['scale']}`",
+            "",
+        ]
+        if run["results"]:
+            lines += table(run["results"])
+        if run.get("stale_results"):
+            lines += [
+                "",
+                "### Stale calibration — retained as last-known chip evidence",
+                "",
+                "These rows were measured under an earlier calibration "
+                "stamp; the current calibration has no on-chip replacement "
+                "yet (captures queue in `tools/tpu_capture.sh` and land on "
+                "the next healthy tunnel window). They are NOT comparable "
+                "to current-calibration rows and are kept so the matrix "
+                "never presents zero chip evidence.",
+                "",
+            ] + table(run["stale_results"])
+    with open(os.path.join(out, "BENCHMARKS.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
 def write_outputs(rows, platform, device_kind, scale, out):
     """Persist the matrix. BENCHMARKS.json holds one run section per
     (platform, scale) — a TPU harvest lands NEXT TO the CPU regression rows
@@ -510,59 +736,17 @@ def write_outputs(rows, platform, device_kind, scale, out):
     matrix). Within a section, a partial rerun (--configs 2) refreshes its
     rows without clobbering the others; a calibration change invalidates
     exactly the affected config's prior rows (per-row config stamps,
-    ADVICE r2 #2)."""
+    ADVICE r2 #2), with chip rows retained as labelled stale evidence
+    (VERDICT r4 task 2)."""
     for r in rows:
         r.setdefault("stamp", config_stamp(r["config"]))
     path = os.path.join(out, "BENCHMARKS.json")
-    runs = []
-    if os.path.exists(path):
-        try:
-            with open(path) as f:
-                prior = json.load(f)
-            if "runs" in prior:
-                cand = list(prior["runs"])
-            elif "results" in prior:  # one-run layout, the stamp's debut
-                cand = [prior]
-            else:
-                cand = []
-            # keep only well-formed sections (a malformed entry must
-            # degrade to "overwrite", not crash the benchmark run), and
-            # within each, only rows whose per-config stamp still matches
-            # the current calibration — stampless or mismatched rows are
-            # untrustworthy and drop; rows of OTHER configs survive
-            dropped = 0
-            for sec in cand:
-                if not (
-                    isinstance(sec, dict)
-                    and all(
-                        k in sec
-                        for k in ("platform", "device_kind", "scale", "results")
-                    )
-                ):
-                    continue
-                kept = [
-                    r
-                    for r in sec["results"]
-                    if isinstance(r, dict)
-                    and r.get("stamp") == config_stamp(r.get("config", -1))
-                ]
-                dropped += len(sec["results"]) - len(kept)
-                if kept:
-                    runs.append(
-                        {
-                            "platform": sec["platform"],
-                            "device_kind": sec["device_kind"],
-                            "scale": sec["scale"],
-                            "results": kept,
-                        }
-                    )
-            if dropped:
-                print(
-                    f"dropped {dropped} prior BENCHMARKS row(s) whose "
-                    "config stamp no longer matches the current calibration"
-                )
-        except (json.JSONDecodeError, KeyError, TypeError, AttributeError):
-            pass  # unreadable prior file: overwrite it
+    runs, dropped = load_prior_runs(path)
+    if dropped:
+        print(
+            f"dropped {dropped} prior BENCHMARKS row(s) whose "
+            "config stamp no longer matches the current calibration"
+        )
     mine = {
         "platform": platform,
         "device_kind": device_kind,
@@ -577,6 +761,16 @@ def write_outputs(rows, platform, device_kind, scale, out):
             and run["scale"] == scale
         ):
             mine["results"] = _merge_rows(rows, run["results"])
+            fresh_ids = {
+                r["config"] for r in mine["results"] if "error" not in r
+            }
+            carried = [
+                r
+                for r in run.get("stale_results", [])
+                if r.get("config") not in fresh_ids
+            ]
+            if carried:
+                mine["stale_results"] = carried
             runs[i] = mine
             merged = True
             break
@@ -587,46 +781,99 @@ def write_outputs(rows, platform, device_kind, scale, out):
     os.makedirs(out, exist_ok=True)
     with open(os.path.join(out, "BENCHMARKS.json"), "w") as f:
         json.dump({"runs": runs}, f, indent=2)
+    render_md(runs, out)
 
-    lines = [
-        "# BASELINE benchmark matrix",
-        "",
-        "Configs 1-5 run hardened synthetic stand-ins — prototype "
-        "mixtures + 10% resampled labels give a Bayes ceiling < 1.0, so "
-        "the accuracy axis cannot saturate (BASELINE.md: `published: {}` "
-        "— no upstream numbers exist); configs 6 and 7 run REAL in-repo "
-        "CSVs (1,797-row digits, 569-row breast-cancer). Both BASELINE "
-        "metric axes per config. "
-        "samples/sec/chip is steady-state (compile window excluded). "
-        "Rows carry per-config calibration stamps; rows from older "
-        "calibrations are dropped automatically. "
-        "Reproduce: `python benchmarks.py`.",
-    ]
+
+def _current_configs(path, platform, device_kind, scale):
+    """Config ids that already have a good, current-calibration row for this
+    (platform, device, scale) section — the rows a default run may skip."""
+    runs, _ = load_prior_runs(path)
     for run in runs:
-        lines += [
-            "",
-            f"## Platform `{run['platform']}` ({run['device_kind']}), "
-            f"scale `{run['scale']}`",
-            "",
-            "| # | config | samples/sec/chip | target acc | epochs to target "
-            "| final acc | total s |",
-            "|---|---|---|---|---|---|---|",
-        ]
-        for r in run["results"]:
-            if "error" in r:
-                lines.append(
-                    f"| {r['config']} | {r['name']} | error: {r['error']} "
-                    "| | | | |"
-                )
-                continue
-            ett = r["epochs_to_target"] if r["epochs_to_target"] else "not reached"
-            lines.append(
-                f"| {r['config']} | {r['name']} | {r['samples_per_sec_per_chip']} "
-                f"| {r['target_accuracy']} | {ett} | {r['final_accuracy']:.4f} "
-                f"| {r['seconds_total']} |"
+        if (
+            run["platform"] == platform
+            and run["device_kind"] == device_kind
+            and run["scale"] == scale
+        ):
+            return {
+                r["config"] for r in run["results"] if "error" not in r
+            }
+    return set()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    # None sentinel (not a default string): an EXPLICIT --configs list —
+    # even the full "1,2,3,4,5,6,7" — must force re-measurement
+    ap.add_argument("--configs", default=None)
+    ap.add_argument("--scale", choices=["smoke", "full"], default="smoke")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument(
+        "--all", action="store_true",
+        help="re-measure configs whose rows already match the current "
+        "calibration (default: skip them — a matrix refresh after a "
+        "one-config retune must not re-burn hours on the others; "
+        "VERDICT r4 weak #5)",
+    )
+    ap.add_argument(
+        "--cpu-full", action="store_true",
+        help="allow --scale full on the CPU fallback (VERDICT r3 weak #6: "
+        "an unintended full-scale CPU pass burned 73 min on one config; "
+        "full scale on CPU must be asked for, not stumbled into)",
+    )
+    ap.add_argument("--out", default=".")
+    args = ap.parse_args()
+
+    platform = resolve_platform(args.cpu)
+    if platform == "cpu" and args.scale == "full" and not args.cpu_full:
+        print("scale 'full' on the CPU fallback downgraded to 'smoke' "
+              "(pass --cpu-full to force; see --help)")
+        args.scale = "smoke"
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    print(f"platform: {platform} ({device_kind}), scale: {args.scale}")
+
+    explicit = args.configs is not None
+    want = {
+        int(c)
+        for c in (args.configs or "1,2,3,4,5,6,7").split(",")
+    }
+    if not args.all and not explicit:
+        have = _current_configs(
+            os.path.join(args.out, "BENCHMARKS.json"),
+            platform, device_kind, args.scale,
+        )
+        skip = want & have
+        if skip:
+            print(
+                f"skipping configs {sorted(skip)}: rows already current "
+                "(--all or an explicit --configs list re-measures)"
             )
-    with open(os.path.join(out, "BENCHMARKS.md"), "w") as f:
-        f.write("\n".join(lines) + "\n")
+        want -= skip
+    rows = []
+    for cfg in build_configs(platform):
+        if cfg["id"] not in want:
+            continue
+        try:
+            rows.append(run_config(cfg, args.scale, platform))
+        except Exception as exc:  # one bad config must not lose the others
+            print(f"   config {cfg['id']} FAILED: {exc}", flush=True)
+            rows.append(
+                {
+                    "config": cfg["id"],
+                    "name": cfg["name"],
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+            )
+        # write after every config: a killed/timed-out run keeps its rows
+        write_outputs(rows, platform, device_kind, args.scale, args.out)
+    if rows:
+        print("wrote BENCHMARKS.json / BENCHMARKS.md")
+    elif not want and explicit is False and not args.all:
+        print("all requested configs already have current rows; "
+              "nothing re-measured (--all forces)")
+    else:
+        print(f"no configs matched {sorted(want)}; nothing written")
 
 
 if __name__ == "__main__":

@@ -25,6 +25,12 @@ from distkeras_tpu.models.layers import (
 from distkeras_tpu.models.sequential import Residual, Sequential
 
 
+def _scaled(channels: int, width: float) -> int:
+    """Channel count under a width multiplier, floored at 8 so narrow smoke
+    variants keep every layer trainable (and TPU-lane friendly)."""
+    return max(8, int(channels * width))
+
+
 def mnist_mlp(hidden=500, num_classes=10, seed=0):
     """MLP over flattened 28x28 inputs (input shape (784,))."""
     return Sequential(
@@ -36,18 +42,23 @@ def mnist_mlp(hidden=500, num_classes=10, seed=0):
     ).build((784,), seed=seed)
 
 
-def mnist_cnn(num_classes=10, seed=0):
-    """Small convnet over (28, 28, 1) images — the north-star bench model."""
+def mnist_cnn(num_classes=10, seed=0, width=1.0):
+    """Small convnet over (28, 28, 1) images — the north-star bench model.
+
+    ``width``: channel multiplier (conv FLOPs scale ~width^2). The benchmark
+    matrix's smoke scale passes <1.0 so a 1-core CPU sandbox can afford the
+    epochs-to-target axis; chip captures and the full scale keep 1.0."""
+    w = lambda c: _scaled(c, width)
     return Sequential(
         [
-            Conv2D(32, 3, activation="relu", padding="SAME"),
-            Conv2D(32, 3, activation="relu", padding="SAME"),
+            Conv2D(w(32), 3, activation="relu", padding="SAME"),
+            Conv2D(w(32), 3, activation="relu", padding="SAME"),
             MaxPool2D(2),
-            Conv2D(64, 3, activation="relu", padding="SAME"),
-            Conv2D(64, 3, activation="relu", padding="SAME"),
+            Conv2D(w(64), 3, activation="relu", padding="SAME"),
+            Conv2D(w(64), 3, activation="relu", padding="SAME"),
             MaxPool2D(2),
             Flatten(),
-            Dense(256, activation="relu"),
+            Dense(w(256), activation="relu"),
             Dropout(0.5),
             Dense(num_classes, activation="softmax"),
         ]
@@ -97,31 +108,33 @@ def higgs_mlp(num_features=30, hidden=600, num_classes=2, seed=0):
     ).build((num_features,), seed=seed)
 
 
-def cifar10_cnn(num_classes=10, seed=0, bn_momentum=0.99):
+def cifar10_cnn(num_classes=10, seed=0, bn_momentum=0.99, width=1.0):
     """VGG-ish convnet over (32, 32, 3).
 
     ``bn_momentum``: BatchNorm moving-stats momentum. The 0.99 default needs
     hundreds of steps before eval-mode stats track the batch stats; short
-    runs (benchmark smoke epochs) should pass ~0.9."""
+    runs (benchmark smoke epochs) should pass ~0.9.
+    ``width``: channel multiplier — see :func:`mnist_cnn`."""
     bn = lambda: BatchNorm(momentum=bn_momentum)
+    w = lambda c: _scaled(c, width)
     return Sequential(
         [
-            Conv2D(64, 3, padding="SAME", use_bias=False),
+            Conv2D(w(64), 3, padding="SAME", use_bias=False),
             bn(),
             Activation("relu"),
-            Conv2D(64, 3, padding="SAME", use_bias=False),
+            Conv2D(w(64), 3, padding="SAME", use_bias=False),
             bn(),
             Activation("relu"),
             MaxPool2D(2),
-            Conv2D(128, 3, padding="SAME", use_bias=False),
+            Conv2D(w(128), 3, padding="SAME", use_bias=False),
             bn(),
             Activation("relu"),
-            Conv2D(128, 3, padding="SAME", use_bias=False),
+            Conv2D(w(128), 3, padding="SAME", use_bias=False),
             bn(),
             Activation("relu"),
             MaxPool2D(2),
             Flatten(),
-            Dense(256, activation="relu"),
+            Dense(w(256), activation="relu"),
             Dropout(0.5),
             Dense(num_classes, activation="softmax"),
         ]
@@ -308,17 +321,21 @@ def _basic_block(filters, stride=1, downsample=False, bn_momentum=0.99):
 
 def resnet18(
     num_classes=1000, input_shape=(224, 224, 3), small_stem=False, seed=0,
-    bn_momentum=0.99,
+    bn_momentum=0.99, width=1.0,
 ):
     """ResNet-18 (NHWC). ``small_stem=True`` swaps the 7x7/s2+maxpool stem for
     a 3x3/s1 stem, the standard CIFAR-scale variant used in smoke tests.
-    ``bn_momentum``: see :func:`cifar10_cnn`."""
+    ``bn_momentum``: see :func:`cifar10_cnn`.
+    ``width``: filter multiplier over the whole trunk (same 18-layer
+    topology); see :func:`mnist_cnn` for why the benchmark smoke scale
+    shrinks it."""
     bn = lambda: BatchNorm(momentum=bn_momentum)
+    w = lambda c: _scaled(c, width)
     stem = (
-        [Conv2D(64, 3, strides=1, padding="SAME", use_bias=False), bn(), Activation("relu")]
+        [Conv2D(w(64), 3, strides=1, padding="SAME", use_bias=False), bn(), Activation("relu")]
         if small_stem
         else [
-            Conv2D(64, 7, strides=2, padding="SAME", use_bias=False),
+            Conv2D(w(64), 7, strides=2, padding="SAME", use_bias=False),
             bn(),
             Activation("relu"),
             MaxPool2D(3, strides=2, padding="SAME"),
@@ -326,14 +343,14 @@ def resnet18(
     )
     blk = lambda *a, **kw: _basic_block(*a, bn_momentum=bn_momentum, **kw)
     body = [
-        blk(64),
-        blk(64),
-        blk(128, stride=2, downsample=True),
-        blk(128),
-        blk(256, stride=2, downsample=True),
-        blk(256),
-        blk(512, stride=2, downsample=True),
-        blk(512),
+        blk(w(64)),
+        blk(w(64)),
+        blk(w(128), stride=2, downsample=True),
+        blk(w(128)),
+        blk(w(256), stride=2, downsample=True),
+        blk(w(256)),
+        blk(w(512), stride=2, downsample=True),
+        blk(w(512)),
     ]
     head = [GlobalAvgPool2D(), Dense(num_classes, activation="softmax")]
     return Sequential(stem + body + head).build(input_shape, seed=seed)
