@@ -141,6 +141,20 @@ def test_attached_attention_bypasses_cache():
     assert c_hooked.window is not c_plain.window
 
 
+def test_window_unroll_decision():
+    """CPU backend: conv models unroll (33x while-loop pathology), dense
+    models keep the loop (measured ~2x faster there) — PERF.md r5."""
+    from distkeras_tpu.workers import _window_unroll
+
+    assert _window_unroll(zoo.mnist_cnn(seed=0)) is True
+    assert _window_unroll(zoo.resnet18(
+        num_classes=10, input_shape=(32, 32, 3), seed=0)) is True  # nested convs
+    assert _window_unroll(zoo.mnist_mlp(hidden=16, seed=0)) is False
+    assert _window_unroll(zoo.transformer_classifier(
+        vocab_size=8, seq_len=16, d_model=16, num_heads=2, depth=1, seed=0
+    )) is False
+
+
 def test_fused_layernorm_hook_bypasses_cache():
     """norm_fn is as trace-affecting and config-invisible as attention_fn
     (r5 review finding: the bypass must cover ALL runtime hooks)."""
