@@ -28,7 +28,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="default sweep only: drop the block-size variants "
-                    "(no effect with --long/--scale)")
+                    "(no effect with --long/--scale/--best/--retire)")
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument(
         "--long", action="store_true",
@@ -39,6 +39,14 @@ def main() -> None:
         "--scale", action="store_true",
         help="MXU scaling rows instead: d_model 1024 and batch 128 — "
         "how MFU moves when the matmuls widen / batch fills the array",
+    )
+    mode.add_argument(
+        "--best", action="store_true",
+        help="frontier rows with the measured-winning bundle (r5 "
+        "on-chip adjudication: flash wins everywhere, pallas_adam wins "
+        "at d1024, fused_ln retired): flash+pallas_adam at d1024 batch "
+        "64/128, and a seq-4096 A/B (8 K/V blocks/program — twice the "
+        "multi-block depth of --long)",
     )
     mode.add_argument(
         "--retire", action="store_true",
@@ -95,6 +103,18 @@ def main() -> None:
             ("dense d1024 L4", dict(wide)),
             ("flash d1024 L4", {"attention": "flash", **wide}),
             ("flash batch128", {"attention": "flash", "batch": 128}),
+        ]
+    elif args.best:
+        bundle = {"attention": "flash", "opt_name": "pallas_adam"}
+        configs = [
+            ("best bundle d1024", {"d_model": 1024, "depth": 4, **bundle}),
+            ("best bundle d1024 batch128",
+             {"d_model": 1024, "depth": 4, "batch": 128, **bundle}),
+            # seq-4096: dense materializes (B,H,4096,4096) scores in HBM;
+            # flash streams 8 K/V blocks through VMEM per program
+            ("dense seq4096", {"seq": 4096, "depth": 4, "batch": 4}),
+            ("flash seq4096",
+             {"attention": "flash", "seq": 4096, "depth": 4, "batch": 4}),
         ]
     elif args.retire:
         wide = {"d_model": 1024, "depth": 4}

@@ -25,6 +25,14 @@ date >> "$LOG"
 
 . tools/git_snap.sh
 
+# --- 0. frontier rows with the r5-adjudicated winning bundle -------------
+# (flash + pallas_adam at d1024, batch-128 variant, seq-4096 8-block A/B;
+#  first in the queue because everything below already has a committed
+#  2026-08-01 row — a short second window should buy NEW evidence first)
+timeout 1200 python tools/mfu_attrib.py --best >> "$LOG" 2>>"$LOG.err"
+commit_snap "Harvest TPU window: winning-bundle frontier rows (d1024, seq4096)" \
+  MFU_ATTRIB.jsonl "$LOG"
+
 # --- 1. transformer MFU: dense-vs-flash A/B, winner is the headline ------
 timeout 1800 python bench_mfu.py --attention best 2>>"$LOG.err" | tail -3 >> "$LOG"
 if grep -q '"platform": "tpu"' BENCH_MFU.json 2>/dev/null; then

@@ -29,7 +29,19 @@ while true; do
       TPU_CAPTURE.log BENCHMARKS.json BENCHMARKS.md \
       "$LOG" >> "$LOG" 2>&1
     echo "$(date -u +%FT%TZ) capture cycle done" >> "$LOG"
-    sleep 120
+    # If the tunnel is still healthy, the cycle genuinely harvested —
+    # hold 30 min before re-sweeping (a re-sweep 2 min later buys
+    # near-zero new evidence and churns the history). If the tunnel is
+    # DOWN, the cycle died partway (error rows, still-queued items):
+    # fall through to the normal 4-min probe cadence so the next
+    # healthy window is not lost to the hold.
+    if timeout -k 10 75 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
+      echo "$(date -u +%FT%TZ) window still healthy post-cycle - holding 30m" >> "$LOG"
+      sleep 1800
+    else
+      echo "$(date -u +%FT%TZ) tunnel died during cycle - resuming probe cadence" >> "$LOG"
+      sleep 240
+    fi
   else
     echo "$(date -u +%FT%TZ) tunnel down" >> "$LOG"
     sleep 240
