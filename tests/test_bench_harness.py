@@ -1,0 +1,114 @@
+"""Measurement-harness pins (no TPU needed).
+
+The on-chip sweeps are unsupervised — they run inside a short, rare tunnel
+window from `tools/tpu_watcher.sh` with nobody watching. A kwarg drifting
+out of `bench_mfu.measure`'s signature or a render regression must be
+caught HERE, on CPU, not discovered as a dead capture cycle after the
+window closed (the r4 `--attention best` KeyError, ADVICE r4 #1, is the
+cautionary tale).
+"""
+
+import inspect
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import benchmarks  # noqa: E402
+import bench_mfu  # noqa: E402
+import mfu_attrib  # noqa: E402
+
+
+MODES = {
+    "default": {},
+    "quick": {"quick": True},
+    "long": {"long": True},
+    "scale": {"scale": True},
+    "best": {"best": True},
+    "retire": {"retire": True},
+}
+
+
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_mode_configs_match_measure_signature(mode):
+    accepted = set(inspect.signature(bench_mfu.measure).parameters) - {
+        "platform"
+    }
+    configs = mfu_attrib.mode_configs(**MODES[mode])
+    assert configs, mode
+    labels = [label for label, _ in configs]
+    assert len(labels) == len(set(labels)), f"duplicate labels in {mode}"
+    for label, kw in configs:
+        extra = set(kw) - accepted
+        assert not extra, f"{mode}/{label}: measure() has no kwargs {extra}"
+
+
+def test_best_mode_is_an_ab():
+    """--best must keep a dense comparator next to the flash seq-4096 row —
+    a lone flash number cannot claim a win."""
+    labels = {label for label, _ in mfu_attrib.mode_configs(best=True)}
+    assert "dense seq4096" in labels and "flash seq4096" in labels
+
+
+def test_north_star_cite_reads_artifact(tmp_path):
+    rec = {"value": 123456.7, "unit": "samples/sec/chip", "batch": 2048}
+    (tmp_path / "BENCH_TPU.json").write_text(json.dumps(rec))
+    cite = benchmarks._north_star_cite(str(tmp_path))
+    assert "123,457" in cite and "samples/sec/chip" in cite
+
+
+def test_north_star_cite_survives_missing_artifact(tmp_path):
+    cite = benchmarks._north_star_cite(str(tmp_path))
+    assert "BENCH_TPU.json" in cite  # still cites the artifact by name
+    (tmp_path / "BENCH_TPU.json").write_text("not json {")
+    assert "BENCH_TPU.json" in benchmarks._north_star_cite(str(tmp_path))
+
+
+def test_render_md_smoke(tmp_path):
+    """render_md over a minimal two-section run list: both platform tables,
+    the fallback `*` marker, and the cross-platform caveat all present."""
+    runs = [
+        {
+            "platform": "tpu",
+            "device_kind": "TPU v5 lite",
+            "scale": "smoke",
+            "results": [
+                {
+                    "config": 1,
+                    "name": "SingleTrainer / MNIST MLP",
+                    "samples_per_sec_per_chip": 3638.6,
+                    "target_accuracy": 0.78,
+                    "epochs_to_target": 6,
+                    "final_accuracy": 0.80,
+                    "seconds_total": 9.7,
+                },
+            ],
+        },
+        {
+            "platform": "cpu",
+            "device_kind": "cpu",
+            "scale": "smoke",
+            "results": [
+                {
+                    "config": 7,
+                    "name": "AEASGD / REAL breast-cancer",
+                    "samples_per_sec_per_chip": 15438.8,
+                    "compile_in_window": True,
+                    "target_accuracy": 0.87,
+                    "epochs_to_target": 1,
+                    "final_accuracy": 0.88,
+                    "seconds_total": 6.3,
+                },
+            ],
+        },
+    ]
+    benchmarks.render_md(runs, str(tmp_path))
+    text = (tmp_path / "BENCHMARKS.md").read_text()
+    assert "## Platform `tpu`" in text and "## Platform `cpu`" in text
+    assert "CAVEAT" in text  # the axon-tunnel latency explanation
+    assert "3638.6" in text

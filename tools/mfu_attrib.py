@@ -24,6 +24,65 @@ from bench import resolve_backend  # noqa: E402
 from bench_mfu import measure  # noqa: E402
 
 
+def mode_configs(quick=False, long=False, scale=False, best=False,
+                 retire=False):
+    """The (label, measure-kwargs) list for each sweep mode — a plain
+    function so tests can pin every mode's kwargs against ``measure``'s
+    real signature without a TPU."""
+    configs = [
+        ("baseline dense+adam", {}),
+        ("pallas_adam only", {"opt_name": "pallas_adam"}),
+        ("fused_ln only", {"fused_ln": True}),
+        # blocks pinned explicitly so a label always means one config,
+        # independent of DEFAULT_BLOCK_Q/K retuning (512 since d7707a8)
+        ("flash only bq512 bk512", {"attention": "flash", "fused_ln": False,
+                                    "opt_name": "adam",
+                                    "block_q": 512, "block_k": 512}),
+        ("flash bundle", {"attention": "flash", "fused_ln": True,
+                          "opt_name": "pallas_adam"}),
+    ]
+    if not quick:
+        configs += [
+            (f"flash only bq{bq} bk{bk}",
+             {"attention": "flash", "fused_ln": False, "opt_name": "adam",
+              "block_q": bq, "block_k": bk})
+            for bq, bk in [(128, 128), (256, 256)]
+        ]
+    if long:
+        shape = {"seq": 2048, "depth": 4, "batch": 8}
+        configs = [
+            ("dense seq2048", dict(shape)),
+            ("flash seq2048", {"attention": "flash", **shape}),
+        ]
+    elif scale:
+        wide = {"d_model": 1024, "depth": 4}
+        configs = [
+            ("dense d1024 L4", dict(wide)),
+            ("flash d1024 L4", {"attention": "flash", **wide}),
+            ("flash batch128", {"attention": "flash", "batch": 128}),
+        ]
+    elif best:
+        bundle = {"attention": "flash", "opt_name": "pallas_adam"}
+        configs = [
+            ("best bundle d1024", {"d_model": 1024, "depth": 4, **bundle}),
+            ("best bundle d1024 batch128",
+             {"d_model": 1024, "depth": 4, "batch": 128, **bundle}),
+            # seq-4096: dense materializes (B,H,4096,4096) scores in HBM;
+            # flash streams 8 K/V blocks through VMEM per program
+            ("dense seq4096", {"seq": 4096, "depth": 4, "batch": 4}),
+            ("flash seq4096",
+             {"attention": "flash", "seq": 4096, "depth": 4, "batch": 4}),
+        ]
+    elif retire:
+        wide = {"d_model": 1024, "depth": 4}
+        configs = [
+            ("retire baseline d1024", dict(wide)),
+            ("retire fused_ln d1024", {"fused_ln": True, **wide}),
+            ("retire pallas_adam d1024", {"opt_name": "pallas_adam", **wide}),
+        ]
+    return configs
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -72,57 +131,9 @@ def main() -> None:
     dev = jax.devices()[0]
     print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
 
-    configs = [
-        ("baseline dense+adam", {}),
-        ("pallas_adam only", {"opt_name": "pallas_adam"}),
-        ("fused_ln only", {"fused_ln": True}),
-        # blocks pinned explicitly so a label always means one config,
-        # independent of DEFAULT_BLOCK_Q/K retuning (512 since d7707a8)
-        ("flash only bq512 bk512", {"attention": "flash", "fused_ln": False,
-                                    "opt_name": "adam",
-                                    "block_q": 512, "block_k": 512}),
-        ("flash bundle", {"attention": "flash", "fused_ln": True,
-                          "opt_name": "pallas_adam"}),
-    ]
-    if not args.quick:
-        configs += [
-            (f"flash only bq{bq} bk{bk}",
-             {"attention": "flash", "fused_ln": False, "opt_name": "adam",
-              "block_q": bq, "block_k": bk})
-            for bq, bk in [(128, 128), (256, 256)]
-        ]
-    if args.long:
-        shape = {"seq": 2048, "depth": 4, "batch": 8}
-        configs = [
-            ("dense seq2048", dict(shape)),
-            ("flash seq2048", {"attention": "flash", **shape}),
-        ]
-    elif args.scale:
-        wide = {"d_model": 1024, "depth": 4}
-        configs = [
-            ("dense d1024 L4", dict(wide)),
-            ("flash d1024 L4", {"attention": "flash", **wide}),
-            ("flash batch128", {"attention": "flash", "batch": 128}),
-        ]
-    elif args.best:
-        bundle = {"attention": "flash", "opt_name": "pallas_adam"}
-        configs = [
-            ("best bundle d1024", {"d_model": 1024, "depth": 4, **bundle}),
-            ("best bundle d1024 batch128",
-             {"d_model": 1024, "depth": 4, "batch": 128, **bundle}),
-            # seq-4096: dense materializes (B,H,4096,4096) scores in HBM;
-            # flash streams 8 K/V blocks through VMEM per program
-            ("dense seq4096", {"seq": 4096, "depth": 4, "batch": 4}),
-            ("flash seq4096",
-             {"attention": "flash", "seq": 4096, "depth": 4, "batch": 4}),
-        ]
-    elif args.retire:
-        wide = {"d_model": 1024, "depth": 4}
-        configs = [
-            ("retire baseline d1024", dict(wide)),
-            ("retire fused_ln d1024", {"fused_ln": True, **wide}),
-            ("retire pallas_adam d1024", {"opt_name": "pallas_adam", **wide}),
-        ]
+    configs = mode_configs(quick=args.quick, long=args.long,
+                           scale=args.scale, best=args.best,
+                           retire=args.retire)
 
     with open("MFU_ATTRIB.jsonl", "a") as f:
         for label, kw in configs:
