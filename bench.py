@@ -59,75 +59,25 @@ TPU_PEAK_BF16 = {
     "v2": 46e12,
 }
 
-def _probe_src(config_platform: str | None) -> str:
-    pin = (
-        f"jax.config.update('jax_platforms', {config_platform!r}); "
-        if config_platform
-        else ""
-    )
-    return f"import jax; {pin}d = jax.devices(); print('PLATFORM=' + d[0].platform)"
+# Backend probing lives in the package (distkeras_tpu.parallel.backend)
+# so the examples share it; the harnesses (bench_mfu, bench_decode,
+# benchmarks, tools/*) import these two names from bench. The wrappers
+# import lazily so `import bench` stays framework-free (and jax-free):
+# probe-only invocations must not pay the full package import at startup.
 
 
-def _probe_backend(config_platform: str | None, timeout: float) -> str | None:
-    """Try initializing JAX in a subprocess; return the platform name on
-    success, None on failure/hang. Probing out-of-process matters because a
-    failed in-process backend init is sticky (VERDICT r1 weak #1: the axon
-    plugin can hang unless the platform is pinned before any backend touch).
-    The cpu pin uses ``jax.config.update`` rather than ``JAX_PLATFORMS``
-    because the sandbox's sitecustomize registers its TPU plugin in a way
-    that overrides the env var (same approach as tests/conftest.py)."""
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", _probe_src(config_platform)],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-        )
-    except (subprocess.TimeoutExpired, OSError):
-        return None
-    if out.returncode != 0:
-        return None
-    for line in reversed(out.stdout.strip().splitlines()):
-        if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1]
-    return None
+def resolve_backend():
+    from distkeras_tpu.parallel.backend import resolve_backend as _rb
+
+    return _rb()
 
 
-def resolve_backend() -> tuple[str, str | None] | None:
-    """Pick a working backend before importing jax in-process.
+def setup_backend(cpu: bool = False, cpu_devices: int = 1,
+                  fallback_cpu_devices: int | None = None) -> str:
+    from distkeras_tpu.parallel.backend import setup_backend as _sb
 
-    Returns (platform, config_pin): apply ``jax.config.update('jax_platforms',
-    config_pin)`` after import when config_pin is not None."""
-    candidates = [
-        (None, 75.0),  # whatever the driver set (axon TPU when healthy)
-        ("cpu", 60.0),  # always-available fallback
-    ]
-    for config_platform, timeout in candidates:
-        platform = _probe_backend(config_platform, timeout)
-        if platform is not None:
-            return platform, config_platform
-    return None
-
-
-def setup_backend(cpu: bool = False, cpu_devices: int = 1) -> str:
-    """The harness bootstrap shared by bench_mfu/bench_decode/benchmarks:
-    force a ``cpu_devices``-wide CPU mesh when asked, otherwise probe
-    out-of-process (a dead tunnel must not hang in-process init) and pin
-    the surviving platform. Returns the platform string."""
-    if cpu:
-        from distkeras_tpu.parallel.mesh import force_cpu_mesh
-
-        force_cpu_mesh(cpu_devices)
-        return "cpu"
-    resolved = resolve_backend()
-    if resolved is None:
-        raise SystemExit("no JAX backend could be initialized")
-    platform, config_pin = resolved
-    import jax
-
-    if config_pin is not None:
-        jax.config.update("jax_platforms", config_pin)
-    return platform
+    return _sb(cpu=cpu, cpu_devices=cpu_devices,
+               fallback_cpu_devices=fallback_cpu_devices)
 
 
 def sync_fetch(array) -> float:

@@ -42,10 +42,12 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (virtual multi-device mesh)")
     args = ap.parse_args()
-    if args.cpu:
-        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+    from distkeras_tpu.parallel.backend import setup_backend
 
-        force_cpu_mesh(max(args.workers, 8))
+    # probe out-of-process: a dead TPU tunnel degrades to the virtual CPU
+    # mesh instead of hanging in-process backend init (--cpu forces it)
+    setup_backend(cpu=args.cpu, cpu_devices=max(args.workers, 8),
+                  fallback_cpu_devices=max(args.workers, 8))
 
     train, test = diabetes().split(0.85, seed=7)
     print(f"real diabetes: {len(train)} train rows, {len(test)} test rows")

@@ -37,10 +37,12 @@ def main():
                     help="force the CPU backend (virtual multi-device mesh "
                          "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     args = ap.parse_args()
-    if args.cpu:
-        import jax
+    from distkeras_tpu.parallel.backend import setup_backend
 
-        jax.config.update("jax_platforms", "cpu")
+    # probe out-of-process: a dead TPU tunnel degrades to CPU instead of
+    # hanging in-process backend init (--cpu forces it)
+    setup_backend(cpu=args.cpu, cpu_devices=max(args.workers, 8),
+                  fallback_cpu_devices=max(args.workers, 8))
 
     raw = load_csv(args.csv) if args.csv else synthetic_higgs(n=args.n)
     num_features = raw["features"].shape[1]

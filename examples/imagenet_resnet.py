@@ -86,10 +86,12 @@ def main():
                     help="force the CPU backend (virtual multi-device mesh "
                          "via XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     args = ap.parse_args()
-    if args.cpu:
-        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+    from distkeras_tpu.parallel.backend import setup_backend
 
-        force_cpu_mesh(max(args.workers, 8))
+    # probe out-of-process: a dead TPU tunnel degrades to the virtual CPU
+    # mesh instead of hanging in-process backend init (--cpu forces it)
+    setup_backend(cpu=args.cpu, cpu_devices=max(args.workers, 8),
+                  fallback_cpu_devices=max(args.workers, 8))
 
     def preprocess(chunk):
         x = chunk["features"].astype(np.float32) / 255.0

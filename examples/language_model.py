@@ -48,10 +48,11 @@ def main():
                          "successor corpus")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
-    if args.cpu:
-        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+    from distkeras_tpu.parallel.backend import setup_backend
 
-        force_cpu_mesh()
+    # probe out-of-process: a dead TPU tunnel degrades to the virtual CPU
+    # mesh instead of hanging in-process backend init (--cpu forces it)
+    setup_backend(cpu=args.cpu, cpu_devices=8, fallback_cpu_devices=8)
 
     from distkeras_tpu import SequenceParallelTrainer, SingleTrainer
     from distkeras_tpu.data.dataset import Dataset

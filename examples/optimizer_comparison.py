@@ -97,10 +97,12 @@ def main():
     ap.add_argument("--out", default=os.path.join("examples", "experiments"))
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
-    if args.cpu:
-        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+    from distkeras_tpu.parallel.backend import setup_backend
 
-        force_cpu_mesh(max(args.workers, 8))
+    # probe out-of-process: a dead TPU tunnel degrades to the virtual CPU
+    # mesh instead of hanging in-process backend init (--cpu forces it)
+    setup_backend(cpu=args.cpu, cpu_devices=max(args.workers, 8),
+                  fallback_cpu_devices=max(args.workers, 8))
     import jax
 
     if args.digits:
