@@ -155,3 +155,31 @@ def test_ulysses_t2048_gradients_match_dense():
     )(q, k, v)
     for a, b in zip(g_u, g_d):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_flash_t4096_matches_dense_values_and_grads():
+    """seq-4096 = an 8x8 block grid (twice the --long regime's depth) —
+    the correctness pin for the capture queue's `--best` seq-4096 perf
+    row (tools/mfu_attrib.py), so the on-chip number never lands without
+    an off-chip parity proof at the same sequence length."""
+    T4 = 4096
+    path, bq, bk = effective_path(T4, D)
+    assert path == "flash" and T4 // bq == 8 and T4 // bk == 8, (path, bq, bk)
+    rng = np.random.default_rng(7)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, T4, H, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, causal=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+    g_f = jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_d = jax.grad(
+        lambda q, k, v: jnp.sum(dense_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_f, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
