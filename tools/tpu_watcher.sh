@@ -22,10 +22,16 @@ while true; do
     # the configs-4,5 run must not cost the 1,2,3,6,7 harvest
     commit_snap "Harvest TPU window: benchmark matrix rows (configs 1,2,3,6,7)" \
       BENCHMARKS.json BENCHMARKS.md "$LOG" >> "$LOG" 2>&1
-    # the remaining matrix rows (CIFAR ADAG, ResNet DynSGD) ride a second
-    # invocation so a dying tunnel cannot cost the cheap rows above
-    timeout -k 30 2400 python benchmarks.py --configs 4,5 >> "$LOG" 2>&1
-    commit_snap "Harvest TPU window: TPU benchmark matrix rows" \
+    # the remaining matrix rows ride SEPARATE invocations, cheapest
+    # first, committing between them: the r5 window killed a combined
+    # 4,5 run mid-config-5 (ResNet: full TPU compile + 32 workers of
+    # tunnel round-trips), and config 5 alone gets the long budget
+    timeout -k 30 1800 python benchmarks.py --configs 4 >> "$LOG" 2>&1
+    commit_snap "Harvest TPU window: TPU matrix row (config 4)" \
+      TPU_CAPTURE.log BENCHMARKS.json BENCHMARKS.md \
+      "$LOG" >> "$LOG" 2>&1
+    timeout -k 30 3600 python benchmarks.py --configs 5 >> "$LOG" 2>&1
+    commit_snap "Harvest TPU window: TPU matrix row (config 5, ResNet DynSGD)" \
       TPU_CAPTURE.log BENCHMARKS.json BENCHMARKS.md \
       "$LOG" >> "$LOG" 2>&1
     echo "$(date -u +%FT%TZ) capture cycle done" >> "$LOG"
