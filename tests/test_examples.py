@@ -1,0 +1,60 @@
+"""The example scripts run end-to-end as a USER would run them.
+
+The reference's examples are its de-facto acceptance artifacts (SURVEY
+§3.2: `examples/mnist.py` [C] is the canonical script), and nothing else
+executes these files — unit tests import the library, not the scripts.
+Each case is a real subprocess (`python examples/<x>.py --cpu ...`), so
+argparse wiring, the shared `setup_backend` bootstrap (rewired across all
+9 scripts in r5), and the printed acceptance lines are all on the hook.
+
+Only the cheap representatives run (mnist single ~10 s, real_digits ~5 s,
+diabetes ~10 s); the expensive family members (cifar10, imagenet_resnet,
+language_model, long_context, optimizer_comparison, higgs_workflow) share
+the exact same bootstrap + trainer surface and stay manual/bench-tier.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+def run_example(script, *args, timeout=420):
+    env = dict(os.environ)
+    # subprocess must not inherit this process's 8-device XLA_FLAGS pin in
+    # a half-applied way; the scripts do their own --cpu bootstrap
+    out = subprocess.run(
+        [sys.executable, os.path.join("examples", script), *args],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    return out.stdout
+
+
+def test_mnist_single_cpu():
+    out = run_example("mnist.py", "single", "--cpu", "--epochs", "1",
+                      "--n", "2048")
+    assert "test accuracy:" in out
+    acc = float(out.rsplit("test accuracy:", 1)[1].strip())
+    assert acc > 0.7, out
+
+
+def test_real_digits_cpu():
+    out = run_example("real_digits.py", "--cpu")
+    assert "REAL holdout accuracy" in out
+    acc = float(out.rsplit("REAL holdout accuracy", 1)[1].strip())
+    assert acc > 0.9, out
+
+
+def test_diabetes_regression_cpu():
+    out = run_example("diabetes_regression.py", "--cpu")
+    assert "r2" in out.lower() or "R^2" in out, out
