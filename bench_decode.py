@@ -69,6 +69,15 @@ def main() -> None:
     cached_tps = timed(CachedSequenceGenerator(model), steps)
     uncached_tps = timed(SequenceGenerator(model), uncached_steps)
 
+    # weight-only int8 A/B on the SAME cached path: decode streams every
+    # weight matrix from HBM once per token, so quartering the weight
+    # bytes (ops/quantization.py) should move tokens/sec on chip; the
+    # numerics are pinned off-chip by tests/test_quantization.py
+    from distkeras_tpu.ops.quantization import count_quantized, quantize_model
+
+    model_q = quantize_model(model.copy())
+    int8_tps = timed(CachedSequenceGenerator(model_q), steps)
+
     record = {
         "metric": "lm_decode_tokens_per_sec",
         "value": round(cached_tps, 1),
@@ -91,6 +100,11 @@ def main() -> None:
         "speedup_vs_uncached_short_ctx_lower_bound": round(
             cached_tps / uncached_tps, 2
         ),
+        "int8_weight_only": {
+            "tokens_per_sec": round(int8_tps, 1),
+            "speedup_vs_f32_cached": round(int8_tps / cached_tps, 3),
+            "quantized_matrices": count_quantized(model_q.params),
+        },
     }
     with open("BENCH_DECODE.json", "w") as f:
         json.dump(record, f, indent=2)

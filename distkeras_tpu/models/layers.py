@@ -127,7 +127,11 @@ class Dense(Layer):
         return params, {}, (*in_shape[:-1], self.units)
 
     def apply(self, params, state, x, train=False, rng=None):
-        y = x @ params["kernel"].astype(x.dtype)
+        from distkeras_tpu.ops.quantization import qmatmul
+
+        # qmatmul == plain matmul for f32 kernels; int8 weight-only when
+        # the tree went through ops.quantization.quantize_params (serving)
+        y = qmatmul(x, params["kernel"])
         if self.use_bias:
             y = y + params["bias"].astype(x.dtype)
         return get_activation(self.activation)(y), state
@@ -468,19 +472,20 @@ class MultiHeadSelfAttention(Layer):
         return params, {}, (*in_shape[:-1], d)
 
     def apply(self, params, state, x, train=False, rng=None):
+        from distkeras_tpu.ops.quantization import qmatmul, qshape
         from distkeras_tpu.parallel.ring_attention import dense_attention
 
         b, t, d = x.shape
         h = self.num_heads
-        hd = params["wq"].shape[1] // h
+        hd = qshape(params["wq"])[1] // h
 
         def proj(w):
-            return (x @ w.astype(x.dtype)).reshape(b, t, h, hd)
+            return qmatmul(x, w).reshape(b, t, h, hd)
 
         q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
         attn = self.attention_fn or dense_attention
         o = attn(q, k, v, causal=self.causal)
-        o = o.reshape(b, t, h * hd) @ params["wo"].astype(x.dtype)
+        o = qmatmul(o.reshape(b, t, h * hd), params["wo"])
         if self.use_bias:
             o = o + params["bo"].astype(x.dtype)
         return o, state

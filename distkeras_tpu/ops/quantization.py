@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization for the serving path.
+
+No reference counterpart (SURVEY §3.4: the reference ships no native/perf
+tier at all); this is a TPU-first lever. Decode and batched inference are
+memory-bound — the v5e HBM streams every weight matrix once per token —
+so halving/quartering weight bytes moves tokens/sec directly, while the
+MXU still computes in the activation dtype (the int8 weights upcast in
+registers; XLA fuses the cast into the matmul's operand read).
+
+Scheme: symmetric per-output-channel scales. A quantized matrix is the
+pytree `{"q": int8 (in, out), "s": f32 (out,)}` with
+`w ≈ q * s[None, :]`. Because the scale is per OUTPUT column it commutes
+through the matmul:
+
+    x @ (q * s[None, :]) == (x @ q) * s[None, :]
+
+so `qmatmul` never materializes the dequantized matrix — the int8 bytes
+are what leaves HBM. Training on a quantized tree is unsupported (no
+gradients through round()); quantize for serving, keep the f32 master
+for training/checkpoints.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: weight-matrix key names eligible for quantization when walking a
+#: params tree: Dense kernels and the attention projections. Biases, LN
+#: gains, embeddings, and conv kernels stay f32 (they are a rounding
+#: error of the bytes; embeddings are gathers, not matmuls).
+DEFAULT_QUANT_KEYS = ("kernel", "wq", "wk", "wv", "wo")
+
+
+def quantize_int8(w):
+    """f32 (in, out) -> {"q": int8, "s": f32 (out,)}, symmetric per-column."""
+    w = jnp.asarray(w)
+    if w.ndim != 2:
+        raise ValueError(f"quantize_int8 expects a 2-D matrix; got {w.shape}")
+    s = jnp.max(jnp.abs(w), axis=0) / 127.0
+    s = jnp.where(s == 0, jnp.float32(1.0), s).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / s[None, :]), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def dequantize(w):
+    """{"q","s"} -> f32 matrix (testing/debugging; serving never calls it)."""
+    return w["q"].astype(jnp.float32) * w["s"][None, :]
+
+
+def qshape(w):
+    """Shape of a weight that may or may not be quantized."""
+    return w["q"].shape if is_quantized(w) else w.shape
+
+
+def qmatmul(x, w):
+    """x @ w for plain or quantized w, in x.dtype, without materializing
+    the dequantized matrix (the per-out-column scale commutes)."""
+    if is_quantized(w):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w.astype(x.dtype)
+
+
+def quantize_params(params, keys=DEFAULT_QUANT_KEYS):
+    """Walk a params pytree; replace eligible 2-D float leaves (dict key in
+    ``keys``) with their int8 form. Already-quantized entries pass through
+    (idempotent). Returns a new tree; the input is not mutated."""
+    if is_quantized(params):
+        return params
+    if isinstance(params, dict):
+        out = {}
+        for k, v in params.items():
+            if (
+                k in keys
+                and hasattr(v, "ndim")
+                and getattr(v, "ndim", 0) == 2
+                and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+            ):
+                out[k] = quantize_int8(v)
+            else:
+                out[k] = quantize_params(v, keys)
+        return out
+    if isinstance(params, (list, tuple)):
+        return type(params)(quantize_params(v, keys) for v in params)
+    return params
+
+
+def count_quantized(params) -> int:
+    """Number of quantized matrices in a tree (tests/reporting)."""
+    if is_quantized(params):
+        return 1
+    if isinstance(params, dict):
+        return sum(count_quantized(v) for v in params.values())
+    if isinstance(params, (list, tuple)):
+        return sum(count_quantized(v) for v in params)
+    return 0
+
+
+def quantize_model(model, keys=DEFAULT_QUANT_KEYS):
+    """Switch a built model's params to the int8 serving tree IN PLACE and
+    return the model (chainable). Serve-only: trainers reject quantized
+    trees (no gradients through round()); quantize a copy —
+    ``quantize_model(m.copy())`` — if the original must keep training."""
+    if getattr(model, "params", None) is None:
+        raise ValueError("quantize_model needs a BUILT model (params set)")
+    model.params = quantize_params(model.params, keys)
+    return model

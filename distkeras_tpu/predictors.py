@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.ops.quantization import qmatmul, qshape
 
 
 class Predictor:
@@ -313,10 +314,10 @@ class CachedSequenceGenerator(SequenceGenerator):
         h_, _ = blk.ln1.apply(p["ln1"], {}, x)
         bsz = x.shape[0]
         nh = blk.mhsa.num_heads
-        hd = mh["wq"].shape[1] // nh
-        q = (h_ @ mh["wq"]).reshape(bsz, nh, hd)
-        k_new = (h_ @ mh["wk"]).reshape(bsz, nh, hd)
-        v_new = (h_ @ mh["wv"]).reshape(bsz, nh, hd)
+        hd = qshape(mh["wq"])[1] // nh
+        q = qmatmul(h_, mh["wq"]).reshape(bsz, nh, hd)
+        k_new = qmatmul(h_, mh["wk"]).reshape(bsz, nh, hd)
+        v_new = qmatmul(h_, mh["wv"]).reshape(bsz, nh, hd)
         cache_k = jax.lax.dynamic_update_slice_in_dim(
             cache_k, k_new[:, None], pos, axis=1
         )
@@ -327,7 +328,7 @@ class CachedSequenceGenerator(SequenceGenerator):
         scores = jnp.where(t_mask[None, None, :], scores, -jnp.inf)
         w = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bht,bthd->bhd", w, cache_v).reshape(bsz, nh * hd)
-        o = o @ mh["wo"]
+        o = qmatmul(o, mh["wo"])
         if "bo" in mh:
             o = o + mh["bo"]
         x = x + o
@@ -352,7 +353,7 @@ class CachedSequenceGenerator(SequenceGenerator):
             p_head = params[str(2 + n_blocks)]
             bsz = ctx.shape[0]
             nh = blocks[0].mhsa.num_heads
-            hd = bp[0]["mhsa"]["wq"].shape[1] // nh
+            hd = qshape(bp[0]["mhsa"]["wq"])[1] // nh
 
             def embed(tok, pos):
                 x = p_emb["tokens"][tok]
@@ -377,13 +378,13 @@ class CachedSequenceGenerator(SequenceGenerator):
                 for blk, p, (ck, cv) in zip(blocks, bp, caches):
                     mh = p["mhsa"]
                     h_, _ = blk.ln1.apply(p["ln1"], {}, x)
-                    q = (h_ @ mh["wq"]).reshape(bsz, pp, nh, hd)
-                    k = (h_ @ mh["wk"]).reshape(bsz, pp, nh, hd)
-                    v = (h_ @ mh["wv"]).reshape(bsz, pp, nh, hd)
+                    q = qmatmul(h_, mh["wq"]).reshape(bsz, pp, nh, hd)
+                    k = qmatmul(h_, mh["wk"]).reshape(bsz, pp, nh, hd)
+                    v = qmatmul(h_, mh["wv"]).reshape(bsz, pp, nh, hd)
                     ck = ck.at[:, :pp].set(k)
                     cv = cv.at[:, :pp].set(v)
                     o = dense_attention(q, k, v, causal=True)
-                    o = o.reshape(bsz, pp, nh * hd) @ mh["wo"]
+                    o = qmatmul(o.reshape(bsz, pp, nh * hd), mh["wo"])
                     if "bo" in mh:
                         o = o + mh["bo"]
                     x = x + o

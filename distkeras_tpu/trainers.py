@@ -100,6 +100,15 @@ class Trainer:
     ):
         if model.params is None:
             raise ValueError("model must be built (call model.build(input_shape))")
+        from distkeras_tpu.ops.quantization import count_quantized
+
+        if count_quantized(model.params):
+            raise ValueError(
+                "model holds an int8-quantized serving tree "
+                "(ops.quantization.quantize_model) — training cannot "
+                "differentiate through round(); train the f32 master and "
+                "quantize a serving copy instead"
+            )
         # accum_steps=k: each optimizer step processes its batch as k
         # sequential microbatches of B/k, averaging the gradients — ~k x
         # less activation memory at (BN aside) full-batch numerics. B must

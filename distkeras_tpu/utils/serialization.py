@@ -156,6 +156,14 @@ def deserialize_params(blob: bytes):
 
 def serialize_model(model) -> bytes:
     """Sequential model -> bytes: architecture spec JSON + weight arrays."""
+    from distkeras_tpu.ops.quantization import count_quantized
+
+    if count_quantized(getattr(model, "params", None) or {}):
+        raise ValueError(
+            "model holds an int8-quantized serving tree; quantization is a "
+            "LOAD-TIME transform — serialize the f32 master and call "
+            "ops.quantization.quantize_model after deserialize_model"
+        )
     buf = io.BytesIO()
     np.savez(buf, *[np.asarray(w) for w in model.get_weights()])
     return pack_frame(
