@@ -46,6 +46,10 @@ def main():
                     help="train on a real text file, byte-level (default: "
                          "the repository's LICENSE) instead of the toy "
                          "successor corpus")
+    ap.add_argument("--int8", action="store_true",
+                    help="serve the decode demo from an int8 weight-only "
+                    "copy (ops.quantization.quantize_model) — quarter the "
+                    "HBM weight bytes per token on chip")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     from distkeras_tpu.parallel.backend import setup_backend
@@ -103,7 +107,14 @@ def main():
 
     from distkeras_tpu.predictors import CachedSequenceGenerator
 
-    gen = CachedSequenceGenerator(trained)
+    serve_model = trained
+    if args.int8:
+        from distkeras_tpu.ops.quantization import count_quantized, quantize_model
+
+        serve_model = quantize_model(trained.copy())
+        print(f"serving int8 weight-only "
+              f"({count_quantized(serve_model.params)} quantized matrices)")
+    gen = CachedSequenceGenerator(serve_model)
     if args.text is not None:
         p_len = min(16, max(1, args.seq // 2))
         prompt = ds["features"][len(ds) // 2 : len(ds) // 2 + 1, :p_len]
