@@ -262,9 +262,15 @@ class CachedSequenceGenerator(SequenceGenerator):
     """
 
     def __init__(self, model, temperature=0.0, seed=0, top_k=None,
-                 top_p=None):
+                 top_p=None, kv_dtype=None):
+        """``kv_dtype``: cache dtype; None keeps f32 (greedy output pinned
+        bit-equal to the uncached generator). ``jnp.bfloat16`` halves the
+        per-token cache-read bytes — the other big HBM stream of the
+        serving path next to the int8 weights (ops/quantization.py);
+        attention still accumulates in f32 (mixed-dtype einsum promotes)."""
         super().__init__(model, temperature=temperature, seed=seed,
                          top_k=top_k, top_p=top_p)
+        self.kv_dtype = jnp.float32 if kv_dtype is None else kv_dtype
         from distkeras_tpu.models.layers import (
             Dense,
             Embedding,
@@ -319,10 +325,10 @@ class CachedSequenceGenerator(SequenceGenerator):
         k_new = qmatmul(h_, mh["wk"]).reshape(bsz, nh, hd)
         v_new = qmatmul(h_, mh["wv"]).reshape(bsz, nh, hd)
         cache_k = jax.lax.dynamic_update_slice_in_dim(
-            cache_k, k_new[:, None], pos, axis=1
+            cache_k, k_new[:, None].astype(cache_k.dtype), pos, axis=1
         )
         cache_v = jax.lax.dynamic_update_slice_in_dim(
-            cache_v, v_new[:, None], pos, axis=1
+            cache_v, v_new[:, None].astype(cache_v.dtype), pos, axis=1
         )
         scores = jnp.einsum("bhd,bthd->bht", q, cache_k) / np.sqrt(hd)
         scores = jnp.where(t_mask[None, None, :], scores, -jnp.inf)
@@ -361,10 +367,11 @@ class CachedSequenceGenerator(SequenceGenerator):
                     x = x + p_emb["positions"][pos]
                 return x
 
+            kvd = self.kv_dtype
             caches = [
                 (
-                    jnp.zeros((bsz, seq_len, nh, hd), jnp.float32),
-                    jnp.zeros((bsz, seq_len, nh, hd), jnp.float32),
+                    jnp.zeros((bsz, seq_len, nh, hd), kvd),
+                    jnp.zeros((bsz, seq_len, nh, hd), kvd),
                 )
                 for _ in range(n_blocks)
             ]
@@ -381,8 +388,8 @@ class CachedSequenceGenerator(SequenceGenerator):
                     q = qmatmul(h_, mh["wq"]).reshape(bsz, pp, nh, hd)
                     k = qmatmul(h_, mh["wk"]).reshape(bsz, pp, nh, hd)
                     v = qmatmul(h_, mh["wv"]).reshape(bsz, pp, nh, hd)
-                    ck = ck.at[:, :pp].set(k)
-                    cv = cv.at[:, :pp].set(v)
+                    ck = ck.at[:, :pp].set(k.astype(ck.dtype))
+                    cv = cv.at[:, :pp].set(v.astype(cv.dtype))
                     o = dense_attention(q, k, v, causal=True)
                     o = qmatmul(o.reshape(bsz, pp, nh * hd), mh["wo"])
                     if "bo" in mh:
