@@ -106,11 +106,9 @@ class ParameterServer:
     commit_rule = staticmethod(delta_rule)
 
     def __init__(self, params, pull_compress=None):
-        if pull_compress not in (None, "bfloat16"):
-            raise ValueError(
-                f"pull_compress must be None or 'bfloat16'; got "
-                f"{pull_compress!r}"
-            )
+        from distkeras_tpu.utils.compression import validate_pull_compress
+
+        validate_pull_compress(pull_compress)
         self.pull_compress = pull_compress
         self._center = _to_host(params)
         self._meta = {"num_updates": 0}
@@ -156,6 +154,10 @@ class ParameterServer:
             from distkeras_tpu.utils.compression import bf16_encode_tree
 
             center = bf16_encode_tree(center)
+        elif self.pull_compress == "int8":
+            from distkeras_tpu.utils.compression import int8_encode_tree
+
+            center = int8_encode_tree(center)
         return center, tag
 
     def commit(self, delta, tag=None, commit_id=None, local_snap=None):

@@ -1727,13 +1727,15 @@ class DistributedTrainer(Trainer):
         parse_compress_spec(compress)  # validate the spec (raises early)
         self.compress = compress
         # pull_compress="bfloat16": the pulled center ships bf16-encoded
-        # (half the pull bytes); workers decode on receipt. bf16 matches
-        # the precision the compute path already runs activations at.
-        if pull_compress not in (None, "bfloat16"):
-            raise ValueError(
-                f"pull_compress must be None or 'bfloat16'; got "
-                f"{pull_compress!r}"
-            )
+        # (half the pull bytes; matches the precision the compute path
+        # already runs activations at). "int8": per-tensor symmetric
+        # quarter-width (one-shot rounding, no feedback needed — pulls
+        # don't accumulate; NaN/non-f32 leaves ride raw so divergence
+        # and integer params survive the wire). Workers decode on
+        # receipt either way.
+        from distkeras_tpu.utils.compression import validate_pull_compress
+
+        validate_pull_compress(pull_compress)
         self.pull_compress = pull_compress
         # device_resident: each worker ships its partition to HBM once and
         # streams only (W, B) index matrices per window — the async face of

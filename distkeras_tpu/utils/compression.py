@@ -136,9 +136,87 @@ def is_bf16(tree) -> bool:
     return isinstance(tree, dict) and set(tree.keys()) == {BF16_KEY}
 
 
+# ------------------------------------------------------- int8 pull tier
+
+PULL_Q8_KEY = "__dkt_pull_q8__"
+
+#: the values DistributedTrainer and ParameterServer accept for
+#: pull_compress — ONE tuple so the two validation sites cannot drift
+PULL_COMPRESS_VALUES = (None, "bfloat16", "int8")
+
+
+def validate_pull_compress(spec):
+    if spec not in PULL_COMPRESS_VALUES:
+        raise ValueError(
+            f"pull_compress must be one of {PULL_COMPRESS_VALUES}; got "
+            f"{spec!r}"
+        )
+    return spec
+
+
+def _int8_encode_leaf(a):
+    """f32 leaf -> (int8 payload, scale, flag=1); anything the tier cannot
+    represent faithfully rides raw with flag=0 — non-f32 leaves (integer/
+    bool params are preserved by design, same as the bf16 tier) AND
+    non-finite leaves (a NaN center must reach the worker AS NaN so a
+    diverged run surfaces instead of killing the PS connection thread)."""
+    a = np.asarray(a)
+    if a.dtype != np.float32:
+        return a, np.float32(0), np.int8(0)
+    amax = np.max(np.abs(a)) if a.size else np.float32(0)
+    if not np.isfinite(amax):
+        return a, np.float32(0), np.int8(0)
+    scale = np.float32(amax / 127.0)
+    if scale == 0.0:
+        return np.zeros(a.shape, np.int8), scale, np.int8(1)
+    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+    return q, scale, np.int8(1)
+
+
+def _int8_decode_leaf(v, s, flag):
+    if not int(flag):
+        return v
+    return v.astype(np.float32) * np.float32(s)
+
+
+def int8_encode_tree(tree):
+    """Per-tensor symmetric int8 for the PULL direction: ~4x fewer center
+    bytes than f32. One-shot rounding (error <= max|w|/254 per weight) —
+    no error feedback exists on pulls because nothing accumulates; the
+    async algorithms' noise tolerance absorbs it (convergence pinned by
+    tests/test_compression.py). Unlike the commit-side quantize_tree this
+    builds no dequantized copy (pulls don't need a residual) and passes
+    non-f32 / non-finite leaves through raw, flagged."""
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    triples = [_int8_encode_leaf(a) for a in flat]
+    unflat = jax.tree_util.tree_unflatten
+    return {
+        PULL_Q8_KEY: {
+            "v": unflat(treedef, [v for v, _, _ in triples]),
+            "s": unflat(treedef, [s for _, s, _ in triples]),
+            "f": unflat(treedef, [f for _, _, f in triples]),
+        }
+    }
+
+
+def int8_decode_tree(payload):
+    body = payload[PULL_Q8_KEY]
+    return jax.tree.map(_int8_decode_leaf, body["v"], body["s"], body["f"])
+
+
+def is_pull_q8(tree) -> bool:
+    return isinstance(tree, dict) and set(tree.keys()) == {PULL_Q8_KEY}
+
+
 def maybe_decode_pull(center):
-    """Worker-side entry: reconstruct a bf16-encoded pulled center."""
-    return bf16_decode_tree(center) if is_bf16(center) else center
+    """Worker-side entry: reconstruct a bf16- or int8-encoded pulled
+    center (the PS encodes per its pull_compress; both wire forms are
+    self-describing)."""
+    if is_bf16(center):
+        return bf16_decode_tree(center)
+    if is_pull_q8(center):
+        return int8_decode_tree(center)
+    return center
 
 
 def compress_with_feedback(delta, residual):

@@ -529,3 +529,79 @@ def test_socket_client_preserves_compressed_dtypes():
     assert out["i"].dtype == np.int32
     assert out["f64"].dtype == np.float32
     assert out["f32"].dtype == np.float32
+
+
+def test_int8_pull_roundtrip_and_bytes():
+    """The pull-side int8 codec decodes through the worker-side entry,
+    the wire form is ~4x smaller than f32, and the leaves the tier
+    cannot represent faithfully ride raw: non-f32 params (preserved by
+    design, same as bf16 pulls) and non-finite centers (a diverged run
+    must surface AS NaN at the worker, not kill the PS serve thread)."""
+    import numpy as np
+
+    from distkeras_tpu.utils.compression import (
+        int8_encode_tree,
+        maybe_decode_pull,
+    )
+    from distkeras_tpu.utils.serialization import serialize_params
+
+    rng = np.random.default_rng(0)
+    tree = {"w": rng.standard_normal((256, 128)).astype(np.float32),
+            "b": rng.standard_normal((128,)).astype(np.float32)}
+    payload = int8_encode_tree(tree)
+    decoded = maybe_decode_pull(payload)
+    for k in tree:
+        # one-shot rounding bound: error <= amax/254 per weight
+        bound = np.abs(tree[k]).max() / 254 + 1e-7
+        assert np.abs(np.asarray(decoded[k]) - tree[k]).max() <= bound
+    assert len(serialize_params(payload)) < (
+        len(serialize_params(tree)) * 0.30
+    )
+    # non-f32 leaves (int step counters, bool masks) round-trip EXACTLY
+    mixed = {"w": tree["w"], "step": np.int64(7),
+             "mask": np.array([True, False])}
+    dec = maybe_decode_pull(int8_encode_tree(mixed))
+    assert np.asarray(dec["step"]) == 7
+    assert np.asarray(dec["step"]).dtype == np.int64
+    np.testing.assert_array_equal(np.asarray(dec["mask"]),
+                                  mixed["mask"])
+    # a NaN center leaf survives the wire as NaN (f32, not an exception)
+    bad = {"w": np.array([1.0, np.nan], np.float32)}
+    dec_bad = maybe_decode_pull(int8_encode_tree(bad))
+    assert np.isnan(np.asarray(dec_bad["w"])[1])
+    assert np.asarray(dec_bad["w"]).dtype == np.float32
+
+
+@pytest.mark.slow
+def test_downpour_int8_pull_converges_over_socket():
+    """Quarter-width pulls (int8 center, no error feedback — one-shot
+    rounding) + int8 commits: the maximum-compression DCN configuration
+    reaches the accuracy target over the real socket transport."""
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.predictors import ModelPredictor
+
+    train, test = mnist_splits()
+
+    t = DOWNPOUR(
+        zoo.mnist_mlp(hidden=32),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.02,
+        num_workers=4,
+        batch_size=64,
+        communication_window=4,
+        num_epoch=3,
+        mode="simulated",
+        compress="int8",
+        pull_compress="int8",
+        remote_ps=True,
+        label_col="label_onehot",
+        seed=0,
+    )
+    trained = t.train(train)
+    acc = AccuracyEvaluator(label_col="label").evaluate(
+        ModelPredictor(trained, batch_size=256).predict(test)
+    )
+    assert acc > 0.9, acc
