@@ -41,6 +41,9 @@ def main():
     ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--int8", action="store_true",
+                    help="also evaluate an int8 weight-only serving copy "
+                    "(ops.quantization.quantize_model) next to f32")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (virtual multi-device mesh)")
     args = ap.parse_args()
@@ -87,6 +90,15 @@ def main():
     pred = ModelPredictor(trained, batch_size=256).predict(test)
     acc = AccuracyEvaluator(label_col="label").evaluate(pred)
     print(f"{args.mode}: {dt:.1f}s, REAL holdout accuracy {acc:.4f}")
+    if args.int8:
+        from distkeras_tpu.ops.quantization import quantize_model
+
+        q = quantize_model(trained.copy())
+        acc_q = AccuracyEvaluator(label_col="label").evaluate(
+            ModelPredictor(q, batch_size=256).predict(test)
+        )
+        print(f"int8 serving copy: REAL holdout accuracy {acc_q:.4f} "
+              f"(drop {acc - acc_q:+.4f})")
 
 
 if __name__ == "__main__":
