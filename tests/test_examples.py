@@ -58,3 +58,15 @@ def test_real_digits_cpu():
 def test_diabetes_regression_cpu():
     out = run_example("diabetes_regression.py", "--cpu")
     assert "r2" in out.lower() or "R^2" in out, out
+
+
+def test_language_model_int8_cpu():
+    out = run_example("language_model.py", "--cpu", "--int8",
+                      "--epochs", "2")
+    assert "serving int8 weight-only (13 quantized matrices)" in out
+    assert "greedy decode from 3 ->" in out
+    # 2 epochs on the counting task trains to ~1.0 next-token accuracy;
+    # the decoded continuation must actually count
+    tail = out.rsplit("-> [", 1)[1].rstrip("]\n")
+    toks = [int(t) for t in tail.split(",")]
+    assert toks[-5:] == list(range(toks[-5], toks[-5] + 5)), toks
