@@ -4,8 +4,10 @@ from distkeras_tpu.ops.losses import get_loss, categorical_crossentropy, mse
 from distkeras_tpu.ops.metrics import accuracy
 from distkeras_tpu.ops.optimizers import get_optimizer, get_schedule
 from distkeras_tpu.ops.quantization import (
+    Int4Weight,
     dequantize,
     qmatmul,
+    quantize_int4,
     quantize_int8,
     quantize_model,
     quantize_params,

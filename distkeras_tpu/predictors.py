@@ -172,35 +172,6 @@ class SequenceGenerator:
             logit = jnp.where(logit < thresh, -jnp.inf, logit)
         return logit
 
-    def _decode_fn(self, prompt_len, steps, temp):
-        apply = self.model.apply
-
-        def decode(params, state, ctx, key):
-            def step(carry, i):
-                ctx, key = carry
-                logits, _ = apply(params, state, ctx, train=False)
-                pos = prompt_len - 1 + i
-                logit = jax.lax.dynamic_index_in_dim(
-                    logits, pos, axis=1, keepdims=False
-                )  # (B, V)
-                if temp == 0.0:
-                    tok = jnp.argmax(logit, axis=-1)
-                else:
-                    key, sub = jax.random.split(key)
-                    tok = jax.random.categorical(
-                        sub, self._filter_logits(logit / temp), axis=-1
-                    )
-                tok = tok.astype(ctx.dtype)
-                ctx = ctx.at[:, pos + 1].set(tok)
-                return (ctx, key), tok
-
-            (ctx, _), _ = jax.lax.scan(
-                step, (ctx, key), jnp.arange(steps)
-            )
-            return ctx
-
-        return jax.jit(decode)
-
     def _validate_generate_args(self, prompts, steps):
         prompts = np.asarray(prompts)
         if prompts.ndim != 2 or prompts.shape[1] < 1:
@@ -219,28 +190,188 @@ class SequenceGenerator:
             )
         return prompts, steps, seq_len
 
-    def generate(self, prompts, steps):
-        """``prompts``: (B, P) int tokens, one shared prompt length P.
-        Returns (B, P + steps) — the prompts continued ``steps`` tokens.
-        P + steps must fit the model's built sequence length."""
-        prompts, steps, seq_len = self._validate_generate_args(prompts, steps)
+    def generate(self, prompts, steps, eos_id=None):
+        """Continue each prompt by up to ``steps`` tokens.
+
+        ``prompts``: either a (B, P) int array (one shared prompt length)
+        or a list/tuple of 1-D int sequences of DIFFERENT lengths (a
+        ragged serving batch). max prompt length + steps must fit the
+        model's built sequence length.
+
+        ``eos_id``: optional end-of-sequence token id. Generation still
+        runs the full compiled scan — XLA wants one static shape, so
+        "early exit" is a host-side trim, not a dynamic abort — and each
+        returned row is cut after its first generated ``eos_id``
+        (inclusive). The wasted tail compute is the price of a single
+        compiled program; at serving batch sizes it is cheaper than a
+        recompile per exit position.
+
+        Greedy decode of a ragged row is pinned equal to its solo
+        rectangular call. SAMPLED ragged rows are deterministic under a
+        fixed seed but batch-composition-dependent: the scan burns one
+        key split per scanned position (and the categorical draw is
+        per-row-of-batch), so a row sampled next to different neighbors
+        draws different bits than it would alone.
+
+        Returns a (B, P + steps) array for rectangular prompts without
+        ``eos_id`` (every row the same length); otherwise a list of B 1-D
+        arrays, row i being prompt i followed by its generated tokens.
+        """
         self._validate_sampling()
+        ragged = isinstance(prompts, (list, tuple)) and len(
+            {len(np.atleast_1d(p)) for p in prompts}
+        ) > 1
+        if not ragged and not isinstance(prompts, np.ndarray):
+            prompts = np.asarray(prompts)
+        if ragged:
+            return self._generate_ragged(prompts, steps, eos_id)
+        prompts, steps, seq_len = self._validate_generate_args(prompts, steps)
         b, p = prompts.shape
         ctx = np.zeros((b, seq_len), prompts.dtype)
         ctx[:, :p] = prompts
-        # the sampling config is baked into the compiled scan, so it keys
-        # the cache — mutating gen.temperature/top_k/top_p between calls
-        # must recompile, not silently reuse the old sampling mode
-        key = (p, steps, self.temperature, self.top_k, self.top_p)
-        if key not in self._fns:
-            self._fns[key] = self._decode_fn(p, steps, self.temperature)
-        out = self._fns[key](
-            self.model.params,
-            self.model.state,
-            jnp.asarray(ctx),
-            jax.random.PRNGKey(self.seed),
+        # rectangular IS the uniform-lens ragged decode: the keep-prompt/
+        # frozen masks are constant-false and the RNG schedule (one split
+        # per scanned position) is identical, so one builder serves both
+        # (no length bucketing here — a single shared length can't churn
+        # compositions, and exact start preserves the pinned rectangular
+        # sampling schedule)
+        out = self._run_decode(
+            ctx, np.full((b,), p, np.int32), p, steps, steps
         )
-        return np.asarray(out)[:, : p + steps]
+        out = out[:, : p + steps]
+        if eos_id is None:
+            return out
+        return [self._trim_eos(row, p, int(eos_id)) for row in out]
+
+    def _run_decode(self, ctx, lens, start, n_scan, steps):
+        """Compile (cached) and run the decode scan for a batch padded
+        into ``ctx``: scanned positions start-1 .. start+n_scan-2. The
+        sampling config is baked into the compiled scan, so it keys the
+        cache — mutating gen.temperature/top_k/top_p between calls must
+        recompile, not silently reuse the old sampling mode."""
+        key = (
+            start, n_scan, steps,
+            self.temperature, self.top_k, self.top_p,
+        )
+        if key not in self._fns:
+            self._fns[key] = self._decode_fn(
+                start, n_scan, steps, self.temperature
+            )
+        return np.asarray(
+            self._fns[key](
+                self.model.params,
+                self.model.state,
+                jnp.asarray(ctx),
+                jnp.asarray(lens),
+                jax.random.PRNGKey(self.seed),
+            )
+        )
+
+    @staticmethod
+    def _trim_eos(row, prompt_len, eos_id):
+        """Cut a decoded row after its first GENERATED eos (inclusive);
+        eos tokens inside the prompt don't end the sequence."""
+        gen = row[prompt_len:]
+        hits = np.flatnonzero(gen == eos_id)
+        if hits.size:
+            return row[: prompt_len + hits[0] + 1]
+        return row
+
+    def _generate_ragged(self, prompts, steps, eos_id):
+        rows = [np.atleast_1d(np.asarray(p)) for p in prompts]
+        if any(r.ndim != 1 or r.shape[0] < 1 for r in rows):
+            raise ValueError(
+                "ragged prompts must be non-empty 1-D token sequences"
+            )
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1; got {steps}")
+        lens = np.asarray([r.shape[0] for r in rows], np.int32)
+        min_len, max_len = int(lens.min()), int(lens.max())
+        seq_len = self.model.input_shape[0]
+        if max_len + steps > seq_len:
+            raise ValueError(
+                f"longest prompt ({max_len}) + steps ({steps}) exceeds "
+                f"the model's sequence length ({seq_len})"
+            )
+        dtype = np.result_type(*[r.dtype for r in rows])
+        ctx = np.zeros((len(rows), seq_len), dtype)
+        for i, r in enumerate(rows):
+            ctx[i, : lens[i]] = r
+        # Bucket the compiled-program key: exact (min_len, max_len) would
+        # compile per length COMPOSITION (O(L^2) programs for a serving
+        # workload with naturally varying prompts). The masks are already
+        # correct for any scan start <= min(lens), so round the start
+        # down to a power of two and the scan length up to one, clamped
+        # so the last write lands at seq_len-1 (coverage holds: the
+        # validation above guarantees max_len + steps <= seq_len).
+        # Greedy output is invariant to the bucket; sampled draws shift
+        # with it — within the documented batch-composition dependence.
+        start = 1 << (min_len.bit_length() - 1)
+        need = max_len - start + steps
+        n_scan = min(1 << (need - 1).bit_length(), seq_len - start)
+        out = self._run_decode(ctx, lens, start, n_scan, steps)
+        res = [out[i, : lens[i] + steps] for i in range(len(rows))]
+        if eos_id is not None:
+            res = [
+                self._trim_eos(row, int(L), int(eos_id))
+                for row, L in zip(res, lens)
+            ]
+        return res
+
+    def _decode_fn(self, min_len, n_scan, steps, temp):
+        """Build THE decode scan (rectangular batches are the uniform-
+        lens special case). At scanned position pos, rows still inside
+        their prompt keep the prompt token (the sampled candidate is
+        discarded), rows past their generation window freeze, everyone
+        else appends the sampled/greedy token. Each row thus generates
+        exactly ``steps`` tokens starting at its own prompt end."""
+        apply = self.model.apply
+
+        def decode(params, state, ctx, lens, key):
+            def step(carry, i):
+                ctx, key = carry
+                logits, _ = apply(params, state, ctx, train=False)
+                pos = min_len - 1 + i
+                logit = jax.lax.dynamic_index_in_dim(
+                    logits, pos, axis=1, keepdims=False
+                )  # (B, V)
+                if temp == 0.0:
+                    tok = jnp.argmax(logit, axis=-1)
+                else:
+                    key, sub = jax.random.split(key)
+                    tok = jax.random.categorical(
+                        sub, self._filter_logits(logit / temp), axis=-1
+                    )
+                ctx, tok = self._masked_write(ctx, lens, steps, pos, tok)
+                return (ctx, key), tok
+
+            (ctx, _), _ = jax.lax.scan(
+                step, (ctx, key), jnp.arange(n_scan)
+            )
+            return ctx
+
+        return jax.jit(decode)
+
+    @staticmethod
+    def _masked_write(ctx, lens, steps, pos, tok):
+        """Write ``tok`` at column pos+1 under the ragged masks — rows
+        still inside their prompt keep the prompt token (the candidate
+        is discarded), rows past their generation window freeze (the
+        existing pad is written back). The one place the ragged-decode
+        invariant lives; both scan bodies call it. Returns (ctx, the
+        token actually written)."""
+        tok = tok.astype(ctx.dtype)
+        cur = jax.lax.dynamic_index_in_dim(
+            ctx, pos + 1, axis=1, keepdims=False
+        )  # (B,) existing token (prompt or pad)
+        in_prompt = (pos + 1) < lens
+        frozen = (pos + 1) >= lens + steps
+        tok = jnp.where(in_prompt | frozen, cur, tok)
+        ctx = jax.lax.dynamic_update_slice_in_dim(
+            ctx, tok[:, None], pos + 1, axis=1
+        )
+        return ctx, tok
 
 
 class CachedSequenceGenerator(SequenceGenerator):
@@ -343,16 +474,51 @@ class CachedSequenceGenerator(SequenceGenerator):
         h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
         return x + h_, cache_k, cache_v
 
-    def _decode_fn(self, prompt_len, steps, temp):
+    def _prefill(self, bp, caches, x):
+        """Run ``x`` (B, PP, d) pre-embedded prompt prefix through every
+        block, filling each cache's first PP rows; returns (hidden,
+        caches)."""
         from distkeras_tpu.parallel.ring_attention import dense_attention
 
+        bsz, pp, _ = x.shape
+        nh = self._blocks[0].mhsa.num_heads
+        new_caches = []
+        for blk, p, (ck, cv) in zip(self._blocks, bp, caches):
+            mh = p["mhsa"]
+            hd = qshape(mh["wq"])[1] // nh
+            h_, _ = blk.ln1.apply(p["ln1"], {}, x)
+            q = qmatmul(h_, mh["wq"]).reshape(bsz, pp, nh, hd)
+            k = qmatmul(h_, mh["wk"]).reshape(bsz, pp, nh, hd)
+            v = qmatmul(h_, mh["wv"]).reshape(bsz, pp, nh, hd)
+            ck = ck.at[:, :pp].set(k.astype(ck.dtype))
+            cv = cv.at[:, :pp].set(v.astype(cv.dtype))
+            o = dense_attention(q, k, v, causal=True)
+            o = qmatmul(o.reshape(bsz, pp, nh * hd), mh["wo"])
+            if "bo" in mh:
+                o = o + mh["bo"]
+            x = x + o
+            h_, _ = blk.ln2.apply(p["ln2"], {}, x)
+            h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
+            h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
+            x = x + h_
+            new_caches.append((ck, cv))
+        return x, new_caches
+
+    def _decode_fn(self, min_len, n_scan, steps, temp):
+        """THE cached decode builder (rectangular = uniform lens). The
+        prefill covers positions 0..min_len-2 — every row's prompt
+        reaches at least min_len, so those are real tokens for the whole
+        batch; each scanned step then advances one position for
+        everyone, with the same keep-prompt / frozen masking as the
+        uncached scan (rows re-embed their own prompt tokens until their
+        prompt ends, then append exactly ``steps`` generated tokens)."""
         blocks = self._blocks
         final_ln, head = self._final_ln, self._head
         seq_len = self.model.input_shape[0]
         n_blocks = len(blocks)
 
-        def decode(params, state, ctx, key):
-            del state  # the LM family carries no mutable state
+        def decode(params, state, ctx, lens, key):
+            del state
             bp = [params[str(1 + i)] for i in range(n_blocks)]
             p_emb = params["0"]
             p_ln = params[str(1 + n_blocks)]
@@ -375,37 +541,16 @@ class CachedSequenceGenerator(SequenceGenerator):
                 )
                 for _ in range(n_blocks)
             ]
-            # ---- prefill positions 0..P-2 in one vectorized pass -------
-            if prompt_len > 1:
-                pp = prompt_len - 1
+            if min_len > 1:
+                pp = min_len - 1
                 x = p_emb["tokens"][ctx[:, :pp]]
                 if "positions" in p_emb:
                     x = x + p_emb["positions"][:pp]
-                new_caches = []
-                for blk, p, (ck, cv) in zip(blocks, bp, caches):
-                    mh = p["mhsa"]
-                    h_, _ = blk.ln1.apply(p["ln1"], {}, x)
-                    q = qmatmul(h_, mh["wq"]).reshape(bsz, pp, nh, hd)
-                    k = qmatmul(h_, mh["wk"]).reshape(bsz, pp, nh, hd)
-                    v = qmatmul(h_, mh["wv"]).reshape(bsz, pp, nh, hd)
-                    ck = ck.at[:, :pp].set(k.astype(ck.dtype))
-                    cv = cv.at[:, :pp].set(v.astype(cv.dtype))
-                    o = dense_attention(q, k, v, causal=True)
-                    o = qmatmul(o.reshape(bsz, pp, nh * hd), mh["wo"])
-                    if "bo" in mh:
-                        o = o + mh["bo"]
-                    x = x + o
-                    h_, _ = blk.ln2.apply(p["ln2"], {}, x)
-                    h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
-                    h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
-                    x = x + h_
-                    new_caches.append((ck, cv))
-                caches = new_caches
+                _, caches = self._prefill(bp, caches, x)
 
-            # ---- scan: one cached-attention row per generated token ----
             def step(carry, i):
-                tok, caches, key = carry
-                pos = prompt_len - 1 + i
+                tok, ctx, caches, key = carry
+                pos = min_len - 1 + i
                 x = embed(tok, pos)
                 t_mask = jnp.arange(seq_len) <= pos
                 new_caches = []
@@ -423,18 +568,13 @@ class CachedSequenceGenerator(SequenceGenerator):
                     nxt = jax.random.categorical(
                         sub, self._filter_logits(logit / temp), axis=-1
                     )
-                return (nxt.astype(tok.dtype), new_caches, key), nxt
+                ctx, nxt = self._masked_write(ctx, lens, steps, pos, nxt)
+                return (nxt, ctx, new_caches, key), nxt
 
-            tok0 = ctx[:, prompt_len - 1]
-            (_, _, _), toks = jax.lax.scan(
-                step, (tok0, caches, key), jnp.arange(steps)
+            tok0 = ctx[:, min_len - 1]
+            (_, ctx, _, _), _ = jax.lax.scan(
+                step, (tok0, ctx, caches, key), jnp.arange(n_scan)
             )
-            # toks: (steps, B) generated tokens for positions P..P+steps-1
-            out = ctx
-            out = jax.lax.dynamic_update_slice_in_dim(
-                out, jnp.swapaxes(toks, 0, 1).astype(ctx.dtype),
-                prompt_len, axis=1,
-            )
-            return out
+            return ctx
 
         return jax.jit(decode)

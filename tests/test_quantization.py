@@ -14,10 +14,13 @@ import pytest
 
 from distkeras_tpu.models import zoo
 from distkeras_tpu.ops.quantization import (
+    Int4Weight,
     count_quantized,
     dequantize,
     is_quantized,
     qmatmul,
+    qshape,
+    quantize_int4,
     quantize_int8,
     quantize_model,
     quantize_params,
@@ -161,6 +164,136 @@ def test_bf16_kv_cache_decode():
     assert out_bundle.shape == out_f.shape
     agree_b = (out_f[:, 8:] == out_bundle[:, 8:]).mean()
     assert agree_b >= 0.5, agree_b  # int8-dominated; measured 0.859
+
+
+@pytest.mark.parametrize("rows", [64, 63])
+def test_int4_pack_roundtrip_is_exact_on_int4_values(rows):
+    """Values already on the int4 grid survive pack -> unpack bit-exactly
+    (the nibble arithmetic itself, incl. sign extension and the odd-row
+    pad, loses nothing; only round() loses information)."""
+    rng = np.random.default_rng(10)
+    grid = rng.integers(-7, 8, (rows, 32)).astype(np.float32)
+    qw = quantize_int4(jnp.asarray(grid))
+    assert isinstance(qw, Int4Weight)
+    assert qw.q4.shape == ((rows + 1) // 2, 32) and qw.q4.dtype == jnp.int8
+    assert qshape(qw) == (rows, 32)
+    scale = np.asarray(qw.s)  # max|col| / 7; grid values are multiples
+    np.testing.assert_allclose(
+        np.asarray(dequantize(qw)), grid, atol=1e-5
+    )
+    assert scale.shape == (32,)
+
+
+def test_int4_roundtrip_error_within_half_scale():
+    rng = np.random.default_rng(11)
+    w = jnp.asarray(rng.standard_normal((64, 32)).astype(np.float32))
+    qw = quantize_int4(w)
+    err = np.abs(np.asarray(dequantize(qw)) - np.asarray(w))
+    half_scale = np.asarray(qw.s) / 2 + 1e-7
+    assert (err <= half_scale[None, :]).all()
+
+
+@pytest.mark.parametrize("rows", [64, 63])
+def test_int4_qmatmul_equals_dequantized_matmul(rows):
+    rng = np.random.default_rng(12)
+    w = jnp.asarray(rng.standard_normal((rows, 48)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((8, rows)).astype(np.float32))
+    qw = quantize_int4(w)
+    np.testing.assert_allclose(
+        np.asarray(qmatmul(x, qw)),
+        np.asarray(x @ dequantize(qw)),
+        atol=1e-4,
+    )
+    # and under jit, with Int4Weight riding the params pytree (rows is
+    # static aux data, so the unpack shapes are concrete at trace time)
+    import jax
+
+    jitted = jax.jit(qmatmul)
+    np.testing.assert_allclose(
+        np.asarray(jitted(x, qw)), np.asarray(qmatmul(x, qw)), atol=1e-6
+    )
+
+
+def test_int4_tree_walk_and_rejections():
+    lm = zoo.transformer_lm(
+        vocab_size=97, d_model=32, depth=2, seq_len=48, num_heads=4, seed=0
+    )
+    q = quantize_params(lm.params, bits=4)
+    assert count_quantized(q) == 2 * 6 + 1
+    assert is_quantized(q["2"]["mhsa"]["wq"])
+    # a tree quantized at one width does not re-quantize at another
+    assert count_quantized(quantize_params(q, bits=8)) == count_quantized(q)
+    with pytest.raises(ValueError, match="bits"):
+        quantize_params(lm.params, bits=2)
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.utils.serialization import serialize_model
+
+    m4 = quantize_model(zoo.mnist_mlp(hidden=32, seed=0), bits=4)
+    with pytest.raises(ValueError, match="quantized"):
+        SingleTrainer(m4, "sgd", loss="categorical_crossentropy")
+    with pytest.raises(ValueError, match="LOAD-TIME"):
+        serialize_model(m4)
+
+
+def test_int4_classifier_argmax_mostly_survives():
+    """Eighth-width weights on a random-init MLP: the agreement bar is
+    necessarily looser than int8's 0.97 (half the mantissa of nothing —
+    these are near-flat logits); trained models hold much higher."""
+    m = zoo.mnist_mlp(hidden=64, seed=0)
+    rng = np.random.default_rng(13)
+    X = rng.standard_normal((512, 784)).astype(np.float32)
+    logits_f = m.predict(X)
+    quantize_model(m, bits=4)
+    logits_q = m.predict(X)
+    agree = (logits_f.argmax(1) == logits_q.argmax(1)).mean()
+    assert agree >= 0.8, agree  # measured 0.934 on the pinned seed
+
+
+def test_int4_cached_decode_runs_and_matches_uncached():
+    lm = zoo.transformer_lm(
+        vocab_size=97, d_model=32, depth=2, seq_len=48, num_heads=4, seed=0
+    )
+    lm4 = quantize_model(lm.copy(), bits=4)
+    rng = np.random.default_rng(14)
+    prompts = rng.integers(0, 97, (4, 8))
+    out_c = CachedSequenceGenerator(lm4).generate(prompts, 16)
+    out_u = SequenceGenerator(lm4).generate(prompts, 16)
+    # both serving paths hit the same qmatmul sites: identical output
+    np.testing.assert_array_equal(out_c, out_u)
+    assert out_c.shape == (4, 24)
+
+
+@pytest.mark.slow
+def test_int4_real_digits_accuracy():
+    """End-to-end on REAL data: int4 serves the trained digits classifier
+    within two points of f32 (measured: f32 0.9481, int4 0.9407 on the
+    pinned seed) — the honest cost of eighth-width weights."""
+    from distkeras_tpu import AccuracyEvaluator, ModelPredictor, SingleTrainer
+    from distkeras_tpu.data.loaders import digits
+    from distkeras_tpu.data.transformers import (
+        MinMaxTransformer,
+        OneHotTransformer,
+    )
+    from distkeras_tpu.models.zoo import digits_mlp
+
+    ds = digits()
+    ds = MinMaxTransformer(0, 1, o_min=0, o_max=16).transform(ds)
+    ds = OneHotTransformer(10, output_col="label_onehot").transform(ds)
+    train, test = ds.split(0.85, seed=7)
+    trained = SingleTrainer(
+        digits_mlp(seed=0), "adam", loss="categorical_crossentropy",
+        label_col="label_onehot", batch_size=32, num_epoch=6, seed=0,
+    ).train(train)
+    acc_f = AccuracyEvaluator(label_col="label").evaluate(
+        ModelPredictor(trained, batch_size=256).predict(test)
+    )
+    acc_4 = AccuracyEvaluator(label_col="label").evaluate(
+        ModelPredictor(
+            quantize_model(trained.copy(), bits=4), batch_size=256
+        ).predict(test)
+    )
+    assert acc_f > 0.9, acc_f
+    assert acc_4 >= acc_f - 0.02, (acc_f, acc_4)
 
 
 @pytest.mark.slow
