@@ -203,7 +203,10 @@ def measure(
         ),
     }
     if attention == "flash":
-        from distkeras_tpu.ops.flash_attention import effective_path
+        from distkeras_tpu.ops.flash_attention import (
+            effective_bwd_blocks,
+            effective_path,
+        )
 
         # always recorded: an artifact must say which kernel config it
         # measured (blocks clamp to seq for short T), and which path the
@@ -219,6 +222,13 @@ def measure(
         record["effective_attention"] = eff_path
         record["effective_block_q"] = eff_bq
         record["effective_block_k"] = eff_bk
+        # the backward re-clamps blocks under its own VMEM model (the
+        # seq-4096 dkv kernel OOMed at the forward's 512s, v5e
+        # 2026-08-01); record what the bwd actually runs so the artifact
+        # keeps the single-source-of-dispatch promise for BOTH passes
+        bwd = effective_bwd_blocks(seq, d_model // heads, block_q, block_k)
+        if bwd is not None:
+            record["effective_bwd_block_q"], record["effective_bwd_block_k"] = bwd
     peak = _peak_flops(dev)
     if peak is not None:
         record["value"] = round(fps / peak, 4)

@@ -229,9 +229,45 @@ def _dkv_kernel(
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_blocks(t, d, bq, bk):
+    """Clamp BACKWARD block sizes so each program's scoped VMEM fits.
+
+    The backward kernels are hungrier than the forward: each program
+    holds two full (t, d) streams (k+v for dq; q+do for dkv) plus ~4
+    (bq, bk) f32 intermediates (s, p, dp, ds), and Mosaic double-buffers
+    the streamed operands. On chip this bit at t=4096, d=64,
+    bq=bk=512: "scoped allocation 16.64M > 16.00M limit" in the dkv
+    kernel (v5e, 2026-08-01) — a failure the CPU interpreter can never
+    see, since interpret mode doesn't model VMEM. The estimate below is
+    deliberately coarse; its one calibration point is that it clamps
+    the measured-failing (4096, 512, 512) case while leaving the
+    measured-healthy (2048, 512, 512) one alone. Halving preserves
+    divisibility for the power-of-two blocks ``effective_path`` picks;
+    the guard skips candidates that stop tiling t (block == t short
+    seqs never hit the budget anyway)."""
+
+    def est(bq_, bk_):
+        full = 2 * t * d * 4 * 2       # two full streams, double-buffered
+        inter = 4 * bq_ * bk_ * 4      # s / p / dp / ds
+        blocks = 6 * max(bq_, bk_) * d * 4  # block ins/outs + accumulators
+        return full + inter + blocks
+
+    while est(bq, bk) > _VMEM_KV_BUDGET_BYTES and max(bq, bk) > 128:
+        big = "bq" if bq >= bk else "bk"
+        cand = (bq if big == "bq" else bk) // 2
+        if cand < 128 or t % cand != 0:
+            break
+        if big == "bq":
+            bq = cand
+        else:
+            bk = cand
+    return bq, bk
+
+
 def _bwd(causal, bq, bk, interpret, residuals, dout):
     q, k, v, out, lse = residuals
     b, h, t, d = q.shape
+    bq, bk = _bwd_blocks(t, d, bq, bk)
     scale = 1.0 / (d**0.5)
     # delta_i = sum_d do_i * o_i — rowwise, cheap in XLA, shared by both
     # backward kernels (the FlashAttention-2 trick that removes dp row sums);
@@ -296,9 +332,11 @@ def effective_path(t, head_dim, block_q=DEFAULT_BLOCK_Q,
     """(path, bq, bk) that ``flash_attention`` will actually run for
     sequence length ``t``: path is "flash", "blockwise" (K+V past the
     VMEM budget), or "dense" (T does not tile the clamped blocks); bq/bk
-    are the clamped block sizes. The single source of the dispatch
-    decision — the dispatch below and the benchmark harnesses both read
-    it, so an artifact can never claim a kernel that silently fell back."""
+    are the clamped FORWARD block sizes. The single source of the
+    dispatch decision — the dispatch below and the benchmark harnesses
+    both read it, so an artifact can never claim a kernel that silently
+    fell back. The backward re-clamps under its own VMEM model; read
+    ``effective_bwd_blocks`` for what the bwd kernels actually run."""
     bq = min(block_q, t)
     bk = min(block_k, t)
     if 2 * t * head_dim * 4 > _VMEM_KV_BUDGET_BYTES:
@@ -312,6 +350,20 @@ def effective_path(t, head_dim, block_q=DEFAULT_BLOCK_Q,
     if bq is None or bk is None:
         return "dense", min(block_q, t), min(block_k, t)
     return "flash", bq, bk
+
+
+def effective_bwd_blocks(t, head_dim, block_q=DEFAULT_BLOCK_Q,
+                         block_k=DEFAULT_BLOCK_K):
+    """(bq, bk) the BACKWARD kernels will actually run for sequence
+    length ``t`` on the flash path: ``effective_path``'s forward blocks
+    re-clamped by the backward VMEM model (``_bwd_blocks`` — the same
+    function ``_bwd`` itself calls, so harness artifacts and the
+    dispatch agree by construction). None when the path isn't flash
+    (no backward kernel runs)."""
+    path, bq, bk = effective_path(t, head_dim, block_q, block_k)
+    if path != "flash":
+        return None
+    return _bwd_blocks(t, head_dim, bq, bk)
 
 
 def _largest_tiling_block(t, block):

@@ -178,3 +178,33 @@ def test_attach_flash_trains_transformer():
     m_flash = SingleTrainer(model, "adam", **kw).train(ds)
     for a, b in zip(m_dense.get_weights(), m_flash.get_weights()):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+
+def test_bwd_blocks_clamp_matches_measured_chip_budget():
+    """Backward-only block clamping (ops/flash_attention._bwd_blocks):
+    the dkv kernel scoped-VMEM-OOMed on chip at t=4096, bq=bk=512
+    (16.64M > 16M, v5e 2026-08-01) while t=2048 measured healthy — the
+    clamp must split exactly that pair of cases, and must never emit a
+    block that stops tiling t."""
+    from distkeras_tpu.ops.flash_attention import _bwd_blocks
+
+    assert _bwd_blocks(4096, 64, 512, 512) == (256, 512)  # measured OOM
+    assert _bwd_blocks(2048, 64, 512, 512) == (512, 512)  # measured OK
+    assert _bwd_blocks(256, 64, 256, 256) == (256, 256)   # short seq
+    bq, bk = _bwd_blocks(65536, 64, 512, 512)             # floor
+    assert bq >= 128 and bk >= 128
+    assert 4096 % _bwd_blocks(4096, 64, 512, 512)[0] == 0
+
+
+def test_effective_bwd_blocks_tracks_dispatch():
+    """effective_bwd_blocks is the harness-facing view of the backward
+    clamp: same function _bwd calls, so artifacts record what ran."""
+    from distkeras_tpu.ops.flash_attention import effective_bwd_blocks
+
+    assert effective_bwd_blocks(4096, 64) == (256, 512)
+    assert effective_bwd_blocks(2048, 64) == (512, 512)
+    # non-flash paths run no backward kernel
+    assert effective_bwd_blocks(640, 64, 512, 512) == (
+        effective_bwd_blocks(640, 64, 512, 512)
+    )  # self-consistent
+    assert effective_bwd_blocks(65536, 64) is None  # blockwise path
