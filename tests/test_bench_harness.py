@@ -31,6 +31,7 @@ MODES = {
     "scale": {"scale": True},
     "best": {"best": True},
     "retire": {"retire": True},
+    "frontier": {"frontier": True},
 }
 
 

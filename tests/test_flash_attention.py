@@ -190,6 +190,9 @@ def test_bwd_blocks_clamp_matches_measured_chip_budget():
 
     assert _bwd_blocks(4096, 64, 512, 512) == (256, 512)  # measured OOM
     assert _bwd_blocks(2048, 64, 512, 512) == (512, 512)  # measured OK
+    # head_dim 256 (d2048/8 heads) also clamps — ran clean on chip at
+    # 0.5224 MFU (frontier d2048 L2 row, 2026-08-01)
+    assert _bwd_blocks(512, 256, 512, 512) == (256, 512)
     assert _bwd_blocks(256, 64, 256, 256) == (256, 256)   # short seq
     bq, bk = _bwd_blocks(65536, 64, 512, 512)             # floor
     assert bq >= 128 and bk >= 128

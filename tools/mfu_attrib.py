@@ -25,7 +25,7 @@ from bench_mfu import measure  # noqa: E402
 
 
 def mode_configs(quick=False, long=False, scale=False, best=False,
-                 retire=False):
+                 retire=False, frontier=False):
     """The (label, measure-kwargs) list for each sweep mode — a plain
     function so tests can pin every mode's kwargs against ``measure``'s
     real signature without a TPU."""
@@ -80,6 +80,21 @@ def mode_configs(quick=False, long=False, scale=False, best=False,
             ("retire fused_ln d1024", {"fused_ln": True, **wide}),
             ("retire pallas_adam d1024", {"opt_name": "pallas_adam", **wide}),
         ]
+    elif frontier:
+        # Past the adjudicated best bundle (d1024 batch128 -> 0.525 MFU,
+        # 2026-08-01): does MFU keep climbing with wider matmuls (d2048,
+        # head_dim 256), more tokens per program (seq 1024 at d1024), or
+        # a still-bigger batch? Exploratory rows — whatever wins becomes
+        # the next --best once it has a second confirming window.
+        bundle = {"attention": "flash", "opt_name": "pallas_adam"}
+        configs = [
+            ("frontier d2048 L2", {"d_model": 2048, "depth": 2,
+                                   "batch": 32, **bundle}),
+            ("frontier d1024 seq1024", {"d_model": 1024, "depth": 4,
+                                        "seq": 1024, "batch": 32, **bundle}),
+            ("frontier d1024 batch256", {"d_model": 1024, "depth": 4,
+                                         "batch": 256, **bundle}),
+        ]
     return configs
 
 
@@ -87,7 +102,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="default sweep only: drop the block-size variants "
-                    "(no effect with --long/--scale/--best/--retire)")
+                    "(no effect with --long/--scale/--best/--retire/"
+                    "--frontier)")
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument(
         "--long", action="store_true",
@@ -101,11 +117,17 @@ def main() -> None:
     )
     mode.add_argument(
         "--best", action="store_true",
-        help="frontier rows with the measured-winning bundle (r5 "
-        "on-chip adjudication: flash wins everywhere, pallas_adam wins "
-        "at d1024, fused_ln retired): flash+pallas_adam at d1024 batch "
-        "64/128, and a seq-4096 A/B (8 K/V blocks/program — twice the "
-        "multi-block depth of --long)",
+        help="the ADJUDICATED winning-bundle rows (r5 on-chip: flash "
+        "wins everywhere, pallas_adam wins at d1024, fused_ln retired): "
+        "flash+pallas_adam at d1024 batch 64/128, and a seq-4096 A/B "
+        "(8 K/V blocks/program — twice the multi-block depth of "
+        "--long); for EXPLORATORY rows past this bundle see --frontier",
+    )
+    mode.add_argument(
+        "--frontier", action="store_true",
+        help="exploratory ceiling rows past the adjudicated best bundle: "
+        "d2048 (head_dim 256), seq-1024 at d1024, batch 256 — hunting "
+        "the next --best config",
     )
     mode.add_argument(
         "--retire", action="store_true",
@@ -133,7 +155,7 @@ def main() -> None:
 
     configs = mode_configs(quick=args.quick, long=args.long,
                            scale=args.scale, best=args.best,
-                           retire=args.retire)
+                           retire=args.retire, frontier=args.frontier)
 
     with open("MFU_ATTRIB.jsonl", "a") as f:
         for label, kw in configs:
