@@ -48,6 +48,7 @@ def measure(
     d_model=None,
     depth=None,
     batch=None,
+    remat=False,
 ):
     """One MFU measurement on the current backend; returns the record dict.
 
@@ -84,6 +85,11 @@ def measure(
         depth=depth,
         num_classes=n_classes,
         seed=0,
+        # jax.checkpoint per block: activation temps stay O(1) in depth
+        # at the cost of a forward recompute in the backward — the lever
+        # for batch/seq sizes whose f32 jvp temps outgrow HBM (the
+        # batch-256 OOM row, 2026-08-01)
+        remat=remat,
     )
     if fused_ln is None:
         fused_ln = False
@@ -202,6 +208,8 @@ def measure(
             else None
         ),
     }
+    if remat:
+        record["remat"] = True  # absent field == no checkpointing
     if attention == "flash":
         from distkeras_tpu.ops.flash_attention import (
             effective_bwd_blocks,

@@ -17,6 +17,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -92,8 +93,17 @@ def mode_configs(quick=False, long=False, scale=False, best=False,
                                    "batch": 32, **bundle}),
             ("frontier d1024 seq1024", {"d_model": 1024, "depth": 4,
                                         "seq": 1024, "batch": 32, **bundle}),
-            ("frontier d1024 batch256", {"d_model": 1024, "depth": 4,
-                                         "batch": 256, **bundle}),
+            # batch-256 WITHOUT remat is a known wall — f32 jvp temps OOM
+            # HBM (16.2G vs 15.75G; two committed error rows,
+            # 2026-08-01) — so the sweep no longer re-pays that compile:
+            # only the remat variant runs. Per-block jax.checkpoint
+            # trades a forward recompute for O(1)-in-depth activation
+            # memory; measured 0.4248 MFU — the shape fits, ~10 points
+            # below batch-128, adjudicating remat as the capability
+            # lever rather than the throughput config.
+            ("frontier d1024 batch256 remat",
+             {"d_model": 1024, "depth": 4, "batch": 256, "remat": True,
+              **bundle}),
         ]
     return configs
 
@@ -126,8 +136,9 @@ def main() -> None:
     mode.add_argument(
         "--frontier", action="store_true",
         help="exploratory ceiling rows past the adjudicated best bundle: "
-        "d2048 (head_dim 256), seq-1024 at d1024, batch 256 — hunting "
-        "the next --best config",
+        "d2048 (head_dim 256), seq-1024 at d1024, and batch-256 with "
+        "per-block remat (without remat batch-256 OOMs HBM — committed "
+        "error rows) — hunting the next --best config",
     )
     mode.add_argument(
         "--retire", action="store_true",
@@ -156,7 +167,28 @@ def main() -> None:
     configs = mode_configs(quick=args.quick, long=args.long,
                            scale=args.scale, best=args.best,
                            retire=args.retire, frontier=args.frontier)
+    mode_name = next(
+        (m for m in ("long", "scale", "best", "retire", "frontier")
+         if getattr(args, m)),
+        "quick" if args.quick else "default",
+    )
 
+    # Every sweep self-documents its provenance in TPU_CAPTURE.log,
+    # however it was invoked: interactive runs used to leave rows in
+    # MFU_ATTRIB.jsonl with no capture trail (and a concurrent watcher
+    # sweep can interleave appends), which made the jsonl unauditable —
+    # the stamp ties each row to a dated invocation.
+    def stamp(line):
+        with open("TPU_CAPTURE.log", "a") as logf:
+            logf.write(
+                time.strftime("%Y-%m-%dT%H:%M:%SZ ", time.gmtime()) + line
+                + "\n"
+            )
+
+    stamp(
+        f"mfu_attrib --{mode_name} start device={dev.device_kind} "
+        f"pid={os.getpid()} rows={[label for label, _ in configs]}"
+    )
     with open("MFU_ATTRIB.jsonl", "a") as f:
         for label, kw in configs:
             try:
@@ -168,6 +200,11 @@ def main() -> None:
             print(json.dumps(rec), flush=True)
             f.write(json.dumps(rec) + "\n")
             f.flush()
+            stamp(
+                f"mfu_attrib --{mode_name} row {label!r}: "
+                + (f"value={rec.get('value')}" if "error" not in rec
+                   else "ERROR " + rec["error"].split(chr(10))[0][:120])
+            )
 
 
 if __name__ == "__main__":
