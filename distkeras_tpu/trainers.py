@@ -1706,6 +1706,7 @@ class DistributedTrainer(Trainer):
         worker_snapshot_stride=1,
         worker_retries=1,
         heartbeat_timeout=None,
+        elastic=False,
         device_resident=False,
         compress=None,
         pull_compress=None,
@@ -1762,8 +1763,21 @@ class DistributedTrainer(Trainer):
         # thread that flags workers gone silent.
         self.worker_retries = int(worker_retries)
         self.heartbeat_timeout = heartbeat_timeout
+        # elastic=True (threads/socket modes): a partition whose worker
+        # exhausts its retries is ORPHANED instead of abandoned — the
+        # first surviving worker to finish its own partition adopts it,
+        # re-running the dead worker OBJECT (same worker id, same commit
+        # sequence), so PS dedup keeps already-landed windows exactly-
+        # once. Heals time-correlated failures (an outage that outlives
+        # the owner thread's retry budget but not the epoch); a worker
+        # whose own state is corrupt will fail its adopter too, and the
+        # partition is then recorded abandoned. No reference counterpart
+        # (SURVEY §5.3 — Spark simply reschedules; here adoption must
+        # thread through the PS dedup contract).
+        self.elastic = bool(elastic)
         self.failures = []
         self.suspicions = []
+        self.adoptions = []  # [{worker_id, adopted_by, ok}]
         self._active_workers = []  # live workers, read by the snapshot hook
 
     # -- template hooks -----------------------------------------------------
@@ -1998,36 +2012,106 @@ class DistributedTrainer(Trainer):
     def _run_threads(self, workers, parts):
         done = set()  # worker ids that exited (finished or gave up) — a
         done_lock = threading.Lock()  # completed worker is not a failure
+        orphans = []  # [(worker, part)] partitions whose owner gave up
+
+        def attempt_partition(w, part, adopted_by=None, reset_first=False):
+            """Run one partition to completion with the retry budget;
+            True on success. Failure records carry ``adopted_by`` when a
+            survivor is re-running a dead worker's object. Every
+            ``reset_for_retry`` runs INSIDE the crash boundary: in
+            remote_ps mode it reconnects sockets and can itself raise
+            during the very outage elastic exists for — a raise there
+            must become a recorded failure, not a lost orphan or an
+            exception escaping the post-join drain."""
+            for attempt in range(self.worker_retries + 1):
+                try:
+                    if attempt > 0 or reset_first:
+                        w.reset_for_retry()
+                    w.train(
+                        part,
+                        self.batch_size,
+                        num_epoch=self.num_epoch,
+                        shuffle_seed=self.seed + w.worker_id,
+                        device_resident=self.device_resident,
+                    )
+                    return True
+                except Exception as e:  # noqa: BLE001 — crash boundary
+                    failure = {
+                        "worker_id": w.worker_id,
+                        "attempt": attempt,
+                        "error": repr(e),
+                    }
+                    if adopted_by is not None:
+                        failure["adopted_by"] = adopted_by
+                    self.failures.append(failure)
+                    if self.metrics_logger is not None:
+                        self.metrics_logger.log(
+                            event="worker_failure", **failure
+                        )
+                    if attempt == self.worker_retries:
+                        return False  # give up; others keep training
 
         def run(w, part):
             try:
-                for attempt in range(self.worker_retries + 1):
-                    try:
-                        w.train(
-                            part,
-                            self.batch_size,
-                            num_epoch=self.num_epoch,
-                            shuffle_seed=self.seed + w.worker_id,
-                            device_resident=self.device_resident,
+                ok = attempt_partition(w, part)
+                if not ok and self.elastic:
+                    with done_lock:
+                        orphans.append((w, part))
+                    if self.metrics_logger is not None:
+                        self.metrics_logger.log(
+                            event="partition_orphaned", worker_id=w.worker_id
                         )
-                        return
-                    except Exception as e:  # noqa: BLE001 — crash boundary
-                        failure = {
-                            "worker_id": w.worker_id,
-                            "attempt": attempt,
-                            "error": repr(e),
-                        }
-                        self.failures.append(failure)
-                        if self.metrics_logger is not None:
-                            self.metrics_logger.log(
-                                event="worker_failure", **failure
-                            )
-                        if attempt == self.worker_retries:
-                            return  # give up; others keep training
-                        w.reset_for_retry()
             finally:
+                # mark done BEFORE any adoption: this worker will never
+                # commit under its own id again, so the heartbeat
+                # monitor must not suspect it while it re-runs someone
+                # else's partition under the dead worker's id
                 with done_lock:
                     done.add(w.worker_id)
+            # elastic adoption: only a worker that FINISHED its own
+            # partition adopts (a struggling worker must not pile
+            # orphans onto itself).
+            while ok and self.elastic and try_adopt(w.worker_id):
+                pass
+
+        def try_adopt(adopter_id):
+            """Pop and re-run one orphaned partition; False when the
+            queue is empty. The dead worker OBJECT re-runs — same id,
+            same commit seqs, so PS dedup keeps its already-landed
+            windows exactly-once. A failed adoption abandons the
+            partition (no re-orphan: a second adopter would hit the same
+            corrupt state, and the loop must terminate). While the
+            adoption runs, the dead id leaves ``done`` so the heartbeat
+            monitor watches the re-run (a hung adoption is suspectable);
+            it returns on completion either way."""
+            with done_lock:
+                if not orphans:
+                    return False
+                dead_w, dead_part = orphans.pop()
+                done.discard(dead_w.worker_id)
+            try:
+                adopted_ok = attempt_partition(
+                    dead_w, dead_part, adopted_by=adopter_id,
+                    reset_first=True,
+                )
+            finally:
+                with done_lock:
+                    done.add(dead_w.worker_id)
+            adoption = {
+                "worker_id": dead_w.worker_id,
+                "adopted_by": adopter_id,
+                "ok": bool(adopted_ok),
+            }
+            self.adoptions.append(adoption)
+            if self.metrics_logger is not None:
+                self.metrics_logger.log(
+                    event=(
+                        "partition_adopted" if adopted_ok
+                        else "partition_abandoned"
+                    ),
+                    **adoption,
+                )
+            return True
 
         stop_monitor = threading.Event()
         monitor = None
@@ -2047,6 +2131,13 @@ class DistributedTrainer(Trainer):
             t.start()
         for t in threads:
             t.join()
+        # straggler orphans: a survivor that finished BEFORE the owner
+        # gave up saw an empty queue and exited — drain what's left here
+        # so an orphan is never silently stranded (and if every worker
+        # gave up, each partition still gets one post-outage attempt)
+        if self.elastic:
+            while try_adopt("main"):
+                pass
         stop_monitor.set()
         if monitor is not None:
             monitor.join()

@@ -265,3 +265,164 @@ def test_snapshot_failure_does_not_crash_committing_worker():
     ps.on_snapshot = exploding_snapshot
     ps.commit(DELTA, commit_id=(0, 0))  # must not raise
     assert ps.num_updates == 1
+
+
+# ------------------------------------------------- elastic partition adoption
+
+
+class OutageDOWNPOURWorker(DOWNPOURWorker):
+    """Models a time-correlated outage: worker 0 crashes at its 2nd
+    commit on each of its first ``heal_after`` train() attempts, then
+    behaves — an outage that outlives the owner thread's retry budget
+    but not the epoch (the case elastic adoption exists for)."""
+
+    heal_after = 2
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._attempts = 0
+
+    def train(self, *args, **kwargs):
+        self._attempts += 1
+        return super().train(*args, **kwargs)
+
+    def finish_window(self):
+        if (
+            self.worker_id == 0
+            and self._attempts <= self.heal_after
+            and self._seq == 2
+        ):
+            self._pending = None
+            raise RuntimeError("injected outage")
+        super().finish_window()
+
+
+class OutageDOWNPOUR(DOWNPOUR):
+    worker_cls = OutageDOWNPOURWorker
+
+
+def test_elastic_adoption_trains_full_dataset(tmp_path):
+    """Worker 0's outage outlives its retry budget (1 retry, heals on
+    attempt 3): without elastic its partition's tail is lost; with it, a
+    survivor adopts the dead worker's OBJECT and the full dataset
+    trains, with PS dedup keeping the replayed commits exactly-once."""
+    ds = make_data(n=512)
+    metrics = str(tmp_path / "elastic.jsonl")
+    t = OutageDOWNPOUR(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=32,
+        num_workers=2,
+        communication_window=2,
+        num_epoch=1,
+        mode="threads",
+        label_col="label_onehot",
+        worker_retries=1,
+        elastic=True,
+        metrics_path=metrics,
+    )
+    t.train(ds)
+
+    # owner thread: initial + 1 retry, both crashed
+    owner_failures = [f for f in t.failures if "adopted_by" not in f]
+    assert len(owner_failures) == 2
+    assert all(f["worker_id"] == 0 for f in owner_failures)
+    # adoption succeeded — by the surviving worker when worker 0 gave up
+    # first, by the post-join main-thread drain when the survivor
+    # finished before the orphan appeared (both orders are correct;
+    # which one runs depends on thread scheduling)
+    assert len(t.adoptions) == 1
+    adoption = t.adoptions[0]
+    assert adoption["worker_id"] == 0 and adoption["ok"] is True
+    assert adoption["adopted_by"] in (1, "main")
+    events = {r["event"] for r in read_metrics(metrics)}
+    assert {"partition_orphaned", "partition_adopted"} <= events
+    # full dataset trained: each partition is 256 rows -> 4 windows.
+    # worker 0 committed seqs 0,1 before each crash; the retry and the
+    # adoption each replay them (2 x 2 deduped) before landing 2,3.
+    ps = t.parameter_server
+    assert ps.num_updates == 8, (ps.num_updates, ps.num_duplicates)
+    assert ps.num_duplicates == 4
+
+
+def test_elastic_abandons_unhealable_partition():
+    """A worker whose failure is NOT time-correlated (crashes forever)
+    fails its adopter too: the partition is recorded abandoned, train()
+    terminates, and the orphan is not re-queued."""
+    ds = make_data(n=512)
+
+    class AlwaysCrash(DOWNPOURWorker):
+        def finish_window(self):
+            if self.worker_id == 0:
+                raise RuntimeError("hard failure")
+            super().finish_window()
+
+    class Crashy(DOWNPOUR):
+        worker_cls = AlwaysCrash
+
+    t = Crashy(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=32,
+        num_workers=2,
+        communication_window=2,
+        num_epoch=1,
+        mode="threads",
+        label_col="label_onehot",
+        worker_retries=1,
+        elastic=True,
+    )
+    t.train(ds)  # must not raise or hang
+    assert len(t.adoptions) == 1
+    assert t.adoptions[0]["ok"] is False
+    # owner attempts (2) + adoption attempts (2), all worker 0
+    assert len(t.failures) == 4
+    assert all(f["worker_id"] == 0 for f in t.failures)
+    assert t.parameter_server.num_updates == 4  # worker 1's windows only
+
+
+def test_elastic_adoption_survives_reset_failure():
+    """reset_for_retry itself can raise mid-outage (remote_ps reconnect)
+    — it runs inside the crash boundary, so a failing reset becomes a
+    recorded failure + abandoned partition, never a lost orphan or an
+    exception escaping the post-join drain."""
+    ds = make_data(n=512)
+
+    class BrokenReset(DOWNPOURWorker):
+        def finish_window(self):
+            if self.worker_id == 0:
+                raise RuntimeError("hard failure")
+            super().finish_window()
+
+        def reset_for_retry(self):
+            if self.worker_id == 0:
+                raise ConnectionRefusedError("PS unreachable")
+            super().reset_for_retry()
+
+    class Broken(DOWNPOUR):
+        worker_cls = BrokenReset
+
+    t = Broken(
+        zoo.mnist_mlp(hidden=16),
+        "sgd",
+        "categorical_crossentropy",
+        learning_rate=0.05,
+        batch_size=32,
+        num_workers=2,
+        communication_window=2,
+        num_epoch=1,
+        mode="threads",
+        label_col="label_onehot",
+        worker_retries=0,
+        elastic=True,
+    )
+    t.train(ds)  # must not raise
+    assert len(t.adoptions) == 1 and t.adoptions[0]["ok"] is False
+    errors = [f["error"] for f in t.failures]
+    assert len(errors) == 2  # owner crash, then the adoption's reset
+    assert "ConnectionRefusedError" in errors[1]
+    assert t.parameter_server.num_updates == 4  # worker 1's windows only
