@@ -21,6 +21,10 @@ from distkeras_tpu.utils.serialization import (
     deserialize_params,
     save_params,
     load_params,
+    serialize_serving_bundle,
+    deserialize_serving_bundle,
+    save_serving_bundle,
+    load_serving_bundle,
 )
 from distkeras_tpu.utils.compile_cache import enable_compile_cache
 from distkeras_tpu.utils.history import TrainingHistory

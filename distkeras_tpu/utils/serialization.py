@@ -42,8 +42,22 @@ _NT_MODULE_ALLOWLIST = ("optax", "distkeras_tpu", "jax", "flax", "collections")
 
 
 def _encode_node(obj, leaves: list) -> dict:
+    from distkeras_tpu.ops.quantization import Int4Weight
+
     if obj is None:
         return {"t": "none"}
+    if isinstance(obj, Int4Weight):
+        # packed int4 weight (serving bundles): the two array children
+        # ride the leaf stream like any other; the logical row count is
+        # structural metadata
+        return {
+            "t": "int4",
+            "rows": int(obj.rows),
+            "children": [
+                _encode_node(obj.q4, leaves),
+                _encode_node(obj.s, leaves),
+            ],
+        }
     if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
         cls = type(obj)
         return {
@@ -104,6 +118,10 @@ def _decode_node(node: dict, leaves: list):
     if kind == "leaf":
         return leaves[node["i"]]
     children = [_decode_node(c, leaves) for c in node["children"]]
+    if kind == "int4":
+        from distkeras_tpu.ops.quantization import Int4Weight
+
+        return Int4Weight(children[0], children[1], int(node["rows"]))
     if kind == "dict":
         return dict(zip(node["keys"], children))
     if kind == "list":
@@ -179,6 +197,12 @@ def deserialize_model(blob: bytes):
     from distkeras_tpu.models.sequential import Sequential
 
     header, payload = unpack_frame(blob)
+    if header.get("serving"):
+        raise ValueError(
+            "this frame is a quantized SERVING bundle, not an f32 "
+            "model — load it with deserialize_serving_bundle / "
+            "load_serving_bundle"
+        )
     model = Sequential.from_config(json.loads(header["spec"]))
     model.build(tuple(header["input_shape"]))
     with np.load(io.BytesIO(payload), allow_pickle=False) as z:
@@ -194,3 +218,125 @@ def save_params(path: str, params) -> None:
 def load_params(path: str):
     with open(path, "rb") as f:
         return deserialize_params(f.read())
+
+
+# ------------------------------------------------------------ serving bundles
+
+
+def serialize_serving_bundle(model) -> bytes:
+    """Quantized model -> bytes, the DELIBERATE counterpart of
+    ``serialize_model``'s quantized-tree rejection: that guard stops a
+    lossy tree being saved AS the training master by accident; this
+    format exists so serving hosts don't ship 4-8x the weight bytes and
+    re-quantize on every boot. The frame carries the architecture spec
+    plus the quantized params tree (int8 dicts ride the leaf stream
+    natively; ``Int4Weight`` has a structural node). Loads serve-only:
+    trainers and ``serialize_model`` reject the result, exactly as they
+    reject any quantized tree."""
+    from distkeras_tpu.ops.quantization import count_quantized
+
+    if getattr(model, "params", None) is None:
+        raise ValueError("serving bundle needs a BUILT model")
+    if not count_quantized(model.params):
+        raise ValueError(
+            "model is not quantized — a serving bundle stores the "
+            "quantized tree (ops.quantization.quantize_model first); "
+            "for the f32 master use serialize_model"
+        )
+    return pack_frame(
+        {
+            "spec": json.dumps(model.get_config()),
+            "input_shape": list(model.input_shape),
+            "serving": True,
+        },
+        serialize_params(model.params),
+    )
+
+
+def deserialize_serving_bundle(blob: bytes):
+    """bytes -> a serve-only model: architecture rebuilt from the spec,
+    params replaced by the stored quantized tree (validated structurally
+    against the spec-built model — same tree paths, quantized leaves'
+    logical shapes matching the f32 ones they replace)."""
+    from distkeras_tpu.models.sequential import Sequential
+    from distkeras_tpu.ops.quantization import is_quantized, qshape
+
+    header, payload = unpack_frame(blob)
+    if not header.get("serving"):
+        raise ValueError(
+            "not a serving bundle (use deserialize_model for f32 frames)"
+        )
+    model = Sequential.from_config(json.loads(header["spec"]))
+    model.build(tuple(header["input_shape"]))
+    loaded = deserialize_params(payload)
+
+    def check(path, built, got):
+        if is_quantized(got):
+            # validate the quantized leaf's INTERNALS, not just its
+            # logical shape: a truncated q4 or a broadcastable (1,)
+            # scale would otherwise load cleanly and serve garbage
+            # (qshape trusts Int4Weight.rows; broadcasting hides a
+            # wrong-length s until the predictions are silently wrong)
+            from distkeras_tpu.ops.quantization import Int4Weight
+
+            want = tuple(np.shape(built))
+            if tuple(qshape(got)) != want:
+                raise ValueError(
+                    f"serving bundle shape mismatch at {path}: "
+                    f"spec builds {want}, bundle holds {tuple(qshape(got))}"
+                )
+            if isinstance(got, Int4Weight):
+                q4_want = ((want[0] + 1) // 2, want[1])
+                if tuple(np.shape(got.q4)) != q4_want or tuple(
+                    np.shape(got.s)
+                ) != (want[1],):
+                    raise ValueError(
+                        f"serving bundle int4 internals mismatch at "
+                        f"{path}: q4 {tuple(np.shape(got.q4))} vs "
+                        f"{q4_want}, s {tuple(np.shape(got.s))} vs "
+                        f"({want[1]},)"
+                    )
+            elif tuple(np.shape(got["q"])) != want or tuple(
+                np.shape(got["s"])
+            ) != (want[1],):
+                raise ValueError(
+                    f"serving bundle int8 internals mismatch at {path}: "
+                    f"q {tuple(np.shape(got['q']))} vs {want}, "
+                    f"s {tuple(np.shape(got['s']))} vs ({want[1]},)"
+                )
+            return
+        if isinstance(built, dict) != isinstance(got, dict) or (
+            isinstance(built, dict) and set(built) != set(got)
+        ):
+            raise ValueError(
+                f"serving bundle structure mismatch at {path}"
+            )
+        if isinstance(built, dict):
+            for k in built:
+                check(f"{path}/{k}", built[k], got[k])
+        elif isinstance(built, (list, tuple)):
+            if len(built) != len(got):
+                raise ValueError(
+                    f"serving bundle structure mismatch at {path}"
+                )
+            for i, (b, g) in enumerate(zip(built, got)):
+                check(f"{path}[{i}]", b, g)
+        elif np.shape(built) != np.shape(got):
+            raise ValueError(
+                f"serving bundle shape mismatch at {path}: "
+                f"{np.shape(built)} vs {np.shape(got)}"
+            )
+
+    check("params", model.params, loaded)
+    model.params = loaded
+    return model
+
+
+def save_serving_bundle(path: str, model) -> None:
+    with open(path, "wb") as f:
+        f.write(serialize_serving_bundle(model))
+
+
+def load_serving_bundle(path: str):
+    with open(path, "rb") as f:
+        return deserialize_serving_bundle(f.read())
