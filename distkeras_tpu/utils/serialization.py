@@ -280,6 +280,16 @@ def deserialize_serving_bundle(blob: bytes):
             from distkeras_tpu.ops.quantization import Int4Weight
 
             want = tuple(np.shape(built))
+            if len(want) != 2:
+                # quantization only ever replaces 2-D matmul weights; a
+                # "quantized" leaf standing in for a bias/LN gain is a
+                # crafted payload and must fail as a ValueError, not an
+                # IndexError on want[1] below
+                raise ValueError(
+                    f"serving bundle structure mismatch at {path}: "
+                    f"quantized leaf where the spec builds a "
+                    f"{len(want)}-D array"
+                )
             if tuple(qshape(got)) != want:
                 raise ValueError(
                     f"serving bundle shape mismatch at {path}: "
@@ -296,12 +306,12 @@ def deserialize_serving_bundle(blob: bytes):
                         f"{q4_want}, s {tuple(np.shape(got.s))} vs "
                         f"({want[1]},)"
                     )
-            elif tuple(np.shape(got["q"])) != want or tuple(
-                np.shape(got["s"])
-            ) != (want[1],):
+            # int8: qshape already IS q.shape, so only the scale vector
+            # needs its own check (a broadcastable (1,) would serve
+            # silently wrong numbers)
+            elif tuple(np.shape(got["s"])) != (want[1],):
                 raise ValueError(
                     f"serving bundle int8 internals mismatch at {path}: "
-                    f"q {tuple(np.shape(got['q']))} vs {want}, "
                     f"s {tuple(np.shape(got['s']))} vs ({want[1]},)"
                 )
             return

@@ -50,8 +50,17 @@ def main():
                     help="serve the decode demo from an int8 weight-only "
                     "copy (ops.quantization.quantize_model) — quarter the "
                     "HBM weight bytes per token on chip")
+    ap.add_argument("--save-bundle", metavar="PATH", default=None,
+                    help="with --int8: persist the quantized serving copy "
+                    "as a serving bundle, reload it, and run the decode "
+                    "demo from the RELOADED model (what a serving host "
+                    "does at boot)")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
+    if args.save_bundle and not args.int8:
+        # fail BEFORE training, not after a long run
+        ap.error("--save-bundle stores a QUANTIZED serving copy; "
+                 "pass --int8 too")
     from distkeras_tpu.parallel.backend import setup_backend
 
     # probe out-of-process: a dead TPU tunnel degrades to the virtual CPU
@@ -114,6 +123,19 @@ def main():
         serve_model = quantize_model(trained.copy())
         print(f"serving int8 weight-only "
               f"({count_quantized(serve_model.params)} quantized matrices)")
+        if args.save_bundle:
+            import os
+
+            from distkeras_tpu.utils.serialization import (
+                load_serving_bundle,
+                save_serving_bundle,
+            )
+
+            save_serving_bundle(args.save_bundle, serve_model)
+            serve_model = load_serving_bundle(args.save_bundle)
+            print(f"serving bundle: {os.path.getsize(args.save_bundle)} "
+                  f"bytes at {args.save_bundle}; decoding from the "
+                  f"RELOADED copy")
     gen = CachedSequenceGenerator(serve_model)
     if args.text is not None:
         p_len = min(16, max(1, args.seq // 2))
@@ -122,11 +144,27 @@ def main():
         out = gen.generate(prompt, steps=steps)
         txt = bytes(out[0].tolist()).decode("latin-1")
         print(f"decode from {txt[:p_len]!r} -> {txt[p_len:]!r}")
+    elif args.seq >= 8:
+        # a RAGGED serving batch: three prompts of different lengths in
+        # one compiled scan, each continued `steps` tokens (the model
+        # learned "count upward", so every row must keep counting from
+        # its own prompt end); prompt tokens wrap into the vocab
+        steps = min(12, args.seq - 5)
+        v = args.vocab
+        prompts = [
+            np.array([3 % v], np.int32),
+            np.array([x % v for x in (10, 11, 12)], np.int32),
+            np.arange(5, dtype=np.int32) % v,
+        ]
+        outs = gen.generate(prompts, steps=steps)
+        for row in outs:
+            print("greedy decode:", row.tolist())
     else:
-        seed_tok = 3
+        # tiny --seq: the single-prompt demo still fits
         steps = min(12, args.seq - 1)
-        out = gen.generate(np.array([[seed_tok]], np.int32), steps=steps)
-        print("greedy decode from", seed_tok, "->", out[0].tolist())
+        out = gen.generate(np.array([[3 % args.vocab]], np.int32),
+                           steps=steps)
+        print("greedy decode:", out[0].tolist())
 
 
 if __name__ == "__main__":

@@ -60,13 +60,21 @@ def test_diabetes_regression_cpu():
     assert "r2" in out.lower() or "R^2" in out, out
 
 
-def test_language_model_int8_cpu():
+def test_language_model_int8_bundle_cpu(tmp_path):
+    """--int8 --save-bundle: the decode demo runs a RAGGED batch from a
+    serving bundle RELOADED off disk — quantize, persist, reload, serve,
+    in one user-visible flow."""
+    bundle = str(tmp_path / "lm.dkt")
     out = run_example("language_model.py", "--cpu", "--int8",
-                      "--epochs", "2")
+                      "--epochs", "2", "--save-bundle", bundle)
     assert "serving int8 weight-only (13 quantized matrices)" in out
-    assert "greedy decode from 3 ->" in out
+    assert "decoding from the RELOADED copy" in out
+    assert os.path.getsize(bundle) > 0
     # 2 epochs on the counting task trains to ~1.0 next-token accuracy;
-    # the decoded continuation must actually count
-    tail = out.rsplit("-> [", 1)[1].rstrip("]\n")
-    toks = [int(t) for t in tail.split(",")]
-    assert toks[-5:] == list(range(toks[-5], toks[-5] + 5)), toks
+    # every ragged row's continuation must actually count from its own
+    # prompt end
+    rows = [l for l in out.splitlines() if l.startswith("greedy decode:")]
+    assert len(rows) == 3, out
+    for line in rows:
+        toks = [int(t) for t in line.split("[", 1)[1].rstrip("]").split(",")]
+        assert toks[-5:] == list(range(toks[-5], toks[-5] + 5)), toks
