@@ -37,6 +37,7 @@ from distkeras_tpu.trainers import (
     DynSGD,
 )
 from distkeras_tpu.predictors import (
+    BeamSearchGenerator,
     CachedSequenceGenerator,
     ModelPredictor,
     SequenceGenerator,

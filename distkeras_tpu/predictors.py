@@ -504,6 +504,43 @@ class CachedSequenceGenerator(SequenceGenerator):
             new_caches.append((ck, cv))
         return x, new_caches
 
+    def _decode_prologue(self, params, ctx, prompt_len):
+        """Shared trace-time prologue of every cached decode builder:
+        unpack the per-layer param groups, build the embed closure,
+        allocate the per-block K/V caches, and prefill positions
+        0..prompt_len-2. One copy — beam search and greedy/ragged decode
+        must never drift on cache layout or param indexing."""
+        n_blocks = len(self._blocks)
+        seq_len = self.model.input_shape[0]
+        bp = [params[str(1 + i)] for i in range(n_blocks)]
+        p_emb = params["0"]
+        p_ln = params[str(1 + n_blocks)]
+        p_head = params[str(2 + n_blocks)]
+        bsz = ctx.shape[0]
+        nh = self._blocks[0].mhsa.num_heads
+        hd = qshape(bp[0]["mhsa"]["wq"])[1] // nh
+
+        def embed(tok, pos):
+            x = p_emb["tokens"][tok]
+            if "positions" in p_emb:
+                x = x + p_emb["positions"][pos]
+            return x
+
+        caches = [
+            (
+                jnp.zeros((bsz, seq_len, nh, hd), self.kv_dtype),
+                jnp.zeros((bsz, seq_len, nh, hd), self.kv_dtype),
+            )
+            for _ in range(n_blocks)
+        ]
+        if prompt_len > 1:
+            pp = prompt_len - 1
+            x = p_emb["tokens"][ctx[:, :pp]]
+            if "positions" in p_emb:
+                x = x + p_emb["positions"][:pp]
+            _, caches = self._prefill(bp, caches, x)
+        return bp, p_ln, p_head, embed, caches
+
     def _decode_fn(self, min_len, n_scan, steps, temp):
         """THE cached decode builder (rectangular = uniform lens). The
         prefill covers positions 0..min_len-2 — every row's prompt
@@ -515,38 +552,12 @@ class CachedSequenceGenerator(SequenceGenerator):
         blocks = self._blocks
         final_ln, head = self._final_ln, self._head
         seq_len = self.model.input_shape[0]
-        n_blocks = len(blocks)
 
         def decode(params, state, ctx, lens, key):
             del state
-            bp = [params[str(1 + i)] for i in range(n_blocks)]
-            p_emb = params["0"]
-            p_ln = params[str(1 + n_blocks)]
-            p_head = params[str(2 + n_blocks)]
-            bsz = ctx.shape[0]
-            nh = blocks[0].mhsa.num_heads
-            hd = qshape(bp[0]["mhsa"]["wq"])[1] // nh
-
-            def embed(tok, pos):
-                x = p_emb["tokens"][tok]
-                if "positions" in p_emb:
-                    x = x + p_emb["positions"][pos]
-                return x
-
-            kvd = self.kv_dtype
-            caches = [
-                (
-                    jnp.zeros((bsz, seq_len, nh, hd), kvd),
-                    jnp.zeros((bsz, seq_len, nh, hd), kvd),
-                )
-                for _ in range(n_blocks)
-            ]
-            if min_len > 1:
-                pp = min_len - 1
-                x = p_emb["tokens"][ctx[:, :pp]]
-                if "positions" in p_emb:
-                    x = x + p_emb["positions"][:pp]
-                _, caches = self._prefill(bp, caches, x)
+            bp, p_ln, p_head, embed, caches = self._decode_prologue(
+                params, ctx, min_len
+            )
 
             def step(carry, i):
                 tok, ctx, caches, key = carry
@@ -576,5 +587,187 @@ class CachedSequenceGenerator(SequenceGenerator):
                 step, (tok0, ctx, caches, key), jnp.arange(n_scan)
             )
             return ctx
+
+        return jax.jit(decode)
+
+
+class BeamSearchGenerator(CachedSequenceGenerator):
+    """Beam-search decoding for the causal-LM family: keep the
+    ``beam_width`` highest-log-probability hypotheses per prompt instead
+    of one greedy path. No reference counterpart (SURVEY §5.7).
+
+    The whole search is ONE compiled program, like the other
+    generators: beams ride the batch axis of the per-block K/V caches
+    ((B*W, T, H, Dh) — ``_block_decode`` is shared verbatim with cached
+    greedy decode), and each scanned step expands every live beam over
+    the vocabulary, takes the top ``beam_width`` of the B×(W·V) scored
+    continuations, and reorders contexts/caches by parent-beam gather.
+    The per-step cache gather is the classic beam cost — O(W·T·H·Dh)
+    extra HBM traffic per token; serving stacks pay it for better
+    sequences, which is exactly the trade this class exposes.
+
+    ``eos_id`` finishes a hypothesis: a finished beam's only extension
+    is another ``eos_id`` at zero additional log-probability, so its
+    score freezes while open beams keep accumulating. Ranking during
+    the search uses raw cumulative log-probability; ``length_penalty``
+    (GNMT-style ``((5+L)/6)**alpha``) applies at FINAL selection only,
+    favouring longer finished hypotheses at alpha > 0.
+
+    ``beam_width=1`` is pinned equal to greedy cached decode. Scores of
+    the returned sequences land in ``self.last_scores`` (raw summed
+    log-prob of the winning beam, before the length penalty).
+    """
+
+    def __init__(self, model, beam_width=4, length_penalty=0.0,
+                 kv_dtype=None):
+        super().__init__(model, temperature=0.0, seed=0, kv_dtype=kv_dtype)
+        self.beam_width = int(beam_width)
+        self.length_penalty = float(length_penalty)
+        self._validate_beam()
+        self.last_scores = None
+
+    def _validate_beam(self):
+        """Re-checked at every generate(), like the parent's sampling
+        validation: beam_width/length_penalty are mutable and key the
+        compiled-fn cache, so a mutated value must hit the same
+        validation the constructor applied."""
+        if self.beam_width < 1:
+            raise ValueError(
+                f"beam_width must be >= 1; got {self.beam_width}"
+            )
+        vocab = self._emb.vocab_size
+        if self.beam_width > vocab:
+            raise ValueError(
+                f"beam_width ({self.beam_width}) exceeds the vocabulary "
+                f"({vocab}) — there are not that many distinct "
+                "single-token continuations"
+            )
+        if self.length_penalty < 0:
+            raise ValueError(
+                f"length_penalty must be >= 0; got {self.length_penalty}"
+            )
+
+    def generate(self, prompts, steps, eos_id=None):
+        """(B, P) prompts -> best-scoring continuation per row. Returns
+        (B, P + steps) (or a list of eos-trimmed rows when ``eos_id`` is
+        given, like the other generators). Ragged batches are not
+        supported for beam search — pad/bucket upstream."""
+        self._validate_beam()
+        if isinstance(prompts, (list, tuple)) and len(
+            {len(np.atleast_1d(p)) for p in prompts}
+        ) > 1:
+            raise ValueError(
+                "beam search decodes rectangular batches only; pad or "
+                "bucket ragged prompts upstream"
+            )
+        prompts, steps, seq_len = self._validate_generate_args(
+            np.asarray(prompts), steps
+        )
+        b, p = prompts.shape
+        ctx = np.zeros((b, seq_len), prompts.dtype)
+        ctx[:, :p] = prompts
+        eos = -1 if eos_id is None else int(eos_id)
+        key = ("beam", p, steps, eos, self.beam_width, self.length_penalty)
+        if key not in self._fns:
+            self._fns[key] = self._beam_decode_fn(p, steps, eos)
+        out, scores = self._fns[key](
+            self.model.params, self.model.state, jnp.asarray(ctx)
+        )
+        self.last_scores = np.asarray(scores)
+        out = np.asarray(out)[:, : p + steps]
+        if eos_id is None:
+            return out
+        return [self._trim_eos(row, p, int(eos_id)) for row in out]
+
+    def _beam_decode_fn(self, prompt_len, steps, eos):
+        blocks = self._blocks
+        final_ln, head = self._final_ln, self._head
+        seq_len = self.model.input_shape[0]
+        W = self.beam_width
+        alpha = self.length_penalty
+
+        def decode(params, state, ctx):
+            del state
+            bsz = ctx.shape[0]
+            bp, p_ln, p_head, embed, caches = self._decode_prologue(
+                params, ctx, prompt_len
+            )
+            # tile beams onto the batch axis; beam 0 alone starts live
+            # (cum[-inf] elsewhere), so the first expansion picks the W
+            # best DISTINCT first tokens instead of W copies of one
+            caches = [
+                (jnp.repeat(ck, W, axis=0), jnp.repeat(cv, W, axis=0))
+                for ck, cv in caches
+            ]
+            ctxw = jnp.repeat(ctx, W, axis=0).reshape(bsz, W, seq_len)
+            cum = jnp.full((bsz, W), -jnp.inf).at[:, 0].set(0.0)
+            fin = jnp.zeros((bsz, W), bool)
+            glen = jnp.zeros((bsz, W), jnp.int32)
+            tok = ctxw[:, :, prompt_len - 1]
+
+            def step(carry, i):
+                tok, ctxw, cum, fin, glen, caches = carry
+                pos = prompt_len - 1 + i
+                x = embed(tok.reshape(-1), pos)  # (B*W, d)
+                t_mask = jnp.arange(seq_len) <= pos
+                new_caches = []
+                for blk, p, (ck, cv) in zip(blocks, bp, caches):
+                    x, ck, cv = self._block_decode(
+                        blk, p, x, ck, cv, pos, t_mask
+                    )
+                    new_caches.append((ck, cv))
+                x, _ = final_ln.apply(p_ln, {}, x)
+                logit, _ = head.apply(p_head, {}, x)  # (B*W, V)
+                vocab = logit.shape[-1]
+                logp = jax.nn.log_softmax(logit, axis=-1).reshape(
+                    bsz, W, vocab
+                )
+                if eos >= 0:
+                    # a finished beam extends only with eos, for free —
+                    # its score freezes while open beams keep paying
+                    only_eos = jnp.full((vocab,), -jnp.inf).at[eos].set(0.0)
+                    logp = jnp.where(
+                        fin[:, :, None], only_eos[None, None, :], logp
+                    )
+                total = (cum[:, :, None] + logp).reshape(bsz, W * vocab)
+                cum, flat = jax.lax.top_k(total, W)  # (B, W) each
+                parent = flat // vocab
+                token = (flat % vocab).astype(tok.dtype)
+                # reorder every piece of beam state by parent
+                ctxw = jnp.take_along_axis(
+                    ctxw, parent[:, :, None], axis=1
+                )
+                fin = jnp.take_along_axis(fin, parent, axis=1)
+                glen = jnp.take_along_axis(glen, parent, axis=1)
+                glen = glen + (~fin).astype(jnp.int32)
+                if eos >= 0:
+                    fin = fin | (token == eos)
+                gather = (
+                    jnp.arange(bsz)[:, None] * W + parent
+                ).reshape(-1)  # (B*W,)
+                caches = [
+                    (ck[gather], cv[gather]) for ck, cv in new_caches
+                ]
+                ctxw = jax.lax.dynamic_update_slice_in_dim(
+                    ctxw, token[:, :, None].astype(ctxw.dtype),
+                    pos + 1, axis=2,
+                )
+                return (token, ctxw, cum, fin, glen, caches), None
+
+            (tok, ctxw, cum, fin, glen, _), _ = jax.lax.scan(
+                step, (tok, ctxw, cum, fin, glen, caches),
+                jnp.arange(steps),
+            )
+            if alpha > 0.0:
+                lp = ((5.0 + glen.astype(jnp.float32)) / 6.0) ** alpha
+                final_score = cum / lp
+            else:
+                final_score = cum
+            best = jnp.argmax(final_score, axis=1)  # (B,)
+            out = jnp.take_along_axis(
+                ctxw, best[:, None, None], axis=1
+            )[:, 0]
+            best_cum = jnp.take_along_axis(cum, best[:, None], axis=1)[:, 0]
+            return out, best_cum
 
         return jax.jit(decode)

@@ -297,22 +297,34 @@ def deserialize_serving_bundle(blob: bytes):
                 )
             if isinstance(got, Int4Weight):
                 q4_want = ((want[0] + 1) // 2, want[1])
-                if tuple(np.shape(got.q4)) != q4_want or tuple(
-                    np.shape(got.s)
-                ) != (want[1],):
+                if (
+                    tuple(np.shape(got.q4)) != q4_want
+                    or tuple(np.shape(got.s)) != (want[1],)
+                    or np.asarray(got.q4).dtype != np.int8
+                    or np.asarray(got.s).dtype != np.float32
+                ):
                     raise ValueError(
                         f"serving bundle int4 internals mismatch at "
-                        f"{path}: q4 {tuple(np.shape(got.q4))} vs "
-                        f"{q4_want}, s {tuple(np.shape(got.s))} vs "
-                        f"({want[1]},)"
+                        f"{path}: q4 {tuple(np.shape(got.q4))}/"
+                        f"{np.asarray(got.q4).dtype} vs {q4_want}/int8, "
+                        f"s {tuple(np.shape(got.s))}/"
+                        f"{np.asarray(got.s).dtype} vs ({want[1]},)/f32"
                     )
             # int8: qshape already IS q.shape, so only the scale vector
-            # needs its own check (a broadcastable (1,) would serve
-            # silently wrong numbers)
-            elif tuple(np.shape(got["s"])) != (want[1],):
+            # and the dtypes need their own checks (a broadcastable (1,)
+            # scale serves silently wrong numbers; an int32 "q" — or an
+            # int32 q4 above, whose nibble sign-extension returns the
+            # whole packed byte — decodes to garbage with no error)
+            elif (
+                tuple(np.shape(got["s"])) != (want[1],)
+                or np.asarray(got["q"]).dtype != np.int8
+                or np.asarray(got["s"]).dtype != np.float32
+            ):
                 raise ValueError(
                     f"serving bundle int8 internals mismatch at {path}: "
-                    f"s {tuple(np.shape(got['s']))} vs ({want[1]},)"
+                    f"q dtype {np.asarray(got['q']).dtype} vs int8, "
+                    f"s {tuple(np.shape(got['s']))}/"
+                    f"{np.asarray(got['s']).dtype} vs ({want[1]},)/f32"
                 )
             return
         if isinstance(built, dict) != isinstance(got, dict) or (

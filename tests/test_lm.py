@@ -697,3 +697,116 @@ def test_ragged_bucketing_bounds_compiles_and_keeps_greedy_pin(cached):
     for rows, lengths in ((out_a, (5, 9)), (out_b, (4, 10))):
         for row, L in zip(rows, lengths):
             assert row.shape == (L + 6,)
+
+
+# ---------------------------------------------------------------- beam search
+
+
+def _seq_logprob(model, rows, prompt_len):
+    """Teacher-forced summed log-prob of each row's generated region."""
+    logits = np.asarray(model(np.asarray(rows)))
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    out = []
+    for b, row in enumerate(np.asarray(rows)):
+        s = 0.0
+        for t in range(prompt_len, row.shape[0]):
+            s += logp[b, t - 1, int(row[t])]
+        out.append(s)
+    return np.asarray(out)
+
+
+def test_beam_width_1_equals_greedy_cached():
+    from distkeras_tpu.predictors import (
+        BeamSearchGenerator,
+        CachedSequenceGenerator,
+    )
+
+    m = _ragged_lm()
+    rng = np.random.default_rng(10)
+    prompts = rng.integers(0, 32, (3, 6)).astype(np.int32)
+    greedy = CachedSequenceGenerator(m).generate(prompts, steps=9)
+    beam1 = BeamSearchGenerator(m, beam_width=1).generate(prompts, steps=9)
+    np.testing.assert_array_equal(greedy, beam1)
+
+
+def test_beam_search_scores_are_exact_and_beat_greedy_on_average():
+    """What beam search actually promises: the returned score is the
+    TRUE summed log-prob of the returned sequence (pinned against a
+    teacher-forced recomputation), and a width-4 search finds higher-
+    likelihood sequences than greedy on average. NOT asserted per-row:
+    beam search famously has no per-prompt >=-greedy guarantee — the
+    greedy path starts inside the search space but can be pruned when
+    other beams' expansions crowd the top-W (this seed's row 0 does
+    exactly that, beam -16.1497 vs greedy -16.1312)."""
+    from distkeras_tpu.predictors import (
+        BeamSearchGenerator,
+        CachedSequenceGenerator,
+    )
+
+    m = _ragged_lm(seed=3)  # random weights: flat-ish logits, real search
+    rng = np.random.default_rng(11)
+    prompts = rng.integers(0, 32, (4, 5)).astype(np.int32)
+    steps = 8
+    greedy = CachedSequenceGenerator(m).generate(prompts, steps=steps)
+    gen = BeamSearchGenerator(m, beam_width=4)
+    beam = gen.generate(prompts, steps=steps)
+    lp_g = _seq_logprob(m, greedy, 5)
+    lp_b = _seq_logprob(m, beam, 5)
+    assert lp_b.mean() > lp_g.mean(), (lp_b, lp_g)
+    assert (lp_b > lp_g + 1e-6).any(), "width-4 should beat greedy somewhere"
+    np.testing.assert_allclose(gen.last_scores, lp_b, atol=1e-3)
+
+
+def test_beam_search_eos_freezes_and_trims():
+    from distkeras_tpu.predictors import BeamSearchGenerator
+
+    m = _ragged_lm()
+    rng = np.random.default_rng(12)
+    prompts = rng.integers(0, 32, (2, 4)).astype(np.int32)
+    gen = BeamSearchGenerator(m, beam_width=3)
+    full = gen.generate(prompts, steps=8)
+    # use row 0's first generated token as eos: its best hypothesis may
+    # change (finishing is free), but the returned rows must be trimmed
+    # after the first generated eos and stay eos-free before it
+    eos = int(full[0, 4])
+    trimmed = gen.generate(prompts, steps=8, eos_id=eos)
+    assert isinstance(trimmed, list)
+    for row, prompt in zip(trimmed, prompts):
+        np.testing.assert_array_equal(row[:4], prompt)
+        gen_part = row[4:]
+        hits = np.flatnonzero(gen_part == eos)
+        if hits.size:
+            assert hits[0] == gen_part.shape[0] - 1  # ends AT the eos
+        else:
+            assert gen_part.shape[0] == 8
+
+
+def test_beam_search_validation():
+    from distkeras_tpu.predictors import BeamSearchGenerator
+
+    m = _ragged_lm()
+    with pytest.raises(ValueError, match="beam_width"):
+        BeamSearchGenerator(m, beam_width=0)
+    with pytest.raises(ValueError, match="vocabulary"):
+        BeamSearchGenerator(m, beam_width=64)  # vocab is 32
+    with pytest.raises(ValueError, match="length_penalty"):
+        BeamSearchGenerator(m, length_penalty=-1)
+    with pytest.raises(ValueError, match="rectangular"):
+        BeamSearchGenerator(m).generate(
+            [np.arange(2), np.arange(5)], steps=4
+        )
+
+
+def test_beam_config_revalidated_after_mutation():
+    from distkeras_tpu.predictors import BeamSearchGenerator
+
+    m = _ragged_lm()
+    gen = BeamSearchGenerator(m, beam_width=2)
+    gen.generate(np.array([[1, 2]], np.int32), steps=3)
+    gen.beam_width = 0
+    with pytest.raises(ValueError, match="beam_width"):
+        gen.generate(np.array([[1, 2]], np.int32), steps=3)
+    gen.beam_width = 2
+    gen.length_penalty = -0.5
+    with pytest.raises(ValueError, match="length_penalty"):
+        gen.generate(np.array([[1, 2]], np.int32), steps=3)

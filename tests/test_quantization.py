@@ -461,3 +461,51 @@ def test_serving_bundle_rejects_tampered_internals():
     # ... and the f32 loader names the right loader for serving frames
     with pytest.raises(ValueError, match="SERVING bundle"):
         deserialize_model(serialize_serving_bundle(m8))
+
+
+def test_serving_bundle_rejects_wrong_dtypes():
+    """Dtype is part of the quantized contract: an int32 q4's nibble
+    sign-extension returns the whole packed byte, so wrong-dtype leaves
+    must fail at load, not decode to garbage."""
+    from distkeras_tpu.ops.quantization import Int4Weight
+    from distkeras_tpu.utils.serialization import (
+        deserialize_serving_bundle,
+        pack_frame,
+        serialize_params,
+        serialize_serving_bundle,
+        unpack_frame,
+    )
+
+    def resave(model_q, mutate):
+        blob = serialize_serving_bundle(model_q)
+        header, _ = unpack_frame(blob)
+        params = {k: v for k, v in model_q.params.items()}
+        mutate(params)
+        return pack_frame(header, serialize_params(params))
+
+    m4 = quantize_model(zoo.mnist_mlp(hidden=32, seed=0), bits=4)
+    first = next(k for k in m4.params if "kernel" in m4.params[k])
+
+    def widen_q4(p):
+        leaf = dict(p[first])
+        w = leaf["kernel"]
+        leaf["kernel"] = Int4Weight(
+            np.asarray(w.q4).astype(np.int32), w.s, w.rows
+        )
+        p[first] = leaf
+
+    with pytest.raises(ValueError, match="int4 internals"):
+        deserialize_serving_bundle(resave(m4, widen_q4))
+
+    m8 = quantize_model(zoo.mnist_mlp(hidden=32, seed=0))
+
+    def float_q(p):
+        leaf = dict(p[first])
+        leaf["kernel"] = {
+            "q": np.asarray(leaf["kernel"]["q"]).astype(np.float32),
+            "s": leaf["kernel"]["s"],
+        }
+        p[first] = leaf
+
+    with pytest.raises(ValueError, match="int8 internals"):
+        deserialize_serving_bundle(resave(m8, float_q))
