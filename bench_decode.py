@@ -37,7 +37,11 @@ def main() -> None:
     import jax
 
     from distkeras_tpu.models.zoo import transformer_lm
-    from distkeras_tpu.predictors import CachedSequenceGenerator, SequenceGenerator
+    from distkeras_tpu.predictors import (
+        BeamSearchGenerator,
+        CachedSequenceGenerator,
+        SequenceGenerator,
+    )
     from distkeras_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache(platform=platform)
@@ -93,6 +97,11 @@ def main() -> None:
     int4_tps = timed(
         CachedSequenceGenerator(model_q4, kv_dtype=jnp.bfloat16), steps
     )
+    # beam search: W hypotheses ride the cache batch axis, plus a
+    # per-token parent-beam cache gather — this row measures that
+    # documented O(W) serving cost against the same f32 cached baseline
+    beam_w = 4
+    beam_tps = timed(BeamSearchGenerator(model, beam_width=beam_w), steps)
 
     record = {
         "metric": "lm_decode_tokens_per_sec",
@@ -128,6 +137,11 @@ def main() -> None:
         "int4_plus_bf16_kv": {
             "tokens_per_sec": round(int4_tps, 1),
             "speedup_vs_f32_cached": round(int4_tps / cached_tps, 3),
+        },
+        "beam_search": {
+            "beam_width": beam_w,
+            "tokens_per_sec": round(beam_tps, 1),
+            "cost_vs_f32_cached": round(cached_tps / beam_tps, 2),
         },
     }
     with open("BENCH_DECODE.json", "w") as f:
