@@ -384,12 +384,30 @@ class CachedSequenceGenerator(SequenceGenerator):
     a (B, T, H, Dh) cache: the prompt prefills the caches in one
     vectorized pass, then every generated token computes ONE row of
     attention against the cache — O(T d) a step, the whole prefill+scan
-    a single compiled program. Greedy output is pinned equal to the
-    uncached generator's.
+    a single compiled program. For DENSE LMs, greedy output is pinned
+    equal to the uncached generator's (bit-equal at the default f32
+    caches); MoE models are exempt from that pin — see below, the
+    uncached path's capacity drops are the part being deliberately not
+    reproduced.
 
-    Supports the LM family's exact layer shape (Embedding -> causal
-    TransformerBlock xN -> LayerNorm -> Dense); anything else (MoE
-    blocks, attention hooks) raises rather than decoding incorrectly.
+    Supports the LM family's layer shapes: Embedding -> causal
+    TransformerBlock xN -> LayerNorm -> Dense (``zoo.transformer_lm``),
+    with an optional switch-``MoE`` layer after any block
+    (``zoo.moe_transformer_lm``); anything else (attention hooks,
+    non-causal blocks) raises rather than decoding incorrectly.
+
+    MoE decoding routes WITHOUT capacity drops (``_moe_nodrop``): the
+    capacity budget is a training-throughput device, and the uncached
+    full-(B, T) forward even lets context PAD tokens consume it —
+    serving wants each real token's true top-1 expert output. The cost
+    is computing all E experts and selecting — E x the FFN FLOPs, paid
+    per token at decode (tiny) AND over the whole (B, PP) prompt at
+    prefill (real; at the zoo family's shapes it is still small, and
+    the alternatives lose: gathering per-token expert weights
+    materializes (S, D, H) copies — worse than the (E, S, H) hidden
+    whenever D > E — and capacity-style dispatch reintroduces the drops
+    this path exists to avoid). The win is output that does not depend
+    on padding or batch composition.
     """
 
     def __init__(self, model, temperature=0.0, seed=0, top_k=None,
@@ -408,39 +426,57 @@ class CachedSequenceGenerator(SequenceGenerator):
             LayerNorm,
             TransformerBlock,
         )
+        from distkeras_tpu.parallel.expert_parallel import MoE
 
         layers = list(model.layers)
-        ok = (
+        shape_err = ValueError(
+            "CachedSequenceGenerator supports Embedding -> causal "
+            "TransformerBlock xN (each optionally followed by a MoE "
+            "layer) -> LayerNorm -> Dense models (zoo.transformer_lm / "
+            f"zoo.moe_transformer_lm); got "
+            f"{[type(l).__name__ for l in layers]}"
+        )
+        if not (
             len(layers) >= 4
             and isinstance(layers[0], Embedding)
-            and all(isinstance(l, TransformerBlock) for l in layers[1:-2])
             and isinstance(layers[-2], LayerNorm)
             and isinstance(layers[-1], Dense)
-            and all(l.causal for l in layers[1:-2])
-        )
-        if not ok:
-            raise ValueError(
-                "CachedSequenceGenerator supports Embedding -> causal "
-                "TransformerBlock xN (N >= 1) -> LayerNorm -> Dense models "
-                f"(zoo.transformer_lm); got {[type(l).__name__ for l in layers]}"
-            )
-        head_shapes = {
-            (l.mhsa.num_heads, l.mhsa.head_dim) for l in layers[1:-2]
-        }
+        ):
+            raise shape_err
+        # parse the middle into (block, optional MoE) stages, keeping
+        # each layer's position — param groups are keyed by layer index
+        stages = []  # [(block, block_idx, moe_or_None, moe_idx_or_None)]
+        i, mid_end = 1, len(layers) - 2
+        while i < mid_end:
+            blk = layers[i]
+            if not isinstance(blk, TransformerBlock):
+                raise shape_err
+            moe, moe_idx = None, None
+            if i + 1 < mid_end and isinstance(layers[i + 1], MoE):
+                moe, moe_idx = layers[i + 1], i + 1
+            stages.append((blk, i, moe, moe_idx))
+            i += 1 if moe is None else 2
+        if not stages:
+            raise shape_err
+        blocks = [s[0] for s in stages]
+        if not all(b.causal for b in blocks):
+            raise shape_err
+        head_shapes = {(b.mhsa.num_heads, b.mhsa.head_dim) for b in blocks}
         if len(head_shapes) != 1:
             raise ValueError(
                 "cached decode derives its cache shape from the first "
                 f"block; blocks must share (num_heads, head_dim), got "
                 f"{sorted(head_shapes)}"
             )
-        for blk in layers[1:-2]:
+        for blk in blocks:
             if blk.mhsa.attention_fn is not None:
                 raise ValueError(
                     "cached decode computes attention itself; detach the "
                     "attention_fn hook (flash/ring) before decoding"
                 )
         self._emb = layers[0]
-        self._blocks = layers[1:-2]
+        self._stages = stages
+        self._blocks = blocks
         self._final_ln = layers[-2]
         self._head = layers[-1]
 
@@ -476,14 +512,17 @@ class CachedSequenceGenerator(SequenceGenerator):
 
     def _prefill(self, bp, caches, x):
         """Run ``x`` (B, PP, d) pre-embedded prompt prefix through every
-        block, filling each cache's first PP rows; returns (hidden,
-        caches)."""
+        stage, filling each cache's first PP rows; returns (hidden,
+        caches). MoE stages use the same no-drop routing as the decode
+        steps, so prefill and per-token outputs agree."""
         from distkeras_tpu.parallel.ring_attention import dense_attention
 
         bsz, pp, _ = x.shape
         nh = self._blocks[0].mhsa.num_heads
         new_caches = []
-        for blk, p, (ck, cv) in zip(self._blocks, bp, caches):
+        for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
+            self._stages, bp, caches
+        ):
             mh = p["mhsa"]
             hd = qshape(mh["wq"])[1] // nh
             h_, _ = blk.ln1.apply(p["ln1"], {}, x)
@@ -501,24 +540,30 @@ class CachedSequenceGenerator(SequenceGenerator):
             h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
             h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
             x = x + h_
+            if moe is not None:
+                x = x + self._moe_nodrop(pm, x)
             new_caches.append((ck, cv))
         return x, new_caches
 
     def _decode_prologue(self, params, ctx, prompt_len):
         """Shared trace-time prologue of every cached decode builder:
-        unpack the per-layer param groups, build the embed closure,
-        allocate the per-block K/V caches, and prefill positions
+        unpack the per-layer param groups (one (block, optional-MoE)
+        pair per stage, keyed by layer index), build the embed closure,
+        allocate the per-stage K/V caches, and prefill positions
         0..prompt_len-2. One copy — beam search and greedy/ragged decode
         must never drift on cache layout or param indexing."""
-        n_blocks = len(self._blocks)
+        n_layers = len(self.model.layers)
         seq_len = self.model.input_shape[0]
-        bp = [params[str(1 + i)] for i in range(n_blocks)]
+        bp = [
+            (params[str(bi)], None if mi is None else params[str(mi)])
+            for (_, bi, _, mi) in self._stages
+        ]
         p_emb = params["0"]
-        p_ln = params[str(1 + n_blocks)]
-        p_head = params[str(2 + n_blocks)]
+        p_ln = params[str(n_layers - 2)]
+        p_head = params[str(n_layers - 1)]
         bsz = ctx.shape[0]
         nh = self._blocks[0].mhsa.num_heads
-        hd = qshape(bp[0]["mhsa"]["wq"])[1] // nh
+        hd = qshape(bp[0][0]["mhsa"]["wq"])[1] // nh
 
         def embed(tok, pos):
             x = p_emb["tokens"][tok]
@@ -531,7 +576,7 @@ class CachedSequenceGenerator(SequenceGenerator):
                 jnp.zeros((bsz, seq_len, nh, hd), self.kv_dtype),
                 jnp.zeros((bsz, seq_len, nh, hd), self.kv_dtype),
             )
-            for _ in range(n_blocks)
+            for _ in self._stages
         ]
         if prompt_len > 1:
             pp = prompt_len - 1
@@ -541,6 +586,44 @@ class CachedSequenceGenerator(SequenceGenerator):
             _, caches = self._prefill(bp, caches, x)
         return bp, p_ln, p_head, embed, caches
 
+    @staticmethod
+    def _moe_nodrop(p, x):
+        """Switch-MoE output for serving: top-1 routing with NO capacity
+        drops — every token gets its routed expert's gated output.
+        Computes all E experts and selects (E x the FFN FLOPs; at decode
+        token counts that is cheap, and the result is independent of
+        padding and batch composition, unlike the capacity-dropped
+        training path ``parallel.expert_parallel.moe_ffn``, whose
+        numbers this matches exactly whenever that path drops nothing).
+        Returns the residual branch only (caller adds)."""
+        d = x.shape[-1]
+        lead = x.shape[:-1]
+        tokens = x.reshape(-1, d)
+        logits = tokens.astype(jnp.float32) @ p["router"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        idx = jnp.argmax(probs, axis=-1)  # (S,)
+        gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+        h = jnp.einsum("sd,edh->esh", tokens, p["wi"].astype(x.dtype))
+        h = jax.nn.gelu(h)
+        out_all = jnp.einsum("esh,ehd->esd", h, p["wo"].astype(x.dtype))
+        sel = out_all[idx, jnp.arange(tokens.shape[0])]  # (S, d)
+        out = sel * gate[:, None].astype(x.dtype)
+        return out.reshape(*lead, d)
+
+    def _stages_decode(self, bp, caches, x, pos, t_mask):
+        """One token through every (block, optional MoE) stage against
+        the caches — the single per-token body both the greedy/ragged
+        scan and beam search run."""
+        new_caches = []
+        for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
+            self._stages, bp, caches
+        ):
+            x, ck, cv = self._block_decode(blk, p, x, ck, cv, pos, t_mask)
+            if moe is not None:
+                x = x + self._moe_nodrop(pm, x)
+            new_caches.append((ck, cv))
+        return x, new_caches
+
     def _decode_fn(self, min_len, n_scan, steps, temp):
         """THE cached decode builder (rectangular = uniform lens). The
         prefill covers positions 0..min_len-2 — every row's prompt
@@ -549,7 +632,6 @@ class CachedSequenceGenerator(SequenceGenerator):
         everyone, with the same keep-prompt / frozen masking as the
         uncached scan (rows re-embed their own prompt tokens until their
         prompt ends, then append exactly ``steps`` generated tokens)."""
-        blocks = self._blocks
         final_ln, head = self._final_ln, self._head
         seq_len = self.model.input_shape[0]
 
@@ -564,12 +646,9 @@ class CachedSequenceGenerator(SequenceGenerator):
                 pos = min_len - 1 + i
                 x = embed(tok, pos)
                 t_mask = jnp.arange(seq_len) <= pos
-                new_caches = []
-                for blk, p, (ck, cv) in zip(blocks, bp, caches):
-                    x, ck, cv = self._block_decode(
-                        blk, p, x, ck, cv, pos, t_mask
-                    )
-                    new_caches.append((ck, cv))
+                x, new_caches = self._stages_decode(
+                    bp, caches, x, pos, t_mask
+                )
                 x, _ = final_ln.apply(p_ln, {}, x)
                 logit, _ = head.apply(p_head, {}, x)  # (B, V)
                 if temp == 0.0:
@@ -680,7 +759,6 @@ class BeamSearchGenerator(CachedSequenceGenerator):
         return [self._trim_eos(row, p, int(eos_id)) for row in out]
 
     def _beam_decode_fn(self, prompt_len, steps, eos):
-        blocks = self._blocks
         final_ln, head = self._final_ln, self._head
         seq_len = self.model.input_shape[0]
         W = self.beam_width
@@ -710,12 +788,9 @@ class BeamSearchGenerator(CachedSequenceGenerator):
                 pos = prompt_len - 1 + i
                 x = embed(tok.reshape(-1), pos)  # (B*W, d)
                 t_mask = jnp.arange(seq_len) <= pos
-                new_caches = []
-                for blk, p, (ck, cv) in zip(blocks, bp, caches):
-                    x, ck, cv = self._block_decode(
-                        blk, p, x, ck, cv, pos, t_mask
-                    )
-                    new_caches.append((ck, cv))
+                x, new_caches = self._stages_decode(
+                    bp, caches, x, pos, t_mask
+                )
                 x, _ = final_ln.apply(p_ln, {}, x)
                 logit, _ = head.apply(p_head, {}, x)  # (B*W, V)
                 vocab = logit.shape[-1]

@@ -810,3 +810,114 @@ def test_beam_config_revalidated_after_mutation():
     gen.length_penalty = -0.5
     with pytest.raises(ValueError, match="length_penalty"):
         gen.generate(np.array([[1, 2]], np.int32), steps=3)
+
+
+# ----------------------------------------------------------- MoE cached decode
+
+
+def _moe_lm(seed=0):
+    return zoo.moe_transformer_lm(vocab_size=32, seq_len=24, d_model=32,
+                                  num_heads=4, depth=2, num_experts=4,
+                                  seed=seed)
+
+
+def test_moe_cached_decode_matches_manual_reference():
+    """Cached MoE decode (no-drop top-1 routing) against a hand-rolled
+    per-position forward that uses the SAME no-drop routing — the
+    correctness pin that doesn't depend on capacity-drop artifacts."""
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+
+    m = _moe_lm()
+    rng = np.random.default_rng(13)
+    prompts = rng.integers(0, 32, (2, 5)).astype(np.int32)
+    steps = 6
+    out = CachedSequenceGenerator(m).generate(prompts, steps=steps)
+
+    # manual reference: full forward per position, but with MoE layers
+    # replaced by the documented no-drop serving routing
+    from distkeras_tpu.parallel.expert_parallel import MoE
+
+    def manual_forward(tokens):
+        params, state = m.params, m.state
+        x = params["0"]["tokens"][tokens]
+        if "positions" in params["0"]:
+            x = x + params["0"]["positions"][: tokens.shape[1]]
+        li = 1
+        for layer in m.layers[1:-2]:
+            p = params[str(li)]
+            if isinstance(layer, MoE):
+                x = x + CachedSequenceGenerator._moe_nodrop(p, x)
+            else:
+                x, _ = layer.apply(p, state[str(li)], x, train=False)
+            li += 1
+        x, _ = m.layers[-2].apply(params[str(li)], state[str(li)], x)
+        logits, _ = m.layers[-1].apply(
+            params[str(li + 1)], state[str(li + 1)], x
+        )
+        return np.asarray(logits)
+
+    ctx = np.zeros((2, 24), np.int32)
+    ctx[:, :5] = prompts
+    for i in range(steps):
+        logits = manual_forward(jnp.asarray(ctx))
+        ctx[:, 5 + i] = logits[:, 4 + i].argmax(-1)
+    np.testing.assert_array_equal(out, ctx[:, : 5 + steps])
+
+
+@pytest.mark.slow
+def test_moe_cached_decode_continues_trained_lm():
+    """Train the MoE successor LM, then serve it through the cached
+    path: the decode must count upward — MoE serving end to end."""
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.predictors import (
+        BeamSearchGenerator,
+        CachedSequenceGenerator,
+    )
+
+    rng = np.random.default_rng(14)
+    starts = rng.integers(0, 8, (768, 1))
+    seqs = ((starts + np.arange(24)) % 32).astype(np.int32)
+    ds = Dataset({"features": seqs, "label": seqs})
+    trained = SingleTrainer(
+        _moe_lm(), "adam", loss="next_token_crossentropy",
+        num_epoch=4, batch_size=64, seed=0,
+    ).train(ds)
+    out = CachedSequenceGenerator(trained).generate(
+        np.array([[3, 4, 5]], np.int32), steps=8
+    )
+    assert out[0].tolist() == list(range(3, 14)), out[0]
+    # beam search rides the same stage machinery: width 1 == greedy
+    beam = BeamSearchGenerator(trained, beam_width=1).generate(
+        np.array([[3, 4, 5]], np.int32), steps=8
+    )
+    np.testing.assert_array_equal(out, beam)
+
+
+def test_moe_cached_decode_ragged_and_eos():
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+
+    m = _moe_lm(seed=2)
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, 32, L).astype(np.int32) for L in (2, 6)]
+    gen = CachedSequenceGenerator(m)
+    outs = gen.generate(prompts, steps=5)
+    for row, prompt in zip(outs, prompts):
+        L = prompt.shape[0]
+        assert row.shape == (L + 5,)
+        solo = gen.generate(prompt[None, :], steps=5)
+        np.testing.assert_array_equal(row, solo[0])
+    # eos trimming through the MoE stage machinery: pick row 0's first
+    # generated token as eos — that row must trim to exactly one
+    # generated token, and rows without a generated eos keep full length
+    eos = int(outs[0][prompts[0].shape[0]])
+    trimmed = gen.generate(prompts, steps=5, eos_id=eos)
+    assert trimmed[0].shape == (prompts[0].shape[0] + 1,)
+    np.testing.assert_array_equal(
+        trimmed[0], outs[0][: prompts[0].shape[0] + 1]
+    )
+    for row, full, prompt in zip(trimmed, outs, prompts):
+        L = prompt.shape[0]
+        hits = np.flatnonzero(full[L:] == eos)
+        want = full[: L + hits[0] + 1] if hits.size else full
+        np.testing.assert_array_equal(row, want)
