@@ -41,6 +41,7 @@ from distkeras_tpu.predictors import (
     CachedSequenceGenerator,
     ModelPredictor,
     SequenceGenerator,
+    SpeculativeGenerator,
 )
 from distkeras_tpu.evaluators import (
     AccuracyEvaluator,

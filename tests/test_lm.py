@@ -921,3 +921,100 @@ def test_moe_cached_decode_ragged_and_eos():
         hits = np.flatnonzero(full[L:] == eos)
         want = full[: L + hits[0] + 1] if hits.size else full
         np.testing.assert_array_equal(row, want)
+
+
+# -------------------------------------------------------- speculative decode
+
+
+def test_speculative_equals_target_greedy_any_draft():
+    """The core guarantee: output is EXACTLY the target's greedy decode,
+    whatever the draft proposes — here a differently-seeded draft that
+    disagrees constantly (worst case), and k spanning the chunk range."""
+    from distkeras_tpu.predictors import (
+        CachedSequenceGenerator,
+        SpeculativeGenerator,
+    )
+
+    target = _ragged_lm(seed=0)
+    draft = zoo.transformer_lm(vocab_size=32, seq_len=24, d_model=16,
+                               num_heads=2, depth=1, seed=9)
+    rng = np.random.default_rng(16)
+    prompts = rng.integers(0, 32, (3, 5)).astype(np.int32)
+    want = CachedSequenceGenerator(target).generate(prompts, steps=9)
+    for k in (1, 3, 5):
+        gen = SpeculativeGenerator(target, draft, k=k)
+        got = gen.generate(prompts, steps=9)
+        np.testing.assert_array_equal(got, want)
+        assert gen.last_rounds.shape == (3,)
+        # progress >= 1 token/round: never more rounds than steps
+        assert (gen.last_rounds <= 9).all()
+
+
+def test_speculative_self_draft_is_the_acceptance_ceiling():
+    """Draft == target: every proposal agrees, so each round accepts
+    k+1 tokens — rounds == ceil(steps/(k+1)), the mechanical ceiling."""
+    from distkeras_tpu.predictors import (
+        CachedSequenceGenerator,
+        SpeculativeGenerator,
+    )
+
+    m = _ragged_lm(seed=1)
+    rng = np.random.default_rng(17)
+    prompts = rng.integers(0, 32, (2, 4)).astype(np.int32)
+    gen = SpeculativeGenerator(m, m, k=3)
+    out = gen.generate(prompts, steps=10)
+    want = CachedSequenceGenerator(m).generate(prompts, steps=10)
+    np.testing.assert_array_equal(out, want)
+    assert (gen.last_rounds == -(-10 // 4)).all(), gen.last_rounds
+    # eos path shares the host-side trim
+    eos = int(want[0, 4])
+    trimmed = gen.generate(prompts, steps=10, eos_id=eos)
+    assert isinstance(trimmed, list)
+    assert trimmed[0].shape == (5,)
+
+
+@pytest.mark.slow
+def test_speculative_trained_pair_counts_and_accepts():
+    """Train a big target and a small draft on the same successor
+    language: speculative decode reproduces the target's counting AND
+    the trained draft buys multi-token acceptance (rounds << steps)."""
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.predictors import SpeculativeGenerator
+
+    rng = np.random.default_rng(18)
+    starts = rng.integers(0, 8, (768, 1))
+    seqs = ((starts + np.arange(24)) % 32).astype(np.int32)
+    ds = Dataset({"features": seqs, "label": seqs})
+    kw = dict(loss="next_token_crossentropy", num_epoch=4, batch_size=64,
+              seed=0)
+    target = SingleTrainer(
+        zoo.transformer_lm(vocab_size=32, seq_len=24, d_model=64,
+                           num_heads=4, depth=2, seed=0), "adam", **kw
+    ).train(ds)
+    draft = SingleTrainer(
+        zoo.transformer_lm(vocab_size=32, seq_len=24, d_model=16,
+                           num_heads=2, depth=1, seed=1), "adam", **kw
+    ).train(ds)
+    gen = SpeculativeGenerator(target, draft, k=4)
+    out = gen.generate(np.array([[3, 4, 5]], np.int32), steps=12)
+    assert out[0].tolist() == list(range(3, 18)), out[0]
+    # both models learned the task, so acceptance is near-total:
+    # 12 tokens in at most 4 rounds (ceiling is ceil(12/5) = 3)
+    assert gen.last_rounds[0] <= 4, gen.last_rounds
+
+
+def test_speculative_validation():
+    from distkeras_tpu.predictors import SpeculativeGenerator
+
+    t = _ragged_lm()
+    with pytest.raises(ValueError, match="k must be"):
+        SpeculativeGenerator(t, t, k=0)
+    other_vocab = zoo.transformer_lm(vocab_size=16, seq_len=24, d_model=16,
+                                     num_heads=2, depth=1, seed=0)
+    with pytest.raises(ValueError, match="vocabulary"):
+        SpeculativeGenerator(t, other_vocab)
+    other_seq = zoo.transformer_lm(vocab_size=32, seq_len=16, d_model=16,
+                                   num_heads=2, depth=1, seed=0)
+    with pytest.raises(ValueError, match="sequence"):
+        SpeculativeGenerator(t, other_seq)
