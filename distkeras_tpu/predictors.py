@@ -480,27 +480,34 @@ class CachedSequenceGenerator(SequenceGenerator):
         self._final_ln = layers[-2]
         self._head = layers[-1]
 
-    def _block_decode(self, blk, p, x, cache_k, cache_v, pos, t_mask):
-        """One token through one block against its cache. x: (B, d);
-        caches: (B, T, H, Dh); t_mask: (T,) bool, True for t <= pos."""
+    def _stage_chunk(self, blk, moe, p, pm, x, cache_k, cache_v, pos,
+                     qmask):
+        """A C-token chunk through one (block, optional MoE) stage
+        against its cache — THE per-stage transformer body; single-token
+        decode is the C=1 case and the speculative verify passes C=k+1.
+        x: (B, C, d); caches: (B, T, H, Dh); pos: the chunk's first
+        position (K/V write offset); qmask: (C, T) bool, True where
+        chunk row c may attend cache position t."""
         mh = p["mhsa"]
-        h_, _ = blk.ln1.apply(p["ln1"], {}, x)
-        bsz = x.shape[0]
+        b, c, _ = x.shape
         nh = blk.mhsa.num_heads
         hd = qshape(mh["wq"])[1] // nh
-        q = qmatmul(h_, mh["wq"]).reshape(bsz, nh, hd)
-        k_new = qmatmul(h_, mh["wk"]).reshape(bsz, nh, hd)
-        v_new = qmatmul(h_, mh["wv"]).reshape(bsz, nh, hd)
-        cache_k = jax.lax.dynamic_update_slice_in_dim(
-            cache_k, k_new[:, None].astype(cache_k.dtype), pos, axis=1
+        h_, _ = blk.ln1.apply(p["ln1"], {}, x)
+        q = qmatmul(h_, mh["wq"]).reshape(b, c, nh, hd)
+        k_new = qmatmul(h_, mh["wk"]).reshape(b, c, nh, hd)
+        v_new = qmatmul(h_, mh["wv"]).reshape(b, c, nh, hd)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, k_new.astype(cache_k.dtype), (0, pos, 0, 0)
         )
-        cache_v = jax.lax.dynamic_update_slice_in_dim(
-            cache_v, v_new[:, None].astype(cache_v.dtype), pos, axis=1
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, v_new.astype(cache_v.dtype), (0, pos, 0, 0)
         )
-        scores = jnp.einsum("bhd,bthd->bht", q, cache_k) / np.sqrt(hd)
-        scores = jnp.where(t_mask[None, None, :], scores, -jnp.inf)
+        scores = jnp.einsum("bchd,bthd->bhct", q, cache_k) / np.sqrt(hd)
+        scores = jnp.where(qmask[None, None], scores, -jnp.inf)
         w = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bht,bthd->bhd", w, cache_v).reshape(bsz, nh * hd)
+        o = jnp.einsum("bhct,bthd->bchd", w, cache_v).reshape(
+            b, c, nh * hd
+        )
         o = qmatmul(o, mh["wo"])
         if "bo" in mh:
             o = o + mh["bo"]
@@ -508,7 +515,10 @@ class CachedSequenceGenerator(SequenceGenerator):
         h_, _ = blk.ln2.apply(p["ln2"], {}, x)
         h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
         h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
-        return x + h_, cache_k, cache_v
+        x = x + h_
+        if moe is not None:
+            x = x + self._moe_nodrop(pm, x)
+        return x, cache_k, cache_v
 
     def _prefill(self, bp, caches, x):
         """Run ``x`` (B, PP, d) pre-embedded prompt prefix through every
@@ -621,17 +631,19 @@ class CachedSequenceGenerator(SequenceGenerator):
 
     def _stages_decode(self, bp, caches, x, pos, t_mask):
         """One token through every (block, optional MoE) stage against
-        the caches — the single per-token body both the greedy/ragged
-        scan and beam search run."""
+        the caches — the C=1 face of ``_stage_chunk``, run by the
+        greedy/ragged scan, beam search, and the speculative draft."""
+        x = x[:, None]  # (B, d) -> (B, 1, d)
+        qmask = t_mask[None, :]
         new_caches = []
         for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
             self._stages, bp, caches
         ):
-            x, ck, cv = self._block_decode(blk, p, x, ck, cv, pos, t_mask)
-            if moe is not None:
-                x = x + self._moe_nodrop(pm, x)
+            x, ck, cv = self._stage_chunk(
+                blk, moe, p, pm, x, ck, cv, pos, qmask
+            )
             new_caches.append((ck, cv))
-        return x, new_caches
+        return x[:, 0], new_caches
 
     def _decode_fn(self, min_len, n_scan, steps, temp):
         """THE cached decode builder (rectangular = uniform lens). The
@@ -943,43 +955,20 @@ class SpeculativeGenerator:
 
     def _extend(self, gen, bp, caches, x, pos, t_pad):
         """Run a (1, C, d) token chunk at positions pos..pos+C-1 through
-        ``gen``'s stages against full-length caches: the verify-side
-        sibling of the one-token ``_stages_decode`` (chunked causal
-        masking inside the chunk, cache writes at the dynamic offset)."""
+        ``gen``'s stages against full-length caches: the verify side of
+        a round — the same ``_stage_chunk`` body as every other decode
+        path, at C=k+1 with chunk-causal masking."""
         c = x.shape[1]
+        qmask = (
+            jnp.arange(t_pad)[None, :] <= (pos + jnp.arange(c))[:, None]
+        )
         new_caches = []
         for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
             gen._stages, bp, caches
         ):
-            mh = p["mhsa"]
-            nh = blk.mhsa.num_heads
-            hd = qshape(mh["wq"])[1] // nh
-            h_, _ = blk.ln1.apply(p["ln1"], {}, x)
-            q = qmatmul(h_, mh["wq"]).reshape(1, c, nh, hd)
-            k_new = qmatmul(h_, mh["wk"]).reshape(1, c, nh, hd)
-            v_new = qmatmul(h_, mh["wv"]).reshape(1, c, nh, hd)
-            ck = jax.lax.dynamic_update_slice(
-                ck, k_new.astype(ck.dtype), (0, pos, 0, 0)
+            x, ck, cv = gen._stage_chunk(
+                blk, moe, p, pm, x, ck, cv, pos, qmask
             )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v_new.astype(cv.dtype), (0, pos, 0, 0)
-            )
-            scores = jnp.einsum("bchd,bthd->bhct", q, ck) / np.sqrt(hd)
-            key_pos = jnp.arange(t_pad)
-            mask = key_pos[None, :] <= (pos + jnp.arange(c))[:, None]
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
-            w = jax.nn.softmax(scores, axis=-1)
-            o = jnp.einsum("bhct,bthd->bchd", w, cv).reshape(1, c, nh * hd)
-            o = qmatmul(o, mh["wo"])
-            if "bo" in mh:
-                o = o + mh["bo"]
-            x = x + o
-            h_, _ = blk.ln2.apply(p["ln2"], {}, x)
-            h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
-            h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
-            x = x + h_
-            if moe is not None:
-                x = x + gen._moe_nodrop(pm, x)
             new_caches.append((ck, cv))
         return x, new_caches
 

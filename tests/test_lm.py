@@ -1018,3 +1018,24 @@ def test_speculative_validation():
                                    num_heads=2, depth=1, seed=0)
     with pytest.raises(ValueError, match="sequence"):
         SpeculativeGenerator(t, other_seq)
+
+
+def test_speculative_serves_moe_target():
+    """The verify chunk's MoE branch: a switch-MoE target decodes
+    speculatively (dense draft) to exactly its own cached greedy
+    output — the chunked no-drop routing must agree with the per-token
+    no-drop routing position by position."""
+    from distkeras_tpu.predictors import (
+        CachedSequenceGenerator,
+        SpeculativeGenerator,
+    )
+
+    target = _moe_lm(seed=4)
+    draft = zoo.transformer_lm(vocab_size=32, seq_len=24, d_model=16,
+                               num_heads=2, depth=1, seed=5)
+    rng = np.random.default_rng(19)
+    prompts = rng.integers(0, 32, (2, 5)).astype(np.int32)
+    want = CachedSequenceGenerator(target).generate(prompts, steps=8)
+    gen = SpeculativeGenerator(target, draft, k=3)
+    got = gen.generate(prompts, steps=8)
+    np.testing.assert_array_equal(got, want)
