@@ -55,12 +55,24 @@ def main():
                     "as a serving bundle, reload it, and run the decode "
                     "demo from the RELOADED model (what a serving host "
                     "does at boot)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="also train a small draft LM and run the decode "
+                    "demo speculatively (draft-and-verify; output is "
+                    "exactly the main model's greedy decode) — prints "
+                    "the measured acceptance per verify round")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.save_bundle and not args.int8:
         # fail BEFORE training, not after a long run
         ap.error("--save-bundle stores a QUANTIZED serving copy; "
                  "pass --int8 too")
+    if args.speculative and (args.text is not None or args.seq < 8):
+        ap.error("--speculative runs on the toy successor corpus with "
+                 "--seq >= 8 (the draft needs the same cheap task)")
+    # draft shape, valid by construction (heads must divide d_model):
+    draft_heads = 2
+    draft_d = max(16, args.d_model // 4)
+    draft_d += draft_d % draft_heads
     from distkeras_tpu.parallel.backend import setup_backend
 
     # probe out-of-process: a dead TPU tunnel degrades to the virtual CPU
@@ -165,6 +177,38 @@ def main():
         out = gen.generate(np.array([[3 % args.vocab]], np.int32),
                            steps=steps)
         print("greedy decode:", out[0].tolist())
+
+    if args.speculative:
+        # train a much smaller draft on the same corpus and decode
+        # draft-and-verify: the output must equal the main model's
+        # greedy decode token for token; acceptance per verify round is
+        # the quantity speculative serving lives on
+        from distkeras_tpu.predictors import SpeculativeGenerator
+
+        draft = zoo.transformer_lm(
+            vocab_size=args.vocab, seq_len=args.seq, d_model=draft_d,
+            num_heads=draft_heads, depth=1, seed=1,
+        )
+        draft_t = SingleTrainer(draft, "adam", **kw).train(ds)
+        spec = SpeculativeGenerator(trained, draft_t, k=4)
+        sp_steps = min(12, args.seq - 5)
+        prompt = np.array([[3 % args.vocab]], np.int32)
+        out_s = spec.generate(prompt, steps=sp_steps)
+        if args.int8:
+            # the ragged demo above served the QUANTIZED copy; the
+            # speculative target is the f32 model, so re-derive its
+            # greedy reference
+            plain = CachedSequenceGenerator(trained).generate(
+                prompt, steps=sp_steps
+            )[0]
+        else:
+            plain = outs[0]  # same model, prompt, and step count
+        match = "EXACT" if (out_s[0] == plain).all() else "MISMATCH"
+        print(f"speculative decode ({match} vs greedy): "
+              f"{out_s[0].tolist()}; "
+              f"{sp_steps} tokens in {int(spec.last_rounds[0])} verify "
+              f"rounds ({sp_steps / int(spec.last_rounds[0]):.2f} "
+              f"accepted/round)")
 
 
 if __name__ == "__main__":
