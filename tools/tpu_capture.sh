@@ -33,6 +33,11 @@ timeout 1200 python tools/mfu_attrib.py --best >> "$LOG" 2>>"$LOG.err"
 commit_snap "Harvest TPU window: winning-bundle frontier rows (d1024, seq4096)" \
   MFU_ATTRIB.jsonl "$LOG"
 
+# --- 0b. exploratory ceiling rows (d2048 / seq1024 / batch-256 remat) ----
+timeout 1500 python tools/mfu_attrib.py --frontier >> "$LOG" 2>>"$LOG.err"
+commit_snap "Harvest TPU window: frontier ceiling rows" \
+  MFU_ATTRIB.jsonl "$LOG"
+
 # --- 1. transformer MFU: dense-vs-flash A/B, winner is the headline ------
 timeout 1800 python bench_mfu.py --attention best 2>>"$LOG.err" | tail -3 >> "$LOG"
 if grep -q '"platform": "tpu"' BENCH_MFU.json 2>/dev/null; then
