@@ -78,3 +78,18 @@ def test_language_model_int8_bundle_cpu(tmp_path):
     for line in rows:
         toks = [int(t) for t in line.split("[", 1)[1].rstrip("]").split(",")]
         assert toks[-5:] == list(range(toks[-5], toks[-5] + 5)), toks
+
+
+def test_language_model_speculative_cpu():
+    """--speculative: the demo trains a draft and decodes draft-and-
+    verify; the printed line must claim EXACT agreement with greedy and
+    a parseable acceptance rate."""
+    out = run_example("language_model.py", "--cpu", "--speculative",
+                      "--epochs", "2", timeout=600)
+    line = next(l for l in out.splitlines()
+                if l.startswith("speculative decode"))
+    assert "(EXACT vs greedy)" in line, line
+    rounds = int(line.rsplit(" in ", 1)[1].split(" verify")[0])
+    assert 1 <= rounds <= 12, line
+    rate = float(line.rsplit("(", 1)[1].split(" accepted")[0])
+    assert 1.0 <= rate <= 5.0, line  # k=4: bounded by k+1 per round
