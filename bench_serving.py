@@ -1,0 +1,160 @@
+"""Online-serving benchmark: continuous batching vs serve-one-at-a-time.
+
+The serving subsystem's claim is iteration-level scheduling (Orca-style
+continuous batching): with a bank of decode slots, finished sequences
+are evicted and queued requests admitted EVERY step, so a concurrent
+stream of mixed-length requests keeps the compiled step full instead of
+decoding sequentially. This harness drives the SAME ``ServingEngine``
+machinery both ways — ``--slots`` slot-bank vs a 1-slot engine (which
+degenerates to serve-one-request-at-a-time through identical scheduler,
+stepper, and dispatch code) — over an identical concurrent mixed-length
+request set, and reports the throughput ratio. Decode outputs are
+position-independent (each slot pins its solo greedy decode), so both
+sides produce identical tokens; the ratio measures scheduling alone.
+
+Writes BENCH_SERVING.json and prints one JSON line:
+    {"metric": "serving_tokens_per_sec", "value": ...,
+     "continuous": ..., "serial": ..., "speedup": ...}
+
+Usage: python bench_serving.py [--cpu] [--slots 8] [--requests 24]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+from bench import setup_backend
+
+
+def _make_requests(n, seq, vocab, rng):
+    """Mixed-length serving traffic: prompts 1..seq/4 tokens, decode
+    budgets seq/8..seq/2 — the ragged mix continuous batching exists
+    for (uniform requests would let static batching tie)."""
+    reqs = []
+    for _ in range(n):
+        plen = int(rng.integers(1, max(2, seq // 4)))
+        steps = int(rng.integers(max(2, seq // 8), seq // 2))
+        steps = min(steps, seq - plen)
+        prompt = rng.integers(0, vocab, plen).astype(np.int32)
+        reqs.append((prompt, steps))
+    return reqs
+
+
+def _drive(engine, reqs, timeout=600.0):
+    """Submit every request concurrently (one thread per request, like
+    independent clients), wait for all, return (wall_seconds,
+    tokens_generated, results)."""
+    results = [None] * len(reqs)
+
+    def worker(i):
+        prompt, steps = reqs[i]
+        results[i] = engine.generate(prompt, steps, timeout=timeout)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(reqs))
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    dt = time.perf_counter() - t0
+    toks = sum(steps for _, steps in reqs)
+    return dt, toks, results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=24)
+    args = ap.parse_args()
+
+    platform = setup_backend(cpu=args.cpu)
+
+    import jax
+
+    from distkeras_tpu.models.zoo import transformer_lm
+    from distkeras_tpu.serving import ServingEngine
+    from distkeras_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(platform=platform)
+    on_cpu = platform == "cpu"
+    # CPU tier shrinks vocab/width until the per-step cost is dispatch-
+    # bound rather than FLOP-bound — the regime a real chip's decode
+    # step lives in (memory-bound: a batch-8 step costs ~a batch-1
+    # step), so the CPU ratio measures SCHEDULING, not a 1-core MXU
+    # stand-in grinding 8x the matmul FLOPs per step
+    seq, d_model, depth, heads, vocab = (
+        (64, 64, 2, 4, 512) if on_cpu else (512, 512, 8, 8, 8192)
+    )
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+
+    model = transformer_lm(
+        vocab_size=vocab, seq_len=seq, d_model=d_model, num_heads=heads,
+        depth=depth, seed=0,
+    )
+    rng = np.random.default_rng(0)
+    reqs = _make_requests(args.requests, seq, vocab, rng)
+
+    def measure(num_slots):
+        eng = ServingEngine(
+            model, num_slots=num_slots,
+            queue_capacity=max(64, 2 * len(reqs)),
+        ).start()
+        try:
+            _drive(eng, reqs)  # compile + warm every prefill bucket
+            for k in eng.batcher.counters:
+                eng.batcher.counters[k] = 0  # count the timed run only
+            dt, toks, results = _drive(eng, reqs)
+            stats = eng.stats()
+        finally:
+            eng.stop()
+        assert all(r is not None for r in results), "requests lost"
+        return toks / dt, stats, results
+
+    cont_tps, cont_stats, cont_out = measure(args.slots)
+    serial_tps, serial_stats, serial_out = measure(1)
+    # composition independence: both schedules produce identical tokens
+    for a, b in zip(cont_out, serial_out):
+        assert np.array_equal(a, b), "continuous != serial decode output"
+
+    record = {
+        "metric": "serving_tokens_per_sec",
+        "value": round(cont_tps, 1),
+        "unit": "tokens/sec",
+        "platform": platform,
+        "device_kind": dev.device_kind,
+        "model": f"transformer_lm d{d_model} L{depth} seq{seq}",
+        "num_requests": len(reqs),
+        "prompt_lens": [int(p.size) for p, _ in reqs],
+        "decode_steps": [int(s) for _, s in reqs],
+        "continuous": {
+            "slots": args.slots,
+            "tokens_per_sec": round(cont_tps, 1),
+            "scheduler_steps": cont_stats["steps"],
+            "mean_batch_occupancy": round(
+                cont_stats["mean_batch_occupancy"], 2
+            ),
+        },
+        "serial_one_at_a_time": {
+            "slots": 1,
+            "tokens_per_sec": round(serial_tps, 1),
+            "scheduler_steps": serial_stats["steps"],
+        },
+        "speedup_continuous_vs_serial": round(cont_tps / serial_tps, 2),
+    }
+    with open("BENCH_SERVING.json", "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,11 @@ from distkeras_tpu.evaluators import (
     PerplexityEvaluator,
     RSquaredEvaluator,
 )
+from distkeras_tpu.serving import (
+    ServingClient,
+    ServingEngine,
+    ServingServer,
+)
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.data.transformers import (
     Transformer,

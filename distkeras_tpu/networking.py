@@ -45,9 +45,18 @@ def send_data(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def recv_data(sock: socket.socket) -> bytes:
+def recv_data(sock: socket.socket, max_len: int | None = None) -> bytes:
+    """``max_len``: refuse frames whose declared length exceeds it BEFORE
+    buffering a byte — on a port that accepts untrusted peers (the
+    serving server), an unchecked 64-bit prefix lets one client grow
+    server memory without bound."""
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
+    if max_len is not None and length > max_len:
+        raise ValueError(
+            f"incoming frame of {length} bytes exceeds the {max_len}-byte "
+            "limit"
+        )
     return _recv_exact(sock, length)
 
 

@@ -1,0 +1,41 @@
+"""Online serving subsystem: continuous-batching inference over the
+decode path (scheduler -> engine -> server, plus the client).
+
+- ``scheduler``: pure host-side request scheduling — iteration-level
+  (continuous) batching for autoregressive decode, windowed batching
+  for batch scoring, bounded-queue backpressure, deadlines, drain.
+- ``engine``: the device face — a slot-bank decode stepper compiled
+  once over a static (num_slots, seq_len) shape, fed by the scheduler
+  from a dedicated thread; loads serving bundles; logs metrics.
+- ``server``/``client``: the length-prefixed TCP wire
+  (``networking``) carrying pickle-free ``DKT1`` frames
+  (``utils.serialization``), verbs generate/predict/health/stats/stop.
+"""
+
+from distkeras_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    DeadlineExceededError,
+    EngineStoppedError,
+    OverloadedError,
+    ServeRequest,
+    ServingError,
+    WindowedBatcher,
+)
+from distkeras_tpu.serving.engine import DecodeStepper, ServingEngine
+from distkeras_tpu.serving.server import ServingServer, serve
+from distkeras_tpu.serving.client import ServingClient
+
+__all__ = [
+    "ContinuousBatcher",
+    "DeadlineExceededError",
+    "DecodeStepper",
+    "EngineStoppedError",
+    "OverloadedError",
+    "ServeRequest",
+    "ServingClient",
+    "ServingEngine",
+    "ServingError",
+    "ServingServer",
+    "WindowedBatcher",
+    "serve",
+]

@@ -1,0 +1,102 @@
+"""Client for ``ServingServer``: one persistent TCP connection, one
+request/reply frame pair per call (open one client per concurrent
+stream — the protocol is strictly request/reply per connection).
+
+Server-side failures come back typed: ``overloaded`` raises
+``OverloadedError`` (back off and retry), ``deadline_exceeded`` raises
+``DeadlineExceededError``, ``stopping`` raises ``EngineStoppedError``;
+anything else raises plain ``ServingError``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.networking import connect, recv_data, send_data
+from distkeras_tpu.serving.scheduler import (
+    DeadlineExceededError,
+    EngineStoppedError,
+    OverloadedError,
+    ServingError,
+)
+from distkeras_tpu.utils.serialization import (
+    deserialize_params,
+    pack_frame,
+    serialize_params,
+    unpack_frame,
+)
+
+_ERRORS = {
+    OverloadedError.code: OverloadedError,
+    DeadlineExceededError.code: DeadlineExceededError,
+    EngineStoppedError.code: EngineStoppedError,
+}
+
+
+class ServingClient:
+    def __init__(self, host, port, timeout=120.0):
+        self._sock = connect(host, int(port), timeout=timeout)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- round trip ---------------------------------------------------------
+
+    def _call(self, header: dict, payload: bytes = b""):
+        send_data(self._sock, pack_frame(header, payload))
+        reply, body = unpack_frame(recv_data(self._sock))
+        if not reply.get("ok"):
+            code = reply.get("error", "error")
+            raise _ERRORS.get(code, ServingError)(
+                reply.get("detail", code)
+            )
+        return reply, body
+
+    # -- verbs --------------------------------------------------------------
+
+    def generate(self, prompt, max_new_tokens, eos_id=None,
+                 deadline_ms=None) -> np.ndarray:
+        """Continue ``prompt`` (1-D int tokens) by up to
+        ``max_new_tokens``; returns the full sequence (prompt +
+        generated, trimmed after the first generated ``eos_id``)."""
+        header = {
+            "verb": "generate",
+            "max_new_tokens": int(max_new_tokens),
+        }
+        if eos_id is not None:
+            header["eos_id"] = int(eos_id)
+        if deadline_ms is not None:
+            header["deadline_ms"] = float(deadline_ms)
+        _, body = self._call(
+            header, serialize_params(np.asarray(prompt, np.int32))
+        )
+        return np.asarray(deserialize_params(body))
+
+    def predict(self, x) -> np.ndarray:
+        _, body = self._call(
+            {"verb": "predict"}, serialize_params(np.asarray(x))
+        )
+        return np.asarray(deserialize_params(body))
+
+    def health(self) -> dict:
+        reply, _ = self._call({"verb": "health"})
+        return reply
+
+    def stats(self) -> dict:
+        reply, _ = self._call({"verb": "stats"})
+        return reply["stats"]
+
+    def stop(self) -> dict:
+        """Ask the server to drain and shut down (acked before the
+        listener closes)."""
+        reply, _ = self._call({"verb": "stop"})
+        return reply

@@ -1,0 +1,497 @@
+"""Online inference engine: the device face of the serving runtime.
+
+``DecodeStepper`` turns ``CachedSequenceGenerator``'s one-shot compiled
+decode into an ITERATION-LEVEL program: a fixed (num_slots, seq_len)
+slot bank where every call to ``step`` advances each active slot by one
+token against persistent per-stage K/V caches, and ``admit`` prefills a
+single slot's prompt without disturbing its neighbours. The batch shape
+is static — XLA compiles the step once per sampling config and the
+prefill once per prompt-length bucket (powers of two, like the ragged
+generator's bucketed scan keys) — so continuous batching churns the
+logical batch composition at zero recompiles.
+
+Per-slot positions are the one thing the generators' shared
+``_stage_chunk`` body cannot express (its K/V write offset and query
+mask are batch-wide), so the step body here re-states the same
+attention math with a per-row write index and a per-row (B, T) mask;
+everything else — model-family parsing, param-group unpacking, MoE
+no-drop routing, the prompt prefill — is reused from the generator.
+
+``ServingEngine`` wraps the stepper in a ``ContinuousBatcher`` driven
+by a dedicated scheduler thread, adds a ``WindowedBatcher`` over
+``ModelPredictor`` for batch scoring, and wires per-request latency /
+queue-depth / batch-occupancy metrics into
+``utils.profiling.MetricsLogger`` with ``annotate()`` trace spans
+around the device phases.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from distkeras_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    EngineStoppedError,
+    ServeRequest,
+    WindowedBatcher,
+)
+from distkeras_tpu.utils.profiling import annotate
+
+
+def _bucket_pow2(n: int, cap: int) -> int:
+    """Round ``n`` up to a power of two, clamped to ``cap`` (compiled-
+    program keys must not grow per distinct prompt length). n <= 0
+    stays 0: a one-token prompt has nothing to prefill."""
+    if n <= 0:
+        return 0
+    return min(1 << (n - 1).bit_length(), cap)
+
+
+class DecodeStepper:
+    """Slot-bank decode over a causal-LM-family model.
+
+    State per slot: one row of the (B, T) token buffer and one row of
+    each stage's (B, T, H, Dh) K/V caches, plus a host-side length.
+    ``admit(slot, prompt)`` writes the prompt row and prefills K/V for
+    positions ``0..len-2`` (the step that follows consumes the last
+    prompt token, exactly like ``CachedSequenceGenerator``'s scan
+    start). ``step(active)`` embeds each slot's last token at its OWN
+    position, attends one row against the caches, and appends the
+    sampled/greedy token — inactive slots freeze (masked writes).
+    Greedy slot output is the cached generator's greedy decode, token
+    for token, regardless of what the neighbouring slots are doing.
+    """
+
+    def __init__(self, model, num_slots=8, temperature=0.0, seed=0,
+                 top_k=None, top_p=None, kv_dtype=None):
+        import jax.numpy as jnp
+
+        from distkeras_tpu.predictors import CachedSequenceGenerator
+
+        # reuse the generator's model-family validation, stage parsing,
+        # sampling config, and MoE no-drop routing wholesale
+        self._gen = CachedSequenceGenerator(
+            model, temperature=temperature, seed=seed, top_k=top_k,
+            top_p=top_p, kv_dtype=kv_dtype,
+        )
+        self.model = model
+        self.num_slots = int(num_slots)
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1; got {num_slots}")
+        self.max_len = int(model.input_shape[0])
+        self.seed = int(seed)
+        nh = self._gen._blocks[0].mhsa.num_heads
+        from distkeras_tpu.ops.quantization import qshape
+
+        hd = qshape(
+            model.params[str(self._gen._stages[0][1])]["mhsa"]["wq"]
+        )[1] // nh
+        b, t = self.num_slots, self.max_len
+        self._ctx = jnp.zeros((b, t), jnp.int32)
+        self._caches = [
+            (
+                jnp.zeros((b, t, nh, hd), self._gen.kv_dtype),
+                jnp.zeros((b, t, nh, hd), self._gen.kv_dtype),
+            )
+            for _ in self._gen._stages
+        ]
+        self._lens = np.ones((b,), np.int32)  # host mirror; >=1 always
+        self._step_idx = 0  # RNG schedule: one fold per global step
+        self._step_fn = None
+        self._admit_fns = {}  # prefill-length bucket -> compiled admit
+
+    # -- param plumbing -----------------------------------------------------
+
+    def _unpack(self, params):
+        """Per-stage (block, MoE) param groups + embed/ln/head groups,
+        keyed by layer index exactly as ``_decode_prologue`` does."""
+        n_layers = len(self.model.layers)
+        bp = [
+            (params[str(bi)], None if mi is None else params[str(mi)])
+            for (_, bi, _, mi) in self._gen._stages
+        ]
+        return (
+            bp,
+            params["0"],
+            params[str(n_layers - 2)],
+            params[str(n_layers - 1)],
+        )
+
+    def _embed(self, p_emb, tok, pos):
+        """Embed (B,) tokens at per-slot (B,) positions (clamped to the
+        table like the generator's embed closure)."""
+        import jax.numpy as jnp
+
+        x = p_emb["tokens"][tok]
+        if "positions" in p_emb:
+            n_pos = p_emb["positions"].shape[0]
+            x = x + p_emb["positions"][jnp.minimum(pos, n_pos - 1)]
+        return x
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, slot: int, prompt) -> None:
+        """Write ``prompt`` into ``slot`` and prefill its K/V rows. The
+        prefill length buckets to a power of two (garbage K/V computed
+        past the real prompt is overwritten by the decode steps before
+        any query can attend it), so a serving mix of naturally varying
+        prompt lengths costs O(log T) compiles, not O(T)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = prompt.size
+        if not 1 <= plen <= self.max_len:
+            raise ValueError(
+                f"prompt length {plen} outside [1, {self.max_len}]"
+            )
+        row = np.zeros((1, self.max_len), np.int32)
+        row[0, :plen] = prompt
+        pb = _bucket_pow2(plen - 1, self.max_len - 1)
+        fn = self._admit_fns.get(pb)
+        if fn is None:
+            fn = self._build_admit_fn(pb)
+            # copy-on-write: stats() iterates this dict from other
+            # threads, so never mutate a published mapping in place
+            self._admit_fns = {**self._admit_fns, pb: fn}
+        with annotate("serving/prefill"):
+            self._ctx, self._caches = fn(
+                self.model.params, self._ctx, self._caches, row,
+                np.int32(slot),
+            )
+        self._lens[slot] = plen
+
+    def release(self, slot: int) -> None:
+        self._lens[slot] = 1  # keep pos = lens-1 in range while parked
+
+    def _build_admit_fn(self, pb: int):
+        """Compiled slot admission for prefill bucket ``pb``: write the
+        (1, T) prompt row into the slot and prefill cache positions
+        0..pb-1 via the generator's shared ``_prefill`` body."""
+        import jax
+        import jax.numpy as jnp
+
+        gen = self._gen
+
+        def admit(params, ctx, caches, row, slot):
+            bp, p_emb, _, _ = self._unpack(params)
+            ctx = jax.lax.dynamic_update_slice(ctx, row, (slot, 0))
+            if pb >= 1:
+                x = p_emb["tokens"][row[:, :pb]]
+                if "positions" in p_emb:
+                    x = x + p_emb["positions"][:pb]
+                nh, hd = caches[0][0].shape[2], caches[0][0].shape[3]
+                small = [
+                    (
+                        jnp.zeros((1, pb, nh, hd), gen.kv_dtype),
+                        jnp.zeros((1, pb, nh, hd), gen.kv_dtype),
+                    )
+                    for _ in gen._stages
+                ]
+                _, small = gen._prefill(bp, small, x)
+                caches = [
+                    (
+                        jax.lax.dynamic_update_slice(
+                            ck, sk, (slot, 0, 0, 0)
+                        ),
+                        jax.lax.dynamic_update_slice(
+                            cv, sv, (slot, 0, 0, 0)
+                        ),
+                    )
+                    for (ck, cv), (sk, sv) in zip(caches, small)
+                ]
+            return ctx, caches
+
+        return jax.jit(admit, donate_argnums=(1, 2))
+
+    # -- the decode step ----------------------------------------------------
+
+    def step(self, active) -> np.ndarray:
+        """Advance every active slot one token; returns the (B,) tokens
+        appended this step (entries for inactive slots are meaningless).
+        One compiled call plus one small host fetch per step — the
+        iteration-level scheduling loop the batcher drives."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step_fn()
+        active = np.asarray(active, bool)
+        with annotate("serving/step"):
+            self._ctx, self._caches, toks = self._step_fn(
+                self.model.params, self._ctx, self._caches,
+                self._lens.copy(), active, np.int32(self._step_idx),
+            )
+        self._step_idx += 1
+        toks = np.asarray(toks)
+        self._lens[active] = np.minimum(
+            self._lens[active] + 1, self.max_len
+        )
+        return toks
+
+    def _build_step_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        from distkeras_tpu.ops.quantization import qmatmul, qshape
+
+        gen = self._gen
+        temp, b, t = gen.temperature, self.num_slots, self.max_len
+        base_key = jax.random.PRNGKey(self.seed)
+
+        def stage_step(blk, moe, p, pm, x, ck, cv, pos, active):
+            """One token per slot through one (block, optional MoE)
+            stage: the per-slot-position restatement of the generators'
+            ``_stage_chunk`` C=1 body — K/V write at each row's own
+            ``pos``, query mask per row, writes frozen where inactive."""
+            mh = p["mhsa"]
+            nh = blk.mhsa.num_heads
+            hd = qshape(mh["wq"])[1] // nh
+            h_, _ = blk.ln1.apply(p["ln1"], {}, x)
+            q = qmatmul(h_, mh["wq"]).reshape(b, nh, hd)
+            k_new = qmatmul(h_, mh["wk"]).reshape(b, nh, hd)
+            v_new = qmatmul(h_, mh["wv"]).reshape(b, nh, hd)
+            rows = jnp.arange(b)
+            keep = active[:, None, None]
+            ck = ck.at[rows, pos].set(
+                jnp.where(keep, k_new.astype(ck.dtype), ck[rows, pos])
+            )
+            cv = cv.at[rows, pos].set(
+                jnp.where(keep, v_new.astype(cv.dtype), cv[rows, pos])
+            )
+            scores = jnp.einsum("bhd,bthd->bht", q, ck) / np.sqrt(hd)
+            t_mask = jnp.arange(t)[None, :] <= pos[:, None]  # (B, T)
+            scores = jnp.where(t_mask[:, None, :], scores, -jnp.inf)
+            w = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bht,bthd->bhd", w, cv).reshape(b, nh * hd)
+            o = qmatmul(o, mh["wo"])
+            if "bo" in mh:
+                o = o + mh["bo"]
+            x = x + o
+            h_, _ = blk.ln2.apply(p["ln2"], {}, x)
+            h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
+            h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
+            x = x + h_
+            if moe is not None:
+                x = x + gen._moe_nodrop(pm, x)
+            return x, ck, cv
+
+        def step(params, ctx, caches, lens, active, step_idx):
+            bp, p_emb, p_ln, p_head = self._unpack(params)
+            pos = jnp.clip(lens - 1, 0, t - 1)  # (B,) per-slot position
+            tok = jnp.take_along_axis(ctx, pos[:, None], axis=1)[:, 0]
+            x = self._embed(p_emb, tok, pos)
+            new_caches = []
+            for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
+                gen._stages, bp, caches
+            ):
+                x, ck, cv = stage_step(
+                    blk, moe, p, pm, x, ck, cv, pos, active
+                )
+                new_caches.append((ck, cv))
+            x, _ = gen._final_ln.apply(p_ln, {}, x)
+            logit, _ = gen._head.apply(p_head, {}, x)  # (B, V)
+            if temp == 0.0:
+                nxt = jnp.argmax(logit, axis=-1).astype(ctx.dtype)
+            else:
+                sub = jax.random.fold_in(base_key, step_idx)
+                nxt = jax.random.categorical(
+                    sub, gen._filter_logits(logit / temp), axis=-1
+                ).astype(ctx.dtype)
+            wpos = jnp.clip(pos + 1, 0, t - 1)
+            rows = jnp.arange(b)
+            cur = ctx[rows, wpos]
+            write = active & (pos + 1 <= t - 1)
+            ctx = ctx.at[rows, wpos].set(jnp.where(write, nxt, cur))
+            return ctx, new_caches, nxt
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+
+class ServingEngine:
+    """The in-process serving runtime: continuous-batching decode plus
+    windowed batch scoring over one model, driven by a dedicated
+    scheduler thread. ``server.ServingServer`` fronts it with TCP; it
+    is equally usable embedded (the benchmark drives it directly).
+
+    ``generate`` is synchronous (submit + wait); ``submit`` returns the
+    ``ServeRequest`` handle for callers managing their own concurrency.
+    ``stop(drain=True)`` refuses new work and completes everything
+    already admitted or queued before returning — the graceful-shutdown
+    contract the server's ``stop`` verb exposes.
+    """
+
+    def __init__(self, model, num_slots=8, queue_capacity=64,
+                 temperature=0.0, seed=0, top_k=None, top_p=None,
+                 kv_dtype=None, predict_batch=64, predict_window=0.005,
+                 metrics_path=None):
+        self.model = model
+        self._stepper = None
+        self._decode_err = None
+        try:
+            self._stepper = DecodeStepper(
+                model, num_slots=num_slots, temperature=temperature,
+                seed=seed, top_k=top_k, top_p=top_p, kv_dtype=kv_dtype,
+            )
+        except ValueError as e:
+            # non-LM models still serve the predict verb; generate
+            # replies with this error instead of refusing to boot
+            self._decode_err = e
+        self.batcher = (
+            None
+            if self._stepper is None
+            else ContinuousBatcher(
+                self._stepper, queue_capacity=queue_capacity
+            )
+        )
+        from distkeras_tpu.data.dataset import Dataset
+        from distkeras_tpu.predictors import ModelPredictor
+
+        self._Dataset = Dataset
+        self._predictor = ModelPredictor(
+            model, batch_size=int(predict_batch)
+        )
+        self._predict_batcher = WindowedBatcher(
+            self._run_predict_batch, max_batch=int(predict_batch),
+            max_wait=float(predict_window),
+        )
+        self.metrics = None
+        if metrics_path is not None:
+            from distkeras_tpu.utils.profiling import MetricsLogger
+
+            self.metrics = MetricsLogger(metrics_path)
+        self._thread = None
+        self._stop_evt = threading.Event()
+        self._started = False
+
+    @classmethod
+    def from_bundle(cls, path: str, **kwargs) -> "ServingEngine":
+        """Boot from a quantized serving bundle on disk — what a serving
+        host does at startup (``utils.serialization.load_serving_bundle``
+        validates structure, shapes, AND dtypes before any weight is
+        trusted)."""
+        from distkeras_tpu.utils.serialization import load_serving_bundle
+
+        return cls(load_serving_bundle(path), **kwargs)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ServingEngine":
+        if self._started:
+            return self
+        self._started = True
+        self._predict_batcher.start()
+        if self.batcher is not None:
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-engine", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        """The scheduler thread: admit/step/evict until stopped; in
+        drain mode, exit only once everything in flight completed. A
+        device-side crash fails every pending request loudly instead of
+        leaving clients blocked until their timeouts."""
+        try:
+            while True:
+                progressed = self.batcher.step()
+                if self._stop_evt.is_set() and self.batcher.idle:
+                    return
+                if not progressed:
+                    if self._stop_evt.is_set():
+                        return
+                    self.batcher.wait_for_work()
+        except Exception as e:  # noqa: BLE001 — scheduler crash boundary
+            self.batcher.stop()
+            if self.metrics is not None:
+                self.metrics.log(
+                    event="serving_engine_crash", error=repr(e)
+                )
+            raise
+
+    def stop(self, drain=True):
+        """Shutdown. ``drain=True``: stop admissions, finish queued and
+        in-flight requests, then stop; ``drain=False``: fail them."""
+        if self.batcher is not None:
+            if drain:
+                self.batcher.drain()
+            else:
+                self.batcher.stop()
+        self._stop_evt.set()
+        if self.batcher is not None:
+            self.batcher._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+        if not drain and self.batcher is not None:
+            self.batcher.stop()  # fail anything the loop left behind
+        self._predict_batcher.close()
+
+    # -- generate -----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens, eos_id=None,
+               deadline=None) -> ServeRequest:
+        if self.batcher is None:
+            raise EngineStoppedError(
+                f"model does not support generate: {self._decode_err}"
+            )
+        if not self._started:
+            raise EngineStoppedError("engine not started")
+        req = ServeRequest(
+            prompt, max_new_tokens, eos_id=eos_id, deadline=deadline
+        )
+        try:
+            return self.batcher.submit(req)
+        finally:
+            if self.metrics is not None:
+                st = self.batcher.stats()
+                self.metrics.log(
+                    event="serving_submit", request_id=req.id,
+                    prompt_len=int(req.prompt.size),
+                    max_new_tokens=req.max_new_tokens,
+                    queue_depth=st["queue_depth"],
+                    active_slots=st["active_slots"],
+                )
+
+    def generate(self, prompt, max_new_tokens, eos_id=None,
+                 deadline=None, timeout=None) -> np.ndarray:
+        req = self.submit(
+            prompt, max_new_tokens, eos_id=eos_id, deadline=deadline
+        )
+        try:
+            return req.result(timeout)
+        finally:
+            if self.metrics is not None:
+                lat = req.latency()
+                self.metrics.log(
+                    event="serving_complete", request_id=req.id,
+                    tokens=len(req.tokens),
+                    error=None if req.error is None else req.error.code,
+                    **{k: v for k, v in lat.items() if v is not None},
+                )
+
+    # -- predict ------------------------------------------------------------
+
+    def _run_predict_batch(self, x):
+        with annotate("serving/predict_batch"):
+            ds = self._Dataset({"features": x})
+            return self._predictor.predict(ds)["prediction"]
+
+    def predict(self, x, timeout=None) -> np.ndarray:
+        """Batch-scoring face: rows accumulate into the current window
+        and run as one padded ``ModelPredictor`` forward."""
+        if not self._started:
+            raise EngineStoppedError("engine not started")
+        return self._predict_batcher.submit(x).result(timeout)
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = {
+            "model": type(self.model).__name__,
+            "num_params": int(self.model.num_params()),
+            "generate_enabled": self.batcher is not None,
+        }
+        if self.batcher is not None:
+            out.update(self.batcher.stats())
+            out["compiled_prefill_buckets"] = sorted(
+                self._stepper._admit_fns
+            )
+        return out

@@ -1,0 +1,460 @@
+"""Request scheduling for the online serving runtime — pure host logic.
+
+Two schedulers, one per inference shape:
+
+- ``ContinuousBatcher``: iteration-level (Orca-style) batching for the
+  autoregressive decode path. A fixed bank of ``num_slots`` sequence
+  slots advances ONE token per scheduler step; finished sequences are
+  evicted and queued requests admitted between steps, so the compiled
+  decode step always sees the same static (num_slots, seq_len) shape
+  while the logical batch composition churns freely. This is the
+  serving counterpart of the generators' "one compiled program" rule:
+  the program is compiled once, occupancy is a runtime mask.
+- ``WindowedBatcher``: size/timeout-windowed batching for
+  ``ModelPredictor``-style batch scoring — requests accumulate until
+  the window fills or the wait budget expires, then run as one padded
+  forward.
+
+Neither class imports JAX or touches sockets: the device face is an
+injected "stepper" object (``engine.DecodeStepper`` in production, a
+pure-Python fake in the unit tests) with::
+
+    num_slots : int        # slot-bank width (static batch shape)
+    max_len   : int        # sequence capacity per slot
+    admit(slot, prompt)    # prefill a slot with a new request's prompt
+    release(slot)          # slot freed (bookkeeping hook)
+    step(active) -> (num_slots,) int array, the token appended per slot
+
+Backpressure is explicit: a full queue rejects at ``submit`` with
+``OverloadedError`` (the server turns that into an ``overloaded`` wire
+reply) instead of queueing unboundedly. Per-request deadlines are
+checked at admission and after every step; drain mode stops admission
+of NEW requests while in-flight ones run to completion.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+
+class ServingError(RuntimeError):
+    """Base class for request-level serving failures; ``code`` is the
+    stable wire-level error string the server replies with."""
+
+    code = "error"
+
+
+class OverloadedError(ServingError):
+    """Admission queue full — retry later (explicit backpressure)."""
+
+    code = "overloaded"
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired before it finished decoding."""
+
+    code = "deadline_exceeded"
+
+
+class EngineStoppedError(ServingError):
+    """The engine is draining or stopped; no new admissions."""
+
+    code = "stopping"
+
+
+class ServeRequest:
+    """One generate request riding the continuous batcher.
+
+    ``deadline`` is an absolute ``time.monotonic()`` instant (None =
+    no deadline). ``result(timeout)`` blocks until the request finishes
+    and returns the full sequence (prompt + generated tokens, cut after
+    the first generated ``eos_id`` inclusive, matching the generators'
+    return convention) or raises the recorded ``ServingError``.
+    """
+
+    _ids = iter(range(1, 1 << 62))
+    _ids_lock = threading.Lock()
+
+    def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1; got {max_new_tokens}"
+            )
+        with self._ids_lock:
+            self.id = next(self._ids)
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.deadline = None if deadline is None else float(deadline)
+        self.created = time.monotonic()
+        self.started = None  # admission instant (queue wait ends)
+        self.finished = None
+        self.tokens: list[int] = []  # generated tokens, in order
+        self.error: ServingError | None = None
+        self._done = threading.Event()
+
+    # -- lifecycle (called by the batcher, under its lock) ------------------
+
+    def _finish(self, error: ServingError | None = None):
+        self.error = error
+        self.finished = time.monotonic()
+        self._done.set()
+
+    def _expired(self, now) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+    # -- caller face --------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout=None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still running")
+        if self.error is not None:
+            raise self.error
+        seq = np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)]
+        )
+        if self.eos_id is not None and self.eos_id in self.tokens:
+            cut = self.prompt.size + self.tokens.index(self.eos_id) + 1
+            seq = seq[:cut]
+        return seq
+
+    def latency(self) -> dict:
+        """Per-request timing breakdown (seconds) for the metrics sink."""
+        return {
+            "queue_wait": (
+                None if self.started is None else self.started - self.created
+            ),
+            "total": (
+                None
+                if self.finished is None
+                else self.finished - self.created
+            ),
+        }
+
+
+class ContinuousBatcher:
+    """Slot-bank continuous batching: admission, eviction, and completion
+    bookkeeping around an injected device stepper. Thread-safe submit;
+    ``step()`` must be driven by exactly one loop (the engine thread).
+    """
+
+    def __init__(self, stepper, queue_capacity=64):
+        self.stepper = stepper
+        self.queue_capacity = int(queue_capacity)
+        if self.queue_capacity < 1:
+            raise ValueError("queue_capacity must be >= 1")
+        self._queue: collections.deque[ServeRequest] = collections.deque()
+        self._slots: list[ServeRequest | None] = [None] * stepper.num_slots
+        self._lock = threading.Lock()
+        self._work = threading.Event()  # signals the engine loop
+        self._draining = False
+        self._stopped = False
+        self.counters = {
+            "submitted": 0,
+            "rejected_overloaded": 0,
+            "completed": 0,
+            "deadline_exceeded": 0,
+            "steps": 0,
+            "occupancy_sum": 0,  # sum over steps of active slots
+            "tokens_generated": 0,
+        }
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, req: ServeRequest) -> ServeRequest:
+        """Enqueue a request or fail fast: ``EngineStoppedError`` while
+        draining/stopped, ``OverloadedError`` on a full queue (the
+        bounded queue IS the backpressure contract), ``ValueError`` when
+        the request cannot ever fit the slot capacity."""
+        if req.prompt.size + req.max_new_tokens > self.stepper.max_len:
+            raise ValueError(
+                f"prompt ({req.prompt.size}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds the serving capacity "
+                f"({self.stepper.max_len})"
+            )
+        with self._lock:
+            if self._draining or self._stopped:
+                raise EngineStoppedError("engine is draining; not accepting")
+            if len(self._queue) >= self.queue_capacity:
+                self.counters["rejected_overloaded"] += 1
+                raise OverloadedError(
+                    f"admission queue full ({self.queue_capacity})"
+                )
+            self._queue.append(req)
+            self.counters["submitted"] += 1
+        self._work.set()
+        return req
+
+    # -- scheduler iteration ------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit queued requests into free
+        slots, advance every active slot one token, evict finished
+        sequences. Returns True when any slot advanced (the engine loop
+        idles when False)."""
+        now = time.monotonic()
+        admitted = []
+        with self._lock:
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    continue
+                req = self._pop_live(now)
+                if req is None:
+                    break
+                self._slots[i] = req
+                req.started = now
+                admitted.append((i, req))
+            active = np.array(
+                [s is not None for s in self._slots], bool
+            )
+        # device work outside the lock: submit() must never block on a
+        # compile or a step (backpressure replies stay fast under load)
+        for i, req in admitted:
+            self.stepper.admit(i, req.prompt)
+        if not active.any():
+            return False
+        toks = np.asarray(self.stepper.step(active))
+        now = time.monotonic()
+        with self._lock:
+            self.counters["steps"] += 1
+            self.counters["occupancy_sum"] += int(active.sum())
+            for i, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                tok = int(toks[i])
+                req.tokens.append(tok)
+                self.counters["tokens_generated"] += 1
+                finished = (
+                    len(req.tokens) >= req.max_new_tokens
+                    or (req.eos_id is not None and tok == req.eos_id)
+                )
+                if finished:
+                    self._evict(i, req, None)
+                elif req._expired(now):
+                    self._evict(
+                        i,
+                        req,
+                        DeadlineExceededError(
+                            f"deadline passed after {len(req.tokens)} tokens"
+                        ),
+                    )
+        return True
+
+    def _pop_live(self, now) -> ServeRequest | None:
+        """Next queued request whose deadline has not already expired;
+        expired ones complete immediately with DeadlineExceededError.
+        Caller holds the lock."""
+        while self._queue:
+            req = self._queue.popleft()
+            if req._expired(now):
+                self.counters["deadline_exceeded"] += 1
+                req._finish(
+                    DeadlineExceededError("deadline expired in queue")
+                )
+                continue
+            return req
+        return None
+
+    def _evict(self, slot_idx, req, error):
+        """Free a slot and complete its request. Caller holds the lock."""
+        self._slots[slot_idx] = None
+        self.stepper.release(slot_idx)
+        if error is None:
+            self.counters["completed"] += 1
+        else:
+            self.counters["deadline_exceeded"] += 1
+        req._finish(error)
+
+    # -- drain / shutdown ---------------------------------------------------
+
+    def drain(self):
+        """Stop admitting NEW requests; queued and in-flight ones keep
+        running (the engine loop calls ``step`` until ``idle``)."""
+        with self._lock:
+            self._draining = True
+        self._work.set()
+
+    def stop(self):
+        """Hard stop: fail everything still queued or in flight."""
+        with self._lock:
+            self._draining = self._stopped = True
+            while self._queue:
+                self._queue.popleft()._finish(
+                    EngineStoppedError("engine stopped")
+                )
+            for i, req in enumerate(self._slots):
+                if req is not None:
+                    self._slots[i] = None
+                    self.stepper.release(i)
+                    req._finish(EngineStoppedError("engine stopped"))
+        self._work.set()
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._queue and all(
+                s is None for s in self._slots
+            )
+
+    def stats(self) -> dict:
+        with self._lock:
+            active = sum(s is not None for s in self._slots)
+            out = dict(self.counters)
+            out["queue_depth"] = len(self._queue)
+            out["active_slots"] = active
+            out["num_slots"] = len(self._slots)
+            out["draining"] = self._draining
+        steps = out["steps"]
+        out["mean_batch_occupancy"] = (
+            out["occupancy_sum"] / steps if steps else 0.0
+        )
+        return out
+
+    def wait_for_work(self, timeout=0.05):
+        """Engine-loop helper: park until a submit/drain signal."""
+        self._work.wait(timeout)
+        self._work.clear()
+
+
+class _Ticket:
+    """Completion handle for one windowed-batch item."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result = None
+        self._error = None
+
+    def _finish(self, result=None, error=None):
+        self._result, self._error = result, error
+        self._done.set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("predict batch still running")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class WindowedBatcher:
+    """Size/timeout-windowed batcher for batch scoring: items accumulate
+    until ``max_batch`` rows are waiting or ``max_wait`` elapsed since
+    the first, then ``run_batch`` scores them as one array and each
+    ticket receives its row span. The ``ModelPredictor`` face of the
+    server — decode gets iteration-level batching, scoring gets windows.
+    """
+
+    def __init__(self, run_batch, max_batch=64, max_wait=0.005,
+                 queue_capacity=256):
+        self.run_batch = run_batch
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait)
+        self.queue_capacity = int(queue_capacity)
+        self._items: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="windowed-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def submit(self, x) -> _Ticket:
+        x = np.asarray(x)
+        if x.ndim < 1:
+            raise ValueError("predict input must be at least 1-D (rows)")
+        if len(x) > self.queue_capacity:
+            # a request that can NEVER fit is a caller error, not
+            # transient backpressure — OverloadedError would send the
+            # client into a retry loop that cannot succeed
+            raise ValueError(
+                f"predict request of {len(x)} rows exceeds the queue "
+                f"capacity ({self.queue_capacity})"
+            )
+        ticket = _Ticket()
+        with self._lock:
+            if self._stop:
+                raise EngineStoppedError("predict batcher stopped")
+            depth = sum(len(item) for item, _ in self._items)
+            if depth + len(x) > self.queue_capacity:
+                raise OverloadedError(
+                    f"predict queue full ({self.queue_capacity} rows)"
+                )
+            self._items.append((x, ticket))
+        self._work.set()
+        return ticket
+
+    def _loop(self):
+        while True:
+            self._work.wait(0.05)
+            self._work.clear()
+            batch = self._collect()
+            if batch is None:
+                if self._stop and not self._items:
+                    return
+                continue
+            xs, tickets = batch
+            try:
+                ys = self.run_batch(np.concatenate(xs, axis=0))
+            except Exception as e:  # noqa: BLE001 — per-window boundary
+                for _, t in zip(xs, tickets):
+                    t._finish(error=e)
+                continue
+            off = 0
+            for x, t in zip(xs, tickets):
+                t._finish(result=np.asarray(ys[off : off + len(x)]))
+                off += len(x)
+
+    def _collect(self):
+        """Wait out the window from the first queued item, then take up
+        to ``max_batch`` rows (whole items only; one oversized item runs
+        alone rather than splitting a request across windows)."""
+        with self._lock:
+            if not self._items:
+                return None
+        deadline = time.monotonic() + self.max_wait
+        while time.monotonic() < deadline:
+            with self._lock:
+                if (
+                    sum(len(i) for i, _ in self._items) >= self.max_batch
+                    or self._stop
+                ):
+                    break
+            time.sleep(self.max_wait / 10)
+        xs, tickets, rows = [], [], 0
+        with self._lock:
+            while self._items:
+                x, t = self._items[0]
+                if xs and rows + len(x) > self.max_batch:
+                    break
+                self._items.popleft()
+                xs.append(x)
+                tickets.append(t)
+                rows += len(x)
+        return (xs, tickets) if xs else None
+
+    def close(self):
+        with self._lock:
+            self._stop = True
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

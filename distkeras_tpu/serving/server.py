@@ -1,0 +1,246 @@
+"""TCP front of the serving engine — the online face of ``networking``.
+
+Same wire primitives as the cross-host parameter-server path
+(``networking.send_data``/``recv_data``: 8-byte length prefix, Nagle
+off) carrying ``serialization.pack_frame`` frames (JSON header + npz
+payload, no pickle on the wire — the serving port accepts bytes from
+untrusted clients, so the codec choice is load-bearing here, not just
+hygiene). One frame per request, one per reply; each connection gets a
+thread, so slow clients never block the scheduler.
+
+Verbs (header ``{"verb": ...}``):
+
+- ``generate``: payload = 1-D int prompt; header carries
+  ``max_new_tokens``, optional ``eos_id``, optional ``deadline_ms``
+  (budget relative to arrival). Reply payload = the full sequence
+  (prompt + generated, eos-trimmed). Failures reply
+  ``{"ok": false, "error": code}`` with code ``overloaded`` (bounded
+  admission queue full — explicit backpressure), ``deadline_exceeded``,
+  or ``stopping`` (drain in progress).
+- ``predict``: payload = (N, ...) feature rows; reply payload = the
+  model's outputs (windowed-batched server-side).
+- ``health`` / ``stats``: JSON-only replies.
+- ``stop``: begins graceful shutdown — in-flight and queued requests
+  complete, new ones are refused, then the listener closes.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+
+from distkeras_tpu.networking import recv_data, send_data
+from distkeras_tpu.serving.scheduler import ServingError
+from distkeras_tpu.utils.serialization import (
+    deserialize_params,
+    pack_frame,
+    serialize_params,
+    unpack_frame,
+)
+
+_PROTOCOL = 1
+
+
+class ServingServer:
+    """Serve one ``ServingEngine`` over TCP. ``port=0`` binds an
+    ephemeral port (read it back from ``.port``)."""
+
+    def __init__(self, engine, host="127.0.0.1", port=0, backlog=64,
+                 max_frame_bytes=64 << 20):
+        """``max_frame_bytes``: per-request frame cap enforced before
+        buffering (the port accepts untrusted bytes; an unchecked
+        length prefix is a one-client memory DoS). 64 MiB comfortably
+        covers prompts and predict feature batches."""
+        self.engine = engine
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(int(backlog))
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._accept_thread = None
+        self._conn_threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ServingServer":
+        self.engine.start()
+        if self._accept_thread is None:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, name="serving-accept",
+                daemon=True,
+            )
+            self._accept_thread.start()
+        return self
+
+    def shutdown(self, drain=True):
+        """Close the listener and stop the engine. ``drain=True`` lets
+        queued and in-flight requests finish first (their connection
+        threads stay alive until the replies are flushed)."""
+        if self._stopping.is_set():
+            return
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self.engine.stop(drain=drain)
+        with self._lock:
+            threads = list(self._conn_threads)
+        # short grace for threads flushing their last reply, then
+        # force-close the sockets of the rest — an idle persistent
+        # connection sits in recv_data forever and would otherwise
+        # stall shutdown and leak its thread
+        deadline = time.monotonic() + 5
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        with self._lock:
+            lingering = list(self._conns)
+        for conn in lingering:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for th in threads:
+            th.join(timeout=5)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # -- connection handling ------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            th = threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="serving-conn", daemon=True,
+            )
+            with self._lock:
+                self._conn_threads = [
+                    t for t in self._conn_threads if t.is_alive()
+                ]
+                self._conn_threads.append(th)
+                self._conns.add(conn)
+            th.start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            self._serve_frames(conn)
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_frames(self, conn: socket.socket):
+        while True:
+            try:
+                frame = recv_data(conn, max_len=self.max_frame_bytes)
+            except ValueError:
+                # oversized declared frame: the stream position is
+                # unrecoverable (bytes keep coming) — reply and close
+                try:
+                    send_data(conn, pack_frame(
+                        {"ok": False, "error": "frame_too_large",
+                         "detail": f"limit {self.max_frame_bytes} bytes"}
+                    ))
+                except (ConnectionError, OSError):
+                    pass
+                return
+            except (ConnectionError, OSError):
+                return
+            try:
+                reply = self._dispatch(frame)
+            except ServingError as e:
+                reply = pack_frame(
+                    {"ok": False, "error": e.code, "detail": str(e)}
+                )
+            except Exception as e:  # noqa: BLE001 — wire boundary
+                reply = pack_frame(
+                    {"ok": False, "error": "bad_request",
+                     "detail": repr(e)}
+                )
+            try:
+                send_data(conn, reply)
+            except (ConnectionError, OSError):
+                return
+            if self._stopping.is_set():
+                return
+
+    # -- verbs --------------------------------------------------------------
+
+    def _dispatch(self, frame: bytes) -> bytes:
+        header, payload = unpack_frame(frame)
+        verb = header.get("verb")
+        if verb == "generate":
+            return self._generate(header, payload)
+        if verb == "predict":
+            return self._predict(payload)
+        if verb == "health":
+            return pack_frame(
+                {
+                    "ok": True,
+                    "status": (
+                        "draining" if self._stopping.is_set() else "serving"
+                    ),
+                    "protocol": _PROTOCOL,
+                }
+            )
+        if verb == "stats":
+            return pack_frame({"ok": True, "stats": self.engine.stats()})
+        if verb == "stop":
+            # reply first, then drain on a side thread so the client
+            # gets its ack before the listener goes away
+            threading.Thread(
+                target=self.shutdown, kwargs={"drain": True}, daemon=True
+            ).start()
+            return pack_frame({"ok": True, "stopping": True})
+        raise ValueError(f"unknown verb {verb!r}")
+
+    def _generate(self, header: dict, payload: bytes) -> bytes:
+        prompt = np.asarray(deserialize_params(payload))
+        deadline = None
+        if header.get("deadline_ms") is not None:
+            deadline = time.monotonic() + float(header["deadline_ms"]) / 1e3
+        seq = self.engine.generate(
+            prompt,
+            int(header["max_new_tokens"]),
+            eos_id=header.get("eos_id"),
+            deadline=deadline,
+        )
+        return pack_frame(
+            {"ok": True, "tokens": int(seq.size - prompt.size)},
+            serialize_params(np.asarray(seq)),
+        )
+
+    def _predict(self, payload: bytes) -> bytes:
+        x = np.asarray(deserialize_params(payload))
+        y = self.engine.predict(x)
+        return pack_frame({"ok": True}, serialize_params(np.asarray(y)))
+
+
+def serve(engine, host="127.0.0.1", port=0) -> ServingServer:
+    """Convenience: construct + start in one call."""
+    return ServingServer(engine, host=host, port=port).start()

@@ -2052,6 +2052,12 @@ class DistributedTrainer(Trainer):
                         return False  # give up; others keep training
 
         def run(w, part):
+            # ok must exist before the try: if attempt_partition itself
+            # raises (e.g. metrics_logger.log failing inside its except
+            # handler, or a BaseException), the adoption loop below
+            # would otherwise NameError in this worker thread and the
+            # partition would be lost without even being orphaned
+            ok = False
             try:
                 ok = attempt_partition(w, part)
                 if not ok and self.elastic:

@@ -348,6 +348,16 @@ def deserialize_serving_bundle(blob: bytes):
                 f"serving bundle shape mismatch at {path}: "
                 f"{np.shape(built)} vs {np.shape(got)}"
             )
+        elif np.asarray(built).dtype != np.asarray(got).dtype:
+            # shape alone would let a crafted bundle substitute e.g. a
+            # float64 or int array for an f32 bias/LN gain and serve it
+            # silently; non-quantized leaves must match the spec-built
+            # dtype exactly (the quantized branch pins its own dtypes)
+            raise ValueError(
+                f"serving bundle dtype mismatch at {path}: spec builds "
+                f"{np.asarray(built).dtype}, bundle holds "
+                f"{np.asarray(got).dtype}"
+            )
 
     check("params", model.params, loaded)
     model.params = loaded

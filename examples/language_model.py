@@ -194,15 +194,13 @@ def main():
         sp_steps = min(12, args.seq - 5)
         prompt = np.array([[3 % args.vocab]], np.int32)
         out_s = spec.generate(prompt, steps=sp_steps)
-        if args.int8:
-            # the ragged demo above served the QUANTIZED copy; the
-            # speculative target is the f32 model, so re-derive its
-            # greedy reference
-            plain = CachedSequenceGenerator(trained).generate(
-                prompt, steps=sp_steps
-            )[0]
-        else:
-            plain = outs[0]  # same model, prompt, and step count
+        # re-derive the greedy reference directly in BOTH modes: the
+        # ragged demo above may have served the quantized copy (--int8),
+        # and reading its outs[0] would couple this branch to the demo
+        # branch having run at all
+        plain = CachedSequenceGenerator(trained).generate(
+            prompt, steps=sp_steps
+        )[0]
         match = "EXACT" if (out_s[0] == plain).all() else "MISMATCH"
         print(f"speculative decode ({match} vs greedy): "
               f"{out_s[0].tolist()}; "

@@ -60,6 +60,21 @@ def test_diabetes_regression_cpu():
     assert "r2" in out.lower() or "R^2" in out, out
 
 
+def test_serve_lm_cpu():
+    """Export -> serve -> query: bundle on disk, engine booted from it,
+    concurrent TCP clients, graceful drain — the serving subsystem as a
+    user runs it."""
+    out = run_example("serve_lm.py", "--cpu")
+    assert "serving bundle:" in out
+    rows = [l for l in out.splitlines() if l.startswith("served decode:")]
+    assert len(rows) == 4, out
+    for line in rows:
+        toks = [int(t) for t in line.split("[", 1)[1].rstrip("]").split(",")]
+        for a, b in zip(toks[-5:], toks[-4:]):
+            assert b == (a + 1) % 32, (toks, out)  # still counting upward
+    assert "drained and stopped" in out
+
+
 def test_language_model_int8_bundle_cpu(tmp_path):
     """--int8 --save-bundle: the decode demo runs a RAGGED batch from a
     serving bundle RELOADED off disk — quantize, persist, reload, serve,

@@ -509,3 +509,15 @@ def test_serving_bundle_rejects_wrong_dtypes():
 
     with pytest.raises(ValueError, match="int8 internals"):
         deserialize_serving_bundle(resave(m8, float_q))
+
+    # NON-quantized leaves pin their dtype too (ADVICE r5): a crafted
+    # bundle substituting a float64 bias would otherwise load cleanly
+    # on a shape-only check — load-bearing now that the serving engine
+    # boots straight from bundles on disk
+    def widen_bias(p):
+        leaf = dict(p[first])
+        leaf["bias"] = np.asarray(leaf["bias"], np.float64)
+        p[first] = leaf
+
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        deserialize_serving_bundle(resave(m8, widen_bias))

@@ -1,0 +1,527 @@
+"""Online serving subsystem (distkeras_tpu/serving/).
+
+Three tiers, matching the subsystem's layering:
+
+- scheduler unit tests: pure host logic against a fake stepper — no
+  sockets, no JAX compiles — pinning admission order, slot eviction
+  and reuse, bounded-queue backpressure, deadlines, drain semantics;
+- stepper tests: the compiled slot-bank decode must equal
+  ``CachedSequenceGenerator``'s greedy decode token for token, for
+  every slot, regardless of batch composition churn;
+- end-to-end: engine + TCP server + client over localhost — generate
+  and predict round trips, ``overloaded`` replies under saturation,
+  deadline failures, and graceful drain completing in-flight work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    DeadlineExceededError,
+    EngineStoppedError,
+    OverloadedError,
+    ServeRequest,
+    WindowedBatcher,
+)
+
+# ------------------------------------------------------------ fake stepper
+
+
+class FakeStepper:
+    """Pure-Python stand-in for the device face: slot ``i`` emits
+    ``base + i*100 + n`` for its n-th token, so every scheduling
+    decision is visible in the token stream."""
+
+    def __init__(self, num_slots=2, max_len=32, base=1000):
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.base = base
+        self.admitted = []  # (slot, prompt list) in admission order
+        self.released = []
+        self._n = np.zeros(num_slots, int)
+
+    def admit(self, slot, prompt):
+        self.admitted.append((slot, list(np.asarray(prompt))))
+        self._n[slot] = 0
+
+    def release(self, slot):
+        self.released.append(slot)
+
+    def step(self, active):
+        toks = np.full(self.num_slots, -1)
+        for i in np.flatnonzero(active):
+            self._n[i] += 1
+            toks[i] = self.base + i * 100 + self._n[i]
+        return toks
+
+
+def _req(plen=3, max_new=4, **kw):
+    return ServeRequest(np.arange(1, plen + 1), max_new, **kw)
+
+
+# ------------------------------------------------------- scheduler units
+
+
+def test_admission_fifo_and_slot_fill():
+    st = FakeStepper(num_slots=2)
+    b = ContinuousBatcher(st, queue_capacity=8)
+    reqs = [b.submit(_req(max_new=2)) for _ in range(3)]
+    b.step()
+    # first two requests took the two slots, in submission order
+    assert [s for s, _ in st.admitted] == [0, 1]
+    assert st.admitted[0][1] == list(reqs[0].prompt)
+    assert st.admitted[1][1] == list(reqs[1].prompt)
+    b.step()
+    assert reqs[0].done and reqs[1].done and not reqs[2].done
+    assert reqs[0].result().tolist() == [1, 2, 3, 1001, 1002]
+    assert reqs[1].result().tolist() == [1, 2, 3, 1101, 1102]
+    # the freed slots pick up the queued request
+    b.step()
+    b.step()
+    assert reqs[2].result().tolist() == [1, 2, 3, 1001, 1002]
+    assert st.released == [0, 1, 0]
+    s = b.stats()
+    assert s["completed"] == 3 and s["queue_depth"] == 0
+    assert s["mean_batch_occupancy"] == pytest.approx(6 / 4)
+
+
+def test_eos_evicts_early():
+    class EosStepper(FakeStepper):
+        def step(self, active):
+            toks = super().step(active)
+            return np.where(toks >= 0, [7, 9], toks)  # slot0 -> 7 always
+
+    st = EosStepper(num_slots=2)
+    b = ContinuousBatcher(st)
+    r0 = b.submit(_req(max_new=10, eos_id=7))
+    r1 = b.submit(_req(max_new=3, eos_id=99))
+    b.step()
+    assert r0.done and not r1.done  # slot0 hit eos on its first token
+    assert r0.result().tolist() == [1, 2, 3, 7]
+    b.step()
+    b.step()
+    assert r1.result().tolist() == [1, 2, 3, 9, 9, 9]  # max_new wins
+
+
+def test_overloaded_rejects_at_bounded_queue():
+    st = FakeStepper(num_slots=1)
+    b = ContinuousBatcher(st, queue_capacity=2)
+    b.submit(_req())
+    b.submit(_req())
+    with pytest.raises(OverloadedError):
+        b.submit(_req())
+    assert b.stats()["rejected_overloaded"] == 1
+    # capacity violations are a ValueError, not backpressure
+    with pytest.raises(ValueError, match="exceeds the serving capacity"):
+        b.submit(_req(plen=30, max_new=30))
+
+
+def test_deadline_expired_in_queue():
+    st = FakeStepper(num_slots=1)
+    b = ContinuousBatcher(st)
+    dead = b.submit(_req(deadline=time.monotonic() - 0.001))
+    live = b.submit(_req(max_new=1))
+    b.step()
+    assert dead.done
+    with pytest.raises(DeadlineExceededError):
+        dead.result()
+    assert live.result().tolist() == [1, 2, 3, 1001]
+    assert st.admitted[0][1] == list(live.prompt)  # dead never admitted
+
+
+def test_deadline_expires_mid_decode():
+    st = FakeStepper(num_slots=1)
+    b = ContinuousBatcher(st)
+    r = b.submit(_req(max_new=20, deadline=time.monotonic() + 0.05))
+    b.step()
+    assert not r.done  # produced a token within budget
+    time.sleep(0.08)
+    b.step()
+    assert r.done
+    with pytest.raises(DeadlineExceededError):
+        r.result()
+    assert len(r.tokens) == 2  # partial progress recorded
+    assert st.released == [0]  # slot freed for the next request
+
+
+def test_drain_finishes_in_flight_and_refuses_new():
+    st = FakeStepper(num_slots=1)
+    b = ContinuousBatcher(st)
+    r0 = b.submit(_req(max_new=3))
+    r1 = b.submit(_req(max_new=2))  # still queued when drain starts
+    b.step()
+    b.drain()
+    with pytest.raises(EngineStoppedError):
+        b.submit(_req())
+    while not b.idle:
+        assert b.step() or not b.idle
+    assert r0.result().tolist() == [1, 2, 3, 1001, 1002, 1003]
+    assert r1.result().tolist() == [1, 2, 3, 1001, 1002]
+
+
+def test_hard_stop_fails_everything():
+    st = FakeStepper(num_slots=1)
+    b = ContinuousBatcher(st)
+    r0 = b.submit(_req(max_new=5))
+    r1 = b.submit(_req(max_new=5))
+    b.step()
+    b.stop()
+    for r in (r0, r1):
+        with pytest.raises(EngineStoppedError):
+            r.result()
+    assert b.idle and st.released == [0]
+
+
+def test_windowed_batcher_never_fit_is_value_error():
+    """A predict request larger than the queue can EVER hold is a
+    caller error, not transient backpressure — OverloadedError would
+    send a well-behaved client into an unwinnable retry loop."""
+    wb = WindowedBatcher(lambda x: x, max_batch=4, queue_capacity=8)
+    with pytest.raises(ValueError, match="exceeds the queue capacity"):
+        wb.submit(np.zeros((9, 2)))
+
+
+def test_windowed_batcher_coalesces_one_window():
+    calls = []
+
+    def run_batch(x):
+        calls.append(len(x))
+        return x * 2
+
+    wb = WindowedBatcher(run_batch, max_batch=16, max_wait=0.1).start()
+    try:
+        tickets = [wb.submit(np.full((2, 3), i)) for i in range(3)]
+        outs = [t.result(timeout=5) for t in tickets]
+        assert calls == [6]  # one window scored all three items
+        for i, y in enumerate(outs):
+            np.testing.assert_array_equal(y, np.full((2, 3), i * 2))
+    finally:
+        wb.close()
+
+
+# --------------------------------------------------- stepper vs generator
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_ref(lm):
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+
+    return CachedSequenceGenerator(lm)
+
+
+def test_stepper_matches_cached_generator_with_churn(lm, lm_ref):
+    """Slots admitted at different times, with different prompt lengths,
+    evicted and reused — every slot's greedy stream must equal its solo
+    ``CachedSequenceGenerator`` decode (composition independence is THE
+    correctness property of continuous batching)."""
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    st = DecodeStepper(lm, num_slots=3)
+    rng = np.random.default_rng(0)
+    p = [rng.integers(0, 61, n).astype(np.int32) for n in (5, 1, 9, 3)]
+    steps = [8, 8, 6, 5]
+    ref = [lm_ref.generate(pi[None], steps=s)[0] for pi, s in zip(p, steps)]
+
+    serving = {}  # slot -> request index
+    outs = [[] for _ in p]
+    admit_at = {2: 1, 4: 2}  # step index -> request index (staggered)
+    st.admit(0, p[0])
+    serving[0] = 0
+    next_req = 3
+    for i in range(40):
+        ri = admit_at.get(i)
+        if ri is not None:
+            st.admit(ri, p[ri])  # slots 1 and 2, first occupants
+            serving[ri] = ri
+        if not serving:
+            break
+        active = np.zeros(3, bool)
+        active[list(serving)] = True
+        toks = st.step(active)
+        for slot, ri in list(serving.items()):
+            outs[ri].append(int(toks[slot]))
+            if len(outs[ri]) == steps[ri]:
+                del serving[slot]
+                st.release(slot)
+                if next_req < len(p):  # reuse the freed slot
+                    st.admit(slot, p[next_req])
+                    serving[slot] = next_req
+                    next_req += 1
+    for ri in range(len(p)):
+        assert outs[ri] == ref[ri][len(p[ri]):].tolist(), f"request {ri}"
+
+
+def test_stepper_prefill_buckets_are_logarithmic(lm):
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    st = DecodeStepper(lm, num_slots=2)
+    rng = np.random.default_rng(1)
+    for plen in (1, 2, 3, 4, 5, 6, 7, 9, 12, 17):
+        st.admit(0, rng.integers(0, 61, plen).astype(np.int32))
+    # 10 distinct prompt lengths compile only the pow2 buckets
+    assert sorted(st._admit_fns) == [0, 1, 2, 4, 8, 16]
+
+
+# ------------------------------------------------------------- end to end
+
+
+@pytest.fixture()
+def served(lm):
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(lm, num_slots=4, queue_capacity=16)
+    srv = ServingServer(eng).start()
+    yield srv
+    srv.shutdown()
+
+
+def _client(srv):
+    from distkeras_tpu.serving import ServingClient
+
+    return ServingClient("127.0.0.1", srv.port)
+
+
+def test_server_generate_predict_stats_roundtrip(lm, lm_ref, served):
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 61, n).astype(np.int32)
+               for n in (1, 4, 6, 2, 7)]
+    refs = [lm_ref.generate(pi[None], steps=6)[0] for pi in prompts]
+    results = [None] * len(prompts)
+
+    def worker(i):
+        with _client(served) as c:
+            results[i] = c.generate(prompts[i], 6)
+
+    ths = [threading.Thread(target=worker, args=(i,))
+           for i in range(len(prompts))]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=120)
+    for i, (got, want) in enumerate(zip(results, refs)):
+        np.testing.assert_array_equal(got, want, err_msg=f"request {i}")
+
+    with _client(served) as c:
+        assert c.health()["status"] == "serving"
+        x = np.stack([np.resize(p, 32) for p in prompts]).astype(np.int32)
+        np.testing.assert_allclose(
+            c.predict(x), lm.predict(x), atol=1e-5
+        )
+        st = c.stats()
+        assert st["completed"] == len(prompts)
+        assert st["generate_enabled"] and st["num_slots"] == 4
+        assert st["mean_batch_occupancy"] >= 1.0
+
+
+def test_server_generate_eos_trims(lm, lm_ref, served):
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 61, 4).astype(np.int32)
+    ref = lm_ref.generate(prompt[None], steps=8, eos_id=40)[0]
+    with _client(served) as c:
+        got = c.generate(prompt, 8, eos_id=40)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_server_replies_overloaded_under_saturation(lm, lm_ref):
+    """Acceptance: with one slot and a one-deep queue, a burst of
+    concurrent requests gets explicit ``overloaded`` replies for the
+    overflow while the admitted ones complete correctly."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(lm, num_slots=1, queue_capacity=1)
+    srv = ServingServer(eng).start()
+    try:
+        prompt = np.arange(1, 4, dtype=np.int32)
+        ref = lm_ref.generate(prompt[None], steps=12)[0]
+        n = 6
+        barrier = threading.Barrier(n)
+        outcomes = [None] * n
+
+        def worker(i):
+            with _client(srv) as c:
+                barrier.wait()
+                try:
+                    outcomes[i] = c.generate(prompt, 12)
+                except OverloadedError:
+                    outcomes[i] = "overloaded"
+
+        ths = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        rejected = [o for o in outcomes if isinstance(o, str)]
+        completed = [o for o in outcomes if isinstance(o, np.ndarray)]
+        assert rejected, "queue saturation produced no overloaded reply"
+        assert completed, "no request completed under saturation"
+        for got in completed:
+            np.testing.assert_array_equal(got, ref)
+        assert eng.stats()["rejected_overloaded"] == len(rejected)
+    finally:
+        srv.shutdown()
+
+
+def test_server_refuses_oversized_frames(lm):
+    """The serving port takes bytes from untrusted peers: a declared
+    frame length past the cap is refused BEFORE buffering, with a typed
+    reply, and the connection closes (the stream is unrecoverable)."""
+    import socket
+    import struct
+
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+    from distkeras_tpu.utils.serialization import unpack_frame
+
+    eng = ServingEngine(lm, num_slots=1)
+    srv = ServingServer(eng, max_frame_bytes=1 << 16).start()
+    try:
+        with socket.create_connection(("127.0.0.1", srv.port)) as s:
+            s.sendall(struct.pack(">Q", 1 << 40) + b"xx")
+            ln = struct.unpack(">Q", s.recv(8))[0]
+            body = b""
+            while len(body) < ln:
+                chunk = s.recv(ln - len(body))
+                assert chunk
+                body += chunk
+            header, _ = unpack_frame(body)
+            assert header["error"] == "frame_too_large"
+            # server closed the stream: clean EOF, or RST when our
+            # unread junk bytes were still in its receive buffer
+            try:
+                assert s.recv(1) == b""
+            except ConnectionResetError:
+                pass
+    finally:
+        srv.shutdown()
+
+
+def test_shutdown_not_stalled_by_idle_connection(lm):
+    """An idle persistent connection (blocked in its next recv) must not
+    stall shutdown for the full join timeout or leak its thread — the
+    server force-closes lingering sockets after the drain grace."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(lm, num_slots=1)
+    srv = ServingServer(eng).start()
+    idle = _client(srv)  # holds a connection, sends nothing
+    try:
+        t0 = time.monotonic()
+        srv.shutdown()
+        assert time.monotonic() - t0 < 15
+        assert not any(t.is_alive() for t in srv._conn_threads)
+    finally:
+        idle.close()
+
+
+def test_server_deadline_exceeded(served):
+    with _client(served) as c:
+        with pytest.raises(DeadlineExceededError):
+            c.generate(np.arange(1, 4, dtype=np.int32), 8, deadline_ms=0)
+
+
+def test_graceful_shutdown_completes_in_flight(lm, lm_ref):
+    """Acceptance: the ``stop`` verb drains — requests admitted or
+    queued before the stop complete with correct results; requests
+    after it are refused."""
+    from distkeras_tpu.serving import ServingEngine, ServingError, ServingServer
+
+    eng = ServingEngine(lm, num_slots=2, queue_capacity=16)
+    srv = ServingServer(eng).start()
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, 61, n).astype(np.int32) for n in (2, 5, 3)]
+    refs = [lm_ref.generate(pi[None], steps=10)[0] for pi in prompts]
+    results = [None] * len(prompts)
+
+    def worker(i):
+        with _client(srv) as c:
+            results[i] = c.generate(prompts[i], 10)
+
+    ths = [threading.Thread(target=worker, args=(i,))
+           for i in range(len(prompts))]
+    for t in ths:
+        t.start()
+    # wait until the burst is actually in flight server-side
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = eng.stats()
+        if st["active_slots"] + st["queue_depth"] >= len(prompts):
+            break
+        time.sleep(0.005)
+    with _client(srv) as c:
+        assert c.stop()["stopping"]
+    for t in ths:
+        t.join(timeout=120)
+    for i, (got, want) in enumerate(zip(results, refs)):
+        np.testing.assert_array_equal(got, want, err_msg=f"request {i}")
+    # the drained engine refuses new work
+    with pytest.raises(ServingError):
+        eng.generate(prompts[0], 4)
+    srv.shutdown()
+
+
+def test_engine_from_bundle_and_non_lm_predict_only(tmp_path):
+    """Booting from a quantized serving bundle serves the quantized
+    numbers; a non-LM model still serves predict but names the decode
+    problem on generate."""
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.ops.quantization import quantize_model
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+    from distkeras_tpu.serving import ServingEngine, ServingError
+    from distkeras_tpu.utils.serialization import save_serving_bundle
+
+    lm_q = quantize_model(
+        zoo.transformer_lm(
+            vocab_size=61, seq_len=32, d_model=32, num_heads=2,
+            depth=2, seed=0,
+        )
+    )
+    path = str(tmp_path / "lm.dkt")
+    save_serving_bundle(path, lm_q)
+    metrics = str(tmp_path / "serving_metrics.jsonl")
+    eng = ServingEngine.from_bundle(
+        path, num_slots=2, metrics_path=metrics
+    ).start()
+    try:
+        prompt = np.arange(1, 6, dtype=np.int32)
+        ref = CachedSequenceGenerator(lm_q).generate(prompt[None], 6)[0]
+        np.testing.assert_array_equal(eng.generate(prompt, 6), ref)
+    finally:
+        eng.stop()
+    from distkeras_tpu.utils.profiling import read_metrics
+
+    events = [m["event"] for m in read_metrics(metrics)]
+    assert "serving_submit" in events and "serving_complete" in events
+    done = next(m for m in read_metrics(metrics)
+                if m["event"] == "serving_complete")
+    assert done["tokens"] == 6 and done["error"] is None
+    assert done["total"] >= done["queue_wait"] >= 0
+
+    mlp = zoo.mnist_mlp(hidden=16, seed=0)
+    eng = ServingEngine(mlp).start()
+    try:
+        x = np.random.default_rng(0).standard_normal((3, 784)).astype(
+            np.float32
+        )
+        np.testing.assert_allclose(
+            eng.predict(x), mlp.predict(x), atol=1e-6
+        )
+        with pytest.raises(ServingError, match="does not support generate"):
+            eng.generate(np.arange(3), 4)
+    finally:
+        eng.stop()
