@@ -1,29 +1,46 @@
-"""Online-serving benchmark: continuous batching vs serve-one-at-a-time.
+"""Online-serving benchmark: chunked prefill + prefix cache vs PR 1.
 
-The serving subsystem's claim is iteration-level scheduling (Orca-style
-continuous batching): with a bank of decode slots, finished sequences
-are evicted and queued requests admitted EVERY step, so a concurrent
-stream of mixed-length requests keeps the compiled step full instead of
-decoding sequentially. This harness drives the SAME ``ServingEngine``
-machinery both ways — ``--slots`` slot-bank vs a 1-slot engine (which
-degenerates to serve-one-request-at-a-time through identical scheduler,
-stepper, and dispatch code) — over an identical concurrent mixed-length
-request set, and reports the throughput ratio. Decode outputs are
-position-independent (each slot pins its solo greedy decode), so both
-sides produce identical tokens; the ratio measures scheduling alone.
+Two serving optimizations ride the continuous batcher, and each gets an
+honest A/B over IDENTICAL request streams through identical scheduler/
+stepper/dispatch code:
 
-Writes BENCH_SERVING.json and prints one JSON line:
-    {"metric": "serving_tokens_per_sec", "value": ...,
-     "continuous": ..., "serial": ..., "speedup": ...}
+- **Chunked prefill** (Sarathi-style): the PR 1 scheduler ran a new
+  prompt's FULL prefill synchronously inside the scheduler iteration,
+  so one long prompt stalled every decoding slot; the chunked scheduler
+  spends at most ``prefill_chunk`` prompt tokens per iteration between
+  decode steps. Measured by time-to-first-token and p99 end-to-end
+  latency under mixed long-prompt traffic.
+- **Shared-prefix KV reuse**: identical prompt prefixes (system
+  prompts, few-shot headers) recompute K/V per request on PR 1; the
+  prefix store serves them from cache (two-touch admission: one-shot
+  novel prompts never earn a device fetch). Honesty protocol: warmup
+  runs the timed set (so every compiled bucket is warm on both sides),
+  then before EVERY timed pass the store is CLEARED and re-seeded with
+  header-only requests — timed-run hits come from the shared header,
+  the claimed effect, never from replaying warmed full prompts.
 
-Usage: python bench_serving.py [--cpu] [--slots 8] [--requests 24]
+Measurement discipline for the 1-core sandbox: baseline and optimized
+timed passes are INTERLEAVED (minutes-scale machine-speed drift hits
+both sides equally), repeated ``--repeats`` times, and aggregated as
+median-of-repeats percentiles with the across-repeat p99 spread kept
+in the artifact.
+
+Correctness rides along: every request's greedy output is asserted
+identical between the two configs, across repeats, AND to its solo
+``CachedSequenceGenerator`` decode (cache-hit, chunked, and combined
+admission paths all pinned). The PR 1 continuous-vs-serial ratio is
+kept for continuity.
+
+Writes BENCH_SERVING.json and prints one JSON line.
+
+Usage: python bench_serving.py [--cpu] [--smoke] [--slots 8]
+                               [--requests 24] [--chunk N]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import threading
 import time
 
 import numpy as np
@@ -31,69 +48,300 @@ import numpy as np
 from bench import setup_backend
 
 
-def _make_requests(n, seq, vocab, rng):
-    """Mixed-length serving traffic: prompts 1..seq/4 tokens, decode
-    budgets seq/8..seq/2 — the ragged mix continuous batching exists
-    for (uniform requests would let static batching tie)."""
+def _make_mixed_long(n, seq, vocab, rng):
+    """Mixed LONG-prompt traffic: prompts 1..3*seq/4 tokens (the PR 1
+    mix capped at seq/4 — too short to ever show prefill stalls),
+    decode budgets seq/8..seq/4."""
     reqs = []
     for _ in range(n):
-        plen = int(rng.integers(1, max(2, seq // 4)))
-        steps = int(rng.integers(max(2, seq // 8), seq // 2))
-        steps = min(steps, seq - plen)
+        plen = int(rng.integers(1, max(2, 3 * seq // 4)))
+        steps = int(rng.integers(max(2, seq // 8), max(3, seq // 4)))
+        steps = max(1, min(steps, seq - plen))
         prompt = rng.integers(0, vocab, plen).astype(np.int32)
         reqs.append((prompt, steps))
     return reqs
 
 
-def _drive(engine, reqs, timeout=600.0):
-    """Submit every request concurrently (one thread per request, like
-    independent clients), wait for all, return (wall_seconds,
-    tokens_generated, results)."""
-    results = [None] * len(reqs)
+def _make_prefix_heavy(n, seq, vocab, rng, header):
+    """Prefix-heavy traffic: every prompt = the shared ``header`` plus
+    a fresh 1..4-token suffix (the system-prompt / few-shot shape the
+    prefix store exists for); decode budgets seq/8..seq/4."""
+    reqs = []
+    for _ in range(n):
+        sfx = rng.integers(0, vocab, int(rng.integers(1, 5)))
+        prompt = np.concatenate([header, sfx]).astype(np.int32)
+        steps = int(rng.integers(max(2, seq // 8), max(3, seq // 4)))
+        steps = max(1, min(steps, seq - prompt.size))
+        reqs.append((prompt, steps))
+    return reqs
 
-    def worker(i):
-        prompt, steps = reqs[i]
-        results[i] = engine.generate(prompt, steps, timeout=timeout)
 
-    threads = [
-        threading.Thread(target=worker, args=(i,))
-        for i in range(len(reqs))
-    ]
+def _make_production_mix(n, seq, vocab, rng, headers):
+    """The adjudicating workload: 2/3 of requests extend one of the
+    shared headers with a fresh mixed-length suffix (real serving
+    traffic shares system prompts), 1/3 are entirely novel long-ish
+    prompts (they pay the store's insert cost and never hit)."""
+    reqs = []
+    for i in range(n):
+        if i % 3 < 2:
+            h = headers[i % len(headers)]
+            sfx = rng.integers(
+                0, vocab, int(rng.integers(1, max(2, seq // 8)))
+            )
+            prompt = np.concatenate([h, sfx]).astype(np.int32)
+        else:
+            plen = int(rng.integers(1, max(2, 3 * seq // 4)))
+            prompt = rng.integers(0, vocab, plen).astype(np.int32)
+        steps = int(rng.integers(max(2, seq // 8), max(3, seq // 4)))
+        steps = max(1, min(steps, seq - prompt.size))
+        reqs.append((prompt, steps))
+    return reqs
+
+
+def _drive(engine, reqs, timeout=600.0, arrivals=None):
+    """Submit ``reqs`` on the ``arrivals`` schedule (absolute offsets in
+    seconds from the drive start; None = all at once), wait for all;
+    returns (wall_seconds, tokens, results, latencies). Staggered
+    arrivals are the traffic shape chunked prefill exists for — a long
+    prompt landing WHILE other slots decode; an all-at-once burst has
+    no in-flight decodes to protect."""
     t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout)
+    handles = []
+    for i, (p, s) in enumerate(reqs):
+        if arrivals is not None:
+            wait = t0 + arrivals[i] - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+        handles.append(engine.submit(p, s))
+    results = [h.result(timeout) for h in handles]
     dt = time.perf_counter() - t0
-    toks = sum(steps for _, steps in reqs)
-    return dt, toks, results
+    toks = sum(s for _, s in reqs)
+    return dt, toks, results, [h.latency() for h in handles]
+
+
+def _pct(per_repeat):
+    """Robust latency aggregate over repeats: per-repeat percentiles,
+    MEDIAN across repeats (one OS-scheduling hiccup must not own the
+    reported tail), with the honest across-repeat p99 spread kept."""
+    reps = [np.asarray(r, float) for r in per_repeat]
+    p50s = [float(np.percentile(r, 50)) for r in reps]
+    p99s = [float(np.percentile(r, 99)) for r in reps]
+    return {
+        "mean": round(float(np.mean([r.mean() for r in reps])), 2),
+        "p50": round(float(np.median(p50s)), 2),
+        "p99": round(float(np.median(p99s)), 2),
+        "p99_spread": [round(min(p99s), 2), round(max(p99s), 2)],
+    }
+
+
+def _engine(model, reqs, *, slots, prefill_chunk, prefix_cache):
+    from distkeras_tpu.serving import ServingEngine
+
+    return ServingEngine(
+        model, num_slots=slots, queue_capacity=2 * len(reqs) + 8,
+        prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+    ).start()
+
+
+def _reset(eng, prime):
+    """Identical start state for every timed pass: prefix store CLEARED
+    (timed-run hits must come from genuinely shared structure, never
+    from replaying warmed or previous-pass prompts) and re-seeded with
+    the ``prime`` requests (e.g. one request carrying the workload's
+    shared header — driven twice, because two-touch admission only
+    stores a prefix on its second miss); scheduler counters zeroed."""
+    if eng.prefix_store is not None:
+        eng.prefix_store.clear()
+        if prime:
+            _drive(eng, prime)
+            _drive(eng, prime)
+        eng.prefix_store.reset_counters()
+    for k in eng.batcher.counters:
+        eng.batcher.counters[k] = 0
+
+
+def _timed_pass(eng, reqs, arrivals, results):
+    d, t, res, lat = _drive(eng, reqs, arrivals=arrivals)
+    if results and results[-1] is not None:
+        for a, b in zip(results[-1], res):  # greedy must not drift
+            assert np.array_equal(a, b), "repeat output drift"
+    results.append(res)
+    return d, t, lat, eng.stats()  # per-pass counter snapshot
+
+
+def _side(runs, prefix_cache):
+    """Aggregate one engine config's repeats. Counters are reset before
+    every timed pass and snapshotted after it, then SUMMED here, so
+    every field in the record covers the same all-repeats window as
+    wall_seconds and per_request (no last-pass-only numbers next to
+    pooled aggregates)."""
+    per_request = [
+        {
+            "ttft_ms": round(lat["ttft"] * 1e3, 2),
+            "total_ms": round(lat["total"] * 1e3, 2),
+            "queue_ms": round(lat["queue_wait"] * 1e3, 2),
+            "prefill_ms": round(lat["prefill"] * 1e3, 2),
+            "decode_ms": round(lat["decode"] * 1e3, 2),
+        }
+        for _, _, lats, _ in runs
+        for lat in lats
+    ]
+    tps = [t / d for d, t, _, _ in runs]
+    snaps = [s for _, _, _, s in runs]
+    stats = dict(snaps[-1])
+    for key in ("steps", "occupancy_sum", "prefill_chunks",
+                "prefill_tokens", "tokens_generated", "completed"):
+        stats[key] = sum(s[key] for s in snaps)
+    stats["mean_batch_occupancy"] = (
+        stats["occupancy_sum"] / stats["steps"] if stats["steps"] else 0.0
+    )
+    if prefix_cache:
+        pc = dict(snaps[-1]["prefix_cache"])  # entries/bytes: last pass
+        for key in ("hits", "misses", "hit_tokens", "inserts",
+                    "evictions"):
+            pc[key] = sum(s["prefix_cache"][key] for s in snaps)
+        stats["prefix_cache"] = pc
+    side = {
+        "prefill_chunk": stats["prefill_chunk"],
+        "prefix_cache_enabled": prefix_cache,
+        "tokens_per_sec": round(float(np.median(tps)), 1),
+        "tokens_per_sec_spread": [
+            round(min(tps), 1), round(max(tps), 1)
+        ],
+        "wall_seconds": round(sum(d for d, _, _, _ in runs), 3),
+        "ttft_ms": _pct(
+            [[lat["ttft"] * 1e3 for lat in lats]
+             for _, _, lats, _ in runs]
+        ),
+        "latency_ms": _pct(
+            [[lat["total"] * 1e3 for lat in lats]
+             for _, _, lats, _ in runs]
+        ),
+        "scheduler_steps": stats["steps"],
+        "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 2),
+        "prefill_chunks": stats["prefill_chunks"],
+        "per_request": per_request,
+    }
+    if prefix_cache:
+        side["prefix_cache"] = {
+            k: stats["prefix_cache"][k]
+            for k in ("hits", "misses", "hit_tokens", "entries",
+                      "evictions", "bytes")
+        }
+    return side
+
+
+def _measure_ab(model, reqs, *, slots, chunk, prime=None, arrivals=None,
+                repeats=1):
+    """The A/B proper: baseline (PR 1 config) and chunked+cached engines
+    measured with INTERLEAVED timed passes — baseline, optimized,
+    baseline, optimized, ... — so the sandbox's minutes-scale speed
+    drift hits both sides equally instead of whichever side ran last
+    (the same alternate-the-measurements discipline as the tunnel-
+    instability playbook in PERF.md). Two warm passes per engine on the
+    SAME arrival schedule as the timed runs first: warm pass one
+    compiles the miss-path programs while populating the store, pass
+    two the hit-path restore/suffix-chunk programs; matching the
+    schedule matches the budget-split chunk shapes, so no timed pass
+    ever pays a one-off compile."""
+    base = _engine(model, reqs, slots=slots, prefill_chunk=None,
+                   prefix_cache=False)
+    opt = _engine(model, reqs, slots=slots, prefill_chunk=chunk,
+                  prefix_cache=True)
+    try:
+        for eng in (base, opt):
+            _drive(eng, reqs, arrivals=arrivals)
+            _drive(eng, reqs, arrivals=arrivals)
+        base_runs, opt_runs = [], []
+        base_out, opt_out = [], []
+        for _ in range(repeats):
+            _reset(base, None)
+            base_runs.append(_timed_pass(base, reqs, arrivals, base_out))
+            _reset(opt, prime)
+            opt_runs.append(_timed_pass(opt, reqs, arrivals, opt_out))
+    finally:
+        base.stop()
+        opt.stop()
+    return (
+        _side(base_runs, False),
+        _side(opt_runs, True),
+        base_out[-1],
+        opt_out[-1],
+    )
+
+
+def _measure_serial(model, reqs, *, arrivals=None, repeats=1):
+    """1 slot + PR 1 config = serve-one-at-a-time through identical
+    code (the PR 1 continuity ratio)."""
+    eng = _engine(model, reqs, slots=1, prefill_chunk=None,
+                  prefix_cache=False)
+    try:
+        _drive(eng, reqs, arrivals=arrivals)
+        runs, outs = [], []
+        for _ in range(repeats):
+            _reset(eng, None)
+            runs.append(_timed_pass(eng, reqs, arrivals, outs))
+    finally:
+        eng.stop()
+    return _side(runs, False)
+
+
+def _ratio(a, b):
+    return round(a / max(b, 1e-9), 2)
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for the CI harness test")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="prefill token budget per scheduler iteration "
+                         "(default seq/4)")
+    ap.add_argument("--gap-ms", type=float, default=None,
+                    help="mean request inter-arrival gap (exponential; "
+                         "default per tier)")
+    ap.add_argument("--repeats", type=int, default=5,
+                    help="timed passes per side, per-request samples "
+                         "pooled (1-core scheduling noise); --smoke "
+                         "forces 1")
     args = ap.parse_args()
 
-    platform = setup_backend(cpu=args.cpu)
+    platform = setup_backend(cpu=args.cpu or args.smoke)
 
     import jax
 
     from distkeras_tpu.models.zoo import transformer_lm
-    from distkeras_tpu.serving import ServingEngine
+    from distkeras_tpu.predictors import CachedSequenceGenerator
     from distkeras_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache(platform=platform)
-    on_cpu = platform == "cpu"
     # CPU tier shrinks vocab/width until the per-step cost is dispatch-
     # bound rather than FLOP-bound — the regime a real chip's decode
     # step lives in (memory-bound: a batch-8 step costs ~a batch-1
-    # step), so the CPU ratio measures SCHEDULING, not a 1-core MXU
-    # stand-in grinding 8x the matmul FLOPs per step
-    seq, d_model, depth, heads, vocab = (
-        (64, 64, 2, 4, 512) if on_cpu else (512, 512, 8, 8, 8192)
-    )
+    # step), so the CPU deltas measure SCHEDULING, not a 1-core MXU
+    # stand-in grinding the matmul FLOPs
+    # the CPU tier needs seq long enough that a full prefill costs
+    # MULTIPLE decode-step times — that cost is the stall chunked
+    # prefill exists to bound; at short seq a prefill is one cheap
+    # dispatch and the A/B would measure pure chunking overhead
+    if args.smoke:
+        seq, d_model, depth, heads, vocab = 32, 16, 1, 2, 61
+        args.slots = min(args.slots, 2)
+        args.requests = min(args.requests, 6)
+        args.repeats = 1
+        gap_ms = 1.0
+    elif platform == "cpu":
+        seq, d_model, depth, heads, vocab = 256, 64, 2, 4, 512
+        gap_ms = 3.0
+    else:
+        seq, d_model, depth, heads, vocab = 512, 512, 8, 8, 8192
+        gap_ms = 2.0
+    if args.gap_ms is not None:
+        gap_ms = args.gap_ms
+    chunk = args.chunk if args.chunk is not None else max(8, seq // 4)
     dev = jax.devices()[0]
     print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
 
@@ -101,59 +349,120 @@ def main() -> None:
         vocab_size=vocab, seq_len=seq, d_model=d_model, num_heads=heads,
         depth=depth, seed=0,
     )
+    ref_gen = CachedSequenceGenerator(model)
     rng = np.random.default_rng(0)
-    reqs = _make_requests(args.requests, seq, vocab, rng)
-
-    def measure(num_slots):
-        eng = ServingEngine(
-            model, num_slots=num_slots,
-            queue_capacity=max(64, 2 * len(reqs)),
-        ).start()
-        try:
-            _drive(eng, reqs)  # compile + warm every prefill bucket
-            for k in eng.batcher.counters:
-                eng.batcher.counters[k] = 0  # count the timed run only
-            dt, toks, results = _drive(eng, reqs)
-            stats = eng.stats()
-        finally:
-            eng.stop()
-        assert all(r is not None for r in results), "requests lost"
-        return toks / dt, stats, results
-
-    cont_tps, cont_stats, cont_out = measure(args.slots)
-    serial_tps, serial_stats, serial_out = measure(1)
-    # composition independence: both schedules produce identical tokens
-    for a, b in zip(cont_out, serial_out):
-        assert np.array_equal(a, b), "continuous != serial decode output"
+    header = rng.integers(0, vocab, seq // 2).astype(np.int32)
+    headers = [header, rng.integers(0, vocab, seq // 4).astype(np.int32)]
+    workloads = {
+        # (timed requests, prefix-store priming requests).
+        # production_mix is the adjudicating A/B; mixed_long isolates
+        # chunking + the store's cold-insert overhead (no request ever
+        # hits — the honesty row); prefix_heavy is the reuse ceiling.
+        # Priming seeds ONLY the shared headers (fresh suffixes), so
+        # timed hits come from shared structure, never replayed prompts.
+        "production_mix": (
+            _make_production_mix(args.requests, seq, vocab, rng, headers),
+            [_make_prefix_heavy(1, seq, vocab, rng, h)[0]
+             for h in headers],
+        ),
+        "mixed_long": (
+            _make_mixed_long(args.requests, seq, vocab, rng),
+            None,
+        ),
+        "prefix_heavy": (
+            _make_prefix_heavy(args.requests, seq, vocab, rng, header),
+            _make_prefix_heavy(1, seq, vocab, rng, header),
+        ),
+    }
 
     record = {
         "metric": "serving_tokens_per_sec",
-        "value": round(cont_tps, 1),
         "unit": "tokens/sec",
         "platform": platform,
         "device_kind": dev.device_kind,
         "model": f"transformer_lm d{d_model} L{depth} seq{seq}",
-        "num_requests": len(reqs),
-        "prompt_lens": [int(p.size) for p, _ in reqs],
-        "decode_steps": [int(s) for _, s in reqs],
-        "continuous": {
-            "slots": args.slots,
-            "tokens_per_sec": round(cont_tps, 1),
-            "scheduler_steps": cont_stats["steps"],
-            "mean_batch_occupancy": round(
-                cont_stats["mean_batch_occupancy"], 2
-            ),
-        },
-        "serial_one_at_a_time": {
-            "slots": 1,
-            "tokens_per_sec": round(serial_tps, 1),
-            "scheduler_steps": serial_stats["steps"],
-        },
-        "speedup_continuous_vs_serial": round(cont_tps / serial_tps, 2),
+        "slots": args.slots,
+        "prefill_chunk": chunk,
+        "workloads": {},
     }
+    record["arrival_gap_ms"] = gap_ms
+    record["repeats_per_side"] = args.repeats
+    arrival_sched = {}
+    for name, (timed, prime) in workloads.items():
+        # solo references via ONE ragged-generator call per workload
+        # (per-request rectangular calls would compile a scan per
+        # distinct prompt length): each greedy ragged row is pinned
+        # equal to its solo decode, so trimming the shared-steps run
+        # to each request's budget IS the solo reference
+        smax = max(s for _, s in timed)
+        ragged = ref_gen.generate([p for p, _ in timed], steps=smax)
+        refs = [
+            np.asarray(row)[: p.size + s]
+            for row, (p, s) in zip(list(ragged), timed)
+        ]
+        # one deterministic Poisson-ish arrival schedule per workload,
+        # identical for every side of the A/B
+        arrivals = arrival_sched[name] = np.cumsum(
+            rng.exponential(gap_ms / 1e3, len(timed))
+        )
+        base, opt, base_out, opt_out = _measure_ab(
+            model, timed, slots=args.slots, chunk=chunk, prime=prime,
+            arrivals=arrivals, repeats=args.repeats,
+        )
+        for i, (a, b, r) in enumerate(zip(base_out, opt_out, refs)):
+            assert np.array_equal(a, r), f"{name} req {i}: baseline != solo"
+            assert np.array_equal(b, r), f"{name} req {i}: chunked+cached != solo"
+        record["workloads"][name] = {
+            "num_requests": len(timed),
+            "prompt_lens": [int(p.size) for p, _ in timed],
+            "decode_steps": [int(s) for _, s in timed],
+            "baseline": base,
+            "chunked_cached": opt,
+            "ttft_p99_speedup": _ratio(
+                base["ttft_ms"]["p99"], opt["ttft_ms"]["p99"]
+            ),
+            "ttft_p50_speedup": _ratio(
+                base["ttft_ms"]["p50"], opt["ttft_ms"]["p50"]
+            ),
+            "latency_p99_speedup": _ratio(
+                base["latency_ms"]["p99"], opt["latency_ms"]["p99"]
+            ),
+            "tokens_per_sec_ratio": _ratio(
+                opt["tokens_per_sec"], base["tokens_per_sec"]
+            ),
+            "outputs_identical": True,
+        }
+        print(json.dumps({name: {
+            k: record["workloads"][name][k]
+            for k in ("ttft_p99_speedup", "latency_p99_speedup",
+                      "tokens_per_sec_ratio")
+        }}), flush=True)
+
+    # PR 1 continuity: continuous batching vs serve-one-at-a-time
+    # (1 slot degenerates to serial through identical code)
+    timed, _ = workloads["mixed_long"]
+    serial = _measure_serial(
+        model, timed, arrivals=arrival_sched["mixed_long"],
+        repeats=args.repeats,
+    )
+    cont = record["workloads"]["mixed_long"]["baseline"]
+    record["continuous_vs_serial"] = {
+        "continuous_tokens_per_sec": cont["tokens_per_sec"],
+        "serial_tokens_per_sec": serial["tokens_per_sec"],
+        "speedup": _ratio(
+            cont["tokens_per_sec"], serial["tokens_per_sec"]
+        ),
+    }
+    record["value"] = record["workloads"]["production_mix"][
+        "chunked_cached"
+    ]["tokens_per_sec"]
+
     with open("BENCH_SERVING.json", "w") as f:
         json.dump(record, f, indent=2)
-    print(json.dumps(record))
+    print(json.dumps({
+        "metric": record["metric"], "value": record["value"],
+        "continuous_vs_serial": record["continuous_vs_serial"]["speedup"],
+    }))
 
 
 if __name__ == "__main__":

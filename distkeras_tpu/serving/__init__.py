@@ -7,6 +7,11 @@ decode path (scheduler -> engine -> server, plus the client).
 - ``engine``: the device face — a slot-bank decode stepper compiled
   once over a static (num_slots, seq_len) shape, fed by the scheduler
   from a dedicated thread; loads serving bundles; logs metrics.
+  Admission is chunked (pow2-bucketed prefill chunks under a per-
+  iteration token budget) and prefix-aware (``prefix_cache``).
+- ``prefix_cache``: host-side shared-prefix KV store — exact-prefix
+  keyed, LRU-bounded by bytes — that lets admission skip recomputing
+  K/V for prompt prefixes other requests already prefilled.
 - ``server``/``client``: the length-prefixed TCP wire
   (``networking``) carrying pickle-free ``DKT1`` frames
   (``utils.serialization``), verbs generate/predict/health/stats/stop.
@@ -22,6 +27,7 @@ from distkeras_tpu.serving.scheduler import (
     WindowedBatcher,
 )
 from distkeras_tpu.serving.engine import DecodeStepper, ServingEngine
+from distkeras_tpu.serving.prefix_cache import PrefixStore
 from distkeras_tpu.serving.server import ServingServer, serve
 from distkeras_tpu.serving.client import ServingClient
 
@@ -31,6 +37,7 @@ __all__ = [
     "DecodeStepper",
     "EngineStoppedError",
     "OverloadedError",
+    "PrefixStore",
     "ServeRequest",
     "ServingClient",
     "ServingEngine",

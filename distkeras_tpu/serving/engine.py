@@ -3,12 +3,18 @@
 ``DecodeStepper`` turns ``CachedSequenceGenerator``'s one-shot compiled
 decode into an ITERATION-LEVEL program: a fixed (num_slots, seq_len)
 slot bank where every call to ``step`` advances each active slot by one
-token against persistent per-stage K/V caches, and ``admit`` prefills a
-single slot's prompt without disturbing its neighbours. The batch shape
-is static — XLA compiles the step once per sampling config and the
-prefill once per prompt-length bucket (powers of two, like the ragged
-generator's bucketed scan keys) — so continuous batching churns the
-logical batch composition at zero recompiles.
+token against persistent per-stage K/V caches, and admission prefills a
+single slot's prompt without disturbing its neighbours. Admission is
+INCREMENTAL: ``begin_admit`` writes the prompt row (and restores any
+``prefix_cache`` hit's K/V), then ``prefill_chunk`` advances the
+remaining prefix a bounded chunk at a time, so the scheduler can
+interleave prefill with decode steps (Sarathi-style chunked prefill)
+instead of stalling every active slot behind one long prompt. The
+batch shape is static — XLA compiles the step once per sampling config
+and the prefill once per prompt-length bucket plus once per
+chunk-length bucket (powers of two, like the ragged generator's
+bucketed scan keys) — so continuous batching churns the logical batch
+composition at zero recompiles.
 
 Per-slot positions are the one thing the generators' shared
 ``_stage_chunk`` body cannot express (its K/V write offset and query
@@ -54,18 +60,29 @@ class DecodeStepper:
 
     State per slot: one row of the (B, T) token buffer and one row of
     each stage's (B, T, H, Dh) K/V caches, plus a host-side length.
-    ``admit(slot, prompt)`` writes the prompt row and prefills K/V for
-    positions ``0..len-2`` (the step that follows consumes the last
-    prompt token, exactly like ``CachedSequenceGenerator``'s scan
-    start). ``step(active)`` embeds each slot's last token at its OWN
-    position, attends one row against the caches, and appends the
-    sampled/greedy token — inactive slots freeze (masked writes).
-    Greedy slot output is the cached generator's greedy decode, token
-    for token, regardless of what the neighbouring slots are doing.
+    Admission prefills K/V for positions ``0..len-2`` (the step that
+    follows consumes the last prompt token, exactly like
+    ``CachedSequenceGenerator``'s scan start) — either in one call
+    (``admit``) or incrementally (``begin_admit`` + ``prefill_chunk``,
+    optionally skipping a ``prefix_cache`` hit's positions entirely).
+    ``step(active)`` embeds each slot's last token at its OWN position,
+    attends one row against the caches, and appends the sampled/greedy
+    token — inactive slots freeze (masked writes). Greedy slot output
+    is the cached generator's greedy decode, token for token,
+    regardless of what the neighbouring slots are doing, and regardless
+    of whether its prefix came from the cache, chunked prefill, or
+    both — THE correctness bar of this subsystem.
     """
 
     def __init__(self, model, num_slots=8, temperature=0.0, seed=0,
-                 top_k=None, top_p=None, kv_dtype=None):
+                 top_k=None, top_p=None, kv_dtype=None,
+                 prefix_cache=None):
+        """``prefix_cache``: an optional ``prefix_cache.PrefixStore``.
+        When set, ``begin_admit`` restores the longest cached prefix's
+        K/V rows into the slot before any prefill compute, and every
+        finished prefill publishes its missing pow2 ladder rungs (an
+        exact-length repeat therefore re-prefills the sub-rung tail —
+        the stated reuse ceiling, not full-hit-on-repeat)."""
         import jax.numpy as jnp
 
         from distkeras_tpu.predictors import CachedSequenceGenerator
@@ -101,6 +118,15 @@ class DecodeStepper:
         self._step_idx = 0  # RNG schedule: one fold per global step
         self._step_fn = None
         self._admit_fns = {}  # prefill-length bucket -> compiled admit
+        self._chunk_fns = {}  # chunk-length bucket -> compiled chunk
+        self._copy_fn = None  # prefix restore (specializes per pb shape)
+        self._row_fn = None  # compiled ctx-row write (one program)
+        self._nh, self._hd = nh, hd
+        self.prefix_cache = prefix_cache
+        # in-progress admissions: slot -> pending prompt / next prefill
+        # position (host bookkeeping for the chunked lifecycle)
+        self._pending: dict[int, np.ndarray] = {}
+        self._prefill_pos: dict[int, int] = {}
 
     # -- param plumbing -----------------------------------------------------
 
@@ -133,17 +159,89 @@ class DecodeStepper:
     # -- admission ----------------------------------------------------------
 
     def admit(self, slot: int, prompt) -> None:
-        """Write ``prompt`` into ``slot`` and prefill its K/V rows. The
-        prefill length buckets to a power of two (garbage K/V computed
-        past the real prompt is overwritten by the decode steps before
-        any query can attend it), so a serving mix of naturally varying
-        prompt lengths costs O(log T) compiles, not O(T)."""
+        """One-shot admission: ``begin_admit`` plus prefill drained to
+        completion in a single call (the unlimited-budget degenerate of
+        the chunked lifecycle — what the PR 1 scheduler always did)."""
+        left = self.begin_admit(slot, prompt)
+        while left > 0:
+            left = self.prefill_chunk(slot, left)
+
+    def begin_admit(self, slot: int, prompt) -> int:
+        """Start admitting ``prompt`` into ``slot``: write its context
+        row, restore the longest ``prefix_cache`` hit's K/V rows, and
+        return the number of prefill positions STILL to compute (0 =
+        ready to decode). ``prefill_chunk`` advances the remainder —
+        the scheduler spreads it over iterations so a long prompt never
+        stalls the decoding slots beyond its per-iteration budget."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = prompt.size
         if not 1 <= plen <= self.max_len:
             raise ValueError(
                 f"prompt length {plen} outside [1, {self.max_len}]"
             )
+        row = np.zeros((1, self.max_len), np.int32)
+        row[0, :plen] = prompt
+        if self._row_fn is None:
+            import jax
+
+            self._row_fn = jax.jit(
+                lambda ctx, r, s: jax.lax.dynamic_update_slice(
+                    ctx, r, (s, 0)
+                ),
+                donate_argnums=(0,),
+            )
+        self._ctx = self._row_fn(self._ctx, row, np.int32(slot))
+        target = plen - 1  # prefill covers positions 0..plen-2
+        start = 0
+        if self.prefix_cache is not None and target >= 1:
+            hit = self.prefix_cache.lookup(prompt[:target])
+            if hit is not None:
+                start, kv = hit
+                self._restore_prefix(slot, kv)
+        self._pending[slot] = prompt
+        self._prefill_pos[slot] = start
+        self._lens[slot] = plen
+        if start >= target:
+            self._finish_admit(slot)
+            return 0
+        return target - start
+
+    def prefill_chunk(self, slot: int, budget: int) -> int:
+        """Prefill up to ``budget`` more positions of ``slot``'s pending
+        prompt; returns positions remaining (0 = ready to decode). A
+        chunk covering the WHOLE prefix from position 0 takes the
+        original bucketed full-prefill program; a mid-prompt chunk runs
+        the generators' ``_stage_chunk`` body against the slot's
+        existing cache rows. Chunk lengths bucket to powers of two —
+        garbage K/V computed past the chunk's real tokens sits at
+        positions >= the prefill frontier and is overwritten (by the
+        next chunk or the decode steps) before any query attends it."""
+        prompt = self._pending.get(slot)
+        if prompt is None:
+            # admission cancelled underneath us (release() raced this
+            # call from stop/evict) — report done, never crash the
+            # engine loop over a benign shutdown race
+            return 0
+        target = prompt.size - 1
+        pos = self._prefill_pos[slot]
+        n = min(int(budget), target - pos)
+        if n > 0:
+            if pos == 0 and n == target:
+                self._prefill_full(slot, prompt)
+            else:
+                n = self._prefill_mid(slot, prompt, pos, n)
+            pos += n
+            self._prefill_pos[slot] = pos
+        if pos >= target:
+            self._finish_admit(slot)
+            return 0
+        return target - pos
+
+    def _prefill_full(self, slot, prompt):
+        """Whole-prefix prefill in one program (bucketed pow2 key): a
+        serving mix of naturally varying prompt lengths costs O(log T)
+        compiles, not O(T)."""
+        plen = prompt.size
         row = np.zeros((1, self.max_len), np.int32)
         row[0, :plen] = prompt
         pb = _bucket_pow2(plen - 1, self.max_len - 1)
@@ -154,27 +252,99 @@ class DecodeStepper:
             # threads, so never mutate a published mapping in place
             self._admit_fns = {**self._admit_fns, pb: fn}
         with annotate("serving/prefill"):
-            self._ctx, self._caches = fn(
-                self.model.params, self._ctx, self._caches, row,
-                np.int32(slot),
+            self._caches = fn(
+                self.model.params, self._caches, row, np.int32(slot),
             )
-        self._lens[slot] = plen
+
+    def _prefill_mid(self, slot, prompt, pos, n) -> int:
+        """One mid-prompt chunk: positions ``pos..pos+n-1`` against the
+        slot's live cache rows; returns the positions actually consumed.
+        Chunk-program keys stay powers of two ALWAYS: when the bucket
+        would run past the cache's time axis (a clamped
+        ``dynamic_update_slice`` would silently shift onto real rows),
+        the chunk SHRINKS to the largest pow2 that fits rather than
+        compiling an arbitrary-length tail program — near-capacity
+        traffic must not break the O(log T) compile discipline."""
+        cb = _bucket_pow2(n, self.max_len)
+        room = self.max_len - pos
+        if cb > room:
+            cb = 1 << (room.bit_length() - 1)  # largest pow2 <= room
+            n = min(n, cb)
+        toks = np.zeros((1, cb), np.int32)
+        toks[0, :n] = prompt[pos:pos + n]
+        fn = self._chunk_fns.get(cb)
+        if fn is None:
+            fn = self._build_chunk_fn(cb)
+            self._chunk_fns = {**self._chunk_fns, cb: fn}
+        with annotate("serving/prefill_chunk"):
+            self._caches = fn(
+                self.model.params, self._caches, toks, np.int32(slot),
+                np.int32(pos),
+            )
+        return n
+
+    def _finish_admit(self, slot):
+        """Admission complete: drop the pending state and publish the
+        finished prefix's missing pow2 ladder rungs to the store. The
+        device->host K/V fetch happens ONLY when a rung is actually
+        missing (and only up to the longest missing rung), so steady-
+        state traffic over warmed prefixes costs zero transfers."""
+        prompt = self._pending.pop(slot, None)
+        self._prefill_pos.pop(slot, None)
+        if prompt is None:
+            return  # release() raced the final chunk; nothing to publish
+        store = self.prefix_cache
+        target = prompt.size - 1
+        if store is None or target < 1:
+            return
+        missing = store.missing_rungs(prompt[:target])
+        if not missing:
+            return
+        pmax = max(missing)
+        with annotate("serving/prefix_insert"):
+            kv = [
+                (np.asarray(ck[slot, :pmax]), np.asarray(cv[slot, :pmax]))
+                for ck, cv in self._caches
+            ]
+            store.insert_prefixes(prompt[:target], kv)
+
+    def _restore_prefix(self, slot, kv):
+        """Copy a cache hit's host K/V rows into the slot (bucketed
+        program key; bucket padding past the real prefix is garbage at
+        positions >= the frontier, overwritten before it is attended)."""
+        p = kv[0][0].shape[0]
+        pb = min(_bucket_pow2(p, self.max_len), self.max_len)
+        nh, hd = self._nh, self._hd
+        ks = np.zeros((len(kv), pb, nh, hd), np.dtype(self._gen.kv_dtype))
+        vs = np.zeros_like(ks)
+        for si, (k, v) in enumerate(kv):
+            ks[si, :p] = k
+            vs[si, :p] = v
+        if self._copy_fn is None:
+            self._copy_fn = self._build_copy_fn()
+        with annotate("serving/prefix_copy"):
+            self._caches = self._copy_fn(
+                self._caches, ks, vs, np.int32(slot)
+            )
 
     def release(self, slot: int) -> None:
         self._lens[slot] = 1  # keep pos = lens-1 in range while parked
+        self._pending.pop(slot, None)  # eviction mid-prefill
+        self._prefill_pos.pop(slot, None)
 
     def _build_admit_fn(self, pb: int):
-        """Compiled slot admission for prefill bucket ``pb``: write the
-        (1, T) prompt row into the slot and prefill cache positions
-        0..pb-1 via the generator's shared ``_prefill`` body."""
+        """Compiled whole-prefix prefill for bucket ``pb``: positions
+        0..pb-1 via the generator's shared ``_prefill`` body. The
+        slot's context row is NOT written here — ``begin_admit`` owns
+        that (one shared program), so this program only reads ``row``
+        for the prompt embeddings."""
         import jax
         import jax.numpy as jnp
 
         gen = self._gen
 
-        def admit(params, ctx, caches, row, slot):
+        def admit(params, caches, row, slot):
             bp, p_emb, _, _ = self._unpack(params)
-            ctx = jax.lax.dynamic_update_slice(ctx, row, (slot, 0))
             if pb >= 1:
                 x = p_emb["tokens"][row[:, :pb]]
                 if "positions" in p_emb:
@@ -199,9 +369,80 @@ class DecodeStepper:
                     )
                     for (ck, cv), (sk, sv) in zip(caches, small)
                 ]
-            return ctx, caches
+            return caches
 
-        return jax.jit(admit, donate_argnums=(1, 2))
+        return jax.jit(admit, donate_argnums=(1,))
+
+    def _build_chunk_fn(self, cb: int):
+        """Compiled mid-prompt prefill chunk for bucket ``cb``: run the
+        chunk's tokens at positions ``start..start+cb-1`` through every
+        stage against the SLOT'S existing cache row — the generators'
+        shared ``_stage_chunk`` body (K/V write at ``start``, (C, T)
+        query mask), sliced to one slot so neighbours are untouched.
+        ``start`` is traced: one program per chunk-length bucket serves
+        every position and every slot."""
+        import jax
+        import jax.numpy as jnp
+
+        gen = self._gen
+        t, nh, hd = self.max_len, self._nh, self._hd
+
+        def chunk(params, caches, toks, slot, start):
+            bp, p_emb, _, _ = self._unpack(params)
+            pos = start + jnp.arange(cb)  # (cb,) absolute positions
+            x = self._embed(p_emb, toks, pos)  # (1, cb, d)
+            qmask = jnp.arange(t)[None, :] <= pos[:, None]  # (cb, T)
+            out = []
+            for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
+                gen._stages, bp, caches
+            ):
+                rk = jax.lax.dynamic_slice(
+                    ck, (slot, 0, 0, 0), (1, t, nh, hd)
+                )
+                rv = jax.lax.dynamic_slice(
+                    cv, (slot, 0, 0, 0), (1, t, nh, hd)
+                )
+                x, rk, rv = gen._stage_chunk(
+                    blk, moe, p, pm, x, rk, rv, start, qmask
+                )
+                out.append(
+                    (
+                        jax.lax.dynamic_update_slice(
+                            ck, rk, (slot, 0, 0, 0)
+                        ),
+                        jax.lax.dynamic_update_slice(
+                            cv, rv, (slot, 0, 0, 0)
+                        ),
+                    )
+                )
+            return out
+
+        return jax.jit(chunk, donate_argnums=(1,))
+
+    def _build_copy_fn(self):
+        """Compiled prefix-cache restore: write the stacked per-stage
+        host K/V rows ``(n_stages, pb, H, Dh)`` into one slot's cache
+        rows (program key = the pb bucket, via the argument shape)."""
+        import jax
+
+        def copy(caches, ks, vs, slot):
+            out = []
+            for si, (ck, cv) in enumerate(caches):
+                out.append(
+                    (
+                        jax.lax.dynamic_update_slice(
+                            ck, ks[si][None].astype(ck.dtype),
+                            (slot, 0, 0, 0),
+                        ),
+                        jax.lax.dynamic_update_slice(
+                            cv, vs[si][None].astype(cv.dtype),
+                            (slot, 0, 0, 0),
+                        ),
+                    )
+                )
+            return out
+
+        return jax.jit(copy, donate_argnums=(0,))
 
     # -- the decode step ----------------------------------------------------
 
@@ -320,24 +561,47 @@ class ServingEngine:
     def __init__(self, model, num_slots=8, queue_capacity=64,
                  temperature=0.0, seed=0, top_k=None, top_p=None,
                  kv_dtype=None, predict_batch=64, predict_window=0.005,
-                 metrics_path=None):
+                 prefill_chunk="auto", prefix_cache=True,
+                 prefix_cache_bytes=64 << 20, metrics_path=None):
+        """``prefill_chunk``: per-scheduler-iteration prefill token
+        budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
+        directly, None disables chunking (full synchronous prefill at
+        admission, the PR 1 behavior). ``prefix_cache``: True builds a
+        byte-bounded ``PrefixStore`` (``prefix_cache_bytes``), a
+        ``PrefixStore`` instance is used as-is (shareable across
+        engines), falsy disables prefix reuse."""
         self.model = model
         self._stepper = None
         self._decode_err = None
+        self.prefix_store = None
+        store = None
+        if prefix_cache:
+            from distkeras_tpu.serving.prefix_cache import PrefixStore
+
+            store = (
+                prefix_cache
+                if isinstance(prefix_cache, PrefixStore)
+                else PrefixStore(max_bytes=prefix_cache_bytes)
+            )
         try:
             self._stepper = DecodeStepper(
                 model, num_slots=num_slots, temperature=temperature,
                 seed=seed, top_k=top_k, top_p=top_p, kv_dtype=kv_dtype,
+                prefix_cache=store,
             )
+            self.prefix_store = store
         except ValueError as e:
             # non-LM models still serve the predict verb; generate
             # replies with this error instead of refusing to boot
             self._decode_err = e
+        if self._stepper is not None and prefill_chunk == "auto":
+            prefill_chunk = max(16, self._stepper.max_len // 8)
         self.batcher = (
             None
             if self._stepper is None
             else ContinuousBatcher(
-                self._stepper, queue_capacity=queue_capacity
+                self._stepper, queue_capacity=queue_capacity,
+                prefill_chunk=prefill_chunk,
             )
         )
         from distkeras_tpu.data.dataset import Dataset
@@ -494,4 +758,12 @@ class ServingEngine:
             out["compiled_prefill_buckets"] = sorted(
                 self._stepper._admit_fns
             )
+            out["compiled_chunk_buckets"] = sorted(
+                self._stepper._chunk_fns
+            )
+        out["prefix_cache"] = (
+            self.prefix_store.stats()
+            if self.prefix_store is not None
+            else {"enabled": False}
+        )
         return out

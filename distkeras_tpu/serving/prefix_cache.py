@@ -1,0 +1,210 @@
+"""Shared-prefix KV store for the online serving tier — pure host logic.
+
+Identical prompt prefixes (system prompts, few-shot headers) recompute
+K/V from scratch on every admission; this store eliminates that cost
+the way SGLang-style radix caching does, scoped down to the repo's
+compile-bucket discipline: entries are EXACT token prefixes, and the
+stepper inserts each finished prefill at its full prefix length plus
+every power-of-two truncation below it. The pow2 ladder is what makes
+unrelated requests that share only a HEADER (not the whole prompt)
+find each other — request B's lookup walks stored lengths descending
+and lands on the longest pow2 prefix of the shared header, the same
+O(log T) granularity the compiled prefill buckets already impose.
+
+No JAX here: values are host numpy per-stage ``(p, H, Dh)`` K/V rows,
+the store is LRU-bounded by BYTES (a serving host's real budget), and
+every operation is lock-guarded because ``stats()`` is read from
+server connection threads while the engine thread admits.
+
+Admission is TWO-TOUCH (TinyLFU-style ghost list): a prefix is only
+fetched from the device and stored once it has missed twice, so
+one-shot novel prompts — the traffic that can never hit — cost zero
+transfers and zero LRU churn; a genuinely shared header is cached from
+its second appearance on.
+
+Limits, stated plainly: exact-prefix keying cannot reuse the middle of
+a longer cached entry (that takes a radix tree), and cached rows cost
+one device->host fetch at insert plus one host->device copy at hit —
+the win is real when the reused prefix out-lengths the suffix, which
+is exactly the system-prompt / few-shot-header traffic shape.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+
+def _pow2_ladder(n: int, min_len: int = 8) -> list[int]:
+    """The insert lengths for a prefix of ``n`` positions: every power
+    of two in ``[min_len, n]``. Pow2-ONLY keys keep the restore-copy
+    program shapes O(log T) (an exact-length key would compile a copy
+    program per distinct prompt length) and keep unique-suffix traffic
+    from polluting the LRU with entries no other request can ever hit;
+    ``min_len`` drops rungs too short to be worth a device round-trip."""
+    lens = []
+    p = 1
+    while p <= n:
+        if p >= min_len:
+            lens.append(p)
+        p <<= 1
+    return lens
+
+
+class PrefixStore:
+    """Exact-prefix-keyed, byte-bounded LRU store of per-stage K/V rows.
+
+    ``insert(tokens, kv)`` stores ``kv`` (list of per-stage ``(k, v)``
+    numpy arrays, first axis = ``len(tokens)`` cache positions) under
+    the token key; ``lookup(tokens)`` returns ``(p, kv)`` for the
+    longest stored prefix of ``tokens`` (or None). Hits refresh LRU
+    order; inserts evict least-recently-used entries until the byte
+    budget holds. An entry that alone exceeds the budget is refused
+    (counted ``oversize_rejected``) rather than flushing the store.
+    """
+
+    def __init__(self, max_bytes: int = 64 << 20, seen_capacity: int = 4096):
+        self.max_bytes = int(max_bytes)
+        if self.max_bytes < 1:
+            raise ValueError("max_bytes must be >= 1")
+        # key -> (prefix_len, kv, nbytes); insertion/access order = LRU
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._len_counts: collections.Counter = collections.Counter()
+        self._bytes = 0
+        # two-touch admission ghost list (TinyLFU-style): a rung is only
+        # worth its device->host fetch once it has MISSED twice — a
+        # one-shot novel prompt's rungs are marked here and never
+        # fetched, so no-reuse traffic costs zero transfers and zero
+        # LRU churn. Bounded keys-only LRU.
+        self._seen: collections.OrderedDict = collections.OrderedDict()
+        self.seen_capacity = int(seen_capacity)
+        self._lock = threading.Lock()
+        self.counters = {
+            "hits": 0,
+            "misses": 0,
+            "inserts": 0,
+            "evictions": 0,
+            "oversize_rejected": 0,
+            "hit_tokens": 0,  # prefill positions served from the store
+        }
+
+    @staticmethod
+    def _key(tokens: np.ndarray) -> bytes:
+        return np.ascontiguousarray(tokens, np.int32).tobytes()
+
+    # -- read face ----------------------------------------------------------
+
+    def lookup(self, tokens):
+        """Longest stored exact prefix of ``tokens``: ``(p, kv)`` with
+        ``p <= tokens.size``, or None. Counts one hit or one miss."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        with self._lock:
+            for p in sorted(self._len_counts, reverse=True):
+                if p > tokens.size:
+                    continue
+                key = self._key(tokens[:p])
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.counters["hits"] += 1
+                    self.counters["hit_tokens"] += p
+                    return p, entry[1]
+            self.counters["misses"] += 1
+            return None
+
+    # -- write face ---------------------------------------------------------
+
+    def insert(self, tokens, kv) -> bool:
+        """Store ``kv`` under the exact token key; returns False when the
+        key already exists (LRU refreshed) or the entry can never fit."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        p = tokens.size
+        if p < 1:
+            return False
+        nbytes = sum(int(k.nbytes) + int(v.nbytes) for k, v in kv)
+        with self._lock:
+            key = self._key(tokens)
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return False
+            if nbytes > self.max_bytes:
+                self.counters["oversize_rejected"] += 1
+                return False
+            self._entries[key] = (p, kv, nbytes)
+            self._len_counts[p] += 1
+            self._bytes += nbytes
+            self.counters["inserts"] += 1
+            while self._bytes > self.max_bytes:
+                _, (ep, _, eb) = self._entries.popitem(last=False)
+                self._len_counts[ep] -= 1
+                if not self._len_counts[ep]:
+                    del self._len_counts[ep]
+                self._bytes -= eb
+                self.counters["evictions"] += 1
+        return True
+
+    def missing_rungs(self, tokens) -> list[int]:
+        """The pow2 ladder lengths of ``tokens`` worth inserting NOW:
+        not yet stored AND on their second-or-later miss (two-touch
+        admission — the first miss only marks the ghost list). Empty
+        list = nothing to fetch from the device. No hit/miss counters,
+        no entry-LRU refresh."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        out = []
+        with self._lock:
+            for p in _pow2_ladder(tokens.size):
+                key = self._key(tokens[:p])
+                if key in self._entries:
+                    continue
+                if key in self._seen:
+                    self._seen.move_to_end(key)
+                    out.append(p)
+                else:
+                    self._seen[key] = None
+                    if len(self._seen) > self.seen_capacity:
+                        self._seen.popitem(last=False)
+        return out
+
+    def insert_prefixes(self, tokens, kv) -> int:
+        """Insert ``tokens``'s pow2 ladder rungs (copies — slices must
+        not pin the parent buffer against the byte bound). ``kv`` rows
+        may cover just the longest rung. Returns entries added."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        have = kv[0][0].shape[0]
+        added = 0
+        for p in _pow2_ladder(min(tokens.size, have)):
+            sub = (
+                kv
+                if p == have
+                else [(k[:p].copy(), v[:p].copy()) for k, v in kv]
+            )
+            if self.insert(tokens[:p], sub):
+                added += 1
+        return added
+
+    # -- maintenance / observability ----------------------------------------
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._len_counts.clear()
+            self._seen.clear()
+            self._bytes = 0
+
+    def reset_counters(self):
+        with self._lock:
+            for k in self.counters:
+                self.counters[k] = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["entries"] = len(self._entries)
+            out["bytes"] = self._bytes
+            out["max_bytes"] = self.max_bytes
+            out["enabled"] = True
+        looks = out["hits"] + out["misses"]
+        out["hit_rate"] = out["hits"] / looks if looks else 0.0
+        return out

@@ -21,7 +21,10 @@ pure-Python fake in the unit tests) with::
 
     num_slots : int        # slot-bank width (static batch shape)
     max_len   : int        # sequence capacity per slot
-    admit(slot, prompt)    # prefill a slot with a new request's prompt
+    begin_admit(slot, prompt) -> int   # start admission; returns the
+                           # prefill positions remaining (0 = decodable)
+    prefill_chunk(slot, budget) -> int # prefill <= budget more prompt
+                           # positions; returns positions remaining
     release(slot)          # slot freed (bookkeeping hook)
     step(active) -> (num_slots,) int array, the token appended per slot
 
@@ -96,6 +99,8 @@ class ServeRequest:
         self.deadline = None if deadline is None else float(deadline)
         self.created = time.monotonic()
         self.started = None  # admission instant (queue wait ends)
+        self.prefill_finished = None  # slot became decodable
+        self.first_token = None  # first generated token appended (TTFT)
         self.finished = None
         self.tokens: list[int] = []  # generated tokens, in order
         self.error: ServingError | None = None
@@ -131,16 +136,21 @@ class ServeRequest:
         return seq
 
     def latency(self) -> dict:
-        """Per-request timing breakdown (seconds) for the metrics sink."""
+        """Per-request timing breakdown (seconds) for the metrics sink:
+        queue wait (submit -> admission), prefill (admission -> slot
+        decodable), decode (decodable -> done), plus ``ttft`` (submit ->
+        first generated token) and ``total``. Phases a failed request
+        never reached stay None."""
+
+        def span(a, b):
+            return None if a is None or b is None else b - a
+
         return {
-            "queue_wait": (
-                None if self.started is None else self.started - self.created
-            ),
-            "total": (
-                None
-                if self.finished is None
-                else self.finished - self.created
-            ),
+            "queue_wait": span(self.created, self.started),
+            "prefill": span(self.started, self.prefill_finished),
+            "decode": span(self.prefill_finished, self.finished),
+            "ttft": span(self.created, self.first_token),
+            "total": span(self.created, self.finished),
         }
 
 
@@ -148,15 +158,39 @@ class ContinuousBatcher:
     """Slot-bank continuous batching: admission, eviction, and completion
     bookkeeping around an injected device stepper. Thread-safe submit;
     ``step()`` must be driven by exactly one loop (the engine thread).
+
+    Slots have an explicit lifecycle: ``queued -> prefilling ->
+    decoding -> evicted``. Admission is INCREMENTAL (Sarathi-style
+    chunked prefill): ``begin_admit`` starts a slot in the prefilling
+    state, and each scheduler iteration spends at most
+    ``prefill_chunk`` prompt tokens (shared across prefilling slots,
+    oldest admission first) via ``stepper.prefill_chunk`` before the
+    decode step runs — so one long prompt delays every decoding slot's
+    next token by one bounded chunk, not its whole prefill. Slots mid-
+    prefill are excluded from the step's active mask. ``prefill_chunk=
+    None`` removes the budget (full prefill at admission — the PR 1
+    scheduler's behavior, kept as the benchmark baseline).
     """
 
-    def __init__(self, stepper, queue_capacity=64):
+    def __init__(self, stepper, queue_capacity=64, prefill_chunk=None):
         self.stepper = stepper
         self.queue_capacity = int(queue_capacity)
         if self.queue_capacity < 1:
             raise ValueError("queue_capacity must be >= 1")
+        self.prefill_chunk = (
+            None if prefill_chunk is None else int(prefill_chunk)
+        )
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 or None; got {prefill_chunk}"
+            )
         self._queue: collections.deque[ServeRequest] = collections.deque()
         self._slots: list[ServeRequest | None] = [None] * stepper.num_slots
+        # slot -> prefill positions remaining; membership IS the
+        # "prefilling" state. FIFO order = admission order (fairness:
+        # the oldest admission reaches its first token first).
+        self._prefill_left: dict[int, int] = {}
+        self._prefill_fifo: collections.deque[int] = collections.deque()
         self._lock = threading.Lock()
         self._work = threading.Event()  # signals the engine loop
         self._draining = False
@@ -169,6 +203,8 @@ class ContinuousBatcher:
             "steps": 0,
             "occupancy_sum": 0,  # sum over steps of active slots
             "tokens_generated": 0,
+            "prefill_chunks": 0,  # stepper.prefill_chunk calls
+            "prefill_tokens": 0,  # prompt positions prefilled
         }
 
     # -- submission ---------------------------------------------------------
@@ -201,9 +237,10 @@ class ContinuousBatcher:
 
     def step(self) -> bool:
         """One scheduler iteration: admit queued requests into free
-        slots, advance every active slot one token, evict finished
-        sequences. Returns True when any slot advanced (the engine loop
-        idles when False)."""
+        slots (prefilling state), spend the prefill chunk budget on
+        slots mid-prefill (oldest first), advance every DECODING slot
+        one token, evict finished sequences. Returns True when any slot
+        made progress (the engine loop idles when False)."""
         now = time.monotonic()
         admitted = []
         with self._lock:
@@ -216,25 +253,59 @@ class ContinuousBatcher:
                 self._slots[i] = req
                 req.started = now
                 admitted.append((i, req))
-            active = np.array(
-                [s is not None for s in self._slots], bool
-            )
         # device work outside the lock: submit() must never block on a
         # compile or a step (backpressure replies stay fast under load)
-        for i, req in admitted:
-            self.stepper.admit(i, req.prompt)
+        began = [
+            (i, req, self.stepper.begin_admit(i, req.prompt))
+            for i, req in admitted
+        ]
+        now = time.monotonic()
+        with self._lock:
+            for i, req, left in began:
+                if self._slots[i] is not req:
+                    continue  # stopped underneath us
+                if left > 0:
+                    self._prefill_left[i] = left
+                    self._prefill_fifo.append(i)
+                else:
+                    req.prefill_finished = now
+        progressed = self._spend_prefill_budget()
+        now = time.monotonic()
+        with self._lock:
+            # deadline sweep for slots still mid-prefill (they produce
+            # no tokens, so the post-step check never sees them)
+            for i, req in enumerate(self._slots):
+                if req is None or i not in self._prefill_left:
+                    continue
+                if req._expired(now):
+                    self._evict(
+                        i,
+                        req,
+                        DeadlineExceededError(
+                            "deadline passed during prefill"
+                        ),
+                    )
+            active = np.array(
+                [
+                    s is not None and i not in self._prefill_left
+                    for i, s in enumerate(self._slots)
+                ],
+                bool,
+            )
         if not active.any():
-            return False
+            return progressed
         toks = np.asarray(self.stepper.step(active))
         now = time.monotonic()
         with self._lock:
             self.counters["steps"] += 1
             self.counters["occupancy_sum"] += int(active.sum())
             for i, req in enumerate(self._slots):
-                if req is None:
+                if req is None or not active[i]:
                     continue
                 tok = int(toks[i])
                 req.tokens.append(tok)
+                if req.first_token is None:
+                    req.first_token = now
                 self.counters["tokens_generated"] += 1
                 finished = (
                     len(req.tokens) >= req.max_new_tokens
@@ -251,6 +322,58 @@ class ContinuousBatcher:
                         ),
                     )
         return True
+
+    def _spend_prefill_budget(self) -> bool:
+        """Advance mid-prefill slots, oldest admission first, spending
+        at most ``prefill_chunk`` prompt tokens this iteration (no cap
+        when None). Returns True when any prefill progressed. Device
+        calls run outside the lock; only this (engine) thread mutates
+        the prefill state, so the unlocked reads between chunks are
+        safe — the lock guards concurrent ``stats()``/``stop()``."""
+        budget = self.prefill_chunk
+        spent = 0
+        progressed = False
+        while True:
+            with self._lock:
+                if not self._prefill_fifo or (
+                    budget is not None and spent >= budget
+                ):
+                    return progressed
+                i = self._prefill_fifo[0]
+                req = self._slots[i]
+                left = self._prefill_left[i]
+                give = (
+                    left if budget is None else min(left, budget - spent)
+                )
+            new_left = self.stepper.prefill_chunk(i, give)  # device work
+            now = time.monotonic()
+            with self._lock:
+                if self._slots[i] is not req:
+                    continue  # stopped/evicted underneath us
+                consumed = left - new_left
+                if consumed <= 0 and new_left > 0:
+                    # a stepper that consumes nothing would spin this
+                    # loop forever — fail loudly (the engine loop's
+                    # crash boundary completes every pending request)
+                    raise RuntimeError(
+                        f"stepper made no prefill progress on slot {i}"
+                    )
+                spent += consumed
+                progressed = progressed or consumed > 0
+                self.counters["prefill_chunks"] += 1
+                self.counters["prefill_tokens"] += consumed
+                self._prefill_left[i] = new_left
+                if new_left == 0:
+                    self._drop_prefill(i)
+                    req.prefill_finished = now
+
+    def _drop_prefill(self, i):
+        """Leave the prefilling state. Caller holds the lock."""
+        self._prefill_left.pop(i, None)
+        try:
+            self._prefill_fifo.remove(i)
+        except ValueError:
+            pass
 
     def _pop_live(self, now) -> ServeRequest | None:
         """Next queued request whose deadline has not already expired;
@@ -270,6 +393,7 @@ class ContinuousBatcher:
     def _evict(self, slot_idx, req, error):
         """Free a slot and complete its request. Caller holds the lock."""
         self._slots[slot_idx] = None
+        self._drop_prefill(slot_idx)
         self.stepper.release(slot_idx)
         if error is None:
             self.counters["completed"] += 1
@@ -294,6 +418,8 @@ class ContinuousBatcher:
                 self._queue.popleft()._finish(
                     EngineStoppedError("engine stopped")
                 )
+            self._prefill_left.clear()
+            self._prefill_fifo.clear()
             for i, req in enumerate(self._slots):
                 if req is not None:
                     self._slots[i] = None
@@ -316,7 +442,9 @@ class ContinuousBatcher:
             out = dict(self.counters)
             out["queue_depth"] = len(self._queue)
             out["active_slots"] = active
+            out["prefilling_slots"] = len(self._prefill_left)
             out["num_slots"] = len(self._slots)
+            out["prefill_chunk"] = self.prefill_chunk
             out["draining"] = self._draining
         steps = out["steps"]
         out["mean_batch_occupancy"] = (

@@ -19,7 +19,10 @@ Verbs (header ``{"verb": ...}``):
   or ``stopping`` (drain in progress).
 - ``predict``: payload = (N, ...) feature rows; reply payload = the
   model's outputs (windowed-batched server-side).
-- ``health`` / ``stats``: JSON-only replies.
+- ``health`` / ``stats``: JSON-only replies. ``stats`` carries the
+  scheduler counters (incl. prefill chunk/token counts and slot
+  lifecycle occupancy), the prefix-cache hit/miss/eviction state, the
+  compiled prefill/chunk buckets, and the live connection count.
 - ``stop``: begins graceful shutdown — in-flight and queued requests
   complete, new ones are refused, then the listener closes.
 """
@@ -209,7 +212,13 @@ class ServingServer:
                 }
             )
         if verb == "stats":
-            return pack_frame({"ok": True, "stats": self.engine.stats()})
+            stats = self.engine.stats()
+            # server-level observability rides the same verb: scheduler
+            # counters, slot lifecycle (prefilling vs decoding), prefix-
+            # cache hit/miss/eviction state, and live connection count
+            with self._lock:
+                stats["open_connections"] = len(self._conns)
+            return pack_frame({"ok": True, "stats": stats})
         if verb == "stop":
             # reply first, then drain on a side thread so the client
             # gets its ack before the listener goes away

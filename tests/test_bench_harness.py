@@ -21,6 +21,7 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 import benchmarks  # noqa: E402
 import bench_mfu  # noqa: E402
+import bench_serving  # noqa: E402
 import mfu_attrib  # noqa: E402
 
 
@@ -54,6 +55,49 @@ def test_best_mode_is_an_ab():
     a lone flash number cannot claim a win."""
     labels = {label for label, _ in mfu_attrib.mode_configs(best=True)}
     assert "dense seq4096" in labels and "flash seq4096" in labels
+
+
+def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
+    """``bench_serving.py --smoke`` runs tiny shapes end to end and the
+    artifact carries the full A/B schema — per-request TTFT, latency
+    percentiles, prefix-cache counters, and the output-identity flag.
+    Before this pin the serving benchmark was the one harness entry
+    with NO CPU exercise: a kwarg drift or schema regression would
+    surface as a broken adjudication run, not a red test."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(
+        sys, "argv", ["bench_serving.py", "--smoke", "--gap-ms", "0.5"]
+    )
+    bench_serving.main()
+    rec = json.loads((tmp_path / "BENCH_SERVING.json").read_text())
+    assert rec["metric"] == "serving_tokens_per_sec"
+    assert rec["value"] > 0
+    assert rec["continuous_vs_serial"]["speedup"] > 0
+    assert set(rec["workloads"]) == {
+        "production_mix", "mixed_long", "prefix_heavy"
+    }
+    for name, wl in rec["workloads"].items():
+        assert wl["outputs_identical"] is True, name
+        for key in ("ttft_p99_speedup", "ttft_p50_speedup",
+                    "latency_p99_speedup", "tokens_per_sec_ratio"):
+            assert wl[key] > 0, (name, key)
+        for side in ("baseline", "chunked_cached"):
+            s = wl[side]
+            assert s["tokens_per_sec"] > 0, (name, side)
+            for pct in ("mean", "p50", "p99"):
+                assert s["ttft_ms"][pct] >= 0
+                assert s["latency_ms"][pct] >= s["ttft_ms"][pct] * 0.99
+            assert len(s["per_request"]) == wl["num_requests"]
+            for pr in s["per_request"]:
+                assert {"ttft_ms", "total_ms", "queue_ms",
+                        "prefill_ms", "decode_ms"} <= set(pr)
+        # the cached side reports its store; the baseline must not
+        # pretend to have one
+        assert "prefix_cache" in wl["chunked_cached"]
+        assert "prefix_cache" not in wl["baseline"]
+    # the prefix-heavy workload actually HITS (the priming contract)
+    assert rec["workloads"]["prefix_heavy"]["chunked_cached"][
+        "prefix_cache"]["hits"] > 0
 
 
 def test_north_star_cite_reads_artifact(tmp_path):

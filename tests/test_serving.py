@@ -36,7 +36,10 @@ from distkeras_tpu.serving.scheduler import (
 class FakeStepper:
     """Pure-Python stand-in for the device face: slot ``i`` emits
     ``base + i*100 + n`` for its n-th token, so every scheduling
-    decision is visible in the token stream."""
+    decision is visible in the token stream. Prefill is the chunked
+    lifecycle contract: ``begin_admit`` reports ``len(prompt) - 1``
+    positions to prefill, ``prefill_chunk`` consumes up to the budget;
+    every chunk call is recorded so tests can pin the budget."""
 
     def __init__(self, num_slots=2, max_len=32, base=1000):
         self.num_slots = num_slots
@@ -44,11 +47,26 @@ class FakeStepper:
         self.base = base
         self.admitted = []  # (slot, prompt list) in admission order
         self.released = []
+        self.chunks = []  # (slot, tokens consumed) per prefill_chunk
         self._n = np.zeros(num_slots, int)
+        self._left = np.zeros(num_slots, int)
 
-    def admit(self, slot, prompt):
+    def begin_admit(self, slot, prompt):
         self.admitted.append((slot, list(np.asarray(prompt))))
         self._n[slot] = 0
+        self._left[slot] = max(0, len(np.asarray(prompt)) - 1)
+        return int(self._left[slot])
+
+    def prefill_chunk(self, slot, budget):
+        n = min(int(budget), int(self._left[slot]))
+        self.chunks.append((slot, n))
+        self._left[slot] -= n
+        return int(self._left[slot])
+
+    def admit(self, slot, prompt):
+        left = self.begin_admit(slot, prompt)
+        while left:
+            left = self.prefill_chunk(slot, left)
 
     def release(self, slot):
         self.released.append(slot)
@@ -205,6 +223,164 @@ def test_windowed_batcher_coalesces_one_window():
         wb.close()
 
 
+def test_chunk_budget_bounds_decode_stall():
+    """Fairness: admitting a max-length prompt mid-stream must not
+    stall an already-decoding slot beyond the configured chunk budget —
+    the decoding slot gets its token EVERY iteration while the long
+    prompt prefills, and no single chunk exceeds the budget."""
+    st = FakeStepper(num_slots=2, max_len=128)
+    b = ContinuousBatcher(st, queue_capacity=8, prefill_chunk=4)
+    r0 = b.submit(_req(plen=2, max_new=40))
+    b.step()
+    assert len(r0.tokens) == 1  # r0 decoding
+    long = b.submit(
+        ServeRequest(np.arange(1, 98, dtype=np.int32), 8)
+    )  # 96 prefill positions -> 24 budget-4 chunks
+    before = len(st.chunks)
+    iters = 0
+    while long.first_token is None:
+        got = len(r0.tokens)
+        assert b.step()
+        iters += 1
+        # the decoding slot advanced THIS iteration too (no starvation)
+        assert len(r0.tokens) == got + 1
+    # prefill spread over ceil(96/4) = 24 iterations, one chunk each,
+    # every chunk within budget
+    new_chunks = st.chunks[before:]
+    assert [n for _, n in new_chunks] == [4] * 24
+    assert iters == 24  # first token the same iteration prefill ended
+    assert b.counters["prefill_tokens"] >= 96
+    # the long request still decodes to completion afterwards
+    while not long.done:
+        b.step()
+    assert len(long.tokens) == 8
+    lat = long.latency()
+    assert lat["prefill"] > 0 and lat["ttft"] >= lat["prefill"]
+
+
+def test_unbounded_prefill_is_one_chunk():
+    """prefill_chunk=None (the PR 1 baseline) admits in one synchronous
+    chunk — the stall the budget exists to remove."""
+    st = FakeStepper(num_slots=1, max_len=128)
+    b = ContinuousBatcher(st, prefill_chunk=None)
+    b.submit(ServeRequest(np.arange(1, 98, dtype=np.int32), 2))
+    b.step()
+    assert st.chunks == [(0, 96)]
+
+
+def test_latency_splits_queue_prefill_decode():
+    st = FakeStepper(num_slots=1, max_len=64)
+    b = ContinuousBatcher(st, prefill_chunk=2)
+    r0 = b.submit(_req(plen=6, max_new=2))  # 5 positions -> 3 chunks
+    r1 = b.submit(_req(plen=2, max_new=1))  # queued behind r0
+    steps = 0
+    while not (r0.done and r1.done):
+        b.step()
+        steps += 1
+        assert steps < 50
+    for r in (r0, r1):
+        lat = r.latency()
+        assert lat["queue_wait"] >= 0
+        assert lat["prefill"] >= 0
+        assert lat["decode"] >= 0
+        assert lat["ttft"] >= lat["queue_wait"] + lat["prefill"]
+        assert lat["total"] >= lat["ttft"]
+    # r1 waited in the queue while r0 held the only slot
+    assert r1.latency()["queue_wait"] >= r0.latency()["prefill"]
+
+
+# ------------------------------------------------------------ prefix store
+
+
+def _kv(p, stages=2, nh=2, hd=4, fill=1.0):
+    return [
+        (
+            np.full((p, nh, hd), fill, np.float32),
+            np.full((p, nh, hd), -fill, np.float32),
+        )
+        for _ in range(stages)
+    ]
+
+
+def test_prefix_store_hit_miss_and_longest_prefix():
+    from distkeras_tpu.serving import PrefixStore
+
+    ps = PrefixStore(max_bytes=1 << 20)
+    toks = np.arange(100, 112, dtype=np.int32)
+    assert ps.lookup(toks) is None  # miss on empty
+    ps.insert(toks[:4], _kv(4, fill=4.0))
+    ps.insert(toks[:8], _kv(8, fill=8.0))
+    p, kv = ps.lookup(toks)  # longest stored prefix wins
+    assert p == 8 and kv[0][0][0, 0, 0] == 8.0
+    p, _ = ps.lookup(toks[:6])  # len-8 entry too long for a 6-token key
+    assert p == 4
+    assert ps.lookup(np.arange(50, 62, dtype=np.int32)) is None
+    st = ps.stats()
+    assert st["hits"] == 2 and st["misses"] == 2
+    assert st["hit_tokens"] == 12 and st["entries"] == 2
+    assert 0 < st["hit_rate"] < 1
+
+
+def test_prefix_store_lru_eviction_and_byte_bound():
+    from distkeras_tpu.serving import PrefixStore
+
+    entry_bytes = sum(k.nbytes + v.nbytes for k, v in _kv(4))
+    ps = PrefixStore(max_bytes=int(entry_bytes * 2.5))  # fits 2 entries
+    a = np.arange(0, 4, dtype=np.int32)
+    b = np.arange(10, 14, dtype=np.int32)
+    c = np.arange(20, 24, dtype=np.int32)
+    ps.insert(a, _kv(4))
+    ps.insert(b, _kv(4))
+    assert ps.lookup(a) is not None  # refresh a: b is now LRU
+    ps.insert(c, _kv(4))  # over budget -> evicts b
+    assert ps.stats()["evictions"] == 1
+    assert ps.lookup(b) is None
+    assert ps.lookup(a) is not None and ps.lookup(c) is not None
+    assert ps.stats()["bytes"] <= ps.max_bytes
+    # an entry that can never fit is refused, not a store flush
+    assert not ps.insert(np.arange(64, dtype=np.int32), _kv(64))
+    assert ps.stats()["oversize_rejected"] == 1
+    assert ps.stats()["entries"] == 2
+
+
+def test_prefix_store_two_touch_admission():
+    """missing_rungs implements two-touch admission: a rung's first
+    miss only marks the ghost list (one-shot prompts never earn a
+    device fetch); the second miss asks for the insert."""
+    from distkeras_tpu.serving import PrefixStore
+
+    ps = PrefixStore(max_bytes=1 << 20)
+    toks = np.arange(300, 320, dtype=np.int32)  # rungs 8, 16
+    assert ps.missing_rungs(toks) == []  # first touch: ghost only
+    assert ps.missing_rungs(toks) == [8, 16]  # second touch: fetch
+    ps.insert_prefixes(toks, _kv(toks.size))
+    assert ps.missing_rungs(toks) == []  # stored now
+    # the ghost list is bounded: flooding it evicts the oldest marks
+    ps2 = PrefixStore(max_bytes=1 << 20, seen_capacity=4)
+    a = np.arange(0, 8, dtype=np.int32)
+    assert ps2.missing_rungs(a) == []
+    for i in range(1, 4):  # 3 floods x 2 rungs = 6 marks > capacity 4
+        ps2.missing_rungs(np.arange(i * 50, i * 50 + 16, dtype=np.int32))
+    assert ps2.missing_rungs(a) == []  # a's mark was evicted: re-ghosted
+
+
+def test_prefix_store_pow2_ladder_shares_headers():
+    """insert_prefixes stores the pow2 truncations, so two prompts that
+    share only a HEADER (not the full prefix) still find each other."""
+    from distkeras_tpu.serving import PrefixStore
+
+    ps = PrefixStore(max_bytes=1 << 20)
+    header = np.arange(200, 216, dtype=np.int32)  # 16 tokens
+    a = np.concatenate([header, [7, 8, 9]]).astype(np.int32)
+    ps.insert_prefixes(a, _kv(a.size))
+    # a different suffix on the same header hits the len-16 ladder rung
+    b = np.concatenate([header, [1, 2, 3, 4]]).astype(np.int32)
+    p, _ = ps.lookup(b)
+    assert p == 16
+    # inserting the same prompt again adds nothing (exact keys exist)
+    assert ps.insert_prefixes(a, _kv(a.size)) == 0
+
+
 # --------------------------------------------------- stepper vs generator
 
 
@@ -274,8 +450,148 @@ def test_stepper_prefill_buckets_are_logarithmic(lm):
     rng = np.random.default_rng(1)
     for plen in (1, 2, 3, 4, 5, 6, 7, 9, 12, 17):
         st.admit(0, rng.integers(0, 61, plen).astype(np.int32))
-    # 10 distinct prompt lengths compile only the pow2 buckets
-    assert sorted(st._admit_fns) == [0, 1, 2, 4, 8, 16]
+    # 10 distinct prompt lengths compile only the pow2 buckets (a
+    # one-token prompt has nothing to prefill — no bucket-0 program,
+    # its context-row write is the shared _row_fn)
+    assert sorted(st._admit_fns) == [1, 2, 4, 8, 16]
+
+
+def _decode_slot(st, slot, steps):
+    """Drive ``steps`` decode steps with only ``slot`` active."""
+    out = []
+    for _ in range(steps):
+        active = np.zeros(st.num_slots, bool)
+        active[slot] = True
+        out.append(int(st.step(active)[slot]))
+    return out
+
+
+def test_stepper_chunked_prefill_matches_solo_decode(lm, lm_ref):
+    """A prompt prefilled in small budget-bounded chunks must decode
+    token-for-token equal to the solo cached generator (which prefills
+    in one pass) — chunked prefill is a schedule change, not a model
+    change."""
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    st = DecodeStepper(lm, num_slots=2)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 61, 23).astype(np.int32)
+    ref = lm_ref.generate(prompt[None], steps=7)[0]
+    left = st.begin_admit(0, prompt)
+    assert left == 22
+    sizes = []
+    while left:
+        before = left
+        left = st.prefill_chunk(0, 5)
+        sizes.append(before - left)
+    assert sizes == [5, 5, 5, 5, 2]  # budget respected, chunked to done
+    assert sorted(st._chunk_fns) == [2, 8]  # pow2 buckets (5 -> 8)
+    assert _decode_slot(st, 0, 7) == ref[23:].tolist()
+
+
+def test_stepper_chunk_buckets_stay_pow2_at_capacity(lm, lm_ref):
+    """A prompt prefilling up against the cache's time axis must shrink
+    its tail chunk to a pow2 that fits — never compile an arbitrary-
+    length program (the O(log T) compile discipline) and never let a
+    clamped dynamic_update_slice shift writes onto real rows."""
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    st = DecodeStepper(lm, num_slots=1)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 61, 31).astype(np.int32)  # target 30 of 32
+    ref = lm_ref.generate(prompt[None], steps=1)[0]
+    left = st.begin_admit(0, prompt)
+    while left:
+        left = st.prefill_chunk(0, 5)  # pos 25: bucket 8 > room 7
+    assert all(b & (b - 1) == 0 for b in st._chunk_fns), st._chunk_fns
+    assert _decode_slot(st, 0, 1) == ref[31:].tolist()
+
+
+def test_stepper_release_mid_prefill_is_benign(lm, lm_ref):
+    """release() racing an in-flight chunked admission (engine stop /
+    deadline evict) must cancel quietly — the next prefill_chunk
+    reports done instead of crashing the engine loop — and the slot
+    stays fully reusable."""
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    st = DecodeStepper(lm, num_slots=2)
+    rng = np.random.default_rng(12)
+    left = st.begin_admit(0, rng.integers(0, 61, 20).astype(np.int32))
+    left = st.prefill_chunk(0, 4)
+    assert left > 0
+    st.release(0)
+    assert st.prefill_chunk(0, 4) == 0  # cancelled, not a KeyError
+    prompt = rng.integers(0, 61, 5).astype(np.int32)
+    ref = lm_ref.generate(prompt[None], steps=4)[0]
+    st.admit(0, prompt)
+    assert _decode_slot(st, 0, 4) == ref[5:].tolist()
+
+
+def test_stepper_prefix_cache_hit_matches_solo_decode(lm, lm_ref):
+    """Cache-hit, chunked, and combined admission paths all pin to the
+    solo cached decode; the store's counters see the traffic."""
+    from distkeras_tpu.serving import PrefixStore
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    store = PrefixStore(max_bytes=8 << 20)
+    st = DecodeStepper(lm, num_slots=2, prefix_cache=store)
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 61, 17).astype(np.int32)
+    ref = lm_ref.generate(prompt[None], steps=6)[0]
+
+    st.admit(0, prompt)  # first miss: ghost-marked only (two-touch)
+    assert store.stats()["misses"] == 1 and store.stats()["entries"] == 0
+    assert _decode_slot(st, 0, 6) == ref[17:].tolist()
+    st.release(0)
+
+    st.admit(1, prompt)  # second miss: ladder fetched and inserted
+    assert store.stats()["misses"] == 2 and store.stats()["entries"] >= 1
+    assert _decode_slot(st, 1, 6) == ref[17:].tolist()
+    st.release(1)
+
+    # exact repeat: full hit (16 = plen-1 prefix stored), zero prefill
+    left = st.begin_admit(1, prompt)
+    assert left == 0
+    assert store.stats()["hits"] == 1
+    assert store.stats()["hit_tokens"] == 16
+    assert _decode_slot(st, 1, 6) == ref[17:].tolist()
+    st.release(1)
+
+    # combined: shared header + fresh suffix -> hit covers the pow2
+    # rung, chunked prefill computes only the remainder
+    ext = np.concatenate(
+        [prompt, rng.integers(0, 61, 9).astype(np.int32)]
+    )
+    ref_ext = lm_ref.generate(ext[None], steps=6)[0]
+    left = st.begin_admit(0, ext)
+    assert 0 < left < ext.size - 1  # partial hit: suffix only
+    while left:
+        left = st.prefill_chunk(0, 4)
+    assert _decode_slot(st, 0, 6) == ref_ext[26:].tolist()
+
+
+def test_engine_defaults_expose_prefix_and_chunk_knobs(lm):
+    """Engine-level wiring: prefix cache on by default, auto chunk
+    budget resolved from seq_len, both visible in stats()."""
+    from distkeras_tpu.serving import PrefixStore, ServingEngine
+
+    eng = ServingEngine(lm, num_slots=2)
+    try:
+        st = eng.stats()
+        assert st["prefill_chunk"] == 16  # max(16, 32 // 8)
+        assert st["prefix_cache"]["enabled"]
+        assert st["prefix_cache"]["entries"] == 0
+        assert isinstance(eng.prefix_store, PrefixStore)
+    finally:
+        eng.stop()
+    eng = ServingEngine(lm, num_slots=2, prefix_cache=False,
+                        prefill_chunk=None)
+    try:
+        st = eng.stats()
+        assert st["prefill_chunk"] is None
+        assert st["prefix_cache"] == {"enabled": False}
+    finally:
+        eng.stop()
 
 
 # ------------------------------------------------------------- end to end
