@@ -49,6 +49,8 @@ from distkeras_tpu.evaluators import (
     PerplexityEvaluator,
     RSquaredEvaluator,
 )
+from distkeras_tpu.faults import FaultPlan, InjectedFault
+from distkeras_tpu.networking import RetryPolicy
 from distkeras_tpu.serving import (
     ServingClient,
     ServingEngine,

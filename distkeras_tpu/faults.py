@@ -1,0 +1,228 @@
+"""Deterministic fault injection for the serving runtime.
+
+The recovery paths in ``serving/`` (slot blame + quarantine, the
+supervisor watchdog, client retry/reconnect) are unprovable without a
+way to make the underlying failures happen ON DEMAND and REPEATABLY.
+This module is that switch: production code registers named injection
+seams at explicit hook points (``fire("stepper.step", ...)``) and a
+test arms a seeded :class:`FaultPlan` against them. Disarmed — the
+default, always, in production — every seam is a module-global load
+plus a ``None`` check; no locks, no allocation, no branches on state
+that could drift.
+
+Seam catalogue (the hook points that exist today)::
+
+    scheduler.loop      engine scheduler thread, top of every iteration
+    stepper.step        DecodeStepper.step, before any device work
+    stepper.prefill     begin_admit / prefill_chunk, before device work
+    prefix_cache.fetch  PrefixStore.lookup (engine degrades to a miss)
+    server.dispatch     ServingServer verb dispatch (typed-reply path)
+    server.reply        ServingServer before sending a reply frame
+    net.send            networking.send_data (both PS and serving wire)
+    net.recv            networking.recv_data
+
+Actions::
+
+    raise     raise ``exc`` (default ``InjectedFault``) at the seam
+    delay     sleep ``delay`` seconds, then continue (slow step/peer)
+    drop      server.reply only: close the connection without replying
+    reset     net.send only: send a partial frame, then RST the socket
+    truncate  net.send only: declare the full length, send half, FIN
+    corrupt   net.send only: flip a byte mid-payload, send normally
+
+Determinism: triggering is COUNTED, not timed — ``after`` skips the
+first N matching events, ``times`` bounds how often the seam fires
+(``None`` = every match), ``when(ctx)`` filters on the call context
+(e.g. the step's active mask). ``probability`` draws from the plan's
+own seeded RNG, so even probabilistic chaos replays exactly.
+
+Usage::
+
+    plan = FaultPlan(seed=0)
+    plan.arm("stepper.step", exc=RuntimeError("boom"))       # once
+    plan.arm("net.send", action="reset", after=2)
+    with plan:                      # activate / deactivate
+        ...drive the engine...
+    assert plan.fired("stepper.step") == 1
+
+Only one plan is active per process at a time (the seams are global,
+like the failures they stand in for); nesting raises.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+SITES = frozenset(
+    {
+        "scheduler.loop",
+        "stepper.step",
+        "stepper.prefill",
+        "prefix_cache.fetch",
+        "server.dispatch",
+        "server.reply",
+        "net.send",
+        "net.recv",
+    }
+)
+
+ACTIONS = frozenset(
+    {"raise", "delay", "drop", "reset", "truncate", "corrupt"}
+)
+
+
+class InjectedFault(RuntimeError):
+    """Default exception raised by an armed ``raise`` seam — typed so
+    tests (and the blame machinery's counters) can tell an injected
+    failure from an organic one."""
+
+
+_ACTIVE: "FaultPlan | None" = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def fire(site: str, **ctx) -> str | None:
+    """The seam. Disarmed: one global read, one ``None`` check, return.
+    Armed: returns the triggered action name for caller-implemented
+    behaviors (``drop``/``reset``/``truncate``/``corrupt``), handles
+    ``raise`` and ``delay`` in place, returns ``None`` when no seam
+    matched this event."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan._fire(site, ctx)
+
+
+class _Seam:
+    __slots__ = (
+        "site", "action", "times", "after", "probability", "when",
+        "exc", "delay", "fired",
+    )
+
+    def __init__(self, site, action, times, after, probability, when,
+                 exc, delay):
+        self.site = site
+        self.action = action
+        self.times = times  # None = unbounded
+        self.after = int(after)
+        self.probability = float(probability)
+        self.when = when
+        self.exc = exc
+        self.delay = float(delay)
+        self.fired = 0
+
+
+class FaultPlan:
+    """A seeded, countable set of armed injection seams.
+
+    Thread-safe: seams fire from the scheduler thread, server
+    connection threads, and client threads concurrently; all matching
+    and bookkeeping happens under one lock (the armed path is test-only
+    — the disarmed fast path in :func:`fire` never touches it)."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._seams: dict[str, list[_Seam]] = {}
+        self._lock = threading.Lock()
+
+    # -- arming -------------------------------------------------------------
+
+    def arm(self, site: str, action: str = "raise", *, times: int | None = 1,
+            after: int = 0, probability: float = 1.0, when=None,
+            exc: BaseException | None = None,
+            delay: float = 0.0) -> "FaultPlan":
+        """Arm ``site`` with ``action``. ``times``: fires before the
+        seam exhausts (``None`` = forever). ``after``: matching events
+        to let pass first. ``when(ctx)``: context predicate. ``exc``:
+        the exception instance a ``raise`` seam throws (default
+        ``InjectedFault(site)``). Returns ``self`` for chaining."""
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r}; known: "
+                             f"{sorted(SITES)}")
+        if action not in ACTIONS:
+            raise ValueError(f"unknown fault action {action!r}; known: "
+                             f"{sorted(ACTIONS)}")
+        if times is not None and int(times) < 1:
+            raise ValueError(f"times must be >= 1 or None; got {times}")
+        seam = _Seam(site, action, None if times is None else int(times),
+                     after, probability, when, exc, delay)
+        with self._lock:
+            self._seams.setdefault(site, []).append(seam)
+        return self
+
+    # -- activation ---------------------------------------------------------
+
+    def activate(self) -> "FaultPlan":
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            if _ACTIVE is not None and _ACTIVE is not self:
+                raise RuntimeError(
+                    "another FaultPlan is already active; deactivate it "
+                    "first (seams are process-global)"
+                )
+            _ACTIVE = self
+        return self
+
+    def deactivate(self) -> None:
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+
+    def __enter__(self) -> "FaultPlan":
+        return self.activate()
+
+    def __exit__(self, *exc) -> None:
+        self.deactivate()
+
+    # -- firing -------------------------------------------------------------
+
+    def _fire(self, site: str, ctx: dict) -> str | None:
+        with self._lock:
+            seam = self._match(site, ctx)
+            if seam is None:
+                return None
+            seam.fired += 1
+            action, exc, delay = seam.action, seam.exc, seam.delay
+        # act OUTSIDE the lock: a delay seam must not serialize every
+        # other seam behind its sleep
+        if action == "raise":
+            raise exc if exc is not None else InjectedFault(
+                f"injected fault at {site}"
+            )
+        if action == "delay":
+            time.sleep(delay)
+        return action
+
+    def _match(self, site: str, ctx: dict) -> _Seam | None:
+        """First armed seam for ``site`` whose gates all pass. Caller
+        holds the lock."""
+        for seam in self._seams.get(site, ()):
+            if seam.times is not None and seam.fired >= seam.times:
+                continue
+            if seam.when is not None and not seam.when(ctx):
+                continue
+            if seam.after > 0:
+                seam.after -= 1
+                continue
+            if seam.probability < 1.0 and (
+                self._rng.random() >= seam.probability
+            ):
+                continue
+            return seam
+        return None
+
+    # -- observability ------------------------------------------------------
+
+    def fired(self, site: str | None = None) -> int:
+        """Total fires, for one site or the whole plan."""
+        with self._lock:
+            seams = (
+                self._seams.get(site, ())
+                if site is not None
+                else [s for lst in self._seams.values() for s in lst]
+            )
+            return sum(s.fired for s in seams)

@@ -13,12 +13,32 @@ disabled. Two deliberate upgrades over the reference:
 Within one host, trainers never touch sockets — workers share the PS object
 in-process. Sockets are only the DCN transport between hosts, where the
 reference used them for everything.
+
+Two robustness facilities live here because BOTH wire consumers (the PS
+path and the serving tier) share them:
+
+- :class:`RetryPolicy` — THE backoff implementation of the repo
+  (exponential, full-jitter, wall-clock retry budget, server-supplied
+  ``Retry-After``-style hints). ``ServingClient`` retries ``overloaded``
+  replies and connection resets through it, a retried worker's
+  ``ps.reconnect()`` redials through it, and the serving engine's
+  supervisor paces scheduler restarts with its ``delay`` schedule — one
+  implementation, so training and serving cannot drift apart on backoff
+  semantics.
+- ``faults.fire`` seams (``net.send`` / ``net.recv``) — the wire-level
+  fault-injection hook points (socket reset mid-frame, truncated frame,
+  corrupted payload, slow peer). Disarmed they are a global load and a
+  ``None`` check; see ``distkeras_tpu/faults.py``.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import struct
+import time
+
+from distkeras_tpu import faults
 
 _LEN = struct.Struct(">Q")
 
@@ -42,6 +62,9 @@ def connect(host: str, port: int, timeout=30.0) -> socket.socket:
 
 
 def send_data(sock: socket.socket, payload: bytes) -> None:
+    act = faults.fire("net.send", nbytes=len(payload))
+    if act is not None:
+        payload = _inject_send_fault(act, sock, payload)
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
@@ -50,6 +73,7 @@ def recv_data(sock: socket.socket, max_len: int | None = None) -> bytes:
     buffering a byte — on a port that accepts untrusted peers (the
     serving server), an unchecked 64-bit prefix lets one client grow
     server memory without bound."""
+    faults.fire("net.recv")
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
     if max_len is not None and length > max_len:
@@ -70,3 +94,104 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         chunks.append(chunk)
         got += len(chunk)
     return b"".join(chunks)
+
+
+# ------------------------------------------------------- fault behaviors
+
+
+def _inject_send_fault(act: str, sock: socket.socket, payload: bytes) -> bytes:
+    """Wire-level injected failures (armed ``net.send`` seams only).
+
+    ``corrupt`` returns a mangled payload for the normal send path;
+    ``truncate``/``reset`` send a partial frame themselves and raise,
+    because their whole point is that the peer sees a broken stream."""
+    if act == "corrupt":
+        mangled = bytearray(payload)
+        if mangled:
+            mangled[len(mangled) // 2] ^= 0xFF
+        return bytes(mangled)
+    if act in ("truncate", "reset"):
+        # declare the full length, deliver half: the peer's _recv_exact
+        # dies mid-message either on FIN (truncate) or RST (reset)
+        try:
+            sock.sendall(_LEN.pack(len(payload)) + payload[: len(payload) // 2])
+        except OSError:
+            pass
+        if act == "reset":
+            try:  # SO_LINGER 0 close aborts the connection (RST, not FIN)
+                sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    struct.pack("ii", 1, 0),
+                )
+            except OSError:
+                pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise ConnectionResetError(f"injected net.send fault: {act}")
+    return payload  # delay already slept inside fire(); raise already threw
+
+
+# ------------------------------------------------------------ retry policy
+
+
+class RetryPolicy:
+    """Exponential backoff with full jitter, a bounded attempt count, and
+    a wall-clock retry budget (AWS-style full jitter: each delay draws
+    uniformly from ``[0, min(max_delay, base_delay * 2**attempt)]``, the
+    schedule that avoids retry synchronization across many clients).
+
+    A server hint (``Retry-After`` semantics — the ``retry_after``
+    attribute the serving client attaches to ``overloaded`` errors)
+    overrides the computed delay, capped at ``max_delay``.
+
+    ``call(fn, retry_on=...)`` is the shared retry loop: it re-invokes
+    ``fn`` on the listed exception types until one succeeds, the attempt
+    count (``max_attempts`` total invocations) is spent, or the next
+    sleep would overrun the wall-clock ``budget`` — then re-raises the
+    last failure unchanged. ``seed=None`` draws real jitter; chaos tests
+    pass a seed so even the sleep schedule replays."""
+
+    def __init__(self, max_attempts: int = 5, base_delay: float = 0.05,
+                 max_delay: float = 2.0, budget: float | None = 30.0,
+                 seed: int | None = None):
+        self.max_attempts = int(max_attempts)
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.budget = None if budget is None else float(budget)
+        self._rng = random.Random(seed)
+
+    def delay(self, attempt: int, hint: float | None = None) -> float:
+        """Sleep before retry number ``attempt`` (0-based). ``hint``: a
+        server-supplied seconds value (``Retry-After``) that replaces
+        the jittered draw, still capped at ``max_delay``."""
+        if hint is not None:
+            return max(0.0, min(float(hint), self.max_delay))
+        cap = min(self.max_delay, self.base_delay * (2 ** attempt))
+        return self._rng.uniform(0.0, cap)
+
+    def call(self, fn, retry_on=(ConnectionError, OSError), on_retry=None):
+        """Run ``fn()`` under this policy. ``on_retry(exc, attempt,
+        delay)`` observes each retry (logging/counters). The hint is
+        read off the exception's ``retry_after`` attribute when present
+        (seconds)."""
+        attempt = 0
+        start = time.monotonic()
+        while True:
+            try:
+                return fn()
+            except retry_on as e:
+                d = self.delay(attempt, hint=getattr(e, "retry_after", None))
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    raise
+                if self.budget is not None and (
+                    time.monotonic() - start + d > self.budget
+                ):
+                    raise
+                if on_retry is not None:
+                    on_retry(e, attempt, d)
+                time.sleep(d)

@@ -13,6 +13,16 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# THE shard_map accessor for the whole repo: ``jax.shard_map`` only became
+# a public top-level name in newer JAX; older installs keep it under
+# ``jax.experimental.shard_map`` with the same (f, mesh, in_specs,
+# out_specs) signature. Every parallel module routes through this alias so
+# the version probe lives in exactly one place.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed JAX
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 
 def local_devices(n=None):
     devs = jax.devices()

@@ -31,6 +31,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from distkeras_tpu.parallel.mesh import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -168,7 +170,7 @@ def pipeline_apply(stacked_params, x, block_apply, mesh: Mesh,
                 )
         param_spec = param_specs
     x_spec = P(None, batch_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _pipeline_local,
             block_apply=block_apply,

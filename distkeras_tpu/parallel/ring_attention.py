@@ -27,6 +27,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from distkeras_tpu.parallel.mesh import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -118,7 +120,7 @@ def ring_attention(
         )
     scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(batch_axis, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ring_attention_local,
             axis_name=axis_name,

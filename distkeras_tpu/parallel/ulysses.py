@@ -28,6 +28,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from distkeras_tpu.parallel.mesh import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -84,7 +86,7 @@ def ulysses_attention(
     if inner not in ("dense", "blockwise"):
         raise ValueError(f"inner must be 'dense' or 'blockwise'; got {inner!r}")
     spec = P(batch_axis, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ulysses_local, axis_name=axis_name, causal=causal,
             inner=inner, block_size=inner_block_size,

@@ -430,9 +430,17 @@ class SocketParameterServer:
 class RemoteParameterServerClient:
     """Worker-side proxy speaking the socket protocol; drop-in for a local PS."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, retry=None):
+        """``retry``: optional ``networking.RetryPolicy`` used by
+        ``reconnect()`` to redial with exponential full-jitter backoff —
+        the SAME backoff implementation the serving client uses, so the
+        training and serving tiers cannot drift apart on retry
+        semantics. A retried worker's PS is often restarting too; a
+        policy-paced redial rides out the gap instead of failing the
+        whole retry on one refused connection."""
         self.host = host
         self.port = port
+        self.retry = retry
         self._sock = networking.connect(host, port)
         self._lock = threading.Lock()
 
@@ -445,7 +453,10 @@ class RemoteParameterServerClient:
                 self._sock.close()
             except OSError:
                 pass
-            self._sock = networking.connect(self.host, self.port)
+            dial = lambda: networking.connect(self.host, self.port)  # noqa: E731
+            self._sock = (
+                self.retry.call(dial) if self.retry is not None else dial()
+            )
 
     def pull(self, worker_id=None):
         with self._lock:

@@ -15,12 +15,22 @@ decode path (scheduler -> engine -> server, plus the client).
 - ``server``/``client``: the length-prefixed TCP wire
   (``networking``) carrying pickle-free ``DKT1`` frames
   (``utils.serialization``), verbs generate/predict/health/stats/stop.
+
+Robustness (see also ``distkeras_tpu/faults.py``): the scheduler
+assigns BLAME for device-step failures (masking retries + bisection)
+so a poison request fails alone with ``InternalError`` and its slot is
+quarantined while every other stream keeps decoding token-identical; a
+supervisor watchdog restarts a dead/wedged scheduler thread (in-flight
+work failed typed, stepper rebuilt) under a bounded backoff budget;
+the client retries ``overloaded`` and connection resets through the
+shared ``networking.RetryPolicy``.
 """
 
 from distkeras_tpu.serving.scheduler import (
     ContinuousBatcher,
     DeadlineExceededError,
     EngineStoppedError,
+    InternalError,
     OverloadedError,
     ServeRequest,
     ServingError,
@@ -36,6 +46,7 @@ __all__ = [
     "DeadlineExceededError",
     "DecodeStepper",
     "EngineStoppedError",
+    "InternalError",
     "OverloadedError",
     "PrefixStore",
     "ServeRequest",

@@ -3,19 +3,36 @@ request/reply frame pair per call (open one client per concurrent
 stream — the protocol is strictly request/reply per connection).
 
 Server-side failures come back typed: ``overloaded`` raises
-``OverloadedError`` (back off and retry), ``deadline_exceeded`` raises
-``DeadlineExceededError``, ``stopping`` raises ``EngineStoppedError``;
-anything else raises plain ``ServingError``.
+``OverloadedError``, ``deadline_exceeded`` raises
+``DeadlineExceededError``, ``stopping`` raises ``EngineStoppedError``,
+``internal`` raises ``InternalError``; anything else raises plain
+``ServingError`` with the wire code on ``.code``.
+
+Resilience (the default — pass ``retry=False`` to observe raw
+failures): a ``networking.RetryPolicy`` auto-retries ``overloaded``
+replies (honoring the server's ``retry_after_ms`` hint) and, for
+idempotent verbs, transparently reconnects and re-sends after a
+connection reset — ``generate``/``predict``/``health``/``stats`` are
+idempotent by the protocol's construction (re-running one produces the
+same answer; a duplicated generate costs the server compute, never
+correctness), ``stop`` is not retried (a reset after ``stop`` usually
+IS the shutdown). When a send dies mid-frame the client tries to
+salvage the server's parting typed reply off the socket (the server
+flushes ``fatal`` replies — ``frame_too_large`` — before closing), so
+the caller gets the reason, not a bare ``ConnectionError``; the last
+fatal reply is also remembered and attached to any later bare reset on
+the same client.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from distkeras_tpu.networking import connect, recv_data, send_data
+from distkeras_tpu.networking import RetryPolicy, connect, recv_data, send_data
 from distkeras_tpu.serving.scheduler import (
     DeadlineExceededError,
     EngineStoppedError,
+    InternalError,
     OverloadedError,
     ServingError,
 )
@@ -30,18 +47,36 @@ _ERRORS = {
     OverloadedError.code: OverloadedError,
     DeadlineExceededError.code: DeadlineExceededError,
     EngineStoppedError.code: EngineStoppedError,
+    InternalError.code: InternalError,
 }
 
 
 class ServingClient:
-    def __init__(self, host, port, timeout=120.0):
-        self._sock = connect(host, int(port), timeout=timeout)
+    def __init__(self, host, port, timeout=120.0, retry=True):
+        """``retry``: True (default) builds a ``RetryPolicy()``; a
+        ``RetryPolicy`` instance is used as-is; False/None disables all
+        retrying and reconnecting (every failure surfaces raw)."""
+        self._host, self._port = host, int(port)
+        self._timeout = timeout
+        if retry is True:
+            retry = RetryPolicy()
+        elif not retry:
+            retry = None
+        self._retry = retry
+        self._last_fatal = None  # last fatal typed reply on this client
+        self._sock = connect(self._host, self._port, timeout=self._timeout)
+        self.max_frame_bytes = None  # learned from health(), if called
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop()
+
+    def _drop(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def __enter__(self):
         return self
@@ -51,15 +86,76 @@ class ServingClient:
 
     # -- round trip ---------------------------------------------------------
 
-    def _call(self, header: dict, payload: bytes = b""):
-        send_data(self._sock, pack_frame(header, payload))
-        reply, body = unpack_frame(recv_data(self._sock))
-        if not reply.get("ok"):
-            code = reply.get("error", "error")
-            raise _ERRORS.get(code, ServingError)(
-                reply.get("detail", code)
+    def _roundtrip(self, header: dict, payload: bytes):
+        if self._sock is None:  # reconnect after a reset / fatal close
+            self._sock = connect(
+                self._host, self._port, timeout=self._timeout
             )
+        try:
+            send_data(self._sock, pack_frame(header, payload))
+            raw = recv_data(self._sock)
+        except (ConnectionError, OSError) as e:
+            salvaged = self._salvage_reply()
+            self._drop()
+            if salvaged is not None:
+                raise salvaged from e
+            if self._last_fatal is not None:
+                raise ConnectionError(
+                    f"connection closed by server; its last fatal reply "
+                    f"on this client was: {self._last_fatal}"
+                ) from e
+            raise
+        reply, body = unpack_frame(raw)
+        if not reply.get("ok"):
+            raise self._typed_error(reply)
         return reply, body
+
+    def _typed_error(self, reply: dict) -> ServingError:
+        code = reply.get("error", "error")
+        err = _ERRORS.get(code, ServingError)(reply.get("detail", code))
+        err.code = code  # wire code survives even for unmapped errors
+        if reply.get("retry_after_ms") is not None:
+            # RetryPolicy reads this attribute as its backoff hint
+            err.retry_after = float(reply["retry_after_ms"]) / 1e3
+        if reply.get("fatal"):
+            # the server closes this connection right after a fatal
+            # reply (e.g. frame_too_large: the stream is unrecoverable);
+            # drop our side now and remember why, so a later bare reset
+            # on this client still names the cause
+            self._last_fatal = f"{code}: {reply.get('detail', '')}"
+            if reply.get("max_frame_bytes") is not None:
+                self.max_frame_bytes = int(reply["max_frame_bytes"])
+            self._drop()
+        return err
+
+    def _salvage_reply(self) -> ServingError | None:
+        """After a send/recv failure, try to read the server's parting
+        typed reply off the half-closed socket (the server flushes
+        ``frame_too_large`` before closing even when it stopped reading
+        our oversized frame mid-send) — a typed reason beats a bare
+        ``ConnectionError``. Best-effort: any failure here just means
+        there was nothing to salvage."""
+        sock = self._sock
+        if sock is None:
+            return None
+        try:
+            sock.settimeout(0.25)
+            reply, _ = unpack_frame(recv_data(sock))
+            if not reply.get("ok"):
+                return self._typed_error(reply)
+        except Exception:  # noqa: BLE001 — salvage is best-effort
+            pass
+        return None
+
+    def _call(self, header: dict, payload: bytes = b"", idempotent=True):
+        if self._retry is None:
+            return self._roundtrip(header, payload)
+        retry_on = (OverloadedError,)
+        if idempotent:
+            retry_on = retry_on + (ConnectionError, OSError)
+        return self._retry.call(
+            lambda: self._roundtrip(header, payload), retry_on=retry_on
+        )
 
     # -- verbs --------------------------------------------------------------
 
@@ -88,7 +184,13 @@ class ServingClient:
         return np.asarray(deserialize_params(body))
 
     def health(self) -> dict:
+        """Server + engine liveness: ``status`` (serving | degraded |
+        draining), heartbeat age, quarantined slots, restart ledger,
+        and ``max_frame_bytes`` (recorded on the client so callers can
+        self-limit payloads)."""
         reply, _ = self._call({"verb": "health"})
+        if reply.get("max_frame_bytes") is not None:
+            self.max_frame_bytes = int(reply["max_frame_bytes"])
         return reply
 
     def stats(self) -> dict:
@@ -97,6 +199,7 @@ class ServingClient:
 
     def stop(self) -> dict:
         """Ask the server to drain and shut down (acked before the
-        listener closes)."""
-        reply, _ = self._call({"verb": "stop"})
+        listener closes). Not retried on connection failure: a reset
+        here usually IS the shutdown taking effect."""
+        reply, _ = self._call({"verb": "stop"}, idempotent=False)
         return reply

@@ -34,12 +34,16 @@ around the device phases.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
+from distkeras_tpu import faults
+from distkeras_tpu.networking import RetryPolicy
 from distkeras_tpu.serving.scheduler import (
     ContinuousBatcher,
     EngineStoppedError,
+    InternalError,
     ServeRequest,
     WindowedBatcher,
 )
@@ -123,10 +127,25 @@ class DecodeStepper:
         self._row_fn = None  # compiled ctx-row write (one program)
         self._nh, self._hd = nh, hd
         self.prefix_cache = prefix_cache
+        # prefix-store failures are degraded to misses, never surfaced
+        # to the request (the cache is an optimization, not a dependency)
+        self.prefix_fetch_failures = 0
+        # called right before each NEW program build: the engine's
+        # watchdog extends its wedge grace through it, so a live-path
+        # XLA compile (a fresh prompt-length bucket, minutes into
+        # serving) is never mistaken for a wedged scheduler
+        self.on_compile = None
         # in-progress admissions: slot -> pending prompt / next prefill
         # position (host bookkeeping for the chunked lifecycle)
         self._pending: dict[int, np.ndarray] = {}
         self._prefill_pos: dict[int, int] = {}
+
+    def _compiling(self):
+        """About to build (and on first call, compile) a new program —
+        let the watchdog know so the compile is not read as a wedge."""
+        hook = self.on_compile
+        if hook is not None:
+            hook()
 
     # -- param plumbing -----------------------------------------------------
 
@@ -173,6 +192,7 @@ class DecodeStepper:
         ready to decode). ``prefill_chunk`` advances the remainder —
         the scheduler spreads it over iterations so a long prompt never
         stalls the decoding slots beyond its per-iteration budget."""
+        faults.fire("stepper.prefill", slot=slot)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = prompt.size
         if not 1 <= plen <= self.max_len:
@@ -184,6 +204,7 @@ class DecodeStepper:
         if self._row_fn is None:
             import jax
 
+            self._compiling()
             self._row_fn = jax.jit(
                 lambda ctx, r, s: jax.lax.dynamic_update_slice(
                     ctx, r, (s, 0)
@@ -194,7 +215,11 @@ class DecodeStepper:
         target = plen - 1  # prefill covers positions 0..plen-2
         start = 0
         if self.prefix_cache is not None and target >= 1:
-            hit = self.prefix_cache.lookup(prompt[:target])
+            try:
+                hit = self.prefix_cache.lookup(prompt[:target])
+            except Exception:  # noqa: BLE001 — cache is best-effort
+                self.prefix_fetch_failures += 1
+                hit = None  # a broken cache degrades to a miss
             if hit is not None:
                 start, kv = hit
                 self._restore_prefix(slot, kv)
@@ -216,6 +241,7 @@ class DecodeStepper:
         garbage K/V computed past the chunk's real tokens sits at
         positions >= the prefill frontier and is overwritten (by the
         next chunk or the decode steps) before any query attends it."""
+        faults.fire("stepper.prefill", slot=slot)
         prompt = self._pending.get(slot)
         if prompt is None:
             # admission cancelled underneath us (release() raced this
@@ -247,6 +273,7 @@ class DecodeStepper:
         pb = _bucket_pow2(plen - 1, self.max_len - 1)
         fn = self._admit_fns.get(pb)
         if fn is None:
+            self._compiling()
             fn = self._build_admit_fn(pb)
             # copy-on-write: stats() iterates this dict from other
             # threads, so never mutate a published mapping in place
@@ -274,6 +301,7 @@ class DecodeStepper:
         toks[0, :n] = prompt[pos:pos + n]
         fn = self._chunk_fns.get(cb)
         if fn is None:
+            self._compiling()
             fn = self._build_chunk_fn(cb)
             self._chunk_fns = {**self._chunk_fns, cb: fn}
         with annotate("serving/prefill_chunk"):
@@ -297,16 +325,21 @@ class DecodeStepper:
         target = prompt.size - 1
         if store is None or target < 1:
             return
-        missing = store.missing_rungs(prompt[:target])
-        if not missing:
-            return
-        pmax = max(missing)
-        with annotate("serving/prefix_insert"):
-            kv = [
-                (np.asarray(ck[slot, :pmax]), np.asarray(cv[slot, :pmax]))
-                for ck, cv in self._caches
-            ]
-            store.insert_prefixes(prompt[:target], kv)
+        try:
+            missing = store.missing_rungs(prompt[:target])
+            if not missing:
+                return
+            pmax = max(missing)
+            with annotate("serving/prefix_insert"):
+                kv = [
+                    (np.asarray(ck[slot, :pmax]), np.asarray(cv[slot, :pmax]))
+                    for ck, cv in self._caches
+                ]
+                store.insert_prefixes(prompt[:target], kv)
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            # a store failure must never fail the (already fully
+            # prefilled) request; it just forgoes the reuse
+            self.prefix_fetch_failures += 1
 
     def _restore_prefix(self, slot, kv):
         """Copy a cache hit's host K/V rows into the slot (bucketed
@@ -321,6 +354,7 @@ class DecodeStepper:
             ks[si, :p] = k
             vs[si, :p] = v
         if self._copy_fn is None:
+            self._compiling()
             self._copy_fn = self._build_copy_fn()
         with annotate("serving/prefix_copy"):
             self._caches = self._copy_fn(
@@ -331,6 +365,26 @@ class DecodeStepper:
         self._lens[slot] = 1  # keep pos = lens-1 in range while parked
         self._pending.pop(slot, None)  # eviction mid-prefill
         self._prefill_pos.pop(slot, None)
+
+    def warmup(self) -> None:
+        """Compile the decode step off the serving path. The supervisor
+        warms a REBUILT stepper before swapping it in, so the first
+        live iteration after a restart does not spend the watchdog
+        budget inside XLA (a ~1 s compile is indistinguishable from a
+        wedge by heartbeat age alone). An all-inactive step call: every
+        write is masked, so the slot bank is numerically untouched; the
+        step-index argument is traced data, so the program is the same
+        one live traffic uses. Deliberately does NOT route through
+        ``step()`` — warmup must not trip armed ``stepper.step`` fault
+        seams meant for live traffic."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step_fn()
+        active = np.zeros(self.num_slots, bool)
+        with annotate("serving/warmup"):
+            self._ctx, self._caches, _ = self._step_fn(
+                self.model.params, self._ctx, self._caches,
+                self._lens.copy(), active, np.int32(self._step_idx),
+            )
 
     def _build_admit_fn(self, pb: int):
         """Compiled whole-prefix prefill for bucket ``pb``: positions
@@ -451,9 +505,14 @@ class DecodeStepper:
         appended this step (entries for inactive slots are meaningless).
         One compiled call plus one small host fetch per step — the
         iteration-level scheduling loop the batcher drives."""
-        if self._step_fn is None:
-            self._step_fn = self._build_step_fn()
         active = np.asarray(active, bool)
+        # the injection seam fires BEFORE any device work or host
+        # bookkeeping: a failed step leaves the slot bank exactly as it
+        # was, which is what makes the batcher's blame retries sound
+        faults.fire("stepper.step", active=active)
+        if self._step_fn is None:
+            self._compiling()
+            self._step_fn = self._build_step_fn()
         with annotate("serving/step"):
             self._ctx, self._caches, toks = self._step_fn(
                 self.model.params, self._ctx, self._caches,
@@ -562,14 +621,35 @@ class ServingEngine:
                  temperature=0.0, seed=0, top_k=None, top_p=None,
                  kv_dtype=None, predict_batch=64, predict_window=0.005,
                  prefill_chunk="auto", prefix_cache=True,
-                 prefix_cache_bytes=64 << 20, metrics_path=None):
+                 prefix_cache_bytes=64 << 20, quarantine_steps=64,
+                 watchdog_interval=10.0, watchdog_grace=None,
+                 max_restarts=3, restart_backoff=0.05,
+                 metrics_path=None):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
         admission, the PR 1 behavior). ``prefix_cache``: True builds a
         byte-bounded ``PrefixStore`` (``prefix_cache_bytes``), a
         ``PrefixStore`` instance is used as-is (shareable across
-        engines), falsy disables prefix reuse."""
+        engines), falsy disables prefix reuse.
+
+        Self-healing knobs: ``quarantine_steps`` (scheduler iterations
+        a blamed slot sits out — see ``ContinuousBatcher``),
+        ``watchdog_interval`` (seconds without a scheduler heartbeat
+        before the supervisor declares the thread dead/wedged, fails
+        in-flight requests typed, and restarts it with a rebuilt
+        stepper; keep it comfortably above the slowest legitimate
+        device phase — a first-step XLA compile counts),
+        ``watchdog_grace`` (seconds after each scheduler (re)launch
+        during which WEDGE detection stays disarmed — fresh prefill
+        buckets still compile on the live path even though restarts
+        pre-warm the decode step; default ``max(2, watchdog_interval)``;
+        dead-thread detection is never graced), ``max_restarts``
+        (lifetime restart budget; exhausted
+        = the engine stays ``degraded`` and refuses generate with
+        ``InternalError``), ``restart_backoff`` (base of the
+        exponential full-jitter delay between restarts — the same
+        ``networking.RetryPolicy`` schedule clients use)."""
         self.model = model
         self._stepper = None
         self._decode_err = None
@@ -583,12 +663,17 @@ class ServingEngine:
                 if isinstance(prefix_cache, PrefixStore)
                 else PrefixStore(max_bytes=prefix_cache_bytes)
             )
+        # everything a supervisor restart needs to rebuild the device
+        # face from scratch (fresh slot bank, fresh caches, recompiled
+        # programs; the host-side prefix store SURVIVES restarts)
+        self._stepper_cfg = dict(
+            num_slots=num_slots, temperature=temperature, seed=seed,
+            top_k=top_k, top_p=top_p, kv_dtype=kv_dtype,
+            prefix_cache=store,
+        )
         try:
-            self._stepper = DecodeStepper(
-                model, num_slots=num_slots, temperature=temperature,
-                seed=seed, top_k=top_k, top_p=top_p, kv_dtype=kv_dtype,
-                prefix_cache=store,
-            )
+            self._stepper = DecodeStepper(model, **self._stepper_cfg)
+            self._stepper.on_compile = self._extend_grace
             self.prefix_store = store
         except ValueError as e:
             # non-LM models still serve the predict verb; generate
@@ -596,13 +681,14 @@ class ServingEngine:
             self._decode_err = e
         if self._stepper is not None and prefill_chunk == "auto":
             prefill_chunk = max(16, self._stepper.max_len // 8)
+        self._batcher_cfg = dict(
+            queue_capacity=queue_capacity, prefill_chunk=prefill_chunk,
+            quarantine_steps=quarantine_steps,
+        )
         self.batcher = (
             None
             if self._stepper is None
-            else ContinuousBatcher(
-                self._stepper, queue_capacity=queue_capacity,
-                prefill_chunk=prefill_chunk,
-            )
+            else ContinuousBatcher(self._stepper, **self._batcher_cfg)
         )
         from distkeras_tpu.data.dataset import Dataset
         from distkeras_tpu.predictors import ModelPredictor
@@ -623,6 +709,30 @@ class ServingEngine:
         self._thread = None
         self._stop_evt = threading.Event()
         self._started = False
+        # supervisor state: the scheduler loop stamps _heartbeat every
+        # iteration; the supervisor thread watches it and the thread's
+        # liveness, failing in-flight work typed and restarting the
+        # loop (rebuilt stepper) under the bounded restart budget
+        self.watchdog_interval = float(watchdog_interval)
+        self.watchdog_grace = (
+            max(2.0, self.watchdog_interval)
+            if watchdog_grace is None
+            else float(watchdog_grace)
+        )
+        self._grace_until = 0.0
+        self.max_restarts = int(max_restarts)
+        self._restart_delays = RetryPolicy(
+            max_attempts=self.max_restarts + 1,
+            base_delay=float(restart_backoff), max_delay=2.0, seed=seed,
+        )
+        self._supervisor = None
+        self._crash_evt = threading.Event()  # crash boundary -> supervisor
+        self._heartbeat = time.monotonic()
+        self._restarts = 0
+        self._watchdog_trips = 0
+        self._failed = False  # permanently degraded (see _failed_reason)
+        self._failed_reason = None
+        self._last_crash = None
 
     @classmethod
     def from_bundle(cls, path: str, **kwargs) -> "ServingEngine":
@@ -642,70 +752,205 @@ class ServingEngine:
         self._started = True
         self._predict_batcher.start()
         if self.batcher is not None:
-            self._thread = threading.Thread(
-                target=self._loop, name="serving-engine", daemon=True
+            self._launch_scheduler(self.batcher)
+            self._supervisor = threading.Thread(
+                target=self._supervise, name="serving-supervisor",
+                daemon=True,
             )
-            self._thread.start()
+            self._supervisor.start()
         return self
 
-    def _loop(self):
+    def _extend_grace(self):
+        """A device program is about to compile (stepper ``on_compile``
+        hook, also stamped at each scheduler launch): push the wedge
+        detector's grace window out so the compile — however far into
+        the serving lifetime it happens (a fresh prompt-length bucket,
+        minutes in) — is never read as a wedged scheduler. Dead-thread
+        detection is unaffected."""
+        self._grace_until = max(
+            self._grace_until, time.monotonic() + self.watchdog_grace
+        )
+
+    def _launch_scheduler(self, batcher):
+        self._heartbeat = time.monotonic()
+        self._grace_until = self._heartbeat + self.watchdog_grace
+        self._thread = threading.Thread(
+            target=self._loop, args=(batcher,), name="serving-engine",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _loop(self, batcher):
         """The scheduler thread: admit/step/evict until stopped; in
         drain mode, exit only once everything in flight completed. A
-        device-side crash fails every pending request loudly instead of
-        leaving clients blocked until their timeouts."""
+        crash that escapes the batcher's own blame machinery fails
+        every pending request TYPED (``InternalError``, not a silent
+        hang) and hands off to the supervisor, which restarts the loop
+        with a rebuilt stepper. ``batcher`` is bound at thread start: a
+        superseded (restart-replaced) loop notices and exits instead of
+        driving the new generation's state."""
         try:
             while True:
-                progressed = self.batcher.step()
-                if self._stop_evt.is_set() and self.batcher.idle:
+                if self.batcher is not batcher:
+                    return  # superseded by a supervisor restart
+                self._heartbeat = time.monotonic()
+                faults.fire("scheduler.loop", busy=not batcher.idle)
+                progressed = batcher.step()
+                if self._stop_evt.is_set() and batcher.idle:
                     return
                 if not progressed:
                     if self._stop_evt.is_set():
                         return
-                    self.batcher.wait_for_work()
+                    batcher.wait_for_work()
         except Exception as e:  # noqa: BLE001 — scheduler crash boundary
-            self.batcher.stop()
+            self._last_crash = repr(e)
+            batcher.stop(error=InternalError(
+                f"scheduler crashed; request aborted: {e!r}"
+            ))
             if self.metrics is not None:
                 self.metrics.log(
                     event="serving_engine_crash", error=repr(e)
                 )
-            raise
+            self._crash_evt.set()  # wake the supervisor immediately
+
+    # -- supervisor ---------------------------------------------------------
+
+    def _supervise(self):
+        """Watchdog: a dead scheduler thread (crash boundary fired) or
+        a wedged one (no heartbeat for ``watchdog_interval`` — stuck in
+        a device call or a pathological sleep) trips a restart. The
+        wedged thread cannot be killed; it is ABANDONED — its batcher
+        is stopped (in-flight requests fail typed) and replaced, and
+        the zombie exits on its own next iteration via the superseded
+        check."""
+        poll = max(0.01, min(0.05, self.watchdog_interval / 4))
+        while not self._stop_evt.is_set():
+            self._crash_evt.wait(timeout=poll)
+            self._crash_evt.clear()
+            if self._stop_evt.is_set():
+                return
+            th = self._thread
+            if th is None or self._failed:
+                continue
+            now = time.monotonic()
+            dead = not th.is_alive()
+            wedged = (
+                now - self._heartbeat > self.watchdog_interval
+                and now > self._grace_until  # compiles are not wedges
+            )
+            if not dead and not wedged:
+                continue
+            self._watchdog_trips += 1
+            if self.metrics is not None:
+                self.metrics.log(
+                    event="serving_watchdog_trip",
+                    dead=dead, wedged=wedged, restarts=self._restarts,
+                )
+            self._restart(dead)
+
+    def _restart(self, dead):
+        """Fail everything the old scheduler generation held (typed —
+        clients must never block on a dead loop), then rebuild the
+        stepper and relaunch under the restart budget with exponential
+        full-jitter backoff (the shared ``RetryPolicy`` schedule)."""
+        old = self.batcher
+        old.stop(error=InternalError(
+            "scheduler " + ("crashed" if dead else "wedged")
+            + "; in-flight request aborted by the supervisor"
+        ))
+        if self._restarts >= self.max_restarts:
+            self._failed = True
+            self._failed_reason = (
+                f"scheduler restart budget exhausted "
+                f"({self._restarts}/{self.max_restarts})"
+            )
+            if self.metrics is not None:
+                self.metrics.log(
+                    event="serving_restart_budget_exhausted",
+                    restarts=self._restarts,
+                )
+            return
+        if self._stop_evt.wait(self._restart_delays.delay(self._restarts)):
+            return  # shutdown arrived during the backoff
+        try:
+            stepper = DecodeStepper(self.model, **self._stepper_cfg)
+            stepper.on_compile = self._extend_grace
+            # compile the decode step HERE, on the supervisor thread,
+            # so the first live iteration is serving, not compiling
+            stepper.warmup()
+        except Exception as e:  # noqa: BLE001 — rebuild is last-resort
+            self._failed = True
+            self._failed_reason = f"stepper rebuild failed: {e!r}"
+            self._last_crash = repr(e)
+            return
+        self._restarts += 1
+        self._stepper = stepper
+        batcher = ContinuousBatcher(stepper, **self._batcher_cfg)
+        self.batcher = batcher
+        self._launch_scheduler(batcher)
+        if self.metrics is not None:
+            self.metrics.log(
+                event="serving_engine_restarted", restarts=self._restarts
+            )
 
     def stop(self, drain=True):
         """Shutdown. ``drain=True``: stop admissions, finish queued and
         in-flight requests, then stop; ``drain=False``: fail them."""
-        if self.batcher is not None:
-            if drain:
-                self.batcher.drain()
-            else:
-                self.batcher.stop()
         self._stop_evt.set()
-        if self.batcher is not None:
-            self.batcher._work.set()
+        self._crash_evt.set()  # wake the supervisor so it can exit
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=10)
+            self._supervisor = None
+        batcher = self.batcher
+        if batcher is not None:
+            if drain:
+                batcher.drain()
+            else:
+                batcher.stop()
+            batcher._work.set()
         if self._thread is not None:
             self._thread.join(timeout=60)
             self._thread = None
-        if not drain and self.batcher is not None:
-            self.batcher.stop()  # fail anything the loop left behind
+        if batcher is not None and (not drain or not batcher.idle):
+            # fail anything the loop left behind (hard stop, or a drain
+            # whose scheduler thread was already dead)
+            batcher.stop()
         self._predict_batcher.close()
 
     # -- generate -----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens, eos_id=None,
                deadline=None) -> ServeRequest:
-        if self.batcher is None:
+        batcher = self.batcher  # one read: restarts swap the attribute
+        if batcher is None:
             raise EngineStoppedError(
                 f"model does not support generate: {self._decode_err}"
             )
         if not self._started:
             raise EngineStoppedError("engine not started")
+        if self._failed:
+            raise InternalError(
+                f"engine is degraded: {self._failed_reason} "
+                f"(last crash: {self._last_crash})"
+            )
         req = ServeRequest(
             prompt, max_new_tokens, eos_id=eos_id, deadline=deadline
         )
         try:
-            return self.batcher.submit(req)
+            try:
+                return batcher.submit(req)
+            except EngineStoppedError:
+                if self._stop_evt.is_set():
+                    raise  # a real shutdown: "stopping" is the truth
+                # the batcher we read was stopped by a supervisor
+                # restart mid-call — a transient internal condition,
+                # not a drain; tell the client the engine's story
+                raise InternalError(
+                    "scheduler restarting after a failure; retry shortly"
+                ) from None
         finally:
             if self.metrics is not None:
-                st = self.batcher.stats()
+                st = batcher.stats()
                 self.metrics.log(
                     event="serving_submit", request_id=req.id,
                     prompt_len=int(req.prompt.size),
@@ -747,6 +992,53 @@ class ServingEngine:
 
     # -- observability ------------------------------------------------------
 
+    def health(self) -> dict:
+        """Liveness summary, cheap enough for a load balancer to poll:
+        ``status`` is ``serving`` (scheduler heartbeating), ``degraded``
+        (scheduler dead/restarting, or the restart budget is exhausted),
+        or ``draining`` (shutdown in progress); plus the heartbeat age,
+        the quarantined-slot count, and the restart ledger."""
+        batcher = self.batcher
+        if self._stop_evt.is_set():
+            status = "draining"
+        elif batcher is None:
+            status = "serving"  # predict-only engines have no scheduler
+        else:
+            th = self._thread
+            now = time.monotonic()
+            healthy = (
+                self._started
+                and not self._failed
+                and th is not None
+                and th.is_alive()
+                and (
+                    now - self._heartbeat <= self.watchdog_interval
+                    # a stale heartbeat inside the compile/launch grace
+                    # is the supervisor's definition of fine — health
+                    # must not pull a node the watchdog would not trip
+                    or now <= self._grace_until
+                )
+            )
+            status = "serving" if healthy else "degraded"
+        out = {
+            "status": status,
+            "restarts": self._restarts,
+            "max_restarts": self.max_restarts,
+            "restart_budget_exhausted": self._failed,
+            "watchdog_trips": self._watchdog_trips,
+            "quarantined_slots": (
+                0 if batcher is None else len(batcher._quarantined)
+            ),
+        }
+        out["heartbeat_age"] = (
+            None
+            if batcher is None or not self._started
+            else time.monotonic() - self._heartbeat
+        )
+        if self._last_crash is not None:
+            out["last_crash"] = self._last_crash
+        return out
+
     def stats(self) -> dict:
         out = {
             "model": type(self.model).__name__,
@@ -761,6 +1053,12 @@ class ServingEngine:
             out["compiled_chunk_buckets"] = sorted(
                 self._stepper._chunk_fns
             )
+            out["prefix_fetch_failures"] = (
+                self._stepper.prefix_fetch_failures
+            )
+        out["restarts"] = self._restarts
+        out["watchdog_trips"] = self._watchdog_trips
+        out["status"] = self.health()["status"]
         out["prefix_cache"] = (
             self.prefix_store.stats()
             if self.prefix_store is not None

@@ -36,6 +36,8 @@ import threading
 
 import numpy as np
 
+from distkeras_tpu import faults
+
 
 def _pow2_ladder(n: int, min_len: int = 8) -> list[int]:
     """The insert lengths for a prefix of ``n`` positions: every power
@@ -98,7 +100,10 @@ class PrefixStore:
 
     def lookup(self, tokens):
         """Longest stored exact prefix of ``tokens``: ``(p, kv)`` with
-        ``p <= tokens.size``, or None. Counts one hit or one miss."""
+        ``p <= tokens.size``, or None. Counts one hit or one miss. The
+        injection seam stands in for a real fetch failure (a remote
+        store, a corrupted entry); the engine degrades it to a miss."""
+        faults.fire("prefix_cache.fetch", n=int(np.asarray(tokens).size))
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         with self._lock:
             for p in sorted(self._len_counts, reverse=True):

@@ -33,6 +33,14 @@ Backpressure is explicit: a full queue rejects at ``submit`` with
 reply) instead of queueing unboundedly. Per-request deadlines are
 checked at admission and after every step; drain mode stops admission
 of NEW requests while in-flight ones run to completion.
+
+Failures are CONTAINED, not fatal: a device-step exception triggers
+blame assignment (masked retry of the newest admission, bisection if
+needed — ``ContinuousBatcher._step_with_blame``) so only the culpable
+request fails (typed ``InternalError``) and its slot is quarantined,
+while every surviving stream advances exactly one token per iteration;
+a prefill crash fails just its own (attributable) request. See
+docs/ARCHITECTURE.md "Failure modes & recovery".
 """
 
 from __future__ import annotations
@@ -67,6 +75,15 @@ class EngineStoppedError(ServingError):
     """The engine is draining or stopped; no new admissions."""
 
     code = "stopping"
+
+
+class InternalError(ServingError):
+    """The engine failed this request for an internal reason — a device
+    step blamed on it, a prefill crash, or a scheduler restart that
+    aborted it mid-flight. Typed so clients are never left to a timeout
+    or a bare connection error when the engine is the thing at fault."""
+
+    code = "internal"
 
 
 class ServeRequest:
@@ -172,7 +189,13 @@ class ContinuousBatcher:
     scheduler's behavior, kept as the benchmark baseline).
     """
 
-    def __init__(self, stepper, queue_capacity=64, prefill_chunk=None):
+    def __init__(self, stepper, queue_capacity=64, prefill_chunk=None,
+                 quarantine_steps=64):
+        """``quarantine_steps``: scheduler iterations a slot sits out
+        after a device step is blamed on its request (its cache rows are
+        suspect, and a systematically poisonous traffic shape should not
+        re-enter the bank instantly); the slot recycles into the free
+        pool automatically once the probation expires."""
         self.stepper = stepper
         self.queue_capacity = int(queue_capacity)
         if self.queue_capacity < 1:
@@ -184,6 +207,9 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prefill_chunk must be >= 1 or None; got {prefill_chunk}"
             )
+        self.quarantine_steps = int(quarantine_steps)
+        if self.quarantine_steps < 1:
+            raise ValueError("quarantine_steps must be >= 1")
         self._queue: collections.deque[ServeRequest] = collections.deque()
         self._slots: list[ServeRequest | None] = [None] * stepper.num_slots
         # slot -> prefill positions remaining; membership IS the
@@ -191,6 +217,13 @@ class ContinuousBatcher:
         # the oldest admission reaches its first token first).
         self._prefill_left: dict[int, int] = {}
         self._prefill_fifo: collections.deque[int] = collections.deque()
+        # blame bookkeeping: per-slot admission sequence (most-recently-
+        # admitted is the prime suspect of a step failure) and the
+        # quarantine ledger (slot -> scheduler iteration it recycles at)
+        self._admit_seq = 0
+        self._admit_order = [0] * stepper.num_slots
+        self._quarantined: dict[int, int] = {}
+        self._sched_iters = 0  # step() calls (not device steps)
         self._lock = threading.Lock()
         self._work = threading.Event()  # signals the engine loop
         self._draining = False
@@ -205,6 +238,12 @@ class ContinuousBatcher:
             "tokens_generated": 0,
             "prefill_chunks": 0,  # stepper.prefill_chunk calls
             "prefill_tokens": 0,  # prompt positions prefilled
+            # fault / recovery counters (the self-healing paths)
+            "step_failures": 0,  # device step raised
+            "blame_probes": 0,  # extra step calls spent assigning blame
+            "internal_errors": 0,  # requests failed with InternalError
+            "prefill_failures": 0,  # begin_admit / prefill_chunk raised
+            "quarantines": 0,  # slots sent to probation
         }
 
     # -- submission ---------------------------------------------------------
@@ -236,29 +275,41 @@ class ContinuousBatcher:
     # -- scheduler iteration ------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduler iteration: admit queued requests into free
-        slots (prefilling state), spend the prefill chunk budget on
-        slots mid-prefill (oldest first), advance every DECODING slot
-        one token, evict finished sequences. Returns True when any slot
-        made progress (the engine loop idles when False)."""
+        """One scheduler iteration: recycle expired quarantines, admit
+        queued requests into free slots (prefilling state), spend the
+        prefill chunk budget on slots mid-prefill (oldest first),
+        advance every DECODING slot one token (with blame assignment on
+        a step failure — see ``_step_with_blame``), evict finished
+        sequences. Returns True when any slot made progress (the engine
+        loop idles when False)."""
         now = time.monotonic()
         admitted = []
         with self._lock:
+            self._sched_iters += 1
+            for s, until in list(self._quarantined.items()):
+                if self._sched_iters >= until:
+                    del self._quarantined[s]  # probation served
             for i, slot in enumerate(self._slots):
-                if slot is not None:
+                if slot is not None or i in self._quarantined:
                     continue
                 req = self._pop_live(now)
                 if req is None:
                     break
                 self._slots[i] = req
                 req.started = now
+                self._admit_seq += 1
+                self._admit_order[i] = self._admit_seq
                 admitted.append((i, req))
         # device work outside the lock: submit() must never block on a
         # compile or a step (backpressure replies stay fast under load)
-        began = [
-            (i, req, self.stepper.begin_admit(i, req.prompt))
-            for i, req in admitted
-        ]
+        began = []
+        for i, req in admitted:
+            try:
+                began.append((i, req, self.stepper.begin_admit(i, req.prompt)))
+            except Exception as e:  # noqa: BLE001 — admission boundary
+                # a prefill crash is attributable by construction (one
+                # slot, one request): fail IT typed, keep everything else
+                self._fail_admission(i, req, e)
         now = time.monotonic()
         with self._lock:
             for i, req, left in began:
@@ -294,13 +345,30 @@ class ContinuousBatcher:
             )
         if not active.any():
             return progressed
-        toks = np.asarray(self.stepper.step(active))
+        toks, blamed = self._step_with_blame(active)
         now = time.monotonic()
         with self._lock:
             self.counters["steps"] += 1
             self.counters["occupancy_sum"] += int(active.sum())
+            for i in blamed:
+                req = self._slots[i]
+                if req is None:
+                    continue  # stopped underneath the blame probes
+                self._quarantine_locked(i)
+                self._evict(
+                    i,
+                    req,
+                    InternalError(
+                        f"device step failed and was blamed on this "
+                        f"request (slot {i}); slot quarantined for "
+                        f"{self.quarantine_steps} iterations"
+                    ),
+                )
+            if toks is None:
+                return True  # every active slot was blamed this round
+            blamed_set = set(blamed)
             for i, req in enumerate(self._slots):
-                if req is None or not active[i]:
+                if req is None or not active[i] or i in blamed_set:
                     continue
                 tok = int(toks[i])
                 req.tokens.append(tok)
@@ -322,6 +390,90 @@ class ContinuousBatcher:
                         ),
                     )
         return True
+
+    # -- blame assignment ----------------------------------------------------
+
+    def _step_with_blame(self, active):
+        """Advance the active slots one token, surviving a poison
+        request: when ``stepper.step`` raises, retry with the most-
+        recently-admitted active slot masked out (the prime suspect —
+        established streams were stepping fine before it arrived); if
+        the retry fails too, bisect the active set until the minimal
+        culpable slots are isolated. Every non-blamed slot advances
+        EXACTLY once (failed step calls advance nothing — the injection
+        seams fire before device work, and a real XLA failure aborts
+        the whole program), so surviving streams stay token-identical
+        to their solo decode. Returns ``(toks, blamed)``; ``toks`` is
+        None when nothing advanced. An engine-level failure (every
+        probe failing) blames all active slots — the supervisor's
+        restart budget is the backstop for a stepper that is truly
+        dead, not poisoned."""
+        try:
+            return np.asarray(self.stepper.step(active)), []
+        except Exception:  # noqa: BLE001 — device crash boundary
+            with self._lock:
+                self.counters["step_failures"] += 1
+        idxs = [int(i) for i in np.flatnonzero(active)]
+        if len(idxs) == 1:
+            return None, idxs  # alone in the batch = culpable by elimination
+        with self._lock:
+            suspect = max(idxs, key=lambda i: self._admit_order[i])
+        retry = active.copy()
+        retry[suspect] = False
+        try:
+            with self._lock:
+                self.counters["blame_probes"] += 1
+            toks = np.asarray(self.stepper.step(retry))
+            return toks, [suspect]
+        except Exception:  # noqa: BLE001
+            pass
+        # the newest admission alone is not the story: bisect the whole
+        # active set (nothing has advanced yet — all probes so far failed)
+        got: dict[int, int] = {}
+        blamed: list[int] = []
+
+        def probe(group):
+            mask = np.zeros_like(active)
+            mask[group] = True
+            try:
+                with self._lock:
+                    self.counters["blame_probes"] += 1
+                t = np.asarray(self.stepper.step(mask))
+            except Exception:  # noqa: BLE001
+                if len(group) == 1:
+                    blamed.append(group[0])
+                    return
+                half = len(group) // 2
+                probe(group[:half])
+                probe(group[half:])
+                return
+            for i in group:
+                got[i] = t[i]
+
+        probe(idxs)
+        if not got:
+            return None, blamed
+        toks = np.zeros(len(active), dtype=np.int64)
+        for i, v in got.items():
+            toks[i] = v
+        return toks, blamed
+
+    def _quarantine_locked(self, i):
+        """Send slot ``i`` to probation. Caller holds the lock."""
+        self.counters["quarantines"] += 1
+        self._quarantined[i] = self._sched_iters + self.quarantine_steps
+
+    def _fail_admission(self, i, req, exc):
+        """A begin_admit/prefill_chunk crash: fail the (attributable)
+        request typed and free the slot."""
+        with self._lock:
+            self.counters["prefill_failures"] += 1
+            if self._slots[i] is req:
+                self._evict(
+                    i,
+                    req,
+                    InternalError(f"prefill failed for this request: {exc!r}"),
+                )
 
     def _spend_prefill_budget(self) -> bool:
         """Advance mid-prefill slots, oldest admission first, spending
@@ -345,7 +497,12 @@ class ContinuousBatcher:
                 give = (
                     left if budget is None else min(left, budget - spent)
                 )
-            new_left = self.stepper.prefill_chunk(i, give)  # device work
+            try:
+                new_left = self.stepper.prefill_chunk(i, give)  # device work
+            except Exception as e:  # noqa: BLE001 — admission boundary
+                self._fail_admission(i, req, e)
+                progressed = True  # the queue can move into this slot now
+                continue
             now = time.monotonic()
             with self._lock:
                 if self._slots[i] is not req:
@@ -397,6 +554,8 @@ class ContinuousBatcher:
         self.stepper.release(slot_idx)
         if error is None:
             self.counters["completed"] += 1
+        elif isinstance(error, InternalError):
+            self.counters["internal_errors"] += 1
         else:
             self.counters["deadline_exceeded"] += 1
         req._finish(error)
@@ -410,21 +569,30 @@ class ContinuousBatcher:
             self._draining = True
         self._work.set()
 
-    def stop(self):
-        """Hard stop: fail everything still queued or in flight."""
+    def stop(self, error: ServingError | None = None):
+        """Hard stop: fail everything still queued or in flight.
+        ``error``: the typed failure handed to each pending request —
+        default ``EngineStoppedError`` (a deliberate shutdown); the
+        engine supervisor passes ``InternalError`` so requests aborted
+        by a scheduler crash/restart are distinguishable from a drain."""
+        proto = error if error is not None else EngineStoppedError(
+            "engine stopped"
+        )
+
+        def fail():  # per-request instance: tracebacks must not be shared
+            return type(proto)(*proto.args)
+
         with self._lock:
             self._draining = self._stopped = True
             while self._queue:
-                self._queue.popleft()._finish(
-                    EngineStoppedError("engine stopped")
-                )
+                self._queue.popleft()._finish(fail())
             self._prefill_left.clear()
             self._prefill_fifo.clear()
             for i, req in enumerate(self._slots):
                 if req is not None:
                     self._slots[i] = None
                     self.stepper.release(i)
-                    req._finish(EngineStoppedError("engine stopped"))
+                    req._finish(fail())
         self._work.set()
 
     # -- introspection ------------------------------------------------------
@@ -443,6 +611,7 @@ class ContinuousBatcher:
             out["queue_depth"] = len(self._queue)
             out["active_slots"] = active
             out["prefilling_slots"] = len(self._prefill_left)
+            out["quarantined_slots"] = len(self._quarantined)
             out["num_slots"] = len(self._slots)
             out["prefill_chunk"] = self.prefill_chunk
             out["draining"] = self._draining

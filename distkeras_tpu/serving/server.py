@@ -19,10 +19,15 @@ Verbs (header ``{"verb": ...}``):
   or ``stopping`` (drain in progress).
 - ``predict``: payload = (N, ...) feature rows; reply payload = the
   model's outputs (windowed-batched server-side).
-- ``health`` / ``stats``: JSON-only replies. ``stats`` carries the
-  scheduler counters (incl. prefill chunk/token counts and slot
-  lifecycle occupancy), the prefix-cache hit/miss/eviction state, the
-  compiled prefill/chunk buckets, and the live connection count.
+- ``health`` / ``stats``: JSON-only replies. ``health`` carries engine
+  liveness (``serving | degraded | draining``, heartbeat age,
+  quarantined slots, the supervisor's restart ledger) plus
+  ``max_frame_bytes`` so clients can self-limit. ``stats`` carries the
+  scheduler counters (incl. prefill chunk/token counts, slot lifecycle
+  occupancy, and the fault/recovery counters), the prefix-cache
+  hit/miss/eviction state, the compiled prefill/chunk buckets, and the
+  live connection count. ``overloaded`` error replies carry a
+  ``retry_after_ms`` backoff hint.
 - ``stop``: begins graceful shutdown — in-flight and queued requests
   complete, new ones are refused, then the listener closes.
 """
@@ -35,6 +40,7 @@ import time
 
 import numpy as np
 
+from distkeras_tpu import faults
 from distkeras_tpu.networking import recv_data, send_data
 from distkeras_tpu.serving.scheduler import ServingError
 from distkeras_tpu.utils.serialization import (
@@ -52,13 +58,18 @@ class ServingServer:
     ephemeral port (read it back from ``.port``)."""
 
     def __init__(self, engine, host="127.0.0.1", port=0, backlog=64,
-                 max_frame_bytes=64 << 20):
+                 max_frame_bytes=64 << 20, retry_after_ms=50.0):
         """``max_frame_bytes``: per-request frame cap enforced before
         buffering (the port accepts untrusted bytes; an unchecked
         length prefix is a one-client memory DoS). 64 MiB comfortably
-        covers prompts and predict feature batches."""
+        covers prompts and predict feature batches. It also rides the
+        ``health`` reply so well-behaved clients can self-limit before
+        sending. ``retry_after_ms``: the Retry-After-style hint stamped
+        on ``overloaded`` replies — clients with a ``RetryPolicy`` back
+        off by it instead of guessing."""
         self.engine = engine
         self.max_frame_bytes = int(max_frame_bytes)
+        self.retry_after_ms = float(retry_after_ms)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, int(port)))
@@ -69,6 +80,7 @@ class ServingServer:
         self._conns: set[socket.socket] = set()
         self._lock = threading.Lock()
         self._stopping = threading.Event()
+        self._shutdown_done = threading.Event()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -85,39 +97,51 @@ class ServingServer:
     def shutdown(self, drain=True):
         """Close the listener and stop the engine. ``drain=True`` lets
         queued and in-flight requests finish first (their connection
-        threads stay alive until the replies are flushed)."""
-        if self._stopping.is_set():
+        threads stay alive until the replies are flushed).
+
+        Idempotent AND awaitable: the ``stop`` verb runs shutdown on a
+        side thread, so a second caller (the owner's ``shutdown()``, a
+        ``with`` block's ``__exit__``) must not return while the first
+        is still draining — it waits for completion instead of racing
+        the teardown."""
+        with self._lock:
+            first = not self._stopping.is_set()
+            self._stopping.set()
+        if not first:
+            self._shutdown_done.wait(timeout=90)
             return
-        self._stopping.set()
         try:
-            self._sock.close()
-        except OSError:
-            pass
-        self.engine.stop(drain=drain)
-        with self._lock:
-            threads = list(self._conn_threads)
-        # short grace for threads flushing their last reply, then
-        # force-close the sockets of the rest — an idle persistent
-        # connection sits in recv_data forever and would otherwise
-        # stall shutdown and leak its thread
-        deadline = time.monotonic() + 5
-        for th in threads:
-            th.join(timeout=max(0.0, deadline - time.monotonic()))
-        with self._lock:
-            lingering = list(self._conns)
-        for conn in lingering:
             try:
-                conn.shutdown(socket.SHUT_RDWR)
+                self._sock.close()
             except OSError:
                 pass
-            try:
-                conn.close()
-            except OSError:
-                pass
-        for th in threads:
-            th.join(timeout=5)
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5)
+            self.engine.stop(drain=drain)
+            with self._lock:
+                threads = list(self._conn_threads)
+            # short grace for threads flushing their last reply, then
+            # force-close the sockets of the rest — an idle persistent
+            # connection sits in recv_data forever and would otherwise
+            # stall shutdown and leak its thread
+            deadline = time.monotonic() + 5
+            for th in threads:
+                th.join(timeout=max(0.0, deadline - time.monotonic()))
+            with self._lock:
+                lingering = list(self._conns)
+            for conn in lingering:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            for th in threads:
+                th.join(timeout=5)
+            if self._accept_thread is not None:
+                self._accept_thread.join(timeout=5)
+        finally:
+            self._shutdown_done.set()  # waiters must never hang on a crash
 
     def __enter__(self):
         return self.start()
@@ -163,10 +187,14 @@ class ServingServer:
                 frame = recv_data(conn, max_len=self.max_frame_bytes)
             except ValueError:
                 # oversized declared frame: the stream position is
-                # unrecoverable (bytes keep coming) — reply and close
+                # unrecoverable (bytes keep coming) — reply (marked
+                # ``fatal`` so the client knows the close that follows
+                # was deliberate and why) and close
                 try:
                     send_data(conn, pack_frame(
                         {"ok": False, "error": "frame_too_large",
+                         "fatal": True,
+                         "max_frame_bytes": self.max_frame_bytes,
                          "detail": f"limit {self.max_frame_bytes} bytes"}
                     ))
                 except (ConnectionError, OSError):
@@ -177,14 +205,20 @@ class ServingServer:
             try:
                 reply = self._dispatch(frame)
             except ServingError as e:
-                reply = pack_frame(
-                    {"ok": False, "error": e.code, "detail": str(e)}
-                )
+                header = {"ok": False, "error": e.code, "detail": str(e)}
+                if e.code == "overloaded":
+                    # Retry-After semantics: tell the client how long to
+                    # back off instead of letting the fleet guess
+                    header["retry_after_ms"] = self.retry_after_ms
+                reply = pack_frame(header)
             except Exception as e:  # noqa: BLE001 — wire boundary
                 reply = pack_frame(
                     {"ok": False, "error": "bad_request",
                      "detail": repr(e)}
                 )
+            act = faults.fire("server.reply", nbytes=len(reply))
+            if act == "drop":
+                return  # injected: vanish without replying (conn closes)
             try:
                 send_data(conn, reply)
             except (ConnectionError, OSError):
@@ -197,20 +231,24 @@ class ServingServer:
     def _dispatch(self, frame: bytes) -> bytes:
         header, payload = unpack_frame(frame)
         verb = header.get("verb")
+        faults.fire("server.dispatch", verb=verb)
         if verb == "generate":
             return self._generate(header, payload)
         if verb == "predict":
             return self._predict(payload)
         if verb == "health":
-            return pack_frame(
-                {
-                    "ok": True,
-                    "status": (
-                        "draining" if self._stopping.is_set() else "serving"
-                    ),
-                    "protocol": _PROTOCOL,
-                }
-            )
+            # engine liveness (serving|degraded|draining, heartbeat age,
+            # quarantine + restart ledger) plus the server's own limits,
+            # so clients can self-limit frame sizes before sending
+            h = {
+                "ok": True,
+                "protocol": _PROTOCOL,
+                "max_frame_bytes": self.max_frame_bytes,
+            }
+            h.update(self.engine.health())
+            if self._stopping.is_set():
+                h["status"] = "draining"
+            return pack_frame(h)
         if verb == "stats":
             stats = self.engine.stats()
             # server-level observability rides the same verb: scheduler

@@ -36,7 +36,6 @@ logger = logging.getLogger(__name__)
 
 from distkeras_tpu.ops.optimizers import effective_learning_rate, get_optimizer
 from distkeras_tpu.parallel.mesh import (
-    batch_sharding,
     host_gather,
     local_devices,
     make_mesh,
@@ -673,7 +672,6 @@ class SynchronousDistributedTrainer(Trainer):
             state = replicate(host_copy(self.model.state), self.mesh)
             opt_state = self._place_opt_state(core, params)
             rng = jax.random.PRNGKey(self.seed)
-        data_sh = batch_sharding(self.mesh)
         cols = [self.features_col, self.label_col]
 
         if self.device_resident:
@@ -684,14 +682,18 @@ class SynchronousDistributedTrainer(Trainer):
                 global_batch,
                 (params, state, opt_state, rng),
                 start_epoch,
-                data_sh,
             )
+
+        # windows stack to (W, B, ...): leave the window axis whole, shard
+        # the batch axis. Constructed directly — NamedSharding.update(spec=)
+        # was removed from JAX.
+        win_sh = NamedSharding(self.mesh, P(None, "data"))
 
         def prepare(batches):
             # host staging (prefetch thread): batch shards along "data"
             xs, ys = stack_window(batches, self.features_col, self.label_col)
-            xs = jax.device_put(xs, data_sh.update(spec=(None, "data")))
-            ys = jax.device_put(ys, data_sh.update(spec=(None, "data")))
+            xs = jax.device_put(xs, win_sh)
+            ys = jax.device_put(ys, win_sh)
             return xs, ys
 
         def run_window(carry, prepared):
@@ -725,7 +727,7 @@ class SynchronousDistributedTrainer(Trainer):
         return self._finish(params, state)
 
     def _train_resident(
-        self, dataset, shuffle, core, global_batch, carry, start_epoch, data_sh
+        self, dataset, shuffle, core, global_batch, carry, start_epoch
     ):
         """HBM-resident sync-DP epochs: the dataset is replicated into every
         chip's HBM once; per window the host ships only the (W, B_global)
@@ -743,7 +745,7 @@ class SynchronousDistributedTrainer(Trainer):
             repl = replicated_sharding(self.mesh)
             data_x = jax.device_put(data_x, repl)
             data_y = jax.device_put(data_y, repl)
-        idx_sh = data_sh.update(spec=(None, "data"))
+        idx_sh = NamedSharding(self.mesh, P(None, "data"))
 
         for epoch in range(start_epoch, self.num_epoch):
             for idx_host in epoch_index_windows(
@@ -1792,7 +1794,17 @@ class DistributedTrainer(Trainer):
     def allocate_worker(self, core, worker_id, device) -> AsyncWorker:
         ps = self.parameter_server
         if self.remote_ps:
-            ps = RemoteParameterServerClient("127.0.0.1", self.service.port)
+            # the retry policy paces reconnect() redials: a worker retry
+            # often races the PS host's own restart, and one refused
+            # connection must not burn the whole worker_retries attempt
+            # (same backoff implementation the serving client uses)
+            from distkeras_tpu.networking import RetryPolicy
+
+            ps = RemoteParameterServerClient(
+                "127.0.0.1", self.service.port,
+                retry=RetryPolicy(max_attempts=5, base_delay=0.05,
+                                  budget=30.0),
+            )
         w = self.worker_cls(
             core,
             ps,

@@ -779,7 +779,7 @@ class AsyncWorker:
         self._resident = None
         self._resident_n = 0
 
-    def reset_for_retry(self):
+    def reset_for_retry(self, retry=None):
         """Restart this worker's training after a failure: from its resume
         restore point when it has one, else from scratch.
 
@@ -788,7 +788,13 @@ class AsyncWorker:
         deduplicated — the retry cannot double-apply work (the reference's
         Spark-retry double-absorb weakness, SURVEY §5.3). After a resume the
         scratch seqs may predate the restored dedup table's window, so the
-        retry goes back to the restore point instead."""
+        retry goes back to the restore point instead.
+
+        ``retry``: optional ``networking.RetryPolicy`` for the PS redial —
+        the shared backoff implementation (the serving client uses the
+        same one), for the case where the PS host is itself mid-restart
+        when this worker comes back. A remote PS client constructed with
+        its own policy already redials under it."""
         self.records = []
         self.timings = []
         self._pending = None
@@ -803,7 +809,11 @@ class AsyncWorker:
             self._opt_state = None
             self._q_residual = None
         if hasattr(self.ps, "reconnect"):
-            self.ps.reconnect()  # a crashed socket stream may be desynced
+            # a crashed socket stream may be desynced — always redial
+            if retry is not None:
+                retry.call(self.ps.reconnect)
+            else:
+                self.ps.reconnect()
 
     # -- worker-local checkpoint/resume --------------------------------------
 

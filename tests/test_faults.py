@@ -1,0 +1,730 @@
+"""Chaos suite: deterministic fault injection against the self-healing
+serving runtime (``distkeras_tpu/faults.py`` + the recovery machinery
+it flushes out).
+
+Everything here is seeded and COUNTED, never timed-and-hoped: faults
+fire on exact events (``times``/``after``/``when``), recovery is
+asserted by outcome (typed errors, token-identical survivors, restart
+ledgers), and no injected delay exceeds 0.5 s. Four tiers:
+
+- ``FaultPlan`` / ``RetryPolicy`` units (no JAX, no sockets);
+- scheduler blame units against a poisonable fake stepper;
+- real-engine chaos: poison requests, watchdog restarts, degraded
+  mode, prefix-store fetch failures — the acceptance scenarios;
+- wire chaos through the real TCP server: reply drops, resets,
+  truncated/corrupted frames, overloaded bursts, frame_too_large.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import faults
+from distkeras_tpu.faults import FaultPlan, InjectedFault
+from distkeras_tpu.networking import RetryPolicy
+from distkeras_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    InternalError,
+    ServeRequest,
+    ServingError,
+)
+
+from test_serving import FakeStepper
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """A chaos test that leaks an active plan would poison every test
+    after it — fail loudly and clean up."""
+    yield
+    leaked = faults._ACTIVE
+    if leaked is not None:
+        leaked.deactivate()
+        pytest.fail("test leaked an active FaultPlan")
+
+
+# ------------------------------------------------------------ plan units
+
+
+def test_fire_disarmed_is_noop():
+    assert faults.fire("stepper.step") is None
+    assert faults.fire("net.send", nbytes=4) is None
+
+
+def test_plan_times_after_and_counters():
+    plan = FaultPlan(seed=0).arm(
+        "stepper.step", exc=RuntimeError("boom"), times=2, after=1
+    )
+    with plan:
+        assert faults.fire("stepper.step") is None  # after: first passes
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="boom"):
+                faults.fire("stepper.step")
+        assert faults.fire("stepper.step") is None  # times exhausted
+    assert plan.fired("stepper.step") == 2
+    assert plan.fired() == 2
+    assert faults.fire("stepper.step") is None  # deactivated on exit
+
+
+def test_plan_when_predicate_and_default_exc():
+    plan = FaultPlan().arm(
+        "stepper.step", when=lambda ctx: ctx.get("active", [False])[0],
+        times=None,
+    )
+    with plan:
+        assert faults.fire("stepper.step", active=[False, True]) is None
+        for _ in range(3):  # times=None keeps firing on every match
+            with pytest.raises(InjectedFault):
+                faults.fire("stepper.step", active=[True, False])
+    assert plan.fired() == 3
+
+
+def test_plan_delay_action_sleeps_and_returns():
+    plan = FaultPlan().arm("stepper.step", action="delay", delay=0.05)
+    with plan:
+        t0 = time.monotonic()
+        assert faults.fire("stepper.step") == "delay"
+        assert time.monotonic() - t0 >= 0.05
+
+
+def test_plan_validates_sites_actions_and_nesting():
+    plan = FaultPlan()
+    with pytest.raises(ValueError, match="unknown fault site"):
+        plan.arm("no.such.seam")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        plan.arm("stepper.step", action="explode")
+    with pytest.raises(ValueError, match="times"):
+        plan.arm("stepper.step", times=0)
+    with plan:
+        with pytest.raises(RuntimeError, match="already active"):
+            FaultPlan().activate()
+        plan.activate()  # re-activating the active plan is fine
+
+
+def test_plan_probability_is_seeded_deterministic():
+    def draw(seed):
+        plan = FaultPlan(seed=seed).arm(
+            "stepper.step", action="delay", delay=0.0,
+            probability=0.5, times=None,
+        )
+        with plan:
+            return [
+                faults.fire("stepper.step") is not None for _ in range(32)
+            ]
+
+    assert draw(7) == draw(7)  # same seed, same chaos
+    assert draw(7) != draw(8)  # different seed, different schedule
+
+
+# ----------------------------------------------------------- retry policy
+
+
+def test_retry_policy_delay_schedule_and_hint():
+    rp = RetryPolicy(base_delay=0.1, max_delay=1.0, seed=0)
+    for attempt in range(6):
+        cap = min(1.0, 0.1 * (2 ** attempt))
+        for _ in range(8):
+            assert 0.0 <= rp.delay(attempt) <= cap
+    assert rp.delay(0, hint=0.3) == 0.3  # server hint wins
+    assert rp.delay(0, hint=99.0) == 1.0  # ...capped at max_delay
+    a = RetryPolicy(seed=3)
+    b = RetryPolicy(seed=3)
+    assert [a.delay(i) for i in range(5)] == [b.delay(i) for i in range(5)]
+
+
+def test_retry_policy_call_retries_then_succeeds():
+    calls = []
+    seen = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("nope")
+        return "ok"
+
+    rp = RetryPolicy(max_attempts=5, base_delay=0.001, seed=0)
+    out = rp.call(flaky, on_retry=lambda e, n, d: seen.append((n, d)))
+    assert out == "ok" and len(calls) == 3
+    assert [n for n, _ in seen] == [1, 2]
+
+
+def test_retry_policy_exhausts_attempts_and_budget():
+    rp = RetryPolicy(max_attempts=3, base_delay=0.001, seed=0)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        rp.call(always)
+    assert len(calls) == 3  # max_attempts = total invocations
+    # a zero budget refuses to sleep at all: one attempt, then raise
+    rp0 = RetryPolicy(max_attempts=10, base_delay=0.5, budget=0.0, seed=0)
+    calls.clear()
+    with pytest.raises(ConnectionError):
+        rp0.call(always)
+    assert len(calls) == 1
+    # errors outside retry_on pass straight through
+    with pytest.raises(ValueError):
+        RetryPolicy(seed=0).call(lambda: (_ for _ in ()).throw(ValueError()))
+
+
+# ------------------------------------------------- scheduler blame units
+
+
+class PoisonStepper(FakeStepper):
+    """Fake stepper whose ``step`` raises whenever a designated poison
+    slot is in the active mask — the deterministic stand-in for a
+    request whose numerics blow up the device step."""
+
+    def __init__(self, poison_slot, **kw):
+        super().__init__(**kw)
+        self.poison_slot = poison_slot
+        self.step_calls = []
+
+    def step(self, active):
+        self.step_calls.append(list(np.flatnonzero(active)))
+        if self.poison_slot is not None and active[self.poison_slot]:
+            raise RuntimeError("poisoned step")
+        return super().step(active)
+
+
+def _drain(b, reqs, limit=200):
+    steps = 0
+    while not all(r.done for r in reqs):
+        b.step()
+        steps += 1
+        assert steps < limit, "scheduler made no progress"
+    return steps
+
+
+def test_blame_newest_admission_masked_first():
+    """Established streams decoding, a poison request arrives: the step
+    failure is blamed on the newest admission via ONE masked retry, the
+    poison fails typed, survivors advance exactly one token per
+    iteration (their streams match a poison-free run token for token)."""
+    st = PoisonStepper(None, num_slots=3)
+    b = ContinuousBatcher(st, queue_capacity=8)
+    good = [b.submit(ServeRequest([1, 2], 6)) for _ in range(2)]
+    b.step()  # goods take slots 0, 1 and decode their first token
+    st.poison_slot = 2
+    bad = b.submit(ServeRequest([9, 9, 9], 6))
+    _drain(b, good + [bad])
+    with pytest.raises(InternalError, match="blamed"):
+        bad.result()
+    # survivors: uninterrupted per-slot streams (base + slot*100 + n)
+    assert good[0].result().tolist() == [1, 2] + [1001 + i for i in range(6)]
+    assert good[1].result().tolist() == [1, 2] + [1101 + i for i in range(6)]
+    s = b.stats()
+    assert s["step_failures"] == 1
+    assert s["blame_probes"] == 1  # one masked retry, no bisect needed
+    assert s["internal_errors"] == 1
+    assert s["quarantines"] == 1
+
+
+def test_blame_bisects_when_suspect_is_innocent():
+    """The poison is the OLDEST admission, so the newest-masked retry
+    fails too and bisection isolates the real culprit; the innocent
+    newest stream still completes with its exact token stream."""
+    st = PoisonStepper(0, num_slots=3)
+    b = ContinuousBatcher(st, queue_capacity=8)
+    bad = b.submit(ServeRequest([9, 9], 6))  # slot 0 = oldest
+    good = [b.submit(ServeRequest([1, 2], 6)) for _ in range(2)]
+    _drain(b, [bad] + good)
+    with pytest.raises(InternalError):
+        bad.result()
+    assert good[0].result().tolist() == [1, 2] + [1101 + i for i in range(6)]
+    assert good[1].result().tolist() == [1, 2] + [1201 + i for i in range(6)]
+    s = b.stats()
+    assert s["blame_probes"] >= 3  # masked retry + bisect probes
+    assert s["internal_errors"] == 1
+
+
+def test_blame_solo_active_slot_by_elimination():
+    st = PoisonStepper(0, num_slots=2)
+    b = ContinuousBatcher(st, queue_capacity=4)
+    bad = b.submit(ServeRequest([5], 4))
+    b.step()
+    with pytest.raises(InternalError):
+        bad.result()
+    assert b.stats()["blame_probes"] == 0  # no probes: alone = culpable
+
+
+def test_quarantined_slot_sits_out_then_recycles():
+    st = PoisonStepper(None, num_slots=1)
+    b = ContinuousBatcher(st, queue_capacity=8, quarantine_steps=5)
+    st.poison_slot = 0
+    bad = b.submit(ServeRequest([7, 7], 4))
+    b.step()
+    assert bad.done and b.stats()["quarantined_slots"] == 1
+    st.poison_slot = None
+    nxt = b.submit(ServeRequest([1, 2], 2))
+    for _ in range(3):  # probation: the only slot stays out of the pool
+        b.step()
+    assert not nxt.done and st.admitted[-1][1] == [7, 7]
+    _drain(b, [nxt])  # probation expires, slot recycles, request runs
+    assert nxt.result().tolist() == [1, 2, 1001, 1002]
+    assert b.stats()["quarantined_slots"] == 0
+
+
+def test_prefill_failure_is_attributed_not_fatal():
+    class PoisonPrefill(FakeStepper):
+        def begin_admit(self, slot, prompt):
+            if list(np.asarray(prompt)) == [6, 6, 6]:
+                raise RuntimeError("poison prompt")
+            return super().begin_admit(slot, prompt)
+
+    st = PoisonPrefill(num_slots=2)
+    b = ContinuousBatcher(st, queue_capacity=8)
+    good = b.submit(ServeRequest([1, 2], 3))
+    bad = b.submit(ServeRequest([6, 6, 6], 3))
+    _drain(b, [good, bad])
+    with pytest.raises(InternalError, match="prefill failed"):
+        bad.result()
+    assert good.result().tolist() == [1, 2, 1001, 1002, 1003]
+    s = b.stats()
+    assert s["prefill_failures"] == 1 and s["quarantines"] == 0
+
+
+def test_mid_prefill_chunk_failure_is_attributed():
+    class FlakyChunk(FakeStepper):
+        def prefill_chunk(self, slot, budget):
+            # the long prompt's third chunk call crashes (the shared
+            # per-iteration budget walks it 10 -> 7 -> 3 remaining);
+            # the short prompt (1 position) never reaches 3
+            if self._left[slot] == 3:
+                raise RuntimeError("chunk crash")
+            return super().prefill_chunk(slot, budget)
+
+    st = FlakyChunk(num_slots=2, max_len=64)
+    b = ContinuousBatcher(st, queue_capacity=8, prefill_chunk=4)
+    good = b.submit(ServeRequest([1, 2], 3))
+    bad = b.submit(ServeRequest(np.arange(1, 12), 3))  # 10 prefill positions
+    _drain(b, [good, bad])
+    with pytest.raises(InternalError, match="prefill failed"):
+        bad.result()
+    assert good.result().tolist() == [1, 2, 1001, 1002, 1003]
+    assert b.stats()["prefill_failures"] == 1
+
+
+# ------------------------------------------------------ real-engine chaos
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_ref(lm):
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+
+    return CachedSequenceGenerator(lm)
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_poison_generate_fails_alone_streams_token_identical(lm, lm_ref):
+    """ACCEPTANCE: a poison generate request fails alone with
+    ``InternalError`` while the concurrent streams' outputs stay
+    token-identical to their solo ``CachedSequenceGenerator`` decode."""
+    from distkeras_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 61, n).astype(np.int32) for n in (4, 7)]
+    refs = [lm_ref.generate(p[None], steps=10)[0] for p in prompts]
+    eng = ServingEngine(
+        lm, num_slots=3, prefix_cache=False, watchdog_interval=30.0
+    ).start()
+    plan = FaultPlan().arm(
+        "stepper.step", times=None,
+        when=lambda ctx: bool(ctx["active"][2]),  # fires iff poison active
+    )
+    try:
+        goods = [eng.submit(p, 10) for p in prompts]  # slots 0 and 1
+        _wait(
+            lambda: eng.stats()["active_slots"] == 2,
+            msg="good streams admitted",
+        )
+        with plan:
+            bad = eng.submit(rng.integers(0, 61, 5).astype(np.int32), 10)
+            with pytest.raises(InternalError, match="blamed"):
+                bad.result(timeout=60)
+            for req, want in zip(goods, refs):
+                np.testing.assert_array_equal(req.result(timeout=60), want)
+        assert plan.fired("stepper.step") >= 1
+        st = eng.stats()
+        assert st["internal_errors"] == 1
+        assert st["quarantines"] == 1
+        assert st["status"] == "serving"  # the engine never went down
+    finally:
+        eng.stop()
+
+
+def test_watchdog_restarts_dead_scheduler(lm, lm_ref):
+    """ACCEPTANCE: a killed scheduler thread is detected and restarted
+    within the watchdog interval; pre-crash in-flight requests fail
+    TYPED (none hung); post-restart traffic decodes correctly."""
+    from distkeras_tpu.serving import ServingEngine
+
+    prompt = np.arange(1, 6, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=6)[0]
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False,
+        # grace 30: wedge detection effectively off — this test targets
+        # DEAD-thread detection, which is poll-based and never graced
+        # (a contended 1-core box can stretch compiles past any small
+        # grace and fake a wedge)
+        watchdog_interval=0.3, watchdog_grace=30.0,
+        max_restarts=3, restart_backoff=0.01,
+    ).start()
+    # a 0.02 s step throttle keeps the stream mid-decode deterministically;
+    # the crash seam fires on the 6th busy loop iteration (mid-stream, not
+    # racing the submit or the completion)
+    plan = (
+        FaultPlan()
+        .arm("stepper.step", action="delay", delay=0.02, times=None)
+        .arm("scheduler.loop", times=1, after=5,
+             when=lambda ctx: ctx["busy"])
+    )
+    try:
+        with plan:
+            inflight = eng.submit(prompt, 20)
+            with pytest.raises(InternalError, match="scheduler crashed"):
+                inflight.result(timeout=10)  # failed typed, never hung
+            assert 0 < len(inflight.tokens) < 20  # it WAS mid-decode
+            _wait(
+                lambda: eng.health()["status"] == "serving"
+                and eng.health()["restarts"] == 1,
+                msg="supervisor restart",
+            )
+            h = eng.health()
+            assert h["watchdog_trips"] == 1 and h["restarts"] == 1
+            assert h["heartbeat_age"] < 0.3
+            # the rebuilt stepper serves fresh traffic, token-identical
+            np.testing.assert_array_equal(eng.generate(prompt, 6), ref)
+    finally:
+        eng.stop()
+
+
+def test_watchdog_detects_wedged_scheduler(lm, lm_ref):
+    """A scheduler thread stuck in a 0.45 s stall (not dead — wedged)
+    trips the heartbeat watchdog: in-flight work fails typed, a fresh
+    generation takes over, and the abandoned zombie exits on wake."""
+    from distkeras_tpu.serving import ServingEngine
+
+    prompt = np.arange(2, 7, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=5)[0]
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False,
+        # grace 30 disarms wedge detection while compiles run (timing
+        # on a contended core is not the test's subject); the test ends
+        # the grace EXPLICITLY once the programs are warm
+        watchdog_interval=0.15, watchdog_grace=30.0,
+        max_restarts=2, restart_backoff=0.01,
+    ).start()
+    try:
+        # prewarm fault-free (compiles the admit bucket + step), then
+        # end the launch grace so the wedge detector is live
+        np.testing.assert_array_equal(eng.generate(prompt, 5), ref)
+        eng._grace_until = 0.0
+        plan = (
+            FaultPlan()
+            .arm("stepper.step", action="delay", delay=0.02, times=None)
+            .arm("scheduler.loop", action="delay", delay=0.45, times=1,
+                 after=3, when=lambda ctx: ctx["busy"])
+        )
+        with plan:
+            inflight = eng.submit(prompt, 20)
+            with pytest.raises(InternalError, match="wedged"):
+                inflight.result(timeout=10)
+            assert 0 < len(inflight.tokens) < 20  # wedged mid-decode
+            _wait(
+                lambda: eng.health()["status"] == "serving"
+                and eng.health()["restarts"] == 1,
+                msg="wedge recovery",
+            )
+            np.testing.assert_array_equal(eng.generate(prompt, 5), ref)
+        old_threads = [
+            t for t in threading.enumerate()
+            if t.name == "serving-engine"
+        ]
+        assert len(old_threads) == 1  # the zombie exited after waking
+    finally:
+        eng.stop()
+
+
+def test_restart_budget_exhausts_to_degraded(lm):
+    from distkeras_tpu.serving import ServingEngine
+
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False,
+        watchdog_interval=0.2, max_restarts=1, restart_backoff=0.01,
+    ).start()
+    plan = FaultPlan().arm("scheduler.loop", times=None)  # crash forever
+    try:
+        with plan:
+            _wait(
+                lambda: eng.health()["restart_budget_exhausted"],
+                msg="budget exhaustion",
+            )
+        h = eng.health()
+        assert h["status"] == "degraded" and h["restarts"] == 1
+        with pytest.raises(InternalError, match="budget exhausted"):
+            eng.submit(np.arange(1, 4), 4)
+        assert eng.stats()["status"] == "degraded"
+    finally:
+        eng.stop()
+
+
+def test_prefix_fetch_failure_degrades_to_miss(lm, lm_ref):
+    """A broken prefix store must cost correctness NOTHING: lookups
+    that raise degrade to misses, the prefill recomputes everything,
+    and the output pins to the solo decode."""
+    from distkeras_tpu.serving import PrefixStore
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    store = PrefixStore(max_bytes=8 << 20)
+    st = DecodeStepper(lm, num_slots=1, prefix_cache=store)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 61, 17).astype(np.int32)
+    ref = lm_ref.generate(prompt[None], steps=5)[0]
+    plan = FaultPlan().arm("prefix_cache.fetch", times=None)
+    with plan:
+        for _ in range(2):  # miss-twice traffic that would normally insert
+            st.admit(0, prompt)
+            out = []
+            for _ in range(5):
+                out.append(int(st.step(np.array([True]))[0]))
+            assert out == ref[17:].tolist()
+            st.release(0)
+    assert st.prefix_fetch_failures >= 2
+    assert plan.fired("prefix_cache.fetch") >= 2
+
+
+def test_slow_step_delays_but_serves(lm, lm_ref):
+    """A slow device step (within the watchdog budget) is latency, not
+    failure: no trips, no restarts, correct output."""
+    from distkeras_tpu.serving import ServingEngine
+
+    prompt = np.arange(3, 8, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=4)[0]
+    eng = ServingEngine(lm, num_slots=2, prefix_cache=False).start()
+    plan = FaultPlan().arm(
+        "stepper.step", action="delay", delay=0.2, times=1
+    )
+    try:
+        with plan:
+            np.testing.assert_array_equal(eng.generate(prompt, 4), ref)
+        h = eng.health()
+        assert h["watchdog_trips"] == 0 and h["restarts"] == 0
+        assert h["status"] == "serving"
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------- wire chaos
+
+
+@pytest.fixture()
+def served(lm):
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(
+        lm, num_slots=4, queue_capacity=16, prefix_cache=False
+    )
+    srv = ServingServer(eng).start()
+    yield srv
+    srv.shutdown()
+
+
+def _retry_client(srv, **kw):
+    from distkeras_tpu.serving import ServingClient
+
+    kw.setdefault("retry", RetryPolicy(base_delay=0.01, seed=0))
+    return ServingClient("127.0.0.1", srv.port, **kw)
+
+
+def test_client_survives_dropped_reply(lm_ref, served):
+    """ACCEPTANCE (reset, server side): the server vanishes without
+    replying and closes the connection; the default-retry client
+    reconnects, re-sends, and the caller never sees an error."""
+    prompt = np.arange(1, 5, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=6)[0]
+    plan = FaultPlan().arm("server.reply", action="drop", times=1)
+    with _retry_client(served) as c, plan:
+        np.testing.assert_array_equal(c.generate(prompt, 6), ref)
+    assert plan.fired("server.reply") == 1
+
+
+def test_client_survives_injected_connection_reset(lm_ref, served):
+    """ACCEPTANCE (reset, client side): the client's own send dies
+    mid-frame with a connection reset; retry reconnects and re-sends."""
+    prompt = np.arange(2, 6, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=6)[0]
+    plan = FaultPlan().arm("net.send", action="reset", times=1)
+    with _retry_client(served) as c:
+        with plan:
+            np.testing.assert_array_equal(c.generate(prompt, 6), ref)
+        assert plan.fired("net.send") == 1
+        assert c.health()["status"] == "serving"  # server unharmed
+
+
+def test_client_survives_truncated_frame(lm_ref, served):
+    """A frame that dies half-sent (FIN mid-message) is a clean retry
+    for the client and a quiet connection close for the server."""
+    prompt = np.arange(3, 7, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=5)[0]
+    plan = FaultPlan().arm("net.send", action="truncate", times=1)
+    with _retry_client(served) as c:
+        with plan:
+            np.testing.assert_array_equal(c.generate(prompt, 5), ref)
+        assert c.health()["status"] == "serving"
+
+
+def test_corrupted_frame_gets_bad_request_conn_survives(lm_ref, served):
+    """A corrupted request frame earns a typed ``bad_request`` reply —
+    the connection (and the server) keep working."""
+    from distkeras_tpu.serving import ServingClient
+
+    prompt = np.arange(1, 6, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=4)[0]
+    plan = FaultPlan().arm("net.send", action="corrupt", times=1)
+    with ServingClient("127.0.0.1", served.port, retry=False) as c:
+        with plan:
+            with pytest.raises(ServingError) as ei:
+                c.generate(prompt, 4)
+            assert ei.value.code == "bad_request"
+        # same connection, next frame is fine
+        np.testing.assert_array_equal(c.generate(prompt, 4), ref)
+
+
+def test_client_survives_overloaded_burst(lm, lm_ref):
+    """ACCEPTANCE: a burst against a 1-slot, 1-deep-queue server drives
+    real ``overloaded`` rejections, and every default-retry client
+    still completes without a caller-visible error (backing off by the
+    server's retry_after hint)."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(lm, num_slots=1, queue_capacity=1,
+                        prefix_cache=False)
+    srv = ServingServer(eng, retry_after_ms=30.0).start()
+    try:
+        prompt = np.arange(1, 4, dtype=np.int32)
+        ref = lm_ref.generate(prompt[None], steps=8)[0]
+        # saturate DETERMINISTICALLY before any client sends: one
+        # request holds the only slot (its first-compile makes that a
+        # wide window), one fills the one-deep queue — the burst's
+        # first wave is guaranteed to see ``overloaded``
+        blocker = eng.submit(prompt, 8)
+        _wait(lambda: eng.stats()["active_slots"] == 1, msg="slot busy")
+        queued = eng.submit(prompt, 8)
+        n = 6
+        barrier = threading.Barrier(n)
+        results = [None] * n
+        errors = []
+
+        def worker(i):
+            policy = RetryPolicy(
+                max_attempts=40, base_delay=0.01, budget=90.0, seed=i
+            )
+            try:
+                with _retry_client(srv, retry=policy) as c:
+                    barrier.wait()
+                    results[i] = c.generate(prompt, 8)
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+
+        ths = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert not errors, errors
+        for got in results:
+            np.testing.assert_array_equal(got, ref)
+        for req in (blocker, queued):
+            np.testing.assert_array_equal(req.result(timeout=60), ref)
+        assert eng.stats()["rejected_overloaded"] > 0  # the burst was real
+    finally:
+        srv.shutdown()
+
+
+def test_frame_too_large_is_typed_and_health_carries_limit(lm):
+    """Satellite: an oversized frame earns the typed ``frame_too_large``
+    reply on the call itself (not a bare ConnectionError later), and
+    ``health`` advertises ``max_frame_bytes`` so clients can self-limit."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(lm, num_slots=1, prefix_cache=False)
+    srv = ServingServer(eng, max_frame_bytes=1 << 16).start()
+    try:
+        with _retry_client(srv) as c:
+            h = c.health()
+            assert h["max_frame_bytes"] == 1 << 16
+            assert c.max_frame_bytes == 1 << 16
+            big = np.zeros((300, 128), np.float32)  # ~150 KiB > 64 KiB cap
+            with pytest.raises(ServingError) as ei:
+                c.predict(big)
+            assert ei.value.code == "frame_too_large"
+            # the client reconnects transparently afterwards
+            assert c.health()["status"] == "serving"
+    finally:
+        srv.shutdown()
+
+
+def test_health_reports_self_healing_fields(lm, served):
+    with _retry_client(served) as c:
+        h = c.health()
+        assert h["status"] == "serving"
+        assert h["restarts"] == 0 and h["watchdog_trips"] == 0
+        assert h["quarantined_slots"] == 0
+        assert h["heartbeat_age"] is not None
+        assert h["max_frame_bytes"] == 64 << 20
+        st = c.stats()
+        for key in ("step_failures", "blame_probes", "internal_errors",
+                    "prefill_failures", "quarantines",
+                    "quarantined_slots", "restarts", "watchdog_trips"):
+            assert st[key] == 0, key
+        assert st["status"] == "serving"
+
+
+# ------------------------------------------------------------- soak smoke
+
+
+def test_soak_serving_smoke(lm):
+    """The chaos soak harness runs end to end at smoke scale and meets
+    its own acceptance bar: zero hung requests, zero non-typed errors."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import soak_serving
+    finally:
+        sys.path.pop(0)
+    summary = soak_serving.run_soak(
+        model=lm, clients=3, duration=2.0, seed=0, fault_every=5,
+    )
+    assert summary["hung"] == 0
+    assert summary["untyped_errors"] == 0
+    assert summary["completed"] > 0
+    assert summary["faults_fired"] > 0

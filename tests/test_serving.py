@@ -657,8 +657,11 @@ def test_server_generate_eos_trims(lm, lm_ref, served):
 def test_server_replies_overloaded_under_saturation(lm, lm_ref):
     """Acceptance: with one slot and a one-deep queue, a burst of
     concurrent requests gets explicit ``overloaded`` replies for the
-    overflow while the admitted ones complete correctly."""
-    from distkeras_tpu.serving import ServingEngine, ServingServer
+    overflow while the admitted ones complete correctly. Clients run
+    with ``retry=False`` — this test observes the RAW backpressure
+    contract (the default RetryPolicy would absorb the rejections;
+    that behavior is pinned in test_faults.py)."""
+    from distkeras_tpu.serving import ServingClient, ServingEngine, ServingServer
 
     eng = ServingEngine(lm, num_slots=1, queue_capacity=1)
     srv = ServingServer(eng).start()
@@ -670,7 +673,7 @@ def test_server_replies_overloaded_under_saturation(lm, lm_ref):
         outcomes = [None] * n
 
         def worker(i):
-            with _client(srv) as c:
+            with ServingClient("127.0.0.1", srv.port, retry=False) as c:
                 barrier.wait()
                 try:
                     outcomes[i] = c.generate(prompt, 12)
@@ -789,6 +792,66 @@ def test_graceful_shutdown_completes_in_flight(lm, lm_ref):
     with pytest.raises(ServingError):
         eng.generate(prompts[0], 4)
     srv.shutdown()
+
+
+def test_stop_verb_races_direct_shutdown(lm, lm_ref):
+    """Shutdown-race satellite: the ``stop`` verb's side-thread
+    ``shutdown()`` racing the owner's direct ``shutdown()`` call, with
+    a generate still in flight — the in-flight request must complete
+    (drain semantics), both shutdown paths must return, and neither may
+    return while the other is still tearing down (the second caller
+    WAITS instead of racing)."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(lm, num_slots=2, queue_capacity=8)
+    srv = ServingServer(eng).start()
+    prompt = np.arange(1, 5, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=10)[0]
+    result = [None]
+
+    def worker():
+        with _client(srv) as c:
+            result[0] = c.generate(prompt, 10)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    # wait until the request is actually in flight server-side
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = eng.stats()
+        if st["active_slots"] + st["queue_depth"] >= 1:
+            break
+        time.sleep(0.005)
+    with _client(srv) as c:
+        assert c.stop()["stopping"]  # side-thread shutdown begins
+    srv.shutdown()  # races the side thread; must WAIT for completion
+    # by the time the direct call returned, teardown is really done:
+    # engine refuses work and no connection threads are left
+    with pytest.raises(EngineStoppedError):
+        eng.generate(prompt, 2)
+    assert not any(t.is_alive() for t in srv._conn_threads)
+    th.join(timeout=60)
+    assert not th.is_alive()
+    np.testing.assert_array_equal(result[0], ref)  # drained, not failed
+
+
+def test_double_shutdown_is_idempotent(lm):
+    """Shutdown-race satellite: ``shutdown()`` twice (and once more via
+    the context manager's ``__exit__``) is safe, and the repeat returns
+    only after the first teardown completed — no exceptions, no
+    half-dead server state, engine ``stop`` also re-entrant."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(lm, num_slots=1)
+    with ServingServer(eng) as srv:
+        srv.shutdown()
+        t0 = time.monotonic()
+        srv.shutdown()  # second call: waits/returns, never raises
+        assert time.monotonic() - t0 < 5
+        assert srv._shutdown_done.is_set()
+    # the with-exit above was shutdown call #3; engine stop is also
+    # re-entrant on an already-stopped engine
+    eng.stop()
 
 
 def test_engine_from_bundle_and_non_lm_predict_only(tmp_path):

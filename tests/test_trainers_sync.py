@@ -271,7 +271,9 @@ def test_single_trainer_adam_and_callable_loss():
         "adam",
         categorical_crossentropy,
         batch_size=64,
-        num_epoch=2,
+        # 2 epochs sits exactly at the convergence knee for this init
+        # trajectory (~0.83 on current JAX); 4 clears the gate with margin
+        num_epoch=4,
         label_col="label_onehot",
     )
     trained = t.train(train)
@@ -608,7 +610,6 @@ def test_zero_shard_opt_state_stays_sharded_through_window():
 
     from distkeras_tpu.ops.optimizers import get_optimizer
     from distkeras_tpu.parallel.mesh import (
-        batch_sharding,
         make_mesh,
         replicate,
         shard_opt_state_zero,
@@ -629,8 +630,11 @@ def test_zero_shard_opt_state_stays_sharded_through_window():
     train, _ = make_data(n=512)
     xs = np.stack([train["features"][:64].reshape(64, -1)])
     ys = np.stack([train["label_onehot"][:64]])
-    xs = jax.device_put(xs, batch_sharding(mesh).update(spec=(None, "data")))
-    ys = jax.device_put(ys, batch_sharding(mesh).update(spec=(None, "data")))
+    win_sh = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(None, "data")
+    )
+    xs = jax.device_put(xs, win_sh)
+    ys = jax.device_put(ys, win_sh)
 
     p2, s2, opt2, rng2, _m = core.window(params, state, opt_state, rng, xs, ys)
     before = jax.tree.leaves(opt_state)

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Chaos soak for the serving tier: N concurrent clients against a
+fault-armed server for a fixed wall-clock.
+
+The acceptance bar it asserts (and prints as JSON):
+
+- ZERO hung requests — every client thread exits within its join
+  budget; nothing blocks forever on a dead scheduler or a dropped
+  reply;
+- ZERO non-typed errors — every failure a caller sees is a
+  ``ServingError`` subclass (``overloaded`` bursts and connection
+  resets are absorbed by the default ``RetryPolicy``; blamed poison
+  steps and supervisor restarts surface as ``internal``);
+- ZERO corrupt outputs — every successful generate is token-identical
+  to its solo ``CachedSequenceGenerator`` reference, restarts and
+  quarantines notwithstanding.
+
+The fault mix is seeded (``FaultPlan`` draws probabilistic seams from
+its own RNG), so a failing soak replays exactly with the same seed::
+
+    python tools/soak_serving.py --clients 4 --duration 10 --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_soak(model=None, clients=4, duration=5.0, seed=0,
+             fault_every=7, max_new=6) -> dict:
+    """Drive the soak; returns the summary dict (also what ``main``
+    prints). ``fault_every``: mean steps between injected device-step
+    faults (the blame-path pressure); wire faults ride fixed seeded
+    probabilities. ``model=None`` builds the standard tiny LM."""
+    import numpy as np
+
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.networking import RetryPolicy
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingError,
+        ServingServer,
+    )
+
+    if model is None:
+        from distkeras_tpu.models import zoo
+
+        model = zoo.transformer_lm(
+            vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+            seed=0,
+        )
+
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(0, 61, n).astype(np.int32) for n in (3, 5, 7, 9)
+    ]
+    ref_gen = CachedSequenceGenerator(model)
+    refs = [ref_gen.generate(p[None], steps=max_new)[0] for p in prompts]
+
+    engine = ServingEngine(
+        model, num_slots=4, queue_capacity=4, prefix_cache=False,
+        # generous grace: the warmup compiles ~5 programs on a possibly
+        # contended core, and a compile mistaken for a wedge would turn
+        # the soak into a restart storm before traffic even starts
+        watchdog_interval=1.0, watchdog_grace=60.0,
+        max_restarts=10_000,  # the soak outlives scheduler crashes
+        restart_backoff=0.01, quarantine_steps=8,
+    )
+    server = ServingServer(engine, retry_after_ms=20.0).start()
+    for p in prompts:  # fault-free warmup: compile every bucket + the step
+        engine.generate(p, max_new)
+
+    plan = (
+        FaultPlan(seed=seed)
+        .arm("stepper.step", times=None, probability=1.0 / fault_every)
+        .arm("server.reply", action="drop", times=None, probability=0.03)
+        .arm("net.send", action="reset", times=None, probability=0.01)
+        .arm("net.send", action="truncate", times=None, probability=0.01)
+    )
+
+    lock = threading.Lock()
+    summary = {
+        "completed": 0,
+        "typed_errors": {},
+        "untyped_errors": 0,
+        "untyped_samples": [],
+        "corrupt_outputs": 0,
+    }
+    stop_at = time.monotonic() + float(duration)
+
+    def client_loop(ci):
+        policy = RetryPolicy(
+            max_attempts=30, base_delay=0.01, max_delay=0.2,
+            budget=duration + 30.0, seed=seed * 1000 + ci,
+        )
+        crng = np.random.default_rng(seed * 100 + ci)
+        with ServingClient("127.0.0.1", server.port, retry=policy) as c:
+            while time.monotonic() < stop_at:
+                pi = int(crng.integers(0, len(prompts)))
+                try:
+                    out = c.generate(prompts[pi], max_new)
+                except ServingError as e:
+                    code = getattr(e, "code", type(e).__name__)
+                    with lock:
+                        summary["typed_errors"][code] = (
+                            summary["typed_errors"].get(code, 0) + 1
+                        )
+                    continue
+                except Exception as e:  # noqa: BLE001 — the finding
+                    with lock:
+                        summary["untyped_errors"] += 1
+                        if len(summary["untyped_samples"]) < 5:
+                            summary["untyped_samples"].append(repr(e))
+                    continue
+                with lock:
+                    if np.array_equal(out, refs[pi]):
+                        summary["completed"] += 1
+                    else:
+                        summary["corrupt_outputs"] += 1
+
+    threads = [
+        threading.Thread(target=client_loop, args=(i,), daemon=True)
+        for i in range(int(clients))
+    ]
+    with plan:
+        for t in threads:
+            t.start()
+        for t in threads:
+            # generous per-thread budget past the wall-clock: a thread
+            # still alive after this is DEFINITIONALLY hung
+            t.join(timeout=duration + 60.0)
+    hung = sum(t.is_alive() for t in threads)
+
+    summary["hung"] = hung
+    summary["faults_fired"] = plan.fired()
+    summary["fired_by_site"] = {
+        s: plan.fired(s)
+        for s in ("stepper.step", "server.reply", "net.send")
+    }
+    engine_stats = engine.stats()
+    summary["engine"] = {
+        k: engine_stats[k]
+        for k in (
+            "step_failures", "blame_probes", "internal_errors",
+            "quarantines", "restarts", "watchdog_trips", "status",
+            "completed", "rejected_overloaded",
+        )
+    }
+    server.shutdown()
+    summary["ok"] = (
+        hung == 0
+        and summary["untyped_errors"] == 0
+        and summary["corrupt_outputs"] == 0
+    )
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="soak wall-clock seconds")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fault-every", type=int, default=7,
+                    help="mean scheduler steps between injected step faults")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU platform before JAX initializes")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        from distkeras_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(1)
+
+    summary = run_soak(
+        clients=args.clients, duration=args.duration, seed=args.seed,
+        fault_every=args.fault_every,
+    )
+    json.dump(summary, sys.stdout, indent=2, default=str)
+    print()
+    if not summary["ok"]:
+        print("SOAK FAILED: hung requests, untyped errors, or corrupt "
+              "outputs (see summary above)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
