@@ -25,11 +25,19 @@ both sides equally), repeated ``--repeats`` times, and aggregated as
 median-of-repeats percentiles with the across-repeat p99 spread kept
 in the artifact.
 
+- **Speculative decoding** (prompt-lookup drafter): its own A/B on a
+  successor-trained LM — both sides the full chunked+cached engine,
+  the optimized side adding ``speculative="ngram"``. Repetitive
+  (self-similar) traffic is the claimed win; an incompressible row
+  (random prompts, budgets too short to wrap into self-repetition)
+  measures what the drafter + verify machinery costs when it cannot
+  propose — stated, not hidden.
+
 Correctness rides along: every request's greedy output is asserted
 identical between the two configs, across repeats, AND to its solo
 ``CachedSequenceGenerator`` decode (cache-hit, chunked, and combined
-admission paths all pinned). The PR 1 continuous-vs-serial ratio is
-kept for continuity.
+admission paths all pinned; the speculative sides too). The PR 1
+continuous-vs-serial ratio is kept for continuity.
 
 Writes BENCH_SERVING.json and prints one JSON line.
 
@@ -72,6 +80,42 @@ def _make_prefix_heavy(n, seq, vocab, rng, header):
         prompt = np.concatenate([header, sfx]).astype(np.int32)
         steps = int(rng.integers(max(2, seq // 8), max(3, seq // 4)))
         steps = max(1, min(steps, seq - prompt.size))
+        reqs.append((prompt, steps))
+    return reqs
+
+
+def _make_spec_repetitive(n, seq, vocab, rng):
+    """REPETITIVE/templated traffic for the speculative A/B: counting
+    runs LONGER than the vocabulary, so the sequence literally repeats
+    spans of itself (mod-V wrap) — the traffic shape prompt-lookup
+    drafting exists for (few-shot templates, code edits, extraction
+    over quoted context). On the successor-trained model the greedy
+    continuation keeps counting, so the drafter's copied spans are
+    RIGHT and acceptance runs near the ceiling."""
+    reqs = []
+    plen = min(vocab + 8, max(2, seq // 3))
+    for _ in range(n):
+        start = int(rng.integers(0, vocab))
+        prompt = ((start + np.arange(plen)) % vocab).astype(np.int32)
+        steps = int(rng.integers(seq // 8, seq // 4))
+        steps = max(1, min(steps, seq - plen))
+        reqs.append((prompt, steps))
+    return reqs
+
+
+def _make_spec_incompressible(n, seq, vocab, rng):
+    """INCOMPRESSIBLE traffic: random prompts whose suffixes (almost)
+    never recur, and decode budgets short enough that the generated
+    tail cannot wrap into self-repetition — the drafter proposes
+    nothing, and this row measures what speculation COSTS when it
+    cannot win (the honesty row of the A/B)."""
+    reqs = []
+    plen = min(vocab + 8, max(2, seq // 3))
+    for _ in range(n):
+        prompt = rng.integers(0, vocab, plen).astype(np.int32)
+        steps = int(rng.integers(max(2, vocab // 4),
+                                 max(3, 3 * vocab // 4)))
+        steps = max(1, min(steps, seq - plen))
         reqs.append((prompt, steps))
     return reqs
 
@@ -134,12 +178,14 @@ def _pct(per_repeat):
     }
 
 
-def _engine(model, reqs, *, slots, prefill_chunk, prefix_cache):
+def _engine(model, reqs, *, slots, prefill_chunk, prefix_cache,
+            speculative=None, draft_k=4):
     from distkeras_tpu.serving import ServingEngine
 
     return ServingEngine(
         model, num_slots=slots, queue_capacity=2 * len(reqs) + 8,
         prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+        speculative=speculative, draft_k=draft_k,
     ).start()
 
 
@@ -158,6 +204,15 @@ def _reset(eng, prime):
         eng.prefix_store.reset_counters()
     for k in eng.batcher.counters:
         eng.batcher.counters[k] = 0
+    st = eng._stepper
+    if getattr(st, "speculative", False):
+        # per-pass speculative counters, so summed snapshots cover
+        # exactly the timed window like every other field
+        st.spec_verify_steps = 0
+        st.spec_fallback_steps = 0
+        st.spec_drafted_tokens = 0
+        eng.batcher._spec_windows[:] = 0
+        eng.batcher._spec_emitted[:] = 0
 
 
 def _timed_pass(eng, reqs, arrivals, results):
@@ -268,6 +323,70 @@ def _measure_ab(model, reqs, *, slots, chunk, prime=None, arrivals=None,
         base_out[-1],
         opt_out[-1],
     )
+
+
+def _spec_summary(runs):
+    """Pool the speculative counters over a side's timed passes (they
+    are zeroed by ``_reset`` before each one)."""
+    snaps = [s["speculative"] for _, _, _, s in runs]
+    tot = {
+        k: sum(s[k] for s in snaps)
+        for k in ("windows", "verify_steps", "fallback_steps",
+                  "drafted_tokens", "accepted_draft_tokens",
+                  "rejected_draft_tokens", "emitted_tokens")
+    }
+    tot["mean_tokens_per_window"] = (
+        round(tot["emitted_tokens"] / tot["windows"], 3)
+        if tot["windows"] else 0.0
+    )
+    return tot
+
+
+def _measure_spec_ab(model, reqs, refs, *, slots, chunk, arrivals,
+                     repeats, draft_k):
+    """Speculative A/B: the SAME chunked+cached engine config with and
+    without ``speculative="ngram"`` over identical request streams —
+    interleaved timed passes per the PERF.md protocol, outputs on both
+    sides asserted token-identical to the solo references."""
+    base = _engine(model, reqs, slots=slots, prefill_chunk=chunk,
+                   prefix_cache=True)
+    opt = _engine(model, reqs, slots=slots, prefill_chunk=chunk,
+                  prefix_cache=True, speculative="ngram",
+                  draft_k=draft_k)
+    try:
+        for eng in (base, opt):  # warm both sides' programs
+            _drive(eng, reqs, arrivals=arrivals)
+            _drive(eng, reqs, arrivals=arrivals)
+        base_runs, opt_runs = [], []
+        base_out, opt_out = [], []
+        for _ in range(repeats):
+            _reset(base, None)
+            base_runs.append(_timed_pass(base, reqs, arrivals, base_out))
+            _reset(opt, None)
+            opt_runs.append(_timed_pass(opt, reqs, arrivals, opt_out))
+    finally:
+        base.stop()
+        opt.stop()
+    for i, (a, b, r) in enumerate(zip(base_out[-1], opt_out[-1], refs)):
+        assert np.array_equal(a, r), f"spec req {i}: baseline != solo"
+        assert np.array_equal(b, r), f"spec req {i}: speculative != solo"
+    b_side = _side(base_runs, True)
+    o_side = _side(opt_runs, True)
+    return {
+        "num_requests": len(reqs),
+        "prompt_lens": [int(p.size) for p, _ in reqs],
+        "decode_steps": [int(s) for _, s in reqs],
+        "baseline": b_side,
+        "speculative": o_side,
+        "acceptance": _spec_summary(opt_runs),
+        "tokens_per_sec_ratio": _ratio(
+            o_side["tokens_per_sec"], b_side["tokens_per_sec"]
+        ),
+        "latency_p99_speedup": _ratio(
+            b_side["latency_ms"]["p99"], o_side["latency_ms"]["p99"]
+        ),
+        "outputs_identical": True,
+    }
 
 
 def _measure_serial(model, reqs, *, arrivals=None, repeats=1):
@@ -457,11 +576,82 @@ def main() -> None:
         "chunked_cached"
     ]["tokens_per_sec"]
 
+    # -- speculative decoding A/B (prompt-lookup drafter) -------------------
+    # Speculation pays off only when the model's continuation repeats
+    # structure the drafter can find, so this A/B runs on a successor-
+    # trained LM whose vocabulary is SMALLER than its prompts (counting
+    # wraps => the sequence repeats itself): spec_repetitive is the
+    # claimed win, spec_incompressible (random prompts, short budgets)
+    # states what the drafter + verify machinery costs when it cannot
+    # propose. Both sides are the full chunked+cached engine; only
+    # speculative="ngram" differs.
+    draft_k = 4
+    if args.smoke:
+        spec_model, spec_vocab, spec_seq = model, vocab, seq
+    else:
+        from distkeras_tpu import SingleTrainer
+        from distkeras_tpu.data.dataset import Dataset
+
+        spec_vocab, spec_seq = 32, min(128, seq)
+        spec_model = transformer_lm(
+            vocab_size=spec_vocab, seq_len=spec_seq, d_model=d_model,
+            num_heads=heads, depth=depth, seed=0,
+        )
+        srng = np.random.default_rng(1)
+        starts = srng.integers(0, spec_vocab, 512)
+        xs = (
+            (starts[:, None] + np.arange(spec_seq)[None, :]) % spec_vocab
+        ).astype(np.int32)
+        spec_model = SingleTrainer(
+            spec_model, "adam", loss="next_token_crossentropy",
+            learning_rate=2e-3, batch_size=32, num_epoch=3, seed=0,
+        ).train(Dataset({"features": xs, "label": xs}))
+    spec_gen = CachedSequenceGenerator(spec_model)
+    record["speculative"] = {
+        "drafter": "ngram",
+        "draft_k": draft_k,
+        "model": (
+            f"transformer_lm d{d_model} L{depth} seq{spec_seq} "
+            f"v{spec_vocab}" + ("" if args.smoke else " (trained)")
+        ),
+        "workloads": {},
+    }
+    spec_workloads = {
+        "spec_repetitive": _make_spec_repetitive(
+            args.requests, spec_seq, spec_vocab, rng
+        ),
+        "spec_incompressible": _make_spec_incompressible(
+            args.requests, spec_seq, spec_vocab, rng
+        ),
+    }
+    for name, timed in spec_workloads.items():
+        smax = max(s for _, s in timed)
+        ragged = spec_gen.generate([p for p, _ in timed], steps=smax)
+        refs = [
+            np.asarray(row)[: p.size + s]
+            for row, (p, s) in zip(list(ragged), timed)
+        ]
+        arrivals = np.cumsum(rng.exponential(gap_ms / 1e3, len(timed)))
+        wl = _measure_spec_ab(
+            spec_model, timed, refs, slots=args.slots, chunk=chunk,
+            arrivals=arrivals, repeats=args.repeats, draft_k=draft_k,
+        )
+        record["speculative"]["workloads"][name] = wl
+        print(json.dumps({name: {
+            "tokens_per_sec_ratio": wl["tokens_per_sec_ratio"],
+            "latency_p99_speedup": wl["latency_p99_speedup"],
+            "tokens_per_window": wl["acceptance"][
+                "mean_tokens_per_window"
+            ],
+        }}), flush=True)
+
     with open("BENCH_SERVING.json", "w") as f:
         json.dump(record, f, indent=2)
     print(json.dumps({
         "metric": record["metric"], "value": record["value"],
         "continuous_vs_serial": record["continuous_vs_serial"]["speedup"],
+        "speculative_repetitive_ratio": record["speculative"][
+            "workloads"]["spec_repetitive"]["tokens_per_sec_ratio"],
     }))
 
 

@@ -14,6 +14,8 @@ Seam catalogue (the hook points that exist today)::
 
     scheduler.loop      engine scheduler thread, top of every iteration
     stepper.step        DecodeStepper.step, before any device work
+    stepper.verify      DecodeStepper.spec_step, before the compiled
+                        speculative verify (drafts already proposed)
     stepper.prefill     begin_admit / prefill_chunk, before device work
     prefix_cache.fetch  PrefixStore.lookup (engine degrades to a miss)
     server.dispatch     ServingServer verb dispatch (typed-reply path)
@@ -60,6 +62,7 @@ SITES = frozenset(
     {
         "scheduler.loop",
         "stepper.step",
+        "stepper.verify",
         "stepper.prefill",
         "prefix_cache.fetch",
         "server.dispatch",

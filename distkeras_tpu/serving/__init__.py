@@ -9,6 +9,12 @@ decode path (scheduler -> engine -> server, plus the client).
   from a dedicated thread; loads serving bundles; logs metrics.
   Admission is chunked (pow2-bucketed prefill chunks under a per-
   iteration token budget) and prefix-aware (``prefix_cache``).
+  Decode is optionally SPECULATIVE (``speculative=``): a pluggable
+  drafter (model-free prompt-lookup ``NgramDrafter``, or a draft-LM
+  ``ModelDrafter`` from a second serving bundle) proposes ``draft_k``
+  tokens per slot and a once-compiled verify step scores all k+1
+  positions in one call — slots emit 1..k+1 tokens per iteration,
+  output pinned token-identical to solo greedy decode.
 - ``prefix_cache``: host-side shared-prefix KV store — exact-prefix
   keyed, LRU-bounded by bytes — that lets admission skip recomputing
   K/V for prompt prefixes other requests already prefilled.
@@ -36,7 +42,12 @@ from distkeras_tpu.serving.scheduler import (
     ServingError,
     WindowedBatcher,
 )
-from distkeras_tpu.serving.engine import DecodeStepper, ServingEngine
+from distkeras_tpu.serving.engine import (
+    DecodeStepper,
+    ModelDrafter,
+    NgramDrafter,
+    ServingEngine,
+)
 from distkeras_tpu.serving.prefix_cache import PrefixStore
 from distkeras_tpu.serving.server import ServingServer, serve
 from distkeras_tpu.serving.client import ServingClient
@@ -47,6 +58,8 @@ __all__ = [
     "DecodeStepper",
     "EngineStoppedError",
     "InternalError",
+    "ModelDrafter",
+    "NgramDrafter",
     "OverloadedError",
     "PrefixStore",
     "ServeRequest",

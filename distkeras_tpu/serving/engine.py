@@ -59,6 +59,235 @@ def _bucket_pow2(n: int, cap: int) -> int:
     return min(1 << (n - 1).bit_length(), cap)
 
 
+class NgramDrafter:
+    """Model-free draft source: prompt-lookup (n-gram) drafting.
+
+    Proposes the ``k`` tokens that followed the most recent earlier
+    occurrence of the sequence's current suffix (longest match first,
+    ``ngram_max`` down to ``ngram_min`` tokens) — the prompt-lookup
+    decoding idea: templated serving traffic (few-shot headers, code
+    edits, extraction over a quoted document) repeats spans of its own
+    context, and copying the continuation of the last such span is
+    free. No model, no device state, no training: proposals are a pure
+    host-side function of each slot's sequence so far, which is why
+    this drafter works the moment speculation is switched on. When no
+    suffix recurs it proposes nothing and the engine falls back to the
+    plain decode step for that iteration — incompressible traffic pays
+    only the (counted) fallback, never a wasted verify.
+
+    Incompressible traffic still produces ACCIDENTAL suffix matches
+    (random contexts repeat bigrams by chance), and one junk proposal
+    drags every active slot through a k+1-position verify to accept a
+    single token — so the drafter self-throttles on FEEDBACK: a slot
+    whose proposals were fully rejected ``cold_after`` windows in a row
+    stops proposing for ``retry_every`` windows, then probes again.
+    Repetitive traffic never builds a rejection streak, so the win is
+    untouched; adversarial traffic degrades to near-plain-decode cost
+    instead of paying the verify tax forever.
+    """
+
+    name = "ngram"
+    wants_sequences = True  # the batcher passes prompt+emitted per slot
+
+    def __init__(self, ngram_max=3, ngram_min=2, k=None,
+                 cold_after=3, retry_every=16):
+        self.ngram_max = int(ngram_max)
+        self.ngram_min = int(ngram_min)
+        if not 1 <= self.ngram_min <= self.ngram_max:
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max; got "
+                f"{ngram_min}, {ngram_max}"
+            )
+        self.cold_after = int(cold_after)
+        self.retry_every = int(retry_every)
+        self._streak = None  # per-slot consecutive all-rejected windows
+        self._pause = None  # per-slot windows left to sit out
+        self._proposed = None  # slots that proposed in the live round
+        del k  # accepted for symmetry; the stepper passes k per call
+
+    def bind(self, stepper):
+        b = stepper.num_slots
+        self._streak = np.zeros(b, np.int64)
+        self._pause = np.zeros(b, np.int64)
+        self._proposed = np.zeros(b, bool)
+
+    def warmup(self):
+        pass
+
+    def admit(self, slot, prompt):
+        self._streak[slot] = 0
+        self._pause[slot] = 0
+
+    def release(self, slot):
+        self._streak[slot] = 0
+        self._pause[slot] = 0
+
+    def invalidate(self, mask):
+        pass
+
+    def sync(self, active, toks, counts, lens0):
+        """Acceptance feedback: ``counts[i] - 1`` of slot i's proposals
+        were accepted this window. All-rejected windows build the
+        throttle streak; any acceptance resets it."""
+        del toks, lens0
+        judged = np.asarray(active, bool) & self._proposed
+        rejected = judged & (np.asarray(counts) <= 1)
+        self._streak[judged & ~rejected] = 0
+        self._streak[rejected] += 1
+        cold = self._streak >= self.cold_after
+        self._pause[cold] = self.retry_every
+        self._streak[cold] = 0
+
+    def propose(self, active, k, seqs):
+        """(B, k) int32 proposals + (B,) proposal counts. Slots whose
+        suffix has no earlier occurrence (or whose sequence is absent),
+        and slots sitting out a rejection-streak pause, get count 0."""
+        b = active.shape[0]
+        dtoks = np.zeros((b, k), np.int32)
+        dcnt = np.zeros((b,), np.int32)
+        self._proposed[:] = False
+        if seqs is None:
+            return dtoks, dcnt
+        from numpy.lib.stride_tricks import sliding_window_view
+
+        for i in np.flatnonzero(active):
+            if self._pause[i] > 0:
+                self._pause[i] -= 1
+                continue
+            s = seqs[i]
+            if s is None:
+                continue
+            if isinstance(s, tuple):  # zero-copy (prompt, emitted)
+                prompt, toks = s
+                s = (
+                    np.concatenate(
+                        [prompt, np.asarray(toks, prompt.dtype)]
+                    )
+                    if len(toks)
+                    else np.asarray(prompt)
+                )
+            if s.size < self.ngram_min + 1:
+                continue
+            ln = s.size
+            for n in range(min(self.ngram_max, ln - 1),
+                           self.ngram_min - 1, -1):
+                pat = s[ln - n:]
+                # windows ending before the suffix itself; the LAST
+                # earlier occurrence wins (most recent context)
+                hits = np.flatnonzero(
+                    (sliding_window_view(s, n)[: ln - n] == pat).all(1)
+                )
+                if hits.size:
+                    j = int(hits[-1])
+                    cont = s[j + n : j + n + k]
+                    dtoks[i, : cont.size] = cont
+                    dcnt[i] = cont.size
+                    self._proposed[i] = True
+                    break
+        return dtoks, dcnt
+
+
+class ModelDrafter:
+    """Draft source backed by a small draft LM: the serving-tier lift
+    of ``SpeculativeGenerator``'s draft path. The draft model runs its
+    OWN quiet slot bank (a nested plain ``DecodeStepper``, same slots,
+    scratch-padded so over-draft writes land past the real positions),
+    admitted/released in lockstep with the target's slots. Each round
+    proposes ``k`` greedy draft tokens via k+1 draft steps — the extra
+    step writes the draft's K/V for the last proposed position, the
+    same gapless-cache fix ``SpeculativeGenerator.draft_chunk``
+    carries — and after the target's verify the draft's context row
+    and length are rolled back to the ACCEPTED sequence (the agreeing
+    prefix is already in place; the target's correction token is
+    written over the rejected proposal). A draft-side crash never
+    fails a request: the slot is marked invalid and simply stops
+    proposing (one token per iteration, plain-greedy pace) until its
+    next admission.
+
+    Known tradeoff, stated: the draft's prompt prefill runs UNCHUNKED
+    on the scheduler thread the iteration its slot turns decodable —
+    a deliberate exception to the PR 2 chunk budget, acceptable only
+    because a draft worth serving is many times smaller than the
+    target (its whole prefill costs on the order of one target chunk);
+    lockstep-chunking the draft admission is the lift if a heavy draft
+    ever makes this stall visible."""
+
+    name = "draft_lm"
+    wants_sequences = False
+
+    def __init__(self, model):
+        self.model = model
+        self._st = None
+        self._valid = None
+
+    def bind(self, stepper):
+        """(Re)build the nested draft slot bank against ``stepper``'s
+        geometry — called from ``DecodeStepper.__init__``, including
+        the supervisor's post-crash rebuilds."""
+        tgt = stepper
+        if self.model.input_shape[0] != tgt.max_len:
+            raise ValueError(
+                "draft and target must be built to the same sequence "
+                f"length; got {self.model.input_shape[0]} vs "
+                f"{tgt.max_len}"
+            )
+        self._st = DecodeStepper(
+            self.model, num_slots=tgt.num_slots, temperature=0.0,
+            kv_dtype=tgt._gen.kv_dtype,
+            scratch=_bucket_pow2(tgt.draft_k, tgt.max_len) + 2,
+            _quiet=True,
+        )
+        if self._st._gen._emb.vocab_size != tgt._gen._emb.vocab_size:
+            raise ValueError(
+                "draft and target must share a vocabulary; got "
+                f"{self._st._gen._emb.vocab_size} vs "
+                f"{tgt._gen._emb.vocab_size}"
+            )
+        self._st.on_compile = lambda: (
+            tgt.on_compile() if tgt.on_compile is not None else None
+        )
+        self._valid = np.zeros(tgt.num_slots, bool)
+
+    def warmup(self):
+        self._st.warmup()
+
+    def admit(self, slot, prompt):
+        self._st.admit(slot, prompt)
+        self._valid[slot] = True
+
+    def release(self, slot):
+        self._valid[slot] = False
+        self._st.release(slot)
+
+    def invalidate(self, mask):
+        """A draft-side failure: stop proposing for these slots (the
+        engine keeps decoding them one token per iteration)."""
+        self._valid[np.asarray(mask, bool)] = False
+
+    def propose(self, active, k, seqs):
+        del seqs
+        act = np.asarray(active, bool) & self._valid
+        b = act.shape[0]
+        dtoks = np.zeros((b, k), np.int32)
+        if not act.any():
+            return dtoks, np.zeros((b,), np.int32)
+        toks = [self._st.step(act) for _ in range(k + 1)]
+        for j in range(k):  # the k+1-th step's proposal is discarded
+            dtoks[act, j] = np.asarray(toks[j])[act]
+        return dtoks, np.where(act, k, 0).astype(np.int32)
+
+    def sync(self, active, toks, counts, lens0):
+        """Roll the draft bank back to the verified truth: write the
+        accepted tokens over the draft's proposals (only the target's
+        correction actually differs) and reset the draft lengths to
+        the target's."""
+        act = np.asarray(active, bool) & self._valid
+        if not act.any():
+            return
+        self._st.write_segment(act, toks, counts, lens0)
+        self._st._lens[act] = lens0[act] + counts[act]
+
+
 class DecodeStepper:
     """Slot-bank decode over a causal-LM-family model.
 
@@ -80,13 +309,31 @@ class DecodeStepper:
 
     def __init__(self, model, num_slots=8, temperature=0.0, seed=0,
                  top_k=None, top_p=None, kv_dtype=None,
-                 prefix_cache=None):
+                 prefix_cache=None, speculative=None, draft_k=4,
+                 scratch=None, _quiet=False):
         """``prefix_cache``: an optional ``prefix_cache.PrefixStore``.
         When set, ``begin_admit`` restores the longest cached prefix's
         K/V rows into the slot before any prefill compute, and every
         finished prefill publishes its missing pow2 ladder rungs (an
         exact-length repeat therefore re-prefills the sub-rung tail —
-        the stated reuse ceiling, not full-hit-on-repeat)."""
+        the stated reuse ceiling, not full-hit-on-repeat).
+
+        ``speculative``: an optional draft source (``NgramDrafter`` /
+        ``ModelDrafter``). When set, the scheduler drives ``spec_step``
+        instead of ``step``: the drafter proposes up to ``draft_k``
+        tokens per active slot and a once-compiled VERIFY program
+        scores all k+1 candidate positions against the live K/V caches
+        in one call, accepting the longest greedy-agreeing prefix plus
+        the target's correction. Greedy only — speculation reproduces
+        the target's greedy decode exactly, so a sampling config is
+        rejected here.
+
+        ``scratch``: extra (masked) positions padded onto the cache /
+        context time axis so speculative over-draft and verify writes
+        land past the real sequence instead of clamping onto it
+        (default: sized from ``draft_k`` when speculative, else 0).
+        ``_quiet``: skip the fault seams — the draft model's nested
+        stepper must not trip seams armed for live target traffic."""
         import jax.numpy as jnp
 
         from distkeras_tpu.predictors import CachedSequenceGenerator
@@ -103,13 +350,32 @@ class DecodeStepper:
             raise ValueError(f"num_slots must be >= 1; got {num_slots}")
         self.max_len = int(model.input_shape[0])
         self.seed = int(seed)
+        self.drafter = speculative if speculative else None
+        self.draft_k = int(draft_k)
+        if self.draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1; got {draft_k}")
+        self._kb = _bucket_pow2(self.draft_k, self.max_len)
+        if self.drafter is not None and (
+            temperature != 0.0 or top_k is not None or top_p is not None
+        ):
+            raise ValueError(
+                "speculative serving verifies GREEDY agreement; it is "
+                "only defined for temperature=0 without top_k/top_p"
+            )
+        if scratch is None:
+            scratch = self._kb + 1 if self.drafter is not None else 0
+        self._tp = self.max_len + int(scratch)  # padded time axis
+        # parked/over-draft lens cap: plain steppers keep the PR 1 cap
+        # (max_len); scratch-padded ones may walk into the pad
+        self._lens_cap = self.max_len + max(0, int(scratch) - 1)
+        self._quiet = bool(_quiet)
         nh = self._gen._blocks[0].mhsa.num_heads
         from distkeras_tpu.ops.quantization import qshape
 
         hd = qshape(
             model.params[str(self._gen._stages[0][1])]["mhsa"]["wq"]
         )[1] // nh
-        b, t = self.num_slots, self.max_len
+        b, t = self.num_slots, self._tp
         self._ctx = jnp.zeros((b, t), jnp.int32)
         self._caches = [
             (
@@ -125,8 +391,20 @@ class DecodeStepper:
         self._chunk_fns = {}  # chunk-length bucket -> compiled chunk
         self._copy_fn = None  # prefix restore (specializes per pb shape)
         self._row_fn = None  # compiled ctx-row write (one program)
+        self._verify_fns = {}  # candidate-count bucket -> compiled verify
+        self._seg_fn = None  # compiled accepted-segment ctx write
         self._nh, self._hd = nh, hd
         self.prefix_cache = prefix_cache
+        # speculation bookkeeping: prompts kept for draft admission,
+        # which slots have a draft admitted, the proposal cache that
+        # keeps blame-probe retries from re-advancing the draft bank,
+        # and the drafted/verify counters stats() attributes per source
+        self._spec_prompts: dict[int, np.ndarray] = {}
+        self._spec_admitted: set[int] = set()
+        self._spec_pending = None  # (lens snapshot, dtoks, dcnt)
+        self.spec_verify_steps = 0
+        self.spec_fallback_steps = 0
+        self.spec_drafted_tokens = 0
         # prefix-store failures are degraded to misses, never surfaced
         # to the request (the cache is an optimization, not a dependency)
         self.prefix_fetch_failures = 0
@@ -139,6 +417,25 @@ class DecodeStepper:
         # position (host bookkeeping for the chunked lifecycle)
         self._pending: dict[int, np.ndarray] = {}
         self._prefill_pos: dict[int, int] = {}
+        if self.drafter is not None:
+            self.drafter.bind(self)
+
+    @property
+    def speculative(self) -> bool:
+        return self.drafter is not None
+
+    @property
+    def wants_sequences(self) -> bool:
+        """True when the draft source needs each slot's host-side
+        sequence so far (prompt + emitted) — the batcher builds them."""
+        return self.drafter is not None and self.drafter.wants_sequences
+
+    def _fire(self, site, **ctx):
+        """Fault seam, silenced for nested (draft) steppers: seams
+        armed against live target traffic must not trip on the draft
+        bank's internal steps."""
+        if not self._quiet:
+            faults.fire(site, **ctx)
 
     def _compiling(self):
         """About to build (and on first call, compile) a new program —
@@ -192,7 +489,7 @@ class DecodeStepper:
         ready to decode). ``prefill_chunk`` advances the remainder —
         the scheduler spreads it over iterations so a long prompt never
         stalls the decoding slots beyond its per-iteration budget."""
-        faults.fire("stepper.prefill", slot=slot)
+        self._fire("stepper.prefill", slot=slot)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = prompt.size
         if not 1 <= plen <= self.max_len:
@@ -225,6 +522,13 @@ class DecodeStepper:
                 self._restore_prefix(slot, kv)
         self._pending[slot] = prompt
         self._prefill_pos[slot] = start
+        if self.drafter is not None:
+            # kept for draft admission once the slot turns decodable;
+            # the proposal cache is stale the moment slot composition
+            # changes (a parked slot's length can collide with its
+            # next occupant's)
+            self._spec_prompts[slot] = prompt
+            self._spec_pending = None
         self._lens[slot] = plen
         if start >= target:
             self._finish_admit(slot)
@@ -241,7 +545,7 @@ class DecodeStepper:
         garbage K/V computed past the chunk's real tokens sits at
         positions >= the prefill frontier and is overwritten (by the
         next chunk or the decode steps) before any query attends it."""
-        faults.fire("stepper.prefill", slot=slot)
+        self._fire("stepper.prefill", slot=slot)
         prompt = self._pending.get(slot)
         if prompt is None:
             # admission cancelled underneath us (release() raced this
@@ -293,7 +597,7 @@ class DecodeStepper:
         compiling an arbitrary-length tail program — near-capacity
         traffic must not break the O(log T) compile discipline."""
         cb = _bucket_pow2(n, self.max_len)
-        room = self.max_len - pos
+        room = self._tp - pos
         if cb > room:
             cb = 1 << (room.bit_length() - 1)  # largest pow2 <= room
             n = min(n, cb)
@@ -365,6 +669,11 @@ class DecodeStepper:
         self._lens[slot] = 1  # keep pos = lens-1 in range while parked
         self._pending.pop(slot, None)  # eviction mid-prefill
         self._prefill_pos.pop(slot, None)
+        self._spec_prompts.pop(slot, None)
+        if slot in self._spec_admitted:
+            self._spec_admitted.discard(slot)
+            self._spec_pending = None
+            self.drafter.release(slot)
 
     def warmup(self) -> None:
         """Compile the decode step off the serving path. The supervisor
@@ -385,6 +694,23 @@ class DecodeStepper:
                 self.model.params, self._ctx, self._caches,
                 self._lens.copy(), active, np.int32(self._step_idx),
             )
+        if self.drafter is not None:
+            # compile the verify (all writes masked: numerically a
+            # no-op) and let the drafter warm its own programs, so a
+            # supervisor restart never compiles on the serving path
+            c = self._kb + 1
+            fn = self._verify_fns.get(c)
+            if fn is None:
+                fn = self._build_verify_fn(c)
+                self._verify_fns = {**self._verify_fns, c: fn}
+            with annotate("serving/warmup"):
+                self._ctx, self._caches, _, _ = fn(
+                    self.model.params, self._ctx, self._caches,
+                    self._lens.copy(), active,
+                    np.zeros((self.num_slots, self._kb), np.int32),
+                    np.zeros((self.num_slots,), np.int32),
+                )
+            self.drafter.warmup()
 
     def _build_admit_fn(self, pb: int):
         """Compiled whole-prefix prefill for bucket ``pb``: positions
@@ -439,7 +765,7 @@ class DecodeStepper:
         import jax.numpy as jnp
 
         gen = self._gen
-        t, nh, hd = self.max_len, self._nh, self._hd
+        t, nh, hd = self._tp, self._nh, self._hd
 
         def chunk(params, caches, toks, slot, start):
             bp, p_emb, _, _ = self._unpack(params)
@@ -509,7 +835,7 @@ class DecodeStepper:
         # the injection seam fires BEFORE any device work or host
         # bookkeeping: a failed step leaves the slot bank exactly as it
         # was, which is what makes the batcher's blame retries sound
-        faults.fire("stepper.step", active=active)
+        self._fire("stepper.step", active=active)
         if self._step_fn is None:
             self._compiling()
             self._step_fn = self._build_step_fn()
@@ -521,7 +847,7 @@ class DecodeStepper:
         self._step_idx += 1
         toks = np.asarray(toks)
         self._lens[active] = np.minimum(
-            self._lens[active] + 1, self.max_len
+            self._lens[active] + 1, self._lens_cap
         )
         return toks
 
@@ -532,7 +858,7 @@ class DecodeStepper:
         from distkeras_tpu.ops.quantization import qmatmul, qshape
 
         gen = self._gen
-        temp, b, t = gen.temperature, self.num_slots, self.max_len
+        temp, b, t = gen.temperature, self.num_slots, self._tp
         base_key = jax.random.PRNGKey(self.seed)
 
         def stage_step(blk, moe, p, pm, x, ck, cv, pos, active):
@@ -603,6 +929,235 @@ class DecodeStepper:
 
         return jax.jit(step, donate_argnums=(1, 2))
 
+    # -- speculative decode (draft -> verify -> rollback) -------------------
+
+    def spec_step(self, active, seqs=None):
+        """One speculative scheduler advance: draft up to ``draft_k``
+        tokens per active slot, verify all k+1 candidate positions
+        against the live caches in ONE compiled call, accept the
+        longest greedy-agreeing prefix plus the target's correction.
+        Returns ``(toks, counts, used_verify)``: ``toks`` is (B, k+1)
+        with row i's first ``counts[i]`` entries the tokens emitted
+        for slot i this iteration (1..k+1 per slot — variable
+        advance). Rollback past rejected positions is the host length:
+        rejected K/V sits at positions >= the new frontier and is
+        rewritten by the next window before anything attends it.
+
+        When no slot has a proposal this iteration the engine falls
+        back to the plain decode step (counted) — the verify's k
+        wasted positions are not worth running to accept one token.
+        A drafter failure (admission or proposal) never fails the
+        request: the slots are invalidated and decode continues at
+        plain-greedy pace.
+
+        Blame-probe safe: proposals are cached against a length
+        snapshot, so a crashed verify retried on a masked subset
+        re-verifies the SAME drafts instead of re-advancing the draft
+        bank."""
+        active = np.asarray(active, bool)
+        k = self._kb
+        drafter = self.drafter
+        # draft admission for slots that just turned decodable
+        for i in np.flatnonzero(active):
+            i = int(i)
+            if i not in self._spec_admitted:
+                self._spec_admitted.add(i)
+                prompt = self._spec_prompts.get(i)
+                try:
+                    drafter.admit(i, prompt)
+                except Exception:  # noqa: BLE001 — draft is best-effort
+                    drafter.invalidate(
+                        np.arange(self.num_slots) == i
+                    )
+        pend = self._spec_pending
+        if pend is not None and np.array_equal(
+            pend[0][active], self._lens[active]
+        ):
+            _, dtoks, dcnt = pend  # blame-probe retry: same drafts
+        else:
+            try:
+                dtoks, dcnt = drafter.propose(active, self.draft_k, seqs)
+            except Exception:  # noqa: BLE001 — draft is best-effort
+                drafter.invalidate(active)
+                dtoks = np.zeros((self.num_slots, self.draft_k), np.int32)
+                dcnt = np.zeros((self.num_slots,), np.int32)
+            if dtoks.shape[1] < k:
+                # pad proposals to the pow2 program bucket; padded
+                # positions are masked out of acceptance by dcnt
+                dtoks = np.concatenate(
+                    [
+                        dtoks,
+                        np.zeros(
+                            (self.num_slots, k - dtoks.shape[1]), np.int32
+                        ),
+                    ],
+                    axis=1,
+                )
+            self._spec_pending = (self._lens.copy(), dtoks, dcnt)
+        if int(dcnt[active].sum()) == 0:
+            self.spec_fallback_steps += 1
+            toks = self.step(active)
+            return (
+                np.asarray(toks).reshape(-1, 1),
+                np.where(active, 1, 0).astype(np.int64),
+                False,
+            )
+        # the verify seam fires with drafts already proposed and
+        # BEFORE any device work: a crashed verify leaves the target
+        # bank untouched (blame retries re-use the cached proposals)
+        self._fire("stepper.verify", active=active)
+        c = k + 1
+        fn = self._verify_fns.get(c)
+        if fn is None:
+            self._compiling()
+            fn = self._build_verify_fn(c)
+            self._verify_fns = {**self._verify_fns, c: fn}
+        lens0 = self._lens.copy()
+        with annotate("serving/verify"):
+            self._ctx, self._caches, t_arg, n_new = fn(
+                self.model.params, self._ctx, self._caches, lens0,
+                active, dtoks.astype(np.int32), dcnt.astype(np.int32),
+            )
+        t_arg = np.asarray(t_arg)
+        counts = np.where(active, np.asarray(n_new), 0).astype(np.int64)
+        self._lens[active] = np.minimum(
+            self._lens[active] + counts[active], self._lens_cap
+        )
+        self.spec_verify_steps += 1
+        self.spec_drafted_tokens += int(dcnt[active].sum())
+        drafter.sync(active, t_arg, counts, lens0)
+        return t_arg, counts, True
+
+    def write_segment(self, active, toks, counts, lens0) -> None:
+        """Write each active row's first ``counts[i]`` tokens at
+        positions ``lens0[i] .. lens0[i]+counts[i]-1`` of its context
+        row — how a draft bank's proposals are rolled back to the
+        verified truth after a window."""
+        if self._seg_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            self._compiling()
+
+            def seg(ctx, toks, lens0, counts, active):
+                b, cw = toks.shape
+                rows = jnp.arange(b)[:, None]
+                wpos = lens0[:, None] + jnp.arange(cw)[None, :]
+                keep = active[:, None] & (
+                    jnp.arange(cw)[None, :] < counts[:, None]
+                )
+                cur = ctx[rows, wpos]
+                return ctx.at[rows, wpos].set(
+                    jnp.where(keep, toks.astype(ctx.dtype), cur)
+                )
+
+            self._seg_fn = jax.jit(seg, donate_argnums=(0,))
+        self._ctx = self._seg_fn(
+            self._ctx, np.asarray(toks, np.int32),
+            lens0.astype(np.int32), counts.astype(np.int32),
+            np.asarray(active, bool),
+        )
+
+    def _build_verify_fn(self, c: int):
+        """Compiled speculative verify for ``c`` candidates per slot
+        (the slot's last real token plus ``c-1`` draft proposals —
+        ``c`` is the pow2 ``draft_k`` bucket + 1, the chunk-program
+        discipline). One call scores every candidate position of every
+        active slot against the live caches (the generators'
+        ``_stage_chunk`` math restated with PER-ROW write offsets,
+        like the decode step), computes the longest greedy-agreeing
+        prefix, and writes the accepted tokens into the context rows —
+        the scheduler reads back only (tokens, counts). K/V and
+        context writes past the real sequence land in the scratch pad
+        (``_tp``); inactive slots are frozen throughout."""
+        import jax
+        import jax.numpy as jnp
+
+        from distkeras_tpu.ops.quantization import qmatmul, qshape
+
+        gen = self._gen
+        b, tp, ml = self.num_slots, self._tp, self.max_len
+
+        def stage_verify(blk, moe, p, pm, x, ck, cv, cpos, active):
+            """c tokens per slot through one (block, optional MoE)
+            stage: the C>1 sibling of the step's ``stage_step`` —
+            same per-row K/V scatter, (B, C, T) causal masks."""
+            mh = p["mhsa"]
+            nh = blk.mhsa.num_heads
+            hd = qshape(mh["wq"])[1] // nh
+            h_, _ = blk.ln1.apply(p["ln1"], {}, x)
+            q = qmatmul(h_, mh["wq"]).reshape(b, c, nh, hd)
+            k_new = qmatmul(h_, mh["wk"]).reshape(b, c, nh, hd)
+            v_new = qmatmul(h_, mh["wv"]).reshape(b, c, nh, hd)
+            rows = jnp.arange(b)[:, None]
+            keep = active[:, None, None, None]
+            ck = ck.at[rows, cpos].set(
+                jnp.where(keep, k_new.astype(ck.dtype), ck[rows, cpos])
+            )
+            cv = cv.at[rows, cpos].set(
+                jnp.where(keep, v_new.astype(cv.dtype), cv[rows, cpos])
+            )
+            scores = jnp.einsum("bchd,bthd->bhct", q, ck) / np.sqrt(hd)
+            t_mask = jnp.arange(tp)[None, None, :] <= cpos[:, :, None]
+            scores = jnp.where(t_mask[:, None], scores, -jnp.inf)
+            w = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bhct,bthd->bchd", w, cv).reshape(
+                b, c, nh * hd
+            )
+            o = qmatmul(o, mh["wo"])
+            if "bo" in mh:
+                o = o + mh["bo"]
+            x = x + o
+            h_, _ = blk.ln2.apply(p["ln2"], {}, x)
+            h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
+            h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
+            x = x + h_
+            if moe is not None:
+                x = x + gen._moe_nodrop(pm, x)
+            return x, ck, cv
+
+        def verify(params, ctx, caches, lens, active, dtoks, dcnt):
+            bp, p_emb, p_ln, p_head = self._unpack(params)
+            pos = jnp.clip(lens - 1, 0, ml - 1)  # (B,)
+            rows = jnp.arange(b)
+            tok0 = ctx[rows, pos]
+            chunk = jnp.concatenate([tok0[:, None], dtoks], axis=1)
+            cpos = pos[:, None] + jnp.arange(c)[None, :]  # (B, C) < tp
+            x = self._embed(p_emb, chunk, cpos)  # (B, C, d)
+            new_caches = []
+            for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
+                gen._stages, bp, caches
+            ):
+                x, ck, cv = stage_verify(
+                    blk, moe, p, pm, x, ck, cv, cpos, active
+                )
+                new_caches.append((ck, cv))
+            x, _ = gen._final_ln.apply(p_ln, {}, x)
+            logit, _ = gen._head.apply(p_head, {}, x)  # (B, C, V)
+            t_arg = jnp.argmax(logit, axis=-1).astype(ctx.dtype)
+            # accept the agreeing prefix + the target's correction;
+            # padded / absent proposals can never be "accepted"
+            agree = (dtoks == t_arg[:, : c - 1]) & (
+                jnp.arange(c - 1)[None, :] < dcnt[:, None]
+            )
+            n_acc = jnp.argmin(  # first disagreement; c-1 if all agree
+                jnp.concatenate(
+                    [agree, jnp.zeros((b, 1), bool)], axis=1
+                ).astype(jnp.int32),
+                axis=1,
+            )
+            n_new = n_acc + 1
+            wpos = cpos + 1  # <= ml-1 + c < tp: scratch absorbs overrun
+            keep = active[:, None] & (
+                jnp.arange(c)[None, :] < n_new[:, None]
+            )
+            rows2 = rows[:, None]
+            cur = ctx[rows2, wpos]
+            ctx = ctx.at[rows2, wpos].set(jnp.where(keep, t_arg, cur))
+            return ctx, new_caches, t_arg, n_new
+
+        return jax.jit(verify, donate_argnums=(1, 2))
+
 
 class ServingEngine:
     """The in-process serving runtime: continuous-batching decode plus
@@ -624,7 +1179,8 @@ class ServingEngine:
                  prefix_cache_bytes=64 << 20, quarantine_steps=64,
                  watchdog_interval=10.0, watchdog_grace=None,
                  max_restarts=3, restart_backoff=0.05,
-                 metrics_path=None):
+                 metrics_path=None, speculative=None, draft_bundle=None,
+                 draft_k=4, ngram_max=3):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -632,6 +1188,18 @@ class ServingEngine:
         byte-bounded ``PrefixStore`` (``prefix_cache_bytes``), a
         ``PrefixStore`` instance is used as-is (shareable across
         engines), falsy disables prefix reuse.
+
+        ``speculative``: enables draft-and-verify decode in the slot
+        bank — ``"ngram"`` for the model-free prompt-lookup drafter
+        (works with no second model; ``ngram_max`` caps the suffix
+        match length), ``"draft"`` for a draft-LM drafter fed by
+        ``draft_bundle`` (a serving-bundle path or a model instance),
+        ``True`` picks ``"draft"`` when a bundle is given else
+        ``"ngram"``, or pass a drafter instance directly. ``draft_k``
+        is the proposals-per-window budget; each scheduler iteration
+        then emits 1..draft_k+1 tokens per slot, output still pinned
+        token-identical to solo greedy decode. Greedy only
+        (temperature=0, no top_k/top_p).
 
         Self-healing knobs: ``quarantine_steps`` (scheduler iterations
         a blamed slot sits out — see ``ContinuousBatcher``),
@@ -663,13 +1231,27 @@ class ServingEngine:
                 if isinstance(prefix_cache, PrefixStore)
                 else PrefixStore(max_bytes=prefix_cache_bytes)
             )
+        drafter = self._resolve_drafter(
+            speculative, draft_bundle, ngram_max
+        )
+        if drafter is not None and (
+            temperature != 0.0 or top_k is not None or top_p is not None
+        ):
+            # a config error, not a model limitation: raise here rather
+            # than letting the stepper's ValueError silently demote the
+            # engine to predict-only
+            raise ValueError(
+                "speculative serving verifies GREEDY agreement; it is "
+                "only defined for temperature=0 without top_k/top_p"
+            )
         # everything a supervisor restart needs to rebuild the device
         # face from scratch (fresh slot bank, fresh caches, recompiled
-        # programs; the host-side prefix store SURVIVES restarts)
+        # programs; the host-side prefix store SURVIVES restarts, and
+        # the drafter re-binds to each rebuilt stepper)
         self._stepper_cfg = dict(
             num_slots=num_slots, temperature=temperature, seed=seed,
             top_k=top_k, top_p=top_p, kv_dtype=kv_dtype,
-            prefix_cache=store,
+            prefix_cache=store, speculative=drafter, draft_k=draft_k,
         )
         try:
             self._stepper = DecodeStepper(model, **self._stepper_cfg)
@@ -733,6 +1315,45 @@ class ServingEngine:
         self._failed = False  # permanently degraded (see _failed_reason)
         self._failed_reason = None
         self._last_crash = None
+
+    @staticmethod
+    def _resolve_drafter(speculative, draft_bundle, ngram_max):
+        """Map the engine-level speculation knobs onto a draft source
+        (None = speculation off)."""
+        if not speculative:
+            if draft_bundle is not None:
+                raise ValueError(
+                    "draft_bundle is only meaningful with speculative "
+                    "decoding enabled; pass speculative='draft'"
+                )
+            return None
+        if hasattr(speculative, "propose") and hasattr(
+            speculative, "bind"
+        ):
+            # any drafter-protocol object, not just the built-ins —
+            # the stepper duck-types the whole protocol
+            return speculative
+        if speculative is True:
+            speculative = "draft" if draft_bundle is not None else "ngram"
+        if speculative == "ngram":
+            return NgramDrafter(ngram_max=ngram_max)
+        if speculative == "draft":
+            if draft_bundle is None:
+                raise ValueError(
+                    "speculative='draft' needs draft_bundle= (a serving-"
+                    "bundle path or a model instance)"
+                )
+            if isinstance(draft_bundle, str):
+                from distkeras_tpu.utils.serialization import (
+                    load_serving_bundle,
+                )
+
+                draft_bundle = load_serving_bundle(draft_bundle)
+            return ModelDrafter(draft_bundle)
+        raise ValueError(
+            f"speculative must be falsy, True, 'ngram', 'draft', or a "
+            f"drafter instance; got {speculative!r}"
+        )
 
     @classmethod
     def from_bundle(cls, path: str, **kwargs) -> "ServingEngine":
@@ -1030,6 +1651,17 @@ class ServingEngine:
                 0 if batcher is None else len(batcher._quarantined)
             ),
         }
+        if batcher is not None and getattr(
+            self._stepper, "speculative", False
+        ):
+            # the load-balancer-facing acceptance aggregate: mean
+            # tokens emitted per verify window (1.0 = drafts never
+            # agree, draft_k+1 = ceiling); None until the first window
+            w = batcher.counters.get("spec_windows", 0)
+            out["speculative_tokens_per_window"] = (
+                round(batcher.counters["spec_tokens"] / w, 2)
+                if w else None
+            )
         out["heartbeat_age"] = (
             None
             if batcher is None or not self._started

@@ -28,6 +28,14 @@ pure-Python fake in the unit tests) with::
     release(slot)          # slot freed (bookkeeping hook)
     step(active) -> (num_slots,) int array, the token appended per slot
 
+Speculative steppers additionally expose ``speculative`` (truthy),
+``wants_sequences`` (the batcher then passes each active slot's host
+sequence so far), and ``spec_step(active, seqs) -> (toks, counts,
+used_verify)`` where ``toks`` is (num_slots, w) and row i's first
+``counts[i]`` entries are the tokens slot i emits this iteration —
+slots advance a VARIABLE 1..w tokens per step, so EOS / max-tokens /
+deadline checks run per emitted token, in emission order.
+
 Backpressure is explicit: a full queue rejects at ``submit`` with
 ``OverloadedError`` (the server turns that into an ``overloaded`` wire
 reply) instead of queueing unboundedly. Per-request deadlines are
@@ -244,7 +252,15 @@ class ContinuousBatcher:
             "internal_errors": 0,  # requests failed with InternalError
             "prefill_failures": 0,  # begin_admit / prefill_chunk raised
             "quarantines": 0,  # slots sent to probation
+            # speculative decode (stay 0 on non-speculative steppers)
+            "spec_windows": 0,  # slot-windows processed via verify
+            "spec_tokens": 0,  # tokens emitted from verify windows
+            "spec_draft_accepted": 0,  # emitted tokens the DRAFT sourced
         }
+        # per-slot acceptance ledger (lifetime): windows seen / tokens
+        # emitted per slot index — stats() reports the per-slot rates
+        self._spec_windows = np.zeros(stepper.num_slots, np.int64)
+        self._spec_emitted = np.zeros(stepper.num_slots, np.int64)
 
     # -- submission ---------------------------------------------------------
 
@@ -343,9 +359,26 @@ class ContinuousBatcher:
                 ],
                 bool,
             )
+            seqs = None
+            if active.any() and getattr(
+                self.stepper, "wants_sequences", False
+            ):
+                # host-side truth per slot: (prompt, emitted-so-far),
+                # handed over ZERO-COPY — only this thread mutates the
+                # token lists and only after the device call, so the
+                # drafter may materialize just the slots it actually
+                # searches (throttled slots cost nothing per iteration)
+                seqs = [
+                    (req.prompt, req.tokens)
+                    if req is not None and active[i]
+                    else None
+                    for i, req in enumerate(self._slots)
+                ]
         if not active.any():
             return progressed
-        toks, blamed = self._step_with_blame(active)
+        toks, counts, blamed, used_verify = self._step_with_blame(
+            active, seqs
+        )
         now = time.monotonic()
         with self._lock:
             self.counters["steps"] += 1
@@ -370,52 +403,102 @@ class ContinuousBatcher:
             for i, req in enumerate(self._slots):
                 if req is None or not active[i] or i in blamed_set:
                     continue
-                tok = int(toks[i])
-                req.tokens.append(tok)
-                if req.first_token is None:
-                    req.first_token = now
-                self.counters["tokens_generated"] += 1
-                finished = (
-                    len(req.tokens) >= req.max_new_tokens
-                    or (req.eos_id is not None and tok == req.eos_id)
-                )
-                if finished:
-                    self._evict(i, req, None)
-                elif req._expired(now):
-                    self._evict(
-                        i,
-                        req,
-                        DeadlineExceededError(
-                            f"deadline passed after {len(req.tokens)} tokens"
-                        ),
+                # variable advance: a slot emits 1..w tokens per
+                # iteration (speculative windows), so every budget /
+                # EOS / deadline check runs PER EMITTED TOKEN, in
+                # emission order — a window's tail past the first
+                # finish/expiry condition is never emitted
+                emitted = 0
+                for tok in np.atleast_1d(toks[i])[: int(counts[i])]:
+                    tok = int(tok)
+                    req.tokens.append(tok)
+                    emitted += 1
+                    if req.first_token is None:
+                        req.first_token = now
+                    self.counters["tokens_generated"] += 1
+                    finished = (
+                        len(req.tokens) >= req.max_new_tokens
+                        or (req.eos_id is not None and tok == req.eos_id)
                     )
+                    if finished:
+                        self._evict(i, req, None)
+                        break
+                    if req._expired(now):
+                        self._evict(
+                            i,
+                            req,
+                            DeadlineExceededError(
+                                f"deadline passed after "
+                                f"{len(req.tokens)} tokens"
+                            ),
+                        )
+                        break
+                if used_verify[i]:
+                    self.counters["spec_windows"] += 1
+                    self.counters["spec_tokens"] += emitted
+                    # the window's last token is the target's
+                    # correction; everything before it came from the
+                    # draft — attribution for the acceptance counters
+                    self.counters["spec_draft_accepted"] += max(
+                        0, min(emitted, int(counts[i]) - 1)
+                    )
+                    self._spec_windows[i] += 1
+                    self._spec_emitted[i] += emitted
         return True
 
     # -- blame assignment ----------------------------------------------------
 
-    def _step_with_blame(self, active):
-        """Advance the active slots one token, surviving a poison
-        request: when ``stepper.step`` raises, retry with the most-
-        recently-admitted active slot masked out (the prime suspect —
-        established streams were stepping fine before it arrived); if
-        the retry fails too, bisect the active set until the minimal
-        culpable slots are isolated. Every non-blamed slot advances
-        EXACTLY once (failed step calls advance nothing — the injection
-        seams fire before device work, and a real XLA failure aborts
-        the whole program), so surviving streams stay token-identical
-        to their solo decode. Returns ``(toks, blamed)``; ``toks`` is
+    def _device_step(self, active, seqs):
+        """One device advance, normalized to the variable-advance
+        shape: ``(toks (B, w), counts (B,), used_verify (B,))``. Plain
+        steppers advance every active slot exactly one token (w = 1);
+        speculative steppers route through ``spec_step`` (draft ->
+        verify -> 1..k+1 tokens per slot). ``used_verify`` is per-slot
+        so the acceptance ledger never counts a plain-step-fallback
+        advance as a verify window."""
+        st = self.stepper
+        if getattr(st, "speculative", False):
+            toks, counts, used = st.spec_step(active, seqs)
+            return (
+                np.asarray(toks),
+                np.asarray(counts),
+                np.asarray(active, bool) & bool(used),
+            )
+        toks = np.asarray(st.step(active))
+        return (
+            toks.reshape(-1, 1),
+            np.where(active, 1, 0).astype(np.int64),
+            np.zeros(len(active), bool),
+        )
+
+    def _step_with_blame(self, active, seqs=None):
+        """Advance the active slots one window, surviving a poison
+        request: when the device step (plain decode OR speculative
+        verify — both crash boundaries look identical from here) raises,
+        retry with the most-recently-admitted active slot masked out
+        (the prime suspect — established streams were stepping fine
+        before it arrived); if the retry fails too, bisect the active
+        set until the minimal culpable slots are isolated. Every
+        non-blamed slot advances EXACTLY one window (failed calls
+        advance nothing — the injection seams fire before device work,
+        a real XLA failure aborts the whole program, and speculative
+        retries re-verify the SAME cached draft proposals), so
+        surviving streams stay token-identical to their solo decode.
+        Returns ``(toks, counts, blamed, used_verify)``; ``toks`` is
         None when nothing advanced. An engine-level failure (every
         probe failing) blames all active slots — the supervisor's
         restart budget is the backstop for a stepper that is truly
         dead, not poisoned."""
         try:
-            return np.asarray(self.stepper.step(active)), []
+            toks, counts, used = self._device_step(active, seqs)
+            return toks, counts, [], used
         except Exception:  # noqa: BLE001 — device crash boundary
             with self._lock:
                 self.counters["step_failures"] += 1
         idxs = [int(i) for i in np.flatnonzero(active)]
         if len(idxs) == 1:
-            return None, idxs  # alone in the batch = culpable by elimination
+            # alone in the batch = culpable by elimination
+            return None, None, idxs, np.zeros(len(active), bool)
         with self._lock:
             suspect = max(idxs, key=lambda i: self._admit_order[i])
         retry = active.copy()
@@ -423,13 +506,13 @@ class ContinuousBatcher:
         try:
             with self._lock:
                 self.counters["blame_probes"] += 1
-            toks = np.asarray(self.stepper.step(retry))
-            return toks, [suspect]
+            toks, counts, used = self._device_step(retry, seqs)
+            return toks, counts, [suspect], used
         except Exception:  # noqa: BLE001
             pass
         # the newest admission alone is not the story: bisect the whole
         # active set (nothing has advanced yet — all probes so far failed)
-        got: dict[int, int] = {}
+        got: dict[int, tuple[np.ndarray, int, bool]] = {}
         blamed: list[int] = []
 
         def probe(group):
@@ -438,7 +521,7 @@ class ContinuousBatcher:
             try:
                 with self._lock:
                     self.counters["blame_probes"] += 1
-                t = np.asarray(self.stepper.step(mask))
+                t, cnt, u = self._device_step(mask, seqs)
             except Exception:  # noqa: BLE001
                 if len(group) == 1:
                     blamed.append(group[0])
@@ -448,15 +531,20 @@ class ContinuousBatcher:
                 probe(group[half:])
                 return
             for i in group:
-                got[i] = t[i]
+                got[i] = (np.atleast_1d(t[i]), int(cnt[i]), bool(u[i]))
 
         probe(idxs)
         if not got:
-            return None, blamed
-        toks = np.zeros(len(active), dtype=np.int64)
-        for i, v in got.items():
-            toks[i] = v
-        return toks, blamed
+            return None, None, blamed, np.zeros(len(active), bool)
+        w = max(row.shape[0] for row, _, _ in got.values())
+        toks = np.zeros((len(active), w), dtype=np.int64)
+        counts = np.zeros(len(active), dtype=np.int64)
+        used = np.zeros(len(active), bool)
+        for i, (row, cnt, u) in got.items():
+            toks[i, : row.shape[0]] = row
+            counts[i] = cnt
+            used[i] = u
+        return toks, counts, blamed, used
 
     def _quarantine_locked(self, i):
         """Send slot ``i`` to probation. Caller holds the lock."""
@@ -619,6 +707,33 @@ class ContinuousBatcher:
         out["mean_batch_occupancy"] = (
             out["occupancy_sum"] / steps if steps else 0.0
         )
+        st = self.stepper
+        if getattr(st, "speculative", False):
+            drafted = int(getattr(st, "spec_drafted_tokens", 0))
+            accepted = out["spec_draft_accepted"]
+            windows = out["spec_windows"]
+            out["speculative"] = {
+                "enabled": True,
+                "draft_source": st.drafter.name,
+                "draft_k": st.draft_k,
+                "verify_steps": int(st.spec_verify_steps),
+                "fallback_steps": int(st.spec_fallback_steps),
+                "windows": windows,
+                "drafted_tokens": drafted,
+                "accepted_draft_tokens": accepted,
+                "rejected_draft_tokens": max(0, drafted - accepted),
+                "emitted_tokens": out["spec_tokens"],
+                "mean_tokens_per_window": (
+                    round(out["spec_tokens"] / windows, 3)
+                    if windows else 0.0
+                ),
+                "per_slot_acceptance": [
+                    round(float(e) / w, 3) if w else None
+                    for e, w in zip(self._spec_emitted, self._spec_windows)
+                ],
+            }
+        else:
+            out["speculative"] = {"enabled": False}
         return out
 
     def wait_for_work(self, timeout=0.05):

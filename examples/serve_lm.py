@@ -33,8 +33,22 @@ def main():
     ap.add_argument("--vocab", type=int, default=32)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--speculative", action="store_true",
+                    help="serve with speculative decoding: model-free "
+                    "prompt-lookup drafting by default, or a trained "
+                    "draft LM with --draft-bundle; outputs stay exactly "
+                    "the greedy decode")
+    ap.add_argument("--draft-bundle", metavar="PATH", default=None,
+                    help="with --speculative: train a small draft LM, "
+                    "persist it as a quantized serving bundle at PATH, "
+                    "and serve draft-and-verify FROM THAT BUNDLE (the "
+                    "second-bundle flow a speculative serving host runs)")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
+    if args.draft_bundle and not args.speculative:
+        # fail BEFORE training, not after a long run
+        ap.error("--draft-bundle feeds the speculative drafter; "
+                 "pass --speculative too")
 
     from distkeras_tpu.parallel.backend import setup_backend
 
@@ -62,17 +76,43 @@ def main():
         batch_size=32, num_epoch=args.epochs, seed=0,
     ).train(ds)
 
+    # -- optionally train + export the DRAFT bundle --------------------------
+    spec_kw = {}
+    if args.speculative:
+        spec_kw = dict(speculative="ngram", draft_k=4)
+        if args.draft_bundle:
+            # quarter-width single-block draft: cheap enough that its
+            # per-round k+1 steps cost well under one target step
+            draft = zoo.transformer_lm(
+                vocab_size=args.vocab, seq_len=args.seq,
+                d_model=16, num_heads=2, depth=1, seed=1,
+            )
+            draft_t = SingleTrainer(
+                draft, "adam", loss="next_token_crossentropy",
+                learning_rate=2e-3, batch_size=32,
+                num_epoch=args.epochs, seed=0,
+            ).train(ds)
+            save_serving_bundle(
+                args.draft_bundle, quantize_model(draft_t.copy())
+            )
+            print(f"draft bundle: {os.path.getsize(args.draft_bundle)} "
+                  f"bytes at {args.draft_bundle}")
+            spec_kw = dict(speculative="draft",
+                           draft_bundle=args.draft_bundle, draft_k=4)
+
     # -- export the serving bundle, boot the engine from DISK ---------------
     with tempfile.TemporaryDirectory() as tmp:
         bundle = os.path.join(tmp, "lm_int8.dkt")
         save_serving_bundle(bundle, quantize_model(trained.copy()))
         print(f"serving bundle: {os.path.getsize(bundle)} bytes")
         engine = ServingEngine.from_bundle(
-            bundle, num_slots=args.slots, queue_capacity=32,
+            bundle, num_slots=args.slots, queue_capacity=32, **spec_kw,
         )
         server = ServingServer(engine).start()
         print(f"serving on {server.host}:{server.port} "
-              f"({args.slots} slots)")
+              f"({args.slots} slots"
+              + (f", speculative={spec_kw['speculative']}"
+                 if spec_kw else "") + ")")
 
         # -- concurrent mixed-length clients --------------------------------
         prompts = [
@@ -108,6 +148,15 @@ def main():
             print(f"stats: {st['completed']} completed, mean batch "
                   f"occupancy {st['mean_batch_occupancy']:.2f}, "
                   f"prefill buckets {st['compiled_prefill_buckets']}")
+            if args.speculative:
+                sp = st["speculative"]
+                print(f"speculative[{sp['draft_source']}]: "
+                      f"{sp['windows']} verify windows, "
+                      f"{sp['mean_tokens_per_window']:.2f} tokens/window, "
+                      f"{sp['accepted_draft_tokens']} draft tokens "
+                      f"accepted / {sp['rejected_draft_tokens']} "
+                      f"rejected, {sp['fallback_steps']} plain-step "
+                      f"fallbacks")
             c.stop()  # graceful: drains in-flight work, then closes
         server.shutdown()
         print("drained and stopped")

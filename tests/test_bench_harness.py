@@ -98,6 +98,27 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
     # the prefix-heavy workload actually HITS (the priming contract)
     assert rec["workloads"]["prefix_heavy"]["chunked_cached"][
         "prefix_cache"]["hits"] > 0
+    # speculative A/B schema: both traffic shapes, both sides, the
+    # acceptance ledger, and the identity flag (win/cost RATIOS are
+    # only meaningful in the full trained-model run, not at smoke
+    # scale — the committed artifact carries those)
+    spec = rec["speculative"]
+    assert spec["drafter"] == "ngram" and spec["draft_k"] >= 1
+    assert set(spec["workloads"]) == {
+        "spec_repetitive", "spec_incompressible"
+    }
+    for name, wl in spec["workloads"].items():
+        assert wl["outputs_identical"] is True, name
+        assert wl["tokens_per_sec_ratio"] > 0, name
+        for side in ("baseline", "speculative"):
+            assert wl[side]["tokens_per_sec"] > 0, (name, side)
+        acc = wl["acceptance"]
+        assert acc["windows"] + acc["fallback_steps"] > 0, name
+        assert acc["mean_tokens_per_window"] >= 0, name
+        assert (
+            acc["drafted_tokens"]
+            >= acc["accepted_draft_tokens"]
+        ), name
 
 
 def test_north_star_cite_reads_artifact(tmp_path):

@@ -75,6 +75,46 @@ def test_serve_lm_cpu():
     assert "drained and stopped" in out
 
 
+def test_serve_lm_speculative_cpu():
+    """--speculative (model-free prompt-lookup drafting): the serving
+    flow runs end to end with speculation on, every served decode
+    still counts upward (the identity guarantee through the verify
+    path), and the printed acceptance line parses."""
+    out = run_example("serve_lm.py", "--cpu", "--speculative")
+    rows = [l for l in out.splitlines() if l.startswith("served decode:")]
+    assert len(rows) == 4, out
+    for line in rows:
+        toks = [int(t) for t in line.split("[", 1)[1].rstrip("]").split(",")]
+        for a, b in zip(toks[-5:], toks[-4:]):
+            assert b == (a + 1) % 32, (toks, out)
+    line = next(l for l in out.splitlines()
+                if l.startswith("speculative[ngram]"))
+    assert "verify windows" in line and "fallbacks" in line
+    assert "drained and stopped" in out
+
+
+def test_serve_lm_draft_bundle_cpu(tmp_path):
+    """--speculative --draft-bundle: a SECOND serving bundle (the
+    trained draft LM) is persisted, the engine boots draft-and-verify
+    from it, and the trained draft buys real acceptance (> 1
+    token/window) while every decode still counts upward."""
+    bundle = str(tmp_path / "draft.dkt")
+    out = run_example("serve_lm.py", "--cpu", "--speculative",
+                      "--draft-bundle", bundle, timeout=600)
+    assert os.path.getsize(bundle) > 0
+    assert "draft bundle:" in out
+    rows = [l for l in out.splitlines() if l.startswith("served decode:")]
+    assert len(rows) == 4, out
+    for line in rows:
+        toks = [int(t) for t in line.split("[", 1)[1].rstrip("]").split(",")]
+        for a, b in zip(toks[-5:], toks[-4:]):
+            assert b == (a + 1) % 32, (toks, out)
+    line = next(l for l in out.splitlines()
+                if l.startswith("speculative[draft_lm]"))
+    rate = float(line.split(" tokens/window")[0].rsplit(" ", 1)[1])
+    assert rate > 1.0, line  # the trained draft actually accepts
+
+
 def test_language_model_int8_bundle_cpu(tmp_path):
     """--int8 --save-bundle: the decode demo runs a RAGGED batch from a
     serving bundle RELOADED off disk — quantize, persist, reload, serve,

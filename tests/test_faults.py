@@ -708,12 +708,52 @@ def test_health_reports_self_healing_fields(lm, served):
         assert st["status"] == "serving"
 
 
+def test_watchdog_restart_rebuilds_speculative_stepper(lm, lm_ref):
+    """A supervisor restart of a SPECULATIVE engine must rebuild the
+    whole draft+verify machinery (drafter re-bound to the fresh
+    stepper, verify pre-warmed) — post-restart traffic decodes
+    token-identical with speculation still live."""
+    from distkeras_tpu.serving import ServingEngine
+
+    prompt = np.arange(1, 6, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=6)[0]
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False,
+        speculative="draft", draft_bundle=lm, draft_k=3,
+        watchdog_interval=0.3, watchdog_grace=30.0,
+        max_restarts=3, restart_backoff=0.01,
+    ).start()
+    plan = (
+        FaultPlan()
+        .arm("scheduler.loop", times=1, after=2,
+             when=lambda ctx: ctx["busy"])
+    )
+    try:
+        with plan:
+            inflight = eng.submit(prompt, 20)
+            with pytest.raises(InternalError, match="scheduler crashed"):
+                inflight.result(timeout=10)
+            _wait(
+                lambda: eng.health()["status"] == "serving"
+                and eng.health()["restarts"] == 1,
+                msg="supervisor restart",
+            )
+            np.testing.assert_array_equal(eng.generate(prompt, 6), ref)
+            spec = eng.stats()["speculative"]
+            assert spec["enabled"] and spec["windows"] > 0
+    finally:
+        eng.stop()
+
+
 # ------------------------------------------------------------- soak smoke
 
 
 def test_soak_serving_smoke(lm):
     """The chaos soak harness runs end to end at smoke scale and meets
-    its own acceptance bar: zero hung requests, zero non-typed errors."""
+    its own acceptance bar: zero hung requests, zero non-typed errors,
+    zero corrupt outputs — now with SPECULATIVE serving on (self-draft)
+    so the ``stepper.verify`` seam sees real traffic and a crashed
+    verify rides the same blame machinery as a crashed step."""
     import sys
 
     sys.path.insert(0, "tools")
@@ -726,5 +766,8 @@ def test_soak_serving_smoke(lm):
     )
     assert summary["hung"] == 0
     assert summary["untyped_errors"] == 0
+    assert summary["corrupt_outputs"] == 0
     assert summary["completed"] > 0
     assert summary["faults_fired"] > 0
+    assert summary["fired_by_site"]["stepper.verify"] > 0
+    assert summary["speculative"]["windows"] > 0

@@ -1039,3 +1039,88 @@ def test_speculative_serves_moe_target():
     gen = SpeculativeGenerator(target, draft, k=3)
     got = gen.generate(prompts, steps=8)
     np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_k_past_budget_and_capacity():
+    """Edge pins: ``k`` larger than the remaining generation budget
+    (per round AND for the whole request), and a prompt decoding right
+    up against the sequence capacity with the draft window overrunning
+    both — the scratch-padded buffers must absorb every overrun write
+    and the output must stay exactly the target's greedy decode."""
+    from distkeras_tpu.predictors import (
+        CachedSequenceGenerator,
+        SpeculativeGenerator,
+    )
+
+    target = _ragged_lm(seed=0)
+    draft = zoo.transformer_lm(vocab_size=32, seq_len=24, d_model=16,
+                               num_heads=2, depth=1, seed=9)
+    ref = CachedSequenceGenerator(target)
+    rng = np.random.default_rng(20)
+    short = rng.integers(0, 32, (2, 5)).astype(np.int32)
+    for k, steps in [(7, 3), (5, 2), (4, 1)]:  # k >= budget
+        want = ref.generate(short, steps=steps)
+        gen = SpeculativeGenerator(target, draft, k=k)
+        np.testing.assert_array_equal(
+            gen.generate(short, steps=steps), want
+        )
+        assert (gen.last_rounds <= steps).all()
+    # capacity bound: prompt 20 of 24, k spans far past the end; a
+    # self-draft run must still finish in ONE fully-accepted round
+    long = rng.integers(0, 32, (2, 20)).astype(np.int32)
+    want = ref.generate(long, steps=4)
+    np.testing.assert_array_equal(
+        SpeculativeGenerator(target, draft, k=7).generate(long, steps=4),
+        want,
+    )
+    gen = SpeculativeGenerator(target, target, k=7)
+    np.testing.assert_array_equal(gen.generate(long, steps=4), want)
+    assert (gen.last_rounds == 1).all(), gen.last_rounds
+
+
+def test_speculative_eos_mid_draft_window():
+    """Edge pin: ``eos_id`` landing in the MIDDLE of a draft window —
+    both on a disagreeing draft (eos arrives as the correction token)
+    and on a self-draft (eos inside a fully-accepted window, with
+    accepted tokens trailing it) — must trim exactly like the cached
+    generator, including eos on the very first generated token."""
+    from distkeras_tpu.predictors import (
+        CachedSequenceGenerator,
+        SpeculativeGenerator,
+    )
+
+    target = _ragged_lm(seed=0)
+    draft = zoo.transformer_lm(vocab_size=32, seq_len=24, d_model=16,
+                               num_heads=2, depth=1, seed=9)
+    ref = CachedSequenceGenerator(target)
+    rng = np.random.default_rng(21)
+    prompts = rng.integers(0, 32, (2, 5)).astype(np.int32)
+    full = ref.generate(prompts, steps=10)
+    for eos_at in (0, 3):  # first generated token / mid-window
+        eos = int(full[0, 5 + eos_at])
+        want = ref.generate(prompts, steps=10, eos_id=eos)
+        for d in (draft, target):
+            got = SpeculativeGenerator(target, d, k=4).generate(
+                prompts, steps=10, eos_id=eos
+            )
+            assert isinstance(got, list)
+            for a, b in zip(got, want):
+                np.testing.assert_array_equal(a, b)
+
+
+def test_speculative_k1_degenerates_to_plain_greedy():
+    """Edge pin: ``k=1`` is one proposal per round — the floor of the
+    scheme. Output equals plain greedy exactly, and with a self-draft
+    every round accepts 2 tokens (rounds == ceil(steps/2))."""
+    from distkeras_tpu.predictors import (
+        CachedSequenceGenerator,
+        SpeculativeGenerator,
+    )
+
+    target = _ragged_lm(seed=1)
+    rng = np.random.default_rng(22)
+    prompts = rng.integers(0, 32, (3, 4)).astype(np.int32)
+    want = CachedSequenceGenerator(target).generate(prompts, steps=9)
+    gen = SpeculativeGenerator(target, target, k=1)
+    np.testing.assert_array_equal(gen.generate(prompts, steps=9), want)
+    assert (gen.last_rounds == -(-9 // 2)).all(), gen.last_rounds

@@ -79,6 +79,37 @@ class FakeStepper:
         return toks
 
 
+class FakeSpecStepper(FakeStepper):
+    """Variable-advance fake: every verify call emits a WINDOW of
+    ``window`` tokens per active slot (the speculative contract),
+    token values following the same slot/sequence scheme as
+    ``FakeStepper`` so emission order stays visible."""
+
+    speculative = True
+    wants_sequences = False
+    draft_k = 3
+
+    def __init__(self, num_slots=2, max_len=32, base=1000, window=3):
+        super().__init__(num_slots, max_len, base)
+        self.window = window
+        self.spec_verify_steps = 0
+        self.spec_fallback_steps = 0
+        self.spec_drafted_tokens = 0
+        self.drafter = type("D", (), {"name": "fake"})()
+
+    def spec_step(self, active, seqs=None):
+        active = np.asarray(active, bool)
+        w = self.window
+        toks = np.zeros((self.num_slots, w), int)
+        for i in np.flatnonzero(active):
+            for c in range(w):
+                self._n[i] += 1
+                toks[i, c] = self.base + i * 100 + self._n[i]
+        self.spec_verify_steps += 1
+        self.spec_drafted_tokens += (w - 1) * int(active.sum())
+        return toks, np.where(active, w, 0), True
+
+
 def _req(plen=3, max_new=4, **kw):
     return ServeRequest(np.arange(1, plen + 1), max_new, **kw)
 
@@ -287,6 +318,71 @@ def test_latency_splits_queue_prefill_decode():
         assert lat["total"] >= lat["ttft"]
     # r1 waited in the queue while r0 held the only slot
     assert r1.latency()["queue_wait"] >= r0.latency()["prefill"]
+
+
+# ------------------------------------------- speculative scheduler units
+
+
+def test_spec_variable_advance_and_budget_cap_per_token():
+    """A slot may emit 1..k+1 tokens per iteration; the max-tokens
+    budget is checked PER EMITTED TOKEN, so a window overrunning the
+    budget emits exactly up to it and frees the slot the same
+    iteration."""
+    st = FakeSpecStepper(num_slots=1, window=3)
+    b = ContinuousBatcher(st)
+    r = b.submit(_req(max_new=5))
+    b.step()
+    assert len(r.tokens) == 3 and not r.done
+    b.step()  # window of 3, budget leaves room for 2
+    assert r.done and len(r.tokens) == 5
+    assert r.result().tolist() == [1, 2, 3, 1001, 1002, 1003, 1004, 1005]
+    assert st.released == [0]
+    s = b.stats()
+    assert s["spec_windows"] == 2 and s["spec_tokens"] == 5
+    # draft attribution: every non-final window token is draft-sourced
+    assert s["spec_draft_accepted"] == 2 + 2
+    assert s["speculative"]["enabled"]
+    assert s["speculative"]["draft_source"] == "fake"
+    assert s["speculative"]["mean_tokens_per_window"] == 2.5
+    assert s["speculative"]["per_slot_acceptance"][0] == 2.5
+
+
+def test_spec_eos_mid_window_frees_slot_same_iteration():
+    """EOS landing mid-window: the tokens after it are NEVER emitted,
+    the request completes trimmed, and the slot is free for the next
+    queued request the same iteration it accepted its EOS."""
+    st = FakeSpecStepper(num_slots=1, window=4)
+    b = ContinuousBatcher(st)
+    r0 = b.submit(_req(max_new=10, eos_id=1002))  # 2nd token of window 1
+    r1 = b.submit(_req(max_new=2, eos_id=None))
+    b.step()
+    assert r0.done and len(r0.tokens) == 2  # window tail dropped
+    assert r0.result().tolist() == [1, 2, 3, 1001, 1002]
+    assert st.released == [0]
+    b.step()  # freed slot picked r1 up
+    assert r1.done and len(r1.tokens) == 2
+    assert b.stats()["spec_tokens"] == 4
+
+
+def test_spec_deadline_mid_window_stops_emission():
+    """A deadline that expired while the window was computing must not
+    keep emitting: at most the in-flight token lands (the plain-step
+    semantics), the rest of the window is dropped, and the request
+    fails typed with its slot freed the same iteration."""
+    st = FakeSpecStepper(num_slots=1, window=4)
+    b = ContinuousBatcher(st)
+    r = b.submit(_req(max_new=20, deadline=time.monotonic() + 0.05))
+    b.step()
+    assert len(r.tokens) == 4 and not r.done  # within budget
+    time.sleep(0.08)  # the deadline expires while "computing"
+    b.step()
+    assert r.done
+    with pytest.raises(DeadlineExceededError):
+        r.result()
+    # exactly ONE in-flight token landed (the plain-step semantics);
+    # the window's post-deadline tail was dropped
+    assert len(r.tokens) == 5
+    assert st.released == [0]
 
 
 # ------------------------------------------------------------ prefix store
@@ -568,6 +664,166 @@ def test_stepper_prefix_cache_hit_matches_solo_decode(lm, lm_ref):
     while left:
         left = st.prefill_chunk(0, 4)
     assert _decode_slot(st, 0, 6) == ref_ext[26:].tolist()
+
+
+def test_stepper_spec_ngram_matches_solo_decode_all_paths(lm, lm_ref):
+    """Speculative decode with the model-free prompt-lookup drafter
+    must stay token-identical to solo greedy decode across EVERY
+    admission path — full, chunked, and prefix-cache hit — for both
+    repetitive prompts (where proposals actually fire) and random ones
+    (rejection-heavy)."""
+    from distkeras_tpu.serving import NgramDrafter, PrefixStore
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    store = PrefixStore(max_bytes=8 << 20)
+    st = DecodeStepper(
+        lm, num_slots=2, prefix_cache=store,
+        speculative=NgramDrafter(), draft_k=4,
+    )
+    rng = np.random.default_rng(23)
+    rep = np.array([5, 9, 5, 9, 5, 9, 5, 9, 5], np.int32)
+    rnd = rng.integers(0, 61, 13).astype(np.int32)
+
+    def spec_decode(slot, prompt, steps):
+        out = []
+        while len(out) < steps:
+            active = np.zeros(st.num_slots, bool)
+            active[slot] = True
+            seqs = [None] * st.num_slots
+            seqs[slot] = np.concatenate(
+                [prompt, np.asarray(out, np.int32)]
+            )
+            toks, counts, _ = st.spec_step(active, seqs)
+            out.extend(
+                int(t) for t in np.atleast_1d(toks[slot])[: counts[slot]]
+            )
+        return out[:steps]
+
+    # full admission (repetitive AND random), slots side by side
+    for slot, prompt in ((0, rep), (1, rnd)):
+        st.admit(slot, prompt)
+    for slot, prompt in ((0, rep), (1, rnd)):
+        ref = lm_ref.generate(prompt[None], steps=7)[0]
+        assert spec_decode(slot, prompt, 7) == ref[prompt.size:].tolist()
+        st.release(slot)
+    assert st.spec_verify_steps > 0  # the repetitive prompt proposed
+    # chunked admission
+    left = st.begin_admit(0, rep)
+    while left:
+        left = st.prefill_chunk(0, 3)
+    ref = lm_ref.generate(rep[None], steps=6)[0]
+    assert spec_decode(0, rep, 6) == ref[rep.size:].tolist()
+    st.release(0)
+    # prefix-cache hit admission (two-touch: second admit stores)
+    st.admit(1, rnd)
+    st.release(1)
+    st.admit(1, rnd)
+    st.release(1)
+    left = st.begin_admit(1, rnd)
+    # 12 prefill positions: the len-8 ladder rung restores, the
+    # sub-rung tail chunks — the combined admission path
+    assert 0 < left < rnd.size - 1 and store.stats()["hits"] >= 1
+    while left:
+        left = st.prefill_chunk(1, 3)
+    ref = lm_ref.generate(rnd[None], steps=6)[0]
+    assert spec_decode(1, rnd, 6) == ref[rnd.size:].tolist()
+
+
+def test_stepper_spec_self_draft_is_the_ceiling(lm, lm_ref):
+    """A draft that always agrees (the target itself) accepts k+1
+    tokens every window — the serving-tier sibling of the solo
+    generator's ceiling pin — while output stays exactly greedy."""
+    from distkeras_tpu.serving.engine import DecodeStepper, ModelDrafter
+
+    st = DecodeStepper(
+        lm, num_slots=2, speculative=ModelDrafter(lm), draft_k=3,
+    )
+    rng = np.random.default_rng(24)
+    prompt = rng.integers(0, 61, 6).astype(np.int32)
+    ref = lm_ref.generate(prompt[None], steps=12)[0]
+    st.admit(0, prompt)
+    out = []
+    active = np.array([True, False])
+    while len(out) < 12:
+        toks, counts, used = st.spec_step(active)
+        assert used and counts[0] == 4  # every window fully accepted
+        out.extend(int(t) for t in toks[0][: counts[0]])
+    assert out[:12] == ref[6:].tolist()
+    assert st.spec_verify_steps == 3 and st.spec_fallback_steps == 0
+
+
+@pytest.mark.chaos
+def test_spec_verify_crash_blamed_like_decode_step(lm, lm_ref):
+    """The stepper.verify seam: a crashing verify must ride the SAME
+    blame machinery as a crashing decode step — the newest admission
+    fails typed and is quarantined, the survivor keeps its window-
+    exact stream (cached proposals re-verified, never re-drafted)."""
+    from distkeras_tpu import faults
+    from distkeras_tpu.serving import InternalError
+    from distkeras_tpu.serving.engine import DecodeStepper, ModelDrafter
+
+    st = DecodeStepper(
+        lm, num_slots=2, speculative=ModelDrafter(lm), draft_k=3,
+    )
+    b = ContinuousBatcher(st, quarantine_steps=3)
+    rng = np.random.default_rng(25)
+    p0 = rng.integers(0, 61, 5).astype(np.int32)
+    p1 = rng.integers(0, 61, 8).astype(np.int32)
+    ref0 = lm_ref.generate(p0[None], steps=8)[0]
+    r0 = b.submit(ServeRequest(p0, 8))
+    b.step()  # r0 decoding alone, one clean window
+    r1 = b.submit(ServeRequest(p1, 8))
+    with faults.FaultPlan(seed=0).arm("stepper.verify", times=1):
+        while not (r0.done and r1.done):
+            assert b.step() or not b.idle
+    with pytest.raises(InternalError, match="blamed"):
+        r1.result()  # newest admission took the blame
+    np.testing.assert_array_equal(r0.result(), ref0)  # survivor exact
+    s = b.stats()
+    assert s["step_failures"] == 1 and s["quarantines"] == 1
+    assert s["blame_probes"] >= 1
+
+
+def test_engine_speculative_wiring_and_validation(lm, lm_ref):
+    """Engine-level knobs: speculative='ngram' serves token-identical
+    output with the stats/health surfaces filled in; misconfigs raise
+    at construction instead of demoting the engine to predict-only."""
+    from distkeras_tpu.serving import ServingEngine
+
+    eng = ServingEngine(
+        lm, num_slots=2, speculative="ngram", draft_k=4
+    ).start()
+    try:
+        prompt = np.array([4, 11, 4, 11, 4, 11, 4], np.int32)
+        ref = lm_ref.generate(prompt[None], steps=8)[0]
+        np.testing.assert_array_equal(eng.generate(prompt, 8), ref)
+        st = eng.stats()
+        spec = st["speculative"]
+        assert spec["enabled"] and spec["draft_source"] == "ngram"
+        assert spec["draft_k"] == 4
+        assert spec["windows"] + spec["fallback_steps"] > 0
+        assert "per_slot_acceptance" in spec
+        assert "speculative_tokens_per_window" in eng.health()
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError, match="GREEDY"):
+        ServingEngine(lm, speculative="ngram", temperature=0.7)
+    with pytest.raises(ValueError, match="draft_bundle"):
+        ServingEngine(lm, speculative="draft")
+    with pytest.raises(ValueError, match="draft_bundle"):
+        ServingEngine(lm, draft_bundle="/nope.dkt")  # without speculative
+    # the drafter protocol is duck-typed: a custom drafter instance is
+    # accepted as-is, not just the built-ins
+    from distkeras_tpu.serving import NgramDrafter
+
+    class CustomDrafter(NgramDrafter):
+        name = "custom"
+
+    eng = ServingEngine(lm, num_slots=1, speculative=CustomDrafter())
+    try:
+        assert eng.stats()["speculative"]["draft_source"] == "custom"
+    finally:
+        eng.stop()
 
 
 def test_engine_defaults_expose_prefix_and_chunk_knobs(lm):

@@ -34,11 +34,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_soak(model=None, clients=4, duration=5.0, seed=0,
-             fault_every=7, max_new=6) -> dict:
+             fault_every=7, max_new=6, speculative=True) -> dict:
     """Drive the soak; returns the summary dict (also what ``main``
     prints). ``fault_every``: mean steps between injected device-step
     faults (the blame-path pressure); wire faults ride fixed seeded
-    probabilities. ``model=None`` builds the standard tiny LM."""
+    probabilities. ``model=None`` builds the standard tiny LM.
+    ``speculative``: serve draft-and-verify (a self-draft — every
+    window fully accepted, so the ``stepper.verify`` seam fires every
+    iteration); outputs must STILL match solo decode under chaos."""
     import numpy as np
 
     from distkeras_tpu.faults import FaultPlan
@@ -74,6 +77,14 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         watchdog_interval=1.0, watchdog_grace=60.0,
         max_restarts=10_000,  # the soak outlives scheduler crashes
         restart_backoff=0.01, quarantine_steps=8,
+        # self-draft: k proposals that always agree, so every scheduler
+        # iteration runs the VERIFY program and the armed stepper.verify
+        # seam sees real traffic
+        **(
+            dict(speculative="draft", draft_bundle=model, draft_k=3)
+            if speculative
+            else {}
+        ),
     )
     server = ServingServer(engine, retry_after_ms=20.0).start()
     for p in prompts:  # fault-free warmup: compile every bucket + the step
@@ -82,6 +93,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     plan = (
         FaultPlan(seed=seed)
         .arm("stepper.step", times=None, probability=1.0 / fault_every)
+        .arm("stepper.verify", times=None, probability=1.0 / fault_every)
         .arm("server.reply", action="drop", times=None, probability=0.03)
         .arm("net.send", action="reset", times=None, probability=0.01)
         .arm("net.send", action="truncate", times=None, probability=0.01)
@@ -144,7 +156,8 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     summary["faults_fired"] = plan.fired()
     summary["fired_by_site"] = {
         s: plan.fired(s)
-        for s in ("stepper.step", "server.reply", "net.send")
+        for s in ("stepper.step", "stepper.verify", "server.reply",
+                  "net.send")
     }
     engine_stats = engine.stats()
     summary["engine"] = {
@@ -155,6 +168,13 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
             "completed", "rejected_overloaded",
         )
     }
+    if speculative:
+        summary["speculative"] = {
+            k: engine_stats["speculative"][k]
+            for k in ("windows", "mean_tokens_per_window",
+                      "fallback_steps", "drafted_tokens",
+                      "accepted_draft_tokens", "rejected_draft_tokens")
+        }
     server.shutdown()
     summary["ok"] = (
         hung == 0
@@ -172,6 +192,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--fault-every", type=int, default=7,
                     help="mean scheduler steps between injected step faults")
+    ap.add_argument("--no-speculative", action="store_true",
+                    help="serve plain decode instead of self-draft "
+                         "speculative (disarms the stepper.verify seam's "
+                         "traffic)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU platform before JAX initializes")
     args = ap.parse_args(argv)
@@ -184,6 +208,7 @@ def main(argv=None) -> int:
     summary = run_soak(
         clients=args.clients, duration=args.duration, seed=args.seed,
         fault_every=args.fault_every,
+        speculative=not args.no_speculative,
     )
     json.dump(summary, sys.stdout, indent=2, default=str)
     print()
