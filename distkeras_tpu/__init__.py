@@ -51,6 +51,13 @@ from distkeras_tpu.evaluators import (
 )
 from distkeras_tpu.faults import FaultPlan, InjectedFault
 from distkeras_tpu.networking import RetryPolicy
+from distkeras_tpu.parameter_servers import (
+    CommitNotAcknowledgedError,
+    ParameterServerError,
+    RemoteParameterServerClient,
+    SocketParameterServer,
+    StandbyError,
+)
 from distkeras_tpu.serving import (
     ServingClient,
     ServingEngine,

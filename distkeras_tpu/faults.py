@@ -22,6 +22,19 @@ Seam catalogue (the hook points that exist today)::
     server.reply        ServingServer before sending a reply frame
     net.send            networking.send_data (both PS and serving wire)
     net.recv            networking.recv_data
+    ps.pull             ParameterServer.pull, client-facing entry (both
+                        the in-process and socket transports), before
+                        any state is read
+    ps.commit           ParameterServer.commit, client-facing entry,
+                        before decompress/dedup/apply — an injected
+                        raise rejects the commit wholesale, so the
+                        worker's commit_id resend is the recovery path
+                        (replication applies are NOT client commits and
+                        do not re-fire this seam)
+    ps.replicate        primary-side replication sink, before the
+                        commit record is forwarded to a warm standby
+                        (failure detaches the sink; the standby
+                        re-syncs with a fresh snapshot attach)
 
 Actions::
 
@@ -69,6 +82,9 @@ SITES = frozenset(
         "server.reply",
         "net.send",
         "net.recv",
+        "ps.pull",
+        "ps.commit",
+        "ps.replicate",
     }
 )
 

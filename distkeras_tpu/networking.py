@@ -61,6 +61,29 @@ def connect(host: str, port: int, timeout=30.0) -> socket.socket:
     return sock
 
 
+def connect_any(endpoints, timeout=30.0, start=0):
+    """Dial a list of ``(host, port)`` endpoints in rotation starting at
+    index ``start``; return ``(sock, index)`` of the first that answers.
+
+    THE multi-endpoint dial for replicated services (the PS primary +
+    warm-standby pair): a caller that remembers the returned index keeps
+    talking to the endpoint that last worked and only rotates onward when
+    it dies, so failover is sticky rather than thrashing. Raises the last
+    dial error when every endpoint refuses."""
+    endpoints = list(endpoints)
+    if not endpoints:
+        raise ValueError("connect_any needs at least one endpoint")
+    last_err = None
+    for k in range(len(endpoints)):
+        i = (start + k) % len(endpoints)
+        host, port = endpoints[i]
+        try:
+            return connect(host, port, timeout=timeout), i
+        except OSError as e:
+            last_err = e
+    raise last_err
+
+
 def send_data(sock: socket.socket, payload: bytes) -> None:
     act = faults.fire("net.send", nbytes=len(payload))
     if act is not None:

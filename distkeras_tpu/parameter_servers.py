@@ -10,8 +10,30 @@ same pull/commit verbs and per-algorithm commit rules, re-homed for TPU:
 - In-process workers (threads driving per-chip windows) call ``pull`` /
   ``commit`` directly under a lock — the single-host fast path.
 - ``SocketParameterServer`` serves the same PS object over TCP for
-  cross-host (DCN) workers, with the reference's one-byte action protocol:
-  b"p" pull, b"c" commit, b"s" stop.
+  cross-host (DCN) workers, with the reference's one-byte action protocol
+  (b"p" pull, b"c" commit, b"s" stop) extended with b"a" (replica attach)
+  and a one-byte reply status (b"k" ok / b"e" + typed error frame) so a
+  protocol error can never silently desync the stream.
+
+Replication & failover (no reference counterpart — upstream's PS death
+kills the whole run):
+
+- any ``ParameterServer`` can stream to warm standbys: ``attach_replica``
+  hands the sink a consistent snapshot (center + meta + dedup table +
+  worker snapshots) taken INSIDE the commit lock, then every post-dedup
+  commit is forwarded in apply order over the same channel, semi-
+  synchronously (the committer's ack implies the standby applied) — the
+  standby's center, version counters, and exactly-once bookkeeping stay
+  commit-identical to the primary's;
+- ``SocketParameterServer(standby_of=(host, port))`` runs the standby
+  side: sync on start, follow the replication stream, re-attach (fresh
+  snapshot) if only the stream dies, and PROMOTE to primary when the
+  primary itself is gone; while in standby role, client verbs are
+  refused with a typed ``standby`` error;
+- ``RemoteParameterServerClient`` accepts an endpoint list and fails
+  over through ``networking.RetryPolicy``, transparently resending
+  ``commit_id``-tagged commits — safe exactly-once, because the dedup
+  table rode the replication stream.
 
 Every commit rule is also exposed as a pure function
 (``center', meta' = RULE(center, meta, delta, tag)``) so tests can assert
@@ -22,6 +44,7 @@ from __future__ import annotations
 
 import logging
 import socket
+import struct
 import threading
 import time
 
@@ -30,7 +53,7 @@ logger = logging.getLogger(__name__)
 import jax
 import numpy as np
 
-from distkeras_tpu import networking
+from distkeras_tpu import faults, networking
 from distkeras_tpu.utils.serialization import (
     deserialize_params,
     pack_frame,
@@ -55,6 +78,110 @@ def _to_host(tree):
         return a.astype(np.float32, copy=False)
 
     return jax.tree.map(conv, tree)
+
+
+# -------------------------------------------------------------- typed errors
+
+
+class ParameterServerError(ConnectionError):
+    """Typed PS protocol failure. Subclasses ``ConnectionError`` on
+    purpose: every retry surface in the repo (``RetryPolicy.call``'s
+    default ``retry_on``, the client's failover wrapper, worker retry)
+    already treats connection errors as retriable, and every PS protocol
+    error IS retriable — commits are exactly-once under resend by the
+    dedup table, pulls are idempotent."""
+
+    # a typed error FRAME arrived, so the connection is still framed
+    # correctly: the client may retry in place without redialing.
+    # Subclasses born from a dead/desynced stream override this.
+    stream_in_sync = True
+
+    def __init__(self, code: str, detail=None):
+        msg = f"parameter server error: {code}"
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.code = code
+        self.detail = detail
+
+
+class StandbyError(ParameterServerError):
+    """The dialed endpoint is a warm standby that has not (yet) promoted.
+    Retriable by design: during a failover there is a window between the
+    primary dying and the standby noticing; a policy-paced retry rides
+    it out."""
+
+    def __init__(self, detail=None):
+        super().__init__("standby", detail)
+
+
+class CommitNotAcknowledgedError(ParameterServerError):
+    """A commit's ack never arrived (stream died, or the reply was not a
+    valid status byte). Carries ``commit_id`` so the caller — and the
+    log line a human reads at 3am — knows WHICH commit is in doubt; with
+    a ``commit_id`` the resend is exactly-once (PS dedup), without one
+    the caller must treat the commit as lost."""
+
+    stream_in_sync = False  # the ack never framed: the stream is suspect
+
+    def __init__(self, commit_id=None, detail=None):
+        msg = f"commit {commit_id} not acknowledged"
+        if detail:
+            msg += f" ({detail})"
+        ConnectionError.__init__(self, msg)
+        self.code = "commit_not_acknowledged"
+        self.detail = detail
+        self.commit_id = commit_id
+
+
+# ------------------------------------------------------- commit wire helpers
+# One encoding of a commit (and one decoder) shared by the worker->PS path
+# and the primary->standby replication stream, so the two cannot drift.
+
+
+def _pack_commit(tree_delta, tag, commit_id, local_snap) -> bytes:
+    header = {
+        "tag": tag,
+        "commit_id": list(commit_id) if commit_id is not None else None,
+    }
+    tree = tree_delta
+    if local_snap is not None:
+        # worker-local checkpoint state rides the same frame ("wrapped"
+        # layout) so remote/DCN workers — and the standby's custody table
+        # — keep full resume parity with in-process ones
+        header["wrapped"] = True
+        tree = {"delta": tree_delta, "snap": local_snap}
+    return pack_frame(header, serialize_params(tree))
+
+
+def _apply_commit_payload(ps: "ParameterServer", data: bytes,
+                          _via: str = "client") -> None:
+    header, blob = unpack_frame(data)
+    commit_id = header.get("commit_id")
+    if commit_id is not None:
+        commit_id = (commit_id[0], commit_id[1])
+    tree = deserialize_params(blob)
+    local_snap = None
+    if header.get("wrapped"):
+        local_snap = tree.get("snap")
+        tree = tree["delta"]
+    ps.commit(
+        tree,
+        header.get("tag"),
+        commit_id=commit_id,
+        local_snap=local_snap,
+        _via=_via,
+    )
+
+
+def _send_error(conn: socket.socket, code: str, **extra) -> None:
+    """Typed error reply: status byte b"e" + an error frame. Best-effort —
+    the peer may already be gone."""
+    try:
+        conn.sendall(b"e")
+        networking.send_data(conn, pack_frame({"error": code, **extra}))
+    except OSError:
+        pass
 
 
 # --------------------------------------------------------------------- rules
@@ -134,10 +261,33 @@ class ParameterServer:
         # section as its own commit) — behind is fine (the replayed windows
         # dedup), ahead would silently lose commits on resume.
         self._worker_snaps = {}  # worker_id -> host-copy state dict
+        # warm-standby replication: sinks registered by attach_replica.
+        # Applied (post-dedup) commits forward to every sink INSIDE the
+        # commit lock — apply order IS replication order — and each sink
+        # awaits the standby's ack before returning, so by the time the
+        # committing worker gets ITS ack the standby has applied too
+        # (semi-synchronous: worker-acked implies standby-applied; a
+        # non-acked commit is resent and deduped on whichever PS serves).
+        # A failing sink is detached and closed; its standby re-syncs
+        # with a fresh snapshot attach rather than trusting a gapped log.
+        self._replicas = []
+        self.replication_drops = 0
+        # durability gate (require_replicas): when > 0, client commits are
+        # REFUSED (typed, retriable "no_replica") while fewer than this
+        # many sinks are live — including the resend of a commit that was
+        # applied right as its sink died. Closes the semi-sync hole where
+        # a commit acked during a replication outage dies with the
+        # primary: nothing is acked unless a live sink either received it
+        # or attached later with a snapshot that contains it. The goal is
+        # kept separately so promotion can relax the gate (sole survivor:
+        # availability over durability) and a rejoining standby's attach
+        # re-arms it.
+        self.min_replicas = 0
+        self._min_replicas_goal = 0
 
     # -- protocol verbs -----------------------------------------------------
 
-    def pull(self, worker_id=None):
+    def pull(self, worker_id=None, _via="client"):
         """Return (copy of center, tag). Tag is None unless versioned.
 
         With ``pull_compress="bfloat16"`` (set by the trainer) the center
@@ -145,6 +295,10 @@ class ParameterServer:
         workers decode via ``utils.compression.maybe_decode_pull``. The
         encode happens here, transport-independently, so simulated and
         socket runs see identical pulled values."""
+        if _via == "client":
+            # explicit chaos hook: fires for worker-facing pulls on BOTH
+            # transports (in-process and socket), never for replication
+            faults.fire("ps.pull", worker_id=worker_id)
         with self._lock:
             center = jax.tree.map(np.copy, self._center)
             tag = self._pull_tag()
@@ -160,7 +314,8 @@ class ParameterServer:
             center = int8_encode_tree(center)
         return center, tag
 
-    def commit(self, delta, tag=None, commit_id=None, local_snap=None):
+    def commit(self, delta, tag=None, commit_id=None, local_snap=None,
+               _via="client"):
         """Apply a delta. ``commit_id=(worker_id, seq)`` makes the commit
         exactly-once: a retried worker re-sends seq numbers the PS has
         already absorbed and they are dropped (counted in meta
@@ -174,18 +329,49 @@ class ParameterServer:
 
         Int8-compressed deltas (``utils.compression``, the workers'
         ``compress="int8"`` wire format) are reconstructed here, before
-        the rule — every PS rule and transport sees plain float trees."""
+        the rule — every PS rule and transport sees plain float trees.
+        Replication forwards the DECOMPRESSED tree, so the standby applies
+        bit-identical values regardless of the worker's wire format.
+
+        ``_via``: "client" for worker-facing commits (the ``ps.commit``
+        chaos seam fires); "replicate" for a standby applying its
+        primary's forwarded stream (no seam — an injected fault there
+        would silently desync the replica instead of exercising a real
+        recovery path)."""
         from distkeras_tpu.utils.compression import maybe_decompress
 
+        if _via == "client":
+            # explicit chaos hook, BEFORE any state change: an injected
+            # raise rejects the commit wholesale and the worker's
+            # commit_id resend is the (exactly-once) recovery path
+            faults.fire("ps.commit", commit_id=commit_id, tag=tag)
         delta = maybe_decompress(delta)
         snap = None
         with self._lock:
+            if (
+                _via == "client"
+                and self.min_replicas
+                and len(self._replicas) < self.min_replicas
+            ):
+                # durability gate: nothing — new commit OR dedup resend —
+                # is acked while replication is below requirement; the
+                # caller's policy-paced retry rides out the standby's
+                # re-attach (which re-arms the gate and, via its fresh
+                # snapshot, covers everything applied meanwhile)
+                raise ParameterServerError(
+                    "no_replica",
+                    detail=f"{len(self._replicas)} of "
+                           f"{self.min_replicas} required replicas attached",
+                )
             if commit_id is not None:
                 wid, seq = commit_id
                 self._activity[wid] = time.monotonic()
                 if local_snap is not None:
                     self._worker_snaps[wid] = local_snap
                 if seq <= self._seen_seq.get(wid, -1):
+                    # deduped replay: NOT forwarded — the standby saw the
+                    # original via the stream, so its state (and its own
+                    # dedup table) already covers this seq
                     self._meta["num_duplicates"] = (
                         self._meta.get("num_duplicates", 0) + 1
                     )
@@ -193,6 +379,22 @@ class ParameterServer:
                 self._seen_seq[wid] = seq
             self._center, self._meta = type(self).commit_rule(
                 self._center, self._meta, delta, tag
+            )
+            if self._replicas:
+                self._forward_to_replicas(delta, tag, commit_id, local_snap)
+            # the sink died DURING this commit's forward: applied locally
+            # but not durably — refuse the ack (flagged here, raised only
+            # AFTER the snapshot bookkeeping below: the commit IS applied
+            # and its num_updates step must not lose its checkpoint
+            # cadence slot, because the deduped resend early-returns and
+            # would never revisit it). The resend is gated until a sink
+            # re-attaches, whose snapshot contains this commit, and is
+            # then deduped and acked: exactly-once with no unreplicated
+            # ack ever issued.
+            repl_lost = (
+                _via == "client"
+                and self.min_replicas
+                and len(self._replicas) < self.min_replicas
             )
             n = self._meta.get("num_updates", 0)
             cb = self.on_snapshot
@@ -215,6 +417,97 @@ class ParameterServer:
                 cb(n, *snap)
             except Exception:
                 logger.exception("parameter-server snapshot at step %d failed", n)
+        if repl_lost:
+            # refusing the ack is safe even though a checkpoint may carry
+            # this commit: the checkpoint meta carries the dedup table
+            # too, so a post-restore resend of this seq is deduplicated
+            raise ParameterServerError(
+                "no_replica",
+                detail="replication lost mid-commit; the resend is "
+                       "deduplicated once a replica re-attaches",
+            )
+
+    # -- replication --------------------------------------------------------
+
+    def attach_replica(self, sink, announce=None):
+        """Register a replication sink atomically with a consistent
+        snapshot of everything failover must preserve: the center, the
+        rule meta (DynSGD version counter included), the exactly-once
+        dedup table, and the worker-state custody table.
+
+        ``announce(center, meta, worker_snaps)`` — when given — runs
+        INSIDE the commit lock, before the sink is registered: the
+        standby's snapshot send and the sink's first forwarded commit
+        cannot interleave on the wire, so the stream the standby sees is
+        exactly snapshot-then-every-later-commit with no gap and no
+        overlap. If ``announce`` raises, the sink is never registered.
+        Returns the snapshot triple."""
+        with self._lock:
+            snap = (
+                jax.tree.map(np.copy, self._center),
+                self._meta_copy(),
+                dict(self._worker_snaps),
+            )
+            if announce is not None:
+                announce(*snap)
+            self._replicas.append(sink)
+            # an attach restores durability: re-arm the configured gate
+            # (no-op unless require_replicas was ever called)
+            self.min_replicas = self._min_replicas_goal
+        return snap
+
+    def detach_replica(self, sink) -> None:
+        with self._lock:
+            if sink in self._replicas:
+                self._replicas.remove(sink)
+
+    def require_replicas(self, n: int) -> None:
+        """Arm the durability gate: client commits are refused (typed,
+        retriable ``no_replica``) while fewer than ``n`` sinks are live.
+        Re-armed automatically by every subsequent attach; relaxed by
+        ``relax_replication_requirement`` (promotion's sole-survivor
+        mode)."""
+        with self._lock:
+            self.min_replicas = int(n)
+            self._min_replicas_goal = int(n)
+
+    def relax_replication_requirement(self) -> None:
+        """Drop the ACTIVE durability gate (availability over durability —
+        the promoted sole survivor must serve), keeping the goal so a
+        rejoining standby's attach re-arms it."""
+        with self._lock:
+            self.min_replicas = 0
+
+    @property
+    def num_replicas(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def _forward_to_replicas(self, delta, tag, commit_id, local_snap):
+        """Stream one applied commit to every attached sink. Caller holds
+        the lock — apply order is replication order, and the committer's
+        ack (sent after this returns) implies every live standby applied.
+        A sink that fails is detached and closed: the primary keeps
+        serving (degraded, counted in ``replication_drops``) and the
+        orphaned standby re-syncs with a fresh snapshot attach instead of
+        trusting a log with a hole in it."""
+        payload = _pack_commit(delta, tag, commit_id, local_snap)
+        dead = []
+        for sink in self._replicas:
+            try:
+                sink.replicate(payload)
+            except Exception:
+                logger.exception(
+                    "replication to standby failed; detaching sink"
+                )
+                dead.append(sink)
+        for sink in dead:
+            self._replicas.remove(sink)
+            self.replication_drops += 1
+            try:
+                sink.close()
+            except Exception:
+                pass
 
     # -- failure detection --------------------------------------------------
 
@@ -333,36 +626,260 @@ class DynSGDParameterServer(ParameterServer):
 # ------------------------------------------------------- socket (DCN) serving
 
 
+class _ReplicaSink:
+    """Primary-side handle to one attached warm standby. ``replicate``
+    runs inside the PS commit lock (see ``_forward_to_replicas``): it
+    sends the commit payload and BLOCKS on the standby's 1-byte ack —
+    semi-synchronous replication, the property the failover exactly-once
+    argument rests on (worker-acked implies standby-applied).
+
+    The socket carries an ack timeout: a standby that stalls without
+    closing its socket (stopped process, wedged apply) must become a
+    detached sink after a bounded wait, not a primary whose commit lock
+    — and with it every worker's pull/commit — is held hostage forever
+    (the training tier has no serving-style watchdog to break that)."""
+
+    ACK_TIMEOUT = 10.0
+
+    def __init__(self, conn: socket.socket, on_close=None):
+        conn.settimeout(self.ACK_TIMEOUT)
+        self.conn = conn
+        self._on_close = on_close
+
+    def replicate(self, payload: bytes) -> None:
+        faults.fire("ps.replicate", nbytes=len(payload))
+        networking.send_data(self.conn, payload)
+        ack = self.conn.recv(1)  # socket.timeout is an OSError: sink fails
+        if ack != b"k":
+            raise ConnectionError("standby did not acknowledge replication")
+
+    def close(self) -> None:
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+        if self._on_close is not None:
+            try:
+                self._on_close()
+            except Exception:
+                pass
+
+
 class SocketParameterServer:
-    """Serves a ParameterServer over TCP for cross-host workers.
+    """Serves a ParameterServer over TCP for cross-host workers — as the
+    primary, or as a warm standby that follows a primary and promotes on
+    its loss.
 
     Protocol (reference: distkeras/parameter_servers.py ->
-    SocketParameterServer.run): connection sends a 1-byte action —
-    b"p": pull -> request frame {"worker_id"} -> reply frame {"tag"} + center;
-    b"c": commit -> frame {"tag", "commit_id"} + delta, reply b"k";
-    b"s": stop the server.
+    SocketParameterServer.run, extended): connection sends a 1-byte
+    action; every reply leads with a status byte — b"k" (ok) or b"e"
+    followed by a typed error frame ``{"error": code, ...}``:
+
+    - b"p": pull -> request frame {"worker_id"} -> b"k" + frame {"tag"}
+      + center;
+    - b"c": commit -> frame {"tag", "commit_id", "wrapped"} + delta
+      (+snap), reply b"k";
+    - b"a": replica attach -> request frame (reserved) -> b"k" + snapshot
+      frame {"meta"} + {center, workers}; the connection then becomes the
+      replication channel — the primary streams every applied commit and
+      the standby acks each with b"k";
+    - b"s": stop the server;
+    - anything else: b"e" + ``unknown_action`` frame and the connection
+      closes — the old server silently ignored unknown bytes and re-read
+      mid-frame payload bytes as actions, a protocol desync that turned
+      one bad byte into an unbounded garbage conversation.
+
     All frames are the pickle-free JSON-header + npz format from
     ``utils.serialization`` — the reference pickled these payloads, which is
     arbitrary-code-execution on whichever host unpickles them.
     One thread per connection; commits serialize on the PS lock.
+
+    **Standby role** (``standby_of=(host, port)``): on ``start()`` the
+    server dials the primary, attaches (consistent snapshot restore —
+    center, meta incl. the DynSGD version counter, dedup table, worker
+    snapshots), then follows the replication stream on a background
+    thread. While in standby role, client verbs are refused with a typed
+    ``standby`` error. If the stream dies but the primary still answers,
+    the standby RE-ATTACHES (fresh snapshot — never trusts a gapped log);
+    if the primary is unreachable, it PROMOTES: role flips to "primary",
+    verbs start serving, and ``on_promote(self)`` fires. Promotion is
+    safe exactly-once territory because the dedup table rode the stream:
+    a worker's resend of an in-doubt commit is applied iff the standby
+    never saw it, deduped iff it did.
     """
 
-    def __init__(self, ps: ParameterServer, host="0.0.0.0", port=0):
+    def __init__(self, ps: ParameterServer, host="0.0.0.0", port=0,
+                 standby_of=None, auto_promote=True, attach_retry=None,
+                 on_promote=None):
         self.ps = ps
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
         self._listener.listen(128)
         self.port = self._listener.getsockname()[1]
+        self.standby_of = tuple(standby_of) if standby_of is not None else None
+        self.role = "primary" if standby_of is None else "standby"
+        self.promoted = False
+        self.promote_reason = None
+        self.auto_promote = bool(auto_promote)
+        self.on_promote = on_promote
+        self.reattaches = 0
+        self.killed = False
+        # re-attach pacing: a few quick policy-paced tries distinguish "the
+        # stream hiccuped" (primary alive: re-sync) from "the primary is
+        # gone" (every dial refused: promote). Short on purpose — workers
+        # are backing off against a dead endpoint while this runs.
+        self._attach_retry = attach_retry or networking.RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=0.2, budget=2.0
+        )
         self._accept_thread = None
+        self._repl_thread = None
+        self._repl_conn = None  # standby side's stream (closed on stop/kill)
         self._conn_threads = []
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+        self._role_lock = threading.Lock()
         self._running = threading.Event()
 
     def start(self):
         self.ps.start()
         self._running.set()
+        if self.role == "standby":
+            # synchronous first sync: when start() returns, the standby is
+            # commit-identical to the primary and following its stream
+            conn = self._attach_to_primary()
+            self._repl_thread = threading.Thread(
+                target=self._follow, args=(conn,), daemon=True
+            )
+            self._repl_thread.start()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
+
+    # -- standby side -------------------------------------------------------
+
+    def _attach_to_primary(self) -> socket.socket:
+        """Dial the primary, attach, restore its consistent snapshot into
+        the local PS; returns the (now replication) connection."""
+        host, port = self.standby_of
+        # short dial timeout: a primary that dies WITHOUT an RST (power
+        # loss, partition) must not stall each probe 30s — the promotion
+        # decision is budgeted in seconds, and this timeout is what keeps
+        # the dial inside that budget
+        conn = networking.connect(host, port, timeout=2.0)
+        try:
+            conn.sendall(b"a")
+            networking.send_data(conn, pack_frame({"replica_port": self.port}))
+            _read_reply_status(conn)
+            header, blob = unpack_frame(networking.recv_data(conn))
+            tree = deserialize_params(blob)
+            self.ps.restore_snapshot(tree["center"], header.get("meta", {}))
+            self.ps.restore_worker_snapshots(tree.get("workers", {}))
+        except BaseException:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+        self._repl_conn = conn
+        return conn
+
+    def _follow(self, conn: socket.socket):
+        """Replication pump: apply each forwarded commit, ack it, repeat.
+        Stream death -> re-attach (primary alive) or promote (primary
+        gone). Any apply/decode failure (e.g. a corrupted payload under
+        wire chaos) also re-syncs from a fresh snapshot — a replica must
+        never keep following a stream it may have misapplied."""
+        while self._running.is_set() and self.role == "standby":
+            try:
+                data = networking.recv_data(conn)
+                _apply_commit_payload(self.ps, data, _via="replicate")
+                conn.sendall(b"k")
+            except Exception:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                if not (self._running.is_set() and self.role == "standby"):
+                    return
+                conn = self._reattach_or_promote()
+                if conn is None:
+                    return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _reattach_or_promote(self):
+        """The standby's liveness judgment: if the primary still answers,
+        re-sync (fresh snapshot) and keep following; if it is gone,
+        promote (when ``auto_promote``). Returns the new replication
+        connection, or None when this follower thread should exit.
+
+        Only CONNECTION-level failure justifies promotion: a snapshot
+        that arrives but fails to decode (wire corruption under chaos)
+        proves the primary is alive, and promoting on it would
+        split-brain — a frozen 'promoted' standby that the trainer's
+        ``active_parameter_server`` would prefer over the live primary,
+        silently losing every later commit. Decode/apply failures retry
+        the attach; if they persist, the standby stands down (stops
+        following, does NOT promote) and logs loudly."""
+        for _ in range(3):
+            try:
+                conn = self._attach_retry.call(self._attach_to_primary)
+                self.reattaches += 1
+                logger.warning(
+                    "standby on port %d re-attached to primary %s "
+                    "(re-sync #%d)",
+                    self.port, self.standby_of, self.reattaches,
+                )
+                return conn
+            except (ConnectionError, OSError):
+                break  # primary unreachable: promotion territory
+            except Exception:
+                logger.exception(
+                    "standby re-attach failed on a non-connection error; "
+                    "retrying"
+                )
+        else:
+            logger.error(
+                "standby on port %d cannot decode the primary's snapshot "
+                "but the primary still answers — standing down (not "
+                "promoting; a split brain would lose commits)",
+                self.port,
+            )
+            return None
+        if self._running.is_set() and self.auto_promote:
+            self.promote(reason="primary-lost")
+        return None
+
+    def promote(self, reason="manual"):
+        """Standby -> primary: flip the role, start serving client verbs.
+        Idempotent; fires ``on_promote(self)`` exactly once. The PS state
+        needs no fixup — replication kept the center, version counters,
+        dedup table, and worker snapshots commit-identical."""
+        with self._role_lock:
+            if self.role == "primary":
+                return
+            self.role = "primary"
+            self.promoted = True
+            self.promote_reason = reason
+        # sole-survivor mode: the new primary has no standby of its own
+        # yet, and a durability gate inherited from the dead primary's
+        # topology would refuse every commit forever. Serve degraded; a
+        # rejoining standby's attach re-arms the gate.
+        self.ps.relax_replication_requirement()
+        logger.warning(
+            "parameter-server standby on port %d promoted to primary (%s)",
+            self.port, reason,
+        )
+        cb = self.on_promote
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                logger.exception("on_promote callback failed")
+
+    # -- serving side -------------------------------------------------------
 
     def _accept_loop(self):
         self._listener.settimeout(0.2)
@@ -376,9 +893,18 @@ class SocketParameterServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
+            # reap as we go: finished connection threads used to pile up
+            # for the server's lifetime (one entry per client connect —
+            # unbounded growth under connection churn)
+            self._conn_threads = [
+                th for th in self._conn_threads if th.is_alive()
+            ]
             self._conn_threads.append(t)
 
     def _serve(self, conn: socket.socket):
+        with self._conns_lock:
+            self._conns.add(conn)
+        handed_off = False
         try:
             while self._running.is_set():
                 action = conn.recv(1)
@@ -388,102 +914,341 @@ class SocketParameterServer:
                     # pull request: JSON header {"worker_id": ...} (None for
                     # anonymous) — keeps the heartbeat live for remote
                     # workers too. No pickle anywhere on this path.
-                    header, _ = unpack_frame(networking.recv_data(conn))
-                    center, tag = self.ps.pull(worker_id=header.get("worker_id"))
+                    data = networking.recv_data(conn)
+                    if self.role != "primary":
+                        _send_error(conn, "standby")
+                        continue
+                    header, _ = unpack_frame(data)
+                    try:
+                        center, tag = self.ps.pull(
+                            worker_id=header.get("worker_id")
+                        )
+                    except Exception as e:
+                        # a failed verb must not kill the stream: the full
+                        # request frame was already consumed, so a typed
+                        # error reply leaves the protocol in sync and the
+                        # client's (idempotent) retry does the recovery
+                        _send_error(conn, "internal", detail=repr(e))
+                        continue
+                    conn.sendall(b"k")
                     networking.send_data(
                         conn, pack_frame({"tag": tag}, serialize_params(center))
                     )
                 elif action == b"c":
-                    header, blob = unpack_frame(networking.recv_data(conn))
-                    commit_id = header.get("commit_id")
-                    if commit_id is not None:
-                        commit_id = (commit_id[0], commit_id[1])
-                    tree = deserialize_params(blob)
-                    local_snap = None
-                    if header.get("wrapped"):
-                        local_snap = tree.get("snap")
-                        tree = tree["delta"]
-                    self.ps.commit(
-                        tree,
-                        header.get("tag"),
-                        commit_id=commit_id,
-                        local_snap=local_snap,
-                    )
+                    data = networking.recv_data(conn)
+                    if self.role != "primary":
+                        _send_error(conn, "standby")
+                        continue
+                    try:
+                        _apply_commit_payload(self.ps, data)
+                    except ParameterServerError as e:
+                        # already typed (the durability gate's
+                        # no_replica): forward the code as-is
+                        _send_error(conn, e.code, detail=e.detail)
+                        continue
+                    except Exception as e:
+                        # commit rejected (e.g. an armed ps.commit seam)
+                        # BEFORE apply: typed reply; the worker's
+                        # commit_id resend is exactly-once under dedup
+                        _send_error(conn, "internal", detail=repr(e))
+                        continue
                     conn.sendall(b"k")
+                elif action == b"a":
+                    data = networking.recv_data(conn)
+                    if self.role != "primary":
+                        # chained standbys are not supported: a replica of
+                        # a replica would double the promotion ambiguity
+                        _send_error(conn, "standby")
+                        continue
+                    unpack_frame(data)  # attach header (reserved fields)
+                    # on_close keeps _conns bounded: every standby re-sync
+                    # is a fresh attach connection, and a detached sink's
+                    # socket must leave the tracked set (the same
+                    # unbounded-growth class as the _conn_threads fix)
+                    sink = _ReplicaSink(
+                        conn, on_close=lambda c=conn: self._discard_conn(c)
+                    )
+
+                    def announce(center, meta, worker_snaps):
+                        # runs INSIDE the PS commit lock (attach_replica):
+                        # snapshot-then-stream with no interleaving window
+                        conn.sendall(b"k")
+                        networking.send_data(
+                            conn,
+                            pack_frame(
+                                {"meta": meta},
+                                serialize_params({
+                                    "center": center,
+                                    "workers": {
+                                        str(k): v
+                                        for k, v in worker_snaps.items()
+                                        if v is not None
+                                    },
+                                }),
+                            ),
+                        )
+
+                    self.ps.attach_replica(sink, announce)
+                    # the sink owns this socket now: commits pump it from
+                    # inside the PS lock; this thread's job is done
+                    handed_off = True
+                    return
                 elif action == b"s":
                     self.stop()
                     break
+                else:
+                    _send_error(
+                        conn, "unknown_action", action=action.hex()
+                    )
+                    break
         except (ConnectionError, OSError):
             pass
+        except Exception:
+            # a malformed/corrupted REQUEST frame (wire chaos) — drop the
+            # connection; the client's retry machinery takes it from here
+            logger.debug("parameter-server connection dropped", exc_info=True)
         finally:
-            conn.close()
+            if not handed_off:
+                with self._conns_lock:
+                    self._conns.discard(conn)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
-    def stop(self):
-        self._running.clear()
-        self.ps.stop()
+    # -- lifecycle ----------------------------------------------------------
+
+    def _discard_conn(self, conn) -> None:
+        with self._conns_lock:
+            self._conns.discard(conn)
+
+    def _close_all(self, rst=False):
         try:
             self._listener.close()
         except OSError:
             pass
+        repl = self._repl_conn
+        if repl is not None:
+            try:  # unblock a standby's follower from its recv
+                repl.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            if rst:
+                try:  # SO_LINGER 0: abort with RST, as a dying process would
+                    c.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0),
+                    )
+                except OSError:
+                    pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._running.clear()
+        self.ps.stop()
+        self._close_all()
+        # join what we spawned (skip the current thread: stop() runs on a
+        # serve thread for the b"s" verb) — with the accept-loop reap this
+        # closes the old unbounded `_conn_threads` growth end to end
+        me = threading.current_thread()
+        for t in [self._accept_thread, self._repl_thread, *self._conn_threads]:
+            if t is not None and t is not me:
+                t.join(timeout=2.0)
+        self._conn_threads = [t for t in self._conn_threads if t.is_alive()]
+
+    def kill(self):
+        """Simulate primary process death for chaos tests: no drain, no
+        goodbye — the listener and every open connection (client AND
+        replication) drop with an RST mid-whatever-they-were-doing. The
+        PS object is left untouched (a dead process doesn't tidy its
+        state). Only tests and the chaos soak call this."""
+        self.killed = True
+        self._running.clear()
+        self._close_all(rst=True)
+
+
+def _read_reply_status(sock: socket.socket) -> None:
+    """Consume a reply's status byte; raise the typed error a b"e" frame
+    carries. THE client-side decoder for the status-byte protocol."""
+    status = sock.recv(1)
+    if status == b"k":
+        return
+    if status == b"e":
+        header, _ = unpack_frame(networking.recv_data(sock))
+        code = header.get("error", "error")
+        if code == "standby":
+            raise StandbyError(header.get("detail"))
+        raise ParameterServerError(code, detail=header.get("detail"))
+    if not status:
+        raise ConnectionError("parameter-server stream closed")
+    raise ConnectionError(
+        f"parameter-server protocol desync: bad status byte {status!r}"
+    )
 
 
 class RemoteParameterServerClient:
-    """Worker-side proxy speaking the socket protocol; drop-in for a local PS."""
+    """Worker-side proxy speaking the socket protocol; drop-in for a local
+    PS. With an endpoint list it is failover-aware: the dial is sticky —
+    it keeps the endpoint that last worked and rotates onward only when
+    that one dies."""
 
-    def __init__(self, host: str, port: int, retry=None):
-        """``retry``: optional ``networking.RetryPolicy`` used by
-        ``reconnect()`` to redial with exponential full-jitter backoff —
-        the SAME backoff implementation the serving client uses, so the
-        training and serving tiers cannot drift apart on retry
-        semantics. A retried worker's PS is often restarting too; a
-        policy-paced redial rides out the gap instead of failing the
-        whole retry on one refused connection."""
-        self.host = host
-        self.port = port
+    def __init__(self, host=None, port=None, retry=None, endpoints=None,
+                 on_failover=None):
+        """``retry``: optional ``networking.RetryPolicy`` — the SAME
+        backoff implementation the serving client uses, so the training
+        and serving tiers cannot drift apart on retry semantics. It paces
+        ``reconnect()`` redials AND the transparent in-operation failover:
+        when a pull/commit dies mid-stream, the client redials (rotating
+        endpoints) and resends under the policy — pulls always (they are
+        idempotent), commits only when a ``commit_id`` is present (the
+        dedup table makes the resend exactly-once; an id-less commit
+        cannot be safely resent and surfaces its failure instead).
+
+        ``endpoints``: list of ``(host, port)`` alternatives — typically
+        ``[primary, standby]``. ``on_failover(endpoint)`` fires whenever
+        the dial lands on a different endpoint than before (observability
+        only; exceptions are swallowed)."""
+        if endpoints is None:
+            if host is None or port is None:
+                raise ValueError(
+                    "RemoteParameterServerClient needs host+port or an "
+                    "endpoints list"
+                )
+            endpoints = [(host, port)]
+        self.endpoints = [tuple(e) for e in endpoints]
         self.retry = retry
-        self._sock = networking.connect(host, port)
+        self.on_failover = on_failover
+        self.failovers = 0
+        # per-endpoint dial timeout: during a failover the rotation must
+        # reach the standby in seconds even when the dead primary drops
+        # SYNs silently (no RST) — connect()'s default 30s per endpoint
+        # would eat the whole retry budget before the first rotation
+        self.dial_timeout = 5.0
         self._lock = threading.Lock()
+        self._sock, self._ep = networking.connect_any(
+            self.endpoints, timeout=self.dial_timeout
+        )
+        self.host, self.port = self.endpoints[self._ep]
+
+    @property
+    def endpoint(self):
+        """The ``(host, port)`` currently connected."""
+        return self.endpoints[self._ep]
+
+    def _dial_locked(self, start_offset=0):
+        """One rotation over the endpoint list starting at the sticky
+        index (+``start_offset``); updates bookkeeping and fires
+        ``on_failover`` on a move. Caller holds the lock."""
+        sock, i = networking.connect_any(
+            self.endpoints, start=self._ep + start_offset,
+            timeout=self.dial_timeout,
+        )
+        if i != self._ep:
+            self._ep = i
+            self.host, self.port = self.endpoints[i]
+            self.failovers += 1
+            cb = self.on_failover
+            if cb is not None:
+                try:
+                    cb(self.endpoints[i])
+                except Exception:
+                    logger.exception("on_failover callback failed")
+        self._sock = sock
+
+    def _reconnect_locked(self, rotate_first=False):
+        """``rotate_first``: start the dial at the NEXT endpoint — the
+        current one answered but refused (a live standby), so redialing
+        it first would livelock against a healthy, dialable primary."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._dial_locked(start_offset=1 if rotate_first else 0)
 
     def reconnect(self):
         """Fresh connection — a retried worker must not reuse a stream that
         may have died mid-message (half-written commit payloads would
-        desync the protocol)."""
+        desync the protocol). Policy-paced when ``retry`` is set; the
+        redial rotates through the endpoint list, so a worker retrying
+        into a failover lands on the promoted standby."""
         with self._lock:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            dial = lambda: networking.connect(self.host, self.port)  # noqa: E731
-            self._sock = (
-                self.retry.call(dial) if self.retry is not None else dial()
-            )
+            if self.retry is not None:
+                self.retry.call(self._reconnect_locked)
+            else:
+                self._reconnect_locked()
+
+    def _with_failover(self, op, resend_safe=True):
+        """Run ``op`` once; on a dead/refusing stream, redial (rotating
+        endpoints) and resend under ``self.retry``. ``StandbyError`` is a
+        ``ConnectionError``, so the not-yet-promoted window during a
+        failover is absorbed by the same policy-paced loop — and because
+        a standby ANSWERS the dial, a standby refusal rotates the next
+        redial past it (a sticky redial would otherwise never try a
+        healthy primary again)."""
+        try:
+            return op()
+        except (ConnectionError, OSError) as first:
+            if self.retry is None or not resend_safe:
+                raise
+            last = [first]
+
+            def redo():
+                e = last[0]
+                rotate = isinstance(e, StandbyError)
+                # a typed reply on a healthy stream (no_replica, internal)
+                # needs no teardown — retry in place; redial only when the
+                # stream is dead/suspect, or rotating off a live standby
+                if rotate or not getattr(e, "stream_in_sync", False):
+                    with self._lock:
+                        self._reconnect_locked(rotate_first=rotate)
+                try:
+                    return op()
+                except (ConnectionError, OSError) as err:
+                    last[0] = err
+                    raise
+
+            return self.retry.call(redo)
 
     def pull(self, worker_id=None):
-        with self._lock:
-            self._sock.sendall(b"p")
-            networking.send_data(
-                self._sock, pack_frame({"worker_id": worker_id})
-            )
-            header, blob = unpack_frame(networking.recv_data(self._sock))
-        return deserialize_params(blob), header.get("tag")
+        def op():
+            with self._lock:
+                self._sock.sendall(b"p")
+                networking.send_data(
+                    self._sock, pack_frame({"worker_id": worker_id})
+                )
+                _read_reply_status(self._sock)
+                header, blob = unpack_frame(networking.recv_data(self._sock))
+            return deserialize_params(blob), header.get("tag")
+
+        return self._with_failover(op)
 
     def commit(self, delta, tag=None, commit_id=None, local_snap=None):
-        header = {"tag": tag, "commit_id": list(commit_id) if commit_id else None}
-        tree = _to_host(delta)
-        if local_snap is not None:
-            # worker-local checkpoint state rides the same frame ("wrapped"
-            # layout) so remote/DCN workers keep full resume parity with
-            # in-process ones; costs one extra params+opt_state per
-            # communication window, only when checkpointing is on
-            header["wrapped"] = True
-            tree = {"delta": tree, "snap": local_snap}
-        payload = pack_frame(header, serialize_params(tree))
-        with self._lock:
-            self._sock.sendall(b"c")
-            networking.send_data(self._sock, payload)
-            ack = self._sock.recv(1)
-        if ack != b"k":
-            raise ConnectionError("commit not acknowledged")
+        payload = _pack_commit(_to_host(delta), tag, commit_id, local_snap)
+
+        def op():
+            with self._lock:
+                self._sock.sendall(b"c")
+                networking.send_data(self._sock, payload)
+                try:
+                    _read_reply_status(self._sock)
+                except ParameterServerError:
+                    raise  # typed reply: the stream is still in sync
+                except ConnectionError as e:
+                    # the ack never arrived — the commit is IN DOUBT
+                    # (applied-but-unacked or never-received); the typed
+                    # error names which one so the resend/escalation
+                    # decision is made on facts
+                    raise CommitNotAcknowledgedError(
+                        commit_id, detail=str(e)
+                    ) from e
+
+        return self._with_failover(op, resend_safe=commit_id is not None)
 
     def close(self):
         try:

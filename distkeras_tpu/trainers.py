@@ -1702,6 +1702,7 @@ class DistributedTrainer(Trainer):
         mode="threads",
         serve_socket=False,
         remote_ps=False,
+        standby=False,
         checkpoint_dir=None,
         checkpoint_every=0,
         max_to_keep=3,
@@ -1754,9 +1755,26 @@ class DistributedTrainer(Trainer):
         # (the cross-host/DCN path) even on one host — the full multi-host
         # wire topology, loopback-exercised (SURVEY §5.8 TPU mapping)
         self.remote_ps = bool(remote_ps)
-        self.serve_socket = bool(serve_socket) or self.remote_ps
+        # standby=True: run a warm-standby PS behind the primary. The
+        # primary streams its consistent snapshot + every post-dedup
+        # commit to the standby (parameter_servers replication), and on
+        # primary loss the standby PROMOTES; remote workers' clients carry
+        # both endpoints and fail over through the shared RetryPolicy with
+        # exactly-once commit resend. Implies serve_socket (replication
+        # rides the socket protocol); failover needs remote_ps (in-process
+        # workers hold the primary object directly — they still get the
+        # replicated checkpoint/promotion machinery, not transparent
+        # client failover).
+        self.standby = bool(standby)
+        self.serve_socket = bool(serve_socket) or self.remote_ps or self.standby
         self.parameter_server = None
         self.service = None
+        self.standby_service = None
+        # failover observability: client endpoint rotations and standby
+        # promotions recorded across the run
+        self.ps_failovers = 0
+        self.ps_promotions = []
+        self._failover_lock = threading.Lock()
         # checkpoint_every is in PS commits here (0 = final snapshot only)
         self._init_checkpointing(checkpoint_dir, checkpoint_every, max_to_keep)
         # fault tolerance (SURVEY §5.3): crashed worker threads are retried
@@ -1794,16 +1812,24 @@ class DistributedTrainer(Trainer):
     def allocate_worker(self, core, worker_id, device) -> AsyncWorker:
         ps = self.parameter_server
         if self.remote_ps:
-            # the retry policy paces reconnect() redials: a worker retry
-            # often races the PS host's own restart, and one refused
-            # connection must not burn the whole worker_retries attempt
-            # (same backoff implementation the serving client uses)
+            # the retry policy paces reconnect() redials AND the client's
+            # transparent in-operation failover: a worker retry often
+            # races the PS host's own restart, and one refused connection
+            # must not burn the whole worker_retries attempt (same
+            # backoff implementation the serving client uses)
             from distkeras_tpu.networking import RetryPolicy
 
+            endpoints = [("127.0.0.1", self.service.port)]
+            if self.standby_service is not None:
+                # failover pair: primary first (sticky), standby second —
+                # commits carry commit_ids, so the post-failover resend is
+                # exactly-once against the promoted standby's dedup table
+                endpoints.append(("127.0.0.1", self.standby_service.port))
             ps = RemoteParameterServerClient(
-                "127.0.0.1", self.service.port,
-                retry=RetryPolicy(max_attempts=5, base_delay=0.05,
+                endpoints=endpoints,
+                retry=RetryPolicy(max_attempts=8, base_delay=0.05,
                                   budget=30.0),
+                on_failover=self._note_failover,
             )
         w = self.worker_cls(
             core,
@@ -1832,12 +1858,84 @@ class DistributedTrainer(Trainer):
         if self.serve_socket:
             self.service = SocketParameterServer(self.parameter_server)
             self.service.start()
+        if self.standby:
+            # warm standby: fresh PS of the same class, synced from the
+            # primary's consistent snapshot at attach (so a resumed
+            # primary's restored state replicates too), then following
+            # the commit stream; promotes itself on primary loss.
+            # require_replicas(1) arms the durability gate on BOTH: no
+            # commit is ever acked without a live replica (a brief
+            # re-sync window surfaces as retriable no_replica), and the
+            # promoted sole survivor relaxes its gate until a standby
+            # rejoins. Remote mode ONLY: the gate's contract is that a
+            # policy-paced client resend rides out the re-sync window,
+            # and only RemoteParameterServerClient has that loop —
+            # in-process workers commit bare, where a transient
+            # no_replica would burn a whole worker_retries replay.
+            standby_ps = self.allocate_parameter_server()
+            if self.remote_ps:
+                self.parameter_server.require_replicas(1)
+                standby_ps.require_replicas(1)
+            self.standby_service = SocketParameterServer(
+                standby_ps,
+                host="127.0.0.1",
+                standby_of=("127.0.0.1", self.service.port),
+                on_promote=self._on_standby_promote,
+                # promotion only makes sense when workers can follow it:
+                # in-process workers hold the primary OBJECT (which cannot
+                # die out from under this process), so a promotion there
+                # would only ever be a false positive that freezes the
+                # replica — replication/durability is the whole value
+                auto_promote=self.remote_ps,
+            )
+            self.standby_service.start()
 
     def stop_service(self):
+        if self.standby_service is not None:
+            self.standby_service.stop()
         if self.service is not None:
             self.service.stop()
             self.service = None
         self.parameter_server.stop()
+
+    def active_parameter_server(self):
+        """The PS whose state is authoritative RIGHT NOW: the promoted
+        standby's after a failover, the primary's otherwise — end-of-run
+        reads (final center, checkpoint snapshot, counters) must go here,
+        or a run that survived a primary loss would report the dead
+        primary's stale state. Remote mode only: in-process workers
+        commit to the primary object until the very end, so even a
+        (spurious) promotion must never outrank it."""
+        if (
+            self.remote_ps
+            and self.standby_service is not None
+            and self.standby_service.promoted
+        ):
+            return self.standby_service.ps
+        return self.parameter_server
+
+    def _note_failover(self, endpoint):
+        with self._failover_lock:
+            self.ps_failovers += 1
+        if self.metrics_logger is not None:
+            self.metrics_logger.log(
+                event="ps_failover", endpoint=list(endpoint)
+            )
+
+    def _on_standby_promote(self, service):
+        """Resume integration for the promoted standby: checkpointing
+        re-attaches to the NEW primary's PS (its dedup table and worker
+        snapshots rode the replication stream, so snapshots taken after
+        promotion restore exactly like pre-failover ones)."""
+        self.ps_promotions.append(
+            {"port": service.port, "reason": service.promote_reason}
+        )
+        self._attach_checkpointing(service.ps)
+        if self.metrics_logger is not None:
+            self.metrics_logger.log(
+                event="ps_promoted", port=service.port,
+                reason=service.promote_reason,
+            )
 
     # -- run ----------------------------------------------------------------
 
@@ -1864,7 +1962,9 @@ class DistributedTrainer(Trainer):
             if worker_states:
                 trees["workers"] = worker_states
             self.checkpointer.save(
-                n, trees, {"ps_meta": meta, "stream": self._stream_fp}
+                n, trees,
+                {"ps_meta": meta,
+                 "stream": getattr(self, "_stream_fp", None)},
             )
 
         ps.snapshot_every = self.checkpoint_every
@@ -1947,7 +2047,10 @@ class DistributedTrainer(Trainer):
                     w.ps.close()
             self.stop_service()
         if self.checkpointer is not None:
-            center, meta = self.parameter_server.snapshot()
+            # the promoted standby's PS after a failover (active_parameter_
+            # server): its center/meta/dedup table are the authoritative
+            # continuation of the run the dead primary started
+            center, meta = self.active_parameter_server().snapshot()
             trees = {"center": center}
             # workers are idle now (threads joined / schedule drained), so a
             # fresh end-of-run snapshot per worker is race-free and exact
@@ -1970,7 +2073,7 @@ class DistributedTrainer(Trainer):
             )
         self.history.record_training_end()
         state = self._aggregate_worker_states(workers)
-        return self._finish(self.parameter_server.get_params(), state)
+        return self._finish(self.active_parameter_server().get_params(), state)
 
     def _aggregate_worker_states(self, workers):
         """Mutable model state (BatchNorm moving stats) to pair with the

@@ -779,6 +779,13 @@ class AsyncWorker:
         self._resident = None
         self._resident_n = 0
 
+    @property
+    def ps_failovers(self) -> int:
+        """How many times this worker's PS client rotated endpoints (0 for
+        in-process / single-endpoint PS connections) — the per-worker face
+        of the replicated-PS failover ledger."""
+        return int(getattr(self.ps, "failovers", 0))
+
     def reset_for_retry(self, retry=None):
         """Restart this worker's training after a failure: from its resume
         restore point when it has one, else from scratch.
@@ -788,13 +795,18 @@ class AsyncWorker:
         deduplicated — the retry cannot double-apply work (the reference's
         Spark-retry double-absorb weakness, SURVEY §5.3). After a resume the
         scratch seqs may predate the restored dedup table's window, so the
-        retry goes back to the restore point instead.
+        retry goes back to the restore point instead. The replay's dedup
+        holds across a PS FAILOVER too: the promoted standby's dedup table
+        rode the replication stream, so a worker retry that lands on the
+        new primary still cannot double-apply pre-crash windows.
 
         ``retry``: optional ``networking.RetryPolicy`` for the PS redial —
         the shared backoff implementation (the serving client uses the
         same one), for the case where the PS host is itself mid-restart
         when this worker comes back. A remote PS client constructed with
-        its own policy already redials under it."""
+        its own policy already redials under it, and a multi-endpoint
+        client's redial rotates through the endpoint list, so the retry
+        lands on whichever replica is serving."""
         self.records = []
         self.timings = []
         self._pending = None

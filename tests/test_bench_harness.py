@@ -121,6 +121,33 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
         ), name
 
 
+@pytest.mark.chaos
+def test_soak_training_smoke():
+    """``tools/soak_training.py --smoke`` runs end to end at tier-1 scale
+    and meets its own acceptance bar: zero hung workers, a real primary
+    kill with standby promotion in BOTH phases, and exactly-once commit
+    application across the failover (the ledger phase's bit-exact center,
+    the training phase's run-vs-run commit-ledger match). Mirrors the
+    ``soak_serving.py`` treatment: the chaos harness itself is pinned on
+    CPU so a drift surfaces as a red test, not a dead soak run."""
+    import soak_training  # REPO/tools is on sys.path (module top)
+
+    summary = soak_training.run_soak(seed=0, smoke=True)
+    ledger = summary["phases"]["ledger"]
+    assert ledger["hung"] == 0
+    assert ledger["errors"] == []
+    assert ledger["promoted"] and ledger["promote_reason"] == "primary-lost"
+    assert ledger["exactly_once"]
+    assert ledger["applied_updates"] == ledger["expected_updates"]
+    training = summary["phases"]["training"]
+    assert training["faulted"]["hung"] is False
+    assert training["faulted"]["error"] is None
+    assert len(training["faulted"]["promotions"]) == 1
+    assert training["faulted"]["failovers"] >= 1
+    assert training["ledger_match"]
+    assert summary["ok"]
+
+
 def test_north_star_cite_reads_artifact(tmp_path):
     rec = {"value": 123456.7, "unit": "samples/sec/chip", "batch": 2048}
     (tmp_path / "BENCH_TPU.json").write_text(json.dumps(rec))

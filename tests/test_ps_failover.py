@@ -1,0 +1,491 @@
+"""Replicated parameter server: replication, promotion, failover edges,
+and the hardened socket protocol (typed error frames, thread reaping).
+
+The chaos-marked tests drive the ``ps.*`` seams deterministically; every
+sleep is a bounded poll <= 0.5 s per step with an explicit deadline."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.faults import FaultPlan, InjectedFault
+from distkeras_tpu.networking import RetryPolicy, recv_data, send_data
+from distkeras_tpu.parameter_servers import (
+    CommitNotAcknowledgedError,
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    RemoteParameterServerClient,
+    SocketParameterServer,
+    StandbyError,
+)
+from distkeras_tpu.utils.serialization import pack_frame, unpack_frame
+
+
+def _params(v=0.0):
+    return {"w": np.full((3,), v, np.float32)}
+
+
+def _wait(cond, timeout=5.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _policy(**kw):
+    kw.setdefault("max_attempts", 20)
+    kw.setdefault("base_delay", 0.02)
+    kw.setdefault("max_delay", 0.2)
+    kw.setdefault("budget", 20.0)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+def _pair(ps_cls=DeltaParameterServer, v=0.0):
+    """(primary_server, standby_server) started and synced."""
+    primary = SocketParameterServer(ps_cls(_params(v)), host="127.0.0.1")
+    primary.start()
+    standby = SocketParameterServer(
+        ps_cls(_params(v)), host="127.0.0.1",
+        standby_of=("127.0.0.1", primary.port),
+    )
+    standby.start()
+    return primary, standby
+
+
+# ------------------------------------------------------- protocol hardening
+
+
+def test_unknown_action_gets_typed_error_and_close():
+    """S2: an unknown action byte must produce a typed error frame and a
+    closed connection — the old server silently ignored it and re-read
+    mid-frame payload bytes as actions (protocol desync)."""
+    srv = SocketParameterServer(DeltaParameterServer(_params()), host="127.0.0.1")
+    srv.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(b"z")
+        assert s.recv(1) == b"e"
+        header, _ = unpack_frame(recv_data(s))
+        assert header["error"] == "unknown_action"
+        assert header["action"] == "7a"
+        assert s.recv(1) == b""  # server closed the connection
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_garbage_bytes_do_not_poison_later_clients():
+    """A connection spraying garbage actions dies alone; the next client
+    speaks the protocol normally."""
+    srv = SocketParameterServer(DeltaParameterServer(_params()), host="127.0.0.1")
+    srv.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(b"\x00\xffgarbage")
+        s.recv(1)  # error status (then close)
+        s.close()
+        client = RemoteParameterServerClient("127.0.0.1", srv.port)
+        client.commit(_params(1.0), commit_id=(0, 0))
+        center, _ = client.pull()
+        np.testing.assert_allclose(center["w"], 1.0)
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_conn_threads_reaped_and_joined_on_stop():
+    """S1: finished connection threads are reaped on accept instead of
+    accumulating forever, and stop() joins the survivors."""
+    srv = SocketParameterServer(DeltaParameterServer(_params()), host="127.0.0.1")
+    srv.start()
+    try:
+        for _ in range(15):
+            c = RemoteParameterServerClient("127.0.0.1", srv.port)
+            c.pull()
+            c.close()
+        # one live keep-alive connection forces a reap pass on its accept
+        keep = RemoteParameterServerClient("127.0.0.1", srv.port)
+        keep.pull()
+        assert _wait(lambda: len(srv._conn_threads) <= 3), (
+            f"{len(srv._conn_threads)} conn threads still tracked"
+        )
+        keep.close()
+    finally:
+        srv.stop()
+    assert all(not t.is_alive() for t in srv._conn_threads)
+
+
+def test_commit_not_acknowledged_carries_commit_id():
+    """S3: a garbled ack raises the typed error naming the commit, not a
+    bare ConnectionError."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+
+    def bad_server():
+        conn, _ = listener.accept()
+        conn.recv(1)            # action
+        recv_data(conn)         # commit frame
+        conn.sendall(b"x")      # not a valid status byte
+        conn.close()
+
+    t = threading.Thread(target=bad_server, daemon=True)
+    t.start()
+    client = RemoteParameterServerClient("127.0.0.1", port)
+    with pytest.raises(CommitNotAcknowledgedError) as ei:
+        client.commit(_params(1.0), commit_id=(3, 7))
+    assert ei.value.commit_id == (3, 7)
+    assert ei.value.code == "commit_not_acknowledged"
+    client.close()
+    listener.close()
+    t.join(timeout=5)
+
+
+def test_pull_and_commit_reconnect_and_retry_when_stream_dies():
+    """S3: a mid-operation dead stream reconnects and resends through
+    self.retry — pulls always, commits only with a commit_id."""
+    srv = SocketParameterServer(DeltaParameterServer(_params()), host="127.0.0.1")
+    srv.start()
+    try:
+        client = RemoteParameterServerClient(
+            "127.0.0.1", srv.port, retry=_policy()
+        )
+        client._sock.close()  # stream died under us
+        center, _ = client.pull()
+        np.testing.assert_allclose(center["w"], 0.0)
+        client._sock.close()
+        client.commit(_params(1.0), commit_id=(0, 0))
+        np.testing.assert_allclose(srv.ps.get_params()["w"], 1.0)
+        # an id-less commit cannot be safely resent: it surfaces instead
+        client._sock.close()
+        with pytest.raises((ConnectionError, OSError)):
+            client.commit(_params(1.0))
+        client.close()
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------- replication core
+
+
+def test_attach_streams_snapshot_then_commits_consistently():
+    primary = SocketParameterServer(
+        DeltaParameterServer(_params()), host="127.0.0.1"
+    )
+    primary.start()
+    try:
+        client = RemoteParameterServerClient("127.0.0.1", primary.port)
+        snap_payload = {"params": _params(9.0), "seq": np.int64(1)}
+        client.commit(_params(1.0), commit_id=(0, 0), local_snap=snap_payload)
+        client.commit(_params(1.0), commit_id=(1, 0))
+
+        standby = SocketParameterServer(
+            DeltaParameterServer(_params()), host="127.0.0.1",
+            standby_of=("127.0.0.1", primary.port),
+        )
+        standby.start()  # synchronous first sync
+        try:
+            assert standby.role == "standby"
+            np.testing.assert_array_equal(
+                standby.ps.get_params()["w"], primary.ps.get_params()["w"]
+            )
+            # the pre-attach worker snapshot rode the snapshot
+            snaps = standby.ps.worker_snapshots()
+            np.testing.assert_allclose(snaps[0]["params"]["w"], 9.0)
+
+            # post-attach commits stream through, dedup table included
+            client.commit(_params(2.0), commit_id=(0, 1))
+            np.testing.assert_array_equal(
+                standby.ps.get_params()["w"], primary.ps.get_params()["w"]
+            )
+            assert standby.ps._seen_seq == primary.ps._seen_seq
+            assert primary.ps.num_replicas == 1
+        finally:
+            standby.stop()
+        client.close()
+    finally:
+        primary.stop()
+
+
+def test_standby_refuses_clients_until_promoted():
+    primary, standby = _pair()
+    try:
+        direct = RemoteParameterServerClient("127.0.0.1", standby.port)
+        with pytest.raises(StandbyError):
+            direct.pull()
+        with pytest.raises(StandbyError):
+            direct.commit(_params(1.0), commit_id=(0, 0))
+        standby.promote(reason="test")
+        center, _ = direct.pull()
+        np.testing.assert_allclose(center["w"], 0.0)
+        direct.close()
+    finally:
+        standby.stop()
+        primary.stop()
+
+
+@pytest.mark.chaos
+def test_promotion_with_inflight_commit_resend_is_deduped():
+    """The failover exactly-once edge: a commit applied (and replicated)
+    whose ack was lost to the primary's death is RESENT to the promoted
+    standby and deduped — applied exactly once across the failover."""
+    primary, standby = _pair()
+    client = RemoteParameterServerClient(
+        endpoints=[("127.0.0.1", primary.port), ("127.0.0.1", standby.port)],
+        retry=_policy(),
+    )
+    try:
+        client.commit(_params(1.0), commit_id=(0, 0))  # applied + replicated
+        primary.kill()  # ...and the worker never hears the ack
+        client.commit(_params(1.0), commit_id=(0, 0))  # transparent resend
+        client.commit(_params(1.0), commit_id=(0, 1))  # new work continues
+        assert _wait(lambda: standby.promoted)
+        assert standby.promote_reason == "primary-lost"
+        np.testing.assert_allclose(standby.ps.get_params()["w"], 2.0)
+        assert standby.ps.num_updates == 2
+        assert standby.ps.num_duplicates == 1
+        assert client.failovers >= 1
+    finally:
+        client.close()
+        standby.stop()
+
+
+@pytest.mark.chaos
+def test_double_failover_through_rejoined_primary():
+    """primary A -> standby B promotes -> A rejoins as A2 (standby of B)
+    -> B dies -> A2 promotes; the ledger stays exact across both hops."""
+    a, b = _pair()
+    client = RemoteParameterServerClient(
+        endpoints=[("127.0.0.1", a.port), ("127.0.0.1", b.port)],
+        retry=_policy(),
+    )
+    client.commit(_params(1.0), commit_id=(0, 0))
+    a.kill()
+    client.commit(_params(1.0), commit_id=(0, 1))  # fails over to B
+    assert _wait(lambda: b.promoted)
+
+    # the old primary's host comes back — as a fresh standby of B
+    a2 = SocketParameterServer(
+        DeltaParameterServer(_params()), host="127.0.0.1",
+        standby_of=("127.0.0.1", b.port),
+    )
+    a2.start()
+    try:
+        np.testing.assert_allclose(a2.ps.get_params()["w"], 2.0)
+        client.commit(_params(1.0), commit_id=(0, 2))  # replicates to a2
+        b.kill()
+        client2 = RemoteParameterServerClient(
+            endpoints=[("127.0.0.1", b.port), ("127.0.0.1", a2.port)],
+            retry=_policy(),
+        )
+        client2.commit(_params(1.0), commit_id=(0, 2))  # in-doubt resend
+        client2.commit(_params(1.0), commit_id=(0, 3))
+        assert _wait(lambda: a2.promoted)
+        np.testing.assert_allclose(a2.ps.get_params()["w"], 4.0)
+        assert a2.ps.num_updates == 4
+        assert a2.ps.num_duplicates == 1
+        assert a2.ps._seen_seq == {0: 3}
+        client2.close()
+    finally:
+        client.close()
+        a2.stop()
+
+
+@pytest.mark.chaos
+def test_dynsgd_version_counter_survives_promotion():
+    """DynSGD's staleness bookkeeping must be commit-identical on the
+    promoted standby: the version counter continues, and a stale tag is
+    scaled by the SAME 1/(staleness+1) the dead primary would have used."""
+    primary, standby = _pair(DynSGDParameterServer)
+    client = RemoteParameterServerClient(
+        endpoints=[("127.0.0.1", primary.port), ("127.0.0.1", standby.port)],
+        retry=_policy(),
+    )
+    try:
+        _, tag0 = client.pull(worker_id=0)
+        assert tag0 == 0
+        client.commit(_params(3.0), tag=tag0, commit_id=(0, 0))  # full
+        client.commit(_params(3.0), tag=tag0, commit_id=(0, 1))  # /2
+        primary.kill()
+        assert _wait(lambda: standby.promoted)
+        _, tag = client.pull(worker_id=0)
+        assert tag == 2  # version counter survived, uninterrupted
+        # staleness 2 -> delta scaled by 1/3, exactly as pre-failover math
+        client.commit(_params(3.0), tag=tag0, commit_id=(0, 2))
+        np.testing.assert_allclose(
+            standby.ps.get_params()["w"], 3.0 + 1.5 + 1.0
+        )
+        assert standby.ps._meta["version"] == 3
+    finally:
+        client.close()
+        standby.stop()
+
+
+# ------------------------------------------------------------- chaos seams
+
+
+@pytest.mark.chaos
+def test_ps_seams_fire_on_inprocess_transport():
+    ps = DeltaParameterServer(_params())
+    plan = FaultPlan(seed=0).arm("ps.pull").arm("ps.commit")
+    with plan:
+        with pytest.raises(InjectedFault):
+            ps.pull(worker_id=0)
+        ps.pull(worker_id=0)  # seam exhausted
+        with pytest.raises(InjectedFault):
+            ps.commit(_params(1.0), commit_id=(0, 0))
+        ps.commit(_params(1.0), commit_id=(0, 0))
+    assert plan.fired("ps.pull") == 1 and plan.fired("ps.commit") == 1
+    np.testing.assert_allclose(ps.get_params()["w"], 1.0)
+    assert ps.num_updates == 1
+
+
+@pytest.mark.chaos
+def test_injected_commit_fault_on_socket_is_typed_and_resent():
+    """An armed ps.commit seam on the socket path surfaces as a typed
+    ``internal`` reply (stream stays in sync) and the client's policy
+    retry resends — exactly-once, the seam's recovery contract."""
+    srv = SocketParameterServer(DeltaParameterServer(_params()), host="127.0.0.1")
+    srv.start()
+    try:
+        client = RemoteParameterServerClient(
+            "127.0.0.1", srv.port, retry=_policy()
+        )
+        plan = FaultPlan(seed=0).arm("ps.commit")
+        with plan:
+            client.commit(_params(1.0), commit_id=(0, 0))
+        assert plan.fired("ps.commit") == 1
+        np.testing.assert_allclose(srv.ps.get_params()["w"], 1.0)
+        assert srv.ps.num_updates == 1
+        client.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.chaos
+def test_standby_does_not_promote_when_primary_answers_garbage():
+    """Split-brain guard: a re-attach that fails for NON-connection
+    reasons (snapshot corrupted on the wire) proves the primary is still
+    alive — the standby must stand down, never promote, or the trainer's
+    active_parameter_server would prefer a frozen replica over the live
+    primary and silently lose every later commit."""
+    primary, standby = _pair()
+    client = RemoteParameterServerClient("127.0.0.1", primary.port)
+    try:
+        client.commit(_params(1.0), commit_id=(0, 0))
+
+        def corrupt_attach():
+            raise ValueError("snapshot failed to decode")
+
+        standby._attach_to_primary = corrupt_attach
+        # break the stream from the PRIMARY side (FIN reliably wakes the
+        # follower's recv): the follower hits the re-attach path, where
+        # every attempt now decodes garbage while the primary answers
+        primary.ps._replicas[0].close()
+        assert _wait(lambda: not standby._repl_thread.is_alive())
+        assert not standby.promoted
+        assert standby.role == "standby"
+        # the primary keeps serving (sink detached, no gate armed here)
+        client.commit(_params(1.0), commit_id=(0, 1))
+        np.testing.assert_allclose(primary.ps.get_params()["w"], 2.0)
+    finally:
+        client.close()
+        standby.stop()
+        primary.stop()
+
+
+@pytest.mark.chaos
+def test_client_pinned_on_standby_rotates_to_healthy_primary():
+    """A standby ANSWERS the dial, so dial-level rotation alone never
+    leaves it; a standby refusal must rotate the redial past the sticky
+    index or the client livelocks against a replica that will never
+    promote (its primary is healthy)."""
+    primary, standby = _pair()
+    # standby listed FIRST: the initial dial pins the client on it
+    client = RemoteParameterServerClient(
+        endpoints=[("127.0.0.1", standby.port), ("127.0.0.1", primary.port)],
+        retry=_policy(max_attempts=5),
+    )
+    try:
+        assert client.endpoint == ("127.0.0.1", standby.port)
+        center, _ = client.pull(worker_id=0)  # refused once, then rotated
+        np.testing.assert_allclose(center["w"], 0.0)
+        assert client.endpoint == ("127.0.0.1", primary.port)
+        client.commit(_params(1.0), commit_id=(0, 0))
+        np.testing.assert_allclose(primary.ps.get_params()["w"], 1.0)
+    finally:
+        client.close()
+        standby.stop()
+        primary.stop()
+
+
+@pytest.mark.chaos
+def test_durability_gate_refuses_acks_without_replica():
+    """require_replicas(1): a commit landing during a replication outage
+    is never ACKED — the hole where work acked mid-outage dies with the
+    primary is closed. The policy-paced resend is absorbed once the
+    standby re-attaches (deduped if the apply already landed), and the
+    promoted sole survivor relaxes the gate."""
+    primary, standby = _pair()
+    primary.ps.require_replicas(1)
+    standby.ps.require_replicas(1)
+    client = RemoteParameterServerClient(
+        endpoints=[("127.0.0.1", primary.port), ("127.0.0.1", standby.port)],
+        retry=_policy(),
+    )
+    try:
+        client.commit(_params(1.0), commit_id=(0, 0))  # replicated + acked
+        # break ONLY the replication channel: the sink dies on the next
+        # forward, so that commit is applied locally but must NOT be acked
+        plan = FaultPlan(seed=0).arm("ps.replicate")
+        with plan:
+            # the client's retry loop spans the outage: first attempt gets
+            # no ack (replication lost mid-commit), the resend is gated
+            # until the standby re-attaches, then deduped and acked
+            client.commit(_params(1.0), commit_id=(0, 1))
+        assert _wait(lambda: standby.reattaches >= 1)
+        np.testing.assert_allclose(standby.ps.get_params()["w"], 2.0)
+        assert standby.ps._seen_seq == {0: 1}
+        assert primary.ps.min_replicas == 1  # re-armed by the re-attach
+        # promotion relaxes the sole survivor's gate: it serves
+        primary.kill()
+        client.commit(_params(1.0), commit_id=(0, 2))
+        assert _wait(lambda: standby.promoted)
+        assert standby.ps.min_replicas == 0
+        np.testing.assert_allclose(standby.ps.get_params()["w"], 3.0)
+    finally:
+        client.close()
+        standby.stop()
+
+
+@pytest.mark.chaos
+def test_replication_fault_detaches_sink_and_standby_resyncs():
+    """An armed ps.replicate seam breaks the stream: the primary detaches
+    the sink and keeps serving; the standby re-attaches with a FRESH
+    snapshot (never trusts a gapped log) and is consistent again."""
+    primary, standby = _pair()
+    client = RemoteParameterServerClient("127.0.0.1", primary.port)
+    try:
+        plan = FaultPlan(seed=0).arm("ps.replicate")
+        with plan:
+            client.commit(_params(1.0), commit_id=(0, 0))
+        assert plan.fired("ps.replicate") == 1
+        assert primary.ps.replication_drops == 1
+        # commit landed on the primary despite the replication fault
+        np.testing.assert_allclose(primary.ps.get_params()["w"], 1.0)
+        assert _wait(lambda: standby.reattaches == 1)
+        assert not standby.promoted  # primary alive: re-sync, not promote
+        client.commit(_params(1.0), commit_id=(0, 1))
+        np.testing.assert_allclose(standby.ps.get_params()["w"], 2.0)
+        assert standby.ps._seen_seq == {0: 1}
+    finally:
+        client.close()
+        standby.stop()
+        primary.stop()
