@@ -20,6 +20,14 @@ Seam catalogue (the hook points that exist today)::
     prefix_cache.fetch  PrefixStore.lookup (engine degrades to a miss)
     server.dispatch     ServingServer verb dispatch (typed-reply path)
     server.reply        ServingServer before sending a reply frame
+    router.dispatch     FleetRouter verb dispatch, before a replica is
+                        picked — an injected typed ServingError rides
+                        the normal typed-reply path to the client
+    router.health       FleetRouter health poll, per replica per sweep,
+                        before the replica is dialed — an injected
+                        raise counts as a failed poll (enough of them
+                        ejects the replica until a clean poll rejoins
+                        it)
     net.send            networking.send_data (both PS and serving wire)
     net.recv            networking.recv_data
     ps.pull             ParameterServer.pull, client-facing entry (both
@@ -80,6 +88,8 @@ SITES = frozenset(
         "prefix_cache.fetch",
         "server.dispatch",
         "server.reply",
+        "router.dispatch",
+        "router.health",
         "net.send",
         "net.recv",
         "ps.pull",

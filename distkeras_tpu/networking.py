@@ -61,27 +61,67 @@ def connect(host: str, port: int, timeout=30.0) -> socket.socket:
     return sock
 
 
+class EndpointsUnreachableError(ConnectionError):
+    """``connect_any`` exhausted every endpoint. ``causes`` holds the
+    ``((host, port), exception)`` pairs in dial order, and the message
+    names each endpoint with its own failure — a failover caller that
+    only saw the LAST error used to misdiagnose a half-dead fleet (one
+    refused, one timed out) as whichever endpoint happened to die last."""
+
+    def __init__(self, causes):
+        self.causes = list(causes)
+        detail = "; ".join(
+            f"{host}:{port}: {err!r}" for (host, port), err in self.causes
+        )
+        super().__init__(
+            f"all {len(self.causes)} endpoints unreachable ({detail})"
+        )
+
+
 def connect_any(endpoints, timeout=30.0, start=0):
     """Dial a list of ``(host, port)`` endpoints in rotation starting at
     index ``start``; return ``(sock, index)`` of the first that answers.
 
     THE multi-endpoint dial for replicated services (the PS primary +
-    warm-standby pair): a caller that remembers the returned index keeps
-    talking to the endpoint that last worked and only rotates onward when
-    it dies, so failover is sticky rather than thrashing. Raises the last
-    dial error when every endpoint refuses."""
+    warm-standby pair, the serving fleet's router): a caller that
+    remembers the returned index keeps talking to the endpoint that last
+    worked and only rotates onward when it dies, so failover is sticky
+    rather than thrashing. Raises :class:`EndpointsUnreachableError`
+    (a ``ConnectionError``) naming EVERY endpoint tried and its
+    per-endpoint cause when the whole rotation refuses."""
     endpoints = list(endpoints)
     if not endpoints:
         raise ValueError("connect_any needs at least one endpoint")
-    last_err = None
+    causes = []
     for k in range(len(endpoints)):
         i = (start + k) % len(endpoints)
         host, port = endpoints[i]
         try:
             return connect(host, port, timeout=timeout), i
         except OSError as e:
-            last_err = e
-    raise last_err
+            causes.append(((host, port), e))
+    raise EndpointsUnreachableError(causes)
+
+
+def probe(endpoints, timeout=1.0):
+    """Reachability sweep: dial each ``(host, port)`` once and close.
+    Returns ``{(host, port): None | OSError}`` — ``None`` means the
+    endpoint accepted the connection. The serving fleet's router uses
+    this to cheaply re-test EJECTED replicas before spending a full
+    health round-trip on them; it deliberately proves only that the
+    listener answers, not that the service behind it is healthy."""
+    out = {}
+    for host, port in endpoints:
+        try:
+            sock = connect(host, port, timeout=timeout)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            out[(host, int(port))] = None
+        except OSError as e:
+            out[(host, int(port))] = e
+    return out
 
 
 def send_data(sock: socket.socket, payload: bytes) -> None:

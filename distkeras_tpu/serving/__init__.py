@@ -21,6 +21,13 @@ decode path (scheduler -> engine -> server, plus the client).
 - ``server``/``client``: the length-prefixed TCP wire
   (``networking``) carrying pickle-free ``DKT1`` frames
   (``utils.serialization``), verbs generate/predict/health/stats/stop.
+- ``fleet``: N replica servers behind a ``FleetRouter`` speaking the
+  same wire — health-gated rotation, prefix-affinity routing (shared
+  headers land where their KV already lives), fleet-wide overload
+  shedding, transparent mid-request failover — plus the
+  ``FleetController``'s rolling bundle upgrade (``rollover``: drain
+  one replica at a time, hot-load the new bundle, health-check back
+  into rotation; no request dropped or duplicated).
 
 Robustness (see also ``distkeras_tpu/faults.py``): the scheduler
 assigns BLAME for device-step failures (masking retries + bisection)
@@ -51,12 +58,20 @@ from distkeras_tpu.serving.engine import (
 from distkeras_tpu.serving.prefix_cache import PrefixStore
 from distkeras_tpu.serving.server import ServingServer, serve
 from distkeras_tpu.serving.client import ServingClient
+from distkeras_tpu.serving.fleet import (
+    FleetController,
+    FleetRouter,
+    affinity_key,
+    local_replica_factory,
+)
 
 __all__ = [
     "ContinuousBatcher",
     "DeadlineExceededError",
     "DecodeStepper",
     "EngineStoppedError",
+    "FleetController",
+    "FleetRouter",
     "InternalError",
     "ModelDrafter",
     "NgramDrafter",
@@ -68,5 +83,7 @@ __all__ = [
     "ServingError",
     "ServingServer",
     "WindowedBatcher",
+    "affinity_key",
+    "local_replica_factory",
     "serve",
 ]

@@ -22,6 +22,14 @@ flushes ``fatal`` replies — ``frame_too_large`` — before closing), so
 the caller gets the reason, not a bare ``ConnectionError``; the last
 fatal reply is also remembered and attached to any later bare reset on
 the same client.
+
+Placement observability: every reply is stamped ``served_by`` with the
+``(host, port)`` that ANSWERED it (``setdefault`` — a stamp already
+present, e.g. the replica's stamp on a reply forwarded by the fleet
+router, is preserved), mirrored on ``client.last_served_by``;
+``client.connected_endpoint`` is the live socket's direct peer. Fleet
+tests assert prefix-affinity placement on these instead of reaching
+into router internals.
 """
 
 from __future__ import annotations
@@ -52,20 +60,54 @@ _ERRORS = {
 
 
 class ServingClient:
-    def __init__(self, host, port, timeout=120.0, retry=True):
+    def __init__(self, host, port, timeout=120.0, retry=True,
+                 connect_timeout=None):
         """``retry``: True (default) builds a ``RetryPolicy()``; a
         ``RetryPolicy`` instance is used as-is; False/None disables all
-        retrying and reconnecting (every failure surfaces raw)."""
+        retrying and reconnecting (every failure surfaces raw).
+        ``connect_timeout``: dial budget per connection attempt (default
+        ``timeout``) — the fleet router dials with a short one so a
+        silently dead replica fails over in seconds, while the operation
+        timeout stays long enough for a full generate."""
         self._host, self._port = host, int(port)
         self._timeout = timeout
+        self._connect_timeout = (
+            timeout if connect_timeout is None else float(connect_timeout)
+        )
         if retry is True:
             retry = RetryPolicy()
         elif not retry:
             retry = None
         self._retry = retry
         self._last_fatal = None  # last fatal typed reply on this client
-        self._sock = connect(self._host, self._port, timeout=self._timeout)
+        self._sock = self._dial()
         self.max_frame_bytes = None  # learned from health(), if called
+        # (host, port) that answered the most recent call — the fleet
+        # router forwards the replica's stamp, so through a router this
+        # is the REPLICA that served, not the router itself
+        self.last_served_by = None
+
+    def _dial(self):
+        sock = connect(
+            self._host, self._port, timeout=self._connect_timeout
+        )
+        sock.settimeout(self._timeout)
+        return sock
+
+    @property
+    def connected_endpoint(self):
+        """``(host, port)`` of the live socket's peer, or None when the
+        client is between connections. This is the direct peer — for a
+        fleet client that is the ROUTER; the serving replica's identity
+        arrives via the ``served_by`` reply stamp instead."""
+        sock = self._sock
+        if sock is None:
+            return None
+        try:
+            peer = sock.getpeername()
+        except OSError:
+            return None
+        return (peer[0], int(peer[1]))
 
     def close(self):
         self._drop()
@@ -86,11 +128,16 @@ class ServingClient:
 
     # -- round trip ---------------------------------------------------------
 
-    def _roundtrip(self, header: dict, payload: bytes):
+    def _roundtrip(self, header: dict, payload: bytes,
+                   raise_on_error=True):
+        """One request/reply frame pair. ``raise_on_error=False`` returns
+        error replies as ``(reply, body)`` instead of raising — the
+        fleet router's forwarding face, which must relay a replica's
+        typed reply verbatim rather than re-interpret it (fatal-reply
+        bookkeeping still runs, so a poisoned pooled connection is still
+        dropped)."""
         if self._sock is None:  # reconnect after a reset / fatal close
-            self._sock = connect(
-                self._host, self._port, timeout=self._timeout
-            )
+            self._sock = self._dial()
         try:
             send_data(self._sock, pack_frame(header, payload))
             raw = recv_data(self._sock)
@@ -106,8 +153,21 @@ class ServingClient:
                 ) from e
             raise
         reply, body = unpack_frame(raw)
+        # stamp the endpoint that answered. setdefault, not overwrite:
+        # a reply forwarded BY the router already carries the replica's
+        # stamp (the router's own internal client wrote it), and that is
+        # the placement truth fleet tests assert on
+        ep = self.connected_endpoint
+        if ep is not None:
+            reply.setdefault("served_by", [ep[0], ep[1]])
+        if reply.get("served_by") is not None:
+            self.last_served_by = (
+                reply["served_by"][0], int(reply["served_by"][1])
+            )
         if not reply.get("ok"):
-            raise self._typed_error(reply)
+            err = self._typed_error(reply)
+            if raise_on_error:
+                raise err
         return reply, body
 
     def _typed_error(self, reply: dict) -> ServingError:

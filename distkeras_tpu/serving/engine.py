@@ -1651,6 +1651,11 @@ class ServingEngine:
                 0 if batcher is None else len(batcher._quarantined)
             ),
         }
+        if batcher is not None:
+            # load surface for routers/load-balancers: occupancy plus
+            # the capacity bounds (slots + queue) a fleet router uses
+            # to account per-replica in-flight work and shed overload
+            out.update(batcher.load())
         if batcher is not None and getattr(
             self._stepper, "speculative", False
         ):

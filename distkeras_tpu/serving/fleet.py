@@ -1,0 +1,968 @@
+"""Serving fleet: N engine replicas behind a prefix-affinity router.
+
+One ``ServingEngine`` process is a vertical ceiling and a single point
+of failure — the serving sibling of the problem the replicated
+parameter server solved for training. This module is the fleet
+front-end over the existing DKT1 wire:
+
+- :class:`FleetRouter` — a TCP router speaking the SAME protocol as
+  ``ServingServer`` (a ``ServingClient`` pointed at the router cannot
+  tell the difference), forwarding ``generate``/``predict`` to one of
+  N replica servers and answering ``health``/``stats`` with the
+  fleet-level view. Replica selection is
+
+  * **health-gated**: a background sweep polls each replica's
+    ``health`` verb; ``degraded``/``draining`` replicas and replicas
+    that stop answering are EJECTED from rotation and rejoin only
+    after a clean poll (``networking.probe`` cheaply re-tests ejected
+    listeners before a full health round-trip is spent on them);
+  * **prefix-affine**: a ``generate`` routes by rendezvous hash of the
+    prompt's longest pow2 ladder key — the exact granularity
+    ``PrefixStore`` stores — so shared-header traffic lands on the
+    replica whose store already holds that KV. Honest limit: a suffix
+    that pushes the prompt past its next power of two changes the key
+    (the same exact-ladder granularity the store itself has);
+  * **load-accounted**: the router counts its own in-flight forwards
+    per replica against the capacity the replica's health advertises
+    (``num_slots + queue_capacity``); a saturated affinity home SPILLS
+    down the hash order, and only when EVERY replica in rotation is
+    saturated (or replies ``overloaded``) does the client see a
+    retriable ``overloaded`` with a ``retry_after_ms`` hint;
+  * **failover-transparent**: a replica that dies mid-forward is
+    ejected and the request is resent to a sibling — bounded (each
+    replica tried at most once per request) and only for the verbs
+    that are idempotent by the protocol's construction (``generate``/
+    ``predict``; the router never forwards ``stop``, the one
+    non-idempotent verb, so a failover can never duplicate a
+    side-effect). All siblings dead ⇒ typed ``unavailable`` naming
+    every endpoint tried and its cause, never a silent hang.
+
+- :class:`FleetController` — owns the replica processes/objects plus
+  the router, and implements **rolling bundle upgrade**:
+  ``rollover(bundle)`` walks the fleet one replica at a time — boot a
+  replacement from the new bundle, health-gate it into rotation, DRAIN
+  the old replica at the router (no new work; in-flight forwards
+  finish), stop it gracefully (``ServingServer.shutdown(drain=True)``
+  — anything it already admitted completes), remove it — so a
+  training-tier checkpoint reaches every replica without dropping or
+  duplicating a request. Fleet capacity never dips below N during the
+  walk because the replacement joins before the old replica leaves.
+
+Fault seams (``distkeras_tpu/faults.py``): ``router.dispatch`` fires
+at verb dispatch before a replica is picked (an injected
+``ServingError`` rides the typed-reply path; anything else replies
+typed ``internal``), ``router.health`` fires per replica per sweep (an
+injected raise counts as a failed poll — enough of them ejects the
+replica until a clean poll rejoins it). ``tools/soak_fleet.py`` is the
+standing proof: kill -9 a replica mid-stream under armed seams, assert
+zero hung clients / zero untyped errors / zero corrupt outputs, with a
+mid-soak rollover.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+import time
+
+import numpy as np
+
+from distkeras_tpu import faults
+from distkeras_tpu.networking import probe, recv_data, send_data
+from distkeras_tpu.serving.prefix_cache import _pow2_ladder
+from distkeras_tpu.serving.scheduler import ServingError
+from distkeras_tpu.utils.serialization import (
+    deserialize_params,
+    pack_frame,
+    unpack_frame,
+)
+
+_PROTOCOL = 1
+
+
+def affinity_key(prompt, min_len: int = 8) -> bytes | None:
+    """The routing key of ``prompt``: its longest pow2 ladder prefix —
+    the longest prefix ``PrefixStore`` could possibly hold for it — as
+    bytes. ``None`` when the prompt is shorter than ``min_len`` (too
+    short for the store to ever cache; such requests route least-loaded
+    instead)."""
+    tokens = np.asarray(prompt, np.int32).reshape(-1)
+    lens = _pow2_ladder(int(tokens.size), min_len=min_len)
+    if not lens:
+        return None
+    return np.ascontiguousarray(tokens[: lens[-1]]).tobytes()
+
+
+def _rendezvous(key: bytes, endpoint) -> int:
+    """Highest-random-weight score of ``(key, endpoint)``. Process- and
+    run-independent (no builtin ``hash``: PYTHONHASHSEED must not move
+    traffic between replicas across restarts)."""
+    h = hashlib.blake2b(key, digest_size=8)
+    h.update(f"@{endpoint[0]}:{endpoint[1]}".encode())
+    return int.from_bytes(h.digest(), "big")
+
+
+# replica rotation states
+JOINING = "joining"    # registered, no clean health poll yet
+ACTIVE = "active"      # in rotation
+EJECTED = "ejected"    # failed polls / died mid-forward; rejoin on a
+                       # clean poll
+DRAINING = "draining"  # router-initiated: no new work, in-flight
+                       # finishes; sticky until remove_replica
+
+
+class _Replica:
+    """Router-side book of one replica endpoint."""
+
+    def __init__(self, endpoint):
+        self.endpoint = (endpoint[0], int(endpoint[1]))
+        self.state = JOINING
+        self.fails = 0          # consecutive failed health polls
+        self.capacity = None    # num_slots + queue_capacity, from health
+        self.in_flight = 0      # router-side forwards outstanding
+        self.forwards = 0
+        self.failovers = 0      # forwards that died here and moved on
+        self.last_health = None
+
+    def snapshot(self) -> dict:
+        return {
+            "endpoint": [self.endpoint[0], self.endpoint[1]],
+            "state": self.state,
+            "in_flight": self.in_flight,
+            "capacity": self.capacity,
+            "forwards": self.forwards,
+            "failovers": self.failovers,
+            "consecutive_poll_failures": self.fails,
+        }
+
+
+class FleetRouter:
+    """DKT1 router over N ``ServingServer`` replicas. ``port=0`` binds
+    an ephemeral port (read it back from ``.port``). Start with
+    ``start()``; a plain ``ServingClient`` pointed at ``(host, port)``
+    speaks to the fleet as if it were one server."""
+
+    #: verbs safe to resend to a sibling after a mid-forward death —
+    #: re-running one produces the same answer (greedy decode is
+    #: deterministic; a duplicated generate costs compute, never
+    #: correctness). ``stop`` is deliberately NOT forwarded at all.
+    IDEMPOTENT = frozenset({"generate", "predict"})
+
+    def __init__(self, endpoints=(), host="127.0.0.1", port=0,
+                 backlog=64, max_frame_bytes=64 << 20,
+                 health_interval=0.25, health_timeout=2.0,
+                 eject_after=2, connect_timeout=2.0,
+                 request_timeout=120.0, retry_after_ms=50.0,
+                 affinity=True, affinity_min_len=8):
+        """``eject_after``: consecutive failed health polls before an
+        ACTIVE replica leaves rotation (a mid-forward connection death
+        ejects immediately — the poll budget is for the quiet path).
+        ``connect_timeout``: dial budget per forward attempt, kept
+        short so a silently dead replica fails over in seconds while
+        ``request_timeout`` stays long enough for a full generate.
+        ``affinity=False`` degrades ``generate`` routing to
+        least-loaded (the A/B baseline in ``bench_fleet.py``)."""
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.health_interval = float(health_interval)
+        self.health_timeout = float(health_timeout)
+        self.eject_after = int(eject_after)
+        self.connect_timeout = float(connect_timeout)
+        self.request_timeout = float(request_timeout)
+        self.retry_after_ms = float(retry_after_ms)
+        self.affinity = bool(affinity)
+        self.affinity_min_len = int(affinity_min_len)
+        self._lock = threading.Lock()
+        self._replicas: dict[tuple, _Replica] = {}
+        self._pools: dict[tuple, list] = {}   # idle forward clients
+        self._health_clients: dict[tuple, object] = {}
+        # per-endpoint poll serialization: the sweep thread and a
+        # wait_in_rotation caller must not interleave frames on the
+        # one persistent health connection
+        self._poll_locks: dict[tuple, threading.Lock] = {}
+        self._drained = threading.Condition(self._lock)
+        self.counters = {
+            "forwards": 0,
+            "affinity_routed": 0,   # generate landed on its hash home
+            "spilled": 0,           # hash home saturated, next in order
+            "least_loaded_routed": 0,
+            "failovers": 0,
+            "fleet_overloaded": 0,  # every replica saturated/overloaded
+            "unavailable": 0,       # every replica unreachable
+            "ejections": 0,
+            "rejoins": 0,
+        }
+        for ep in endpoints:
+            self._replicas[(ep[0], int(ep[1]))] = _Replica(ep)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(int(backlog))
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._accept_thread = None
+        self._health_thread = None
+        self._conn_threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._stopping = threading.Event()
+        self._shutdown_done = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        if self._accept_thread is None:
+            self._health_sweep()  # synchronous first sweep: a router
+            # that starts with live replicas routes from request one
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="fleet-health", daemon=True
+            )
+            self._health_thread.start()
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, name="fleet-accept", daemon=True
+            )
+            self._accept_thread.start()
+        return self
+
+    def shutdown(self, drain=True):
+        """Close the listener and stop routing. Replicas are NOT
+        stopped — the router does not own them (``FleetController``
+        does). Idempotent and awaitable, like ``ServingServer``."""
+        with self._lock:
+            first = not self._stopping.is_set()
+            self._stopping.set()
+        if not first:
+            self._shutdown_done.wait(timeout=90)
+            return
+        try:
+            # shutdown BEFORE close: a bare close does not wake a
+            # thread blocked in accept(), which would leak it and
+            # stall the join below for its full timeout
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            with self._lock:
+                threads = list(self._conn_threads)
+            deadline = time.monotonic() + (5 if drain else 0)
+            for th in threads:
+                th.join(timeout=max(0.0, deadline - time.monotonic()))
+            with self._lock:
+                lingering = list(self._conns)
+            for conn in lingering:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            for th in threads:
+                th.join(timeout=5)
+            if self._accept_thread is not None:
+                self._accept_thread.join(timeout=5)
+            if self._health_thread is not None:
+                self._health_thread.join(timeout=5)
+            with self._lock:
+                pools = list(self._pools.values())
+                self._pools.clear()
+                health = list(self._health_clients.values())
+                self._health_clients.clear()
+            for pool in pools:
+                for cli in pool:
+                    cli.close()
+            for cli in health:
+                cli.close()
+        finally:
+            self._shutdown_done.set()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # -- rotation management (the controller's face) ------------------------
+
+    def add_replica(self, endpoint) -> None:
+        """Register an endpoint. It enters rotation only after a clean
+        health poll (health-gated admission) — call
+        ``wait_in_rotation`` to block on that."""
+        ep = (endpoint[0], int(endpoint[1]))
+        with self._lock:
+            rep = self._replicas.get(ep)
+            if rep is None:
+                self._replicas[ep] = _Replica(ep)
+            elif rep.state == DRAINING:
+                # re-adding a drained replica UN-drains it (the aborted-
+                # rollover path); it still re-enters via the health gate
+                rep.state = JOINING
+
+    def remove_replica(self, endpoint) -> None:
+        ep = (endpoint[0], int(endpoint[1]))
+        with self._lock:
+            self._replicas.pop(ep, None)
+            pool = self._pools.pop(ep, [])
+            health = self._health_clients.pop(ep, None)
+            self._poll_locks.pop(ep, None)
+        for cli in pool:
+            cli.close()
+        if health is not None:
+            health.close()
+
+    def drain_replica(self, endpoint) -> None:
+        """Take ``endpoint`` out of rotation WITHOUT ejecting it: no
+        new requests route there, in-flight forwards complete. Sticky —
+        health polls cannot rejoin a draining replica; only
+        ``remove_replica`` (or re-``add_replica``) clears the state."""
+        ep = (endpoint[0], int(endpoint[1]))
+        with self._lock:
+            rep = self._replicas.get(ep)
+            if rep is not None:
+                rep.state = DRAINING
+
+    def wait_drained(self, endpoint, timeout=60.0) -> bool:
+        """Block until the router has ZERO in-flight forwards to
+        ``endpoint`` (or it was removed). True on drained."""
+        ep = (endpoint[0], int(endpoint[1]))
+        deadline = time.monotonic() + float(timeout)
+        with self._lock:
+            while True:
+                rep = self._replicas.get(ep)
+                if rep is None or rep.in_flight == 0:
+                    return True
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._drained.wait(timeout=min(left, 0.5))
+
+    def wait_in_rotation(self, endpoint, timeout=30.0) -> bool:
+        """Block until ``endpoint`` is ACTIVE (health-gated in). The
+        wait polls the replica directly rather than riding the sweep
+        cadence, so controller rollovers are not paced by
+        ``health_interval``."""
+        ep = (endpoint[0], int(endpoint[1]))
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                rep = self._replicas.get(ep)
+            if rep is None:
+                return False
+            if rep.state == ACTIVE:
+                return True
+            self._poll_one(ep)
+            time.sleep(min(0.05, self.health_interval))
+        return False
+
+    def replicas(self) -> list[dict]:
+        with self._lock:
+            return [r.snapshot() for r in self._replicas.values()]
+
+    # -- health sweep -------------------------------------------------------
+
+    def _health_loop(self):
+        while not self._stopping.is_set():
+            self._health_sweep()
+            self._stopping.wait(self.health_interval)
+
+    def _health_sweep(self):
+        with self._lock:
+            states = {ep: r.state for ep, r in self._replicas.items()}
+
+        def sweep_one(ep, state):
+            if self._stopping.is_set():
+                return
+            if state == EJECTED:
+                # cheap dial-probe of an EJECTED listener first: a dead
+                # process costs one refused connect, not a full health
+                # client + RTT
+                err = probe([ep], timeout=self.health_timeout)[ep]
+                if err is not None:
+                    self._poll_failed(ep)
+                    return
+            self._poll_one(ep)
+
+        # poll CONCURRENTLY: one unreachable-but-not-refusing endpoint
+        # (dropped packets, a stopped process) blocks its own poll for
+        # health_timeout; serialized, it would stall ejection of every
+        # OTHER replica and grow the sweep cadence with fleet size
+        threads = [
+            threading.Thread(
+                target=sweep_one, args=(ep, st),
+                name="fleet-poll", daemon=True,
+            )
+            for ep, st in states.items()
+        ]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + self.health_timeout + 2.0
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def _poll_one(self, ep):
+        with self._lock:
+            plock = self._poll_locks.setdefault(ep, threading.Lock())
+        try:
+            faults.fire("router.health", endpoint=ep)
+            with plock:
+                cli = self._health_client(ep)
+                h = cli.health()
+        except Exception:  # noqa: BLE001 — any poll failure counts once
+            # close the stale client UNDER the poll lock: a concurrent
+            # poller (wait_in_rotation bypasses the sweep cadence) may
+            # be mid-health() on this very socket, and a close landing
+            # under it would turn a healthy reply into a second failed
+            # poll — enough to eject a healthy replica at eject_after=2
+            with plock:
+                with self._lock:
+                    stale = self._health_clients.pop(ep, None)
+                if stale is not None:
+                    stale.close()
+            self._poll_failed(ep)
+            return
+        with self._lock:
+            rep = self._replicas.get(ep)
+            if rep is None:
+                return
+            rep.last_health = h
+            if h.get("num_slots") is not None:
+                rep.capacity = int(h["num_slots"]) + int(
+                    h.get("queue_capacity") or 0
+                )
+            if h.get("status") == "serving":
+                rep.fails = 0
+                if rep.state in (JOINING, EJECTED):
+                    if rep.state == EJECTED:
+                        self.counters["rejoins"] += 1
+                    rep.state = ACTIVE
+            else:  # degraded | draining: the replica said so itself
+                if rep.state == ACTIVE:
+                    self.counters["ejections"] += 1
+                    rep.state = EJECTED
+                rep.fails = max(rep.fails, self.eject_after)
+
+    def _poll_failed(self, ep):
+        with self._lock:
+            rep = self._replicas.get(ep)
+            if rep is None:
+                return
+            rep.fails += 1
+            if rep.state == ACTIVE and rep.fails >= self.eject_after:
+                self.counters["ejections"] += 1
+                rep.state = EJECTED
+
+    def _health_client(self, ep):
+        from distkeras_tpu.serving.client import ServingClient
+
+        with self._lock:
+            cli = self._health_clients.get(ep)
+        if cli is None:
+            cli = ServingClient(
+                ep[0], ep[1], timeout=self.health_timeout,
+                connect_timeout=self.health_timeout, retry=False,
+            )
+            with self._lock:
+                prior = self._health_clients.get(ep)
+                if prior is not None:
+                    cli.close()
+                    return prior
+                self._health_clients[ep] = cli
+        return cli
+
+    # -- forward-connection pool --------------------------------------------
+
+    def _checkout(self, ep):
+        from distkeras_tpu.serving.client import ServingClient
+
+        with self._lock:
+            pool = self._pools.setdefault(ep, [])
+            if pool:
+                return pool.pop()
+        return ServingClient(
+            ep[0], ep[1], timeout=self.request_timeout,
+            connect_timeout=self.connect_timeout, retry=False,
+        )
+
+    def _checkin(self, ep, cli):
+        with self._lock:
+            if ep in self._replicas and not self._stopping.is_set():
+                self._pools.setdefault(ep, []).append(cli)
+                return
+        cli.close()
+
+    # -- connection handling (client side of the router) --------------------
+
+    def _accept_loop(self):
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            th = threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="fleet-conn", daemon=True,
+            )
+            with self._lock:
+                self._conn_threads = [
+                    t for t in self._conn_threads if t.is_alive()
+                ]
+                self._conn_threads.append(th)
+                self._conns.add(conn)
+            th.start()
+
+    def _serve_conn(self, conn):
+        try:
+            self._serve_frames(conn)
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_frames(self, conn):
+        while True:
+            try:
+                frame = recv_data(conn, max_len=self.max_frame_bytes)
+            except ValueError:
+                try:
+                    send_data(conn, pack_frame(
+                        {"ok": False, "error": "frame_too_large",
+                         "fatal": True,
+                         "max_frame_bytes": self.max_frame_bytes,
+                         "detail": f"limit {self.max_frame_bytes} bytes"}
+                    ))
+                except (ConnectionError, OSError):
+                    pass
+                return
+            except (ConnectionError, OSError):
+                return
+            try:
+                reply = self._dispatch(frame)
+            except ServingError as e:
+                header = {"ok": False, "error": e.code, "detail": str(e)}
+                if getattr(e, "retry_after", None) is not None:
+                    header["retry_after_ms"] = e.retry_after * 1e3
+                elif e.code == "overloaded":
+                    header["retry_after_ms"] = self.retry_after_ms
+                reply = pack_frame(header)
+            except (ConnectionError, OSError) as e:
+                # forward-side wire death that escaped failover — only
+                # reachable if a non-idempotent verb is ever routed
+                # (today none is); typed, never a silent close
+                reply = pack_frame(
+                    {"ok": False, "error": "unavailable",
+                     "detail": repr(e),
+                     "retry_after_ms": self.retry_after_ms}
+                )
+            except Exception as e:  # noqa: BLE001 — wire boundary
+                reply = pack_frame(
+                    {"ok": False, "error": "internal", "detail": repr(e)}
+                )
+            try:
+                send_data(conn, reply)
+            except (ConnectionError, OSError):
+                return
+            if self._stopping.is_set():
+                return
+
+    # -- verbs --------------------------------------------------------------
+
+    def _dispatch(self, frame: bytes) -> bytes:
+        header, payload = unpack_frame(frame)
+        verb = header.get("verb")
+        faults.fire("router.dispatch", verb=verb)
+        if verb in ("generate", "predict"):
+            reply, body = self._route(header, payload)
+            return pack_frame(reply, body)
+        if verb == "health":
+            return pack_frame(self._health_reply())
+        if verb == "stats":
+            return pack_frame({"ok": True, "stats": self.stats()})
+        if verb == "stop":
+            # stop THE ROUTER (reply first, drain on a side thread,
+            # mirroring ServingServer). Replica lifecycle belongs to
+            # the controller: forwarding stop would tear down capacity
+            # behind its back, and stop is the one non-idempotent verb.
+            threading.Thread(
+                target=self.shutdown, kwargs={"drain": True}, daemon=True
+            ).start()
+            return pack_frame({"ok": True, "stopping": True})
+        raise ValueError(f"unknown verb {verb!r}")
+
+    def _health_reply(self) -> dict:
+        with self._lock:
+            reps = [r.snapshot() for r in self._replicas.values()]
+        active = sum(r["state"] == ACTIVE for r in reps)
+        if self._stopping.is_set():
+            status = "draining"
+        elif active > 0:
+            status = "serving"
+        else:
+            status = "degraded"
+        return {
+            "ok": True,
+            "protocol": _PROTOCOL,
+            "role": "router",
+            "status": status,
+            "endpoint": [self.host, int(self.port)],
+            "max_frame_bytes": self.max_frame_bytes,
+            "replicas": reps,
+            "active_replicas": active,
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["replicas"] = [r.snapshot() for r in self._replicas.values()]
+            out["open_connections"] = len(self._conns)
+        out["affinity_enabled"] = self.affinity
+        return out
+
+    # -- routing ------------------------------------------------------------
+
+    def _affinity_key(self, verb, payload):
+        if verb != "generate" or not self.affinity:
+            return None
+        try:
+            prompt = deserialize_params(payload)
+        except Exception:  # noqa: BLE001 — let the replica reply typed
+            return None    # bad_request; routing must not pre-judge it
+        return affinity_key(prompt, min_len=self.affinity_min_len)
+
+    def _pick(self, key, excluded):
+        """One routing decision under the lock: ``(replica, how)`` or
+        ``(None, why)`` — ``why`` is "empty" (nothing in rotation),
+        "tried" (every rotation member already excluded this request),
+        or "saturated" (members remain but none has capacity)."""
+        cands = [
+            r for r in self._replicas.values() if r.state == ACTIVE
+        ]
+        if not cands:
+            return None, "empty"
+        fresh = [r for r in cands if r.endpoint not in excluded]
+        if not fresh:
+            return None, "tried"
+        if key is not None:
+            order = sorted(
+                fresh,
+                key=lambda r: _rendezvous(key, r.endpoint),
+                reverse=True,
+            )
+            for i, rep in enumerate(order):
+                if rep.capacity is None or rep.in_flight < rep.capacity:
+                    return rep, ("affinity" if i == 0 else "spill")
+            return None, "saturated"
+        order = sorted(
+            fresh,
+            key=lambda r: (
+                r.in_flight / r.capacity if r.capacity else r.in_flight
+            ),
+        )
+        for rep in order:
+            if rep.capacity is None or rep.in_flight < rep.capacity:
+                return rep, "least_loaded"
+        return None, "saturated"
+
+    def _route(self, header: dict, payload: bytes):
+        """Pick a replica, forward, failover. Returns ``(reply, body)``
+        to relay verbatim (the replica's typed errors — deadline,
+        internal, bad_request — pass through untouched; only fleet-wide
+        saturation and fleet-wide death are the router's own replies)."""
+        verb = header.get("verb")
+        key = self._affinity_key(verb, payload)
+        excluded: set = set()
+        causes = []
+        saw_overloaded_hint = None
+        while True:
+            with self._lock:
+                rep, how = self._pick(key, excluded)
+                if rep is not None:
+                    rep.in_flight += 1
+                    rep.forwards += 1
+                    self.counters["forwards"] += 1
+                    self.counters[
+                        {"affinity": "affinity_routed",
+                         "spill": "spilled",
+                         "least_loaded": "least_loaded_routed"}[how]
+                    ] += 1
+                    ep = rep.endpoint
+            if rep is None:
+                if how == "saturated" or saw_overloaded_hint is not None:
+                    with self._lock:
+                        self.counters["fleet_overloaded"] += 1
+                    hint = saw_overloaded_hint or self.retry_after_ms
+                    return {
+                        "ok": False, "error": "overloaded",
+                        "detail": "every fleet replica is saturated",
+                        "retry_after_ms": float(hint),
+                    }, b""
+                with self._lock:
+                    self.counters["unavailable"] += 1
+                detail = "no replica in rotation" if how == "empty" else (
+                    "every replica failed: " + "; ".join(
+                        f"{h}:{p}: {e!r}" for (h, p), e in causes
+                    )
+                )
+                return {
+                    "ok": False, "error": "unavailable", "detail": detail,
+                    "retry_after_ms": self.retry_after_ms,
+                }, b""
+            try:
+                cli = self._checkout(ep)
+                try:
+                    reply, body = cli._roundtrip(
+                        header, payload, raise_on_error=False
+                    )
+                except BaseException:
+                    cli.close()
+                    raise
+                self._checkin(ep, cli)
+            except (ConnectionError, OSError) as e:
+                self._forward_died(ep, e, causes, excluded)
+                # every verb _dispatch routes today IS idempotent, so
+                # this always continues (bounded: ep now in excluded);
+                # the raise is the safety net for a future non-
+                # idempotent routed verb, which must surface the death
+                # rather than risk a duplicated side effect
+                if verb in self.IDEMPOTENT:
+                    continue
+                raise
+            finally:
+                with self._lock:
+                    r = self._replicas.get(ep)
+                    if r is not None:
+                        r.in_flight -= 1
+                        self._drained.notify_all()
+            if (not reply.get("ok")
+                    and reply.get("error") == "overloaded"):
+                # replica-level saturation the router's accounting
+                # missed (capacity estimate stale): try a sibling; the
+                # client only sees overloaded when EVERY one refused
+                excluded.add(ep)
+                hint = reply.get("retry_after_ms")
+                if hint is not None:
+                    saw_overloaded_hint = max(
+                        saw_overloaded_hint or 0.0, float(hint)
+                    )
+                continue
+            return reply, body
+
+    def _forward_died(self, ep, exc, causes, excluded):
+        """A forward connection died mid-request: eject the replica now
+        (health polls will rejoin it when it answers again) and record
+        the cause for the all-dead reply."""
+        causes.append((ep, exc))
+        excluded.add(ep)
+        with self._lock:
+            rep = self._replicas.get(ep)
+            if rep is not None:
+                rep.failovers += 1
+                rep.fails = max(rep.fails, self.eject_after)
+                if rep.state == ACTIVE:
+                    self.counters["ejections"] += 1
+                    rep.state = EJECTED
+            self.counters["failovers"] += 1
+            pool = self._pools.pop(ep, [])
+        for cli in pool:  # siblings of a dead connection are suspect
+            cli.close()
+
+
+# --------------------------------------------------------------- controller
+
+
+class _LocalReplica:
+    """One in-process replica: engine + ``ServingServer``. The default
+    ``FleetController`` backend (tests, the example, single-host
+    fleets); the soak's subprocess replicas implement the same
+    protocol — ``endpoint``, ``stop(drain=)``, ``alive()``."""
+
+    def __init__(self, engine, server):
+        self.engine = engine
+        self.server = server
+        self.endpoint = (server.host, int(server.port))
+
+    def stop(self, drain=True):
+        self.server.shutdown(drain=drain)
+
+    def alive(self) -> bool:
+        th = self.server._accept_thread
+        return th is not None and th.is_alive()
+
+
+def local_replica_factory(host="127.0.0.1", **engine_kw):
+    """Factory of in-process replicas: ``factory(bundle)`` boots a
+    ``ServingEngine`` from ``bundle`` (a serving-bundle path, or a
+    model instance for tests) behind its own ``ServingServer`` on an
+    ephemeral port."""
+
+    def factory(bundle):
+        from distkeras_tpu.serving.engine import ServingEngine
+        from distkeras_tpu.serving.server import ServingServer
+
+        engine = (
+            ServingEngine.from_bundle(bundle, **engine_kw)
+            if isinstance(bundle, str)
+            else ServingEngine(bundle, **engine_kw)
+        )
+        server = ServingServer(engine, host=host).start()
+        return _LocalReplica(engine, server)
+
+    return factory
+
+
+class FleetController:
+    """Owns N replicas plus their router; implements rolling upgrade.
+
+    ``bundle``: what replicas boot from — a serving-bundle path (the
+    production flow) or a model instance. ``factory``: replaces the
+    local in-process backend (the chaos soak passes a subprocess
+    spawner). ``router_kw`` feeds ``FleetRouter``; ``engine_kw`` feeds
+    each local replica's engine."""
+
+    def __init__(self, bundle, replicas=2, factory=None,
+                 router_kw=None, **engine_kw):
+        if int(replicas) < 1:
+            raise ValueError("a fleet needs at least 1 replica")
+        self._bundle = bundle
+        self._n = int(replicas)
+        self._factory = factory or local_replica_factory(**engine_kw)
+        self._router_kw = dict(router_kw or {})
+        self.replicas: list = []
+        self.router: FleetRouter | None = None
+        self.rollovers = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FleetController":
+        if self.router is not None:
+            return self
+        try:
+            for _ in range(self._n):
+                self.replicas.append(self._factory(self._bundle))
+            self.router = FleetRouter(
+                endpoints=[r.endpoint for r in self.replicas],
+                **self._router_kw,
+            ).start()
+            for r in self.replicas:
+                if not self.router.wait_in_rotation(r.endpoint):
+                    raise RuntimeError(
+                        f"replica {r.endpoint} never became healthy"
+                    )
+        except BaseException:
+            self.stop()
+            raise
+        return self
+
+    def stop(self):
+        """Router first (clients get typed failures, not forwards into
+        stopping replicas), then each replica gracefully."""
+        if self.router is not None:
+            self.router.shutdown()
+            self.router = None
+        for r in self.replicas:
+            try:
+                r.stop(drain=True)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self.replicas = []
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    @property
+    def endpoint(self):
+        """The router's ``(host, port)`` — what clients dial."""
+        return (self.router.host, self.router.port)
+
+    def client(self, **kw):
+        from distkeras_tpu.serving.client import ServingClient
+
+        return ServingClient(self.router.host, self.router.port, **kw)
+
+    def reap_dead(self) -> list:
+        """Drop replicas whose process/server is gone (e.g. the soak's
+        kill -9 victims) from the controller's book and the router's
+        rotation. Returns the reaped handles."""
+        gone = [r for r in self.replicas if not r.alive()]
+        for r in gone:
+            self.router.remove_replica(r.endpoint)
+            self.replicas.remove(r)
+        return gone
+
+    # -- rolling upgrade ----------------------------------------------------
+
+    def rollover(self, bundle=None, timeout=120.0) -> dict:
+        """Upgrade every replica to ``bundle`` (default: the boot
+        bundle) one at a time, never dropping a request:
+
+        1. boot a REPLACEMENT from the new bundle (capacity never dips);
+        2. health-gate it into the router's rotation;
+        3. DRAIN the old replica at the router — new work routes
+           elsewhere, in-flight forwards complete (``wait_drained``);
+        4. remove it from rotation and stop it gracefully
+           (``shutdown(drain=True)``: anything it already admitted —
+           e.g. work that arrived before the drain — still completes);
+        5. next replica.
+
+        Nothing is resent during a rollover, so nothing can be
+        duplicated; nothing is refused that a healthy sibling could
+        serve, so nothing is dropped. Returns the rollover ledger."""
+        if self.router is None:
+            raise RuntimeError("controller not started")
+        bundle = self._bundle if bundle is None else bundle
+        self._bundle = bundle
+        ledger = {"replaced": [], "seconds": 0.0}
+        t0 = time.monotonic()
+        for i, old in enumerate(list(self.replicas)):
+            new = self._factory(bundle)
+            try:
+                self.router.add_replica(new.endpoint)
+                if not self.router.wait_in_rotation(
+                    new.endpoint, timeout=timeout
+                ):
+                    raise RuntimeError(
+                        f"replacement {new.endpoint} never became "
+                        "healthy; rollover aborted (old replica still "
+                        "serving)"
+                    )
+            except BaseException:
+                self.router.remove_replica(new.endpoint)
+                new.stop(drain=False)
+                raise
+            self.router.drain_replica(old.endpoint)
+            if not self.router.wait_drained(old.endpoint, timeout=timeout):
+                # never strand client work: put the old replica back
+                # and surface the wedge instead of killing it mid-flight.
+                # The replacement must not leak either — it is already
+                # in rotation and may have taken traffic, so drain it
+                # out and stop it, restoring the pre-rollover fleet
+                self.router.add_replica(old.endpoint)
+                self.router.drain_replica(new.endpoint)
+                self.router.wait_drained(new.endpoint, timeout=timeout)
+                self.router.remove_replica(new.endpoint)
+                try:
+                    new.stop(drain=True)
+                except Exception:  # noqa: BLE001 — abort is best-effort
+                    pass
+                raise RuntimeError(
+                    f"replica {old.endpoint} still has in-flight work "
+                    f"after {timeout}s; rollover aborted"
+                )
+            self.router.remove_replica(old.endpoint)
+            old.stop(drain=True)
+            self.replicas[self.replicas.index(old)] = new
+            ledger["replaced"].append(
+                {"old": list(old.endpoint), "new": list(new.endpoint)}
+            )
+        self.rollovers += 1
+        ledger["seconds"] = round(time.monotonic() - t0, 3)
+        return ledger

@@ -692,6 +692,20 @@ class ContinuousBatcher:
                 s is None for s in self._slots
             )
 
+    def load(self) -> dict:
+        """Cheap occupancy snapshot for the health surface (polled by
+        load balancers / the fleet router every few hundred ms — must
+        not build the full ``stats()`` dict): queued + active work and
+        the capacity bounds a router needs to account in-flight load."""
+        with self._lock:
+            return {
+                "queue_depth": len(self._queue),
+                "queue_capacity": self.queue_capacity,
+                "active_slots": sum(s is not None for s in self._slots),
+                "prefilling_slots": len(self._prefill_left),
+                "num_slots": len(self._slots),
+            }
+
     def stats(self) -> dict:
         with self._lock:
             active = sum(s is not None for s in self._slots)

@@ -111,6 +111,13 @@ class ServingServer:
             self._shutdown_done.wait(timeout=90)
             return
         try:
+            # shutdown BEFORE close: a bare close does not wake a
+            # thread blocked in accept(), which would leak it and
+            # stall the accept-thread join below for its full timeout
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:
@@ -244,6 +251,10 @@ class ServingServer:
                 "ok": True,
                 "protocol": _PROTOCOL,
                 "max_frame_bytes": self.max_frame_bytes,
+                # the server's canonical bound address: a fleet router
+                # keys its rotation on this, and a health reply that
+                # names its endpoint is self-describing in logs
+                "endpoint": [self.host, int(self.port)],
             }
             h.update(self.engine.health())
             if self._stopping.is_set():

@@ -11,6 +11,8 @@ work.
 
 Usage:
     python examples/serve_lm.py [--cpu] [--seq 64] [--slots 4]
+                                [--speculative [--draft-bundle PATH]]
+                                [--fleet N]
 """
 
 from __future__ import annotations
@@ -43,12 +45,25 @@ def main():
                     "persist it as a quantized serving bundle at PATH, "
                     "and serve draft-and-verify FROM THAT BUNDLE (the "
                     "second-bundle flow a speculative serving host runs)")
+    ap.add_argument("--fleet", type=int, metavar="N", default=None,
+                    help="serve N engine replicas behind the prefix-"
+                    "affinity FleetRouter (all booted from the one "
+                    "bundle), then demo a zero-downtime rolling bundle "
+                    "upgrade")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.draft_bundle and not args.speculative:
         # fail BEFORE training, not after a long run
         ap.error("--draft-bundle feeds the speculative drafter; "
                  "pass --speculative too")
+    if args.fleet is not None and args.fleet < 2:
+        ap.error("--fleet N needs N >= 2 (one replica is just a "
+                 "server; the router exists to spread and fail over)")
+    if args.fleet and args.speculative:
+        # each knob is its own demo; N speculative engines would just
+        # multiply boot time without showing anything new
+        ap.error("--fleet and --speculative are separate demos; "
+                 "pick one")
 
     from distkeras_tpu.parallel.backend import setup_backend
 
@@ -105,6 +120,9 @@ def main():
         bundle = os.path.join(tmp, "lm_int8.dkt")
         save_serving_bundle(bundle, quantize_model(trained.copy()))
         print(f"serving bundle: {os.path.getsize(bundle)} bytes")
+        if args.fleet:
+            serve_fleet(args, bundle)
+            return
         engine = ServingEngine.from_bundle(
             bundle, num_slots=args.slots, queue_capacity=32, **spec_kw,
         )
@@ -160,6 +178,75 @@ def main():
             c.stop()  # graceful: drains in-flight work, then closes
         server.shutdown()
         print("drained and stopped")
+
+
+def serve_fleet(args, bundle):
+    """--fleet N: the replicated flow a production serving host runs —
+    N replicas booted from ONE bundle behind the prefix-affinity
+    router, concurrent shared-header clients (placement visible via
+    the ``served_by`` reply stamp), then a zero-downtime rolling
+    bundle upgrade and proof the upgraded fleet still serves."""
+    from distkeras_tpu.serving import FleetController, ServingClient
+
+    ctl = FleetController(
+        bundle, replicas=args.fleet, num_slots=args.slots,
+        queue_capacity=32,
+    ).start()
+    try:
+        host, port = ctl.endpoint
+        print(f"fleet: {args.fleet} replicas behind router "
+              f"{host}:{port} "
+              f"({', '.join('%s:%s' % r.endpoint for r in ctl.replicas)})")
+
+        # shared-header traffic: every prompt extends one 16-token
+        # header, so prefix affinity must land ALL of them on ONE
+        # replica (where the shared KV lives)
+        header = (np.arange(16, dtype=np.int32) * 3 + 1) % args.vocab
+        prompts = [
+            np.concatenate([header,
+                            np.asarray(sfx, np.int32) % args.vocab])
+            for sfx in ([17], [17, 18], [17, 18, 19], [17, 18, 19, 20])
+        ]
+        steps = min(10, args.seq // 2)
+        results = [None] * len(prompts)
+        served = [None] * len(prompts)
+
+        def client(i):
+            with ServingClient(host, port) as c:
+                results[i] = c.generate(prompts[i], steps)
+                served[i] = c.last_served_by
+
+        t0 = time.time()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.time() - t0
+        for row in results:
+            print("served decode:", row.tolist())  # must count upward
+        homes = {s for s in served}
+        print(f"{len(prompts)} shared-header requests x {steps} tokens "
+              f"in {dt:.2f}s, served by {len(homes)} replica(s): "
+              f"{sorted('%s:%s' % h for h in homes)}")
+
+        # rolling upgrade: same bundle stands in for the next training
+        # checkpoint — the sequence (boot replacement, health-gate in,
+        # drain old, stop old) is identical either way
+        ledger = ctl.rollover(bundle)
+        print(f"rollover complete: {len(ledger['replaced'])} replicas "
+              f"upgraded in {ledger['seconds']}s, zero requests "
+              f"dropped")
+        with ServingClient(host, port) as c:
+            out = c.generate(prompts[0], steps)
+            print("served decode (upgraded fleet):", out.tolist())
+            h = c.health()
+            print(f"fleet health: {h['status']}, "
+                  f"{h['active_replicas']} replicas in rotation")
+    finally:
+        ctl.stop()
+    print("drained and stopped")
 
 
 if __name__ == "__main__":

@@ -20,6 +20,7 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
 import benchmarks  # noqa: E402
+import bench_fleet  # noqa: E402
 import bench_mfu  # noqa: E402
 import bench_serving  # noqa: E402
 import mfu_attrib  # noqa: E402
@@ -119,6 +120,107 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
             acc["drafted_tokens"]
             >= acc["accepted_draft_tokens"]
         ), name
+
+
+def _check_fleet_record(rec):
+    """The BENCH_FLEET.json contract both the smoke artifact and the
+    committed artifact must meet: three sides per workload (single /
+    fleet_affinity / fleet_random), throughput + latency percentiles,
+    prefix-cache ledgers with hit rates, router counters on the fleet
+    sides, the single-core honesty caveat, and the identity flag."""
+    assert rec["metric"] == "fleet_tokens_per_sec"
+    assert rec["value"] > 0
+    assert rec["replicas"] == 2
+    assert "time-share" in rec["single_core_caveat"]
+    assert set(rec["workloads"]) == {"prefix_heavy", "zero_reuse"}
+    for name, wl in rec["workloads"].items():
+        assert wl["outputs_identical"] is True, name
+        assert wl["fleet_vs_single"] > 0, name
+        for rate_key in ("affinity_hit_rate", "random_hit_rate"):
+            assert 0.0 <= wl[rate_key] <= 1.0, (name, rate_key)
+        for side in ("single", "fleet_affinity", "fleet_random"):
+            s = wl[side]
+            assert s["tokens_per_sec"] > 0, (name, side)
+            for pct in ("mean", "p50", "p99"):
+                assert s["latency_ms"][pct] > 0, (name, side, pct)
+            pc = s["prefix_cache"]
+            assert pc["hits"] + pc["misses"] >= 0, (name, side)
+            assert 0.0 <= pc["hit_rate"] <= 1.0, (name, side)
+            if side == "single":
+                assert "router" not in s, name  # no router to report
+                assert len(pc["entries_per_replica"]) == 1, name
+            else:
+                r = s["router"]
+                # every timed request was forwarded, none dropped to
+                # the fleet-level failure counters on a quiet bench
+                assert r["forwards"] >= wl["num_requests"], (name, side)
+                assert r["failovers"] == 0, (name, side)
+                assert len(pc["entries_per_replica"]) == 2, name
+        # the A/B is honest: the random side routed none by affinity,
+        # the affinity side routed generates by hash (spill allowed)
+        aff = wl["fleet_affinity"]["router"]
+        rnd = wl["fleet_random"]["router"]
+        assert rnd["affinity_routed"] == 0, name
+        assert aff["affinity_routed"] + aff["spilled"] > 0, name
+    # zero-reuse is the adversarial row: nothing to hit on either side
+    zr = rec["workloads"]["zero_reuse"]
+    assert zr["affinity_hit_rate"] == 0.0
+    assert zr["random_hit_rate"] == 0.0
+
+
+def test_bench_fleet_smoke_mode_end_to_end(tmp_path, monkeypatch):
+    """``bench_fleet.py --smoke`` boots the full three-sided harness —
+    one single server plus TWO 2-replica fleets over real TCP — on tiny
+    shapes and writes an artifact carrying the committed schema. Same
+    rationale as the serving pin: a kwarg drift or schema regression
+    must surface as a red CPU test, not a broken adjudication run."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(sys, "argv", ["bench_fleet.py", "--smoke"])
+    bench_fleet.main()
+    rec = json.loads((tmp_path / "BENCH_FLEET.json").read_text())
+    _check_fleet_record(rec)
+    # the priming contract at any scale: the affinity side of the
+    # prefix-heavy workload concentrates each header's KV and HITS
+    assert rec["workloads"]["prefix_heavy"]["fleet_affinity"][
+        "prefix_cache"]["hits"] > 0
+
+
+def test_committed_bench_fleet_artifact_schema():
+    """The COMMITTED BENCH_FLEET.json (the number PERF.md quotes) still
+    matches the schema this harness produces, and carries the claimed
+    effect: prefix-affinity routing beats random routing on hit rate
+    for the prefix-heavy workload."""
+    rec = json.loads(
+        open(os.path.join(REPO, "BENCH_FLEET.json")).read()
+    )
+    _check_fleet_record(rec)
+    ph = rec["workloads"]["prefix_heavy"]
+    assert ph["affinity_hit_rate"] > ph["random_hit_rate"]
+
+
+@pytest.mark.chaos
+def test_soak_fleet_smoke():
+    """``tools/soak_fleet.py --smoke`` runs end to end at tier-1 scale
+    and meets its own acceptance bar: a REAL subprocess replica
+    kill -9'd mid-stream under armed ``router.*``/``net.*``/
+    ``stepper.step`` seams, zero hung clients, zero untyped errors,
+    zero corrupt outputs, exact attempt accounting, and a mid-soak
+    rolling upgrade of every survivor. Mirrors the ``soak_serving``/
+    ``soak_training`` treatment: the chaos harness itself is pinned on
+    CPU so a drift surfaces as a red test, not a dead soak run."""
+    import soak_fleet  # REPO/tools is on sys.path (module top)
+
+    summary = soak_fleet.run_soak(seed=0, smoke=True)
+    assert summary["hung"] == 0
+    assert summary["untyped_errors"] == 0, summary["untyped_samples"]
+    assert summary["corrupt_outputs"] == 0
+    assert summary["accounting_exact"]
+    assert summary["control_errors"] == []
+    assert summary["kill"]["in_flight_at_kill"]
+    # 2 smoke replicas: the victim is reaped, the survivor upgrades
+    assert len(summary["rollover"]["replaced"]) == 1
+    assert summary["completed"] > 0
+    assert summary["ok"]
 
 
 @pytest.mark.chaos

@@ -115,6 +115,35 @@ def test_serve_lm_draft_bundle_cpu(tmp_path):
     assert rate > 1.0, line  # the trained draft actually accepts
 
 
+def test_serve_lm_fleet_cpu():
+    """--fleet 2: the replicated flow — two replicas booted from ONE
+    bundle behind the prefix-affinity router, concurrent shared-header
+    clients all landing on a single replica (the affinity guarantee,
+    asserted via the printed ``served_by`` placement), a zero-downtime
+    rolling upgrade, and the upgraded fleet still serving counting
+    decodes."""
+    out = run_example("serve_lm.py", "--cpu", "--fleet", "2",
+                      timeout=600)
+    assert "fleet: 2 replicas behind router" in out
+    rows = [l for l in out.splitlines() if l.startswith("served decode:")]
+    assert len(rows) == 4, out
+    for line in rows:
+        toks = [int(t) for t in line.split("[", 1)[1].rstrip("]").split(",")]
+        for a, b in zip(toks[-5:], toks[-4:]):
+            assert b == (a + 1) % 32, (toks, out)  # still counting upward
+    # all four shared-header requests landed where the header's KV lives
+    assert "served by 1 replica(s)" in out, out
+    assert "rollover complete: 2 replicas upgraded" in out
+    assert "zero requests dropped" in out
+    line = next(l for l in out.splitlines()
+                if l.startswith("served decode (upgraded fleet):"))
+    toks = [int(t) for t in line.split("[", 1)[1].rstrip("]").split(",")]
+    for a, b in zip(toks[-5:], toks[-4:]):
+        assert b == (a + 1) % 32, (toks, out)
+    assert "fleet health: serving, 2 replicas in rotation" in out
+    assert "drained and stopped" in out
+
+
 def test_language_model_int8_bundle_cpu(tmp_path):
     """--int8 --save-bundle: the decode demo runs a RAGGED batch from a
     serving bundle RELOADED off disk — quantize, persist, reload, serve,

@@ -154,6 +154,50 @@ def test_retry_policy_call_retries_then_succeeds():
     assert [n for n, _ in seen] == [1, 2]
 
 
+def test_retry_policy_call_reads_retry_after_off_the_exception():
+    """The Retry-After hint path end to end: ``call`` reads the
+    ``retry_after`` attribute the serving client stamps on
+    ``overloaded`` errors and sleeps exactly that (not a jittered
+    draw), still capped at ``max_delay`` — the contract the fleet
+    router's ``retry_after_ms`` replies lean on."""
+    rp = RetryPolicy(max_attempts=4, base_delay=5.0, max_delay=0.2,
+                     seed=0)
+    delays = []
+    calls = []
+
+    def flaky(hint):
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                e = ConnectionError("busy")
+                e.retry_after = hint
+                raise e
+            return "ok"
+        return fn
+
+    out = rp.call(flaky(0.013),
+                  on_retry=lambda e, n, d: delays.append(d))
+    assert out == "ok"
+    assert delays == [0.013, 0.013]  # the hint, verbatim — no jitter
+    # an abusive hint is capped at max_delay before the sleep
+    calls.clear()
+    delays.clear()
+    rp.call(flaky(99.0), on_retry=lambda e, n, d: delays.append(d))
+    assert delays == [0.2, 0.2]
+    # hintless errors fall back to the jittered schedule (<= cap)
+    calls.clear()
+    delays.clear()
+
+    def bare():
+        calls.append(1)
+        if len(calls) < 2:
+            raise ConnectionError("busy")
+        return "ok"
+
+    rp.call(bare, on_retry=lambda e, n, d: delays.append(d))
+    assert len(delays) == 1 and 0.0 <= delays[0] <= 0.2
+
+
 def test_retry_policy_exhausts_attempts_and_budget():
     rp = RetryPolicy(max_attempts=3, base_delay=0.001, seed=0)
     calls = []

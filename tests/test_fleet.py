@@ -1,0 +1,818 @@
+"""Serving fleet (distkeras_tpu/serving/fleet.py) + the networking
+satellites it rides on.
+
+Three tiers, mirroring the serving suite's layering:
+
+- pure units: affinity keys (pow2-ladder granularity), rendezvous
+  hashing, ``connect_any``'s aggregate error + sticky rotation, and
+  ``probe``;
+- router tests against FAKE replica servers — real DKT1 over real
+  sockets, no JAX — pinning health-gated rotation (eject on degraded /
+  failed polls, rejoin on a clean one), prefix-affinity placement
+  (expected winner computed from the hash, asserted via the
+  ``served_by`` reply stamp), in-flight accounting with fleet-wide
+  overload shedding (``overloaded`` only when EVERY replica is
+  saturated), transparent mid-request failover (bounded, idempotent
+  verbs only), drain semantics, and the ``router.*`` fault seams;
+- controller tests: rolling upgrade over fake replicas (ordering:
+  replacement joins BEFORE the old replica leaves), and one real-LM
+  end-to-end — 2-replica fleet, concurrent clients, placement
+  asserted, a live rollover, every output pinned to solo decode.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import faults
+from distkeras_tpu.faults import FaultPlan
+from distkeras_tpu.networking import (
+    EndpointsUnreachableError,
+    connect_any,
+    probe,
+    recv_data,
+    send_data,
+)
+from distkeras_tpu.serving.fleet import (
+    ACTIVE,
+    DRAINING,
+    EJECTED,
+    FleetController,
+    FleetRouter,
+    _rendezvous,
+    affinity_key,
+)
+from distkeras_tpu.serving.scheduler import (
+    DeadlineExceededError,
+    OverloadedError,
+    ServingError,
+)
+from distkeras_tpu.utils.serialization import (
+    deserialize_params,
+    pack_frame,
+    serialize_params,
+    unpack_frame,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    leaked = faults._ACTIVE
+    if leaked is not None:
+        leaked.deactivate()
+        pytest.fail("test leaked an active FaultPlan")
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------------ fake replica
+
+
+class FakeReplica:
+    """A DKT1 replica server with scripted behavior and NO engine: its
+    ``generate`` appends ``tag`` ``max_new_tokens`` times, so the reply
+    itself names which replica served — router placement is assertable
+    from token values alone. Scripting knobs: ``status`` (what health
+    reports), ``overload_next`` (typed ``overloaded`` replies),
+    ``die_next`` (read the request, close the connection without
+    replying — a mid-request death), ``block`` (an Event ``generate``
+    waits on — in-flight occupancy on demand)."""
+
+    def __init__(self, tag, num_slots=2, queue_capacity=2):
+        self.tag = int(tag)
+        self.num_slots = int(num_slots)
+        self.queue_capacity = int(queue_capacity)
+        self.status = "serving"
+        self.overload_next = 0
+        self.die_next = 0
+        self.block = None
+        self.calls = []
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.endpoint = self._sock.getsockname()[:2]
+        self._conns: set = set()
+        self._stopping = threading.Event()
+        self._accept = threading.Thread(target=self._loop, daemon=True)
+        self._accept.start()
+
+    # handle protocol (what FleetController expects of a replica)
+
+    def stop(self, drain=True):
+        self.kill()
+
+    def alive(self):
+        return self._accept.is_alive()
+
+    def kill(self):
+        self._stopping.set()
+        # shutdown BEFORE close: a bare close does not wake a thread
+        # blocked in accept() (the kernel file stays referenced), so
+        # the port would keep accepting into limbo — shutdown refuses
+        # new connections immediately, which is what "killed" means
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        # make kill() awaitable: alive() flips false before we return
+        # (rollover asserts on it immediately after stop)
+        if threading.current_thread() is not self._accept:
+            self._accept.join(timeout=10)
+
+    # wire
+
+    def _loop(self):
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            while not self._stopping.is_set():
+                try:
+                    header, payload = unpack_frame(recv_data(conn))
+                except (ConnectionError, OSError):
+                    return
+                verb = header.get("verb")
+                with self._lock:
+                    self.calls.append(verb)
+                    die = self.die_next > 0 and verb == "generate"
+                    if die:
+                        self.die_next -= 1
+                    shed = self.overload_next > 0 and verb == "generate"
+                    if shed:
+                        self.overload_next -= 1
+                if die:
+                    return  # close without replying: death mid-request
+                if shed:
+                    reply = pack_frame(
+                        {"ok": False, "error": "overloaded",
+                         "retry_after_ms": 25.0}
+                    )
+                elif verb == "health":
+                    reply = pack_frame({
+                        "ok": True, "status": self.status,
+                        "num_slots": self.num_slots,
+                        "queue_capacity": self.queue_capacity,
+                        "endpoint": list(self.endpoint),
+                        "max_frame_bytes": 64 << 20,
+                    })
+                elif verb == "generate":
+                    if self.block is not None:
+                        self.block.wait(timeout=30)
+                    prompt = np.asarray(deserialize_params(payload))
+                    seq = np.concatenate([
+                        prompt,
+                        np.full(int(header["max_new_tokens"]), self.tag,
+                                np.int32),
+                    ])
+                    reply = pack_frame(
+                        {"ok": True, "tokens": int(header["max_new_tokens"])},
+                        serialize_params(seq),
+                    )
+                elif verb == "stats":
+                    reply = pack_frame({"ok": True, "stats": {
+                        "tag": self.tag, "calls": len(self.calls)}})
+                else:
+                    reply = pack_frame(
+                        {"ok": False, "error": "bad_request",
+                         "detail": f"fake has no verb {verb!r}"}
+                    )
+                try:
+                    send_data(conn, reply)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def _fake_pair(**kw):
+    return FakeReplica(7001, **kw), FakeReplica(7002, **kw)
+
+
+def _router(*fakes, **kw):
+    kw.setdefault("health_interval", 0.05)
+    kw.setdefault("health_timeout", 1.0)
+    kw.setdefault("connect_timeout", 1.0)
+    kw.setdefault("request_timeout", 10.0)
+    return FleetRouter(
+        endpoints=[f.endpoint for f in fakes], **kw
+    ).start()
+
+
+def _client(router, **kw):
+    from distkeras_tpu.serving import ServingClient
+
+    kw.setdefault("retry", False)
+    return ServingClient(router.host, router.port, timeout=15.0, **kw)
+
+
+def _prompt_for(fakes, winner, plen=16, tries=500):
+    """A prompt whose affinity key rendezvous-hashes to ``winner`` —
+    computed, not hoped for, so placement assertions are exact."""
+    for s in range(tries):
+        prompt = np.arange(s, s + plen, dtype=np.int32)
+        key = affinity_key(prompt)
+        best = max(
+            (f for f in fakes),
+            key=lambda f: _rendezvous(key, f.endpoint),
+        )
+        if best is winner:
+            return prompt
+    pytest.fail("no prompt hashed to the requested replica")
+
+
+def _state_of(router, ep):
+    for r in router.replicas():
+        if tuple(r["endpoint"]) == tuple(ep):
+            return r["state"]
+    return None
+
+
+# ------------------------------------------------- networking satellites
+
+
+def _dead_port():
+    """A port that was just bound and released: dialing it refuses."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_connect_any_aggregate_error_names_every_endpoint():
+    eps = [("127.0.0.1", _dead_port()), ("127.0.0.1", _dead_port())]
+    with pytest.raises(EndpointsUnreachableError) as ei:
+        connect_any(eps, timeout=1.0)
+    err = ei.value
+    assert isinstance(err, ConnectionError)  # failover callers catch it
+    assert len(err.causes) == 2
+    # dial order preserved, every endpoint named with its own cause
+    assert [ep for ep, _ in err.causes] == eps
+    for (host, port), cause in err.causes:
+        assert f"{host}:{port}" in str(err)
+        assert isinstance(cause, OSError)
+
+
+def test_connect_any_rotation_order_and_sticky_start():
+    a = FakeReplica(1)
+    b = FakeReplica(2)
+    try:
+        eps = [a.endpoint, b.endpoint]
+        # start=1 dials b FIRST (sticky resume at the endpoint that
+        # last worked), and the returned index names it
+        sock, i = connect_any(eps, timeout=2.0, start=1)
+        sock.close()
+        assert i == 1
+        # dead sticky endpoint: rotation continues PAST it, in order
+        b.kill()
+        sock, i = connect_any(eps, timeout=2.0, start=1)
+        sock.close()
+        assert i == 0
+    finally:
+        a.kill()
+        b.kill()
+    with pytest.raises(ValueError):
+        connect_any([])
+
+
+def test_probe_reports_per_endpoint_reachability():
+    live = FakeReplica(1)
+    dead = ("127.0.0.1", _dead_port())
+    try:
+        out = probe([live.endpoint, dead], timeout=1.0)
+    finally:
+        live.kill()
+    assert out[tuple(live.endpoint)] is None
+    assert isinstance(out[tuple(dead)], OSError)
+
+
+# ------------------------------------------------------------- pure units
+
+
+def test_affinity_key_is_pow2_ladder_granular():
+    header = np.arange(100, 116, dtype=np.int32)  # 16-token header
+    for sfx in ([7], [8, 9], [1, 2, 3]):
+        prompt = np.concatenate([header, np.asarray(sfx, np.int32)])
+        # largest pow2 <= len is 16 == the header: shared key
+        assert affinity_key(prompt) == affinity_key(header)
+    # a suffix that pushes past the next power of two changes the key
+    # (the store's own exact-ladder granularity, stated in the docs)
+    long = np.concatenate([header, np.arange(16, dtype=np.int32)])
+    assert affinity_key(long) != affinity_key(header)
+    # too short for the store to ever cache: no affinity
+    assert affinity_key(np.arange(7)) is None
+    assert affinity_key(np.arange(8)) is not None
+
+
+def test_rendezvous_is_deterministic_and_spreads():
+    eps = [("127.0.0.1", 9000 + i) for i in range(4)]
+    key = affinity_key(np.arange(32))
+    assert _rendezvous(key, eps[0]) == _rendezvous(key, eps[0])
+    winners = set()
+    for s in range(64):
+        k = affinity_key(np.arange(s, s + 16))
+        winners.add(max(eps, key=lambda e: _rendezvous(k, e)))
+    assert len(winners) == len(eps)  # every replica owns some keyspace
+
+
+# ---------------------------------------------------------- router: routing
+
+
+def test_router_affinity_placement_and_served_by_stamp():
+    a, b = _fake_pair()
+    router = _router(a, b)
+    try:
+        pa = _prompt_for((a, b), a)
+        pb = _prompt_for((a, b), b)
+        with _client(router) as c:
+            out = c.generate(pa, 4)
+            assert list(out[-4:]) == [a.tag] * 4  # landed on its home
+            # the reply stamp names the REPLICA, the socket the router
+            assert c.last_served_by == tuple(a.endpoint)
+            assert c.connected_endpoint == (router.host, router.port)
+            out = c.generate(pb, 4)
+            assert list(out[-4:]) == [b.tag] * 4
+            assert c.last_served_by == tuple(b.endpoint)
+            # same header, fresh suffix inside the same pow2 rung:
+            # same replica (the whole point of affinity routing)
+            out = c.generate(np.concatenate([pa, [3, 1]]), 4)
+            assert list(out[-4:]) == [a.tag] * 4
+        st = router.stats()
+        assert st["affinity_enabled"]
+        assert st["affinity_routed"] == 3
+        assert st["failovers"] == 0
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+def test_router_without_affinity_routes_least_loaded():
+    a, b = _fake_pair()
+    router = _router(a, b, affinity=False)
+    try:
+        gate = threading.Event()
+        a.block = gate
+        b.block = gate
+        with _client(router) as c0, _client(router) as c1:
+            outs = [None, None]
+            ths = [
+                threading.Thread(
+                    target=lambda i=i, c=c: outs.__setitem__(
+                        i, c.generate(np.arange(16), 3)
+                    )
+                )
+                for i, c in enumerate((c0, c1))
+            ]
+            for t in ths:
+                t.start()
+            # both in flight: least-loaded MUST have spread them
+            _wait(
+                lambda: sorted(
+                    r["in_flight"] for r in router.replicas()
+                ) == [1, 1],
+                msg="one in-flight forward per replica",
+            )
+            gate.set()
+            for t in ths:
+                t.join(timeout=15)
+        tags = {int(o[-1]) for o in outs}
+        assert tags == {a.tag, b.tag}
+        assert router.stats()["least_loaded_routed"] == 2
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+def test_router_health_gate_ejects_degraded_and_rejoins():
+    a, b = _fake_pair()
+    router = _router(a, b)
+    try:
+        pa = _prompt_for((a, b), a)
+        a.status = "degraded"
+        _wait(lambda: _state_of(router, a.endpoint) == EJECTED,
+              msg="degraded replica ejected")
+        with _client(router) as c:
+            # a's keyspace fails over to b while a is out of rotation
+            out = c.generate(pa, 4)
+            assert list(out[-4:]) == [b.tag] * 4
+        a.status = "serving"
+        _wait(lambda: _state_of(router, a.endpoint) == ACTIVE,
+              msg="clean poll rejoins the replica")
+        with _client(router) as c:
+            assert list(c.generate(pa, 4)[-4:]) == [a.tag] * 4
+        st = router.stats()
+        assert st["ejections"] >= 1 and st["rejoins"] >= 1
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+def test_router_fails_over_mid_request_and_ejects_victim():
+    a, b = _fake_pair()
+    router = _router(a, b)
+    try:
+        pa = _prompt_for((a, b), a)
+        a.die_next = 1  # read the request, close without replying
+        with _client(router) as c:
+            out = c.generate(pa, 4)
+        # the client saw ONE clean reply, served by the sibling
+        assert list(out[-4:]) == [b.tag] * 4
+        assert c.last_served_by == tuple(b.endpoint)
+        st = router.stats()
+        assert st["failovers"] == 1
+        # the victim is ejected NOW (not after eject_after polls) and
+        # rejoins once it polls clean again
+        _wait(lambda: _state_of(router, a.endpoint) == ACTIVE,
+              msg="victim rejoins after clean polls")
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+def test_router_unavailable_when_every_replica_is_dead():
+    a, b = _fake_pair()
+    router = _router(a, b)
+    a.kill()
+    b.kill()
+    try:
+        with _client(router) as c:
+            with pytest.raises(ServingError) as ei:
+                c.generate(np.arange(16), 4)
+        assert ei.value.code == "unavailable"
+        assert router.stats()["unavailable"] == 1
+    finally:
+        router.shutdown()
+
+
+# ------------------------------------------------- router: overload shed
+
+
+def test_replica_overloaded_spills_before_fleet_sheds():
+    a, b = _fake_pair()
+    router = _router(a, b)
+    try:
+        pa = _prompt_for((a, b), a)
+        a.overload_next = 1
+        with _client(router) as c:
+            out = c.generate(pa, 4)  # a refused; b absorbed
+            assert list(out[-4:]) == [b.tag] * 4
+            # every replica refusing is the ONLY fleet-overloaded case
+            a.overload_next = 5
+            b.overload_next = 5
+            with pytest.raises(OverloadedError) as ei:
+                c.generate(pa, 4)
+        assert ei.value.retry_after == pytest.approx(0.025)
+        assert router.stats()["fleet_overloaded"] == 1
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+def test_router_accounts_in_flight_and_sheds_at_capacity():
+    # capacity 1 per replica (1 slot, zero queue): two blocked
+    # requests saturate the FLEET in the router's own accounting —
+    # the third is shed without a single byte reaching a replica
+    a, b = _fake_pair(num_slots=1, queue_capacity=0)
+    router = _router(a, b)
+    try:
+        gate = threading.Event()
+        a.block = gate
+        b.block = gate
+        outs = [None, None]
+        clis = [_client(router) for _ in range(2)]
+        ths = [
+            threading.Thread(
+                target=lambda i=i: outs.__setitem__(
+                    i, clis[i].generate(np.arange(i * 40, i * 40 + 16), 3)
+                )
+            )
+            for i in range(2)
+        ]
+        def gen_calls():
+            # only generate verbs: health polls keep appending
+            # concurrently and must not fail the no-forward assertion
+            with a._lock, b._lock:
+                return sum(
+                    v == "generate" for v in a.calls + b.calls
+                )
+
+        for t in ths:
+            t.start()
+        # wait for DELIVERY, not just accounting: in_flight increments
+        # before the frame reaches the replica, so the no-new-forward
+        # baseline below must see both requests already landed
+        _wait(
+            lambda: sum(r["in_flight"] for r in router.replicas()) == 2
+            and gen_calls() == 2,
+            msg="both replicas accounted busy and requests delivered",
+        )
+        before = gen_calls()
+        with _client(router) as c:
+            with pytest.raises(OverloadedError):
+                c.generate(np.arange(16), 3)
+        # shed router-side: no new generate reached either replica
+        assert gen_calls() == before
+        gate.set()
+        for t in ths:
+            t.join(timeout=15)
+        for cli in clis:
+            cli.close()
+        assert all(o is not None for o in outs)
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+# ------------------------------------------------------- router: drain
+
+
+def test_drain_excludes_from_rotation_and_wait_drained():
+    a, b = _fake_pair()
+    router = _router(a, b)
+    try:
+        pa = _prompt_for((a, b), a)
+        gate = threading.Event()
+        a.block = gate
+        out = [None]
+        with _client(router) as c0:
+            th = threading.Thread(
+                target=lambda: out.__setitem__(0, c0.generate(pa, 3))
+            )
+            th.start()
+            _wait(lambda: any(
+                r["in_flight"] == 1 for r in router.replicas()
+            ), msg="request in flight on its affinity home")
+            router.drain_replica(a.endpoint)
+            assert _state_of(router, a.endpoint) == DRAINING
+            # still draining: the in-flight forward holds it open
+            assert not router.wait_drained(a.endpoint, timeout=0.2)
+            # new work for a's keyspace routes AROUND the draining node
+            with _client(router) as c1:
+                assert list(c1.generate(pa, 3)[-3:]) == [b.tag] * 3
+            gate.set()
+            assert router.wait_drained(a.endpoint, timeout=10)
+            th.join(timeout=10)
+        assert list(out[0][-3:]) == [a.tag] * 3  # in-flight completed
+        # health polls must NOT rejoin a draining replica
+        time.sleep(0.2)
+        assert _state_of(router, a.endpoint) == DRAINING
+        router.remove_replica(a.endpoint)
+        assert _state_of(router, a.endpoint) is None
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+# ------------------------------------------------------- router: seams
+
+
+@pytest.mark.chaos
+def test_router_dispatch_seam_rides_typed_reply_path():
+    a, b = _fake_pair()
+    router = _router(a, b)
+    try:
+        plan = FaultPlan().arm(
+            "router.dispatch", exc=DeadlineExceededError("injected")
+        )
+        with _client(router) as c, plan:
+            with pytest.raises(DeadlineExceededError):
+                c.generate(np.arange(16), 3)
+            # seam exhausted: same connection serves the next call
+            assert c.generate(np.arange(16), 3) is not None
+        assert plan.fired("router.dispatch") == 1
+        # a non-ServingError injection becomes a typed internal reply
+        plan2 = FaultPlan().arm("router.dispatch")
+        with _client(router) as c, plan2:
+            with pytest.raises(ServingError) as ei:
+                c.generate(np.arange(16), 3)
+            assert ei.value.code == "internal"
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+@pytest.mark.chaos
+def test_router_health_seam_ejects_until_clean_poll():
+    a, b = _fake_pair()
+    router = _router(a, b, eject_after=2)
+    try:
+        target = tuple(a.endpoint)
+        plan = FaultPlan().arm(
+            "router.health", times=None,
+            when=lambda ctx: tuple(ctx["endpoint"]) == target,
+        )
+        with plan:
+            _wait(lambda: _state_of(router, a.endpoint) == EJECTED,
+                  msg="failed polls eject the replica")
+            pa = _prompt_for((a, b), a)
+            with _client(router) as c:
+                assert list(c.generate(pa, 3)[-3:]) == [b.tag] * 3
+        assert plan.fired("router.health") >= 2
+        _wait(lambda: _state_of(router, a.endpoint) == ACTIVE,
+              msg="clean poll rejoins after the seam disarms")
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+# --------------------------------------------------------- controller
+
+
+def test_controller_rollover_order_and_ledger_with_fakes():
+    built = []
+
+    def factory(bundle):
+        rep = FakeReplica(8000 + len(built) + int(bundle))
+        built.append(rep)
+        return rep
+
+    ctl = FleetController(
+        0, replicas=2, factory=factory,
+        router_kw=dict(health_interval=0.05),
+    ).start()
+    try:
+        gen0 = list(built)
+        old_eps = [r.endpoint for r in ctl.replicas]
+        ledger = ctl.rollover(bundle=10)
+        assert len(ledger["replaced"]) == 2
+        assert [tuple(r["old"]) for r in ledger["replaced"]] == [
+            tuple(e) for e in old_eps
+        ]
+        # generation swapped: old replicas stopped, new ones in rotation
+        assert all(not r.alive() for r in gen0)
+        assert all(r.alive() for r in ctl.replicas)
+        states = {
+            tuple(r["endpoint"]): r["state"]
+            for r in ctl.router.replicas()
+        }
+        assert set(states) == {r.endpoint for r in ctl.replicas}
+        assert all(s == ACTIVE for s in states.values())
+        assert ctl.rollovers == 1
+        # the upgraded fleet serves (new tags prove the new bundle)
+        with ctl.client(retry=False) as c:
+            tag = int(c.generate(np.arange(16), 2)[-1])
+        assert tag in {r.tag for r in ctl.replicas}
+    finally:
+        ctl.stop()
+        for r in built:
+            r.kill()
+
+
+def test_controller_reaps_killed_replicas():
+    built = []
+
+    def factory(bundle):
+        rep = FakeReplica(8100 + len(built))
+        built.append(rep)
+        return rep
+
+    ctl = FleetController(
+        0, replicas=2, factory=factory,
+        router_kw=dict(health_interval=0.05),
+    ).start()
+    try:
+        victim = ctl.replicas[0]
+        victim.kill()
+        gone = ctl.reap_dead()
+        assert gone == [victim]
+        assert len(ctl.replicas) == 1
+        assert _state_of(ctl.router, victim.endpoint) is None
+        with ctl.client(retry=False) as c:
+            assert int(c.generate(np.arange(16), 2)[-1]) == (
+                ctl.replicas[0].tag
+            )
+    finally:
+        ctl.stop()
+        for r in built:
+            r.kill()
+
+
+# ------------------------------------------------- real-engine end to end
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_ref(lm):
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+
+    return CachedSequenceGenerator(lm)
+
+
+def test_fleet_end_to_end_identity_affinity_and_rollover(lm, lm_ref):
+    """ACCEPTANCE: a 2-replica fleet of REAL engines serves concurrent
+    shared-header traffic token-identical to solo decode, every
+    request of one header lands on one replica (asserted via the
+    ``served_by`` stamp, not router internals), and a live
+    ``rollover()`` replaces both replicas with zero failed requests."""
+    rng = np.random.default_rng(0)
+    header = rng.integers(0, 61, 16).astype(np.int32)
+    prompts = [
+        np.concatenate([header, rng.integers(0, 61, k).astype(np.int32)])
+        for k in (1, 2, 3, 4)
+    ] + [rng.integers(0, 61, 5).astype(np.int32)]  # one novel short
+    refs = [lm_ref.generate(p[None], steps=6)[0] for p in prompts]
+
+    ctl = FleetController(
+        lm, replicas=2, num_slots=2, queue_capacity=16,
+        prefix_cache=True,
+        router_kw=dict(health_interval=0.1),
+    ).start()
+    try:
+        results = [None] * len(prompts)
+        served = [None] * len(prompts)
+
+        def run_all():
+            def worker(i):
+                with ctl.client() as c:
+                    results[i] = c.generate(prompts[i], 6)
+                    served[i] = c.last_served_by
+
+            ths = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in ths)
+
+        run_all()
+        for i, (got, want) in enumerate(zip(results, refs)):
+            np.testing.assert_array_equal(got, want, err_msg=f"req {i}")
+        # all four shared-header requests share one pow2-rung key ⇒
+        # one replica served them all (placement via the reply stamp)
+        homes = {served[i] for i in range(4)}
+        assert len(homes) == 1
+        assert homes.pop() in {r.endpoint for r in ctl.replicas}
+
+        old_eps = {r.endpoint for r in ctl.replicas}
+        ledger = ctl.rollover()  # same bundle: outputs must not move
+        assert len(ledger["replaced"]) == 2
+        assert {r.endpoint for r in ctl.replicas}.isdisjoint(old_eps)
+
+        run_all()  # the upgraded fleet still serves, still pinned
+        for i, (got, want) in enumerate(zip(results, refs)):
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"post-rollover req {i}"
+            )
+    finally:
+        ctl.stop()

@@ -901,6 +901,93 @@ def test_server_generate_predict_stats_roundtrip(lm, lm_ref, served):
         assert st["mean_batch_occupancy"] >= 1.0
 
 
+def test_client_stamps_served_by_and_connected_endpoint(lm_ref, served):
+    """Placement observability satellite: every reply is stamped with
+    the ``(host, port)`` that answered it, mirrored on
+    ``last_served_by``, and ``connected_endpoint`` names the live
+    socket's peer — the surfaces fleet tests assert prefix-affinity
+    placement on instead of reaching into router internals."""
+    prompt = np.arange(1, 5, dtype=np.int32)
+    ref = lm_ref.generate(prompt[None], steps=4)[0]
+    with _client(served) as c:
+        assert c.last_served_by is None  # nothing answered yet
+        assert c.connected_endpoint == ("127.0.0.1", served.port)
+        np.testing.assert_array_equal(c.generate(prompt, 4), ref)
+        assert c.last_served_by == ("127.0.0.1", served.port)
+        # health replies carry the stamp too, and the server's own
+        # canonical endpoint rides the health body
+        h = c.health()
+        assert tuple(h["served_by"]) == ("127.0.0.1", served.port)
+        assert h["endpoint"] == [served.host, served.port]
+    # closed client: between connections, no endpoint to report
+    assert c.connected_endpoint is None
+
+
+def test_shutdown_drain_races_stop_verb_while_prefilling(lm, lm_ref):
+    """Shutdown-race satellite (the fleet rollover's load-bearing
+    path): the ``stop`` verb's side-thread shutdown racing the owner's
+    direct ``shutdown()`` while a long admission is still CHUNK-
+    PREFILLING and more work sits queued behind it — everything
+    already admitted or queued must complete token-identical, both
+    shutdown paths must return, nothing may hang."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    # 1 slot + tiny chunk budget: the long prompt prefills over many
+    # scheduler iterations while the second request waits in queue
+    eng = ServingEngine(
+        lm, num_slots=1, queue_capacity=4, prefill_chunk=4,
+        prefix_cache=False,
+    )
+    srv = ServingServer(eng).start()
+    rng = np.random.default_rng(7)
+    long_p = rng.integers(0, 61, 24).astype(np.int32)
+    short_p = rng.integers(0, 61, 3).astype(np.int32)
+    eng.generate(short_p, 1)  # warm the compile so the race window
+    # below is about PREFILL, not a first-call XLA build
+    refs = [
+        lm_ref.generate(long_p[None], steps=6)[0],
+        lm_ref.generate(short_p[None], steps=6)[0],
+    ]
+    results = [None, None]
+
+    def worker(i, p):
+        with _client(srv) as c:
+            results[i] = c.generate(p, 6)
+
+    ths = [
+        threading.Thread(target=worker, args=(0, long_p)),
+        threading.Thread(target=worker, args=(1, short_p)),
+    ]
+    ths[0].start()
+    # wait until the long admission is mid-prefill (slot active,
+    # decode not yet started), then queue the second request behind it
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = eng.stats()
+        if st["prefilling_slots"] >= 1 or st["active_slots"] >= 1:
+            break
+        time.sleep(0.002)
+    ths[1].start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = eng.stats()
+        if st["active_slots"] + st["queue_depth"] >= 2:
+            break
+        time.sleep(0.002)
+    with _client(srv) as c:
+        assert c.stop()["stopping"]  # side-thread drain begins
+    srv.shutdown()  # races it; must WAIT, not tear down under it
+    for t in ths:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in ths)
+    for i, (got, want) in enumerate(zip(results, refs)):
+        np.testing.assert_array_equal(
+            got, want, err_msg=f"request {i} dropped by the race"
+        )
+    with pytest.raises(EngineStoppedError):
+        eng.generate(short_p, 2)
+
+
 def test_server_generate_eos_trims(lm, lm_ref, served):
     rng = np.random.default_rng(3)
     prompt = rng.integers(0, 61, 4).astype(np.int32)

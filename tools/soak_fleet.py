@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Chaos soak for the serving FLEET: N clients against a router over
+real replica SUBPROCESSES, one of which is kill -9'd mid-stream, with
+a rolling bundle upgrade completing under the same traffic.
+
+The acceptance bar it asserts (and prints as JSON):
+
+- ZERO hung clients — every client thread exits within its join
+  budget, through a replica hard-kill, probabilistic router/wire
+  faults, and a full rollover;
+- ZERO non-typed errors — every failure a caller sees is a
+  ``ServingError`` subclass (the router's ``unavailable``/
+  ``overloaded`` replies, blamed poison steps surfacing as
+  ``internal``); connection resets and overload bursts are absorbed
+  by the ``RetryPolicy``;
+- ZERO corrupt outputs — every successful generate is token-identical
+  to its solo reference decode of the SAME quantized bundle the
+  replicas booted from, failovers and upgrades notwithstanding;
+- EXACT accounting — every attempt resolves exactly once (completed
+  or typed), so a rollover can neither drop nor duplicate a request.
+
+Topology: replicas are REAL subprocesses (``--replica`` runs one)
+booted from a shared quantized serving bundle, each arming its OWN
+``stepper.step`` seam (fault plans are per-process); the parent runs
+the router, the clients, and the parent-side plan (``router.dispatch``
+/ ``router.health`` / ``net.send``). The kill is a genuine SIGKILL —
+no drain, no FIN handshakes beyond what the kernel sends for a dead
+process. The fault mix is seeded, so a failing soak replays::
+
+    python tools/soak_fleet.py --replicas 3 --clients 4 --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_HERE = os.path.abspath(__file__)
+
+
+# ---------------------------------------------------------------- replica
+
+
+def replica_main(args) -> int:
+    """One fleet replica: boot from the shared bundle, arm the local
+    ``stepper.step`` seam, print ``READY <port>``, serve until a
+    ``stop`` verb (rollover) or a signal (the kill) ends us."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    engine = ServingEngine.from_bundle(
+        args.bundle, num_slots=4, queue_capacity=8, prefix_cache=True,
+        watchdog_interval=1.0, watchdog_grace=60.0,
+        max_restarts=10_000, restart_backoff=0.01, quarantine_steps=8,
+    )
+    server = ServingServer(engine, retry_after_ms=20.0).start()
+    # warm every prefill bucket the soak's prompt lengths touch, so the
+    # first routed request is not a multi-second XLA compile
+    for n in (3, 5, 9, 13):
+        engine.generate(np.arange(1, n + 1, dtype=np.int32), 6)
+    plan = FaultPlan(seed=args.seed).arm(
+        "stepper.step", times=None, probability=1.0 / args.fault_every
+    )
+    plan.activate()
+    print(f"READY {server.port}", flush=True)
+    try:
+        server._shutdown_done.wait()
+    finally:
+        plan.deactivate()
+    return 0
+
+
+class SubprocessReplica:
+    """``FleetController`` replica handle backed by a real process —
+    the backend that makes kill -9 mean kill -9."""
+
+    def __init__(self, bundle, seed, fault_every):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, _HERE, "--replica", "--bundle", bundle,
+             "--seed", str(seed), "--fault-every", str(fault_every)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+        )
+        deadline = time.monotonic() + 240
+        port = None
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("READY "):
+                port = int(line.split()[1])
+                break
+        if port is None:
+            self.proc.kill()
+            raise RuntimeError("replica subprocess never became ready")
+        self.endpoint = ("127.0.0.1", port)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self, drain=True):
+        """Graceful: the ``stop`` verb drains the replica's in-flight
+        work, its server shutdown completes, the process exits."""
+        try:
+            from distkeras_tpu.serving import ServingClient
+
+            with ServingClient(
+                self.endpoint[0], self.endpoint[1], timeout=30,
+                retry=False,
+            ) as c:
+                c.stop()
+        except Exception:  # noqa: BLE001 — it may already be dead
+            pass
+        try:
+            self.proc.wait(timeout=90)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+    def kill9(self):
+        """SIGKILL — the real thing, mid-whatever-it-was-doing."""
+        os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait()
+
+
+# ------------------------------------------------------------------ soak
+
+
+def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
+             fault_every=9, max_new=6, smoke=False) -> dict:
+    """Drive the soak; returns the summary dict ``main`` prints.
+    ``smoke=True`` shrinks the fleet and the pacing for tier-1 (all
+    control-thread sleeps <= 0.5 s; the wall-clock is dominated by
+    replica subprocess boots, not by waiting)."""
+    import numpy as np
+
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.networking import RetryPolicy
+    from distkeras_tpu.ops.quantization import quantize_model
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+    from distkeras_tpu.serving import FleetController, ServingClient, ServingError
+    from distkeras_tpu.utils.serialization import (
+        load_serving_bundle,
+        save_serving_bundle,
+    )
+
+    if smoke:
+        replicas, clients, duration = 2, 3, min(duration, 3.0)
+    pace = min(0.5, duration / 6.0)
+
+    workdir = tempfile.mkdtemp(prefix="soak_fleet_")
+    bundle = os.path.join(workdir, "lm_int8.dkt")
+    model = zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+    save_serving_bundle(bundle, quantize_model(model))
+    # solo references decode the SAME bundle the replicas serve — the
+    # quantized weights, reloaded off disk, are the identity baseline
+    ref_model = load_serving_bundle(bundle)
+    ref_gen = CachedSequenceGenerator(ref_model)
+
+    rng = np.random.default_rng(seed)
+    header = rng.integers(0, 61, 12).astype(np.int32)
+    prompts = [
+        np.concatenate([header, rng.integers(0, 61, k).astype(np.int32)])
+        for k in (1, 2, 3)
+    ] + [rng.integers(0, 61, n).astype(np.int32) for n in (3, 5, 9)]
+    refs = [ref_gen.generate(p[None], steps=max_new)[0] for p in prompts]
+
+    spawned = []
+
+    def factory(bundle_path):
+        rep = SubprocessReplica(
+            bundle_path, seed=seed + 100 + len(spawned),
+            fault_every=fault_every,
+        )
+        spawned.append(rep)
+        return rep
+
+    ctl = FleetController(
+        bundle, replicas=replicas, factory=factory,
+        router_kw=dict(
+            health_interval=0.2, eject_after=2, connect_timeout=2.0,
+            request_timeout=60.0, retry_after_ms=25.0,
+        ),
+    ).start()
+
+    plan = (
+        FaultPlan(seed=seed)
+        .arm("router.dispatch", times=None, probability=0.02)
+        .arm("router.health", times=None, probability=0.05)
+        .arm("net.send", action="reset", times=None, probability=0.004)
+        .arm("net.send", action="truncate", times=None, probability=0.004)
+    )
+
+    lock = threading.Lock()
+    summary = {
+        "replicas": replicas,
+        "clients": clients,
+        "attempts": 0,
+        "completed": 0,
+        "typed_errors": {},
+        "untyped_errors": 0,
+        "untyped_samples": [],
+        "corrupt_outputs": 0,
+    }
+    stop_evt = threading.Event()
+    control_err = []
+
+    def client_loop(ci):
+        policy = RetryPolicy(
+            max_attempts=30, base_delay=0.01, max_delay=0.2,
+            budget=300.0, seed=seed * 1000 + ci,
+        )
+        crng = np.random.default_rng(seed * 100 + ci)
+        with ServingClient(
+            ctl.router.host, ctl.router.port, retry=policy
+        ) as c:
+            while not stop_evt.is_set():
+                pi = int(crng.integers(0, len(prompts)))
+                with lock:
+                    summary["attempts"] += 1
+                try:
+                    out = c.generate(prompts[pi], max_new)
+                except ServingError as e:
+                    code = getattr(e, "code", type(e).__name__)
+                    with lock:
+                        summary["typed_errors"][code] = (
+                            summary["typed_errors"].get(code, 0) + 1
+                        )
+                    continue
+                except Exception as e:  # noqa: BLE001 — the finding
+                    with lock:
+                        summary["untyped_errors"] += 1
+                        if len(summary["untyped_samples"]) < 5:
+                            summary["untyped_samples"].append(repr(e))
+                    continue
+                with lock:
+                    if np.array_equal(out, refs[pi]):
+                        summary["completed"] += 1
+                    else:
+                        summary["corrupt_outputs"] += 1
+
+    def control_loop():
+        """warm traffic → kill -9 a loaded replica → reap → rolling
+        upgrade of the survivors → tail traffic → stop."""
+        try:
+            time.sleep(pace)
+            victim = ctl.replicas[0]
+            vep = victim.endpoint
+            deadline = time.monotonic() + 20
+            loaded = False
+            while time.monotonic() < deadline:
+                for r in ctl.router.replicas():
+                    if tuple(r["endpoint"]) == vep and r["in_flight"] > 0:
+                        loaded = True
+                        break
+                if loaded:
+                    break
+                time.sleep(0.002)
+            victim.kill9()  # mid-stream: its in-flight forward dies
+            summary["kill"] = {
+                "endpoint": list(vep),
+                "in_flight_at_kill": loaded,
+            }
+            ctl.reap_dead()
+            time.sleep(pace)
+            summary["rollover"] = ctl.rollover(timeout=300)
+            time.sleep(pace)
+        except Exception as e:  # noqa: BLE001 — surfaced in summary
+            control_err.append(repr(e))
+        finally:
+            stop_evt.set()
+
+    threads = [
+        threading.Thread(target=client_loop, args=(i,), daemon=True)
+        for i in range(int(clients))
+    ]
+    controller = threading.Thread(target=control_loop, daemon=True)
+    try:
+        with plan:
+            for t in threads:
+                t.start()
+            controller.start()
+            controller.join(timeout=600)
+            stop_evt.set()
+            for t in threads:
+                # generous budget past the stop signal: a thread still
+                # alive after this is DEFINITIONALLY hung
+                t.join(timeout=120.0)
+        hung = sum(t.is_alive() for t in threads)
+        summary["hung"] = hung + int(controller.is_alive())
+        summary["control_errors"] = control_err
+        summary["router"] = {
+            k: v
+            for k, v in ctl.router.stats().items()
+            if k != "replicas"
+        }
+        summary["faults_fired_parent"] = plan.fired()
+        summary["fired_by_site"] = {
+            s: plan.fired(s)
+            for s in ("router.dispatch", "router.health", "net.send")
+        }
+    finally:
+        stop_evt.set()
+        ctl.stop()
+        for rep in spawned:
+            if rep.alive():
+                rep.kill9()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    typed_total = sum(summary["typed_errors"].values())
+    summary["accounting_exact"] = (
+        summary["attempts"]
+        == summary["completed"] + typed_total
+        + summary["untyped_errors"] + summary["corrupt_outputs"]
+    )
+    summary["ok"] = (
+        summary["hung"] == 0
+        and summary["untyped_errors"] == 0
+        and summary["corrupt_outputs"] == 0
+        and summary["accounting_exact"]
+        and not control_err
+        and len(summary.get("rollover", {}).get("replaced", ())) == (
+            replicas - 1  # the kill -9 victim is reaped, not upgraded
+        )
+        and summary["completed"] > 0
+    )
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="pacing scale for the soak phases")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fault-every", type=int, default=9,
+                    help="mean scheduler steps between injected "
+                         "replica-side step faults")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 scale: 2 replicas, 3 clients, short "
+                         "pacing")
+    # internal: run as one replica subprocess
+    ap.add_argument("--replica", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--bundle", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.replica:
+        return replica_main(args)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    summary = run_soak(
+        replicas=args.replicas, clients=args.clients,
+        duration=args.duration, seed=args.seed,
+        fault_every=args.fault_every, smoke=args.smoke,
+    )
+    json.dump(summary, sys.stdout, indent=2, default=str)
+    print()
+    if not summary["ok"]:
+        print("SOAK FAILED: hung clients, untyped errors, corrupt "
+              "outputs, or an incomplete rollover (see summary above)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
