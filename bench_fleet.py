@@ -222,7 +222,7 @@ class _Side:
 
 
 def _measure_workload(model, reqs, refs, prime, *, slots, chunk,
-                      arrivals, repeats, gap_s):
+                      arrivals, repeats, gap_s, capture_obs=False):
     """Interleaved A/B/C: single engine, affinity fleet, random fleet —
     booted once, warmed on the timed schedule, then timed in strict
     rotation so drift hits all three equally."""
@@ -269,11 +269,42 @@ def _measure_workload(model, reqs, refs, prime, *, slots, chunk,
                 assert np.array_equal(got, want), (
                     f"{side.name} req {i}: output != solo decode"
                 )
+        obsv = None
+        if capture_obs:
+            # the well-formedness artifacts the CI harness pins: one
+            # traced generate through the affinity fleet (complete
+            # timeline, router span included) and the router's
+            # per-replica-labeled metrics aggregate + Prometheus dump
+            from distkeras_tpu.obs import parse_prometheus, timeline_complete
+            from distkeras_tpu.serving import ServingClient
+
+            aff = fleets["fleet_affinity"]
+            with ServingClient(*aff.endpoint, timeout=600.0) as c:
+                p, s = reqs[0]
+                c.generate(p, s, trace=True)
+                tl = c.last_trace
+                samples = c.metrics()
+                prom = parse_prometheus(c.metrics(prometheus=True))
+            assert timeline_complete(tl["spans"]), tl
+            obsv = {
+                "sample_trace_spans": [sp["name"] for sp in tl["spans"]],
+                "sample_trace_complete": True,
+                "router_metrics_samples": len(samples),
+                "replica_labels": sorted({
+                    sp["labels"].get("replica")
+                    for sp in samples
+                    if sp["labels"].get("replica")
+                }),
+                "prometheus_series": len(prom),
+                "prometheus_parses": True,
+            }
     finally:
         single_srv.shutdown()
         for ctl in fleets.values():
             ctl.stop()
     recs = {side.name: side.record() for side in sides}
+    if obsv is not None:
+        recs["_observability"] = obsv
     return {
         "num_requests": len(reqs),
         "prompt_lens": [int(p.size) for p, _ in reqs],
@@ -387,7 +418,11 @@ def main() -> None:
         wl = _measure_workload(
             model, timed, refs, prime, slots=args.slots, chunk=chunk,
             arrivals=arrivals, repeats=args.repeats, gap_s=gap_ms / 1e3,
+            capture_obs=(name == "prefix_heavy"),
         )
+        obsv = wl.pop("_observability", None)
+        if obsv is not None:
+            record["observability"] = obsv
         record["workloads"][name] = wl
         print(json.dumps({name: {
             "fleet_vs_single": wl["fleet_vs_single"],

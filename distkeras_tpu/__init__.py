@@ -51,6 +51,7 @@ from distkeras_tpu.evaluators import (
 )
 from distkeras_tpu.faults import FaultPlan, InjectedFault
 from distkeras_tpu.networking import RetryPolicy
+from distkeras_tpu.obs import MetricsRegistry, TraceContext
 from distkeras_tpu.parameter_servers import (
     CommitNotAcknowledgedError,
     ParameterServerError,

@@ -1,0 +1,62 @@
+"""Observability: end-to-end request tracing + the typed metrics registry.
+
+Two pillars, wired through every tier of the stack (client, fleet
+router, serving server, scheduler, engine, prefix cache, parameter
+servers):
+
+- ``tracing``: a Dapper-style :class:`TraceContext` propagated in an
+  optional ``trace`` field of the DKT1 frame header, with
+  :class:`Span` records collected process-wide and (opt-in per
+  request) assembled into a per-request timeline on the reply. See
+  docs/ARCHITECTURE.md "Observability" for the span hierarchy.
+- ``metrics``: Prometheus-style :class:`Counter` / :class:`Gauge` /
+  :class:`Histogram` in a :class:`MetricsRegistry`, replacing the
+  hand-rolled per-component counter dicts (:class:`CounterGroup` keeps
+  the ``counters["key"] += 1`` call sites working verbatim); exposed
+  by the ``metrics`` DKT1 verb and renderable as the Prometheus text
+  exposition format (``render_prometheus`` / ``parse_prometheus``).
+"""
+
+from distkeras_tpu.obs.metrics import (
+    Counter,
+    CounterGroup,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    label_samples,
+    parse_prometheus,
+    render_prometheus,
+)
+from distkeras_tpu.obs.tracing import (
+    COLLECTOR,
+    Span,
+    TraceCollector,
+    TraceContext,
+    new_id,
+    request_spans,
+    span_record,
+    stamp_error_trace,
+    start_span,
+    timeline_complete,
+)
+
+__all__ = [
+    "COLLECTOR",
+    "Counter",
+    "CounterGroup",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "TraceCollector",
+    "TraceContext",
+    "label_samples",
+    "new_id",
+    "parse_prometheus",
+    "render_prometheus",
+    "request_spans",
+    "span_record",
+    "stamp_error_trace",
+    "start_span",
+    "timeline_complete",
+]

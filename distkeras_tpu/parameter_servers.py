@@ -284,6 +284,44 @@ class ParameterServer:
         # re-arms it.
         self.min_replicas = 0
         self._min_replicas_goal = 0
+        # typed metrics (obs.metrics): client-facing traffic counters
+        # plus scrape-time gauges over the meta/replication ledgers the
+        # PS already keeps — the training tier's side of the registry
+        # the serving tier exposes over its ``metrics`` verb. Per-PS
+        # registry: multi-PS processes (tests, standby pairs) keep
+        # separate books. ``metrics_snapshot()`` is the read face.
+        from distkeras_tpu.obs import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self._metrics = self.registry.group(
+            "training_ps",
+            ("pulls", "commits", "commits_refused_no_replica"),
+        )
+        self.registry.gauge(
+            "training_ps_updates",
+            fn=lambda: self._meta.get("num_updates", 0),
+        )
+        self.registry.gauge(
+            "training_ps_duplicates",
+            fn=lambda: self._meta.get("num_duplicates", 0),
+        )
+        self.registry.gauge(
+            "training_ps_version",
+            fn=lambda: self._meta.get("version", 0),
+        )
+        self.registry.gauge(
+            "training_ps_replicas", fn=lambda: len(self._replicas)
+        )
+        self.registry.gauge(
+            "training_ps_min_replicas", fn=lambda: self.min_replicas
+        )
+        self.registry.gauge(
+            "training_ps_replication_drops",
+            fn=lambda: self.replication_drops,
+        )
+        self.registry.gauge(
+            "training_ps_workers_seen", fn=lambda: len(self._seen_seq)
+        )
 
     # -- protocol verbs -----------------------------------------------------
 
@@ -300,6 +338,10 @@ class ParameterServer:
             # transports (in-process and socket), never for replication
             faults.fire("ps.pull", worker_id=worker_id)
         with self._lock:
+            if _via == "client":
+                # counter increments ride the commit lock (the
+                # registry's counters leave serialization to callers)
+                self._metrics.inc("pulls")
             center = jax.tree.map(np.copy, self._center)
             tag = self._pull_tag()
             if worker_id is not None:
@@ -348,6 +390,8 @@ class ParameterServer:
         delta = maybe_decompress(delta)
         snap = None
         with self._lock:
+            if _via == "client":
+                self._metrics.inc("commits")
             if (
                 _via == "client"
                 and self.min_replicas
@@ -358,6 +402,7 @@ class ParameterServer:
                 # caller's policy-paced retry rides out the standby's
                 # re-attach (which re-arms the gate and, via its fresh
                 # snapshot, covers everything applied meanwhile)
+                self._metrics.inc("commits_refused_no_replica")
                 raise ParameterServerError(
                     "no_replica",
                     detail=f"{len(self._replicas)} of "
@@ -421,6 +466,8 @@ class ParameterServer:
             # refusing the ack is safe even though a checkpoint may carry
             # this commit: the checkpoint meta carries the dedup table
             # too, so a post-restore resend of this seq is deduplicated
+            with self._lock:
+                self._metrics.inc("commits_refused_no_replica")
             raise ParameterServerError(
                 "no_replica",
                 detail="replication lost mid-commit; the resend is "
@@ -585,6 +632,12 @@ class ParameterServer:
         with self._lock:
             self._worker_snaps = {_wid_key(k): v for k, v in snaps.items()}
 
+    def metrics_snapshot(self) -> list:
+        """JSON-able samples of the PS registry (counters + ledger
+        gauges) — the training tier's analogue of the serving
+        ``metrics`` verb payload."""
+        return self.registry.snapshot()
+
     @property
     def num_updates(self) -> int:
         with self._lock:
@@ -740,6 +793,18 @@ class SocketParameterServer:
         self._conns_lock = threading.Lock()
         self._role_lock = threading.Lock()
         self._running = threading.Event()
+        # socket-tier gauges ride the wrapped PS's registry, so one
+        # metrics_snapshot() covers commits AND failover posture
+        self.ps.registry.gauge(
+            "training_ps_socket_reattaches", fn=lambda: self.reattaches
+        )
+        self.ps.registry.gauge(
+            "training_ps_socket_promoted", fn=lambda: self.promoted
+        )
+        self.ps.registry.gauge(
+            "training_ps_socket_open_connections",
+            fn=lambda: len(self._conns),
+        )
 
     def start(self):
         self.ps.start()

@@ -86,6 +86,15 @@ class ServingClient:
         # router forwards the replica's stamp, so through a router this
         # is the REPLICA that served, not the router itself
         self.last_served_by = None
+        # assembled timeline of the most recent ``generate(trace=True)``
+        # call: {"trace_id", "spans"} — server/router spans off the
+        # reply plus this client's own terminal ``client.request`` span
+        # (also set on typed failures, so errors stay joinable)
+        self.last_trace = None
+        self.last_attempts = 0  # roundtrips the last traced call took
+        # replicas the router could not scrape on the last metrics()
+        # call (empty for a lone server / a fully reachable fleet)
+        self.last_metrics_unreachable = []
 
     def _dial(self):
         sock = connect(
@@ -174,6 +183,11 @@ class ServingClient:
         code = reply.get("error", "error")
         err = _ERRORS.get(code, ServingError)(reply.get("detail", code))
         err.code = code  # wire code survives even for unmapped errors
+        if reply.get("trace") is not None:
+            # typed failures stay joinable to server-side spans: the
+            # reply's trace stamp (id + any timeline) rides the error
+            err.trace = reply["trace"]
+            err.trace_id = reply["trace"].get("id")
         if reply.get("retry_after_ms") is not None:
             # RetryPolicy reads this attribute as its backoff hint
             err.retry_after = float(reply["retry_after_ms"]) / 1e3
@@ -207,23 +221,46 @@ class ServingClient:
             pass
         return None
 
-    def _call(self, header: dict, payload: bytes = b"", idempotent=True):
+    def _call(self, header: dict, payload: bytes = b"", idempotent=True,
+              trace_ctx=None):
+        """``trace_ctx``: when set, every attempt (retries and resends
+        included) carries a FRESH child context on the wire, so each
+        server-side span gets its own id under the same trace; the
+        attempt count lands on ``last_attempts``."""
+        if trace_ctx is None:
+            roundtrip = lambda: self._roundtrip(header, payload)  # noqa: E731
+        else:
+            self.last_attempts = 0
+
+            def roundtrip():
+                self.last_attempts += 1
+                header["trace"] = trace_ctx.child().to_wire()
+                return self._roundtrip(header, payload)
+
         if self._retry is None:
-            return self._roundtrip(header, payload)
+            return roundtrip()
         retry_on = (OverloadedError,)
         if idempotent:
             retry_on = retry_on + (ConnectionError, OSError)
-        return self._retry.call(
-            lambda: self._roundtrip(header, payload), retry_on=retry_on
-        )
+        return self._retry.call(roundtrip, retry_on=retry_on)
 
     # -- verbs --------------------------------------------------------------
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
-                 deadline_ms=None) -> np.ndarray:
+                 deadline_ms=None, trace=False) -> np.ndarray:
         """Continue ``prompt`` (1-D int tokens) by up to
         ``max_new_tokens``; returns the full sequence (prompt +
-        generated, trimmed after the first generated ``eos_id``)."""
+        generated, trimmed after the first generated ``eos_id``).
+
+        ``trace=True`` propagates a trace context end to end (client →
+        router → server → scheduler) and assembles the per-request
+        timeline on ``self.last_trace`` — the client's own terminal
+        ``client.request`` span plus every span the reply returned.
+        The timeline is assembled for typed failures too (the error
+        carries the server's trace stamp), so "which hop failed it"
+        is answerable from the client alone."""
+        from distkeras_tpu.obs import TraceContext, start_span
+
         header = {
             "verb": "generate",
             "max_new_tokens": int(max_new_tokens),
@@ -232,10 +269,48 @@ class ServingClient:
             header["eos_id"] = int(eos_id)
         if deadline_ms is not None:
             header["deadline_ms"] = float(deadline_ms)
-        _, body = self._call(
-            header, serialize_params(np.asarray(prompt, np.int32))
-        )
+        ctx = span = None
+        if trace:
+            ctx = TraceContext.new(want_timeline=True)
+            span = start_span(
+                "client.request", ctx, verb="generate",
+                endpoint=f"{self._host}:{self._port}",
+            )
+        try:
+            reply, body = self._call(
+                header, serialize_params(np.asarray(prompt, np.int32)),
+                trace_ctx=ctx,
+            )
+        except ServingError as e:
+            if span is not None:
+                rec = span.end(
+                    status=getattr(e, "code", "error"), terminal=True,
+                    attempts=self.last_attempts,
+                )
+                self._assemble_trace(ctx, getattr(e, "trace", None), rec)
+            raise
+        except Exception:
+            if span is not None:
+                # an untyped wire death still ends the trace: exactly
+                # one terminal span per attempt is the soak's bar
+                rec = span.end(
+                    status="connection_error", terminal=True,
+                    attempts=self.last_attempts,
+                )
+                self._assemble_trace(ctx, None, rec)
+            raise
+        if span is not None:
+            rec = span.end(
+                status="ok", terminal=True, attempts=self.last_attempts
+            )
+            self._assemble_trace(ctx, reply.get("trace"), rec)
         return np.asarray(deserialize_params(body))
+
+    def _assemble_trace(self, ctx, wire_trace, client_record) -> dict:
+        spans = list((wire_trace or {}).get("timeline") or [])
+        spans.append(client_record)
+        self.last_trace = {"trace_id": ctx.trace_id, "spans": spans}
+        return self.last_trace
 
     def predict(self, x) -> np.ndarray:
         _, body = self._call(
@@ -256,6 +331,27 @@ class ServingClient:
     def stats(self) -> dict:
         reply, _ = self._call({"verb": "stats"})
         return reply["stats"]
+
+    def metrics(self, prometheus=False):
+        """The typed-registry snapshot of whatever answers — a lone
+        server's engine book, or the router's per-replica-labeled
+        fleet aggregate. ``prometheus=True`` returns the text
+        exposition dump (a ``str``) instead of JSON samples.
+
+        A fleet scrape that skipped dead replicas is NOT complete:
+        the router names them and this client mirrors that on
+        ``last_metrics_unreachable`` (empty for a lone server), so
+        consumers like ``dkt_top`` can show the gap instead of
+        rendering a silently shrunken fleet."""
+        if prometheus:
+            reply, _ = self._call(
+                {"verb": "metrics", "format": "prometheus"}
+            )
+            self.last_metrics_unreachable = reply.get("unreachable") or []
+            return reply["text"]
+        reply, _ = self._call({"verb": "metrics"})
+        self.last_metrics_unreachable = reply.get("unreachable") or []
+        return reply["metrics"]
 
     def stop(self) -> dict:
         """Ask the server to drain and shut down (acked before the

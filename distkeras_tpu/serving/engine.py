@@ -1218,10 +1218,25 @@ class ServingEngine:
         ``InternalError``), ``restart_backoff`` (base of the
         exponential full-jitter delay between restarts — the same
         ``networking.RetryPolicy`` schedule clients use)."""
+        from distkeras_tpu.obs import MetricsRegistry
+
         self.model = model
         self._stepper = None
         self._decode_err = None
         self.prefix_store = None
+        # the engine-owned metrics registry: scheduler counters, prefix-
+        # cache counters, engine gauges, and request-latency histograms
+        # all register here; the server's ``metrics`` verb ships
+        # ``metrics_snapshot()``. Component-owned (not module-global)
+        # so in-process fleets keep per-replica books.
+        self.registry = MetricsRegistry()
+        # engine-owned span ring for the same reason: the server
+        # records this engine's request spans here, and draining to
+        # THIS engine's MetricsLogger can never steal a sibling
+        # engine's pending spans in an in-process fleet
+        from distkeras_tpu.obs import TraceCollector
+
+        self.trace_collector = TraceCollector()
         store = None
         if prefix_cache:
             from distkeras_tpu.serving.prefix_cache import PrefixStore
@@ -1229,7 +1244,9 @@ class ServingEngine:
             store = (
                 prefix_cache
                 if isinstance(prefix_cache, PrefixStore)
-                else PrefixStore(max_bytes=prefix_cache_bytes)
+                else PrefixStore(
+                    max_bytes=prefix_cache_bytes, registry=self.registry
+                )
             )
         drafter = self._resolve_drafter(
             speculative, draft_bundle, ngram_max
@@ -1265,7 +1282,7 @@ class ServingEngine:
             prefill_chunk = max(16, self._stepper.max_len // 8)
         self._batcher_cfg = dict(
             queue_capacity=queue_capacity, prefill_chunk=prefill_chunk,
-            quarantine_steps=quarantine_steps,
+            quarantine_steps=quarantine_steps, registry=self.registry,
         )
         self.batcher = (
             None
@@ -1315,6 +1332,37 @@ class ServingEngine:
         self._failed = False  # permanently degraded (see _failed_reason)
         self._failed_reason = None
         self._last_crash = None
+        # engine-level gauges (scrape-time callbacks over state the
+        # engine already keeps) and per-phase request-latency
+        # histograms (log-bucketed: 0.1 ms .. ~52 s in 20 buckets),
+        # observed at request completion in ``wait``
+        reg = self.registry
+        reg.gauge("serving_engine_restarts", fn=lambda: self._restarts)
+        reg.gauge(
+            "serving_engine_watchdog_trips",
+            fn=lambda: self._watchdog_trips,
+        )
+        reg.gauge("serving_engine_degraded", fn=lambda: self._failed)
+        reg.gauge(
+            "serving_engine_heartbeat_age_seconds",
+            fn=lambda: (
+                time.monotonic() - self._heartbeat
+                if self._started and self.batcher is not None
+                else None
+            ),
+        )
+        reg.gauge(
+            "serving_engine_prefix_fetch_failures",
+            fn=lambda: (
+                0 if self._stepper is None
+                else self._stepper.prefix_fetch_failures
+            ),
+        )
+        self._lat_hists = {
+            phase: reg.histogram(f"serving_request_{phase}_seconds")
+            for phase in ("queue_wait", "prefill", "decode", "ttft",
+                          "total")
+        }
 
     @staticmethod
     def _resolve_drafter(speculative, draft_bundle, ngram_max):
@@ -1537,11 +1585,16 @@ class ServingEngine:
             # whose scheduler thread was already dead)
             batcher.stop()
         self._predict_batcher.close()
+        self.drain_traces()  # the tail of the span ring is not lost
 
     # -- generate -----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               deadline=None) -> ServeRequest:
+               deadline=None, trace=None) -> ServeRequest:
+        """``trace``: an optional ``obs.TraceContext`` — the scheduler
+        then keeps the per-request event ledger ``obs.request_spans``
+        turns into the server-side phase timeline. None (the default)
+        costs nothing."""
         batcher = self.batcher  # one read: restarts swap the attribute
         if batcher is None:
             raise EngineStoppedError(
@@ -1555,7 +1608,8 @@ class ServingEngine:
                 f"(last crash: {self._last_crash})"
             )
         req = ServeRequest(
-            prompt, max_new_tokens, eos_id=eos_id, deadline=deadline
+            prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
+            trace=trace,
         )
         try:
             try:
@@ -1581,21 +1635,45 @@ class ServingEngine:
                 )
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
-                 deadline=None, timeout=None) -> np.ndarray:
+                 deadline=None, timeout=None, trace=None) -> np.ndarray:
         req = self.submit(
-            prompt, max_new_tokens, eos_id=eos_id, deadline=deadline
+            prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
+            trace=trace,
         )
+        return self.wait(req, timeout)
+
+    def wait(self, req: ServeRequest, timeout=None) -> np.ndarray:
+        """Block on a submitted request and run the completion
+        bookkeeping — latency-histogram observations, the JSONL
+        ``serving_complete`` record, and (for traced requests) draining
+        finished spans to the metrics sink. The server's ``generate``
+        verb uses ``submit`` + ``wait`` so it can hold the request
+        handle for the trace timeline; ``generate`` above is the
+        embedded one-call face over the same path."""
         try:
             return req.result(timeout)
         finally:
+            lat = req.latency()
+            for phase, hist in self._lat_hists.items():
+                if lat[phase] is not None:
+                    hist.observe(lat[phase])
             if self.metrics is not None:
-                lat = req.latency()
                 self.metrics.log(
                     event="serving_complete", request_id=req.id,
                     tokens=len(req.tokens),
                     error=None if req.error is None else req.error.code,
                     **{k: v for k, v in lat.items() if v is not None},
                 )
+                if req.trace is not None:
+                    self.drain_traces()
+
+    def drain_traces(self) -> int:
+        """Flush this engine's trace collector into its
+        ``MetricsLogger`` (one ``trace_span`` JSONL line per span);
+        no-op without a ``metrics_path``. Returns spans written."""
+        if self.metrics is None:
+            return 0
+        return self.trace_collector.drain_to(self.metrics)
 
     # -- predict ------------------------------------------------------------
 
@@ -1612,6 +1690,17 @@ class ServingEngine:
         return self._predict_batcher.submit(x).result(timeout)
 
     # -- observability ------------------------------------------------------
+
+    def metrics_snapshot(self) -> list:
+        """JSON-able samples of every registered metric — the payload
+        of the server's ``metrics`` verb. A shared ``PrefixStore``
+        instance passed in from outside keeps its own registry; its
+        samples are merged here so the verb still sees the cache."""
+        samples = self.registry.snapshot()
+        store = self.prefix_store
+        if store is not None and store.registry is not self.registry:
+            samples = samples + store.registry.snapshot()
+        return samples
 
     def health(self) -> dict:
         """Liveness summary, cheap enough for a load balancer to poll:

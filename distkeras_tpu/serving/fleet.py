@@ -8,8 +8,12 @@ front-end over the existing DKT1 wire:
 - :class:`FleetRouter` — a TCP router speaking the SAME protocol as
   ``ServingServer`` (a ``ServingClient`` pointed at the router cannot
   tell the difference), forwarding ``generate``/``predict`` to one of
-  N replica servers and answering ``health``/``stats`` with the
-  fleet-level view. Replica selection is
+  N replica servers and answering ``health``/``stats``/``metrics``
+  with the fleet-level view (``metrics`` aggregates every replica's
+  typed-registry snapshot, labeled ``replica="host:port"``; a traced
+  request gets a ``router.route`` span recording the affinity
+  decision and every failover hop — see docs/ARCHITECTURE.md
+  "Observability"). Replica selection is
 
   * **health-gated**: a background sweep polls each replica's
     ``health`` verb; ``degraded``/``draining`` replicas and replicas
@@ -70,6 +74,7 @@ import numpy as np
 
 from distkeras_tpu import faults
 from distkeras_tpu.networking import probe, recv_data, send_data
+from distkeras_tpu.obs import stamp_error_trace as _stamp_trace
 from distkeras_tpu.serving.prefix_cache import _pow2_ladder
 from distkeras_tpu.serving.scheduler import ServingError
 from distkeras_tpu.utils.serialization import (
@@ -181,17 +186,49 @@ class FleetRouter:
         # one persistent health connection
         self._poll_locks: dict[tuple, threading.Lock] = {}
         self._drained = threading.Condition(self._lock)
-        self.counters = {
-            "forwards": 0,
-            "affinity_routed": 0,   # generate landed on its hash home
-            "spilled": 0,           # hash home saturated, next in order
-            "least_loaded_routed": 0,
-            "failovers": 0,
-            "fleet_overloaded": 0,  # every replica saturated/overloaded
-            "unavailable": 0,       # every replica unreachable
-            "ejections": 0,
-            "rejoins": 0,
-        }
+        from distkeras_tpu.obs import MetricsRegistry
+
+        # router-owned registry: the old counter dict becomes a
+        # CounterGroup (``fleet_router_<key>``; every existing call
+        # site and stats() reader keeps working), plus rotation gauges
+        # and a forward-latency histogram — the ``metrics`` verb ships
+        # these next to every replica's own labeled samples
+        self.registry = MetricsRegistry()
+        self.counters = self.registry.group(
+            "fleet_router",
+            (
+                "forwards",
+                "affinity_routed",  # generate landed on its hash home
+                "spilled",        # hash home saturated, next in order
+                "least_loaded_routed",
+                "failovers",
+                "fleet_overloaded",  # every replica saturated/refusing
+                "unavailable",    # every replica unreachable
+                "ejections",
+                "rejoins",
+            ),
+        )
+        self.registry.gauge(
+            "fleet_router_replicas", fn=lambda: len(self._replicas)
+        )
+        self.registry.gauge(
+            "fleet_router_active_replicas",
+            fn=lambda: sum(
+                r.state == ACTIVE for r in list(self._replicas.values())
+            ),
+        )
+        self.registry.gauge(
+            "fleet_router_in_flight",
+            fn=lambda: sum(
+                r.in_flight for r in list(self._replicas.values())
+            ),
+        )
+        self.registry.gauge(
+            "fleet_router_open_connections", fn=lambda: len(self._conns)
+        )
+        self._forward_hist = self.registry.histogram(
+            "fleet_router_forward_seconds"
+        )
         for ep in endpoints:
             self._replicas[(ep[0], int(ep[1]))] = _Replica(ep)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -542,28 +579,32 @@ class FleetRouter:
                 return
             except (ConnectionError, OSError):
                 return
+            req_header = {}
             try:
-                reply = self._dispatch(frame)
+                req_header, payload = unpack_frame(frame)
+                reply = self._dispatch(req_header, payload)
             except ServingError as e:
                 header = {"ok": False, "error": e.code, "detail": str(e)}
                 if getattr(e, "retry_after", None) is not None:
                     header["retry_after_ms"] = e.retry_after * 1e3
                 elif e.code == "overloaded":
                     header["retry_after_ms"] = self.retry_after_ms
+                _stamp_trace(header, req_header, e)
                 reply = pack_frame(header)
             except (ConnectionError, OSError) as e:
                 # forward-side wire death that escaped failover — only
                 # reachable if a non-idempotent verb is ever routed
                 # (today none is); typed, never a silent close
-                reply = pack_frame(
-                    {"ok": False, "error": "unavailable",
-                     "detail": repr(e),
-                     "retry_after_ms": self.retry_after_ms}
-                )
+                header = {"ok": False, "error": "unavailable",
+                          "detail": repr(e),
+                          "retry_after_ms": self.retry_after_ms}
+                _stamp_trace(header, req_header, e)
+                reply = pack_frame(header)
             except Exception as e:  # noqa: BLE001 — wire boundary
-                reply = pack_frame(
-                    {"ok": False, "error": "internal", "detail": repr(e)}
-                )
+                header = {"ok": False, "error": "internal",
+                          "detail": repr(e)}
+                _stamp_trace(header, req_header, e)
+                reply = pack_frame(header)
             try:
                 send_data(conn, reply)
             except (ConnectionError, OSError):
@@ -573,8 +614,7 @@ class FleetRouter:
 
     # -- verbs --------------------------------------------------------------
 
-    def _dispatch(self, frame: bytes) -> bytes:
-        header, payload = unpack_frame(frame)
+    def _dispatch(self, header: dict, payload: bytes) -> bytes:
         verb = header.get("verb")
         faults.fire("router.dispatch", verb=verb)
         if verb in ("generate", "predict"):
@@ -584,6 +624,8 @@ class FleetRouter:
             return pack_frame(self._health_reply())
         if verb == "stats":
             return pack_frame({"ok": True, "stats": self.stats()})
+        if verb == "metrics":
+            return pack_frame(self._metrics_reply(header))
         if verb == "stop":
             # stop THE ROUTER (reply first, drain on a side thread,
             # mirroring ServingServer). Replica lifecycle belongs to
@@ -623,6 +665,72 @@ class FleetRouter:
             out["open_connections"] = len(self._conns)
         out["affinity_enabled"] = self.affinity
         return out
+
+    def _metrics_reply(self, header: dict) -> dict:
+        """The fleet-level ``metrics`` verb: the router's own registry
+        samples labeled ``replica="router"`` plus every registered
+        replica's ``metrics`` snapshot labeled with its endpoint —
+        one scrape shows the whole fleet, per-replica attributed. A
+        replica that fails the scrape is named in ``unreachable``
+        rather than silently missing (rotation is untouched: scraping
+        is observability, ejection belongs to the health sweep)."""
+        from distkeras_tpu.obs import label_samples, render_prometheus
+
+        samples = label_samples(self.registry.snapshot(), replica="router")
+        unreachable = []
+        with self._lock:
+            eps = list(self._replicas)
+        results: dict = {}
+        errors: dict = {}
+
+        def scrape_one(ep):
+            with self._lock:
+                plock = self._poll_locks.setdefault(ep, threading.Lock())
+            try:
+                # the persistent health client, under its poll lock so a
+                # concurrent sweep never interleaves frames with us
+                with plock:
+                    cli = self._health_client(ep)
+                    results[ep] = cli.metrics()
+            except Exception as e:  # noqa: BLE001 — scrape best-effort
+                # the shared client may be mid-frame desynced: drop it
+                # (the next poll redials) and report, don't eject
+                with plock:
+                    with self._lock:
+                        stale = self._health_clients.pop(ep, None)
+                    if stale is not None:
+                        stale.close()
+                errors[ep] = repr(e)
+
+        # scrape CONCURRENTLY, like the health sweep: serialized, one
+        # slow/dead replica stalls the whole fleet scrape (and dkt_top)
+        # by health_timeout PER dead replica while holding its poll lock
+        threads = [
+            threading.Thread(target=scrape_one, args=(ep,),
+                             name="fleet-scrape", daemon=True)
+            for ep in eps
+        ]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + self.health_timeout + 2.0
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        for ep in eps:
+            if ep in results:
+                samples += label_samples(results[ep],
+                                         replica=f"{ep[0]}:{ep[1]}")
+            else:
+                unreachable.append({
+                    "endpoint": [ep[0], ep[1]],
+                    "error": errors.get(ep, "scrape timed out"),
+                })
+        reply = {"ok": True, "unreachable": unreachable}
+        if header.get("format") == "prometheus":
+            reply["format"] = "prometheus"
+            reply["text"] = render_prometheus(samples)
+        else:
+            reply["metrics"] = samples
+        return reply
 
     # -- routing ------------------------------------------------------------
 
@@ -673,12 +781,49 @@ class FleetRouter:
         """Pick a replica, forward, failover. Returns ``(reply, body)``
         to relay verbatim (the replica's typed errors — deadline,
         internal, bad_request — pass through untouched; only fleet-wide
-        saturation and fleet-wide death are the router's own replies)."""
+        saturation and fleet-wide death are the router's own replies).
+
+        Tracing: a request carrying a ``trace`` header field gets a
+        ``router.route`` span recording the routing decision (affinity
+        key, chosen replica, affinity/spill/least-loaded, every
+        failover hop) — appended to the reply's timeline when the
+        client asked for it, and parenting the replica's own server
+        span (each forward attempt carries a fresh child context)."""
+        from distkeras_tpu.obs import TraceContext, start_span
+
         verb = header.get("verb")
         key = self._affinity_key(verb, payload)
+        ctx = TraceContext.from_wire(header.get("trace"))
+        span = None
+        hops: list[str] = []
+        if ctx is not None:
+            span = start_span(
+                "router.route", ctx, verb=verb,
+                affinity_key=(
+                    None if key is None
+                    else hashlib.blake2b(key, digest_size=4).hexdigest()
+                ),
+            )
+            header = dict(header)  # per-attempt child contexts below
         excluded: set = set()
         causes = []
         saw_overloaded_hint = None
+
+        def finish(reply, status, how=None, replica=None):
+            """End the router span (terminal belongs to the CLIENT) and
+            ride the reply: append to a returned timeline, or stamp the
+            bare trace id on the router's own typed errors."""
+            if span is None:
+                return reply
+            rec = span.end(
+                status=status, how=how, replica=replica, hops=hops,
+                failovers=len(causes),
+            )
+            tr = reply.setdefault("trace", {"id": ctx.trace_id})
+            if ctx.want_timeline:
+                tr.setdefault("timeline", []).append(rec)
+            return reply
+
         while True:
             with self._lock:
                 rep, how = self._pick(key, excluded)
@@ -697,11 +842,11 @@ class FleetRouter:
                     with self._lock:
                         self.counters["fleet_overloaded"] += 1
                     hint = saw_overloaded_hint or self.retry_after_ms
-                    return {
+                    return finish({
                         "ok": False, "error": "overloaded",
                         "detail": "every fleet replica is saturated",
                         "retry_after_ms": float(hint),
-                    }, b""
+                    }, "overloaded"), b""
                 with self._lock:
                     self.counters["unavailable"] += 1
                 detail = "no replica in rotation" if how == "empty" else (
@@ -709,10 +854,15 @@ class FleetRouter:
                         f"{h}:{p}: {e!r}" for (h, p), e in causes
                     )
                 )
-                return {
+                return finish({
                     "ok": False, "error": "unavailable", "detail": detail,
                     "retry_after_ms": self.retry_after_ms,
-                }, b""
+                }, "unavailable"), b""
+            if ctx is not None:
+                # a fresh child per attempt: a failover resend gets its
+                # own server-side span id under the same router span
+                header["trace"] = ctx.child().to_wire()
+            fwd_t0 = time.monotonic()
             try:
                 cli = self._checkout(ep)
                 try:
@@ -724,6 +874,7 @@ class FleetRouter:
                     raise
                 self._checkin(ep, cli)
             except (ConnectionError, OSError) as e:
+                hops.append(f"{ep[0]}:{ep[1]} died")
                 self._forward_died(ep, e, causes, excluded)
                 # every verb _dispatch routes today IS idempotent, so
                 # this always continues (bounded: ep now in excluded);
@@ -734,6 +885,7 @@ class FleetRouter:
                     continue
                 raise
             finally:
+                self._forward_hist.observe(time.monotonic() - fwd_t0)
                 with self._lock:
                     r = self._replicas.get(ep)
                     if r is not None:
@@ -744,6 +896,7 @@ class FleetRouter:
                 # replica-level saturation the router's accounting
                 # missed (capacity estimate stale): try a sibling; the
                 # client only sees overloaded when EVERY one refused
+                hops.append(f"{ep[0]}:{ep[1]} overloaded")
                 excluded.add(ep)
                 hint = reply.get("retry_after_ms")
                 if hint is not None:
@@ -751,7 +904,15 @@ class FleetRouter:
                         saw_overloaded_hint or 0.0, float(hint)
                     )
                 continue
-            return reply, body
+            hops.append(
+                f"{ep[0]}:{ep[1]} "
+                + ("ok" if reply.get("ok") else str(reply.get("error")))
+            )
+            return finish(
+                reply,
+                "ok" if reply.get("ok") else str(reply.get("error")),
+                how=how, replica=f"{ep[0]}:{ep[1]}",
+            ), body
 
     def _forward_died(self, ep, exc, causes, excluded):
         """A forward connection died mid-request: eject the replica now

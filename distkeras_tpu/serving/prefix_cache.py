@@ -67,10 +67,18 @@ class PrefixStore:
     (counted ``oversize_rejected``) rather than flushing the store.
     """
 
-    def __init__(self, max_bytes: int = 64 << 20, seen_capacity: int = 4096):
+    def __init__(self, max_bytes: int = 64 << 20, seen_capacity: int = 4096,
+                 registry=None):
+        """``registry``: an ``obs.MetricsRegistry`` to register the
+        store's counters and size gauges in (the engine passes its own
+        so the ``metrics`` verb scrapes them); None builds a private
+        one. ``counters`` stays dict-shaped (a ``CounterGroup``)."""
+        from distkeras_tpu.obs import MetricsRegistry
+
         self.max_bytes = int(max_bytes)
         if self.max_bytes < 1:
             raise ValueError("max_bytes must be >= 1")
+        self.registry = registry if registry is not None else MetricsRegistry()
         # key -> (prefix_len, kv, nbytes); insertion/access order = LRU
         self._entries: collections.OrderedDict = collections.OrderedDict()
         self._len_counts: collections.Counter = collections.Counter()
@@ -83,14 +91,27 @@ class PrefixStore:
         self._seen: collections.OrderedDict = collections.OrderedDict()
         self.seen_capacity = int(seen_capacity)
         self._lock = threading.Lock()
-        self.counters = {
-            "hits": 0,
-            "misses": 0,
-            "inserts": 0,
-            "evictions": 0,
-            "oversize_rejected": 0,
-            "hit_tokens": 0,  # prefill positions served from the store
-        }
+        # the old counter dict as a CounterGroup over typed registry
+        # counters (``serving_prefix_cache_<key>``): existing call
+        # sites, ``reset_counters``, and the bench's summed snapshots
+        # all keep working while the values become scrapeable
+        self.counters = self.registry.group(
+            "serving_prefix_cache",
+            (
+                "hits",
+                "misses",
+                "inserts",
+                "evictions",
+                "oversize_rejected",
+                "hit_tokens",  # prefill positions served from store
+            ),
+        )
+        self.registry.gauge(
+            "serving_prefix_cache_entries", fn=lambda: len(self._entries)
+        )
+        self.registry.gauge(
+            "serving_prefix_cache_bytes", fn=lambda: self._bytes
+        )
 
     @staticmethod
     def _key(tokens: np.ndarray) -> bytes:

@@ -102,12 +102,20 @@ class ServeRequest:
     and returns the full sequence (prompt + generated tokens, cut after
     the first generated ``eos_id`` inclusive, matching the generators'
     return convention) or raises the recorded ``ServingError``.
+
+    ``trace``: an optional ``obs.tracing.TraceContext``. When set, the
+    batcher additionally records a per-request EVENT ledger (one entry
+    per prefill chunk, one per blame assignment) that
+    ``obs.tracing.request_spans`` turns into the server-side phase
+    timeline; untraced requests skip the ledger entirely (the
+    timestamps below are always stamped — they feed ``latency()``).
     """
 
     _ids = iter(range(1, 1 << 62))
     _ids_lock = threading.Lock()
 
-    def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None):
+    def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None,
+                 trace=None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -129,6 +137,10 @@ class ServeRequest:
         self.finished = None
         self.tokens: list[int] = []  # generated tokens, in order
         self.error: ServingError | None = None
+        self.trace = trace  # TraceContext | None (None = no ledger)
+        self.events: list[dict] = []  # trace ledger (traced reqs only)
+        self.prefill_chunks = 0  # stepper.prefill_chunk calls, this req
+        self.iterations = 0  # scheduler iterations this slot advanced
         self._done = threading.Event()
 
     # -- lifecycle (called by the batcher, under its lock) ------------------
@@ -198,12 +210,19 @@ class ContinuousBatcher:
     """
 
     def __init__(self, stepper, queue_capacity=64, prefill_chunk=None,
-                 quarantine_steps=64):
+                 quarantine_steps=64, registry=None):
         """``quarantine_steps``: scheduler iterations a slot sits out
         after a device step is blamed on its request (its cache rows are
         suspect, and a systematically poisonous traffic shape should not
         re-enter the bank instantly); the slot recycles into the free
-        pool automatically once the probation expires."""
+        pool automatically once the probation expires.
+
+        ``registry``: an ``obs.MetricsRegistry`` to register the
+        scheduler's counters and occupancy gauges in (the engine passes
+        its own, so the ``metrics`` verb scrapes them); None builds a
+        private one. ``counters`` stays dict-shaped (a
+        ``CounterGroup``) so every existing call site and reset loop
+        keeps working while the values become typed metrics."""
         self.stepper = stepper
         self.queue_capacity = int(queue_capacity)
         if self.queue_capacity < 1:
@@ -236,27 +255,60 @@ class ContinuousBatcher:
         self._work = threading.Event()  # signals the engine loop
         self._draining = False
         self._stopped = False
-        self.counters = {
-            "submitted": 0,
-            "rejected_overloaded": 0,
-            "completed": 0,
-            "deadline_exceeded": 0,
-            "steps": 0,
-            "occupancy_sum": 0,  # sum over steps of active slots
-            "tokens_generated": 0,
-            "prefill_chunks": 0,  # stepper.prefill_chunk calls
-            "prefill_tokens": 0,  # prompt positions prefilled
-            # fault / recovery counters (the self-healing paths)
-            "step_failures": 0,  # device step raised
-            "blame_probes": 0,  # extra step calls spent assigning blame
-            "internal_errors": 0,  # requests failed with InternalError
-            "prefill_failures": 0,  # begin_admit / prefill_chunk raised
-            "quarantines": 0,  # slots sent to probation
-            # speculative decode (stay 0 on non-speculative steppers)
-            "spec_windows": 0,  # slot-windows processed via verify
-            "spec_tokens": 0,  # tokens emitted from verify windows
-            "spec_draft_accepted": 0,  # emitted tokens the DRAFT sourced
-        }
+        from distkeras_tpu.obs import MetricsRegistry
+
+        self.registry = registry if registry is not None else MetricsRegistry()
+        # the old hand-rolled counter dict, now a CounterGroup over
+        # typed registry counters (``serving_scheduler_<key>``): every
+        # ``counters["key"] += 1`` call site, test, and bench counter
+        # reset keeps working unchanged, and the values become
+        # scrapeable through the ``metrics`` verb. ``fresh=True``: a
+        # supervisor-rebuilt batcher starts at zero like the dict did.
+        self.counters = self.registry.group(
+            "serving_scheduler",
+            (
+                "submitted",
+                "rejected_overloaded",
+                "completed",
+                "deadline_exceeded",
+                "steps",
+                "occupancy_sum",  # sum over steps of active slots
+                "tokens_generated",
+                "prefill_chunks",  # stepper.prefill_chunk calls
+                "prefill_tokens",  # prompt positions prefilled
+                # fault / recovery counters (the self-healing paths)
+                "step_failures",  # device step raised
+                "blame_probes",  # extra step calls assigning blame
+                "internal_errors",  # requests failed InternalError
+                "prefill_failures",  # begin_admit/prefill_chunk raised
+                "quarantines",  # slots sent to probation
+                # speculative decode (0 on non-speculative steppers)
+                "spec_windows",  # slot-windows processed via verify
+                "spec_tokens",  # tokens emitted from verify windows
+                "spec_draft_accepted",  # emitted tokens DRAFT sourced
+            ),
+        )
+        # occupancy gauges, computed at scrape time from state the
+        # batcher already keeps (unlocked reads: scrapes tolerate a
+        # torn read, the serving path must not pay a lock for them)
+        self.registry.gauge(
+            "serving_scheduler_queue_depth", fn=lambda: len(self._queue)
+        )
+        self.registry.gauge(
+            "serving_scheduler_active_slots",
+            fn=lambda: sum(s is not None for s in self._slots),
+        )
+        self.registry.gauge(
+            "serving_scheduler_prefilling_slots",
+            fn=lambda: len(self._prefill_left),
+        )
+        self.registry.gauge(
+            "serving_scheduler_quarantined_slots",
+            fn=lambda: len(self._quarantined),
+        )
+        self.registry.gauge(
+            "serving_scheduler_num_slots", fn=lambda: len(self._slots)
+        )
         # per-slot acceptance ledger (lifetime): windows seen / tokens
         # emitted per slot index — stats() reports the per-slot rates
         self._spec_windows = np.zeros(stepper.num_slots, np.int64)
@@ -376,6 +428,7 @@ class ContinuousBatcher:
                 ]
         if not active.any():
             return progressed
+        step_t0 = time.monotonic()
         toks, counts, blamed, used_verify = self._step_with_blame(
             active, seqs
         )
@@ -387,6 +440,14 @@ class ContinuousBatcher:
                 req = self._slots[i]
                 if req is None:
                     continue  # stopped underneath the blame probes
+                if req.trace is not None:
+                    # the blame window (failed step + probes) on the
+                    # culprit's own ledger — request_spans turns it
+                    # into a scheduler.blame span
+                    req.events.append({
+                        "name": "scheduler.blame",
+                        "t0": step_t0, "t1": now, "slot": i,
+                    })
                 self._quarantine_locked(i)
                 self._evict(
                     i,
@@ -408,6 +469,7 @@ class ContinuousBatcher:
                 # EOS / deadline check runs PER EMITTED TOKEN, in
                 # emission order — a window's tail past the first
                 # finish/expiry condition is never emitted
+                req.iterations += 1
                 emitted = 0
                 for tok in np.atleast_1d(toks[i])[: int(counts[i])]:
                     tok = int(tok)
@@ -585,6 +647,7 @@ class ContinuousBatcher:
                 give = (
                     left if budget is None else min(left, budget - spent)
                 )
+            chunk_t0 = time.monotonic()
             try:
                 new_left = self.stepper.prefill_chunk(i, give)  # device work
             except Exception as e:  # noqa: BLE001 — admission boundary
@@ -596,6 +659,13 @@ class ContinuousBatcher:
                 if self._slots[i] is not req:
                     continue  # stopped/evicted underneath us
                 consumed = left - new_left
+                req.prefill_chunks += 1
+                if req.trace is not None:
+                    req.events.append({
+                        "name": "serving.prefill_chunk",
+                        "t0": chunk_t0, "t1": now,
+                        "tokens": int(consumed), "slot": i,
+                    })
                 if consumed <= 0 and new_left > 0:
                     # a stepper that consumes nothing would spin this
                     # loop forever — fail loudly (the engine loop's

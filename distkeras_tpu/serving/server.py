@@ -28,8 +28,20 @@ Verbs (header ``{"verb": ...}``):
   hit/miss/eviction state, the compiled prefill/chunk buckets, and the
   live connection count. ``overloaded`` error replies carry a
   ``retry_after_ms`` backoff hint.
+- ``metrics``: the typed-registry snapshot (``obs.metrics``) —
+  scheduler/engine/prefix-cache counters, gauges, and latency
+  histograms as JSON samples; ``format: "prometheus"`` returns the
+  text exposition dump instead (``tools/dkt_top.py`` polls this verb).
 - ``stop``: begins graceful shutdown — in-flight and queued requests
   complete, new ones are refused, then the listener closes.
+
+Tracing (``obs.tracing``): a request header may carry an optional
+``trace`` field (``TraceContext.to_wire``). ``generate`` then records
+a ``server.generate`` span plus the scheduler's per-request phase
+timeline (queue wait, prefill chunks, decode, blame), returned on the
+reply when the client asked (``return`` flag). Typed ERROR replies
+are stamped with the trace id (and the timeline, for a traced
+generate) so client-side failures join server-side spans.
 """
 
 from __future__ import annotations
@@ -42,6 +54,7 @@ import numpy as np
 
 from distkeras_tpu import faults
 from distkeras_tpu.networking import recv_data, send_data
+from distkeras_tpu.obs import stamp_error_trace as _stamp_trace
 from distkeras_tpu.serving.scheduler import ServingError
 from distkeras_tpu.utils.serialization import (
     deserialize_params,
@@ -81,6 +94,12 @@ class ServingServer:
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._shutdown_done = threading.Event()
+        reg = getattr(engine, "registry", None)
+        if reg is not None:  # server-level gauge rides the engine book
+            reg.gauge(
+                "serving_server_open_connections",
+                fn=lambda: len(self._conns),
+            )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -209,20 +228,23 @@ class ServingServer:
                 return
             except (ConnectionError, OSError):
                 return
+            header = {}
             try:
-                reply = self._dispatch(frame)
+                header, payload = unpack_frame(frame)
+                reply = self._dispatch(header, payload)
             except ServingError as e:
-                header = {"ok": False, "error": e.code, "detail": str(e)}
+                h = {"ok": False, "error": e.code, "detail": str(e)}
                 if e.code == "overloaded":
                     # Retry-After semantics: tell the client how long to
                     # back off instead of letting the fleet guess
-                    header["retry_after_ms"] = self.retry_after_ms
-                reply = pack_frame(header)
+                    h["retry_after_ms"] = self.retry_after_ms
+                _stamp_trace(h, header, e)
+                reply = pack_frame(h)
             except Exception as e:  # noqa: BLE001 — wire boundary
-                reply = pack_frame(
-                    {"ok": False, "error": "bad_request",
+                h = {"ok": False, "error": "bad_request",
                      "detail": repr(e)}
-                )
+                _stamp_trace(h, header, e)
+                reply = pack_frame(h)
             act = faults.fire("server.reply", nbytes=len(reply))
             if act == "drop":
                 return  # injected: vanish without replying (conn closes)
@@ -235,14 +257,26 @@ class ServingServer:
 
     # -- verbs --------------------------------------------------------------
 
-    def _dispatch(self, frame: bytes) -> bytes:
-        header, payload = unpack_frame(frame)
+    def _dispatch(self, header: dict, payload: bytes) -> bytes:
         verb = header.get("verb")
         faults.fire("server.dispatch", verb=verb)
         if verb == "generate":
             return self._generate(header, payload)
         if verb == "predict":
             return self._predict(payload)
+        if verb == "metrics":
+            # the typed-registry snapshot (scheduler/engine/prefix-
+            # cache counters, gauges, latency histograms); format=
+            # "prometheus" ships the text exposition dump instead
+            samples = self.engine.metrics_snapshot()
+            if header.get("format") == "prometheus":
+                from distkeras_tpu.obs import render_prometheus
+
+                return pack_frame(
+                    {"ok": True, "format": "prometheus",
+                     "text": render_prometheus(samples)}
+                )
+            return pack_frame({"ok": True, "metrics": samples})
         if verb == "health":
             # engine liveness (serving|degraded|draining, heartbeat age,
             # quarantine + restart ledger) plus the server's own limits,
@@ -278,20 +312,75 @@ class ServingServer:
         raise ValueError(f"unknown verb {verb!r}")
 
     def _generate(self, header: dict, payload: bytes) -> bytes:
+        from distkeras_tpu.obs import TraceContext, request_spans, start_span
+
         prompt = np.asarray(deserialize_params(payload))
         deadline = None
         if header.get("deadline_ms") is not None:
             deadline = time.monotonic() + float(header["deadline_ms"]) / 1e3
-        seq = self.engine.generate(
-            prompt,
-            int(header["max_new_tokens"]),
-            eos_id=header.get("eos_id"),
-            deadline=deadline,
-        )
-        return pack_frame(
-            {"ok": True, "tokens": int(seq.size - prompt.size)},
-            serialize_params(np.asarray(seq)),
-        )
+        # opt-in tracing: absent field = one dict lookup and nothing
+        # else; present = a server.generate span plus the scheduler's
+        # per-request phase timeline, returned on the reply when the
+        # client asked for it (``return`` in the wire field)
+        ctx = TraceContext.from_wire(header.get("trace"))
+        span = None
+        col = None
+        if ctx is not None:
+            from distkeras_tpu.obs import COLLECTOR
+
+            # this engine's own span ring (drained to ITS MetricsLogger)
+            col = getattr(self.engine, "trace_collector", None) or COLLECTOR
+            span = start_span(
+                "server.generate", ctx, collector=col,
+                prompt_len=int(prompt.size),
+                max_new_tokens=int(header["max_new_tokens"]),
+            )
+        req = None
+
+        def assemble_trace(status):
+            """End the server span with ``status`` and build the reply's
+            ``trace`` dict (timeline included when the client asked):
+            the one assembly every exit path — ok, typed, untyped —
+            shares, so they cannot drift apart."""
+            spans = (
+                []
+                if req is None
+                else request_spans(req, ctx, collector=col)
+            )
+            spans.append(span.end(status=status))
+            tr = {"id": ctx.trace_id}
+            if ctx.want_timeline:
+                tr["timeline"] = spans
+            return tr
+
+        try:
+            req = self.engine.submit(
+                prompt,
+                int(header["max_new_tokens"]),
+                eos_id=header.get("eos_id"),
+                deadline=deadline,
+                trace=ctx,
+            )
+            seq = self.engine.wait(req)
+        except ServingError as e:
+            if ctx is not None:
+                e.trace = assemble_trace(e.code)
+            raise
+        except Exception as e:  # noqa: BLE001 — the wire boundary
+            # replies generic bad_request for non-typed failures; the
+            # span must still end (and hit the collector/JSONL sink)
+            # or exactly the untyped failure class vanishes from traces
+            if ctx is not None:
+                tr = assemble_trace("bad_request")
+                try:
+                    e.trace = tr
+                except AttributeError:
+                    pass  # exotic exception refusing attributes
+            raise
+        reply = {"ok": True, "tokens": int(seq.size - prompt.size)}
+        if ctx is not None:
+            reply["trace"] = assemble_trace("ok")
+        return pack_frame(reply, serialize_params(np.asarray(seq)))
 
     def _predict(self, payload: bytes) -> bytes:
         x = np.asarray(deserialize_params(payload))

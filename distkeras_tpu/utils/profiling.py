@@ -69,12 +69,30 @@ class MetricsLogger:
         self.close()
 
 
-def read_metrics(path: str):
-    """Read a JSONL metrics file back into a list of dicts."""
+def read_metrics(path: str, strict: bool = False):
+    """Read a JSONL metrics file back into a list of dicts.
+
+    A process that dies mid-append leaves a torn FINAL line; by default
+    that line is dropped and every whole record before it is returned
+    (``strict=True`` restores the raise). Garbage anywhere else in the
+    file is still an error — a half-written tail is an expected crash
+    artifact, a corrupt middle is not."""
     out = []
+    held = None  # previous non-empty line: parsed only once a later
+    # one proves it was not the (possibly torn) final append
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
-                out.append(json.loads(line))
+            if not line:
+                continue
+            if held is not None:
+                out.append(json.loads(held))
+            held = line
+    if held is not None:
+        try:
+            out.append(json.loads(held))
+        except json.JSONDecodeError:
+            if strict:
+                raise
+            # torn final append: salvage everything before it
     return out

@@ -815,3 +815,9 @@ def test_soak_serving_smoke(lm):
     assert summary["faults_fired"] > 0
     assert summary["fired_by_site"]["stepper.verify"] > 0
     assert summary["speculative"]["windows"] > 0
+    # trace completeness under chaos: every attempt (completed or
+    # typed-error) assembled a timeline with exactly one terminal span
+    assert summary["trace_attempts"] > 0
+    assert summary["trace_incomplete"] == 0, (
+        summary["trace_incomplete_samples"]
+    )

@@ -1,0 +1,599 @@
+"""Observability subsystem (distkeras_tpu/obs/): the typed metrics
+registry + end-to-end request tracing, and their wiring through every
+tier.
+
+Four tiers:
+
+- primitive units: Counter/Gauge/Histogram/CounterGroup semantics, the
+  registry's get-or-register/fresh contract, the Prometheus render →
+  parse roundtrip (escaping included), TraceContext wire roundtrips,
+  the collector's bounded ring;
+- golden-schema pins for the ``health`` / ``stats`` / ``metrics``
+  reply shapes: dashboards key on these names and types, so a drift
+  must be a red test here, not a silently broken panel;
+- end-to-end: a routed ``generate`` through a REAL 2-replica fleet
+  with ``trace=True`` returns a timeline of >= 5 spans forming one
+  tree under the client's terminal span; typed errors stay joinable
+  (trace id on the error reply); the ``metrics`` verb aggregates
+  per-replica-labeled samples through the router and the Prometheus
+  dump parses;
+- tools: ``dkt_top`` renders a snapshot without a socket and end to
+  end against a live server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ),
+)  # tools/dkt_top.py is a script, not a package
+
+from distkeras_tpu.obs import (
+    COLLECTOR,
+    Counter,
+    CounterGroup,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    TraceCollector,
+    TraceContext,
+    label_samples,
+    parse_prometheus,
+    render_prometheus,
+    request_spans,
+    stamp_error_trace,
+    start_span,
+    timeline_complete,
+)
+
+# ------------------------------------------------------- metric primitives
+
+
+def test_counter_and_gauge_samples():
+    c = Counter("x_total_things", labels={"k": "v"})
+    c.inc()
+    c.inc(4)
+    assert c.sample() == {
+        "name": "x_total_things", "kind": "counter",
+        "labels": {"k": "v"}, "value": 5,
+    }
+    g = Gauge("x_depth")
+    g.set(3.5)
+    assert g.sample()["value"] == 3.5
+    fn = Gauge("x_live", fn=lambda: 7)
+    assert fn.sample()["value"] == 7
+
+
+def test_gauge_callback_failure_never_crashes_a_scrape():
+    g = Gauge("x_bad", fn=lambda: 1 / 0)
+    assert g.sample()["value"] is None
+    assert render_prometheus([g.sample()]).strip().endswith("NaN")
+
+
+def test_histogram_buckets_quantiles_and_validation():
+    h = Histogram("lat_seconds", start=1e-3, factor=2.0, num_buckets=10)
+    for v in (0.0005, 0.003, 0.003, 0.1):
+        h.observe(v)
+    s = h.sample()
+    assert s["kind"] == "histogram"
+    assert s["count"] == 4 and s["sum"] == pytest.approx(0.1065)
+    # cumulative buckets end at +Inf with the full count
+    assert s["buckets"][-1][0] == "+Inf" and s["buckets"][-1][1] == 4
+    assert h.quantile(0.5) == pytest.approx(0.004)  # bucket upper bound
+    assert Histogram("e").quantile(0.5) is None  # empty = None
+    with pytest.raises(ValueError):
+        Histogram("bad", start=0.0)
+    with pytest.raises(ValueError):
+        Histogram("bad", factor=1.0)
+
+
+def test_counter_group_is_the_old_dict():
+    reg = MetricsRegistry()
+    grp = reg.group("sub", ("a", "b"))
+    grp["a"] += 2  # the hot-path idiom every component uses
+    grp.inc("b", 3)
+    assert dict(grp) == {"a": 2, "b": 3}
+    assert list(grp) == ["a", "b"] and len(grp) == 2
+    grp["a"] = 0  # the bench's counter reset
+    assert grp["a"] == 0
+    with pytest.raises(TypeError):
+        del grp["a"]
+    with pytest.raises(KeyError):
+        grp["missing"]
+    # the registry sees the same values under prefixed names
+    by_name = {s["name"]: s for s in reg.snapshot()}
+    assert by_name["sub_b"]["value"] == 3
+
+
+def test_registry_get_or_register_and_fresh_replacement():
+    reg = MetricsRegistry()
+    c1 = reg.counter("hits")
+    assert reg.counter("hits") is c1  # same (name, labels) = same metric
+    assert reg.counter("hits", labels={"a": "b"}) is not c1
+    with pytest.raises(ValueError):
+        reg.gauge("hits")  # kind mismatch is loud
+    c1.inc(5)
+    grp = reg.group("req", ("hits",), fresh=True)  # rebuilt component
+    assert grp["hits"] == 0  # starts at zero like the dict it replaced
+    c1.inc()  # the superseded object still works standalone
+    assert c1.value == 6
+    by_name = {
+        (s["name"], tuple(sorted(s["labels"].items()))): s["value"]
+        for s in reg.snapshot()
+        if s["kind"] == "counter"
+    }
+    assert by_name[("req_hits", ())] == 0  # registry shows the fresh one
+
+
+def test_label_samples_existing_keys_win():
+    out = label_samples(
+        [{"name": "n", "kind": "counter", "labels": {"replica": "own"},
+          "value": 1}],
+        replica="router", extra="x",
+    )
+    assert out[0]["labels"] == {"replica": "own", "extra": "x"}
+
+
+def test_prometheus_render_parse_roundtrip_with_escaping():
+    reg = MetricsRegistry()
+    reg.counter("req", labels={"path": 'a"b\\c\nd'}).inc(2)
+    reg.gauge("depth").set(1.5)
+    h = reg.histogram("lat_seconds", num_buckets=4)
+    h.observe(0.01)
+    text = render_prometheus(reg.snapshot())
+    series = parse_prometheus(text)
+    by_name = {}
+    for name, labels, value in series:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["req_total"][0] == ({"path": 'a"b\\c\nd'}, 2.0)
+    assert by_name["depth"][0][1] == 1.5
+    assert len(by_name["lat_seconds_bucket"]) == 5  # 4 bounds + +Inf
+    assert by_name["lat_seconds_count"][0][1] == 1.0
+
+
+@pytest.mark.parametrize("bad", [
+    "no_value_here",
+    'name{l="unterminated} 1',
+    "name{l=unquoted} 1",
+    "9starts_with_digit 1",
+    "sp ace{x} 1",
+])
+def test_prometheus_parser_rejects_malformed_lines(bad):
+    with pytest.raises(ValueError):
+        parse_prometheus(bad)
+
+
+# --------------------------------------------------------- trace primitives
+
+
+def test_trace_context_wire_roundtrip_and_child_linkage():
+    root = TraceContext.new(want_timeline=True)
+    child = TraceContext.from_wire(root.child().to_wire())
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+    assert child.want_timeline is True
+    bare = TraceContext.from_wire(TraceContext.new().child().to_wire())
+    assert bare.want_timeline is False
+
+
+@pytest.mark.parametrize("field", [None, "junk", 42, {}, {"span": "x"}])
+def test_trace_context_malformed_wire_field_is_untraced(field):
+    assert TraceContext.from_wire(field) is None
+
+
+def test_span_end_is_idempotent_and_records_once():
+    col = TraceCollector()
+    span = start_span("op", TraceContext.new(), collector=col, k=1)
+    rec = span.end(status="ok", terminal=True, extra=2)
+    assert span.end(status="different") is rec  # frozen
+    assert len(col.drain()) == 1
+    assert rec["name"] == "op" and rec["terminal"] is True
+    assert rec["attrs"] == {"k": 1, "extra": 2}
+    assert rec["duration_ms"] >= 0
+
+
+def test_collector_ring_bound_counts_drops_and_drains():
+    col = TraceCollector(capacity=3)
+    for i in range(5):
+        col.record({"trace_id": "t", "span_id": str(i)})
+    assert col.dropped == 2
+    assert [s["span_id"] for s in col.spans_for("t")] == ["2", "3", "4"]
+
+    class Sink:
+        def __init__(self):
+            self.recs = []
+
+        def log(self, **fields):
+            self.recs.append(fields)
+
+    sink = Sink()
+    assert col.drain_to(sink) == 3
+    events = [r["event"] for r in sink.recs]
+    assert events.count("trace_span") == 3
+    assert events[-1] == "trace_spans_dropped"
+    assert col.dropped == 0 and col.drain() == []
+
+
+def test_timeline_complete_means_exactly_one_terminal():
+    a = {"name": "x", "terminal": True}
+    b = {"name": "y"}
+    assert timeline_complete([b, a])
+    assert not timeline_complete([b])
+    assert not timeline_complete([a, dict(a)])
+
+
+def test_stamp_error_trace_prefers_exc_trace_then_header_id():
+    class E(Exception):
+        pass
+
+    e = E()
+    h = {}
+    stamp_error_trace(h, {"trace": {"id": "abc"}}, e)
+    assert h["trace"] == {"id": "abc"}
+    e.trace = {"id": "xyz", "timeline": []}
+    h2 = {}
+    stamp_error_trace(h2, {"trace": {"id": "abc"}}, e)
+    assert h2["trace"]["id"] == "xyz"
+    h3 = {}
+    stamp_error_trace(h3, {}, E())
+    assert "trace" not in h3
+
+
+def test_request_spans_reconstructs_the_phase_timeline():
+    from distkeras_tpu.serving.scheduler import ServeRequest
+
+    ctx = TraceContext.new(want_timeline=True)
+    req = ServeRequest(np.arange(1, 9), 4, trace=ctx)
+    now = time.monotonic()
+    req.created = now - 1.0
+    req.started = now - 0.8
+    req.prefill_finished = now - 0.5
+    req.finished = now
+    req.tokens = [1, 2, 3]
+    req.iterations = 3
+    req.prefill_chunks = 2
+    req.events = [
+        {"name": "serving.prefill_chunk", "t0": now - 0.8,
+         "t1": now - 0.65, "tokens": 4, "slot": 0},
+        {"name": "serving.prefill_chunk", "t0": now - 0.65,
+         "t1": now - 0.5, "tokens": 3, "slot": 0},
+        {"name": "scheduler.blame", "t0": now - 0.4, "t1": now - 0.3,
+         "slot": 0},
+    ]
+    col = TraceCollector()
+    spans = request_spans(req, ctx, collector=col)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert set(by_name) == {
+        "serving.queue", "serving.prefill", "serving.prefill_chunk",
+        "serving.decode", "scheduler.blame",
+    }
+    (queue,) = by_name["serving.queue"]
+    assert queue["parent_id"] == ctx.span_id
+    assert queue["duration_ms"] == pytest.approx(200, abs=60)
+    (prefill,) = by_name["serving.prefill"]
+    assert prefill["attrs"]["chunks"] == 2
+    for chunk in by_name["serving.prefill_chunk"]:
+        assert chunk["parent_id"] == prefill["span_id"]  # child spans
+    (decode,) = by_name["serving.decode"]
+    assert decode["attrs"] == {"iterations": 3, "tokens": 3}
+    assert by_name["scheduler.blame"][0]["attrs"]["slot"] == 0
+    assert len(col.drain()) == len(spans)  # also pushed to the collector
+    assert not any(s.get("terminal") for s in spans)  # client owns it
+
+
+# ----------------------------------------------------- live serving fixture
+
+
+@pytest.fixture(scope="module")
+def lm_model():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def served(lm_model):
+    """One engine + TCP server + client, shared module-wide (schema
+    pins and metrics-verb tests are read-only against it)."""
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+
+    eng = ServingEngine(lm_model, num_slots=2, prefill_chunk=4)
+    srv = ServingServer(eng).start()
+    cli = ServingClient("127.0.0.1", srv.port)
+    cli.generate(np.arange(1, 10, dtype=np.int32), 4)  # warm compile
+    yield eng, srv, cli
+    cli.close()
+    srv.shutdown()
+
+
+# ------------------------------------------------------ golden schema pins
+
+
+def test_health_reply_schema_pinned(served):
+    _, _, cli = served
+    h = cli.health()
+    # dashboards key on these: adding is fine, renaming/removing is a
+    # breaking change and must fail here first
+    expected = {
+        "ok": bool, "protocol": int, "max_frame_bytes": int,
+        "endpoint": list, "status": str, "restarts": int,
+        "max_restarts": int, "restart_budget_exhausted": bool,
+        "watchdog_trips": int, "quarantined_slots": int,
+        "queue_depth": int, "queue_capacity": int, "active_slots": int,
+        "prefilling_slots": int, "num_slots": int,
+        "heartbeat_age": (int, float), "served_by": list,
+    }
+    for key, typ in expected.items():
+        assert key in h, f"health reply lost key {key!r}"
+        assert isinstance(h[key], typ), (key, type(h[key]))
+    assert h["status"] in ("serving", "degraded", "draining")
+
+
+def test_stats_reply_schema_pinned(served):
+    _, _, cli = served
+    st = cli.stats()
+    counter_keys = {
+        "submitted", "rejected_overloaded", "completed",
+        "deadline_exceeded", "steps", "occupancy_sum",
+        "tokens_generated", "prefill_chunks", "prefill_tokens",
+        "step_failures", "blame_probes", "internal_errors",
+        "prefill_failures", "quarantines", "spec_windows",
+        "spec_tokens", "spec_draft_accepted",
+    }
+    for key in counter_keys:
+        assert isinstance(st[key], int), key
+    for key in ("queue_depth", "active_slots", "prefilling_slots",
+                "quarantined_slots", "num_slots", "open_connections"):
+        assert isinstance(st[key], int), key
+    assert isinstance(st["mean_batch_occupancy"], (int, float))
+    assert isinstance(st["prefix_cache"], dict)
+    assert isinstance(st["speculative"], dict)
+    assert isinstance(st["status"], str)
+
+
+def _check_sample_schema(samples):
+    assert samples, "metrics snapshot is empty"
+    for s in samples:
+        assert set(s) >= {"name", "kind", "labels"}, s
+        assert s["kind"] in ("counter", "gauge", "histogram"), s
+        # naming convention: snake_case, subsystem-prefixed
+        assert s["name"].replace("_", "a").isalnum(), s["name"]
+        assert s["name"].split("_", 1)[0] in (
+            "serving", "fleet", "training"
+        ), s["name"]
+        if s["kind"] == "histogram":
+            assert {"count", "sum", "buckets"} <= set(s), s
+            assert s["buckets"][-1][0] == "+Inf", s
+        else:
+            assert "value" in s, s
+        json.dumps(s)  # the verb ships these: must be JSON-able
+
+
+def test_metrics_verb_schema_and_prometheus_dump(served):
+    eng, _, cli = served
+    samples = cli.metrics()
+    _check_sample_schema(samples)
+    names = {s["name"] for s in samples}
+    # one representative per wired subsystem
+    assert "serving_scheduler_completed" in names
+    assert "serving_prefix_cache_hits" in names
+    assert "serving_engine_restarts" in names
+    assert "serving_server_open_connections" in names
+    assert "serving_request_total_seconds" in names
+    # counters actually count: the warm generate completed
+    by_name = {s["name"]: s for s in samples}
+    assert by_name["serving_scheduler_completed"]["value"] >= 1
+    assert by_name["serving_request_total_seconds"]["count"] >= 1
+    # the text exposition dump parses (the checked claim)
+    series = parse_prometheus(cli.metrics(prometheus=True))
+    assert {n for n, _, _ in series} >= {
+        "serving_scheduler_completed_total",
+        "serving_request_total_seconds_bucket",
+    }
+
+
+def test_training_ps_metrics_schema():
+    from distkeras_tpu.parameter_servers import ParameterServer
+
+    ps = ParameterServer({"w": np.zeros(3)})
+    ps.pull(worker_id=0)
+    ps.commit({"w": np.ones(3)}, commit_id=(0, 0))
+    ps.commit({"w": np.ones(3)}, commit_id=(0, 0))  # deduped replay
+    samples = ps.metrics_snapshot()
+    _check_sample_schema(samples)
+    by_name = {s["name"]: s for s in samples}
+    assert by_name["training_ps_pulls"]["value"] == 1
+    assert by_name["training_ps_commits"]["value"] == 2
+    assert by_name["training_ps_updates"]["value"] == 1  # dedup held
+    assert by_name["training_ps_duplicates"]["value"] == 1
+    parse_prometheus(render_prometheus(samples))
+
+
+# ------------------------------------------------- end-to-end trace + fleet
+
+
+def test_traced_generate_single_server_timeline(served, lm_model):
+    _, _, cli = served
+    prompt = np.arange(1, 12, dtype=np.int32)
+    plain = cli.generate(prompt, 5)
+    traced = cli.generate(prompt, 5, trace=True)
+    assert np.array_equal(plain, traced)  # tracing never changes output
+    tl = cli.last_trace
+    names = [s["name"] for s in tl["spans"]]
+    assert {"client.request", "server.generate", "serving.queue",
+            "serving.prefill", "serving.decode"} <= set(names)
+    assert timeline_complete(tl["spans"])
+    # one tree: every span's trace id matches, every parent resolves
+    ids = {s["span_id"] for s in tl["spans"]}
+    assert len({s["trace_id"] for s in tl["spans"]}) == 1
+    roots = [s for s in tl["spans"] if s["parent_id"] is None]
+    assert [s["name"] for s in roots] == ["client.request"]
+    for s in tl["spans"]:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in ids, s
+    # the terminal span is the client's, with the outcome
+    (term,) = [s for s in tl["spans"] if s.get("terminal")]
+    assert term["name"] == "client.request"
+    assert term["status"] == "ok"
+
+
+def test_untraced_request_reply_carries_no_trace(served):
+    _, srv, _ = served
+    from distkeras_tpu.serving import ServingClient
+
+    with ServingClient("127.0.0.1", srv.port) as c:
+        c.generate(np.arange(1, 8, dtype=np.int32), 3)
+        assert c.last_trace is None
+
+
+def test_typed_error_reply_is_joinable_by_trace_id(served):
+    from distkeras_tpu.serving.scheduler import DeadlineExceededError
+
+    _, _, cli = served
+    with pytest.raises(DeadlineExceededError) as ei:
+        cli.generate(
+            np.arange(1, 8, dtype=np.int32), 4, deadline_ms=0.0,
+            trace=True,
+        )
+    assert ei.value.trace_id == cli.last_trace["trace_id"]
+    assert timeline_complete(cli.last_trace["spans"])
+    (term,) = [s for s in cli.last_trace["spans"] if s.get("terminal")]
+    assert term["status"] == "deadline_exceeded"
+    # the server's span came back on the ERROR reply too
+    assert "server.generate" in [
+        s["name"] for s in cli.last_trace["spans"]
+    ]
+
+
+def test_fleet_routed_trace_and_metrics_aggregate(lm_model):
+    """The acceptance pin: a routed generate through a REAL 2-replica
+    fleet with trace=True returns >= 5 spans (client, router decision,
+    server dispatch, queue/prefill, decode) forming one complete
+    timeline, and the router's ``metrics`` verb returns per-replica-
+    labeled samples whose Prometheus dump parses."""
+    from distkeras_tpu.serving import FleetController
+
+    ctl = FleetController(lm_model, replicas=2, num_slots=2).start()
+    try:
+        with ctl.client() as c:
+            prompt = np.arange(1, 14, dtype=np.int32)
+            out = c.generate(prompt, 5, trace=True)
+            assert out.size == prompt.size + 5
+            tl = c.last_trace
+            names = [s["name"] for s in tl["spans"]]
+            assert len(names) >= 5, names
+            assert {"client.request", "router.route", "server.generate",
+                    "serving.queue", "serving.decode"} <= set(names)
+            assert timeline_complete(tl["spans"])
+            # the router span records the routing decision
+            (route,) = [s for s in tl["spans"]
+                        if s["name"] == "router.route"]
+            attrs = route["attrs"]
+            assert attrs["how"] in ("affinity", "spill", "least_loaded")
+            assert attrs["replica"].startswith("127.0.0.1:")
+            assert attrs["failovers"] == 0
+            # linkage: router parents the server span, client the router
+            by_name = {s["name"]: s for s in tl["spans"]}
+            assert by_name["server.generate"]["parent_id"] == (
+                route["span_id"]
+            )
+            assert route["parent_id"] == (
+                by_name["client.request"]["span_id"]
+            )
+            samples = c.metrics()
+            _check_sample_schema(samples)
+            labels = {s["labels"].get("replica") for s in samples}
+            assert "router" in labels
+            assert len(labels) == 3  # router + both replicas
+            names = {s["name"] for s in samples}
+            assert "fleet_router_forwards" in names
+            assert "fleet_router_forward_seconds" in names
+            parse_prometheus(c.metrics(prometheus=True))
+    finally:
+        ctl.stop()
+
+
+def test_traced_spans_drain_to_jsonl_sink(lm_model, tmp_path):
+    from distkeras_tpu.serving import ServingEngine
+    from distkeras_tpu.utils.profiling import read_metrics
+
+    path = str(tmp_path / "m.jsonl")
+    eng = ServingEngine(
+        lm_model, num_slots=2, prefill_chunk=4, metrics_path=path,
+    ).start()
+    try:
+        # pollute the PROCESS-WIDE collector: an in-process sibling's
+        # spans must never leak into this engine's JSONL book
+        COLLECTOR.record({"trace_id": "someone-else", "span_id": "x",
+                          "name": "other.engine"})
+        ctx = TraceContext.new(want_timeline=True)
+        req = eng.submit(np.arange(1, 8, dtype=np.int32), 3, trace=ctx)
+        eng.wait(req)
+        from distkeras_tpu.obs import request_spans as build
+
+        build(req, ctx, collector=eng.trace_collector)
+        eng.drain_traces()
+    finally:
+        eng.stop()
+    spans = [
+        r for r in read_metrics(path) if r["event"] == "trace_span"
+    ]
+    assert {s["name"] for s in spans} >= {
+        "serving.queue", "serving.decode"
+    }
+    assert all(s["trace_id"] == ctx.trace_id for s in spans)
+
+
+# ------------------------------------------------------------------- tools
+
+
+def test_dkt_top_format_table_is_socketless():
+    from dkt_top import format_table
+
+    reg = MetricsRegistry()
+    reg.counter("serving_scheduler_completed").inc(7)
+    reg.gauge("serving_scheduler_queue_depth").set(2)
+    h = reg.histogram("serving_request_total_seconds", num_buckets=6)
+    h.observe(0.02)
+    samples = label_samples(reg.snapshot(), replica="127.0.0.1:9000")
+    samples += label_samples(reg.snapshot(), replica="router")
+    out = format_table(samples)
+    assert "== 127.0.0.1:9000 " in out and "== router " in out
+    assert "serving_scheduler_completed" in out and "7" in out
+    assert "p99" in out  # histogram quantile line
+
+
+def test_dkt_top_once_against_live_server(served, capsys):
+    import dkt_top
+
+    _, srv, _ = served
+    assert dkt_top.main(
+        ["127.0.0.1", str(srv.port), "--once"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "serving_scheduler_completed" in out
+    assert dkt_top.main(
+        ["127.0.0.1", str(srv.port), "--once", "--prometheus"]
+    ) == 0
+    parse_prometheus(capsys.readouterr().out)

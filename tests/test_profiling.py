@@ -49,6 +49,33 @@ def test_metrics_logger_thread_safe(tmp_path):
     assert len(records) == 200
 
 
+def test_read_metrics_tolerates_truncated_final_line(tmp_path):
+    """A crash mid-append leaves a torn last line; the reader must
+    salvage every whole record before it instead of losing the file
+    to a JSONDecodeError (strict=True restores the raise). Garbage in
+    the MIDDLE is still loud — that is corruption, not a torn tail."""
+    import json
+
+    import pytest
+
+    path = str(tmp_path / "m.jsonl")
+    log = MetricsLogger(path)
+    log.log(event="a", x=1)
+    log.log(event="b", x=2)
+    with open(path, "a") as f:
+        f.write('{"ts": 3, "event": "c", "x"')  # crash mid-append
+    records = read_metrics(path)
+    assert [r["event"] for r in records] == ["a", "b"]
+    with pytest.raises(json.JSONDecodeError):
+        read_metrics(path, strict=True)
+    # mid-file garbage is NOT tolerated
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write('{"event": "a"}\n{torn\n{"event": "b"}\n')
+    with pytest.raises(json.JSONDecodeError):
+        read_metrics(bad)
+
+
 def test_history_throughput():
     h = TrainingHistory()
     h.record_training_start()

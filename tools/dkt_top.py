@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""dkt_top — live terminal view over the ``metrics`` DKT1 verb.
+
+Point it at a ``ServingServer`` (one engine's book) or a
+``FleetRouter`` (the per-replica-labeled fleet aggregate) and it polls
+the typed-metrics registry snapshot every ``--interval`` seconds,
+rendering counters, gauges, and latency-histogram quantiles grouped by
+replica — the "where is the fleet spending its time" answer without
+grepping four logs::
+
+    python tools/dkt_top.py 127.0.0.1 9000
+    python tools/dkt_top.py 127.0.0.1 9000 --once        # one snapshot
+    python tools/dkt_top.py 127.0.0.1 9000 --prometheus --once  # raw dump
+    python tools/dkt_top.py 127.0.0.1 9000 --prometheus  # live raw dump
+
+No curses: plain ANSI clear-and-redraw, so it works in any terminal
+(and in a pipe with ``--once``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_value(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        return f"{v:,.4g}"
+    return f"{v:,}"
+
+
+def _hist_line(s) -> str:
+    """count / mean / p50 / p99 out of the cumulative bucket samples
+    (bucket-resolution quantiles: the upper bound of the bucket that
+    holds the target observation)."""
+    count, total = s["count"], s["sum"]
+    if not count:
+        return "count=0"
+
+    def q(frac):
+        target = max(1, int(frac * count))
+        prev = 0
+        for le, cum in s["buckets"]:
+            if cum >= target and cum > prev:
+                return "inf" if le == "+Inf" else f"{float(le):.4g}"
+            prev = cum
+        return "inf"
+
+    return (
+        f"count={count:,} mean={total / count:.4g} "
+        f"p50={q(0.5)} p99={q(0.99)}"
+    )
+
+
+def format_table(samples, width: int = 78) -> str:
+    """Render one registry snapshot (the ``metrics`` verb payload) as
+    a replica-grouped table. Pure function of the samples — the unit
+    tests drive it without a socket."""
+    groups: dict[str, list] = {}
+    for s in samples:
+        labels = dict(s.get("labels") or {})
+        replica = labels.pop("replica", "") or "(local)"
+        groups.setdefault(replica, []).append((s, labels))
+    lines = []
+    for replica in sorted(groups):
+        lines.append(f"== {replica} ".ljust(width, "="))
+        rows = []
+        for s, labels in sorted(
+            groups[replica], key=lambda p: p[0]["name"]
+        ):
+            name = s["name"]
+            if labels:
+                name += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(labels.items())
+                ) + "}"
+            if s["kind"] == "histogram":
+                rows.append((name, "H", _hist_line(s)))
+            else:
+                rows.append(
+                    (name, "C" if s["kind"] == "counter" else "G",
+                     _fmt_value(s["value"]))
+                )
+        namew = max((len(n) for n, _, _ in rows), default=0)
+        for name, kind, val in rows:
+            lines.append(f"  {name.ljust(namew)}  {kind}  {val}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("host")
+    ap.add_argument("port", type=int)
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between polls")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen clear)")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="print the text exposition dump instead of "
+                         "the table")
+    args = ap.parse_args(argv)
+
+    from distkeras_tpu.serving import ServingClient
+
+    with ServingClient(args.host, args.port, timeout=10.0) as cli:
+        while True:
+            if args.prometheus:
+                out = cli.metrics(prometheus=True)
+            else:
+                out = format_table(cli.metrics())
+            for gap in cli.last_metrics_unreachable:
+                # a fleet scrape that skipped a replica is NOT complete
+                # — show the hole, never a silently shrunken fleet
+                ep = gap.get("endpoint", ["?", "?"])
+                out += (
+                    f"\n!! replica {ep[0]}:{ep[1]} UNREACHABLE for this "
+                    f"scrape: {gap.get('error')}"
+                )
+            if args.once:
+                print(out)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            stamp = time.strftime("%H:%M:%S")
+            print(f"dkt_top {args.host}:{args.port}  {stamp}  "
+                  f"(interval {args.interval}s, ctrl-c to quit)")
+            print(out)
+            sys.stdout.flush()
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,12 @@ The acceptance bar it asserts (and prints as JSON):
   to its solo reference decode of the SAME quantized bundle the
   replicas booted from, failovers and upgrades notwithstanding;
 - EXACT accounting — every attempt resolves exactly once (completed
-  or typed), so a rollover can neither drop nor duplicate a request.
+  or typed), so a rollover can neither drop nor duplicate a request;
+- ZERO incomplete traces — every attempt runs ``trace=True`` and must
+  assemble a timeline with EXACTLY ONE terminal span, through the
+  kill -9, failover resends, and the rollover: a mid-request replica
+  death still yields one complete trace ending in the client's
+  terminal span (the router's span records the failover hop).
 
 Topology: replicas are REAL subprocesses (``--replica`` runs one)
 booted from a shared quantized serving bundle, each arming its OWN
@@ -210,6 +215,8 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         .arm("net.send", action="truncate", times=None, probability=0.004)
     )
 
+    from distkeras_tpu.obs import timeline_complete
+
     lock = threading.Lock()
     summary = {
         "replicas": replicas,
@@ -220,9 +227,34 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         "untyped_errors": 0,
         "untyped_samples": [],
         "corrupt_outputs": 0,
+        "trace_attempts": 0,
+        "trace_incomplete": 0,
+        "trace_incomplete_samples": [],
+        "traced_failover_hops": 0,  # traces whose router span moved on
     }
     stop_evt = threading.Event()
     control_err = []
+
+    def check_trace(c):
+        """Every attempt — completed, typed-error, or failed-over —
+        must have assembled a timeline with exactly one terminal span;
+        router spans that record failover hops are counted as direct
+        evidence the kill was traced through."""
+        tl = c.last_trace
+        with lock:
+            summary["trace_attempts"] += 1
+            if tl is None or not timeline_complete(tl["spans"]):
+                summary["trace_incomplete"] += 1
+                if len(summary["trace_incomplete_samples"]) < 5:
+                    summary["trace_incomplete_samples"].append(
+                        None if tl is None
+                        else [s["name"] for s in tl["spans"]]
+                    )
+                return
+            for s in tl["spans"]:
+                if (s["name"] == "router.route"
+                        and (s.get("attrs") or {}).get("failovers")):
+                    summary["traced_failover_hops"] += 1
 
     def client_loop(ci):
         policy = RetryPolicy(
@@ -237,26 +269,30 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
                 pi = int(crng.integers(0, len(prompts)))
                 with lock:
                     summary["attempts"] += 1
+                c.last_trace = None  # fresh per attempt
                 try:
-                    out = c.generate(prompts[pi], max_new)
+                    out = c.generate(prompts[pi], max_new, trace=True)
                 except ServingError as e:
                     code = getattr(e, "code", type(e).__name__)
                     with lock:
                         summary["typed_errors"][code] = (
                             summary["typed_errors"].get(code, 0) + 1
                         )
+                    check_trace(c)
                     continue
                 except Exception as e:  # noqa: BLE001 — the finding
                     with lock:
                         summary["untyped_errors"] += 1
                         if len(summary["untyped_samples"]) < 5:
                             summary["untyped_samples"].append(repr(e))
+                    check_trace(c)
                     continue
                 with lock:
                     if np.array_equal(out, refs[pi]):
                         summary["completed"] += 1
                     else:
                         summary["corrupt_outputs"] += 1
+                check_trace(c)
 
     def control_loop():
         """warm traffic → kill -9 a loaded replica → reap → rolling
@@ -337,6 +373,8 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         and summary["untyped_errors"] == 0
         and summary["corrupt_outputs"] == 0
         and summary["accounting_exact"]
+        and summary["trace_incomplete"] == 0
+        and summary["trace_attempts"] > 0
         and not control_err
         and len(summary.get("rollover", {}).get("replaced", ())) == (
             replicas - 1  # the kill -9 victim is reaped, not upgraded

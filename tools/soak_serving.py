@@ -13,7 +13,12 @@ The acceptance bar it asserts (and prints as JSON):
   steps and supervisor restarts surface as ``internal``);
 - ZERO corrupt outputs — every successful generate is token-identical
   to its solo ``CachedSequenceGenerator`` reference, restarts and
-  quarantines notwithstanding.
+  quarantines notwithstanding;
+- ZERO incomplete traces — every client request runs ``trace=True``,
+  and every attempt (completed or typed-error alike) must assemble a
+  timeline with EXACTLY ONE terminal span. "0 hung / 0 untyped" stops
+  being a client-side claim: the instrumentation itself must account
+  for where every request ended.
 
 The fault mix is seeded (``FaultPlan`` draws probabilistic seams from
 its own RNG), so a failing soak replays exactly with the same seed::
@@ -99,6 +104,8 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         .arm("net.send", action="truncate", times=None, probability=0.01)
     )
 
+    from distkeras_tpu.obs import timeline_complete
+
     lock = threading.Lock()
     summary = {
         "completed": 0,
@@ -106,8 +113,25 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         "untyped_errors": 0,
         "untyped_samples": [],
         "corrupt_outputs": 0,
+        "trace_attempts": 0,
+        "trace_incomplete": 0,
+        "trace_incomplete_samples": [],
     }
     stop_at = time.monotonic() + float(duration)
+
+    def check_trace(c):
+        """Every attempt — completed OR typed-error — must have
+        assembled a timeline with exactly one terminal span."""
+        tl = c.last_trace
+        with lock:
+            summary["trace_attempts"] += 1
+            if tl is None or not timeline_complete(tl["spans"]):
+                summary["trace_incomplete"] += 1
+                if len(summary["trace_incomplete_samples"]) < 5:
+                    summary["trace_incomplete_samples"].append(
+                        None if tl is None
+                        else [s["name"] for s in tl["spans"]]
+                    )
 
     def client_loop(ci):
         policy = RetryPolicy(
@@ -118,26 +142,30 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         with ServingClient("127.0.0.1", server.port, retry=policy) as c:
             while time.monotonic() < stop_at:
                 pi = int(crng.integers(0, len(prompts)))
+                c.last_trace = None  # fresh per attempt
                 try:
-                    out = c.generate(prompts[pi], max_new)
+                    out = c.generate(prompts[pi], max_new, trace=True)
                 except ServingError as e:
                     code = getattr(e, "code", type(e).__name__)
                     with lock:
                         summary["typed_errors"][code] = (
                             summary["typed_errors"].get(code, 0) + 1
                         )
+                    check_trace(c)
                     continue
                 except Exception as e:  # noqa: BLE001 — the finding
                     with lock:
                         summary["untyped_errors"] += 1
                         if len(summary["untyped_samples"]) < 5:
                             summary["untyped_samples"].append(repr(e))
+                    check_trace(c)
                     continue
                 with lock:
                     if np.array_equal(out, refs[pi]):
                         summary["completed"] += 1
                     else:
                         summary["corrupt_outputs"] += 1
+                check_trace(c)
 
     threads = [
         threading.Thread(target=client_loop, args=(i,), daemon=True)
@@ -180,6 +208,8 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         hung == 0
         and summary["untyped_errors"] == 0
         and summary["corrupt_outputs"] == 0
+        and summary["trace_incomplete"] == 0
+        and summary["trace_attempts"] > 0
     )
     return summary
 
