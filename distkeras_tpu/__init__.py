@@ -51,7 +51,12 @@ from distkeras_tpu.evaluators import (
 )
 from distkeras_tpu.faults import FaultPlan, InjectedFault
 from distkeras_tpu.networking import RetryPolicy
-from distkeras_tpu.obs import MetricsRegistry, TraceContext
+from distkeras_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    SloSpec,
+    TraceContext,
+)
 from distkeras_tpu.parameter_servers import (
     CommitNotAcknowledgedError,
     ParameterServerError,

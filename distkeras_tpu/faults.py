@@ -112,6 +112,50 @@ class InjectedFault(RuntimeError):
 _ACTIVE: "FaultPlan | None" = None
 _ACTIVE_LOCK = threading.Lock()
 
+# armed-fire observers (the flight recorder's tap): called with
+# (site, action, ctx) AFTER a seam matched and BEFORE it acts, so a
+# ``raise`` seam's firing is on the record before the exception that
+# kills the component it hit. Observers run only on the ARMED path —
+# the disarmed fast path in :func:`fire` never reads this list.
+_OBSERVERS: list = []
+_OBSERVERS_LOCK = threading.Lock()
+
+
+def add_observer(fn) -> None:
+    """Register ``fn(site, action, ctx)`` to be called on every armed
+    seam firing (e.g. ``FlightRecorder.fault_observer``). Observers
+    must not raise; failures are swallowed — observability must never
+    change what an injected fault does."""
+    with _OBSERVERS_LOCK:
+        if fn not in _OBSERVERS:
+            _OBSERVERS.append(fn)
+
+
+def remove_observer(fn) -> None:
+    with _OBSERVERS_LOCK:
+        if fn in _OBSERVERS:
+            _OBSERVERS.remove(fn)
+
+
+def _notify(site: str, action: str, ctx: dict) -> None:
+    with _OBSERVERS_LOCK:
+        observers = list(_OBSERVERS)
+    for fn in observers:
+        try:
+            fn(site, action, ctx)
+        except Exception:  # noqa: BLE001 — observers are best-effort
+            pass
+
+
+def describe_active() -> list | None:
+    """JSON-able arming state of the active plan (None when disarmed)
+    — what a post-mortem bundle records so "was chaos armed, and what
+    had fired" is answerable from the bundle alone."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan.describe()
+
 
 def fire(site: str, **ctx) -> str | None:
     """The seam. Disarmed: one global read, one ``None`` check, return.
@@ -217,7 +261,9 @@ class FaultPlan:
             seam.fired += 1
             action, exc, delay = seam.action, seam.exc, seam.delay
         # act OUTSIDE the lock: a delay seam must not serialize every
-        # other seam behind its sleep
+        # other seam behind its sleep. Observers see the firing FIRST,
+        # so a raise lands in the flight recorder before it propagates.
+        _notify(site, action, ctx)
         if action == "raise":
             raise exc if exc is not None else InjectedFault(
                 f"injected fault at {site}"
@@ -255,3 +301,21 @@ class FaultPlan:
                 else [s for lst in self._seams.values() for s in lst]
             )
             return sum(s.fired for s in seams)
+
+    def describe(self) -> list:
+        """JSON-able arming state: one row per armed seam with its
+        gates and fire count — the ``fault_seams`` section of a
+        post-mortem bundle."""
+        with self._lock:
+            return [
+                {
+                    "site": s.site,
+                    "action": s.action,
+                    "times": s.times,
+                    "after": s.after,
+                    "probability": s.probability,
+                    "fired": s.fired,
+                }
+                for lst in self._seams.values()
+                for s in lst
+            ]

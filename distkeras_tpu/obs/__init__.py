@@ -1,6 +1,6 @@
-"""Observability: end-to-end request tracing + the typed metrics registry.
+"""Observability: tracing + typed metrics + the failure-path black box.
 
-Two pillars, wired through every tier of the stack (client, fleet
+Four pillars, wired through every tier of the stack (client, fleet
 router, serving server, scheduler, engine, prefix cache, parameter
 servers):
 
@@ -15,8 +15,34 @@ servers):
   the ``counters["key"] += 1`` call sites working verbatim); exposed
   by the ``metrics`` DKT1 verb and renderable as the Prometheus text
   exposition format (``render_prometheus`` / ``parse_prometheus``).
+- ``recorder``: the always-on :class:`FlightRecorder` ring of
+  component events (scheduler iterations, blame/quarantine, watchdog
+  trips, router ejections, PS replication/promotion, armed fault-seam
+  firings) plus :func:`dump_postmortem` — the one bundle writer every
+  self-healing seam dumps through on a terminal event, retrieved by
+  the ``postmortem`` DKT1 verb and rendered by
+  ``tools/dkt_postmortem.py``.
+- ``slo``: declarative :class:`SloSpec` objectives evaluated from the
+  registries (:func:`evaluate_slos` / :class:`SloEvaluator`); verdicts
+  (``ok``/``warn``/``breach``) ride the ``health`` verb, breaches land
+  in the recorder and a registry counter, and the fleet health sweep
+  can eject on sustained breach.
 """
 
+from distkeras_tpu.obs.recorder import (
+    POSTMORTEM_SCHEMA,
+    FlightRecorder,
+    build_postmortem,
+    dump_postmortem,
+    latest_postmortem,
+)
+from distkeras_tpu.obs.slo import (
+    SloEvaluator,
+    SloSpec,
+    default_serving_slos,
+    default_training_slos,
+    evaluate_slos,
+)
 from distkeras_tpu.obs.metrics import (
     Counter,
     CounterGroup,
@@ -42,14 +68,24 @@ from distkeras_tpu.obs.tracing import (
 
 __all__ = [
     "COLLECTOR",
+    "POSTMORTEM_SCHEMA",
     "Counter",
     "CounterGroup",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "SloEvaluator",
+    "SloSpec",
     "Span",
     "TraceCollector",
     "TraceContext",
+    "build_postmortem",
+    "default_serving_slos",
+    "default_training_slos",
+    "dump_postmortem",
+    "evaluate_slos",
+    "latest_postmortem",
     "label_samples",
     "new_id",
     "parse_prometheus",

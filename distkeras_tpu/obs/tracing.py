@@ -171,11 +171,16 @@ class TraceCollector:
         self._spans: deque = deque(maxlen=int(capacity))
         self._lock = threading.Lock()
         self.dropped = 0
+        # lifetime total: ``dropped`` is read-and-reset by the JSONL
+        # drain, so a scrape-time gauge over it would zero whenever the
+        # sink flushed — this one only grows
+        self.dropped_total = 0
 
     def record(self, span: dict) -> None:
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
+                self.dropped_total += 1
             self._spans.append(span)
 
     def drain(self) -> list[dict]:

@@ -290,13 +290,43 @@ class ParameterServer:
         # the serving tier exposes over its ``metrics`` verb. Per-PS
         # registry: multi-PS processes (tests, standby pairs) keep
         # separate books. ``metrics_snapshot()`` is the read face.
-        from distkeras_tpu.obs import MetricsRegistry
+        from distkeras_tpu.obs import FlightRecorder, MetricsRegistry
 
         self.registry = MetricsRegistry()
         self._metrics = self.registry.group(
             "training_ps",
             ("pulls", "commits", "commits_refused_no_replica"),
         )
+        # the training tier's black box: commit-stream positions,
+        # replication attach/detach, gate refusals — always-on, dumped
+        # by the socket tier's promotion/stand-down post-mortems
+        self.recorder = FlightRecorder(capacity=1024)
+        self.recorder.register_gauges(self.registry, "training")
+        # per-worker commit cadence: one aggregate histogram (register
+        # it FIRST, so name-indexed consumers — the SLO evaluator —
+        # see the fleet-wide one) plus a labeled histogram per worker,
+        # and the straggler gauge = max/median of per-worker mean
+        # intervals (the DOWNPOUR/AEASGD lag detector)
+        self._interval_hist = self.registry.histogram(
+            "training_ps_commit_interval_seconds", start=1e-3,
+        )
+        self._interval_hists = {}  # wid -> labeled Histogram
+        self._commit_last = {}  # wid -> last commit monotonic instant
+        self._commit_stats = {}  # wid -> [count, interval_sum]
+
+        def _straggler():
+            means = [
+                s[1] / s[0]
+                for s in list(self._commit_stats.values())
+                if s[0] > 0
+            ]
+            if len(means) < 2:
+                return None  # one worker has no one to straggle behind
+            means.sort()
+            median = means[len(means) // 2]
+            return means[-1] / max(median, 1e-9)
+
+        self.registry.gauge("training_ps_straggler", fn=_straggler)
         self.registry.gauge(
             "training_ps_updates",
             fn=lambda: self._meta.get("num_updates", 0),
@@ -403,6 +433,11 @@ class ParameterServer:
                 # re-attach (which re-arms the gate and, via its fresh
                 # snapshot, covers everything applied meanwhile)
                 self._metrics.inc("commits_refused_no_replica")
+                self.recorder.record(
+                    "ps.gate_refused",
+                    replicas=len(self._replicas),
+                    required=self.min_replicas,
+                )
                 raise ParameterServerError(
                     "no_replica",
                     detail=f"{len(self._replicas)} of "
@@ -410,7 +445,29 @@ class ParameterServer:
                 )
             if commit_id is not None:
                 wid, seq = commit_id
-                self._activity[wid] = time.monotonic()
+                now_m = time.monotonic()
+                self._activity[wid] = now_m
+                if _via == "client":
+                    # per-worker commit cadence (straggler detection):
+                    # interval since this worker's LAST commit, observed
+                    # fleet-wide and per-worker (deduped replays count —
+                    # a resend is still worker activity)
+                    last = self._commit_last.get(wid)
+                    if last is not None:
+                        dt = now_m - last
+                        self._interval_hist.observe(dt)
+                        h = self._interval_hists.get(wid)
+                        if h is None:
+                            h = self.registry.histogram(
+                                "training_ps_commit_interval_seconds",
+                                labels={"worker": str(wid)}, start=1e-3,
+                            )
+                            self._interval_hists[wid] = h
+                        h.observe(dt)
+                        st = self._commit_stats.setdefault(wid, [0, 0.0])
+                        st[0] += 1
+                        st[1] += dt
+                    self._commit_last[wid] = now_m
                 if local_snap is not None:
                     self._worker_snaps[wid] = local_snap
                 if seq <= self._seen_seq.get(wid, -1):
@@ -424,6 +481,16 @@ class ParameterServer:
                 self._seen_seq[wid] = seq
             self._center, self._meta = type(self).commit_rule(
                 self._center, self._meta, delta, tag
+            )
+            # the commit-stream position: a promoted standby's bundle
+            # shows exactly how far its stream reached before failover
+            self.recorder.record(
+                "ps.commit",
+                position=self._meta.get("num_updates", 0),
+                commit_id=(
+                    None if commit_id is None else list(commit_id)
+                ),
+                via=_via,
             )
             if self._replicas:
                 self._forward_to_replicas(delta, tag, commit_id, local_snap)
@@ -498,6 +565,11 @@ class ParameterServer:
             if announce is not None:
                 announce(*snap)
             self._replicas.append(sink)
+            self.recorder.record(
+                "ps.attach",
+                replicas=len(self._replicas),
+                position=self._meta.get("num_updates", 0),
+            )
             # an attach restores durability: re-arm the configured gate
             # (no-op unless require_replicas was ever called)
             self.min_replicas = self._min_replicas_goal
@@ -551,6 +623,11 @@ class ParameterServer:
         for sink in dead:
             self._replicas.remove(sink)
             self.replication_drops += 1
+            self.recorder.record(
+                "ps.detach",
+                replicas=len(self._replicas),
+                position=self._meta.get("num_updates", 0),
+            )
             try:
                 sink.close()
             except Exception:
@@ -736,6 +813,9 @@ class SocketParameterServer:
       frame {"meta"} + {center, workers}; the connection then becomes the
       replication channel — the primary streams every applied commit and
       the standby acks each with b"k";
+    - b"m": metrics scrape -> b"k" + frame {"metrics", "role", "port"}
+      (the typed-registry snapshot; served in BOTH roles so a standby
+      is observable before it promotes — ``dkt_top --ps`` polls this);
     - b"s": stop the server;
     - anything else: b"e" + ``unknown_action`` frame and the connection
       closes — the old server silently ignored unknown bytes and re-read
@@ -763,8 +843,17 @@ class SocketParameterServer:
 
     def __init__(self, ps: ParameterServer, host="0.0.0.0", port=0,
                  standby_of=None, auto_promote=True, attach_retry=None,
-                 on_promote=None):
+                 on_promote=None, postmortem_dir=None):
+        """``postmortem_dir``: where PROMOTION and STAND-DOWN — the
+        training tier's terminal events — dump a post-mortem bundle
+        (the wrapped PS's flight-recorder ring: commit-stream
+        positions, replication attach/detach, armed seam firings —
+        plus its metrics snapshot). None keeps the latest bundle in
+        memory only (``last_postmortem``)."""
         self.ps = ps
+        self.postmortem_dir = postmortem_dir
+        self.last_postmortem = None
+        self.last_postmortem_path = None
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -809,6 +898,9 @@ class SocketParameterServer:
     def start(self):
         self.ps.start()
         self._running.set()
+        # armed ps.*/net.* seam firings land in the PS ring, so a
+        # promotion bundle names the chaos that preceded the failover
+        faults.add_observer(self.ps.recorder.fault_observer)
         if self.role == "standby":
             # synchronous first sync: when start() returns, the standby is
             # commit-identical to the primary and following its stream
@@ -839,6 +931,11 @@ class SocketParameterServer:
             tree = deserialize_params(blob)
             self.ps.restore_snapshot(tree["center"], header.get("meta", {}))
             self.ps.restore_worker_snapshots(tree.get("workers", {}))
+            self.ps.recorder.record(
+                "ps.sync",
+                primary=f"{host}:{port}",
+                position=self.ps.num_updates,
+            )
         except BaseException:
             try:
                 conn.close()
@@ -892,6 +989,10 @@ class SocketParameterServer:
             try:
                 conn = self._attach_retry.call(self._attach_to_primary)
                 self.reattaches += 1
+                self.ps.recorder.record(
+                    "ps.reattach", count=self.reattaches,
+                    position=self.ps.num_updates,
+                )
                 logger.warning(
                     "standby on port %d re-attached to primary %s "
                     "(re-sync #%d)",
@@ -911,6 +1012,13 @@ class SocketParameterServer:
                 "but the primary still answers — standing down (not "
                 "promoting; a split brain would lose commits)",
                 self.port,
+            )
+            self.ps.recorder.record(
+                "ps.stand_down", position=self.ps.num_updates,
+            )
+            self.dump_postmortem(
+                "stand_down",
+                detail={"primary": list(self.standby_of)},
             )
             return None
         if self._running.is_set() and self.auto_promote:
@@ -933,6 +1041,15 @@ class SocketParameterServer:
         # topology would refuse every commit forever. Serve degraded; a
         # rejoining standby's attach re-arms the gate.
         self.ps.relax_replication_requirement()
+        self.ps.recorder.record(
+            "ps.promoted", reason=reason,
+            position=self.ps.num_updates,
+            reattaches=self.reattaches,
+        )
+        # promotion IS the training tier's terminal event: the old
+        # primary is dead and this ring holds the last evidence of how
+        # far its stream reached — dump before serving a single commit
+        self.dump_postmortem("promotion", detail={"reason": reason})
         logger.warning(
             "parameter-server standby on port %d promoted to primary (%s)",
             self.port, reason,
@@ -943,6 +1060,47 @@ class SocketParameterServer:
                 cb(self)
             except Exception:
                 logger.exception("on_promote callback failed")
+
+    def dump_postmortem(self, reason: str, detail=None):
+        """The training tier's post-mortem bundle (shared
+        ``obs.dump_postmortem`` schema): the wrapped PS's recorder ring
+        (commit-stream positions, replication attach/detach, gate
+        refusals, armed seam firings), its metrics snapshot, the
+        worker-activity table as the in-flight view, and the failover
+        config. Returns ``(bundle, path)``."""
+        from distkeras_tpu.obs import dump_postmortem as _dump
+
+        with self.ps._lock:
+            in_flight = [
+                {
+                    "worker_id": wid,
+                    "last_seq": self.ps._seen_seq.get(wid),
+                    "idle_seconds": round(
+                        time.monotonic() - last, 3
+                    ),
+                }
+                for wid, last in self.ps._activity.items()
+            ]
+        bundle, path = _dump(
+            self.postmortem_dir, "parameter_server", reason,
+            recorder=self.ps.recorder,
+            metrics=self.ps.metrics_snapshot(),
+            in_flight=in_flight,
+            config={
+                "role": self.role,
+                "standby_of": (
+                    None if self.standby_of is None
+                    else list(self.standby_of)
+                ),
+                "port": self.port,
+                "min_replicas": self.ps.min_replicas,
+                "rule": type(self.ps).__name__,
+            },
+            detail=detail,
+        )
+        self.last_postmortem = bundle
+        self.last_postmortem_path = path
+        return bundle, path
 
     # -- serving side -------------------------------------------------------
 
@@ -1058,6 +1216,20 @@ class SocketParameterServer:
                     # inside the PS lock; this thread's job is done
                     handed_off = True
                     return
+                elif action == b"m":
+                    # metrics scrape (works on standby AND primary —
+                    # observability must not be gated on role): b"k" +
+                    # frame {"metrics": samples, "role": ...}; what
+                    # ``dkt_top --ps`` polls
+                    conn.sendall(b"k")
+                    networking.send_data(
+                        conn,
+                        pack_frame({
+                            "metrics": self.ps.metrics_snapshot(),
+                            "role": self.role,
+                            "port": self.port,
+                        }),
+                    )
                 elif action == b"s":
                     self.stop()
                     break
@@ -1116,6 +1288,7 @@ class SocketParameterServer:
 
     def stop(self):
         self._running.clear()
+        faults.remove_observer(self.ps.recorder.fault_observer)
         self.ps.stop()
         self._close_all()
         # join what we spawned (skip the current thread: stop() runs on a
@@ -1135,6 +1308,9 @@ class SocketParameterServer:
         state). Only tests and the chaos soak call this."""
         self.killed = True
         self._running.clear()
+        # a dead process's observer cannot fire; in-process simulations
+        # must match, or the victim's ring keeps taping after "death"
+        faults.remove_observer(self.ps.recorder.fault_observer)
         self._close_all(rst=True)
 
 
@@ -1314,6 +1490,19 @@ class RemoteParameterServerClient:
                     ) from e
 
         return self._with_failover(op, resend_safe=commit_id is not None)
+
+    def metrics(self) -> dict:
+        """Scrape the connected PS's typed-metrics snapshot (works on
+        a standby too): ``{"metrics": samples, "role", "port"}``."""
+
+        def op():
+            with self._lock:
+                self._sock.sendall(b"m")
+                _read_reply_status(self._sock)
+                header, _ = unpack_frame(networking.recv_data(self._sock))
+            return header
+
+        return self._with_failover(op)
 
     def close(self):
         try:

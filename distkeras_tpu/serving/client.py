@@ -95,6 +95,9 @@ class ServingClient:
         # replicas the router could not scrape on the last metrics()
         # call (empty for a lone server / a fully reachable fleet)
         self.last_metrics_unreachable = []
+        # where the last postmortem() bundle was persisted (None when
+        # it was memory-only or nothing terminal has happened)
+        self.last_postmortem_path = None
 
     def _dial(self):
         sock = connect(
@@ -352,6 +355,15 @@ class ServingClient:
         reply, _ = self._call({"verb": "metrics"})
         self.last_metrics_unreachable = reply.get("unreachable") or []
         return reply["metrics"]
+
+    def postmortem(self):
+        """The latest post-mortem bundle of whatever answers (a lone
+        server's engine, or the router's own book), or None when
+        nothing terminal has happened. The bundle's ``path`` (when it
+        was persisted) lands on ``last_postmortem_path``."""
+        reply, _ = self._call({"verb": "postmortem"})
+        self.last_postmortem_path = reply.get("path")
+        return reply.get("postmortem")
 
     def stop(self) -> dict:
         """Ask the server to drain and shut down (acked before the

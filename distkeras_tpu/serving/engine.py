@@ -1180,7 +1180,9 @@ class ServingEngine:
                  watchdog_interval=10.0, watchdog_grace=None,
                  max_restarts=3, restart_backoff=0.05,
                  metrics_path=None, speculative=None, draft_bundle=None,
-                 draft_k=4, ngram_max=3):
+                 draft_k=4, ngram_max=3, flight_recorder=True,
+                 recorder_capacity=2048, postmortem_dir=None,
+                 slos=None, slo_interval=5.0):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -1217,7 +1219,20 @@ class ServingEngine:
         = the engine stays ``degraded`` and refuses generate with
         ``InternalError``), ``restart_backoff`` (base of the
         exponential full-jitter delay between restarts — the same
-        ``networking.RetryPolicy`` schedule clients use)."""
+        ``networking.RetryPolicy`` schedule clients use).
+
+        Black-box knobs: ``flight_recorder`` (True keeps an always-on
+        ``obs.FlightRecorder`` ring of ``recorder_capacity`` events —
+        scheduler iterations, blame/quarantine, watchdog trips, armed
+        fault-seam firings; False disables it, the bench's A/B
+        control), ``postmortem_dir`` (where terminal events — watchdog
+        trips, permanent degradation — dump their post-mortem bundle;
+        None keeps the latest bundle in memory only, still served by
+        the ``postmortem`` verb), ``slos`` (a list of ``obs.SloSpec``
+        — see ``obs.default_serving_slos``; verdicts ride ``health()``
+        as ``slo``/``slo_violations``, re-evaluated at most every
+        ``slo_interval`` seconds; breaches count in
+        ``serving_slo_breaches`` and land in the recorder)."""
         from distkeras_tpu.obs import MetricsRegistry
 
         self.model = model
@@ -1234,9 +1249,29 @@ class ServingEngine:
         # records this engine's request spans here, and draining to
         # THIS engine's MetricsLogger can never steal a sibling
         # engine's pending spans in an in-process fleet
-        from distkeras_tpu.obs import TraceCollector
+        from distkeras_tpu.obs import FlightRecorder, TraceCollector
 
         self.trace_collector = TraceCollector()
+        # span-ring drops, scrapeable (today they are counted but only
+        # visible in the JSONL drain): lifetime total, so a drain's
+        # read-and-reset of ``dropped`` never zeroes the gauge
+        self.registry.gauge(
+            "serving_trace_collector_dropped",
+            fn=lambda: self.trace_collector.dropped_total,
+        )
+        # the black box: always-on ring of component events; every
+        # self-healing decision and armed seam firing lands here, and
+        # terminal events dump it as a post-mortem bundle
+        self.recorder = (
+            FlightRecorder(capacity=recorder_capacity)
+            if flight_recorder
+            else None
+        )
+        if self.recorder is not None:
+            self.recorder.register_gauges(self.registry, "serving")
+        self.postmortem_dir = postmortem_dir
+        self.last_postmortem = None
+        self.last_postmortem_path = None
         store = None
         if prefix_cache:
             from distkeras_tpu.serving.prefix_cache import PrefixStore
@@ -1283,6 +1318,7 @@ class ServingEngine:
         self._batcher_cfg = dict(
             queue_capacity=queue_capacity, prefill_chunk=prefill_chunk,
             quarantine_steps=quarantine_steps, registry=self.registry,
+            recorder=self.recorder,
         )
         self.batcher = (
             None
@@ -1363,6 +1399,17 @@ class ServingEngine:
             for phase in ("queue_wait", "prefill", "decode", "ttft",
                           "total")
         }
+        # SLO watchdog: declarative specs graded from THIS registry,
+        # cadence-guarded (health polls between evaluations read the
+        # cached verdict); breaches count + land in the recorder
+        self.slo = None
+        if slos:
+            from distkeras_tpu.obs import SloEvaluator
+
+            self.slo = SloEvaluator(
+                slos, self.metrics_snapshot, interval=slo_interval,
+                registry=reg, recorder=self.recorder, prefix="serving",
+            )
 
     @staticmethod
     def _resolve_drafter(speculative, draft_bundle, ngram_max):
@@ -1419,6 +1466,10 @@ class ServingEngine:
         if self._started:
             return self
         self._started = True
+        if self.recorder is not None:
+            # every ARMED fault-seam firing becomes a ring event, so a
+            # bundle names the injection that preceded the failure
+            faults.add_observer(self.recorder.fault_observer)
         self._predict_batcher.start()
         if self.batcher is not None:
             self._launch_scheduler(self.batcher)
@@ -1510,11 +1561,25 @@ class ServingEngine:
             if not dead and not wedged:
                 continue
             self._watchdog_trips += 1
+            if self.recorder is not None:
+                self.recorder.record(
+                    "engine.watchdog_trip", dead=dead, wedged=wedged,
+                    restarts=self._restarts,
+                    heartbeat_age=round(now - self._heartbeat, 3),
+                    last_crash=self._last_crash,
+                )
             if self.metrics is not None:
                 self.metrics.log(
                     event="serving_watchdog_trip",
                     dead=dead, wedged=wedged, restarts=self._restarts,
                 )
+            # dump BEFORE the restart tears the old batcher down: the
+            # bundle's in-flight table is the state at trip time
+            self._safe_dump(
+                "watchdog_trip",
+                {"dead": dead, "wedged": wedged,
+                 "last_crash": self._last_crash},
+            )
             self._restart(dead)
 
     def _restart(self, dead):
@@ -1533,11 +1598,18 @@ class ServingEngine:
                 f"scheduler restart budget exhausted "
                 f"({self._restarts}/{self.max_restarts})"
             )
+            if self.recorder is not None:
+                self.recorder.record(
+                    "engine.degraded", reason=self._failed_reason,
+                )
             if self.metrics is not None:
                 self.metrics.log(
                     event="serving_restart_budget_exhausted",
                     restarts=self._restarts,
                 )
+            self._safe_dump(
+                "degraded", {"reason": self._failed_reason},
+            )
             return
         if self._stop_evt.wait(self._restart_delays.delay(self._restarts)):
             return  # shutdown arrived during the backoff
@@ -1551,12 +1623,23 @@ class ServingEngine:
             self._failed = True
             self._failed_reason = f"stepper rebuild failed: {e!r}"
             self._last_crash = repr(e)
+            if self.recorder is not None:
+                self.recorder.record(
+                    "engine.degraded", reason=self._failed_reason,
+                )
+            self._safe_dump(
+                "degraded", {"reason": self._failed_reason},
+            )
             return
         self._restarts += 1
         self._stepper = stepper
         batcher = ContinuousBatcher(stepper, **self._batcher_cfg)
         self.batcher = batcher
         self._launch_scheduler(batcher)
+        if self.recorder is not None:
+            self.recorder.record(
+                "engine.restarted", restarts=self._restarts
+            )
         if self.metrics is not None:
             self.metrics.log(
                 event="serving_engine_restarted", restarts=self._restarts
@@ -1585,6 +1668,8 @@ class ServingEngine:
             # whose scheduler thread was already dead)
             batcher.stop()
         self._predict_batcher.close()
+        if self.recorder is not None:
+            faults.remove_observer(self.recorder.fault_observer)
         self.drain_traces()  # the tail of the span ring is not lost
 
     # -- generate -----------------------------------------------------------
@@ -1702,12 +1787,93 @@ class ServingEngine:
             samples = samples + store.registry.snapshot()
         return samples
 
+    def _safe_dump(self, reason, detail):
+        """Supervisor-path dump: a post-mortem failure (snapshot race,
+        disk) must never break the self-healing it documents."""
+        try:
+            self.dump_postmortem(reason, detail=detail)
+        except Exception as e:  # noqa: BLE001 — observability boundary
+            if self.metrics is not None:
+                self.metrics.log(
+                    event="postmortem_dump_failed", reason=reason,
+                    error=repr(e),
+                )
+
+    def dump_postmortem(self, reason: str, detail=None):
+        """Dump this engine's post-mortem bundle (the shared
+        ``obs.dump_postmortem`` schema): flight-recorder ring, metrics
+        snapshot, the batcher's in-flight request table with trace
+        ids (plus any spans the collector still holds for them), the
+        serving config, armed fault-seam state, and a FORCED SLO
+        verdict as of the dump. Kept on ``last_postmortem`` for the
+        ``postmortem`` verb; written to ``postmortem_dir`` when one is
+        configured. Returns ``(bundle, path)``."""
+        from distkeras_tpu.obs import dump_postmortem as _dump
+
+        batcher = self.batcher
+        in_flight = (
+            [] if batcher is None else batcher.inflight_snapshot()
+        )
+        trace_spans = []
+        for row in in_flight:
+            if row["trace_id"] is not None:
+                trace_spans.extend(
+                    self.trace_collector.spans_for(row["trace_id"])
+                )
+        cfg = dict(self._batcher_cfg)
+        cfg.pop("registry", None)
+        cfg.pop("recorder", None)
+        cfg.update(
+            model=type(self.model).__name__,
+            num_slots=(
+                None if self._stepper is None
+                else self._stepper.num_slots
+            ),
+            speculative=(
+                self._stepper is not None
+                and bool(self._stepper.speculative)
+            ),
+            watchdog_interval=self.watchdog_interval,
+            watchdog_grace=self.watchdog_grace,
+            max_restarts=self.max_restarts,
+        )
+        bundle, path = _dump(
+            self.postmortem_dir, "serving_engine", reason,
+            recorder=self.recorder, metrics=self.metrics_snapshot(),
+            in_flight=in_flight, config=cfg, trace_spans=trace_spans,
+            slo=None if self.slo is None else self.slo.evaluate(),
+            detail=detail,
+        )
+        self.last_postmortem = bundle
+        self.last_postmortem_path = path
+        if self.metrics is not None:
+            self.metrics.log(
+                event="postmortem_dumped", reason=reason, path=path,
+            )
+        return bundle, path
+
+    def postmortem(self):
+        """Latest bundle for the ``postmortem`` DKT1 verb: the
+        in-memory last dump, falling back to the newest file in
+        ``postmortem_dir`` (a restarted process still serves the bundle
+        its predecessor wrote). ``(bundle_or_None, path_or_None)``."""
+        if self.last_postmortem is not None:
+            return self.last_postmortem, self.last_postmortem_path
+        if self.postmortem_dir is not None:
+            from distkeras_tpu.obs import latest_postmortem
+
+            return latest_postmortem(self.postmortem_dir)
+        return None, None
+
     def health(self) -> dict:
         """Liveness summary, cheap enough for a load balancer to poll:
         ``status`` is ``serving`` (scheduler heartbeating), ``degraded``
         (scheduler dead/restarting, or the restart budget is exhausted),
         or ``draining`` (shutdown in progress); plus the heartbeat age,
-        the quarantined-slot count, and the restart ledger."""
+        the quarantined-slot count, the restart ledger, and — when
+        SLOs are configured — the cadence-guarded SLO verdict
+        (``slo``: ok|warn|breach, ``slo_violations`` naming the
+        violating series)."""
         batcher = self.batcher
         if self._stop_evt.is_set():
             status = "draining"
@@ -1761,6 +1927,10 @@ class ServingEngine:
             if batcher is None or not self._started
             else time.monotonic() - self._heartbeat
         )
+        if self.slo is not None:
+            verdict = self.slo.maybe_evaluate()
+            out["slo"] = verdict["slo"]
+            out["slo_violations"] = verdict["violations"]
         if self._last_crash is not None:
             out["last_crash"] = self._last_crash
         return out

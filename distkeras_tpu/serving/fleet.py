@@ -128,6 +128,7 @@ class _Replica:
         self.in_flight = 0      # router-side forwards outstanding
         self.forwards = 0
         self.failovers = 0      # forwards that died here and moved on
+        self.slo_breaches = 0   # consecutive polls reporting slo breach
         self.last_health = None
 
     def snapshot(self) -> dict:
@@ -139,6 +140,7 @@ class _Replica:
             "forwards": self.forwards,
             "failovers": self.failovers,
             "consecutive_poll_failures": self.fails,
+            "consecutive_slo_breaches": self.slo_breaches,
         }
 
 
@@ -159,7 +161,9 @@ class FleetRouter:
                  health_interval=0.25, health_timeout=2.0,
                  eject_after=2, connect_timeout=2.0,
                  request_timeout=120.0, retry_after_ms=50.0,
-                 affinity=True, affinity_min_len=8):
+                 affinity=True, affinity_min_len=8,
+                 postmortem_dir=None, eject_on_slo_breach=0,
+                 recorder_capacity=1024):
         """``eject_after``: consecutive failed health polls before an
         ACTIVE replica leaves rotation (a mid-forward connection death
         ejects immediately — the poll budget is for the quiet path).
@@ -167,7 +171,16 @@ class FleetRouter:
         short so a silently dead replica fails over in seconds while
         ``request_timeout`` stays long enough for a full generate.
         ``affinity=False`` degrades ``generate`` routing to
-        least-loaded (the A/B baseline in ``bench_fleet.py``)."""
+        least-loaded (the A/B baseline in ``bench_fleet.py``).
+
+        ``postmortem_dir``: where every replica EJECTION dumps the
+        router's post-mortem bundle (recorder ring + rotation books +
+        metrics; None keeps only the latest in memory, still served by
+        the ``postmortem`` verb). ``eject_on_slo_breach``: when > 0, a
+        replica whose health reply reports ``slo: "breach"`` for that
+        many CONSECUTIVE polls is ejected like a degraded one, and
+        cannot rejoin until a poll shows the breach cleared (0 — the
+        default — never ejects on SLO: verdicts stay advisory)."""
         self.max_frame_bytes = int(max_frame_bytes)
         self.health_interval = float(health_interval)
         self.health_timeout = float(health_timeout)
@@ -177,6 +190,10 @@ class FleetRouter:
         self.retry_after_ms = float(retry_after_ms)
         self.affinity = bool(affinity)
         self.affinity_min_len = int(affinity_min_len)
+        self.postmortem_dir = postmortem_dir
+        self.eject_on_slo_breach = int(eject_on_slo_breach)
+        self.last_postmortem = None
+        self.last_postmortem_path = None
         self._lock = threading.Lock()
         self._replicas: dict[tuple, _Replica] = {}
         self._pools: dict[tuple, list] = {}   # idle forward clients
@@ -229,6 +246,18 @@ class FleetRouter:
         self._forward_hist = self.registry.histogram(
             "fleet_router_forward_seconds"
         )
+        # the router's black box: routing/ejection/failover decisions,
+        # always-on (the engine-side twin records scheduler events)
+        from distkeras_tpu.obs import COLLECTOR, FlightRecorder
+
+        self.recorder = FlightRecorder(capacity=recorder_capacity)
+        self.recorder.register_gauges(self.registry, "fleet")
+        # router spans land in the process-wide collector; its drops
+        # become scrapeable here (the router has no private span ring)
+        self.registry.gauge(
+            "fleet_router_trace_collector_dropped",
+            fn=lambda: COLLECTOR.dropped_total,
+        )
         for ep in endpoints:
             self._replicas[(ep[0], int(ep[1]))] = _Replica(ep)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -247,6 +276,10 @@ class FleetRouter:
 
     def start(self) -> "FleetRouter":
         if self._accept_thread is None:
+            # armed fault-seam firings (router.dispatch/router.health/
+            # net.*) land in the ring, so an ejection bundle names the
+            # injections that preceded it
+            faults.add_observer(self.recorder.fault_observer)
             self._health_sweep()  # synchronous first sweep: a router
             # that starts with live replicas routes from request one
             self._health_thread = threading.Thread(
@@ -314,6 +347,7 @@ class FleetRouter:
             for cli in health:
                 cli.close()
         finally:
+            faults.remove_observer(self.recorder.fault_observer)
             self._shutdown_done.set()
 
     def __enter__(self):
@@ -360,6 +394,10 @@ class FleetRouter:
             rep = self._replicas.get(ep)
             if rep is not None:
                 rep.state = DRAINING
+                self.recorder.record(
+                    "router.drain", endpoint=f"{ep[0]}:{ep[1]}",
+                    in_flight=rep.in_flight,
+                )
 
     def wait_drained(self, endpoint, timeout=60.0) -> bool:
         """Block until the router has ZERO in-flight forwards to
@@ -460,6 +498,7 @@ class FleetRouter:
                     stale.close()
             self._poll_failed(ep)
             return
+        dump = None
         with self._lock:
             rep = self._replicas.get(ep)
             if rep is None:
@@ -469,19 +508,56 @@ class FleetRouter:
                 rep.capacity = int(h["num_slots"]) + int(
                     h.get("queue_capacity") or 0
                 )
+            slo_breach = h.get("slo") == "breach"
             if h.get("status") == "serving":
                 rep.fails = 0
-                if rep.state in (JOINING, EJECTED):
-                    if rep.state == EJECTED:
-                        self.counters["rejoins"] += 1
-                    rep.state = ACTIVE
+                if self.eject_on_slo_breach and slo_breach:
+                    # the replica serves but violates its SLOs: after
+                    # enough CONSECUTIVE breached polls it leaves
+                    # rotation like a degraded one, and stays out
+                    # until a poll shows the breach cleared
+                    rep.slo_breaches += 1
+                    if (
+                        rep.state == ACTIVE
+                        and rep.slo_breaches >= self.eject_on_slo_breach
+                    ):
+                        self.counters["ejections"] += 1
+                        rep.state = EJECTED
+                        dump = self._record_eject(
+                            ep, "slo_breach",
+                            violations=h.get("slo_violations"),
+                        )
+                else:
+                    rep.slo_breaches = 0
+                    if rep.state in (JOINING, EJECTED):
+                        if rep.state == EJECTED:
+                            self.counters["rejoins"] += 1
+                            self.recorder.record(
+                                "router.rejoin",
+                                endpoint=f"{ep[0]}:{ep[1]}",
+                            )
+                        rep.state = ACTIVE
             else:  # degraded | draining: the replica said so itself
                 if rep.state == ACTIVE:
                     self.counters["ejections"] += 1
                     rep.state = EJECTED
+                    dump = self._record_eject(
+                        ep, str(h.get("status")),
+                    )
                 rep.fails = max(rep.fails, self.eject_after)
+        if dump is not None:
+            self._dump_postmortem("replica_ejected", detail=dump)
+
+    def _record_eject(self, ep, cause, **extra) -> dict:
+        """Record the ejection in the ring (caller may hold the lock —
+        the recorder's own lock is a leaf) and return the post-mortem
+        detail dict the caller dumps AFTER releasing the lock."""
+        detail = {"endpoint": f"{ep[0]}:{ep[1]}", "cause": cause, **extra}
+        self.recorder.record("router.eject", **detail)
+        return detail
 
     def _poll_failed(self, ep):
+        dump = None
         with self._lock:
             rep = self._replicas.get(ep)
             if rep is None:
@@ -490,6 +566,11 @@ class FleetRouter:
             if rep.state == ACTIVE and rep.fails >= self.eject_after:
                 self.counters["ejections"] += 1
                 rep.state = EJECTED
+                dump = self._record_eject(
+                    ep, "health_polls_failed", fails=rep.fails,
+                )
+        if dump is not None:
+            self._dump_postmortem("replica_ejected", detail=dump)
 
     def _health_client(self, ep):
         from distkeras_tpu.serving.client import ServingClient
@@ -626,6 +707,13 @@ class FleetRouter:
             return pack_frame({"ok": True, "stats": self.stats()})
         if verb == "metrics":
             return pack_frame(self._metrics_reply(header))
+        if verb == "postmortem":
+            # the ROUTER's latest bundle (replica ejections); replica
+            # engines serve their own over their own ports
+            bundle, path = self.postmortem()
+            return pack_frame(
+                {"ok": True, "postmortem": bundle, "path": path}
+            )
         if verb == "stop":
             # stop THE ROUTER (reply first, drain on a side thread,
             # mirroring ServingServer). Replica lifecycle belongs to
@@ -665,6 +753,42 @@ class FleetRouter:
             out["open_connections"] = len(self._conns)
         out["affinity_enabled"] = self.affinity
         return out
+
+    def _dump_postmortem(self, reason: str, detail=None):
+        """The router's post-mortem bundle (shared schema): recorder
+        ring, its own metrics samples, the per-replica rotation books
+        as the in-flight table, and the routing config. Never called
+        under the router lock — the dump walks the registry and may
+        touch disk."""
+        from distkeras_tpu.obs import dump_postmortem as _dump
+
+        bundle, path = _dump(
+            self.postmortem_dir, "fleet_router", reason,
+            recorder=self.recorder, metrics=self.registry.snapshot(),
+            in_flight=self.replicas(),
+            config={
+                "affinity": self.affinity,
+                "eject_after": self.eject_after,
+                "health_interval": self.health_interval,
+                "eject_on_slo_breach": self.eject_on_slo_breach,
+            },
+            detail=detail,
+        )
+        self.last_postmortem = bundle
+        self.last_postmortem_path = path
+        return bundle, path
+
+    def postmortem(self):
+        """Latest router bundle (in-memory first, then the newest file
+        in ``postmortem_dir``); ``(None, None)`` when no replica has
+        ever been ejected."""
+        if self.last_postmortem is not None:
+            return self.last_postmortem, self.last_postmortem_path
+        if self.postmortem_dir is not None:
+            from distkeras_tpu.obs import latest_postmortem
+
+            return latest_postmortem(self.postmortem_dir)
+        return None, None
 
     def _metrics_reply(self, header: dict) -> dict:
         """The fleet-level ``metrics`` verb: the router's own registry
@@ -841,6 +965,10 @@ class FleetRouter:
                 if how == "saturated" or saw_overloaded_hint is not None:
                     with self._lock:
                         self.counters["fleet_overloaded"] += 1
+                    self.recorder.record(
+                        "router.route", verb=verb,
+                        outcome="fleet_overloaded", hops=hops,
+                    )
                     hint = saw_overloaded_hint or self.retry_after_ms
                     return finish({
                         "ok": False, "error": "overloaded",
@@ -853,6 +981,10 @@ class FleetRouter:
                     "every replica failed: " + "; ".join(
                         f"{h}:{p}: {e!r}" for (h, p), e in causes
                     )
+                )
+                self.recorder.record(
+                    "router.route", verb=verb, outcome="unavailable",
+                    hops=hops,
                 )
                 return finish({
                     "ok": False, "error": "unavailable", "detail": detail,
@@ -908,6 +1040,16 @@ class FleetRouter:
                 f"{ep[0]}:{ep[1]} "
                 + ("ok" if reply.get("ok") else str(reply.get("error")))
             )
+            # the always-on black-box line (the trace span above is
+            # opt-in per request; the ring is not)
+            self.recorder.record(
+                "router.route", verb=verb,
+                replica=f"{ep[0]}:{ep[1]}", how=how,
+                failovers=len(causes),
+                outcome=(
+                    "ok" if reply.get("ok") else str(reply.get("error"))
+                ),
+            )
             return finish(
                 reply,
                 "ok" if reply.get("ok") else str(reply.get("error")),
@@ -920,6 +1062,7 @@ class FleetRouter:
         the cause for the all-dead reply."""
         causes.append((ep, exc))
         excluded.add(ep)
+        dump = None
         with self._lock:
             rep = self._replicas.get(ep)
             if rep is not None:
@@ -928,10 +1071,19 @@ class FleetRouter:
                 if rep.state == ACTIVE:
                     self.counters["ejections"] += 1
                     rep.state = EJECTED
+                    dump = self._record_eject(
+                        ep, "died_mid_forward", error=repr(exc)[:200],
+                    )
             self.counters["failovers"] += 1
+            self.recorder.record(
+                "router.failover", endpoint=f"{ep[0]}:{ep[1]}",
+                error=repr(exc)[:200],
+            )
             pool = self._pools.pop(ep, [])
         for cli in pool:  # siblings of a dead connection are suspect
             cli.close()
+        if dump is not None:
+            self._dump_postmortem("replica_ejected", detail=dump)
 
 
 # --------------------------------------------------------------- controller

@@ -210,7 +210,7 @@ class ContinuousBatcher:
     """
 
     def __init__(self, stepper, queue_capacity=64, prefill_chunk=None,
-                 quarantine_steps=64, registry=None):
+                 quarantine_steps=64, registry=None, recorder=None):
         """``quarantine_steps``: scheduler iterations a slot sits out
         after a device step is blamed on its request (its cache rows are
         suspect, and a systematically poisonous traffic shape should not
@@ -222,7 +222,13 @@ class ContinuousBatcher:
         its own, so the ``metrics`` verb scrapes them); None builds a
         private one. ``counters`` stays dict-shaped (a
         ``CounterGroup``) so every existing call site and reset loop
-        keeps working while the values become typed metrics."""
+        keeps working while the values become typed metrics.
+
+        ``recorder``: an ``obs.FlightRecorder`` (the engine passes its
+        own) — the batcher then records iteration summaries, blame and
+        quarantine decisions, and prefill failures ALWAYS-ON (one
+        bounded-deque append per working iteration; idle iterations
+        record nothing). None disables recording."""
         self.stepper = stepper
         self.queue_capacity = int(queue_capacity)
         if self.queue_capacity < 1:
@@ -255,6 +261,7 @@ class ContinuousBatcher:
         self._work = threading.Event()  # signals the engine loop
         self._draining = False
         self._stopped = False
+        self.recorder = recorder
         from distkeras_tpu.obs import MetricsRegistry
 
         self.registry = registry if registry is not None else MetricsRegistry()
@@ -433,6 +440,7 @@ class ContinuousBatcher:
             active, seqs
         )
         now = time.monotonic()
+        emitted_total = 0
         with self._lock:
             self.counters["steps"] += 1
             self.counters["occupancy_sum"] += int(active.sum())
@@ -440,6 +448,14 @@ class ContinuousBatcher:
                 req = self._slots[i]
                 if req is None:
                     continue  # stopped underneath the blame probes
+                if self.recorder is not None:
+                    # the black-box line a post-mortem reads: WHICH
+                    # slot/request the failed step was pinned on
+                    self.recorder.record(
+                        "scheduler.blame", slot=i, request_id=req.id,
+                        iter=self._sched_iters,
+                        probes=self.counters["blame_probes"],
+                    )
                 if req.trace is not None:
                     # the blame window (failed step + probes) on the
                     # culprit's own ledger — request_spans turns it
@@ -459,6 +475,12 @@ class ContinuousBatcher:
                     ),
                 )
             if toks is None:
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "scheduler.iteration", iter=self._sched_iters,
+                        active=int(active.sum()), emitted=0,
+                        blamed=blamed,
+                    )
                 return True  # every active slot was blamed this round
             blamed_set = set(blamed)
             for i, req in enumerate(self._slots):
@@ -495,6 +517,7 @@ class ContinuousBatcher:
                             ),
                         )
                         break
+                emitted_total += emitted
                 if used_verify[i]:
                     self.counters["spec_windows"] += 1
                     self.counters["spec_tokens"] += emitted
@@ -506,6 +529,15 @@ class ContinuousBatcher:
                     )
                     self._spec_windows[i] += 1
                     self._spec_emitted[i] += emitted
+        if self.recorder is not None:
+            # one black-box line per WORKING iteration (idle loops
+            # record nothing): what the slot bank did this tick
+            self.recorder.record(
+                "scheduler.iteration", iter=self._sched_iters,
+                active=int(active.sum()), emitted=emitted_total,
+                spec=bool(used_verify.any()),
+                blamed=blamed if blamed else None,
+            )
         return True
 
     # -- blame assignment ----------------------------------------------------
@@ -612,12 +644,22 @@ class ContinuousBatcher:
         """Send slot ``i`` to probation. Caller holds the lock."""
         self.counters["quarantines"] += 1
         self._quarantined[i] = self._sched_iters + self.quarantine_steps
+        if self.recorder is not None:
+            self.recorder.record(
+                "scheduler.quarantine", slot=i,
+                until_iter=self._quarantined[i],
+            )
 
     def _fail_admission(self, i, req, exc):
         """A begin_admit/prefill_chunk crash: fail the (attributable)
         request typed and free the slot."""
         with self._lock:
             self.counters["prefill_failures"] += 1
+            if self.recorder is not None:
+                self.recorder.record(
+                    "scheduler.prefill_failure", slot=i,
+                    request_id=req.id, error=repr(exc)[:200],
+                )
             if self._slots[i] is req:
                 self._evict(
                     i,
@@ -761,6 +803,36 @@ class ContinuousBatcher:
             return not self._queue and all(
                 s is None for s in self._slots
             )
+
+    def inflight_snapshot(self) -> list[dict]:
+        """The in-flight request table for a post-mortem bundle: every
+        queued and slotted request with its trace id (when traced) —
+        the "who was in the air when it went down" page. JSON-able and
+        cheap (one pass under the lock)."""
+
+        def row(req, state, slot=None):
+            return {
+                "request_id": req.id,
+                "state": state,
+                "slot": slot,
+                "prompt_len": int(req.prompt.size),
+                "max_new_tokens": req.max_new_tokens,
+                "tokens_emitted": len(req.tokens),
+                "trace_id": (
+                    None if req.trace is None else req.trace.trace_id
+                ),
+            }
+
+        with self._lock:
+            out = [row(r, "queued") for r in self._queue]
+            for i, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                state = (
+                    "prefilling" if i in self._prefill_left else "decoding"
+                )
+                out.append(row(req, state, slot=i))
+            return out
 
     def load(self) -> dict:
         """Cheap occupancy snapshot for the health surface (polled by

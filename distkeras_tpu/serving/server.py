@@ -32,6 +32,9 @@ Verbs (header ``{"verb": ...}``):
   scheduler/engine/prefix-cache counters, gauges, and latency
   histograms as JSON samples; ``format: "prometheus"`` returns the
   text exposition dump instead (``tools/dkt_top.py`` polls this verb).
+- ``postmortem``: the engine's latest crash bundle (watchdog trip or
+  permanent degradation — ``obs.dump_postmortem`` schema), or None;
+  ``tools/dkt_postmortem.py`` renders it into an incident timeline.
 - ``stop``: begins graceful shutdown — in-flight and queued requests
   complete, new ones are refused, then the listener closes.
 
@@ -277,6 +280,14 @@ class ServingServer:
                      "text": render_prometheus(samples)}
                 )
             return pack_frame({"ok": True, "metrics": samples})
+        if verb == "postmortem":
+            # the latest crash bundle (watchdog trip / degradation),
+            # retrievable remotely so soak triage never needs shell
+            # access to the serving host; None when nothing has died
+            bundle, path = self.engine.postmortem()
+            return pack_frame(
+                {"ok": True, "postmortem": bundle, "path": path}
+            )
         if verb == "health":
             # engine liveness (serving|degraded|draining, heartbeat age,
             # quarantine + restart ledger) plus the server's own limits,

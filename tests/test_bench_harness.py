@@ -23,6 +23,7 @@ import benchmarks  # noqa: E402
 import bench_fleet  # noqa: E402
 import bench_mfu  # noqa: E402
 import bench_serving  # noqa: E402
+import check_bench  # noqa: E402
 import mfu_attrib  # noqa: E402
 
 
@@ -117,6 +118,22 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
     assert obs["metrics_samples"] > 10
     assert obs["prometheus_parses"] is True
     assert obs["prometheus_series"] > obs["metrics_samples"]
+    # flight-recorder overhead row: the always-on black box vs off,
+    # identical outputs, the ring actually taped scheduler events
+    ro = rec["recorder_overhead"]
+    assert ro["recorder_off_tokens_per_sec"] > 0
+    assert ro["recorder_on_tokens_per_sec"] > 0
+    assert ro["recorder_vs_off"] > 0
+    assert ro["outputs_identical"] is True
+    assert ro["events_recorded"] > 0
+    # the regression gate: the fresh smoke ratios must land within the
+    # stated band of the COMMITTED artifact (a perf collapse fails
+    # tier-1 here instead of silently rotting the committed numbers)
+    committed = json.loads(
+        open(os.path.join(REPO, "BENCH_SERVING.json")).read()
+    )
+    violations = check_bench.compare_serving(rec, committed)
+    assert violations == [], violations
     # speculative A/B schema: both traffic shapes, both sides, the
     # acceptance ledger, and the identity flag (win/cost RATIOS are
     # only meaningful in the full trained-model run, not at smoke
@@ -211,6 +228,13 @@ def test_bench_fleet_smoke_mode_end_to_end(tmp_path, monkeypatch):
     assert "router" in obs["replica_labels"]
     assert len(obs["replica_labels"]) == 3  # router + 2 replicas
     assert obs["prometheus_parses"] is True
+    # the fleet side of the regression gate (ratio bands + invariants
+    # against the committed artifact)
+    committed = json.loads(
+        open(os.path.join(REPO, "BENCH_FLEET.json")).read()
+    )
+    violations = check_bench.compare_fleet(rec, committed)
+    assert violations == [], violations
 
 
 def test_committed_bench_serving_tracing_row():
@@ -230,6 +254,12 @@ def test_committed_bench_serving_tracing_row():
     assert obs["prometheus_parses"] is True
     assert {"client.request", "server.generate",
             "serving.decode"} <= set(obs["sample_trace_spans"])
+    # the committed flight-recorder row carries THIS PR's claim: the
+    # always-on black box costs < 2% tokens/sec, outputs identical
+    ro = rec["recorder_overhead"]
+    assert ro["outputs_identical"] is True
+    assert ro["recorder_vs_off"] >= 0.98, ro
+    assert ro["events_recorded"] > 0
 
 
 def test_committed_bench_fleet_artifact_schema():

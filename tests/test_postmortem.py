@@ -1,0 +1,689 @@
+"""The failure-path black box: flight recorder, crash post-mortem
+bundles, and the SLO watchdog (distkeras_tpu/obs/recorder.py + slo.py)
+and their wiring through engine, server, router, and parameter server.
+
+Tiers:
+
+- primitive units: the recorder ring's bound/overwrite accounting, the
+  fault-seam observer tap, bundle build/dump/latest roundtrips, SLO
+  spec evaluation (every agg/bound/min_count shape);
+- GOLDEN-SCHEMA pins for the bundle dict and the ``postmortem`` verb
+  reply — triage tooling keys on these names, so a drift must be a
+  red test here, not a broken incident review;
+- chaos end-to-end: an armed ``stepper.step`` blame followed by an
+  armed ``scheduler.loop`` kill produces a watchdog trip whose bundle
+  names the blamed slot AND the injected seam firings;
+- SLO end-to-end: breach -> health verdict -> recorder event ->
+  breach counter, and the fleet sweep's sustained-breach ejection;
+- tools: ``dkt_postmortem`` renders a bundle socketlessly and over
+  the verb; ``dkt_top --ps`` scrapes a parameter server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ),
+)
+
+from distkeras_tpu import faults
+from distkeras_tpu.obs import (
+    POSTMORTEM_SCHEMA,
+    FlightRecorder,
+    MetricsRegistry,
+    SloEvaluator,
+    SloSpec,
+    build_postmortem,
+    default_serving_slos,
+    default_training_slos,
+    dump_postmortem,
+    evaluate_slos,
+    latest_postmortem,
+)
+
+# ------------------------------------------------------- recorder primitives
+
+
+def test_recorder_ring_bound_and_overwrite_accounting():
+    r = FlightRecorder(capacity=3)
+    for i in range(5):
+        r.record("k", i=i)
+    assert r.events_recorded == 5
+    assert r.overwrites == 2
+    assert [e["i"] for e in r.snapshot()] == [2, 3, 4]  # oldest first
+    assert [e["i"] for e in r.events("k")] == [2, 3, 4]
+    assert r.events("other") == []
+    ev = r.record("k2", a="b")
+    assert ev["kind"] == "k2" and ev["ts"] > 0
+    r.clear()
+    assert r.snapshot() == [] and r.events_recorded == 6  # totals survive
+
+
+def test_recorder_gauges_ride_the_owning_registry():
+    reg = MetricsRegistry()
+    r = FlightRecorder(capacity=2)
+    r.register_gauges(reg, "serving")
+    r.record("a")
+    r.record("a")
+    r.record("a")
+    by = {s["name"]: s["value"] for s in reg.snapshot()}
+    assert by["serving_recorder_events"] == 3
+    assert by["serving_recorder_overwrites"] == 1
+
+
+def test_fault_observer_tapes_armed_firings_with_summarized_ctx():
+    r = FlightRecorder()
+    plan = faults.FaultPlan(seed=0).arm("stepper.step", times=1)
+    faults.add_observer(r.fault_observer)
+    try:
+        with plan:
+            with pytest.raises(faults.InjectedFault):
+                faults.fire(
+                    "stepper.step", slot=3, active=np.ones(4, bool)
+                )
+            # disarmed-matching events do not tape (seam exhausted)
+            faults.fire("stepper.step", slot=4)
+    finally:
+        faults.remove_observer(r.fault_observer)
+    (ev,) = r.events("fault.fired")
+    assert ev["site"] == "stepper.step" and ev["action"] == "raise"
+    assert ev["slot"] == 3
+    assert isinstance(ev["active"], str)  # arrays summarized, not embedded
+    json.dumps(ev)  # the bundle ships it: must be JSON-able
+    # an observer that raises must never change what the seam does
+    faults.add_observer(lambda *a: 1 / 0)
+    try:
+        with faults.FaultPlan(seed=0).arm("stepper.step", times=1):
+            with pytest.raises(faults.InjectedFault):
+                faults.fire("stepper.step")
+    finally:
+        faults._OBSERVERS.clear()
+
+
+def test_faults_describe_active_arming_state():
+    assert faults.describe_active() is None
+    plan = faults.FaultPlan(seed=0).arm(
+        "net.send", action="reset", times=2, probability=0.5
+    )
+    with plan:
+        rows = faults.describe_active()
+    assert rows == [{
+        "site": "net.send", "action": "reset", "times": 2,
+        "after": 0, "probability": 0.5, "fired": 0,
+    }]
+
+
+# ----------------------------------------------------------- bundle schema
+
+#: THE bundle key set (schema v1) — triage tooling (dkt_postmortem,
+#: the soak assertions) keys on these; renaming/removing one is a
+#: breaking change and must fail here first
+BUNDLE_KEYS = {
+    "schema", "component", "reason", "ts", "events", "metrics",
+    "in_flight", "config", "fault_seams", "trace_spans", "slo",
+    "detail",
+}
+
+
+def test_bundle_golden_schema_pinned(tmp_path):
+    r = FlightRecorder()
+    r.record("x", a=1)
+    bundle, path = dump_postmortem(
+        str(tmp_path), "serving_engine", "watchdog_trip", recorder=r,
+        metrics=[{"name": "m", "kind": "counter", "labels": {},
+                  "value": 1}],
+        in_flight=[{"request_id": 1, "trace_id": None}],
+        config={"num_slots": 2}, detail={"why": "test"},
+    )
+    assert set(bundle) == BUNDLE_KEYS
+    assert bundle["schema"] == POSTMORTEM_SCHEMA
+    assert bundle["component"] == "serving_engine"
+    assert bundle["reason"] == "watchdog_trip"
+    assert bundle["events"][0]["kind"] == "x"
+    assert bundle["fault_seams"] is None  # nothing armed here
+    assert os.path.exists(path)
+    loaded, lpath = latest_postmortem(str(tmp_path))
+    assert lpath == path and set(loaded) == BUNDLE_KEYS
+
+
+def test_dump_postmortem_memory_only_and_latest_ordering(tmp_path):
+    bundle, path = dump_postmortem(None, "c", "r")
+    assert path is None and bundle["component"] == "c"
+    assert latest_postmortem(str(tmp_path / "missing")) == (None, None)
+    d = str(tmp_path)
+    dump_postmortem(d, "c", "first")
+    time.sleep(0.002)  # filenames carry the timestamp: strictly later
+    b2, p2 = dump_postmortem(d, "c", "second")
+    latest, lpath = latest_postmortem(d)
+    assert latest["reason"] == "second" and lpath == p2
+    # a torn newest file falls back to the next-newest
+    with open(os.path.join(d, "postmortem_c_9999999999.000000_1.json"),
+              "w") as f:
+        f.write("{torn")
+    latest, _ = latest_postmortem(d)
+    assert latest["reason"] == "second"
+
+
+def test_latest_postmortem_orders_by_time_across_components(tmp_path):
+    """A directory shared by several components must yield the NEWEST
+    incident: ordering is by the timestamp in the filename, not
+    lexicographic (where 'serving_engine' would always beat
+    'fleet_router' regardless of age)."""
+    d = str(tmp_path)
+    with open(os.path.join(
+        d, "postmortem_serving_engine_100.000000_1.json"
+    ), "w") as f:
+        json.dump({"reason": "older"}, f)
+    with open(os.path.join(
+        d, "postmortem_fleet_router_200.000000_1.json"
+    ), "w") as f:
+        json.dump({"reason": "newer"}, f)
+    latest, _ = latest_postmortem(d)
+    assert latest["reason"] == "newer"
+
+
+def test_build_postmortem_captures_armed_seams():
+    with faults.FaultPlan(seed=0).arm("ps.commit", times=3):
+        bundle = build_postmortem("parameter_server", "promotion")
+    assert bundle["fault_seams"][0]["site"] == "ps.commit"
+
+
+# ------------------------------------------------------------ SLO evaluation
+
+
+def _hist_sample(name, count, buckets):
+    return {"name": name, "kind": "histogram", "labels": {},
+            "count": count, "sum": 0.0, "buckets": buckets}
+
+
+def _val(name, v, kind="counter"):
+    return {"name": name, "kind": kind, "labels": {}, "value": v}
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        SloSpec("x", "s", 1.0, agg="median")
+    with pytest.raises(ValueError):
+        SloSpec("x", "s", 1.0, bound="sideways")
+    with pytest.raises(ValueError):
+        SloSpec("x", "s", 1.0, agg="rate")  # rate needs per=
+
+
+def test_evaluate_slos_value_gauge_bounds():
+    samples = [_val("g", 5.0, kind="gauge")]
+    ok = evaluate_slos(samples, [SloSpec("hi", "g", 10.0)])
+    assert ok["slo"] == "ok" and ok["violations"] == []
+    br = evaluate_slos(samples, [SloSpec("lo", "g", 2.0)])
+    assert br["slo"] == "breach"
+    assert br["violations"][0]["series"] == "g"
+    floor = evaluate_slos(
+        samples, [SloSpec("fl", "g", 7.0, bound="min")]
+    )
+    assert floor["slo"] == "breach"  # 5 < 7 with bound=min
+
+
+def test_evaluate_slos_warn_tier_and_missing_series():
+    samples = [_val("g", 5.0, kind="gauge")]
+    warned = evaluate_slos(
+        samples, [SloSpec("w", "g", 10.0, warn=4.0)]
+    )
+    assert warned["slo"] == "warn"
+    assert warned["violations"][0]["verdict"] == "warn"
+    # a missing series is not judgeable, never a violation
+    absent = evaluate_slos(samples, [SloSpec("m", "nope", 1.0)])
+    assert absent["slo"] == "ok"
+    # a None-valued gauge (failed scrape callback) likewise
+    none_v = evaluate_slos(
+        [_val("g2", None, kind="gauge")], [SloSpec("n", "g2", 1.0)]
+    )
+    assert none_v["slo"] == "ok"
+
+
+def test_evaluate_slos_histogram_quantiles_and_min_count():
+    h = _hist_sample("lat", 30, [[0.1, 25], [0.2, 29], ["+Inf", 30]])
+    br = evaluate_slos([h], [SloSpec("p99", "lat", 0.15, agg="p99")])
+    assert br["slo"] == "breach" and br["violations"][0]["value"] == 0.2
+    ok = evaluate_slos([h], [SloSpec("p50", "lat", 0.15, agg="p50")])
+    assert ok["slo"] == "ok"
+    # too few observations to judge: refuse, even past the threshold
+    few = evaluate_slos(
+        [h], [SloSpec("p99", "lat", 0.15, agg="p99", min_count=100)]
+    )
+    assert few["slo"] == "ok"
+    empty = evaluate_slos(
+        [_hist_sample("lat", 0, [["+Inf", 0]])],
+        [SloSpec("p99", "lat", 0.15, agg="p99")],
+    )
+    assert empty["slo"] == "ok"
+
+
+def test_evaluate_slos_rate_and_zero_denominator():
+    samples = [_val("err", 5), _val("total", 20)]
+    br = evaluate_slos(
+        samples,
+        [SloSpec("er", "err", 0.1, agg="rate", per="total",
+                 min_count=1)],
+    )
+    assert br["slo"] == "breach"  # 0.25 > 0.1
+    zero = evaluate_slos(
+        [_val("err", 5), _val("total", 0)],
+        [SloSpec("er", "err", 0.1, agg="rate", per="total")],
+    )
+    assert zero["slo"] == "ok"  # nothing to rate against
+
+
+def test_slo_evaluator_cadence_counter_and_recorder_transition():
+    reg = MetricsRegistry()
+    rec = FlightRecorder()
+    value = {"v": 0.0}
+    snapshot = lambda: [_val("g", value["v"], kind="gauge")]  # noqa: E731
+    ev = SloEvaluator(
+        [SloSpec("cap", "g", 1.0)], snapshot, interval=3600.0,
+        registry=reg, recorder=rec, prefix="serving",
+    )
+    assert ev.evaluate()["slo"] == "ok"
+    value["v"] = 5.0
+    # cadence guard: within the interval the CACHED verdict returns
+    assert ev.maybe_evaluate()["slo"] == "ok"
+    assert ev.evaluate()["slo"] == "breach"
+    ev.evaluate()  # sustained breach: one ring event, counter ticks on
+    by = {s["name"]: s for s in reg.snapshot()}
+    assert by["serving_slo_breaches"]["value"] == 2
+    assert by["serving_slo_status"]["value"] == 2  # 2 = breach
+    assert len(rec.events("slo.breach")) == 1  # the TRANSITION only
+
+
+def test_default_slo_factories_cover_their_series():
+    specs = default_serving_slos(
+        latency_p99_s=1.0, ttft_p99_s=0.5, error_rate=0.01,
+        acceptance_rate=2.0,
+    )
+    assert {s.series for s in specs} == {
+        "serving_request_total_seconds", "serving_request_ttft_seconds",
+        "serving_scheduler_internal_errors",
+        "serving_scheduler_spec_tokens",
+    }
+    assert default_serving_slos() == []  # every knob opt-in
+    tspecs = default_training_slos(
+        straggler_ratio=4.0, commit_interval_p99_s=1.0,
+        gate_refusal_rate=0.1,
+    )
+    assert {s.series for s in tspecs} == {
+        "training_ps_straggler",
+        "training_ps_commit_interval_seconds",
+        "training_ps_commits_refused_no_replica",
+    }
+
+
+# --------------------------------------------------- engine + verb end-to-end
+
+
+@pytest.fixture(scope="module")
+def lm_model():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+#: the ``postmortem`` verb reply keys (triage tooling keys on these)
+VERB_KEYS = {"ok", "postmortem", "path", "served_by"}
+
+
+@pytest.mark.chaos
+def test_watchdog_trip_bundle_names_blamed_slot_and_seam(
+    lm_model, tmp_path
+):
+    """The acceptance chaos pin: an armed ``stepper.step`` seam blames
+    a slot (quarantine), then an armed ``scheduler.loop`` seam kills
+    the scheduler thread; the watchdog trip must dump a bundle whose
+    recorder timeline names BOTH — the blamed slot and the injected
+    seam firings — and the ``postmortem`` verb must serve it with the
+    pinned reply schema."""
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+    from distkeras_tpu.serving.scheduler import InternalError
+
+    eng = ServingEngine(
+        lm_model, num_slots=2, prefill_chunk=4,
+        watchdog_interval=0.5, watchdog_grace=30.0, max_restarts=5,
+        restart_backoff=0.01, postmortem_dir=str(tmp_path),
+    )
+    srv = ServingServer(eng).start()
+    try:
+        with ServingClient("127.0.0.1", srv.port) as cli:
+            assert cli.postmortem() is None  # nothing terminal yet
+            cli.generate(np.arange(1, 10, dtype=np.int32), 4)
+            plan = (
+                FaultPlan(seed=0)
+                .arm("stepper.step", times=1)
+                .arm("scheduler.loop", times=1, after=4)
+            )
+            with plan:
+                with pytest.raises(InternalError):
+                    cli.generate(np.arange(1, 8, dtype=np.int32), 4)
+                deadline = time.monotonic() + 30
+                while (
+                    time.monotonic() < deadline
+                    and eng.last_postmortem is None
+                ):
+                    time.sleep(0.05)
+            assert plan.fired("stepper.step") == 1
+            assert plan.fired("scheduler.loop") == 1
+            reply, _ = cli._call({"verb": "postmortem"})
+            assert set(reply) == VERB_KEYS
+            pm = reply["postmortem"]
+            assert set(pm) == BUNDLE_KEYS
+            assert pm["reason"] == "watchdog_trip"
+            assert pm["component"] == "serving_engine"
+            # the injected seams are ON TAPE, by name
+            sites = [
+                e["site"] for e in pm["events"]
+                if e["kind"] == "fault.fired"
+            ]
+            assert "stepper.step" in sites
+            assert "scheduler.loop" in sites
+            # the blamed slot is on tape, and matches the quarantine
+            (blame,) = [
+                e for e in pm["events"]
+                if e["kind"] == "scheduler.blame"
+            ]
+            (quar,) = [
+                e for e in pm["events"]
+                if e["kind"] == "scheduler.quarantine"
+            ]
+            assert blame["slot"] == quar["slot"]
+            assert isinstance(blame["request_id"], int)
+            # working iterations were taped always-on (no tracing)
+            assert any(
+                e["kind"] == "scheduler.iteration" for e in pm["events"]
+            )
+            # the bundle also landed on disk, newest-first readable
+            loaded, path = latest_postmortem(str(tmp_path))
+            assert loaded["reason"] == "watchdog_trip"
+            assert reply["path"] == path
+            # the engine healed: post-trip traffic still serves
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    cli.generate(np.arange(1, 6, dtype=np.int32), 3)
+                    break
+                except InternalError:
+                    time.sleep(0.05)
+            else:
+                pytest.fail("engine never recovered after the trip")
+    finally:
+        srv.shutdown()
+
+
+def test_slo_breach_health_verdict_recorder_counter_end_to_end(
+    lm_model,
+):
+    """SLO breach -> health verdict -> recorder event -> breach
+    counter, on a live server: an absurd latency objective breaches on
+    the first real request; ``health`` names the violating series, the
+    ring records the transition, the registry counts it."""
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+
+    eng = ServingEngine(
+        lm_model, num_slots=2, prefill_chunk=4,
+        slos=default_serving_slos(latency_p99_s=1e-9, min_count=1),
+        slo_interval=0.0,  # every health poll re-evaluates
+    )
+    srv = ServingServer(eng).start()
+    try:
+        with ServingClient("127.0.0.1", srv.port) as cli:
+            h0 = cli.health()
+            assert h0["slo"] == "ok"  # nothing observed yet
+            cli.generate(np.arange(1, 10, dtype=np.int32), 4)
+            h = cli.health()
+            assert h["slo"] == "breach"
+            (v,) = [
+                x for x in h["slo_violations"]
+                if x["name"] == "latency_p99"
+            ]
+            assert v["series"] == "serving_request_total_seconds"
+            assert v["value"] > v["threshold"]
+            samples = cli.metrics()
+            by = {s["name"]: s for s in samples}
+            assert by["serving_slo_breaches"]["value"] >= 1
+            assert by["serving_slo_status"]["value"] == 2
+            assert len(eng.recorder.events("slo.breach")) == 1
+            # the verdict (forced, fresh) rides any bundle dumped now
+            bundle, _ = eng.dump_postmortem("manual")
+            assert bundle["slo"]["slo"] == "breach"
+    finally:
+        srv.shutdown()
+
+
+def test_fleet_sweep_ejects_on_sustained_slo_breach(lm_model):
+    """The fleet side: a replica breaching its SLOs for
+    ``eject_on_slo_breach`` consecutive polls is ejected (with a
+    router bundle), and CANNOT rejoin while the breach persists."""
+    from distkeras_tpu.serving import FleetController
+
+    ctl = FleetController(
+        lm_model, replicas=2, num_slots=2,
+        slos=default_serving_slos(latency_p99_s=1e-9, min_count=1),
+        slo_interval=0.0,
+        router_kw=dict(health_interval=0.05, eject_on_slo_breach=2),
+    ).start()
+    try:
+        with ctl.client() as c:
+            # drive one generate: whichever replica served it now
+            # breaches its (absurd) latency objective forever
+            c.generate(np.arange(1, 10, dtype=np.int32), 4)
+            deadline = time.monotonic() + 20
+            ejected = None
+            while time.monotonic() < deadline and ejected is None:
+                for r in ctl.router.replicas():
+                    if r["state"] == "ejected":
+                        ejected = r
+                time.sleep(0.02)
+            assert ejected is not None, ctl.router.replicas()
+            assert ejected["consecutive_slo_breaches"] >= 2
+            pm, _ = ctl.router.postmortem()
+            assert pm["reason"] == "replica_ejected"
+            (ej,) = [
+                e for e in pm["events"] if e["kind"] == "router.eject"
+            ]
+            assert ej["cause"] == "slo_breach"
+            # sustained breach: it stays out (the sweep keeps polling,
+            # the verdict keeps breaching, no rejoin happens)
+            time.sleep(0.3)
+            states = {
+                tuple(r["endpoint"]): r["state"]
+                for r in ctl.router.replicas()
+            }
+            assert states[tuple(ejected["endpoint"])] == "ejected"
+            # the fleet still serves from the healthy sibling
+            out = c.generate(np.arange(1, 6, dtype=np.int32), 3)
+            assert out.size == 5 + 3
+    finally:
+        ctl.stop()
+
+
+def test_ps_commit_interval_histograms_and_straggler_gauge():
+    """Satellite 3: per-worker commit-interval histograms plus the
+    ``training_ps_straggler`` gauge (max/median of per-worker mean
+    intervals) — a worker committing 10x slower than its peers shows
+    a ratio near 10."""
+    from distkeras_tpu.parameter_servers import ParameterServer
+
+    ps = ParameterServer({"w": np.zeros(3, np.float32)})
+    by = {
+        s["name"]: s for s in ps.metrics_snapshot() if not s["labels"]
+    }
+    assert by["training_ps_straggler"]["value"] is None  # no workers yet
+    # three workers: two fast (simulated 10 ms cadence), one slow
+    # (100 ms) — drive the clock via the recorded last-commit stamps
+    for wid in (0, 1, 2):
+        ps.commit({"w": np.ones(3, np.float32)}, commit_id=(wid, 0))
+    for seq in range(1, 4):
+        for wid, dt in ((0, 0.01), (1, 0.01), (2, 0.1)):
+            ps._commit_last[wid] -= dt  # age the last stamp by dt
+            ps.commit(
+                {"w": np.ones(3, np.float32)}, commit_id=(wid, seq)
+            )
+    samples = ps.metrics_snapshot()
+    agg = [
+        s for s in samples
+        if s["name"] == "training_ps_commit_interval_seconds"
+        and not s["labels"]
+    ]
+    assert agg and agg[0]["count"] == 9  # 3 workers x 3 intervals
+    workers = {
+        s["labels"]["worker"]
+        for s in samples
+        if s["name"] == "training_ps_commit_interval_seconds"
+        and s["labels"]
+    }
+    assert workers == {"0", "1", "2"}
+    by = {s["name"]: s for s in samples if not s["labels"]}
+    ratio = by["training_ps_straggler"]["value"]
+    assert 5.0 < ratio < 20.0, ratio  # ~10x, bucket/clock tolerance
+
+
+# ------------------------------------------------------------------- tools
+
+
+def test_dkt_postmortem_render_is_socketless():
+    from dkt_postmortem import render_bundle
+
+    r = FlightRecorder()
+    r.record("scheduler.blame", slot=1, request_id=7)
+    r.fault_observer("stepper.step", "raise", {"slot": 1})
+    bundle = build_postmortem(
+        "serving_engine", "watchdog_trip", recorder=r,
+        in_flight=[{"request_id": 7, "state": "decoding",
+                    "trace_id": "abc123"}],
+        config={"num_slots": 2},
+        trace_spans=[{"name": "serving.decode", "trace_id": "abc123",
+                      "span_id": "s", "parent_id": None,
+                      "start": time.time(), "duration_ms": 12.5,
+                      "status": "ok"}],
+        slo={"slo": "breach", "violations": [
+            {"name": "lat", "series": "serving_request_total_seconds",
+             "value": 2.0, "threshold": 1.0, "verdict": "breach"},
+        ]},
+    )
+    out = render_bundle(bundle)
+    assert "POST-MORTEM  serving_engine  reason=watchdog_trip" in out
+    assert "scheduler.blame" in out and "slot=1" in out
+    assert "fault.fired" in out and "stepper.step" in out
+    assert "span serving.decode" in out and "trace=abc123" in out
+    assert "slo: breach" in out and "serving_request_total_seconds" in out
+    assert "request_id=7" in out  # the in-flight table
+
+
+def test_dkt_postmortem_main_reads_file_dir_and_verb(
+    lm_model, tmp_path, capsys
+):
+    import dkt_postmortem
+
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(
+        lm_model, num_slots=2, prefill_chunk=4,
+        postmortem_dir=str(tmp_path),
+    ).start()
+    srv = ServingServer(eng).start()
+    try:
+        eng.generate(np.arange(1, 8, dtype=np.int32), 3)
+        _, path = eng.dump_postmortem("manual", detail={"via": "test"})
+        assert dkt_postmortem.main([path]) == 0
+        assert "reason=manual" in capsys.readouterr().out
+        assert dkt_postmortem.main([str(tmp_path)]) == 0  # dir form
+        capsys.readouterr()
+        assert dkt_postmortem.main(
+            ["--host", "127.0.0.1", "--port", str(srv.port)]
+        ) == 0
+        assert "reason=manual" in capsys.readouterr().out
+    finally:
+        srv.shutdown()
+
+
+def test_dkt_top_ps_scrape(capsys):
+    import dkt_top
+
+    from distkeras_tpu.parameter_servers import (
+        DeltaParameterServer,
+        RemoteParameterServerClient,
+        SocketParameterServer,
+    )
+
+    srv = SocketParameterServer(
+        DeltaParameterServer({"w": np.zeros(3, np.float32)}),
+        host="127.0.0.1",
+    )
+    srv.start()
+    try:
+        cli = RemoteParameterServerClient("127.0.0.1", srv.port)
+        for seq in range(3):
+            cli.commit(
+                {"w": np.ones(3, np.float32)}, commit_id=(0, seq)
+            )
+        m = cli.metrics()
+        assert m["role"] == "primary"
+        names = {s["name"] for s in m["metrics"]}
+        assert "training_ps_commits" in names
+        assert "training_ps_straggler" in names
+        cli.close()
+        assert dkt_top.main(
+            ["127.0.0.1", str(srv.port), "--once", "--ps"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "training_ps_commits" in out and "(primary)" in out
+    finally:
+        srv.stop()
+
+
+def test_router_postmortem_verb_empty_then_populated(lm_model):
+    from distkeras_tpu.serving import FleetController
+
+    ctl = FleetController(
+        lm_model, replicas=2, num_slots=2,
+        router_kw=dict(health_interval=0.05, eject_after=2),
+    ).start()
+    try:
+        with ctl.client() as c:
+            assert c.postmortem() is None
+            ctl.replicas[0].stop(drain=False)  # self-reports draining
+            deadline = time.monotonic() + 20
+            while (
+                time.monotonic() < deadline
+                and ctl.router.last_postmortem is None
+            ):
+                time.sleep(0.02)
+            pm = c.postmortem()
+            assert set(pm) == BUNDLE_KEYS
+            assert pm["component"] == "fleet_router"
+            assert pm["reason"] == "replica_ejected"
+            assert any(
+                e["kind"] == "router.eject" for e in pm["events"]
+            )
+            # rotation books ride as the in-flight table
+            assert all("endpoint" in row for row in pm["in_flight"])
+    finally:
+        ctl.stop()

@@ -76,6 +76,90 @@ def test_read_metrics_tolerates_truncated_final_line(tmp_path):
         read_metrics(bad)
 
 
+def test_metrics_logger_size_bounded_rotation(tmp_path):
+    """``max_bytes`` keeps a week-long soak's sink bounded: the active
+    file rotates through ``path.1`` ... ``path.keep`` (oldest dropped),
+    every segment stays whole-line JSONL, and ``read_metrics`` reads
+    across the segments in append order."""
+    import os
+
+    from distkeras_tpu.utils.profiling import rotated_segments
+
+    path = str(tmp_path / "m.jsonl")
+    log = MetricsLogger(path, max_bytes=200, keep=3)
+    for i in range(60):
+        log.log(event="tick", i=i)
+    assert log.rotations > 3  # rotation actually happened
+    segs = rotated_segments(path)
+    assert [os.path.basename(s) for s in segs] == [
+        "m.jsonl.3", "m.jsonl.2", "m.jsonl.1", "m.jsonl",
+    ]  # bounded at keep rotated segments + the active file
+    for seg in segs:
+        assert os.path.getsize(seg) <= 200  # the bound held per file
+    records = read_metrics(path)
+    idx = [r["i"] for r in records]
+    # append order preserved across segments; newest records survive,
+    # oldest were dropped with the rotated-out segment
+    assert idx == sorted(idx) and idx[-1] == 59
+    assert 0 < len(records) < 60
+    # an unrotated file still reads as before
+    plain = str(tmp_path / "plain.jsonl")
+    MetricsLogger(plain).log(event="only")
+    assert [r["event"] for r in read_metrics(plain)] == ["only"]
+    with pytest.raises(ValueError):
+        MetricsLogger(plain, max_bytes=0)
+    with pytest.raises(ValueError):
+        MetricsLogger(plain, keep=0)
+
+
+def test_read_metrics_rotated_torn_tail_semantics(tmp_path):
+    """Across rotated segments, only the ACTIVE file's final line may
+    be torn (a crash mid-append); a torn line in a rotated segment is
+    corruption — rotation happens on a line boundary — and stays
+    loud."""
+    import json
+
+    path = str(tmp_path / "m.jsonl")
+    log = MetricsLogger(path, max_bytes=120, keep=2)
+    for i in range(12):
+        log.log(event="tick", i=i)
+    with open(path, "a") as f:
+        f.write('{"ts": 3, "event": "c", "i"')  # crash mid-append
+    records = read_metrics(path)  # salvages everything whole
+    assert records and records[-1]["event"] == "tick"
+    with pytest.raises(json.JSONDecodeError):
+        read_metrics(path, strict=True)
+    # torn tail in a ROTATED segment: loud regardless of strict
+    with open(path + ".1", "a") as f:
+        f.write('{"torn')
+    with pytest.raises(json.JSONDecodeError):
+        read_metrics(path)
+
+
+def test_metrics_logger_repairs_torn_tail_on_reopen(tmp_path):
+    """A process that died mid-append leaves a torn final line; a
+    RESTARTED logger on the same path must drop it before appending —
+    otherwise the restart's appends turn the salvageable torn TAIL
+    into mid-file garbage (and rotation would archive it into a
+    strict segment), destroying the whole read."""
+    import json
+
+    path = str(tmp_path / "m.jsonl")
+    log = MetricsLogger(path, max_bytes=120, keep=2)
+    for i in range(3):
+        log.log(event="before", i=i)
+    with open(path, "a") as f:
+        f.write('{"ts": 3, "event": "c", "i"')  # crash mid-append
+    log2 = MetricsLogger(path, max_bytes=120, keep=2)  # the restart
+    for i in range(8):  # enough to rotate the repaired file
+        log2.log(event="after", i=i)
+    records = read_metrics(path)  # parses end to end, no garbage
+    events = [r["event"] for r in records]
+    assert "c" not in events  # the torn record is gone, as salvage would
+    assert events[-1] == "after"
+    assert read_metrics(path, strict=True) == records  # fully whole
+
+
 def test_history_throughput():
     h = TrainingHistory()
     h.record_training_start()

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""check_bench — bench-regression gate for the committed artifacts.
+
+The committed ``BENCH_SERVING.json`` / ``BENCH_FLEET.json`` carry the
+repo's performance claims (PERF.md quotes them), but nothing used to
+stop them from silently rotting: a change that halved the serving
+ratio would pass tier-1 as long as the schema held, and the stale
+committed numbers would keep telling the old story. This gate closes
+that: it compares a FRESH ``--smoke`` bench run's key ratios against
+the committed artifact within STATED tolerances, and is wired into
+``tests/test_bench_harness.py`` so a perf regression fails tier-1
+instead of rotting the numbers.
+
+Tolerance philosophy (stated, not vibes):
+
+- **Invariants** hold at ANY scale: outputs token-identical, the
+  affinity side's hit rate >= the random side's, zero-reuse traffic
+  hits nothing, a quiet bench has zero failovers. A violated
+  invariant is a correctness bug, not noise.
+- **Ratio bands**: smoke-scale ratios are NOISY (2 slots, 6 requests,
+  1 repeat on a contended core), so a fresh smoke ratio must only
+  land within a stated factor band of the committed value — the
+  gate catches a collapse (chunking suddenly 5× slower than baseline),
+  not a 20% wobble. The committed values themselves carry the tight
+  claims and are pinned separately (``COMMITTED_FLOORS`` here, plus
+  the dedicated committed-row tests).
+
+Usage::
+
+    python tools/check_bench.py --kind serving \
+        --fresh /tmp/BENCH_SERVING.json --committed BENCH_SERVING.json
+    python tools/check_bench.py --kind fleet --run   # runs --smoke
+        # itself in a temp dir, then compares against the repo artifact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: a fresh smoke ratio must land within this FACTOR of the committed
+#: ratio, either way (smoke scale is noisy; collapses are not). The
+#: serving smoke interleaves its A/B inside one process, so its
+#: ratios are fairly stable even under load; the fleet smoke runs 5+
+#: processes (2 fleets + a single + the driver) time-sharing one
+#: core, and its fleet_vs_single ratios have been observed to swing
+#: ~6x between an idle and a suite-loaded machine — hence the wider
+#: band there (still far inside "the feature stopped working").
+SERVING_RATIO_BAND = 4.0
+FLEET_RATIO_BAND = 10.0
+
+#: dotted paths of the ratio keys the band applies to, per artifact
+SERVING_RATIO_KEYS = (
+    "continuous_vs_serial.speedup",
+    "workloads.production_mix.tokens_per_sec_ratio",
+    "workloads.mixed_long.tokens_per_sec_ratio",
+    "workloads.prefix_heavy.tokens_per_sec_ratio",
+    "tracing_overhead.traced_vs_untraced",
+    "recorder_overhead.recorder_vs_off",
+)
+FLEET_RATIO_KEYS = (
+    "workloads.prefix_heavy.fleet_vs_single",
+    "workloads.zero_reuse.fleet_vs_single",
+)
+
+#: floors the COMMITTED artifact must clear — the claims PERF.md
+#: quotes; regenerating the artifact with a worse number fails here
+COMMITTED_FLOORS = {
+    "serving": {
+        # per-request tracing costs < 3% (PR 7's bar)
+        "tracing_overhead.traced_vs_untraced": 0.97,
+        # the always-on flight recorder costs < 2% (this PR's budget)
+        "recorder_overhead.recorder_vs_off": 0.98,
+    },
+    "fleet": {},
+}
+
+
+def _get(record: dict, dotted: str):
+    cur = record
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _band_check(fresh, committed, keys, band, violations):
+    for key in keys:
+        f, c = _get(fresh, key), _get(committed, key)
+        if f is None or c is None:
+            violations.append(
+                f"{key}: missing ({'fresh' if f is None else 'committed'})"
+            )
+            continue
+        if not (c / band <= f <= c * band):
+            violations.append(
+                f"{key}: fresh {f} outside {band}x band of "
+                f"committed {c}"
+            )
+
+
+def _committed_floors(committed, kind, violations):
+    for key, floor in COMMITTED_FLOORS[kind].items():
+        c = _get(committed, key)
+        if c is None:
+            violations.append(f"{key}: missing from committed artifact")
+        elif c < floor:
+            violations.append(
+                f"{key}: committed {c} below the claimed floor {floor}"
+            )
+
+
+def compare_serving(fresh: dict, committed: dict) -> list[str]:
+    """Violations of the serving gate (empty list = pass)."""
+    violations: list[str] = []
+    for rec, tag in ((fresh, "fresh"), (committed, "committed")):
+        for name, wl in rec.get("workloads", {}).items():
+            if wl.get("outputs_identical") is not True:
+                violations.append(
+                    f"{tag} workloads.{name}: outputs not identical"
+                )
+        for row in ("tracing_overhead", "recorder_overhead"):
+            r = rec.get(row)
+            if r is None:
+                violations.append(f"{tag}: missing {row} row")
+            elif r.get("outputs_identical") is not True:
+                violations.append(f"{tag} {row}: outputs not identical")
+    _band_check(
+        fresh, committed, SERVING_RATIO_KEYS, SERVING_RATIO_BAND,
+        violations,
+    )
+    _committed_floors(committed, "serving", violations)
+    return violations
+
+
+def compare_fleet(fresh: dict, committed: dict) -> list[str]:
+    """Violations of the fleet gate (empty list = pass)."""
+    violations: list[str] = []
+    for rec, tag in ((fresh, "fresh"), (committed, "committed")):
+        for name, wl in rec.get("workloads", {}).items():
+            if wl.get("outputs_identical") is not True:
+                violations.append(
+                    f"{tag} workloads.{name}: outputs not identical"
+                )
+            # the claimed effect, directionally, at any scale
+            if wl.get("affinity_hit_rate", 0) < wl.get(
+                "random_hit_rate", 0
+            ):
+                violations.append(
+                    f"{tag} workloads.{name}: affinity hit rate below "
+                    "random's"
+                )
+            for side in ("fleet_affinity", "fleet_random"):
+                r = (wl.get(side) or {}).get("router") or {}
+                if r.get("failovers", 0) != 0:
+                    violations.append(
+                        f"{tag} workloads.{name}.{side}: failovers on "
+                        "a quiet bench"
+                    )
+        zr = rec.get("workloads", {}).get("zero_reuse", {})
+        if zr.get("affinity_hit_rate") != 0.0 or (
+            zr.get("random_hit_rate") != 0.0
+        ):
+            violations.append(
+                f"{tag} zero_reuse: nonzero hit rate on zero-reuse "
+                "traffic"
+            )
+    # committed strictly separates the A/B (the adjudicated claim)
+    ph = committed.get("workloads", {}).get("prefix_heavy", {})
+    if not (
+        ph.get("affinity_hit_rate", 0) > ph.get("random_hit_rate", 1)
+    ):
+        violations.append(
+            "committed prefix_heavy: affinity hit rate does not beat "
+            "random's"
+        )
+    _band_check(
+        fresh, committed, FLEET_RATIO_KEYS, FLEET_RATIO_BAND,
+        violations,
+    )
+    _committed_floors(committed, "fleet", violations)
+    return violations
+
+
+COMPARATORS = {"serving": compare_serving, "fleet": compare_fleet}
+ARTIFACTS = {"serving": "BENCH_SERVING.json", "fleet": "BENCH_FLEET.json"}
+
+
+def run_smoke(kind: str, workdir: str) -> dict:
+    """Run the kind's ``--smoke`` bench in ``workdir`` and return the
+    fresh record (what ``--run`` and the harness test share)."""
+    import subprocess
+
+    script = {"serving": "bench_serving.py", "fleet": "bench_fleet.py"}[
+        kind
+    ]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, script), "--smoke"],
+        cwd=workdir, check=True, env=env,
+    )
+    with open(os.path.join(workdir, ARTIFACTS[kind])) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--kind", choices=("serving", "fleet"),
+                    required=True)
+    ap.add_argument("--fresh", help="fresh --smoke artifact to grade")
+    ap.add_argument("--committed",
+                    help="committed artifact (default: the repo's)")
+    ap.add_argument("--run", action="store_true",
+                    help="run the --smoke bench in a temp dir to "
+                         "produce the fresh artifact")
+    args = ap.parse_args(argv)
+
+    committed_path = args.committed or os.path.join(
+        REPO, ARTIFACTS[args.kind]
+    )
+    with open(committed_path) as f:
+        committed = json.load(f)
+    if args.run:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as workdir:
+            fresh = run_smoke(args.kind, workdir)
+    elif args.fresh:
+        with open(args.fresh) as f:
+            fresh = json.load(f)
+    else:
+        ap.error("pass --fresh PATH or --run")
+        return 2
+
+    violations = COMPARATORS[args.kind](fresh, committed)
+    if violations:
+        print(f"BENCH GATE FAILED ({args.kind}):", file=sys.stderr)
+        for v in violations:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    print(f"bench gate ok ({args.kind}): "
+          f"{len(SERVING_RATIO_KEYS if args.kind == 'serving' else FLEET_RATIO_KEYS)}"
+          " ratio bands + invariants hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,10 @@ grepping four logs::
     python tools/dkt_top.py 127.0.0.1 9000 --once        # one snapshot
     python tools/dkt_top.py 127.0.0.1 9000 --prometheus --once  # raw dump
     python tools/dkt_top.py 127.0.0.1 9000 --prometheus  # live raw dump
+    python tools/dkt_top.py 127.0.0.1 7000 --ps          # parameter
+        # server (its b"m" scrape action; works on a standby too) —
+        # commit/pull counters, per-worker commit-interval histograms,
+        # and the training_ps_straggler gauge
 
 No curses: plain ANSI clear-and-redraw, so it works in any terminal
 (and in a pipe with ``--once``).
@@ -94,6 +98,44 @@ def format_table(samples, width: int = 78) -> str:
     return "\n".join(lines)
 
 
+def _ps_loop(args) -> int:
+    """The PS face: scrape the b"m" action and render the same table
+    (the PS registry speaks the identical sample schema). Works on a
+    standby, which refuses pull/commit but serves metrics — the
+    straggler gauge and commit-interval histograms are how a DOWNPOUR
+    run's lagging worker shows up here."""
+    from distkeras_tpu.obs import render_prometheus
+    from distkeras_tpu.parameter_servers import RemoteParameterServerClient
+
+    cli = RemoteParameterServerClient(args.host, args.port)
+    try:
+        while True:
+            m = cli.metrics()
+            label = f"ps:{args.host}:{args.port} ({m.get('role')})"
+            if args.prometheus:
+                out = render_prometheus(m["metrics"])
+            else:
+                out = format_table(
+                    [dict(s) for s in m["metrics"]]
+                )
+            if args.once:
+                print(f"== {label}")
+                print(out)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H")
+            stamp = time.strftime("%H:%M:%S")
+            print(f"dkt_top {label}  {stamp}  "
+                  f"(interval {args.interval}s, ctrl-c to quit)")
+            print(out)
+            sys.stdout.flush()
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+    finally:
+        cli.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("host")
@@ -105,7 +147,13 @@ def main(argv=None) -> int:
     ap.add_argument("--prometheus", action="store_true",
                     help="print the text exposition dump instead of "
                          "the table")
+    ap.add_argument("--ps", action="store_true",
+                    help="the target is a parameter server (PS wire "
+                         "protocol), not a serving server/router")
     args = ap.parse_args(argv)
+
+    if args.ps:
+        return _ps_loop(args)
 
     from distkeras_tpu.serving import ServingClient
 

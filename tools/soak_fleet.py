@@ -23,6 +23,13 @@ The acceptance bar it asserts (and prints as JSON):
   kill -9, failover resends, and the rollover: a mid-request replica
   death still yields one complete trace ending in the client's
   terminal span (the router's span records the failover hop).
+- A POST-MORTEM BUNDLE PER EJECTION — every replica the router ejects
+  (the kill -9 victim above all) dumps one router bundle to the
+  soak's ``postmortem_dir``; bundle count must equal the router's
+  ejection count, every bundle's recorder timeline must carry the
+  ``router.eject`` event naming the ejected endpoint, and at least
+  one must name the kill victim — the injected terminal failure is
+  explainable from the bundle alone, asserted, not eyeballed.
 
 Topology: replicas are REAL subprocesses (``--replica`` runs one)
 booted from a shared quantized serving bundle, each arming its OWN
@@ -199,11 +206,13 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         spawned.append(rep)
         return rep
 
+    pm_dir = os.path.join(workdir, "postmortems")
     ctl = FleetController(
         bundle, replicas=replicas, factory=factory,
         router_kw=dict(
             health_interval=0.2, eject_after=2, connect_timeout=2.0,
             request_timeout=60.0, retry_after_ms=25.0,
+            postmortem_dir=pm_dir,
         ),
     ).start()
 
@@ -316,6 +325,22 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
                 "endpoint": list(vep),
                 "in_flight_at_kill": loaded,
             }
+            # let the ROUTER notice the death (mid-forward failover or
+            # failed polls -> ejection + post-mortem dump) before the
+            # reap deregisters the endpoint — reaping first would
+            # remove the book entry the ejection path records against
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                states = {
+                    tuple(r["endpoint"]): r["state"]
+                    for r in ctl.router.replicas()
+                }
+                if states.get(vep) == "ejected":
+                    break
+                time.sleep(0.01)
+            summary["kill"]["ejected_before_reap"] = (
+                states.get(vep) == "ejected"
+            )
             ctl.reap_dead()
             time.sleep(pace)
             summary["rollover"] = ctl.rollover(timeout=300)
@@ -356,10 +381,44 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         }
     finally:
         stop_evt.set()
+        ejections_final = (
+            0 if ctl.router is None else ctl.router.stats()["ejections"]
+        )
         ctl.stop()
         for rep in spawned:
             if rep.alive():
                 rep.kill9()
+        # the post-mortem bar, read AFTER shutdown (every dump landed):
+        # one router bundle per ejection, each carrying the eject event
+        # naming its endpoint; the kill victim must be among them
+        bundles = []
+        try:
+            for n in sorted(os.listdir(pm_dir)):
+                if n.startswith("postmortem_") and n.endswith(".json"):
+                    with open(os.path.join(pm_dir, n)) as f:
+                        bundles.append(json.load(f))
+        except OSError:
+            pass
+        victim_ep = "{}:{}".format(*summary.get("kill", {}).get(
+            "endpoint", ["?", "?"]
+        ))
+        well_formed = sum(
+            b["reason"] == "replica_ejected"
+            and any(
+                e["kind"] == "router.eject" and e.get("endpoint")
+                for e in b["events"]
+            )
+            for b in bundles
+        )
+        victim_named = any(
+            e["kind"] == "router.eject" and e.get("endpoint") == victim_ep
+            for b in bundles
+            for e in b["events"]
+        )
+        summary["ejections"] = ejections_final
+        summary["postmortems"] = len(bundles)
+        summary["postmortems_well_formed"] = well_formed
+        summary["postmortem_names_victim"] = victim_named
         shutil.rmtree(workdir, ignore_errors=True)
 
     typed_total = sum(summary["typed_errors"].values())
@@ -380,6 +439,10 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
             replicas - 1  # the kill -9 victim is reaped, not upgraded
         )
         and summary["completed"] > 0
+        and summary["ejections"] >= 1
+        and summary["postmortems"] == summary["ejections"]
+        and summary["postmortems_well_formed"] == summary["postmortems"]
+        and summary["postmortem_names_victim"]
     )
     return summary
 

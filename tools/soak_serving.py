@@ -19,6 +19,13 @@ The acceptance bar it asserts (and prints as JSON):
   timeline with EXACTLY ONE terminal span. "0 hung / 0 untyped" stops
   being a client-side claim: the instrumentation itself must account
   for where every request ended.
+- A POST-MORTEM BUNDLE PER TERMINAL FAILURE — the armed
+  ``scheduler.loop`` seam kills the scheduler thread repeatedly; every
+  resulting watchdog trip must dump exactly one bundle to the soak's
+  ``postmortem_dir``, and every bundle's flight-recorder timeline must
+  NAME the injected seam (a ``fault.fired`` event at
+  ``scheduler.loop``) — failure triage without a seed replay is the
+  acceptance bar, asserted here, not eyeballed.
 
 The fault mix is seeded (``FaultPlan`` draws probabilistic seams from
 its own RNG), so a failing soak replays exactly with the same seed::
@@ -31,7 +38,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
+import tempfile
 import threading
 import time
 
@@ -74,6 +83,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     ref_gen = CachedSequenceGenerator(model)
     refs = [ref_gen.generate(p[None], steps=max_new)[0] for p in prompts]
 
+    postmortem_dir = tempfile.mkdtemp(prefix="soak_serving_pm_")
     engine = ServingEngine(
         model, num_slots=4, queue_capacity=4, prefix_cache=False,
         # generous grace: the warmup compiles ~5 programs on a possibly
@@ -82,6 +92,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         watchdog_interval=1.0, watchdog_grace=60.0,
         max_restarts=10_000,  # the soak outlives scheduler crashes
         restart_backoff=0.01, quarantine_steps=8,
+        postmortem_dir=postmortem_dir,
         # self-draft: k proposals that always agree, so every scheduler
         # iteration runs the VERIFY program and the armed stepper.verify
         # seam sees real traffic
@@ -102,6 +113,12 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         .arm("server.reply", action="drop", times=None, probability=0.03)
         .arm("net.send", action="reset", times=None, probability=0.01)
         .arm("net.send", action="truncate", times=None, probability=0.01)
+        # the TERMINAL seam: kill the scheduler thread outright — once
+        # deterministically (the guaranteed trip even at smoke scale)
+        # and then probabilistically — so every watchdog trip's
+        # post-mortem bundle can be asserted below
+        .arm("scheduler.loop", times=1, after=60)
+        .arm("scheduler.loop", times=None, after=200, probability=0.002)
     )
 
     from distkeras_tpu.obs import timeline_complete
@@ -185,7 +202,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     summary["fired_by_site"] = {
         s: plan.fired(s)
         for s in ("stepper.step", "stepper.verify", "server.reply",
-                  "net.send")
+                  "net.send", "scheduler.loop")
     }
     engine_stats = engine.stats()
     summary["engine"] = {
@@ -203,13 +220,42 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
                       "fallback_steps", "drafted_tokens",
                       "accepted_draft_tokens", "rejected_draft_tokens")
         }
-    server.shutdown()
+    server.shutdown()  # joins the supervisor: every dump has landed
+    # the post-mortem bar: one bundle PER watchdog trip, and every
+    # bundle's recorder timeline names the injected seam that killed
+    # the scheduler (fault.fired at scheduler.loop)
+    trips = engine.stats()["watchdog_trips"]
+    bundles = sorted(
+        os.path.join(postmortem_dir, n)
+        for n in os.listdir(postmortem_dir)
+        if n.startswith("postmortem_") and n.endswith(".json")
+    )
+    named_seam = 0
+    for path in bundles:
+        with open(path) as f:
+            bundle = json.load(f)
+        sites = {
+            e.get("site")
+            for e in bundle["events"]
+            if e["kind"] == "fault.fired"
+        }
+        if bundle["reason"] == "watchdog_trip" and (
+            "scheduler.loop" in sites
+        ):
+            named_seam += 1
+    summary["engine"]["watchdog_trips"] = trips
+    summary["postmortems"] = len(bundles)
+    summary["postmortems_naming_seam"] = named_seam
+    shutil.rmtree(postmortem_dir, ignore_errors=True)
     summary["ok"] = (
         hung == 0
         and summary["untyped_errors"] == 0
         and summary["corrupt_outputs"] == 0
         and summary["trace_incomplete"] == 0
         and summary["trace_attempts"] > 0
+        and trips >= 1
+        and len(bundles) == trips
+        and named_seam == len(bundles)
     )
     return summary
 

@@ -12,7 +12,11 @@ Two phases, each with its own acceptance bar (printed as JSON):
    commit application: the promoted standby's center equals
    ``init + workers * windows`` to the bit, and its dedup table carries
    every worker's full sequence — resends across the failover were
-   absorbed, none were lost.
+   absorbed, none were lost. The PROMOTION — the kill's terminal
+   event — must dump a post-mortem bundle whose flight-recorder
+   timeline shows the standby's commit-stream position and NAMES the
+   injected seams (``fault.fired`` events at the armed ``ps.*``
+   sites) — asserted, not eyeballed.
 
 2. **Training phase** — two identical DOWNPOUR runs (remote PS + warm
    standby, thread mode, seeded data/model), one unfaulted, one with
@@ -75,6 +79,9 @@ def run_ledger_phase(workers=4, windows=40, seed=0, join_budget=60.0) -> dict:
     def params(v=0.0):
         return {"w": np.full((4,), v, np.float32)}
 
+    import tempfile
+
+    pm_dir = tempfile.mkdtemp(prefix="soak_training_pm_")
     primary_ps = DeltaParameterServer(params(0.0))
     # durability gate on: no commit is acked without a live replica, so a
     # kill landing inside a replication-outage window cannot lose acked
@@ -87,6 +94,7 @@ def run_ledger_phase(workers=4, windows=40, seed=0, join_budget=60.0) -> dict:
     standby = SocketParameterServer(
         standby_ps, host="127.0.0.1",
         standby_of=("127.0.0.1", primary.port),
+        postmortem_dir=pm_dir,
     )
     standby.start()
     endpoints = [("127.0.0.1", primary.port), ("127.0.0.1", standby.port)]
@@ -161,10 +169,44 @@ def run_ledger_phase(workers=4, windows=40, seed=0, join_budget=60.0) -> dict:
             for s in ("ps.pull", "ps.commit", "ps.replicate", "net.send")
         },
     }
+    # the post-mortem bar: the promotion (the kill's terminal event)
+    # dumped exactly one bundle; its recorder timeline carries the
+    # commit-stream position and names the injected ps.* seams
+    import glob as _glob
+    import shutil
+
+    bundles = sorted(_glob.glob(os.path.join(pm_dir, "postmortem_*.json")))
+    pm_ok = False
+    if len(bundles) == 1:
+        with open(bundles[0]) as f:
+            bundle = json.load(f)
+        kinds = {e["kind"] for e in bundle["events"]}
+        fired_sites = {
+            e.get("site")
+            for e in bundle["events"]
+            if e["kind"] == "fault.fired"
+        }
+        pm_ok = (
+            bundle["reason"] == "promotion"
+            and "ps.promoted" in kinds
+            and "ps.commit" in kinds  # the stream position is on tape
+            and bool(
+                fired_sites
+                & {"ps.pull", "ps.commit", "ps.replicate", "net.send"}
+            )
+        )
+        summary["postmortem"] = {
+            "reason": bundle["reason"],
+            "event_kinds": sorted(kinds),
+            "fired_sites": sorted(s for s in fired_sites if s),
+        }
+    summary["postmortems"] = len(bundles)
+    summary["postmortem_names_seam"] = pm_ok
+    shutil.rmtree(pm_dir, ignore_errors=True)
     standby.stop()
     summary["ok"] = (
         hung == 0 and not errors and summary["promoted"]
-        and summary["exactly_once"]
+        and summary["exactly_once"] and pm_ok
     )
     return summary
 
