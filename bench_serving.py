@@ -120,6 +120,58 @@ def _make_spec_incompressible(n, seq, vocab, rng):
     return reqs
 
 
+def _make_long_tail(n, seq, vocab, rng):
+    """Long-tail mixed-length traffic — the paged A/B's adjudicating
+    workload: most requests are SHORT (the mass of real mixed traffic),
+    a tail is long. A dense (num_slots, seq_len) bank charges every
+    one of them worst-case sequence memory; the paged pool charges
+    what each actually needs, so the same KV byte budget sustains more
+    concurrent slots."""
+    reqs = []
+    for _ in range(n):
+        r = rng.random()
+        if r < 0.70:  # short mass
+            plen = int(rng.integers(1, max(2, seq // 8)))
+        elif r < 0.95:  # medium
+            plen = int(rng.integers(seq // 8, max(seq // 8 + 1, seq // 3)))
+        else:  # the long tail
+            plen = int(rng.integers(seq // 2, max(seq // 2 + 1, 3 * seq // 4)))
+        steps = int(rng.integers(max(2, seq // 16), max(3, seq // 8)))
+        steps = max(1, min(steps, seq - plen))
+        reqs.append((rng.integers(0, vocab, plen).astype(np.int32), steps))
+    return reqs
+
+
+def _make_short_uniform(n, seq, vocab, rng):
+    """Uniform SHORT prompts and budgets. The expected adversarial
+    row going in (no length diversity for reservation to exploit) —
+    measured, it is where the paged step's DYNAMIC attention extent
+    pays instead: every table is short, so the bucketed gather attends
+    a fraction of the dense bank's fixed worst-case extent. Committed
+    as measured either way."""
+    plen = max(2, seq // 8)
+    steps = max(2, seq // 8)
+    return [
+        (rng.integers(0, vocab, plen).astype(np.int32), steps)
+        for _ in range(n)
+    ]
+
+
+def _make_long_uniform(n, seq, vocab, rng):
+    """The paged A/B's ADVERSARIAL row: every request near the
+    sequence capacity. Reservations are worst-case for everyone (the
+    equal-byte pool admits no more concurrency than the dense bank),
+    the attention extent is full on both sides, and paging's
+    gather/scatter plus allocator bookkeeping have NO occupancy win to
+    pay for them — the honest cost row."""
+    plen = 5 * seq // 8
+    steps = max(2, seq // 8)
+    return [
+        (rng.integers(0, vocab, plen).astype(np.int32), steps)
+        for _ in range(n)
+    ]
+
+
 def _make_production_mix(n, seq, vocab, rng, headers):
     """The adjudicating workload: 2/3 of requests extend one of the
     shared headers with a fresh mixed-length suffix (real serving
@@ -193,7 +245,8 @@ def _pct(per_repeat):
 
 
 def _engine(model, reqs, *, slots, prefill_chunk, prefix_cache,
-            speculative=None, draft_k=4, flight_recorder=True):
+            speculative=None, draft_k=4, flight_recorder=True,
+            paged=False, page_size=16, num_pages=None):
     from distkeras_tpu.serving import ServingEngine
 
     return ServingEngine(
@@ -201,6 +254,7 @@ def _engine(model, reqs, *, slots, prefill_chunk, prefix_cache,
         prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
         speculative=speculative, draft_k=draft_k,
         flight_recorder=flight_recorder,
+        paged=paged, page_size=page_size, num_pages=num_pages,
     ).start()
 
 
@@ -211,12 +265,26 @@ def _reset(eng, prime):
     the ``prime`` requests (e.g. one request carrying the workload's
     shared header — driven twice, because two-touch admission only
     stores a prefix on its second miss); scheduler counters zeroed."""
+    st0 = eng._stepper
+    if getattr(st0, "paged", False):
+        # the device-resident index is reuse state like the host store:
+        # cleared before every timed pass so hits come from the pass's
+        # own shared structure (the prime re-seeds it below); pool and
+        # index LEDGERS reset so the committed snapshot covers the
+        # timed passes, not the warm drives
+        if st0.prefix_index is not None:
+            st0.prefix_index.clear()
+            st0.prefix_index.reset_counters()
+        st0._kv_alloc.reset_counters()
     if eng.prefix_store is not None:
         eng.prefix_store.clear()
         if prime:
             _drive(eng, prime)
             _drive(eng, prime)
         eng.prefix_store.reset_counters()
+    elif prime and getattr(st0, "paged", False):
+        _drive(eng, prime)
+        _drive(eng, prime)
     for k in eng.batcher.counters:
         eng.batcher.counters[k] = 0
     st = eng._stepper
@@ -575,6 +643,132 @@ def _measure_recorder(model, reqs, refs, *, slots, chunk, arrivals,
     }
 
 
+def _measure_paged_ab(model, reqs, refs, *, slots, chunk, arrivals,
+                      repeats, page_size=16, prime=None,
+                      slot_multiple=2):
+    """Paged-vs-dense A/B at an EQUAL KV byte budget: the dense side
+    serves ``slots`` slots each pinned to worst-case sequence memory;
+    the paged side spends the SAME pool bytes (``slots * ceil(seq /
+    page_size)`` pages) across ``slot_multiple x slots`` logical slots,
+    each reserving only what its request needs — the occupancy unlock
+    under mixed-length traffic, plus device-resident block-granular
+    prefix sharing. Interleaved timed passes per the PERF.md protocol;
+    outputs on BOTH sides asserted token-identical to the solo refs on
+    every pass (the paged admission paths ride the same pin)."""
+    seq = model.input_shape[0]
+    pool_pages = slots * (-(-seq // page_size)) + 1  # + null sentinel
+    dense = _engine(model, reqs, slots=slots, prefill_chunk=chunk,
+                    prefix_cache=True)
+    paged = _engine(model, reqs, slots=slot_multiple * slots,
+                    prefill_chunk=chunk, prefix_cache=True,
+                    paged=True, page_size=page_size,
+                    num_pages=pool_pages)
+    try:
+        for eng in (dense, paged):  # warm every program family
+            _drive(eng, reqs, arrivals=arrivals)
+            _drive(eng, reqs, arrivals=arrivals)
+        dense_runs, paged_runs = [], []
+        dense_out, paged_out = [], []
+        for _ in range(repeats):
+            _reset(dense, prime)
+            dense_runs.append(
+                _timed_pass(dense, reqs, arrivals, dense_out)
+            )
+            _reset(paged, prime)
+            paged_runs.append(
+                _timed_pass(paged, reqs, arrivals, paged_out)
+            )
+        paged_stats = paged.stats()["paged"]
+    finally:
+        dense.stop()
+        paged.stop()
+    for i, (a, b, r) in enumerate(zip(dense_out[-1], paged_out[-1],
+                                      refs)):
+        assert np.array_equal(a, r), f"paged A/B req {i}: dense != solo"
+        assert np.array_equal(b, r), f"paged A/B req {i}: paged != solo"
+    d_side = _side(dense_runs, True)
+    p_side = _side(paged_runs, True)
+    p_side["paged"] = {
+        k: paged_stats[k]
+        for k in ("page_size", "total_pages", "shared_pages",
+                  "cow_copies", "exhaustions")
+    }
+    p_side["paged"]["device_prefix"] = {
+        k: paged_stats["device_prefix"][k]
+        for k in ("hits", "misses", "hit_pages", "reclaims")
+    }
+    return {
+        "num_requests": len(reqs),
+        "prompt_lens": [int(p.size) for p, _ in reqs],
+        "decode_steps": [int(s) for _, s in reqs],
+        "dense_slots": slots,
+        "paged_slots": slot_multiple * slots,
+        "kv_pool_pages": pool_pages - 1,
+        "dense": d_side,
+        "paged": p_side,
+        "tokens_per_sec_ratio": _ratio(
+            p_side["tokens_per_sec"], d_side["tokens_per_sec"]
+        ),
+        "latency_p99_speedup": _ratio(
+            d_side["latency_ms"]["p99"], p_side["latency_ms"]["p99"]
+        ),
+        "occupancy_ratio": _ratio(
+            p_side["mean_batch_occupancy"],
+            max(d_side["mean_batch_occupancy"], 1e-9),
+        ),
+        "outputs_identical": True,
+    }
+
+
+def _measure_paged_block(model, ref_gen, *, seq, vocab, slots, chunk,
+                         requests, gap_ms, repeats, rng, header,
+                         high_load_factor=3.0):
+    """The full paged-vs-dense block: long-tail mixed lengths at HIGH
+    load (arrivals ``high_load_factor`` x faster than the standard
+    tiers — occupancy only pays when demand exceeds the dense slot
+    count), prefix-heavy reuse (must not regress), and the
+    short-uniform adversarial row."""
+    paged_workloads = {
+        "long_tail_mixed": (
+            _make_long_tail(int(requests * 2), seq, vocab, rng),
+            None,
+        ),
+        "prefix_heavy": (
+            _make_prefix_heavy(requests, seq, vocab, rng, header),
+            _make_prefix_heavy(1, seq, vocab, rng, header),
+        ),
+        "short_uniform": (
+            _make_short_uniform(requests, seq, vocab, rng),
+            None,
+        ),
+        "long_uniform": (
+            _make_long_uniform(requests, seq, vocab, rng),
+            None,
+        ),
+    }
+    block = {
+        "page_size": 16,
+        "high_load_arrival_gap_ms": round(gap_ms / high_load_factor, 3),
+        "workloads": {},
+    }
+    for name, (timed, prime) in paged_workloads.items():
+        refs = _solo_refs(ref_gen, timed)
+        gap = gap_ms / (high_load_factor if name == "long_tail_mixed"
+                        else 1.0)
+        arrivals = np.cumsum(rng.exponential(gap / 1e3, len(timed)))
+        wl = _measure_paged_ab(
+            model, timed, refs, slots=slots, chunk=chunk,
+            arrivals=arrivals, repeats=repeats, prime=prime,
+        )
+        block["workloads"][name] = wl
+        print(json.dumps({f"paged_{name}": {
+            "tokens_per_sec_ratio": wl["tokens_per_sec_ratio"],
+            "occupancy_ratio": wl["occupancy_ratio"],
+            "latency_p99_speedup": wl["latency_p99_speedup"],
+        }}), flush=True)
+    return block
+
+
 def _measure_serial(model, reqs, *, arrivals=None, repeats=1):
     """1 slot + PR 1 config = serve-one-at-a-time through identical
     code (the PR 1 continuity ratio)."""
@@ -620,6 +814,10 @@ def main() -> None:
     ap.add_argument("--recorder-only", action="store_true",
                     help="run ONLY the flight-recorder overhead A/B "
                          "and merge the row into the existing "
+                         "BENCH_SERVING.json")
+    ap.add_argument("--paged-only", action="store_true",
+                    help="run ONLY the paged-vs-dense KV-cache A/B "
+                         "and merge the block into the existing "
                          "BENCH_SERVING.json")
     args = ap.parse_args()
 
@@ -688,6 +886,25 @@ def main() -> None:
             _make_prefix_heavy(1, seq, vocab, rng, header),
         ),
     }
+
+    if args.paged_only:
+        # merge-mode sibling of --tracing-only / --recorder-only:
+        # measure just the paged-vs-dense block into the committed
+        # record, leaving the other workload numbers as measured
+        with open("BENCH_SERVING.json") as f:
+            record = json.load(f)
+        record["paged"] = _measure_paged_block(
+            model, ref_gen, seq=seq, vocab=vocab, slots=args.slots,
+            chunk=chunk, requests=args.requests, gap_ms=gap_ms,
+            repeats=args.repeats, rng=rng, header=header,
+        )
+        with open("BENCH_SERVING.json", "w") as f:
+            json.dump(record, f, indent=2)
+        print(json.dumps({"paged": {
+            n: w["tokens_per_sec_ratio"]
+            for n, w in record["paged"]["workloads"].items()
+        }}))
+        return
 
     if args.recorder_only:
         # merge-mode sibling of --tracing-only: measure just the
@@ -826,6 +1043,13 @@ def main() -> None:
             "recorder_vs_off"
         ],
     }}), flush=True)
+
+    # -- paged-vs-dense KV cache A/B (equal byte budget) --------------------
+    record["paged"] = _measure_paged_block(
+        model, ref_gen, seq=seq, vocab=vocab, slots=args.slots,
+        chunk=chunk, requests=args.requests, gap_ms=gap_ms,
+        repeats=args.repeats, rng=rng, header=header,
+    )
 
     # -- speculative decoding A/B (prompt-lookup drafter) -------------------
     # Speculation pays off only when the model's continuation repeats

@@ -18,6 +18,12 @@ Seam catalogue (the hook points that exist today)::
                         speculative verify (drafts already proposed)
     stepper.prefill     begin_admit / prefill_chunk, before device work
     prefix_cache.fetch  PrefixStore.lookup (engine degrades to a miss)
+    kv.alloc            paging.PageAllocator.alloc, before any pool
+                        state changes — an injected raise makes page
+                        exhaustion / allocator failure happen on
+                        demand; the scheduler surfaces an exhausted
+                        admission as typed retriable ``overloaded``,
+                        never a hung slot or a corrupt stream
     server.dispatch     ServingServer verb dispatch (typed-reply path)
     server.reply        ServingServer before sending a reply frame
     router.dispatch     FleetRouter verb dispatch, before a replica is
@@ -86,6 +92,7 @@ SITES = frozenset(
         "stepper.verify",
         "stepper.prefill",
         "prefix_cache.fetch",
+        "kv.alloc",
         "server.dispatch",
         "server.reply",
         "router.dispatch",

@@ -390,6 +390,13 @@ class CachedSequenceGenerator(SequenceGenerator):
     uncached path's capacity drops are the part being deliberately not
     reproduced.
 
+    This generator is also THE identity reference for the online
+    serving tier: every ``serving.engine.DecodeStepper`` admission
+    path — dense or block-PAGED (gather-based attention over a page
+    pool), fresh / chunked / prefix-cache-hit / CoW-forked alike — is
+    pinned token-identical to this class's solo greedy decode by the
+    serving test suite and the committed bench artifacts.
+
     Supports the LM family's layer shapes: Embedding -> causal
     TransformerBlock xN -> LayerNorm -> Dense (``zoo.transformer_lm``),
     with an optional switch-``MoE`` layer after any block

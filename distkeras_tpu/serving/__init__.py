@@ -45,17 +45,22 @@ from distkeras_tpu.serving.scheduler import (
     EngineStoppedError,
     InternalError,
     OverloadedError,
+    PoolExhaustedError,
     ServeRequest,
     ServingError,
     WindowedBatcher,
 )
+from distkeras_tpu.serving.paging import PageAllocator
 from distkeras_tpu.serving.engine import (
     DecodeStepper,
     ModelDrafter,
     NgramDrafter,
     ServingEngine,
 )
-from distkeras_tpu.serving.prefix_cache import PrefixStore
+from distkeras_tpu.serving.prefix_cache import (
+    DevicePrefixIndex,
+    PrefixStore,
+)
 from distkeras_tpu.serving.server import ServingServer, serve
 from distkeras_tpu.serving.client import ServingClient
 from distkeras_tpu.serving.fleet import (
@@ -69,6 +74,7 @@ __all__ = [
     "ContinuousBatcher",
     "DeadlineExceededError",
     "DecodeStepper",
+    "DevicePrefixIndex",
     "EngineStoppedError",
     "FleetController",
     "FleetRouter",
@@ -76,6 +82,8 @@ __all__ = [
     "ModelDrafter",
     "NgramDrafter",
     "OverloadedError",
+    "PageAllocator",
+    "PoolExhaustedError",
     "PrefixStore",
     "ServeRequest",
     "ServingClient",

@@ -310,13 +310,42 @@ class DecodeStepper:
     def __init__(self, model, num_slots=8, temperature=0.0, seed=0,
                  top_k=None, top_p=None, kv_dtype=None,
                  prefix_cache=None, speculative=None, draft_k=4,
-                 scratch=None, _quiet=False):
+                 scratch=None, paged=False, page_size=16,
+                 num_pages=None, recorder=None, _quiet=False):
         """``prefix_cache``: an optional ``prefix_cache.PrefixStore``.
         When set, ``begin_admit`` restores the longest cached prefix's
         K/V rows into the slot before any prefill compute, and every
         finished prefill publishes its missing pow2 ladder rungs (an
         exact-length repeat therefore re-prefills the sub-rung tail —
         the stated reuse ceiling, not full-hit-on-repeat).
+
+        ``paged``: replace the per-slot contiguous K/V caches with a
+        BLOCK-PAGED pool — per stage, a fixed ``(num_pages, page_size,
+        H, Dh)`` device pool plus host-managed per-slot page tables
+        (``paging.PageAllocator`` owns the free list / refcounts).
+        Admission RESERVES exactly the pages the request can touch
+        (``prompt + max_new`` positions, not the worst-case sequence),
+        so slot occupancy is length-independent: the pool, not the slot
+        count x max_len product, is the capacity. The step / chunked-
+        prefill / speculative-verify programs gather each slot's pages
+        into its logical K/V row (program keys add the pow2-bucketed
+        max-pages-per-slot, so compiles stay O(log T) per family), and
+        greedy output remains pinned token-identical to the dense bank
+        and to solo decode. Full prompt-prefix pages are shared
+        copy-on-write across slots through a device-resident
+        ``DevicePrefixIndex`` (refcounted page-table entries, zero
+        bytes moved on a hit) in front of the host ``PrefixStore``
+        ladder; ``fork_slot`` forks a live slot's table the same way
+        (beam / parallel sampling pay only divergent pages). Pool
+        exhaustion raises the typed, retriable ``PoolExhaustedError``
+        (``overloaded`` on the wire) before any slot state mutates.
+
+        ``page_size``: tokens per page. ``num_pages``: pool size; None
+        sizes the pool to the dense bank's byte budget
+        (``num_slots * ceil(seq_len / page_size)`` pages) so paged-by-
+        default never regresses capacity. ``recorder``: an optional
+        ``obs.FlightRecorder`` — page grants/frees, CoW forks, pool
+        exhaustion, and prefix-cache errors land on the tape.
 
         ``speculative``: an optional draft source (``NgramDrafter`` /
         ``ModelDrafter``). When set, the scheduler drives ``spec_step``
@@ -377,13 +406,65 @@ class DecodeStepper:
         )[1] // nh
         b, t = self.num_slots, self._tp
         self._ctx = jnp.zeros((b, t), jnp.int32)
-        self._caches = [
-            (
-                jnp.zeros((b, t, nh, hd), self._gen.kv_dtype),
-                jnp.zeros((b, t, nh, hd), self._gen.kv_dtype),
+        self.paged = bool(paged)
+        self.page_size = int(page_size)
+        self.recorder = recorder
+        if self.paged:
+            from distkeras_tpu.serving.paging import PageAllocator
+            from distkeras_tpu.serving.prefix_cache import (
+                DevicePrefixIndex,
             )
-            for _ in self._gen._stages
-        ]
+
+            if self.page_size < 1:
+                raise ValueError(
+                    f"page_size must be >= 1; got {page_size}"
+                )
+            pages_per_slot = -(-t // self.page_size)
+            if num_pages is None:
+                # dense-equivalent byte budget (+ the null sentinel)
+                num_pages = b * pages_per_slot + 1
+            self._kv_alloc = PageAllocator(
+                int(num_pages), self.page_size, recorder=recorder,
+            )
+            # page-table bucket ceiling: the pow2 bucket that covers a
+            # full-capacity slot (every runtime bucket is <= this)
+            self._max_pages_bucket = max(
+                1, 1 << (pages_per_slot - 1).bit_length()
+            )
+            self._caches = None
+            self._pools = [
+                (
+                    jnp.zeros(
+                        (int(num_pages), self.page_size, nh, hd),
+                        self._gen.kv_dtype,
+                    ),
+                    jnp.zeros(
+                        (int(num_pages), self.page_size, nh, hd),
+                        self._gen.kv_dtype,
+                    ),
+                )
+                for _ in self._gen._stages
+            ]
+            self._tables: list[list[int]] = [[] for _ in range(b)]
+            self.prefix_index = DevicePrefixIndex(self._kv_alloc)
+            # paged program caches (separate families from the dense
+            # ones: their keys carry the page-table bucket)
+            self._pstep_fns = {}  # table-bucket -> compiled step
+            self._pchunk_fns = {}  # (chunk-bucket, table-bucket) -> fn
+            self._pverify_fns = {}  # (candidates, table-bucket) -> fn
+            self._pcopy_fns = {}  # (prefix-bucket, table-bucket) -> fn
+            self._page_copy_fn = None  # one-page CoW device copy
+            self._row_copy_fn = None  # ctx-row copy (fork)
+        else:
+            self._kv_alloc = None
+            self.prefix_index = None
+            self._caches = [
+                (
+                    jnp.zeros((b, t, nh, hd), self._gen.kv_dtype),
+                    jnp.zeros((b, t, nh, hd), self._gen.kv_dtype),
+                )
+                for _ in self._gen._stages
+            ]
         self._lens = np.ones((b,), np.int32)  # host mirror; >=1 always
         self._step_idx = 0  # RNG schedule: one fold per global step
         self._step_fn = None
@@ -423,6 +504,22 @@ class DecodeStepper:
     @property
     def speculative(self) -> bool:
         return self.drafter is not None
+
+    def paged_stats(self) -> dict:
+        """Pool / allocator / device-prefix-index observability for the
+        engine's ``stats()`` (empty when dense)."""
+        if not self.paged:
+            return {"enabled": False}
+        out = {"enabled": True}
+        out.update(self._kv_alloc.stats())
+        out["device_prefix"] = (
+            self.prefix_index.stats()
+            if self.prefix_index is not None
+            else {"entries": 0}
+        )
+        out["compiled_step_buckets"] = sorted(self._pstep_fns)
+        out["compiled_chunk_buckets"] = sorted(self._pchunk_fns)
+        return out
 
     @property
     def wants_sequences(self) -> bool:
@@ -474,21 +571,84 @@ class DecodeStepper:
 
     # -- admission ----------------------------------------------------------
 
-    def admit(self, slot: int, prompt) -> None:
+    def admit(self, slot: int, prompt, max_new=None) -> None:
         """One-shot admission: ``begin_admit`` plus prefill drained to
         completion in a single call (the unlimited-budget degenerate of
         the chunked lifecycle — what the PR 1 scheduler always did)."""
-        left = self.begin_admit(slot, prompt)
+        left = self.begin_admit(slot, prompt, max_new=max_new)
         while left > 0:
             left = self.prefill_chunk(slot, left)
 
-    def begin_admit(self, slot: int, prompt) -> int:
+    def pages_for(self, prompt_len: int, max_new: int) -> int:
+        """Pages a request needs end to end: its prompt plus decode
+        budget (plus the speculative scratch window), page-rounded —
+        what admission reserves and what the scheduler gates on."""
+        need = int(prompt_len) + int(max_new)
+        if self.drafter is not None:
+            need += self._kb + 1  # verify writes walk into scratch
+        need = min(need, self._tp)
+        return max(1, -(-need // self.page_size))
+
+    @property
+    def free_pages(self) -> int:
+        return self._kv_alloc.free_pages if self.paged else 1 << 30
+
+    @property
+    def available_pages(self) -> int:
+        """What admission can actually obtain: the free list PLUS
+        pages the device prefix index holds alone (reclaimed under
+        pressure — cached prefixes never starve live traffic)."""
+        if not self.paged:
+            return 1 << 30
+        n = self._kv_alloc.free_pages
+        if self.prefix_index is not None:
+            n += self.prefix_index.reclaimable()
+        return n
+
+    @property
+    def total_pages(self) -> int:
+        return self._kv_alloc.total_pages if self.paged else 1 << 30
+
+    def _alloc_pages(self, n: int, reason: str) -> list[int]:
+        """Allocate with pool-pressure reclaim: shed LRU device-prefix
+        entries before refusing — exhaustion means LIVE demand exceeds
+        the pool, not that the cache filled it."""
+        deficit = n - self._kv_alloc.free_pages
+        if deficit > 0 and self.prefix_index is not None:
+            self.prefix_index.reclaim(deficit)
+        return self._kv_alloc.alloc(n, reason=reason)
+
+    def _record_prefix_error(self, op: str, exc: BaseException, slot):
+        """The prefix cache is best-effort, but a degraded lookup or
+        insert must leave its EXCEPTION CLASS on the tape — a store
+        that is silently failing every call looks identical to a cold
+        one from the counters alone."""
+        self.prefix_fetch_failures += 1
+        if self.recorder is not None:
+            self.recorder.record(
+                "prefix_cache.error", op=op,
+                error=type(exc).__name__, detail=repr(exc)[:200],
+                slot=slot,
+            )
+
+    def begin_admit(self, slot: int, prompt, max_new=None) -> int:
         """Start admitting ``prompt`` into ``slot``: write its context
         row, restore the longest ``prefix_cache`` hit's K/V rows, and
         return the number of prefill positions STILL to compute (0 =
         ready to decode). ``prefill_chunk`` advances the remainder —
         the scheduler spreads it over iterations so a long prompt never
-        stalls the decoding slots beyond its per-iteration budget."""
+        stalls the decoding slots beyond its per-iteration budget.
+
+        Paged mode additionally RESERVES the slot's page table first
+        (``max_new`` bounds the reservation; None reserves to capacity)
+        — sharing any device-resident prefix hit's full pages, falling
+        back to the host ladder — and raises the typed, retriable
+        ``PoolExhaustedError`` BEFORE any slot state mutates when the
+        pool cannot cover it. That nothing-mutated guarantee holds for
+        a RELEASED slot (the scheduler path, which always releases
+        before reuse); re-admitting over a still-held slot first frees
+        its previous table (a test-drive convenience, not a resumable
+        path)."""
         self._fire("stepper.prefill", slot=slot)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = prompt.size
@@ -496,6 +656,19 @@ class DecodeStepper:
             raise ValueError(
                 f"prompt length {plen} outside [1, {self.max_len}]"
             )
+        target = plen - 1  # prefill covers positions 0..plen-2
+        start = 0
+        host_hit = None
+        if self.paged:
+            start, host_hit = self._reserve_pages(
+                slot, prompt, plen, max_new
+            )
+        elif self.prefix_cache is not None and target >= 1:
+            try:
+                host_hit = self.prefix_cache.lookup(prompt[:target])
+            except Exception as e:  # noqa: BLE001 — cache is best-effort
+                self._record_prefix_error("lookup", e, slot)
+                host_hit = None  # a broken cache degrades to a miss
         row = np.zeros((1, self.max_len), np.int32)
         row[0, :plen] = prompt
         if self._row_fn is None:
@@ -509,17 +682,9 @@ class DecodeStepper:
                 donate_argnums=(0,),
             )
         self._ctx = self._row_fn(self._ctx, row, np.int32(slot))
-        target = plen - 1  # prefill covers positions 0..plen-2
-        start = 0
-        if self.prefix_cache is not None and target >= 1:
-            try:
-                hit = self.prefix_cache.lookup(prompt[:target])
-            except Exception:  # noqa: BLE001 — cache is best-effort
-                self.prefix_fetch_failures += 1
-                hit = None  # a broken cache degrades to a miss
-            if hit is not None:
-                start, kv = hit
-                self._restore_prefix(slot, kv)
+        if host_hit is not None:
+            start, kv = host_hit
+            self._restore_prefix(slot, kv)
         self._pending[slot] = prompt
         self._prefill_pos[slot] = start
         if self.drafter is not None:
@@ -534,6 +699,143 @@ class DecodeStepper:
             self._finish_admit(slot)
             return 0
         return target - start
+
+    def _reserve_pages(self, slot, prompt, plen, max_new):
+        """Paged admission's first act: decide the prefix-reuse source
+        (device index vs host ladder — the LONGER coverage wins), build
+        the slot's page table (shared full pages + fresh private
+        pages), and reserve everything the request can ever write.
+        Exhaustion raises ``PoolExhaustedError`` with every reference
+        taken here released — nothing to roll back, no slot state has
+        been touched yet. Returns ``(prefill_start, host_hit_or_None)``
+        (a host hit is restored by the caller AFTER the table exists)."""
+        target = plen - 1
+        mnew = (self.max_len - plen) if max_new is None else int(max_new)
+        need = self.pages_for(plen, max(1, mnew))
+        if self._tables[slot]:
+            # direct re-admission without release() (test drives);
+            # the scheduler always releases first
+            self._free_slot_pages(slot)
+        start = 0
+        shared: list[int] = []
+        if self.prefix_index is not None and target >= self.page_size:
+            hit = self.prefix_index.lookup(prompt[:target])
+            if hit is not None:
+                start, shared = hit  # pages already retained for us
+        host_hit = None
+        if self.prefix_cache is not None and target >= 1:
+            try:
+                host_hit = self.prefix_cache.lookup(prompt[:target])
+            except Exception as e:  # noqa: BLE001 — cache is best-effort
+                self._record_prefix_error("lookup", e, slot)
+                host_hit = None
+        if host_hit is not None and host_hit[0] <= start:
+            host_hit = None  # device coverage already >= the rung
+        if host_hit is not None and shared:
+            # the host ladder reaches further than the device index —
+            # a restore WRITES positions [0, p), so shared (immutable)
+            # pages cannot back them; go all-private
+            self._kv_alloc.free(shared, reason="admit_host_override")
+            start, shared = 0, []
+        try:
+            fresh = self._alloc_pages(need - len(shared), "admit")
+        except Exception:
+            if shared:
+                self._kv_alloc.free(shared, reason="admit_abort")
+            raise
+        self._tables[slot] = shared + fresh
+        return start, host_hit
+
+    def _free_slot_pages(self, slot):
+        pages = self._tables[slot]
+        self._tables[slot] = []
+        if pages:
+            self._kv_alloc.free(pages, reason="release")
+
+    def fork_slot(self, src: int, dst: int, max_new=None) -> None:
+        """Copy-on-write fork: ``dst`` becomes a divergent continuation
+        of ``src`` — n-parallel sampling and beam candidates pay only
+        their divergent pages instead of a full-cache copy. Full pages
+        strictly below the write frontier (position ``len-1``, where
+        the next step's K/V lands) are SHARED into ``dst``'s table
+        (refcount++, zero bytes); the partial frontier page, if any, is
+        device-copied (the one CoW copy divergence costs); the rest of
+        ``dst``'s budget is fresh private pages. The context row and
+        host length are copied, so both slots decode from the identical
+        sequence state — and a greedy fork is pinned token-identical to
+        its source's solo decode. ``src`` must be a DECODING slot (not
+        mid-prefill); ``dst`` must be free. Raises ``PoolExhaustedError``
+        (nothing mutated) when the pool cannot cover the fork."""
+        if not self.paged:
+            raise ValueError("fork_slot requires paged=True")
+        if src in self._pending or not self._tables[src]:
+            raise ValueError(
+                f"slot {src} is not a decodable admitted slot"
+            )
+        if self._tables[dst]:
+            raise ValueError(f"slot {dst} already holds pages")
+        ln = int(self._lens[src])
+        ps = self.page_size
+        mnew = (self.max_len - ln) if max_new is None else int(max_new)
+        need = self.pages_for(ln, max(1, mnew))
+        frontier = (ln - 1) // ps  # page the next K/V write lands in
+        shared = list(self._tables[src][:frontier])
+        self._kv_alloc.share(shared)
+        try:
+            fresh = self._alloc_pages(max(0, need - frontier), "fork")
+        except Exception:
+            if shared:
+                self._kv_alloc.free(shared, reason="fork_abort")
+            raise
+        table = shared + fresh
+        if (ln - 1) % ps != 0 and frontier < len(self._tables[src]):
+            # the frontier page holds positions frontier*ps .. len-2 of
+            # the shared history: copy it so src and dst can diverge
+            src_pg = self._tables[src][frontier]
+            if self._page_copy_fn is None:
+                import jax
+
+                self._compiling()
+                self._page_copy_fn = jax.jit(
+                    lambda pools, s, d: [
+                        (ck.at[d].set(ck[s]), cv.at[d].set(cv[s]))
+                        for ck, cv in pools
+                    ],
+                    donate_argnums=(0,),
+                )
+            with annotate("serving/page_cow"):
+                self._pools = self._page_copy_fn(
+                    self._pools, np.int32(src_pg),
+                    np.int32(table[frontier]),
+                )
+            self._kv_alloc.note_cow(src_pg, table[frontier])
+        self._tables[dst] = table
+        if self._row_copy_fn is None:
+            import jax
+
+            self._compiling()
+            self._row_copy_fn = jax.jit(
+                lambda ctx, s, d: ctx.at[d].set(ctx[s]),
+                donate_argnums=(0,),
+            )
+        self._ctx = self._row_copy_fn(
+            self._ctx, np.int32(src), np.int32(dst)
+        )
+        self._lens[dst] = ln
+        if self.drafter is not None:
+            sp = self._spec_prompts.get(src)
+            if sp is not None:
+                self._spec_prompts[dst] = sp
+            # the draft bank holds no K/V for the tokens src decoded
+            # before the fork, so a lazily-admitted draft for dst would
+            # propose from garbage positions (junk that verify rejects
+            # — correct output, pure overhead). Mark dst admitted and
+            # INVALID: model drafters skip it (plain-decode pace until
+            # its next real admission); host-sequence drafters (ngram)
+            # ignore invalidate and keep proposing from the true tokens.
+            self._spec_admitted.add(dst)
+            self.drafter.invalidate(np.arange(self.num_slots) == dst)
+            self._spec_pending = None
 
     def prefill_chunk(self, slot: int, budget: int) -> int:
         """Prefill up to ``budget`` more positions of ``slot``'s pending
@@ -556,7 +858,11 @@ class DecodeStepper:
         pos = self._prefill_pos[slot]
         n = min(int(budget), target - pos)
         if n > 0:
-            if pos == 0 and n == target:
+            if self.paged:
+                # one program family: every chunk (including a whole
+                # prefix from 0) runs the paged gather/scatter chunk
+                n = self._prefill_mid(slot, prompt, pos, n)
+            elif pos == 0 and n == target:
                 self._prefill_full(slot, prompt)
             else:
                 n = self._prefill_mid(slot, prompt, pos, n)
@@ -597,12 +903,36 @@ class DecodeStepper:
         compiling an arbitrary-length tail program — near-capacity
         traffic must not break the O(log T) compile discipline."""
         cb = _bucket_pow2(n, self.max_len)
-        room = self._tp - pos
+        room = (
+            len(self._tables[slot]) * self.page_size - pos
+            if self.paged
+            else self._tp - pos
+        )
         if cb > room:
             cb = 1 << (room.bit_length() - 1)  # largest pow2 <= room
             n = min(n, cb)
         toks = np.zeros((1, cb), np.int32)
         toks[0, :n] = prompt[pos:pos + n]
+        if self.paged:
+            # chunk programs run at the FIXED full-capacity extent: the
+            # cost is amortized per prompt token (and equals the dense
+            # chunk's extent), while a per-table-bucket key would
+            # multiply program shapes by arrival interleaving — a
+            # mid-pass XLA compile costs more than the gather it saves.
+            # The DYNAMIC extent lives in the per-token step program.
+            pbt = self._max_pages_bucket
+            key = (cb, pbt)
+            fn = self._pchunk_fns.get(key)
+            if fn is None:
+                self._compiling()
+                fn = self._build_chunk_fn_paged(cb, pbt)
+                self._pchunk_fns = {**self._pchunk_fns, key: fn}
+            with annotate("serving/prefill_chunk"):
+                self._pools = fn(
+                    self.model.params, self._pools, toks,
+                    self._table_row(slot, pbt), np.int32(pos),
+                )
+            return n
         fn = self._chunk_fns.get(cb)
         if fn is None:
             self._compiling()
@@ -615,6 +945,27 @@ class DecodeStepper:
             )
         return n
 
+    def _table_bucket(self) -> int:
+        """Pow2 bucket covering every OCCUPIED slot's table — the step
+        / verify program key. Occupied (not active) so blame-probe
+        masks never change the program mid-blame."""
+        m = max((len(t) for t in self._tables), default=0)
+        return _bucket_pow2(max(1, m), self._max_pages_bucket)
+
+    def _table_row(self, slot, pbt) -> np.ndarray:
+        row = np.zeros((pbt,), np.int32)
+        pages = self._tables[slot]
+        row[: len(pages)] = pages
+        return row
+
+    def _tables_array(self, pbt) -> np.ndarray:
+        """The (B, pbt) page-table argument of the step / verify
+        programs; rows pad with the null sentinel page 0 (masked)."""
+        arr = np.zeros((self.num_slots, pbt), np.int32)
+        for i, pages in enumerate(self._tables):
+            arr[i, : len(pages)] = pages
+        return arr
+
     def _finish_admit(self, slot):
         """Admission complete: drop the pending state and publish the
         finished prefix's missing pow2 ladder rungs to the store. The
@@ -625,8 +976,19 @@ class DecodeStepper:
         self._prefill_pos.pop(slot, None)
         if prompt is None:
             return  # release() raced the final chunk; nothing to publish
-        store = self.prefix_cache
         target = prompt.size - 1
+        if self.paged and self.prefix_index is not None and target >= 1:
+            # device-resident sharing: register the prompt's FULL pages
+            # strictly below the write frontier (the slot only writes
+            # at/past position ``target``, so these pages are immutable
+            # from here on). Zero transfers — the index just retains
+            # the page ids.
+            m = target // self.page_size
+            if m >= 1:
+                self.prefix_index.insert(
+                    prompt[:target], self._tables[slot][:m]
+                )
+        store = self.prefix_cache
         if store is None or target < 1:
             return
         try:
@@ -635,15 +997,35 @@ class DecodeStepper:
                 return
             pmax = max(missing)
             with annotate("serving/prefix_insert"):
-                kv = [
-                    (np.asarray(ck[slot, :pmax]), np.asarray(cv[slot, :pmax]))
-                    for ck, cv in self._caches
-                ]
+                if self.paged:
+                    npg = -(-pmax // self.page_size)
+                    pages = np.asarray(
+                        self._tables[slot][:npg], np.int32
+                    )
+                    kv = [
+                        (
+                            np.asarray(ck[pages]).reshape(
+                                -1, self._nh, self._hd
+                            )[:pmax],
+                            np.asarray(cv[pages]).reshape(
+                                -1, self._nh, self._hd
+                            )[:pmax],
+                        )
+                        for ck, cv in self._pools
+                    ]
+                else:
+                    kv = [
+                        (
+                            np.asarray(ck[slot, :pmax]),
+                            np.asarray(cv[slot, :pmax]),
+                        )
+                        for ck, cv in self._caches
+                    ]
                 store.insert_prefixes(prompt[:target], kv)
-        except Exception:  # noqa: BLE001 — cache is best-effort
+        except Exception as e:  # noqa: BLE001 — cache is best-effort
             # a store failure must never fail the (already fully
             # prefilled) request; it just forgoes the reuse
-            self.prefix_fetch_failures += 1
+            self._record_prefix_error("insert", e, slot)
 
     def _restore_prefix(self, slot, kv):
         """Copy a cache hit's host K/V rows into the slot (bucketed
@@ -657,6 +1039,19 @@ class DecodeStepper:
         for si, (k, v) in enumerate(kv):
             ks[si, :p] = k
             vs[si, :p] = v
+        if self.paged:
+            pbt = self._max_pages_bucket  # fixed extent, like the chunks
+            key = (pb, pbt)
+            fn = self._pcopy_fns.get(key)
+            if fn is None:
+                self._compiling()
+                fn = self._build_copy_fn_paged(pb, pbt)
+                self._pcopy_fns = {**self._pcopy_fns, key: fn}
+            with annotate("serving/prefix_copy"):
+                self._pools = fn(
+                    self._pools, ks, vs, self._table_row(slot, pbt)
+                )
+            return
         if self._copy_fn is None:
             self._compiling()
             self._copy_fn = self._build_copy_fn()
@@ -669,6 +1064,11 @@ class DecodeStepper:
         self._lens[slot] = 1  # keep pos = lens-1 in range while parked
         self._pending.pop(slot, None)  # eviction mid-prefill
         self._prefill_pos.pop(slot, None)
+        if self.paged:
+            # a quarantined / evicted slot must give its pages back the
+            # moment it leaves the bank (shared prefix pages survive
+            # via the index's and other holders' refs)
+            self._free_slot_pages(slot)
         self._spec_prompts.pop(slot, None)
         if slot in self._spec_admitted:
             self._spec_admitted.discard(slot)
@@ -686,9 +1086,47 @@ class DecodeStepper:
         one live traffic uses. Deliberately does NOT route through
         ``step()`` — warmup must not trip armed ``stepper.step`` fault
         seams meant for live traffic."""
+        active = np.zeros(self.num_slots, bool)
+        if self.paged:
+            # warm EVERY pow2 table bucket of the step program (the one
+            # paged family with a dynamic extent): the bucket tracks
+            # the longest occupied table at runtime, and a mid-serving
+            # bucket change must find its program compiled — a live-
+            # path step compile is exactly the stall paging must not
+            # reintroduce. O(log pages) programs, off the serving path.
+            pbt = 1
+            while True:
+                fn = self._pstep_fns.get(pbt)
+                if fn is None:
+                    fn = self._build_step_fn_paged(pbt)
+                    self._pstep_fns = {**self._pstep_fns, pbt: fn}
+                table = np.zeros((self.num_slots, pbt), np.int32)
+                with annotate("serving/warmup"):
+                    self._ctx, self._pools, _ = fn(
+                        self.model.params, self._ctx, self._pools,
+                        self._lens.copy(), active, table,
+                        np.int32(self._step_idx),
+                    )
+                if pbt >= self._max_pages_bucket:
+                    break
+                pbt *= 2
+            if self.drafter is not None:
+                key = (self._kb + 1, self._max_pages_bucket)
+                vfn = self._pverify_fns.get(key)
+                if vfn is None:
+                    vfn = self._build_verify_fn_paged(*key)
+                    self._pverify_fns = {**self._pverify_fns, key: vfn}
+                with annotate("serving/warmup"):
+                    self._ctx, self._pools, _, _ = vfn(
+                        self.model.params, self._ctx, self._pools,
+                        self._lens.copy(), active,
+                        np.zeros((self.num_slots, self._kb), np.int32),
+                        np.zeros((self.num_slots,), np.int32), table,
+                    )
+                self.drafter.warmup()
+            return
         if self._step_fn is None:
             self._step_fn = self._build_step_fn()
-        active = np.zeros(self.num_slots, bool)
         with annotate("serving/warmup"):
             self._ctx, self._caches, _ = self._step_fn(
                 self.model.params, self._ctx, self._caches,
@@ -824,6 +1262,292 @@ class DecodeStepper:
 
         return jax.jit(copy, donate_argnums=(0,))
 
+    # -- paged programs (gather-based attention over page pools) ------------
+    #
+    # The paged family restates the dense programs over a ``(num_pages,
+    # page_size, H, Dh)`` pool per stage: each slot's logical K/V row
+    # is the GATHER of its page-table entries (``pool[table]`` ->
+    # (B, pages, page_size, H, Dh), reshaped to (B, T', H, Dh) with
+    # T' = bucket * page_size), and every K/V write scatters to the
+    # physical (page, offset) its logical position maps to. Program
+    # keys add the pow2-bucketed page count, so the attention extent
+    # tracks the ACTUAL longest table instead of the worst-case
+    # sequence — mixed-length traffic attends what it holds, and the
+    # compile count stays O(log T) per family. Attention math, masks,
+    # and the sampling tail are the dense bodies verbatim, which is
+    # what keeps paged greedy output pinned token-identical.
+
+    def _build_step_fn_paged(self, pbt: int):
+        """Compiled paged decode step for table bucket ``pbt``: the
+        dense ``_build_step_fn`` with the per-row cache write scattered
+        to ``table[row][pos // ps]`` and attention over the gathered
+        pages. Inactive / short rows pad their tables with the null
+        sentinel page (writes masked to read-back, reads masked by the
+        position mask), so one program serves every occupancy."""
+        import jax
+        import jax.numpy as jnp
+
+        from distkeras_tpu.ops.quantization import qmatmul, qshape
+
+        gen = self._gen
+        temp, b, ps = gen.temperature, self.num_slots, self.page_size
+        t = pbt * ps  # gathered (logical) attention extent
+        tp = self._tp
+        base_key = jax.random.PRNGKey(self.seed)
+
+        def stage_step(blk, moe, p, pm, x, ck, cv, phys, off, table,
+                       pos, active):
+            mh = p["mhsa"]
+            nh = blk.mhsa.num_heads
+            hd = qshape(mh["wq"])[1] // nh
+            h_, _ = blk.ln1.apply(p["ln1"], {}, x)
+            q = qmatmul(h_, mh["wq"]).reshape(b, nh, hd)
+            k_new = qmatmul(h_, mh["wk"]).reshape(b, nh, hd)
+            v_new = qmatmul(h_, mh["wv"]).reshape(b, nh, hd)
+            keep = active[:, None, None]
+            ck = ck.at[phys, off].set(
+                jnp.where(keep, k_new.astype(ck.dtype), ck[phys, off])
+            )
+            cv = cv.at[phys, off].set(
+                jnp.where(keep, v_new.astype(cv.dtype), cv[phys, off])
+            )
+            kg = ck[table].reshape(b, t, nh, hd)
+            vg = cv[table].reshape(b, t, nh, hd)
+            scores = jnp.einsum("bhd,bthd->bht", q, kg) / np.sqrt(hd)
+            t_mask = jnp.arange(t)[None, :] <= pos[:, None]  # (B, T')
+            scores = jnp.where(t_mask[:, None, :], scores, -jnp.inf)
+            w = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bht,bthd->bhd", w, vg).reshape(b, nh * hd)
+            o = qmatmul(o, mh["wo"])
+            if "bo" in mh:
+                o = o + mh["bo"]
+            x = x + o
+            h_, _ = blk.ln2.apply(p["ln2"], {}, x)
+            h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
+            h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
+            x = x + h_
+            if moe is not None:
+                x = x + gen._moe_nodrop(pm, x)
+            return x, ck, cv
+
+        def step(params, ctx, pools, lens, active, table, step_idx):
+            bp, p_emb, p_ln, p_head = self._unpack(params)
+            pos = jnp.clip(lens - 1, 0, tp - 1)  # (B,) per-slot position
+            rows = jnp.arange(b)
+            tok = jnp.take_along_axis(ctx, pos[:, None], axis=1)[:, 0]
+            x = self._embed(p_emb, tok, pos)
+            phys = table[rows, jnp.clip(pos // ps, 0, pbt - 1)]
+            off = pos % ps
+            new_pools = []
+            for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
+                gen._stages, bp, pools
+            ):
+                x, ck, cv = stage_step(
+                    blk, moe, p, pm, x, ck, cv, phys, off, table, pos,
+                    active,
+                )
+                new_pools.append((ck, cv))
+            x, _ = gen._final_ln.apply(p_ln, {}, x)
+            logit, _ = gen._head.apply(p_head, {}, x)  # (B, V)
+            if temp == 0.0:
+                nxt = jnp.argmax(logit, axis=-1).astype(ctx.dtype)
+            else:
+                sub = jax.random.fold_in(base_key, step_idx)
+                nxt = jax.random.categorical(
+                    sub, gen._filter_logits(logit / temp), axis=-1
+                ).astype(ctx.dtype)
+            wpos = jnp.clip(pos + 1, 0, tp - 1)
+            cur = ctx[rows, wpos]
+            write = active & (pos + 1 <= tp - 1)
+            ctx = ctx.at[rows, wpos].set(jnp.where(write, nxt, cur))
+            return ctx, new_pools, nxt
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _build_chunk_fn_paged(self, cb: int, pbt: int):
+        """Compiled paged prefill chunk for (chunk bucket ``cb``, table
+        bucket ``pbt``): gather the slot's pages into its logical row,
+        run the generators' shared ``_stage_chunk`` body against it
+        (identical math to the dense chunk program), then scatter the
+        chunk's updated K/V positions back to their physical pages.
+        ``start`` is traced, so one program serves every position."""
+        import jax
+        import jax.numpy as jnp
+
+        gen = self._gen
+        ps, nh, hd = self.page_size, self._nh, self._hd
+        t = pbt * ps
+
+        def chunk(params, pools, toks, trow, start):
+            bp, p_emb, _, _ = self._unpack(params)
+            pos = start + jnp.arange(cb)  # (cb,) absolute positions
+            x = self._embed(p_emb, toks, pos)  # (1, cb, d)
+            qmask = jnp.arange(t)[None, :] <= pos[:, None]  # (cb, T')
+            fpos = (
+                trow[jnp.clip(pos // ps, 0, pbt - 1)] * ps + pos % ps
+            )  # (cb,) physical flat positions
+            out = []
+            for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
+                gen._stages, bp, pools
+            ):
+                rk = ck[trow].reshape(t, nh, hd)[None]
+                rv = cv[trow].reshape(t, nh, hd)[None]
+                x, rk, rv = gen._stage_chunk(
+                    blk, moe, p, pm, x, rk, rv, start, qmask
+                )
+                ku = jax.lax.dynamic_slice(
+                    rk, (0, start, 0, 0), (1, cb, nh, hd)
+                )[0]
+                vu = jax.lax.dynamic_slice(
+                    rv, (0, start, 0, 0), (1, cb, nh, hd)
+                )[0]
+                ck = (
+                    ck.reshape(-1, nh, hd)
+                    .at[fpos].set(ku.astype(ck.dtype))
+                    .reshape(ck.shape)
+                )
+                cv = (
+                    cv.reshape(-1, nh, hd)
+                    .at[fpos].set(vu.astype(cv.dtype))
+                    .reshape(cv.shape)
+                )
+                out.append((ck, cv))
+            return out
+
+        return jax.jit(chunk, donate_argnums=(1,))
+
+    def _build_copy_fn_paged(self, pbk: int, pbt: int):
+        """Compiled paged prefix restore: scatter the stacked per-stage
+        host K/V rows ``(n_stages, pbk, H, Dh)`` to the physical flat
+        positions the slot's leading logical positions map to. Bucket
+        padding past the real prefix lands at later reserved positions
+        (clamped to the table), overwritten before anything attends it."""
+        import jax
+        import jax.numpy as jnp
+
+        ps, nh, hd = self.page_size, self._nh, self._hd
+
+        def copy(pools, ks, vs, trow):
+            pvec = jnp.arange(pbk)
+            fpos = (
+                trow[jnp.clip(pvec // ps, 0, pbt - 1)] * ps + pvec % ps
+            )
+            out = []
+            for si, (ck, cv) in enumerate(pools):
+                out.append(
+                    (
+                        ck.reshape(-1, nh, hd)
+                        .at[fpos].set(ks[si].astype(ck.dtype))
+                        .reshape(ck.shape),
+                        cv.reshape(-1, nh, hd)
+                        .at[fpos].set(vs[si].astype(cv.dtype))
+                        .reshape(cv.shape),
+                    )
+                )
+            return out
+
+        return jax.jit(copy, donate_argnums=(0,))
+
+    def _build_verify_fn_paged(self, c: int, pbt: int):
+        """Compiled paged speculative verify for (``c`` candidates,
+        table bucket ``pbt``): the dense ``_build_verify_fn`` with the
+        (B, C) candidate K/V writes scattered to their physical pages
+        and attention over the gathered extent. Scratch overrun lands
+        in the slot's reserved scratch pages (``pages_for`` includes
+        the verify window), exactly as the dense pad absorbs it."""
+        import jax
+        import jax.numpy as jnp
+
+        from distkeras_tpu.ops.quantization import qmatmul, qshape
+
+        gen = self._gen
+        b, tp, ml = self.num_slots, self._tp, self.max_len
+        ps = self.page_size
+        t = pbt * ps
+
+        def stage_verify(blk, moe, p, pm, x, ck, cv, phys, offs, table,
+                         cpos, active):
+            mh = p["mhsa"]
+            nh = blk.mhsa.num_heads
+            hd = qshape(mh["wq"])[1] // nh
+            h_, _ = blk.ln1.apply(p["ln1"], {}, x)
+            q = qmatmul(h_, mh["wq"]).reshape(b, c, nh, hd)
+            k_new = qmatmul(h_, mh["wk"]).reshape(b, c, nh, hd)
+            v_new = qmatmul(h_, mh["wv"]).reshape(b, c, nh, hd)
+            keep = active[:, None, None, None]
+            ck = ck.at[phys, offs].set(
+                jnp.where(keep, k_new.astype(ck.dtype), ck[phys, offs])
+            )
+            cv = cv.at[phys, offs].set(
+                jnp.where(keep, v_new.astype(cv.dtype), cv[phys, offs])
+            )
+            kg = ck[table].reshape(b, t, nh, hd)
+            vg = cv[table].reshape(b, t, nh, hd)
+            scores = jnp.einsum("bchd,bthd->bhct", q, kg) / np.sqrt(hd)
+            t_mask = jnp.arange(t)[None, None, :] <= cpos[:, :, None]
+            scores = jnp.where(t_mask[:, None], scores, -jnp.inf)
+            w = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bhct,bthd->bchd", w, vg).reshape(
+                b, c, nh * hd
+            )
+            o = qmatmul(o, mh["wo"])
+            if "bo" in mh:
+                o = o + mh["bo"]
+            x = x + o
+            h_, _ = blk.ln2.apply(p["ln2"], {}, x)
+            h_, _ = blk._fc1.apply(p["fc1"], {}, h_)
+            h_, _ = blk._fc2.apply(p["fc2"], {}, h_)
+            x = x + h_
+            if moe is not None:
+                x = x + gen._moe_nodrop(pm, x)
+            return x, ck, cv
+
+        def verify(params, ctx, pools, lens, active, dtoks, dcnt,
+                   table):
+            bp, p_emb, p_ln, p_head = self._unpack(params)
+            pos = jnp.clip(lens - 1, 0, ml - 1)  # (B,)
+            rows = jnp.arange(b)
+            tok0 = ctx[rows, pos]
+            chunk = jnp.concatenate([tok0[:, None], dtoks], axis=1)
+            cpos = pos[:, None] + jnp.arange(c)[None, :]  # (B, C) < tp
+            x = self._embed(p_emb, chunk, cpos)  # (B, C, d)
+            phys = table[
+                rows[:, None], jnp.clip(cpos // ps, 0, pbt - 1)
+            ]  # (B, C)
+            offs = cpos % ps
+            new_pools = []
+            for (blk, _, moe, _), (p, pm), (ck, cv) in zip(
+                gen._stages, bp, pools
+            ):
+                x, ck, cv = stage_verify(
+                    blk, moe, p, pm, x, ck, cv, phys, offs, table,
+                    cpos, active,
+                )
+                new_pools.append((ck, cv))
+            x, _ = gen._final_ln.apply(p_ln, {}, x)
+            logit, _ = gen._head.apply(p_head, {}, x)  # (B, C, V)
+            t_arg = jnp.argmax(logit, axis=-1).astype(ctx.dtype)
+            agree = (dtoks == t_arg[:, : c - 1]) & (
+                jnp.arange(c - 1)[None, :] < dcnt[:, None]
+            )
+            n_acc = jnp.argmin(  # first disagreement; c-1 if all agree
+                jnp.concatenate(
+                    [agree, jnp.zeros((b, 1), bool)], axis=1
+                ).astype(jnp.int32),
+                axis=1,
+            )
+            n_new = n_acc + 1
+            wpos = cpos + 1  # <= ml-1 + c < tp: scratch absorbs overrun
+            keep = active[:, None] & (
+                jnp.arange(c)[None, :] < n_new[:, None]
+            )
+            rows2 = rows[:, None]
+            cur = ctx[rows2, wpos]
+            ctx = ctx.at[rows2, wpos].set(jnp.where(keep, t_arg, cur))
+            return ctx, new_pools, t_arg, n_new
+
+        return jax.jit(verify, donate_argnums=(1, 2))
+
     # -- the decode step ----------------------------------------------------
 
     def step(self, active) -> np.ndarray:
@@ -836,14 +1560,28 @@ class DecodeStepper:
         # bookkeeping: a failed step leaves the slot bank exactly as it
         # was, which is what makes the batcher's blame retries sound
         self._fire("stepper.step", active=active)
-        if self._step_fn is None:
-            self._compiling()
-            self._step_fn = self._build_step_fn()
-        with annotate("serving/step"):
-            self._ctx, self._caches, toks = self._step_fn(
-                self.model.params, self._ctx, self._caches,
-                self._lens.copy(), active, np.int32(self._step_idx),
-            )
+        if self.paged:
+            pbt = self._table_bucket()
+            fn = self._pstep_fns.get(pbt)
+            if fn is None:
+                self._compiling()
+                fn = self._build_step_fn_paged(pbt)
+                self._pstep_fns = {**self._pstep_fns, pbt: fn}
+            with annotate("serving/step"):
+                self._ctx, self._pools, toks = fn(
+                    self.model.params, self._ctx, self._pools,
+                    self._lens.copy(), active,
+                    self._tables_array(pbt), np.int32(self._step_idx),
+                )
+        else:
+            if self._step_fn is None:
+                self._compiling()
+                self._step_fn = self._build_step_fn()
+            with annotate("serving/step"):
+                self._ctx, self._caches, toks = self._step_fn(
+                    self.model.params, self._ctx, self._caches,
+                    self._lens.copy(), active, np.int32(self._step_idx),
+                )
         self._step_idx += 1
         toks = np.asarray(toks)
         self._lens[active] = np.minimum(
@@ -1007,17 +1745,35 @@ class DecodeStepper:
         # bank untouched (blame retries re-use the cached proposals)
         self._fire("stepper.verify", active=active)
         c = k + 1
-        fn = self._verify_fns.get(c)
-        if fn is None:
-            self._compiling()
-            fn = self._build_verify_fn(c)
-            self._verify_fns = {**self._verify_fns, c: fn}
         lens0 = self._lens.copy()
-        with annotate("serving/verify"):
-            self._ctx, self._caches, t_arg, n_new = fn(
-                self.model.params, self._ctx, self._caches, lens0,
-                active, dtoks.astype(np.int32), dcnt.astype(np.int32),
-            )
+        if self.paged:
+            # verify windows amortize over k+1 candidate tokens, so
+            # they too run at the fixed extent (one program per c)
+            pbt = self._max_pages_bucket
+            key = (c, pbt)
+            fn = self._pverify_fns.get(key)
+            if fn is None:
+                self._compiling()
+                fn = self._build_verify_fn_paged(c, pbt)
+                self._pverify_fns = {**self._pverify_fns, key: fn}
+            with annotate("serving/verify"):
+                self._ctx, self._pools, t_arg, n_new = fn(
+                    self.model.params, self._ctx, self._pools, lens0,
+                    active, dtoks.astype(np.int32),
+                    dcnt.astype(np.int32), self._tables_array(pbt),
+                )
+        else:
+            fn = self._verify_fns.get(c)
+            if fn is None:
+                self._compiling()
+                fn = self._build_verify_fn(c)
+                self._verify_fns = {**self._verify_fns, c: fn}
+            with annotate("serving/verify"):
+                self._ctx, self._caches, t_arg, n_new = fn(
+                    self.model.params, self._ctx, self._caches, lens0,
+                    active, dtoks.astype(np.int32),
+                    dcnt.astype(np.int32),
+                )
         t_arg = np.asarray(t_arg)
         counts = np.where(active, np.asarray(n_new), 0).astype(np.int64)
         self._lens[active] = np.minimum(
@@ -1182,7 +1938,8 @@ class ServingEngine:
                  metrics_path=None, speculative=None, draft_bundle=None,
                  draft_k=4, ngram_max=3, flight_recorder=True,
                  recorder_capacity=2048, postmortem_dir=None,
-                 slos=None, slo_interval=5.0):
+                 slos=None, slo_interval=5.0, paged=False,
+                 page_size=16, num_pages=None):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -1232,7 +1989,16 @@ class ServingEngine:
         — see ``obs.default_serving_slos``; verdicts ride ``health()``
         as ``slo``/``slo_violations``, re-evaluated at most every
         ``slo_interval`` seconds; breaches count in
-        ``serving_slo_breaches`` and land in the recorder)."""
+        ``serving_slo_breaches`` and land in the recorder).
+
+        Capacity knobs: ``paged=True`` swaps the stepper's per-slot
+        contiguous K/V caches for the block-paged pool (``page_size``
+        tokens per page; ``num_pages`` — None sizes the pool to the
+        dense bank's byte budget). Admission reserves exactly each
+        request's pages, device-resident prefix pages are shared
+        copy-on-write across slots, and pool exhaustion surfaces as
+        the typed retriable ``overloaded`` (with ``retry_after_ms``)
+        instead of a hung or failed request. See ``DecodeStepper``."""
         from distkeras_tpu.obs import MetricsRegistry
 
         self.model = model
@@ -1304,6 +2070,8 @@ class ServingEngine:
             num_slots=num_slots, temperature=temperature, seed=seed,
             top_k=top_k, top_p=top_p, kv_dtype=kv_dtype,
             prefix_cache=store, speculative=drafter, draft_k=draft_k,
+            paged=paged, page_size=page_size, num_pages=num_pages,
+            recorder=self.recorder,
         )
         try:
             self._stepper = DecodeStepper(model, **self._stepper_cfg)
@@ -1394,6 +2162,44 @@ class ServingEngine:
                 else self._stepper.prefix_fetch_failures
             ),
         )
+        if paged:
+            # page-pool occupancy gauges, read from whichever stepper
+            # generation is live (supervisor restarts rebuild the pool)
+            def _alloc():
+                st = self._stepper
+                return None if st is None else st._kv_alloc
+
+            reg.gauge(
+                "serving_kv_pages_total",
+                fn=lambda: (
+                    None if _alloc() is None else _alloc().total_pages
+                ),
+            )
+            reg.gauge(
+                "serving_kv_pages_in_use",
+                fn=lambda: (
+                    None if _alloc() is None else _alloc().pages_in_use
+                ),
+            )
+            reg.gauge(
+                "serving_kv_pages_shared",
+                fn=lambda: (
+                    None if _alloc() is None else _alloc().shared_pages
+                ),
+            )
+            reg.gauge(
+                "serving_kv_cow_copies",
+                fn=lambda: (
+                    None if _alloc() is None else _alloc().cow_copies
+                ),
+            )
+            reg.gauge(
+                "serving_kv_page_util",
+                fn=lambda: (
+                    None if _alloc() is None
+                    else round(_alloc().utilization(), 4)
+                ),
+            )
         self._lat_hists = {
             phase: reg.histogram(f"serving_request_{phase}_seconds")
             for phase in ("queue_wait", "prefill", "decode", "ttft",
@@ -1922,6 +2728,13 @@ class ServingEngine:
                 round(batcher.counters["spec_tokens"] / w, 2)
                 if w else None
             )
+        if batcher is not None and getattr(self._stepper, "paged", False):
+            # pool pressure for routers/load balancers: the fraction of
+            # KV pages in use — the paged tier's real capacity signal
+            # (slot occupancy alone no longer bounds admissions)
+            out["kv_page_util"] = round(
+                self._stepper._kv_alloc.utilization(), 4
+            )
         out["heartbeat_age"] = (
             None
             if batcher is None or not self._started
@@ -1952,6 +2765,7 @@ class ServingEngine:
             out["prefix_fetch_failures"] = (
                 self._stepper.prefix_fetch_failures
             )
+            out["paged"] = self._stepper.paged_stats()
         out["restarts"] = self._restarts
         out["watchdog_trips"] = self._watchdog_trips
         out["status"] = self.health()["status"]

@@ -234,3 +234,185 @@ class PrefixStore:
         looks = out["hits"] + out["misses"]
         out["hit_rate"] = out["hits"] / looks if looks else 0.0
         return out
+
+
+class DevicePrefixIndex:
+    """Block-granular DEVICE-RESIDENT prefix sharing for the paged KV
+    cache — the layer in front of the host ladder above.
+
+    Where :class:`PrefixStore` round-trips K/V through host memory
+    (device->host fetch at insert, host->device copy at hit, pow2-rung
+    granularity), this index maps page-aligned token prefixes straight
+    to the page ids that already hold their K/V in the device pool: an
+    admission that hits shares those pages into its own page table
+    (refcount++, zero bytes moved) and prefills only the divergent
+    tail. Granularity is one PAGE (``page_size`` tokens) instead of the
+    pow2 ladder, so a 3-page shared header reuses all 3 pages, not just
+    the 2-page rung below it.
+
+    Entries hold REFERENCES: inserting a chain retains every page in it
+    via the allocator, so the pages outlive the slot that prefilled
+    them; evicting an entry (bounded LRU) releases them back. Pages in
+    the index are immutable by construction — only FULL pages strictly
+    below an admission's prefill frontier are ever registered, and the
+    owning slot writes exclusively at or past that frontier.
+
+    The host :class:`PrefixStore` keeps its roles: the serialization /
+    transfer format between engines and the fleet router's affinity
+    key. This index is intra-engine reuse only (page ids are meaning-
+    less outside their pool, and a stepper rebuild clears it).
+    """
+
+    def __init__(self, allocator, max_entries: int = 1024):
+        self.allocator = allocator
+        self.page_size = int(allocator.page_size)
+        self.max_entries = int(max_entries)
+        if self.max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        # key -> tuple of page ids covering tokens[:len(pages)*ps];
+        # insertion/access order = LRU. One entry per page-multiple
+        # prefix length, so lookup can find the LONGEST shared header
+        # even when full prompts diverge after it.
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._len_counts: collections.Counter = collections.Counter()
+        # page -> how many ENTRIES reference it: a page whose allocator
+        # refcount equals this is held by the index alone (reclaimable)
+        self._page_refs: collections.Counter = collections.Counter()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.hit_pages = 0
+        self.inserts = 0
+        self.evictions = 0
+        self.reclaims = 0
+
+    @staticmethod
+    def _key(tokens: np.ndarray) -> bytes:
+        return np.ascontiguousarray(tokens, np.int32).tobytes()
+
+    def lookup(self, tokens) -> tuple[int, list[int]] | None:
+        """Longest page-aligned stored prefix of ``tokens``:
+        ``(n_positions, pages)`` with the pages ALREADY retained for
+        the caller (refcount bumped under the index lock, so an
+        eviction racing the admission cannot free them in between), or
+        None. The caller owns the returned references — it must
+        ``free`` them on release like pages it allocated."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        ps = self.page_size
+        with self._lock:
+            for m in sorted(self._len_counts, reverse=True):
+                if m * ps > tokens.size:
+                    continue
+                key = self._key(tokens[: m * ps])
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    self.hit_pages += m
+                    self.allocator.share(entry)
+                    return m * ps, list(entry)
+            self.misses += 1
+            return None
+
+    def insert(self, tokens, pages) -> int:
+        """Register ``tokens``'s page-aligned prefixes against the
+        slot's (leading) ``pages``: one entry per page-multiple length
+        ``1..len(pages)``, each retaining its chain. Returns entries
+        added. Over-capacity evicts LRU entries (their refs released)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        ps = self.page_size
+        n = min(len(pages), tokens.size // ps)
+        added = 0
+        evict: list[tuple[int, ...]] = []
+        with self._lock:
+            for m in range(1, n + 1):
+                key = self._key(tokens[: m * ps])
+                if key in self._entries:
+                    self._entries.move_to_end(key)
+                    continue
+                chain = tuple(int(p) for p in pages[:m])
+                self.allocator.share(chain)
+                self._entries[key] = chain
+                self._len_counts[m] += 1
+                self._page_refs.update(chain)
+                self.inserts += 1
+                added += 1
+            while len(self._entries) > self.max_entries:
+                evict.append(self._pop_lru_locked())
+        for chain in evict:  # release outside the index lock
+            self.allocator.free(chain, reason="prefix_index_evict")
+        return added
+
+    def _pop_lru_locked(self) -> tuple[int, ...]:
+        """Drop the LRU entry's bookkeeping; caller frees the chain
+        (outside the lock) and holds the lock here."""
+        _, old = self._entries.popitem(last=False)
+        self._len_counts[len(old)] -= 1
+        if not self._len_counts[len(old)]:
+            del self._len_counts[len(old)]
+        for p in old:
+            self._page_refs[p] -= 1
+            if not self._page_refs[p]:
+                del self._page_refs[p]
+        self.evictions += 1
+        return old
+
+    def reclaimable(self) -> int:
+        """Pages that would return to the FREE LIST if the whole index
+        were dropped: held by the index alone (allocator refcount ==
+        this index's reference count). The admission gate counts these
+        as available — cached prefixes must never starve live traffic."""
+        with self._lock:
+            return sum(
+                1
+                for p, n in self._page_refs.items()
+                if self.allocator.refcount(p) == n
+            )
+
+    def reclaim(self, n_pages: int) -> int:
+        """Evict LRU entries until at least ``n_pages`` pages actually
+        return to the free list (or the index is empty) — the pool-
+        pressure path: a full pool sheds cached prefixes before it
+        refuses an admission. Returns pages freed."""
+        freed = 0
+        while freed < n_pages:
+            with self._lock:
+                if not self._entries:
+                    break
+                chain = self._pop_lru_locked()
+                self.reclaims += 1
+            freed += self.allocator.free(
+                chain, reason="prefix_index_reclaim"
+            )
+        return freed
+
+    def reset_counters(self) -> None:
+        """Zero the hit/miss/insert ledgers (bench pass discipline);
+        entries and their references are untouched."""
+        with self._lock:
+            self.hits = self.misses = self.hit_pages = 0
+            self.inserts = self.evictions = self.reclaims = 0
+
+    def clear(self) -> None:
+        """Release every entry (e.g. before the pool is torn down)."""
+        with self._lock:
+            chains = list(self._entries.values())
+            self._entries.clear()
+            self._len_counts.clear()
+            self._page_refs.clear()
+        for chain in chains:
+            self.allocator.free(chain, reason="prefix_index_clear")
+
+    def stats(self) -> dict:
+        with self._lock:
+            looks = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": self.hits / looks if looks else 0.0,
+                "hit_pages": self.hit_pages,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+                "reclaims": self.reclaims,
+            }

@@ -73,6 +73,21 @@ class OverloadedError(ServingError):
     code = "overloaded"
 
 
+class PoolExhaustedError(OverloadedError):
+    """The paged KV cache's page pool cannot cover an allocation —
+    capacity pressure, not a fault, so it IS ``overloaded`` on the wire
+    (retriable; ``retry_after_ms`` rides the typed error so embedded
+    callers get the same backoff hint the server stamps on replies).
+    Raised by ``serving.paging.PageAllocator.alloc`` and surfaced by
+    the scheduler when an admission's page reservation cannot be met."""
+
+    def __init__(self, msg, retry_after_ms: float = 50.0):
+        super().__init__(msg)
+        self.retry_after_ms = float(retry_after_ms)
+        # what networking.RetryPolicy reads (seconds, Retry-After style)
+        self.retry_after = self.retry_after_ms / 1e3
+
+
 class DeadlineExceededError(ServingError):
     """The request's deadline expired before it finished decoding."""
 
@@ -288,6 +303,8 @@ class ContinuousBatcher:
                 "blame_probes",  # extra step calls assigning blame
                 "internal_errors",  # requests failed InternalError
                 "prefill_failures",  # begin_admit/prefill_chunk raised
+                "pool_exhausted",  # admissions failed typed overloaded
+                # (paged KV: page reservation could not be met)
                 "quarantines",  # slots sent to probation
                 # speculative decode (0 on non-speculative steppers)
                 "spec_windows",  # slot-windows processed via verify
@@ -334,6 +351,17 @@ class ContinuousBatcher:
                 f"({req.max_new_tokens}) exceeds the serving capacity "
                 f"({self.stepper.max_len})"
             )
+        if getattr(self.stepper, "paged", False):
+            need = self.stepper.pages_for(
+                req.prompt.size, req.max_new_tokens
+            )
+            if need > self.stepper.total_pages:
+                # can NEVER fit the pool — a caller error like the
+                # max_len check above, not transient backpressure
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool holds "
+                    f"{self.stepper.total_pages}"
+                )
         with self._lock:
             if self._draining or self._stopped:
                 raise EngineStoppedError("engine is draining; not accepting")
@@ -359,6 +387,8 @@ class ContinuousBatcher:
         loop idles when False)."""
         now = time.monotonic()
         admitted = []
+        paged = getattr(self.stepper, "paged", False)
+        page_budget = self.stepper.available_pages if paged else None
         with self._lock:
             self._sched_iters += 1
             for s, until in list(self._quarantined.items()):
@@ -370,6 +400,20 @@ class ContinuousBatcher:
                 req = self._pop_live(now)
                 if req is None:
                     break
+                if paged:
+                    # admission reserves pages: gate on the pool, not
+                    # just a free slot, so occupancy is bounded by KV
+                    # bytes actually needed. The head-of-line request
+                    # WAITS for eviction to free pages (FIFO fairness);
+                    # begin_admit's typed PoolExhaustedError is the
+                    # backstop for races and shared-page estimates.
+                    need = self.stepper.pages_for(
+                        req.prompt.size, req.max_new_tokens
+                    )
+                    if need > page_budget:
+                        self._queue.appendleft(req)
+                        break
+                    page_budget -= need
                 self._slots[i] = req
                 req.started = now
                 self._admit_seq += 1
@@ -380,7 +424,10 @@ class ContinuousBatcher:
         began = []
         for i, req in admitted:
             try:
-                began.append((i, req, self.stepper.begin_admit(i, req.prompt)))
+                kw = {"max_new": req.max_new_tokens} if paged else {}
+                began.append(
+                    (i, req, self.stepper.begin_admit(i, req.prompt, **kw))
+                )
             except Exception as e:  # noqa: BLE001 — admission boundary
                 # a prefill crash is attributable by construction (one
                 # slot, one request): fail IT typed, keep everything else
@@ -652,7 +699,23 @@ class ContinuousBatcher:
 
     def _fail_admission(self, i, req, exc):
         """A begin_admit/prefill_chunk crash: fail the (attributable)
-        request typed and free the slot."""
+        request typed and free the slot. A ``ServingError`` (notably
+        ``PoolExhaustedError`` — typed retriable ``overloaded`` with a
+        ``retry_after_ms`` hint) passes through AS ITSELF: capacity
+        pressure must reach the client as backpressure, not be
+        laundered into ``internal``."""
+        import copy
+
+        err = (
+            # a fresh copy per request: an injected seam re-raises ONE
+            # instance, and tracebacks must not be shared across
+            # requests (same discipline as stop()'s per-request fail())
+            copy.copy(exc)
+            if isinstance(exc, ServingError)
+            else InternalError(
+                f"prefill failed for this request: {exc!r}"
+            )
+        )
         with self._lock:
             self.counters["prefill_failures"] += 1
             if self.recorder is not None:
@@ -661,11 +724,7 @@ class ContinuousBatcher:
                     request_id=req.id, error=repr(exc)[:200],
                 )
             if self._slots[i] is req:
-                self._evict(
-                    i,
-                    req,
-                    InternalError(f"prefill failed for this request: {exc!r}"),
-                )
+                self._evict(i, req, err)
 
     def _spend_prefill_budget(self) -> bool:
         """Advance mid-prefill slots, oldest admission first, spending
@@ -756,6 +815,8 @@ class ContinuousBatcher:
             self.counters["completed"] += 1
         elif isinstance(error, InternalError):
             self.counters["internal_errors"] += 1
+        elif isinstance(error, OverloadedError):
+            self.counters["pool_exhausted"] += 1
         else:
             self.counters["deadline_exceeded"] += 1
         req._finish(error)

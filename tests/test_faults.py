@@ -815,9 +815,110 @@ def test_soak_serving_smoke(lm):
     assert summary["faults_fired"] > 0
     assert summary["fired_by_site"]["stepper.verify"] > 0
     assert summary["speculative"]["windows"] > 0
+    # the soak serves the PAGED cache by default with kv.alloc armed:
+    # the pool must be live and leak-free at the end (every page is
+    # either free or held by the device prefix index — no slot holds)
+    assert summary["paged"]["enabled"]
+    assert summary["engine"]["pool_exhausted"] >= 0
     # trace completeness under chaos: every attempt (completed or
     # typed-error) assembled a timeline with exactly one terminal span
     assert summary["trace_attempts"] > 0
     assert summary["trace_incomplete"] == 0, (
         summary["trace_incomplete_samples"]
     )
+
+
+# ------------------------------------------------------ paged KV chaos
+
+
+def test_kv_alloc_fault_yields_typed_overloaded(lm, lm_ref):
+    """ACCEPTANCE (paged KV): an injected allocator exhaustion fails
+    ONLY the admission it hits — typed retriable ``overloaded`` with
+    the ``retry_after_ms`` hint riding the error, never ``internal``,
+    never a hung slot — and the engine serves the retry pinned."""
+    from distkeras_tpu.serving import (
+        OverloadedError,
+        PoolExhaustedError,
+        ServingEngine,
+    )
+
+    eng = ServingEngine(
+        lm, num_slots=2, paged=True, page_size=4, prefix_cache=False,
+        watchdog_interval=30.0,
+    ).start()
+    try:
+        prompt = np.arange(1, 8, dtype=np.int32)
+        ref = lm_ref.generate(prompt[None], steps=5)[0]
+        np.testing.assert_array_equal(eng.generate(prompt, 5), ref)
+        plan = FaultPlan(seed=0).arm(
+            "kv.alloc", times=1,
+            exc=PoolExhaustedError(
+                "injected pool exhaustion", retry_after_ms=7.0
+            ),
+        )
+        with plan:
+            req = eng.submit(prompt, 5)
+            with pytest.raises(OverloadedError) as ei:
+                req.result(timeout=30)  # failed typed, never hung
+        assert ei.value.code == "overloaded"
+        assert ei.value.retry_after_ms == 7.0
+        assert plan.fired("kv.alloc") == 1
+        # the stream was NOT corrupted and the engine never went down:
+        # the client-style retry completes token-identical
+        np.testing.assert_array_equal(eng.generate(prompt, 5), ref)
+        st = eng.stats()
+        assert st["pool_exhausted"] == 1
+        assert st["internal_errors"] == 0
+        assert st["status"] == "serving"
+        # the injected exhaustion left no page behind (the index may
+        # hold prefix pages; slot tables must all be empty)
+        assert all(not t for t in eng._stepper._tables)
+    finally:
+        eng.stop()
+
+
+def test_blame_quarantine_frees_the_quarantined_slots_pages(lm, lm_ref):
+    """ACCEPTANCE (paged KV): a poison request blamed and quarantined
+    gives its PAGES back to the pool immediately — quarantine parks
+    the slot, never the bytes — while the surviving streams decode
+    token-identical to solo."""
+    from distkeras_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(0, 61, n).astype(np.int32) for n in (4, 7)]
+    refs = [lm_ref.generate(p[None], steps=10)[0] for p in prompts]
+    eng = ServingEngine(
+        lm, num_slots=3, paged=True, page_size=4, prefix_cache=False,
+        quarantine_steps=200, watchdog_interval=30.0,
+    ).start()
+    plan = FaultPlan().arm(
+        "stepper.step", times=None,
+        when=lambda ctx: bool(ctx["active"][2]),  # fires iff poison active
+    )
+    try:
+        goods = [eng.submit(p, 10) for p in prompts]  # slots 0 and 1
+        _wait(
+            lambda: eng.stats()["active_slots"] == 2,
+            msg="good streams admitted",
+        )
+        with plan:
+            bad = eng.submit(rng.integers(0, 61, 5).astype(np.int32), 10)
+            with pytest.raises(InternalError, match="blamed"):
+                bad.result(timeout=60)
+            # the blamed slot is quarantined AND its pages are free —
+            # before its probation ends
+            st = eng.stats()
+            assert st["quarantines"] == 1
+            assert len(eng._stepper._tables[2]) == 0
+            for req, want in zip(goods, refs):
+                np.testing.assert_array_equal(
+                    req.result(timeout=60), want
+                )
+        _wait(lambda: eng.batcher.idle, msg="drained")
+        # every slot released every page (no index: prefix_cache=False
+        # only disables the host store, so clear the device index too)
+        eng._stepper.prefix_index.clear()
+        assert eng._stepper._kv_alloc.pages_in_use == 0
+        assert eng.stats()["status"] == "serving"
+    finally:
+        eng.stop()

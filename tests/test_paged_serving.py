@@ -1,0 +1,408 @@
+"""Paged KV-cache device-face pins.
+
+THE correctness bar, inherited from every serving PR since PR 1: a
+paged slot's greedy stream equals its solo ``CachedSequenceGenerator``
+decode token for token, on EVERY admission path — fresh, chunked,
+device-prefix-hit, host-ladder-hit, and CoW fork — regardless of what
+the neighbouring slots are doing. Plus the capacity semantics the
+paging exists for: admission reserves pages, eviction frees them,
+sharing is refcounted and zero-copy, exhaustion is typed retriable
+``overloaded``, and the pool (not slots x max_len) bounds occupancy.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.serving import (
+    PoolExhaustedError,
+    PrefixStore,
+    ServingEngine,
+)
+from distkeras_tpu.serving.engine import DecodeStepper, NgramDrafter
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_ref(lm):
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+
+    return CachedSequenceGenerator(lm)
+
+
+def _solo(lm_ref, p, s):
+    return lm_ref.generate(p[None], steps=s)[0][len(p):].tolist()
+
+
+def _decode_slot(st, slot, steps):
+    out = []
+    for _ in range(steps):
+        active = np.zeros(st.num_slots, bool)
+        active[slot] = True
+        out.append(int(st.step(active)[slot]))
+    return out
+
+
+# ------------------------------------------------- identity: every path
+
+
+def test_paged_matches_solo_decode_with_churn(lm, lm_ref):
+    """Slots admitted at different times with different prompt lengths,
+    evicted and reused — composition independence survives the paged
+    layout (mixed table lengths, pow2 step-bucket changes included)."""
+    st = DecodeStepper(lm, num_slots=3, paged=True, page_size=4)
+    rng = np.random.default_rng(0)
+    p = [rng.integers(0, 61, n).astype(np.int32) for n in (5, 1, 9, 3)]
+    steps = [8, 8, 6, 5]
+    refs = [_solo(lm_ref, pi, s) for pi, s in zip(p, steps)]
+    serving = {}
+    outs = [[] for _ in p]
+    admit_at = {2: 1, 4: 2}
+    st.admit(0, p[0], max_new=steps[0])
+    serving[0] = 0
+    next_req = 3
+    for i in range(40):
+        ri = admit_at.get(i)
+        if ri is not None:
+            st.admit(ri, p[ri], max_new=steps[ri])
+            serving[ri] = ri
+        if not serving:
+            break
+        active = np.zeros(3, bool)
+        active[list(serving)] = True
+        toks = st.step(active)
+        for slot, ri in list(serving.items()):
+            outs[ri].append(int(toks[slot]))
+            if len(outs[ri]) == steps[ri]:
+                del serving[slot]
+                st.release(slot)
+                if next_req < len(p):
+                    st.admit(slot, p[next_req], max_new=steps[next_req])
+                    serving[slot] = next_req
+                    next_req += 1
+    for ri in range(len(p)):
+        assert outs[ri] == refs[ri], f"request {ri}"
+    # eviction freed every slot-held page; only the device prefix
+    # index still holds references
+    idx_pages = sum(
+        len(c) for c in st.prefix_index._entries.values()
+    ) if st.prefix_index is not None else 0
+    held = {p for t in st._tables for p in t}
+    assert not held
+    assert st._kv_alloc.pages_in_use <= idx_pages or idx_pages == 0
+
+
+def test_paged_chunked_prefill_matches_solo(lm, lm_ref):
+    st = DecodeStepper(lm, num_slots=2, paged=True, page_size=4,
+                       prefix_cache=None)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 61, 23).astype(np.int32)
+    ref = _solo(lm_ref, prompt, 7)
+    left = st.begin_admit(0, prompt, max_new=7)
+    assert left == 22
+    sizes = []
+    while left:
+        before = left
+        left = st.prefill_chunk(0, 5)
+        sizes.append(before - left)
+    assert sizes == [5, 5, 5, 5, 2]  # budget respected
+    # chunk-program keys stay pow2 on BOTH axes (chunk, table bucket)
+    assert all(
+        c & (c - 1) == 0 and t & (t - 1) == 0
+        for c, t in st._pchunk_fns
+    ), st._pchunk_fns
+    assert _decode_slot(st, 0, 7) == ref
+
+
+def test_paged_chunk_shrinks_at_table_capacity(lm, lm_ref):
+    """A prompt prefilling up against its RESERVED pages (not the dense
+    time axis) must shrink its tail chunk to a pow2 that fits — the
+    clamped-scatter hazard is per-table now."""
+    st = DecodeStepper(lm, num_slots=1, paged=True, page_size=4,
+                       prefix_cache=None)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 61, 31).astype(np.int32)
+    ref = _solo(lm_ref, prompt, 1)
+    left = st.begin_admit(0, prompt, max_new=1)
+    while left:
+        left = st.prefill_chunk(0, 5)
+    assert _decode_slot(st, 0, 1) == ref
+
+
+def test_paged_device_prefix_hit_is_shared_not_copied(lm, lm_ref):
+    """Two prompts sharing a long header: the second admission SHARES
+    the header's full pages (refcount, zero transfers — the host store
+    is disabled here to prove the bytes came from the device index)
+    and decodes token-identical to solo."""
+    st = DecodeStepper(lm, num_slots=3, paged=True, page_size=4,
+                       prefix_cache=None)
+    rng = np.random.default_rng(8)
+    header = rng.integers(0, 61, 17).astype(np.int32)
+    st.admit(0, header, max_new=6)
+    assert _decode_slot(st, 0, 6) == _solo(lm_ref, header, 6)
+    ext = np.concatenate(
+        [header, rng.integers(0, 61, 5).astype(np.int32)]
+    )
+    left = st.begin_admit(1, ext, max_new=6)
+    # the 17-token prompt registered 4 full pages (16 positions);
+    # ext's prefill starts past them
+    assert st.prefix_index.stats()["hits"] == 1
+    assert left == (ext.size - 1) - 16
+    assert st._kv_alloc.shared_pages >= 4
+    while left:
+        left = st.prefill_chunk(1, 4)
+    assert _decode_slot(st, 1, 6) == _solo(lm_ref, ext, 6)
+    # both streams stay live and independent afterwards
+    st.release(0)
+    assert _decode_slot(st, 1, 2) == _solo(lm_ref, ext, 8)[6:]
+
+
+def test_paged_host_ladder_hit_matches_solo(lm, lm_ref):
+    """With the device index cold (cleared), the host ``PrefixStore``
+    ladder still restores into private pages — the fleet/serialization
+    path — token-identical to solo."""
+    store = PrefixStore(max_bytes=8 << 20)
+    st = DecodeStepper(lm, num_slots=2, paged=True, page_size=4,
+                       prefix_cache=store)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 61, 17).astype(np.int32)
+    ref = _solo(lm_ref, prompt, 6)
+    st.admit(0, prompt, max_new=6)  # miss 1 (ghost)
+    st.release(0)
+    st.prefix_index.clear()
+    st.admit(0, prompt, max_new=6)  # miss 2: ladder stored
+    st.release(0)
+    st.prefix_index.clear()
+    assert store.stats()["entries"] >= 1
+    left = st.begin_admit(1, prompt, max_new=6)
+    assert store.stats()["hits"] == 1
+    assert left < prompt.size - 1  # the rung skipped real prefill
+    while left:
+        left = st.prefill_chunk(1, 4)
+    assert _decode_slot(st, 1, 6) == ref
+
+
+def test_paged_fork_matches_solo_and_pays_only_divergence(lm, lm_ref):
+    """CoW fork mid-decode: the fork and its source both continue
+    token-identical to the source's solo decode, the fork SHARES every
+    full page below the frontier, and at most ONE page was copied."""
+    st = DecodeStepper(lm, num_slots=3, paged=True, page_size=4,
+                       prefix_cache=None)
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(0, 61, 7).astype(np.int32)
+    full = _solo(lm_ref, prompt, 9)
+    st.admit(0, prompt, max_new=12)
+    got = _decode_slot(st, 0, 4)
+    before = st._kv_alloc.pages_in_use
+    st.fork_slot(0, 2, max_new=8)
+    ln = 7 + 4
+    shared_expect = (ln - 1) // 4  # full pages below the frontier
+    assert st._kv_alloc.shared_pages >= shared_expect
+    assert st._kv_alloc.cow_copies <= 1
+    # the fork cost only divergent pages, not a full-cache copy
+    assert (
+        st._kv_alloc.pages_in_use - before
+        <= st.pages_for(ln, 8) - shared_expect
+    )
+    active = np.zeros(3, bool)
+    active[[0, 2]] = True
+    g0, g2 = [], []
+    for _ in range(5):
+        t = st.step(active)
+        g0.append(int(t[0]))
+        g2.append(int(t[2]))
+    assert got + g0 == full
+    assert g2 == full[4:]
+    # releasing the source leaves the fork decoding correctly
+    st.release(0)
+    st.release(2)
+    # after both releases only the device prefix index holds pages;
+    # dropping it proves every slot reference was returned
+    st.prefix_index.clear()
+    assert st._kv_alloc.pages_in_use == 0
+
+
+def test_paged_fork_under_speculation_stays_pinned(lm, lm_ref):
+    """Forking a slot on a SPECULATIVE stepper: the fork is marked
+    draft-admitted-and-invalid (the draft bank holds no K/V for the
+    tokens decoded before the fork, so a lazy draft admission would
+    verify junk), and both streams stay token-identical to solo."""
+    st = DecodeStepper(lm, num_slots=3, paged=True, page_size=4,
+                       speculative=NgramDrafter(), draft_k=3,
+                       prefix_cache=None)
+    p = ((5 + np.arange(11)) % 9).astype(np.int32)  # repetitive
+    full = _solo(lm_ref, p, 9)
+    st.admit(0, p, max_new=12)
+    outs = {0: []}
+    def advance(live):
+        active = np.zeros(3, bool); active[list(live)] = True
+        seqs = [(p, outs[i]) if i in live else None for i in range(3)]
+        toks, counts, _ = st.spec_step(active, seqs)
+        for i in live:
+            outs[i].extend(int(t) for t in
+                           np.atleast_1d(toks[i])[: int(counts[i])])
+    while len(outs[0]) < 4:
+        advance([0])
+    outs[0] = outs[0][:4]
+    st._lens[0] = p.size + 4  # trim any window tail past the cut
+    st.fork_slot(0, 2, max_new=8)
+    assert 2 in st._spec_admitted  # no lazy junk-draft admission later
+    outs[2] = list(outs[0])
+    while len(outs[0]) < 9 or len(outs[2]) < 9:
+        advance([i for i in (0, 2) if len(outs[i]) < 9])
+    assert outs[0][:9] == full
+    assert outs[2][:9] == full
+
+
+def test_paged_fork_validation(lm):
+    st = DecodeStepper(lm, num_slots=2, paged=True, page_size=4)
+    with pytest.raises(ValueError, match="not a decodable"):
+        st.fork_slot(0, 1)
+    dense = DecodeStepper(lm, num_slots=2)
+    with pytest.raises(ValueError, match="paged"):
+        dense.fork_slot(0, 1)
+
+
+def test_paged_speculative_matches_solo(lm, lm_ref):
+    """Speculative verify over pages: repetitive traffic (proposals
+    fire, variable advance) and random traffic (rejection-heavy) both
+    stay token-identical to solo greedy decode."""
+    st = DecodeStepper(lm, num_slots=2, paged=True, page_size=4,
+                       speculative=NgramDrafter(), draft_k=3)
+    rng = np.random.default_rng(12)
+    prompts = [
+        ((7 + np.arange(14)) % 13).astype(np.int32),  # repetitive
+        rng.integers(0, 61, 9).astype(np.int32),  # incompressible
+    ]
+    for slot, p in enumerate(prompts):
+        st.admit(slot, p, max_new=8)
+    refs = [_solo(lm_ref, p, 8) for p in prompts]
+    outs = [[], []]
+    live = {0, 1}
+    while live:
+        active = np.zeros(2, bool)
+        active[list(live)] = True
+        seqs = [
+            (prompts[i], outs[i]) if i in live else None
+            for i in range(2)
+        ]
+        toks, counts, _ = st.spec_step(active, seqs)
+        for i in list(live):
+            for t in np.atleast_1d(toks[i])[: int(counts[i])]:
+                outs[i].append(int(t))
+                if len(outs[i]) == 8:
+                    live.discard(i)
+                    st.release(i)
+                    break
+    assert outs[0] == refs[0] and outs[1] == refs[1]
+    assert st.spec_verify_steps > 0  # the paged verify actually ran
+
+
+# ------------------------------------------------ capacity semantics
+
+
+def test_exhaustion_before_any_slot_state(lm):
+    st = DecodeStepper(lm, num_slots=2, paged=True, page_size=4,
+                       num_pages=3)
+    rng = np.random.default_rng(1)
+    with pytest.raises(PoolExhaustedError):
+        st.begin_admit(0, rng.integers(0, 61, 20).astype(np.int32),
+                       max_new=8)
+    # nothing to roll back: no table, no pending admission, empty pool
+    assert st._tables[0] == [] and 0 not in st._pending
+    assert st._kv_alloc.pages_in_use == 0
+    # a fitting request still admits afterwards
+    st.admit(0, rng.integers(0, 61, 4).astype(np.int32), max_new=3)
+    assert st._kv_alloc.pages_in_use > 0
+
+
+def test_never_fits_pool_is_value_error(lm):
+    from distkeras_tpu.serving.scheduler import (
+        ContinuousBatcher,
+        ServeRequest,
+    )
+
+    st = DecodeStepper(lm, num_slots=2, paged=True, page_size=4,
+                       num_pages=4)
+    b = ContinuousBatcher(st, queue_capacity=4)
+    with pytest.raises(ValueError, match="KV pages"):
+        b.submit(ServeRequest(np.arange(1, 12, dtype=np.int32), 12))
+
+
+def test_pool_gates_admission_but_everyone_completes(lm, lm_ref):
+    """More concurrent demand than the pool covers: the scheduler
+    admits only what fits (head-of-line waits for eviction), nothing
+    fails, nothing hangs, outputs stay pinned — occupancy is bounded
+    by the POOL, slots alone no longer admit."""
+    eng = ServingEngine(
+        lm, num_slots=4, paged=True, page_size=4, num_pages=13,
+        prefill_chunk=8, queue_capacity=16, prefix_cache=False,
+        watchdog_interval=30.0,
+    ).start()
+    try:
+        rng = np.random.default_rng(2)
+        reqs = [
+            (rng.integers(0, 61, int(rng.integers(3, 16))).astype(
+                np.int32), int(rng.integers(2, 6)))
+            for _ in range(8)
+        ]
+        handles = [eng.submit(p, s) for p, s in reqs]
+        outs = [h.result(120) for h in handles]
+        for (p, s), o in zip(reqs, outs):
+            assert np.array_equal(
+                o, lm_ref.generate(p[None], steps=s)[0]
+            )
+        st = eng.stats()
+        assert st["completed"] == len(reqs)
+        assert st["internal_errors"] == 0
+        assert st["paged"]["exhaustions"] == 0  # gating did its job
+    finally:
+        eng.stop()
+
+
+def test_engine_health_and_gauges_expose_pool(lm):
+    eng = ServingEngine(
+        lm, num_slots=2, paged=True, page_size=4,
+        watchdog_interval=30.0,
+    ).start()
+    try:
+        eng.generate(np.arange(1, 6, dtype=np.int32), 3)
+        h = eng.health()
+        assert 0.0 <= h["kv_page_util"] <= 1.0
+        names = {s["name"] for s in eng.metrics_snapshot()}
+        assert {
+            "serving_kv_pages_total", "serving_kv_pages_in_use",
+            "serving_kv_pages_shared", "serving_kv_cow_copies",
+            "serving_kv_page_util",
+        } <= names
+        pg = eng.stats()["paged"]
+        assert pg["enabled"] and pg["total_pages"] > 0
+        assert "device_prefix" in pg
+    finally:
+        eng.stop()
+
+
+def test_step_bucket_stable_across_blame_masks(lm):
+    """The step-program key derives from OCCUPIED tables, not the
+    active mask — a blame probe over a subset must reuse the same
+    compiled program, not trigger a compile storm mid-blame."""
+    st = DecodeStepper(lm, num_slots=3, paged=True, page_size=4,
+                       prefix_cache=None)
+    rng = np.random.default_rng(4)
+    for slot, n in ((0, 5), (1, 21)):
+        st.admit(slot, rng.integers(0, 61, n).astype(np.int32),
+                 max_new=4)
+    full = np.array([True, True, False])
+    st.step(full)
+    before = set(st._pstep_fns)
+    st.step(np.array([True, False, False]))  # a blame-probe mask
+    st.step(np.array([False, True, False]))
+    assert set(st._pstep_fns) == before

@@ -1,0 +1,254 @@
+"""Unit tests for the paged-KV host layer: the ``PageAllocator`` as a
+pure allocator (alloc/free/refcount/CoW/exhaustion/double-free), the
+pow2 page-table bucketing boundaries, and the ``DevicePrefixIndex``'s
+reference discipline — no JAX, no device, no engine. The device-face
+integration (gather programs, identity pins, typed overload through
+the scheduler) lives in ``test_paged_serving.py``.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.serving import PageAllocator, PoolExhaustedError
+from distkeras_tpu.serving.prefix_cache import DevicePrefixIndex
+
+
+# ------------------------------------------------------------ allocator
+
+
+def test_alloc_free_roundtrip_and_sentinel():
+    a = PageAllocator(num_pages=8, page_size=4)
+    assert a.total_pages == 7  # page 0 is the null sentinel
+    pages = a.alloc(3)
+    assert len(pages) == 3 and 0 not in pages
+    assert a.pages_in_use == 3 and a.free_pages == 4
+    assert all(a.refcount(p) == 1 for p in pages)
+    assert a.free(pages) == 3
+    assert a.pages_in_use == 0 and a.free_pages == 7
+    assert a.utilization() == 0.0
+
+
+def test_alloc_is_all_or_nothing_and_typed():
+    a = PageAllocator(num_pages=5, page_size=4, retry_after_ms=17.0)
+    a.alloc(2)
+    with pytest.raises(PoolExhaustedError) as ei:
+        a.alloc(3)  # only 2 free
+    # typed retriable overloaded, with the backoff hint on the error
+    assert ei.value.code == "overloaded"
+    assert ei.value.retry_after_ms == 17.0
+    assert ei.value.retry_after == pytest.approx(0.017)
+    # all-or-nothing: the failed call allocated NOTHING
+    assert a.pages_in_use == 2 and a.free_pages == 2
+    assert a.exhaustions == 1
+    assert a.alloc(2) and a.free_pages == 0
+
+
+def test_share_and_free_refcounts():
+    a = PageAllocator(num_pages=6, page_size=4)
+    pages = a.alloc(2)
+    a.share(pages)  # a second holder
+    assert all(a.refcount(p) == 2 for p in pages)
+    assert a.shared_pages == 2
+    assert a.free(pages) == 0  # still held by the first holder
+    assert a.pages_in_use == 2 and a.shared_pages == 0
+    assert a.free(pages) == 2  # last holder: back to the free list
+    assert a.pages_in_use == 0
+
+
+def test_double_free_raises_and_mutates_nothing():
+    a = PageAllocator(num_pages=4, page_size=4)
+    (p,) = a.alloc(1)
+    a.free([p])
+    with pytest.raises(RuntimeError, match="double free"):
+        a.free([p])
+    with pytest.raises(RuntimeError, match="double free"):
+        a.free([0])  # the sentinel is never freeable
+    assert a.free_pages == 3
+
+
+def test_share_unallocated_raises():
+    a = PageAllocator(num_pages=4, page_size=4)
+    (p,) = a.alloc(1)
+    a.free([p])
+    with pytest.raises(RuntimeError, match="share"):
+        a.share([p])
+
+
+def test_cow_transfers_the_reference():
+    a = PageAllocator(num_pages=6, page_size=4)
+    (p,) = a.alloc(1)
+    a.share([p])  # two holders of p
+    new = a.cow(p)  # second holder privatizes
+    assert new != p
+    assert a.refcount(p) == 1 and a.refcount(new) == 1
+    assert a.cow_copies == 1
+    assert a.pages_in_use == 2
+
+
+def test_cow_on_exhausted_pool_is_typed_and_clean():
+    a = PageAllocator(num_pages=3, page_size=4)
+    p1, p2 = a.alloc(2)
+    a.share([p1])
+    with pytest.raises(PoolExhaustedError):
+        a.cow(p1)  # no free page for the copy
+    # the failed CoW dropped no reference and copied nothing
+    assert a.refcount(p1) == 2 and a.cow_copies == 0
+
+
+def test_allocator_records_pool_events():
+    from distkeras_tpu.obs import FlightRecorder
+
+    rec = FlightRecorder(capacity=64)
+    a = PageAllocator(num_pages=4, page_size=4, recorder=rec)
+    pages = a.alloc(2)
+    with pytest.raises(PoolExhaustedError):
+        a.alloc(5)
+    a.share((pages[0],))
+    a.cow(pages[0])
+    a.free(pages)
+    kinds = [e["kind"] for e in rec.snapshot()]
+    for want in ("kv.page_alloc", "kv.pool_exhausted", "kv.page_free",
+                 "kv.cow_fork"):
+        assert want in kinds, (want, kinds)
+
+
+def test_property_interleaved_admit_release_fork_accounting():
+    """Property-style: ANY seeded interleaving of admit (alloc), fork
+    (share + alloc), CoW, and release (free) leaves ``pages_in_use``
+    equal to the number of DISTINCT pages referenced by live holders —
+    shared overlaps counted once — and every refcount equal to the
+    number of holders referencing that page."""
+    rng = np.random.default_rng(0)
+    a = PageAllocator(num_pages=64, page_size=4)
+    holders: list[list[int]] = []  # each holder's page list (live)
+    for _ in range(400):
+        op = rng.integers(0, 4)
+        if op == 0:  # admit: fresh private pages
+            n = int(rng.integers(1, 6))
+            try:
+                holders.append(a.alloc(n))
+            except PoolExhaustedError:
+                assert a.free_pages < n
+        elif op == 1 and holders:  # fork: share a prefix + fresh tail
+            src = holders[int(rng.integers(0, len(holders)))]
+            k = int(rng.integers(0, len(src) + 1))
+            shared = src[:k]
+            a.share(shared)
+            try:
+                fresh = a.alloc(int(rng.integers(0, 3)))
+            except PoolExhaustedError:
+                a.free(shared)
+                continue
+            holders.append(list(shared) + fresh)
+        elif op == 2 and holders:  # CoW-privatize one shared page
+            h = holders[int(rng.integers(0, len(holders)))]
+            shared = [p for p in h if a.refcount(p) > 1]
+            if shared:
+                old = shared[0]
+                try:
+                    h[h.index(old)] = a.cow(old)
+                except PoolExhaustedError:
+                    pass
+        elif op == 3 and holders:  # release a holder
+            a.free(holders.pop(int(rng.integers(0, len(holders)))))
+        # THE invariant, checked after every step
+        live = set()
+        refs: dict[int, int] = {}
+        for h in holders:
+            live.update(h)
+            for p in h:
+                refs[p] = refs.get(p, 0) + 1
+        assert a.pages_in_use == len(live)
+        for p, r in refs.items():
+            assert a.refcount(p) == r, f"page {p}"
+    for h in holders:
+        a.free(h)
+    assert a.pages_in_use == 0 and a.free_pages == a.total_pages
+
+
+# --------------------------------------------------- pow2 table buckets
+
+
+def test_pow2_bucketing_boundaries():
+    """Page-table buckets round to powers of two at the exact
+    boundaries — the once-compiled-program discipline for the paged
+    step/chunk/verify families."""
+    from distkeras_tpu.serving.engine import _bucket_pow2
+
+    cap = 1 << 10
+    assert [_bucket_pow2(n, cap) for n in (1, 2, 3, 4, 5, 7, 8, 9)] == [
+        1, 2, 4, 4, 8, 8, 8, 16,
+    ]
+    assert _bucket_pow2(0, cap) == 0  # nothing to cover
+    assert _bucket_pow2(1000, 64) == 64  # clamped to the cap
+
+
+# ------------------------------------------------- device prefix index
+
+
+def _mk(n_pages=32, ps=4):
+    a = PageAllocator(num_pages=n_pages, page_size=ps)
+    return a, DevicePrefixIndex(a, max_entries=8)
+
+
+def test_index_lookup_longest_page_aligned_prefix():
+    a, idx = _mk()
+    toks = np.arange(20, dtype=np.int32)
+    pages = a.alloc(4)  # covers 16 positions at ps=4
+    idx.insert(toks[:17], pages)  # target 17 -> 4 full pages
+    # a prompt sharing 11 positions hits the 2-page (8-token) chain
+    probe = np.concatenate([toks[:11], np.array([99, 98], np.int32)])
+    hit = idx.lookup(probe)
+    assert hit is not None
+    n, got = hit
+    assert n == 8 and got == pages[:2]
+    # the hit RETAINED the chain for the caller
+    assert a.refcount(pages[0]) >= 3  # owner + index + caller
+    a.free(got)
+    assert idx.stats()["hits"] == 1
+
+
+def test_index_holds_pages_across_owner_release():
+    a, idx = _mk()
+    toks = np.arange(12, dtype=np.int32)
+    pages = a.alloc(3)
+    idx.insert(toks, pages)
+    a.free(pages)  # the admitting slot evicts
+    # pages survive via the index's references
+    assert a.pages_in_use == 3
+    hit = idx.lookup(toks)
+    assert hit is not None and hit[0] == 12
+    a.free(hit[1])
+    idx.clear()
+    assert a.pages_in_use == 0  # every reference accounted for
+
+
+def test_index_eviction_releases_references():
+    a, idx = _mk(n_pages=64)
+    chains = []
+    for i in range(12):  # 12 one-page entries into an 8-entry index
+        toks = (np.arange(4) + 100 * i).astype(np.int32)
+        pages = a.alloc(1)
+        idx.insert(toks, pages)
+        chains.append(pages)
+        a.free(pages)  # owner releases; only the index holds them
+    st = idx.stats()
+    assert st["entries"] == 8 and st["evictions"] == 4
+    assert a.pages_in_use == 8  # evicted chains went back to the pool
+    idx.clear()
+    assert a.pages_in_use == 0
+
+
+def test_index_insert_registers_every_page_multiple():
+    """A 3-page insert is findable at 1-, 2-, and 3-page granularity —
+    block-granular sharing, not the host ladder's pow2 rungs."""
+    a, idx = _mk()
+    toks = np.arange(12, dtype=np.int32)
+    pages = a.alloc(3)
+    assert idx.insert(toks, pages) == 3
+    for m in (3, 2, 1):
+        hit = idx.lookup(toks[: m * 4])
+        assert hit is not None and hit[0] == m * 4, m
+        a.free(hit[1])
+    # sub-page probes never hit (nothing page-aligned to share)
+    assert idx.lookup(toks[:3]) is None

@@ -63,6 +63,10 @@ SERVING_RATIO_KEYS = (
     "workloads.prefix_heavy.tokens_per_sec_ratio",
     "tracing_overhead.traced_vs_untraced",
     "recorder_overhead.recorder_vs_off",
+    "paged.workloads.long_tail_mixed.tokens_per_sec_ratio",
+    "paged.workloads.prefix_heavy.tokens_per_sec_ratio",
+    "paged.workloads.short_uniform.tokens_per_sec_ratio",
+    "paged.workloads.long_uniform.tokens_per_sec_ratio",
 )
 FLEET_RATIO_KEYS = (
     "workloads.prefix_heavy.fleet_vs_single",
@@ -75,8 +79,14 @@ COMMITTED_FLOORS = {
     "serving": {
         # per-request tracing costs < 3% (PR 7's bar)
         "tracing_overhead.traced_vs_untraced": 0.97,
-        # the always-on flight recorder costs < 2% (this PR's budget)
+        # the always-on flight recorder costs < 2% (PR 8's budget)
         "recorder_overhead.recorder_vs_off": 0.98,
+        # paged KV at an equal byte budget sustains >= 1.2x tokens/sec
+        # on high-load long-tail traffic (this PR's occupancy claim)
+        "paged.workloads.long_tail_mixed.tokens_per_sec_ratio": 1.2,
+        # prefix-heavy reuse must not regress under paging (block-
+        # granular device sharing replaces the host ladder's hits)
+        "paged.workloads.prefix_heavy.tokens_per_sec_ratio": 0.95,
     },
     "fleet": {},
 }
@@ -132,6 +142,15 @@ def compare_serving(fresh: dict, committed: dict) -> list[str]:
                 violations.append(f"{tag}: missing {row} row")
             elif r.get("outputs_identical") is not True:
                 violations.append(f"{tag} {row}: outputs not identical")
+        for name, wl in (rec.get("paged") or {}).get(
+            "workloads", {}
+        ).items():
+            if wl.get("outputs_identical") is not True:
+                violations.append(
+                    f"{tag} paged.{name}: outputs not identical"
+                )
+        if "paged" not in rec:
+            violations.append(f"{tag}: missing paged block")
     _band_check(
         fresh, committed, SERVING_RATIO_KEYS, SERVING_RATIO_BAND,
         violations,

@@ -48,20 +48,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_soak(model=None, clients=4, duration=5.0, seed=0,
-             fault_every=7, max_new=6, speculative=True) -> dict:
+             fault_every=7, max_new=6, speculative=True,
+             paged=True) -> dict:
     """Drive the soak; returns the summary dict (also what ``main``
     prints). ``fault_every``: mean steps between injected device-step
     faults (the blame-path pressure); wire faults ride fixed seeded
     probabilities. ``model=None`` builds the standard tiny LM.
     ``speculative``: serve draft-and-verify (a self-draft — every
     window fully accepted, so the ``stepper.verify`` seam fires every
-    iteration); outputs must STILL match solo decode under chaos."""
+    iteration); outputs must STILL match solo decode under chaos.
+    ``paged``: serve the block-paged KV cache (the default — the soak
+    covers the capacity path production runs) with the ``kv.alloc``
+    seam in the armed set: injected allocator failures must surface
+    typed (``internal`` for a generic crash, retriable ``overloaded``
+    for exhaustion), never hang a slot or corrupt a stream."""
     import numpy as np
 
     from distkeras_tpu.faults import FaultPlan
     from distkeras_tpu.networking import RetryPolicy
     from distkeras_tpu.predictors import CachedSequenceGenerator
     from distkeras_tpu.serving import (
+        PoolExhaustedError,
         ServingClient,
         ServingEngine,
         ServingError,
@@ -93,6 +100,11 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         max_restarts=10_000,  # the soak outlives scheduler crashes
         restart_backoff=0.01, quarantine_steps=8,
         postmortem_dir=postmortem_dir,
+        # paged KV (the production capacity path): small pages so the
+        # soak's short prompts still span multiple pages, pool at the
+        # dense-equivalent budget so organic exhaustion stays rare and
+        # the armed kv.alloc seam provides the injected pressure
+        **(dict(paged=True, page_size=4) if paged else {}),
         # self-draft: k proposals that always agree, so every scheduler
         # iteration runs the VERIFY program and the armed stepper.verify
         # seam sees real traffic
@@ -113,6 +125,13 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         .arm("server.reply", action="drop", times=None, probability=0.03)
         .arm("net.send", action="reset", times=None, probability=0.01)
         .arm("net.send", action="truncate", times=None, probability=0.01)
+        # paged-KV allocator chaos: a generic allocator crash (typed
+        # internal via the prefill-failure path) and injected pool
+        # exhaustion (typed retriable overloaded, absorbed by the
+        # clients' RetryPolicy like any backpressure)
+        .arm("kv.alloc", times=None, probability=0.03)
+        .arm("kv.alloc", times=None, probability=0.03,
+             exc=PoolExhaustedError("injected pool exhaustion"))
         # the TERMINAL seam: kill the scheduler thread outright — once
         # deterministically (the guaranteed trip even at smoke scale)
         # and then probabilistically — so every watchdog trip's
@@ -202,7 +221,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     summary["fired_by_site"] = {
         s: plan.fired(s)
         for s in ("stepper.step", "stepper.verify", "server.reply",
-                  "net.send", "scheduler.loop")
+                  "net.send", "scheduler.loop", "kv.alloc")
     }
     engine_stats = engine.stats()
     summary["engine"] = {
@@ -210,9 +229,16 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         for k in (
             "step_failures", "blame_probes", "internal_errors",
             "quarantines", "restarts", "watchdog_trips", "status",
-            "completed", "rejected_overloaded",
+            "completed", "rejected_overloaded", "pool_exhausted",
         )
     }
+    if paged:
+        pg = engine_stats["paged"]
+        summary["paged"] = {
+            k: pg[k]
+            for k in ("enabled", "total_pages", "pages_in_use",
+                      "shared_pages", "cow_copies", "exhaustions")
+        }
     if speculative:
         summary["speculative"] = {
             k: engine_stats["speculative"][k]
@@ -272,6 +298,10 @@ def main(argv=None) -> int:
                     help="serve plain decode instead of self-draft "
                          "speculative (disarms the stepper.verify seam's "
                          "traffic)")
+    ap.add_argument("--dense", action="store_true",
+                    help="serve the dense slot bank instead of the "
+                         "paged KV cache (disarms the kv.alloc seam's "
+                         "traffic)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU platform before JAX initializes")
     args = ap.parse_args(argv)
@@ -285,6 +315,7 @@ def main(argv=None) -> int:
         clients=args.clients, duration=args.duration, seed=args.seed,
         fault_every=args.fault_every,
         speculative=not args.no_speculative,
+        paged=not args.dense,
     )
     json.dump(summary, sys.stdout, indent=2, default=str)
     print()
