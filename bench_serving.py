@@ -208,13 +208,15 @@ def _solo_refs(ref_gen, reqs):
     ]
 
 
-def _drive(engine, reqs, timeout=600.0, arrivals=None):
+def _drive(engine, reqs, timeout=600.0, arrivals=None, sampling=None):
     """Submit ``reqs`` on the ``arrivals`` schedule (absolute offsets in
     seconds from the drive start; None = all at once), wait for all;
     returns (wall_seconds, tokens, results, latencies). Staggered
     arrivals are the traffic shape chunked prefill exists for — a long
     prompt landing WHILE other slots decode; an all-at-once burst has
-    no in-flight decodes to protect."""
+    no in-flight decodes to protect. ``sampling``: optional per-request
+    ``SamplingParams`` list (the sampled-side A/B driver); the token
+    count scales by each request's ``n`` completions."""
     t0 = time.perf_counter()
     handles = []
     for i, (p, s) in enumerate(reqs):
@@ -222,10 +224,14 @@ def _drive(engine, reqs, timeout=600.0, arrivals=None):
             wait = t0 + arrivals[i] - time.perf_counter()
             if wait > 0:
                 time.sleep(wait)
-        handles.append(engine.submit(p, s))
+        kw = {} if sampling is None else {"sampling": sampling[i]}
+        handles.append(engine.submit(p, s, **kw))
     results = [h.result(timeout) for h in handles]
     dt = time.perf_counter() - t0
-    toks = sum(s for _, s in reqs)
+    toks = sum(
+        s * (1 if sampling is None else sampling[i].n)
+        for i, (_, s) in enumerate(reqs)
+    )
     return dt, toks, results, [h.latency() for h in handles]
 
 
@@ -769,6 +775,152 @@ def _measure_paged_block(model, ref_gen, *, seq, vocab, slots, chunk,
     return block
 
 
+def _measure_sampling_block(model, reqs, refs, *, slots, chunk,
+                            arrivals, repeats, rng):
+    """The sampling block: (a) sampled-vs-greedy — the SAME
+    chunked+cached engine config serving the identical request stream
+    greedy vs per-request temperature/top-p sampled, interleaved timed
+    passes per the PERF.md protocol; the greedy side is identity-
+    asserted against the solo refs, the sampled side REPLAY-asserted
+    across repeats (position-keyed RNG: same seed, same tokens — the
+    repeat-drift assert IS the claim). (b) n=4-via-fork — one n=4
+    completion-group request (CoW ``fork_slot`` after one shared
+    prefill) vs FOUR independent admissions with the derived
+    per-completion seeds, on identical paged engines; the two sides
+    produce token-identical completions BY CONSTRUCTION (asserted),
+    so the ratio prices exactly the shared prefill + shared pages."""
+    from distkeras_tpu.serving import SamplingParams
+    from distkeras_tpu.serving.sampling import seed_for_completion
+
+    # -- (a) sampled vs greedy ---------------------------------------------
+    greedy = _engine(model, reqs, slots=slots, prefill_chunk=chunk,
+                     prefix_cache=True)
+    sampled = _engine(model, reqs, slots=slots, prefill_chunk=chunk,
+                      prefix_cache=True)
+    sparams = [
+        SamplingParams(temperature=0.7, top_p=0.9, seed=1000 + i)
+        for i in range(len(reqs))
+    ]
+    g_tps, s_tps = [], []
+    g_out, s_out = [], []
+    try:
+        for eng in (greedy, sampled):  # warm the greedy programs
+            _drive(eng, reqs, arrivals=arrivals)
+        _drive(sampled, reqs, arrivals=arrivals, sampling=sparams)
+        for _ in range(repeats):
+            _reset(greedy, None)
+            d, t, res, _ = _drive(greedy, reqs, arrivals=arrivals)
+            g_tps.append(t / d)
+            g_out = res
+            _reset(sampled, None)
+            d, t, res, _ = _drive(
+                sampled, reqs, arrivals=arrivals, sampling=sparams
+            )
+            s_tps.append(t / d)
+            if s_out:
+                for i, (a, b) in enumerate(zip(s_out, res)):
+                    assert np.array_equal(a, b), (
+                        f"sampled req {i}: replay drift across repeats"
+                    )
+            s_out = res
+    finally:
+        greedy.stop()
+        sampled.stop()
+    for i, (a, r) in enumerate(zip(g_out, refs)):
+        assert np.array_equal(a, r), f"sampling A/B req {i}: greedy != solo"
+    row_ab = {
+        "num_requests": len(reqs),
+        "temperature": 0.7,
+        "top_p": 0.9,
+        "greedy_tokens_per_sec": round(float(np.median(g_tps)), 1),
+        "greedy_spread": [round(min(g_tps), 1), round(max(g_tps), 1)],
+        "sampled_tokens_per_sec": round(float(np.median(s_tps)), 1),
+        "sampled_spread": [round(min(s_tps), 1), round(max(s_tps), 1)],
+        # the overhead row: per-token sort + counter-keyed draw vs
+        # plain argmax, everything else identical
+        "tokens_per_sec_ratio": _ratio(
+            float(np.median(s_tps)), float(np.median(g_tps))
+        ),
+        "outputs_identical": True,
+        "replay_identical": True,
+    }
+
+    # -- (b) n=4 via fork vs 4 independent admissions ----------------------
+    n = 4
+    base = reqs[: max(2, len(reqs) // 3)]
+    fork_params = [
+        SamplingParams(temperature=0.8, seed=500 + i, n=n)
+        for i in range(len(base))
+    ]
+    ind_reqs, ind_params = [], []
+    for i, (p, s) in enumerate(base):
+        for j in range(n):
+            ind_reqs.append((p, s))
+            ind_params.append(SamplingParams(
+                temperature=0.8,
+                seed=seed_for_completion(500 + i, j),
+            ))
+    fork_arr = np.cumsum(rng.exponential(0.002, len(base)))
+    ind_arr = np.repeat(fork_arr, n)  # the same instants, 4 users each
+    fork_eng = _engine(model, ind_reqs, slots=max(slots, n),
+                       prefill_chunk=chunk, prefix_cache=False,
+                       paged=True)
+    ind_eng = _engine(model, ind_reqs, slots=max(slots, n),
+                      prefill_chunk=chunk, prefix_cache=False,
+                      paged=True)
+    f_tps, i_tps = [], []
+    f_out, i_out = [], []
+    try:
+        _drive(fork_eng, base, arrivals=fork_arr, sampling=fork_params)
+        _drive(ind_eng, ind_reqs, arrivals=ind_arr, sampling=ind_params)
+        for _ in range(repeats):
+            _reset(fork_eng, None)
+            d, t, res, _ = _drive(
+                fork_eng, base, arrivals=fork_arr, sampling=fork_params
+            )
+            f_tps.append(t / d)
+            f_out = res
+            _reset(ind_eng, None)
+            d, t, res, _ = _drive(
+                ind_eng, ind_reqs, arrivals=ind_arr,
+                sampling=ind_params,
+            )
+            i_tps.append(t / d)
+            i_out = res
+        fork_stats = fork_eng.stats()
+        forked_total = int(fork_eng.batcher.forked_slots.value)
+    finally:
+        fork_eng.stop()
+        ind_eng.stop()
+    for i in range(len(base)):
+        for j in range(n):
+            assert np.array_equal(f_out[i][j], i_out[i * n + j]), (
+                f"fork req {i} completion {j} != independent admission"
+            )
+    return {
+        "sampled_vs_greedy": row_ab,
+        "n4_fork": {
+            "n": n,
+            "num_requests": len(base),
+            "fork_tokens_per_sec": round(float(np.median(f_tps)), 1),
+            "fork_spread": [round(min(f_tps), 1),
+                            round(max(f_tps), 1)],
+            "independent_tokens_per_sec": round(
+                float(np.median(i_tps)), 1
+            ),
+            "independent_spread": [round(min(i_tps), 1),
+                                   round(max(i_tps), 1)],
+            # > 1 = one prefill + CoW page sharing beat n admissions
+            "fork_vs_independent": _ratio(
+                float(np.median(f_tps)), float(np.median(i_tps))
+            ),
+            "completions_identical": True,
+            "cow_copies": fork_stats["paged"]["cow_copies"],
+            "forked_slots": forked_total,
+        },
+    }
+
+
 def _measure_serial(model, reqs, *, arrivals=None, repeats=1):
     """1 slot + PR 1 config = serve-one-at-a-time through identical
     code (the PR 1 continuity ratio)."""
@@ -819,6 +971,11 @@ def main() -> None:
                     help="run ONLY the paged-vs-dense KV-cache A/B "
                          "and merge the block into the existing "
                          "BENCH_SERVING.json")
+    ap.add_argument("--sampling-only", action="store_true",
+                    help="run ONLY the sampling block (sampled-vs-"
+                         "greedy overhead A/B + n=4-via-fork vs 4 "
+                         "independent admissions) and merge it into "
+                         "the existing BENCH_SERVING.json")
     args = ap.parse_args()
 
     platform = setup_backend(cpu=args.cpu or args.smoke)
@@ -903,6 +1060,28 @@ def main() -> None:
         print(json.dumps({"paged": {
             n: w["tokens_per_sec_ratio"]
             for n, w in record["paged"]["workloads"].items()
+        }}))
+        return
+
+    if args.sampling_only:
+        # merge-mode sibling of --paged-only: measure just the
+        # sampling block into the committed record
+        with open("BENCH_SERVING.json") as f:
+            record = json.load(f)
+        timed, _ = workloads["production_mix"]
+        refs = _solo_refs(ref_gen, timed)
+        arrivals = np.cumsum(rng.exponential(gap_ms / 1e3, len(timed)))
+        record["sampling"] = _measure_sampling_block(
+            model, timed, refs, slots=args.slots, chunk=chunk,
+            arrivals=arrivals, repeats=args.repeats, rng=rng,
+        )
+        with open("BENCH_SERVING.json", "w") as f:
+            json.dump(record, f, indent=2)
+        print(json.dumps({"sampling": {
+            "sampled_vs_greedy": record["sampling"][
+                "sampled_vs_greedy"]["tokens_per_sec_ratio"],
+            "n4_fork_vs_independent": record["sampling"]["n4_fork"][
+                "fork_vs_independent"],
         }}))
         return
 
@@ -1050,6 +1229,21 @@ def main() -> None:
         chunk=chunk, requests=args.requests, gap_ms=gap_ms,
         repeats=args.repeats, rng=rng, header=header,
     )
+
+    # -- sampling block (sampled-vs-greedy overhead + n=4 via fork) ---------
+    timed, _ = workloads["production_mix"]
+    record["sampling"] = _measure_sampling_block(
+        model, timed, refs_by_wl["production_mix"],
+        slots=args.slots, chunk=chunk,
+        arrivals=arrival_sched["production_mix"], repeats=args.repeats,
+        rng=rng,
+    )
+    print(json.dumps({"sampling": {
+        "sampled_vs_greedy": record["sampling"]["sampled_vs_greedy"][
+            "tokens_per_sec_ratio"],
+        "n4_fork_vs_independent": record["sampling"]["n4_fork"][
+            "fork_vs_independent"],
+    }}), flush=True)
 
     # -- speculative decoding A/B (prompt-lookup drafter) -------------------
     # Speculation pays off only when the model's continuation repeats

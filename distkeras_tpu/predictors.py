@@ -117,6 +117,14 @@ class SequenceGenerator:
     ``top_p`` keeps the smallest nucleus whose probability mass reaches
     p (both static, compiled into the scan; combinable — k first, then
     the nucleus within it).
+
+    Sampling RNG is COUNTER-BASED (``serving.sampling``): each row's
+    draw at its e-th generated token keys on ``(seed, e)`` — a pure
+    function of the request, independent of batch composition, scan
+    bucketing, and neighbours. This makes solo sampled decode the
+    identity reference for the serving tier's per-request sampled
+    decode (same seed => same tokens), exactly as solo greedy decode
+    anchors the serving greedy pins.
     """
 
     def __init__(self, model, temperature=0.0, seed=0, top_k=None,
@@ -145,32 +153,6 @@ class SequenceGenerator:
                 "top_k/top_p filter SAMPLING; temperature=0 is greedy "
                 "argmax — pass a temperature > 0"
             )
-
-    def _filter_logits(self, logit):
-        """Apply top-k / nucleus filtering to (B, V) logits (-inf out the
-        excluded tokens; jax.random.categorical renormalizes). When both
-        are set the nucleus runs over the renormalized top-k values
-        (B, k) — no full-vocab sort on the per-token serving path."""
-        sorted_desc = None
-        if self.top_k is not None and self.top_k < logit.shape[-1]:
-            topv = jax.lax.top_k(logit, self.top_k)[0]  # (B, k), desc
-            logit = jnp.where(logit < topv[..., -1:], -jnp.inf, logit)
-            sorted_desc = topv
-        if self.top_p is not None and self.top_p < 1.0:
-            if sorted_desc is None:
-                sorted_desc = jnp.sort(logit, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_desc, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # keep tokens while the mass BEFORE them is < p (the first
-            # token is always kept)
-            keep_sorted = (cum - probs) < self.top_p
-            # threshold = smallest kept logit
-            thresh = jnp.min(
-                jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1,
-                keepdims=True,
-            )
-            logit = jnp.where(logit < thresh, -jnp.inf, logit)
-        return logit
 
     def _validate_generate_args(self, prompts, steps):
         prompts = np.asarray(prompts)
@@ -207,11 +189,11 @@ class SequenceGenerator:
         recompile per exit position.
 
         Greedy decode of a ragged row is pinned equal to its solo
-        rectangular call. SAMPLED ragged rows are deterministic under a
-        fixed seed but batch-composition-dependent: the scan burns one
-        key split per scanned position (and the categorical draw is
-        per-row-of-batch), so a row sampled next to different neighbors
-        draws different bits than it would alone.
+        rectangular call. SAMPLED rows are deterministic under a fixed
+        seed AND batch-composition-independent: each row's e-th
+        generated token draws from a counter-based key ``(seed, e)``
+        (``serving.sampling``), so a row samples the same tokens next
+        to any neighbours, at any bucketing, and alone.
 
         Returns a (B, P + steps) array for rectangular prompts without
         ``eos_id`` (every row the same length); otherwise a list of B 1-D
@@ -305,8 +287,8 @@ class SequenceGenerator:
         # down to a power of two and the scan length up to one, clamped
         # so the last write lands at seq_len-1 (coverage holds: the
         # validation above guarantees max_len + steps <= seq_len).
-        # Greedy output is invariant to the bucket; sampled draws shift
-        # with it — within the documented batch-composition dependence.
+        # Greedy AND sampled output are invariant to the bucket: draws
+        # key on each row's own (seed, emitted-index) counter.
         start = 1 << (min_len.bit_length() - 1)
         need = max_len - start + steps
         n_scan = min(1 << (need - 1).bit_length(), seq_len - start)
@@ -329,8 +311,11 @@ class SequenceGenerator:
         apply = self.model.apply
 
         def decode(params, state, ctx, lens, key):
+            del key  # RNG is counter-based: (seed, per-row emitted idx)
+            temps, topk, topp, seeds = self._sampling_rows(ctx.shape[0])
+
             def step(carry, i):
-                ctx, key = carry
+                ctx = carry
                 logits, _ = apply(params, state, ctx, train=False)
                 pos = min_len - 1 + i
                 logit = jax.lax.dynamic_index_in_dim(
@@ -339,19 +324,33 @@ class SequenceGenerator:
                 if temp == 0.0:
                     tok = jnp.argmax(logit, axis=-1)
                 else:
-                    key, sub = jax.random.split(key)
-                    tok = jax.random.categorical(
-                        sub, self._filter_logits(logit / temp), axis=-1
+                    from distkeras_tpu.serving import sampling as _sp
+
+                    epos = jnp.maximum(pos + 1 - lens, 0)  # emitted idx
+                    tok = _sp.sample_tokens(
+                        logit, temps, topk, topp, seeds, epos
                     )
                 ctx, tok = self._masked_write(ctx, lens, steps, pos, tok)
-                return (ctx, key), tok
+                return ctx, tok
 
-            (ctx, _), _ = jax.lax.scan(
-                step, (ctx, key), jnp.arange(n_scan)
-            )
+            ctx, _ = jax.lax.scan(step, ctx, jnp.arange(n_scan))
             return ctx
 
         return jax.jit(decode)
+
+    def _sampling_rows(self, b):
+        """Trace-time per-row sampling params (uniform: one config per
+        generator) in the vectorized shape ``serving.sampling`` takes —
+        THE bridge that makes this solo path and the served per-slot
+        path the same computation."""
+        return (
+            jnp.full((b,), self.temperature, jnp.float32),
+            jnp.full((b,), 0 if self.top_k is None else self.top_k,
+                     jnp.int32),
+            jnp.full((b,), 1.0 if self.top_p is None else self.top_p,
+                     jnp.float32),
+            jnp.full((b,), self.seed, jnp.int32),
+        )
 
     @staticmethod
     def _masked_write(ctx, lens, steps, pos, tok):
@@ -664,13 +663,14 @@ class CachedSequenceGenerator(SequenceGenerator):
         seq_len = self.model.input_shape[0]
 
         def decode(params, state, ctx, lens, key):
-            del state
+            del state, key  # RNG is counter-based: (seed, emitted idx)
             bp, p_ln, p_head, embed, caches = self._decode_prologue(
                 params, ctx, min_len
             )
+            temps, topk, topp, seeds = self._sampling_rows(ctx.shape[0])
 
             def step(carry, i):
-                tok, ctx, caches, key = carry
+                tok, ctx, caches = carry
                 pos = min_len - 1 + i
                 x = embed(tok, pos)
                 t_mask = jnp.arange(seq_len) <= pos
@@ -682,16 +682,18 @@ class CachedSequenceGenerator(SequenceGenerator):
                 if temp == 0.0:
                     nxt = jnp.argmax(logit, axis=-1)
                 else:
-                    key, sub = jax.random.split(key)
-                    nxt = jax.random.categorical(
-                        sub, self._filter_logits(logit / temp), axis=-1
+                    from distkeras_tpu.serving import sampling as _sp
+
+                    epos = jnp.maximum(pos + 1 - lens, 0)  # emitted idx
+                    nxt = _sp.sample_tokens(
+                        logit, temps, topk, topp, seeds, epos
                     )
                 ctx, nxt = self._masked_write(ctx, lens, steps, pos, nxt)
-                return (nxt, ctx, new_caches, key), nxt
+                return (nxt, ctx, new_caches), nxt
 
             tok0 = ctx[:, min_len - 1]
-            (_, ctx, _, _), _ = jax.lax.scan(
-                step, (tok0, ctx, caches, key), jnp.arange(n_scan)
+            (_, ctx, _), _ = jax.lax.scan(
+                step, (tok0, ctx, caches), jnp.arange(n_scan)
             )
             return ctx
 

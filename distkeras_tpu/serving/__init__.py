@@ -15,6 +15,13 @@ decode path (scheduler -> engine -> server, plus the client).
   tokens per slot and a once-compiled verify step scores all k+1
   positions in one call — slots emit 1..k+1 tokens per iteration,
   output pinned token-identical to solo greedy decode.
+- ``sampling``: per-request sampling & structured decoding —
+  ``SamplingParams`` (temperature / top_k / top_p / seed / n /
+  grammar) riding the wire into per-slot sampler state, counter-based
+  RNG keyed on (request seed, emitted position) for replay-exact
+  sampled decode, ``seed_for_completion`` for n-parallel CoW-forked
+  completions, and ``TokenMaskCompiler`` for grammar-constrained
+  decoding via device-side token masks.
 - ``prefix_cache``: host-side shared-prefix KV store — exact-prefix
   keyed, LRU-bounded by bytes — that lets admission skip recomputing
   K/V for prompt prefixes other requests already prefilled.
@@ -51,6 +58,11 @@ from distkeras_tpu.serving.scheduler import (
     WindowedBatcher,
 )
 from distkeras_tpu.serving.paging import PageAllocator
+from distkeras_tpu.serving.sampling import (
+    SamplingParams,
+    TokenMaskCompiler,
+    seed_for_completion,
+)
 from distkeras_tpu.serving.engine import (
     DecodeStepper,
     ModelDrafter,
@@ -85,13 +97,16 @@ __all__ = [
     "PageAllocator",
     "PoolExhaustedError",
     "PrefixStore",
+    "SamplingParams",
     "ServeRequest",
     "ServingClient",
     "ServingEngine",
     "ServingError",
     "ServingServer",
+    "TokenMaskCompiler",
     "WindowedBatcher",
     "affinity_key",
     "local_replica_factory",
+    "seed_for_completion",
     "serve",
 ]

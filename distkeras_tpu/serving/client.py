@@ -250,10 +250,20 @@ class ServingClient:
     # -- verbs --------------------------------------------------------------
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
-                 deadline_ms=None, trace=False) -> np.ndarray:
+                 deadline_ms=None, trace=False, sampling=None):
         """Continue ``prompt`` (1-D int tokens) by up to
         ``max_new_tokens``; returns the full sequence (prompt +
         generated, trimmed after the first generated ``eos_id``).
+
+        ``sampling``: per-request ``sampling.SamplingParams`` (or its
+        wire dict) — temperature / top_k / top_p / seed / n / grammar.
+        Omitted = greedy, byte-for-byte the pre-sampling wire format.
+        With ``n > 1`` the server decodes n parallel completions (CoW
+        slot forks) and this call returns the LIST of n sequences.
+        Sampled generates stay idempotent: the RNG keys on (seed,
+        position), so a retried/resent request reproduces the same
+        tokens — which is also why routing through the fleet router
+        needs no sampling awareness at all.
 
         ``trace=True`` propagates a trace context end to end (client →
         router → server → scheduler) and assembles the per-request
@@ -263,6 +273,7 @@ class ServingClient:
         carries the server's trace stamp), so "which hop failed it"
         is answerable from the client alone."""
         from distkeras_tpu.obs import TraceContext, start_span
+        from distkeras_tpu.serving.sampling import SamplingParams
 
         header = {
             "verb": "generate",
@@ -272,6 +283,9 @@ class ServingClient:
             header["eos_id"] = int(eos_id)
         if deadline_ms is not None:
             header["deadline_ms"] = float(deadline_ms)
+        sampling = SamplingParams.from_wire(sampling)
+        if sampling is not None:
+            header["sampling"] = sampling.to_wire()
         ctx = span = None
         if trace:
             ctx = TraceContext.new(want_timeline=True)
@@ -307,7 +321,10 @@ class ServingClient:
                 status="ok", terminal=True, attempts=self.last_attempts
             )
             self._assemble_trace(ctx, reply.get("trace"), rec)
-        return np.asarray(deserialize_params(body))
+        out = deserialize_params(body)
+        if reply.get("n") is not None:
+            return [np.asarray(s) for s in out]  # n parallel completions
+        return np.asarray(out)
 
     def _assemble_trace(self, ctx, wire_trace, client_record) -> dict:
         spans = list((wire_trace or {}).get("timeline") or [])

@@ -310,8 +310,9 @@ class DecodeStepper:
     def __init__(self, model, num_slots=8, temperature=0.0, seed=0,
                  top_k=None, top_p=None, kv_dtype=None,
                  prefix_cache=None, speculative=None, draft_k=4,
-                 scratch=None, paged=False, page_size=16,
-                 num_pages=None, recorder=None, _quiet=False):
+                 spec_mode="rejection", scratch=None, paged=False,
+                 page_size=16, num_pages=None, recorder=None,
+                 _quiet=False):
         """``prefix_cache``: an optional ``prefix_cache.PrefixStore``.
         When set, ``begin_admit`` restores the longest cached prefix's
         K/V rows into the slot before any prefill compute, and every
@@ -352,10 +353,15 @@ class DecodeStepper:
         instead of ``step``: the drafter proposes up to ``draft_k``
         tokens per active slot and a once-compiled VERIFY program
         scores all k+1 candidate positions against the live K/V caches
-        in one call, accepting the longest greedy-agreeing prefix plus
-        the target's correction. Greedy only — speculation reproduces
-        the target's greedy decode exactly, so a sampling config is
-        rejected here.
+        in one call. Greedy slots accept the longest argmax-agreeing
+        prefix plus the target's correction (output = the target's
+        greedy decode, exactly); under ``spec_mode="rejection"`` (the
+        default) SAMPLED slots accept each drafted token with its
+        target probability and draw corrections from the residual —
+        distribution-preserving and same-seed replay-deterministic.
+        ``spec_mode="strict"`` is the legacy greedy-agreement-only
+        mode: any sampling config (engine-wide or per-request) is
+        refused with the historical ValueError.
 
         ``scratch``: extra (masked) positions padded onto the cache /
         context time axis so speculative over-draft and verify writes
@@ -384,12 +390,15 @@ class DecodeStepper:
         if self.draft_k < 1:
             raise ValueError(f"draft_k must be >= 1; got {draft_k}")
         self._kb = _bucket_pow2(self.draft_k, self.max_len)
-        if self.drafter is not None and (
-            temperature != 0.0 or top_k is not None or top_p is not None
-        ):
-            raise ValueError(
-                "speculative serving verifies GREEDY agreement; it is "
-                "only defined for temperature=0 without top_k/top_p"
+        self.spec_mode = spec_mode
+        if self.drafter is not None:
+            # one shared validation (sampling.check_spec_sampling):
+            # strict mode raises the legacy greedy-only ValueError,
+            # rejection mode (default) serves sampled slots too
+            from distkeras_tpu.serving.sampling import check_spec_sampling
+
+            self.spec_mode = check_spec_sampling(
+                spec_mode, temperature, top_k, top_p
             )
         if scratch is None:
             scratch = self._kb + 1 if self.drafter is not None else 0
@@ -448,10 +457,11 @@ class DecodeStepper:
             self._tables: list[list[int]] = [[] for _ in range(b)]
             self.prefix_index = DevicePrefixIndex(self._kv_alloc)
             # paged program caches (separate families from the dense
-            # ones: their keys carry the page-table bucket)
-            self._pstep_fns = {}  # table-bucket -> compiled step
+            # ones: their keys carry the page-table bucket; the masked
+            # flag selects the grammar-constrained variant)
+            self._pstep_fns = {}  # (table-bucket, masked) -> step
             self._pchunk_fns = {}  # (chunk-bucket, table-bucket) -> fn
-            self._pverify_fns = {}  # (candidates, table-bucket) -> fn
+            self._pverify_fns = {}  # (candidates, table-bucket, masked)
             self._pcopy_fns = {}  # (prefix-bucket, table-bucket) -> fn
             self._page_copy_fn = None  # one-page CoW device copy
             self._row_copy_fn = None  # ctx-row copy (fork)
@@ -466,14 +476,45 @@ class DecodeStepper:
                 for _ in self._gen._stages
             ]
         self._lens = np.ones((b,), np.int32)  # host mirror; >=1 always
-        self._step_idx = 0  # RNG schedule: one fold per global step
-        self._step_fn = None
+        self._step_fns = {}  # masked flag -> compiled decode step
         self._admit_fns = {}  # prefill-length bucket -> compiled admit
         self._chunk_fns = {}  # chunk-length bucket -> compiled chunk
         self._copy_fn = None  # prefix restore (specializes per pb shape)
         self._row_fn = None  # compiled ctx-row write (one program)
-        self._verify_fns = {}  # candidate-count bucket -> compiled verify
+        self._verify_fns = {}  # (candidates, masked) -> compiled verify
         self._seg_fn = None  # compiled accepted-segment ctx write
+        # -- per-slot sampler state (the tentpole) --------------------
+        # Every step/verify program takes these as DATA (never baked
+        # into the compile key): per-slot temperature / top-k / top-p /
+        # seed plus the EMITTED-POSITION counter the RNG keys on.
+        # Greedy slots (temps == 0, the default) take exact argmax, so
+        # an all-greedy bank reproduces the pre-sampling programs'
+        # output token for token. ``default_sampling`` carries the
+        # engine-wide construction knobs for admissions that bring no
+        # per-request params (back-compat: engine-wide temperature
+        # still samples, now replay-deterministically).
+        from distkeras_tpu.serving.sampling import (
+            SamplingParams,
+            TokenMaskCompiler,
+        )
+
+        self.default_sampling = SamplingParams(
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+        )
+        self._temps = np.zeros((b,), np.float32)
+        self._topk = np.zeros((b,), np.int32)  # 0 = disabled
+        self._topp = np.ones((b,), np.float32)  # 1.0 = disabled
+        self._seeds = np.zeros((b,), np.int32)
+        self._spos = np.zeros((b,), np.int32)  # emitted-token counter
+        self._slot_params = [None] * b  # SamplingParams per slot
+        self._grammar = {}  # slot -> incremental grammar mask state
+        self._mask_compiler = TokenMaskCompiler(
+            self._gen._emb.vocab_size
+        )
+        self.constrained_masks = 0  # masks applied (device-side rows)
+        self.mask_exhaustions = 0  # all-candidates-zeroed fallbacks
+        for i in range(b):
+            self._reset_slot_sampling(i)
         self._nh, self._hd = nh, hd
         self.prefix_cache = prefix_cache
         # speculation bookkeeping: prompts kept for draft admission,
@@ -541,6 +582,101 @@ class DecodeStepper:
         if hook is not None:
             hook()
 
+    # -- per-slot sampler state ---------------------------------------------
+
+    def _reset_slot_sampling(self, slot):
+        """Park a slot on the engine-wide default params (greedy unless
+        the engine was constructed with a temperature)."""
+        self.set_sampling(slot, None)
+
+    def set_sampling(self, slot, params, completion=0, eos_id=None):
+        """Bind ``params`` (None = the engine default) to ``slot``:
+        the vectorized per-slot arrays the step/verify programs read,
+        the emitted-position RNG counter (reset to 0 — admission IS
+        the replay boundary), and a fresh grammar mask state when the
+        params carry one. ``completion`` derives the slot's seed
+        (``sampling.seed_for_completion``) so n-parallel completions
+        diverge while completion 0 stays the solo reference."""
+        from distkeras_tpu.serving.sampling import seed_for_completion
+
+        p = params if params is not None else self.default_sampling
+        self._slot_params[slot] = p
+        self._temps[slot] = p.temperature
+        self._topk[slot] = 0 if p.top_k is None else p.top_k
+        self._topp[slot] = 1.0 if p.top_p is None else p.top_p
+        self._seeds[slot] = seed_for_completion(p.seed, completion)
+        self._spos[slot] = 0
+        self._grammar.pop(slot, None)
+        if p.grammar is not None:
+            self._grammar[slot] = self._mask_compiler.compile(
+                p.grammar, eos_id=eos_id
+            )
+
+    def _build_tmask(self, active):
+        """The (B, V) additive grammar mask for this step — None when
+        no ACTIVE slot is constrained (the unmasked program then runs:
+        greedy/sampled traffic never pays for grammar support). A mask
+        that zeroes out every candidate falls back to forced-EOS
+        (request eos when known, else unconstrained) — recorded on the
+        flight tape, never a hang."""
+        if not self._grammar:
+            return None
+        rows = [i for i in self._grammar if active[i]]
+        if not rows:
+            return None
+        v = self._gen._emb.vocab_size
+        tm = np.zeros((self.num_slots, v), np.float32)
+        for i in rows:
+            st = self._grammar[i]
+            allow = np.asarray(st.mask(), bool)
+            if not allow.any():
+                self.mask_exhaustions += 1
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "sampling.mask_exhausted", slot=i,
+                        pos=int(self._spos[i]),
+                    )
+                eos = st.eos_id
+                allow = np.zeros(v, bool)
+                if eos is not None and 0 <= int(eos) < v:
+                    allow[int(eos)] = True  # forced-EOS fallback
+                else:
+                    allow[:] = True  # no eos known: unconstrain
+            tm[i] = np.where(allow, 0.0, -np.inf)
+            self.constrained_masks += 1
+        return tm
+
+    def _advance_grammar(self, toks, counts):
+        """Consume the emitted tokens into each constrained slot's mask
+        state (``toks`` (B, w) with ``counts[i]`` real entries)."""
+        for i, st in self._grammar.items():
+            for j in range(int(counts[i])):
+                st.advance(int(toks[i, j]))
+
+    def _sampling_args(self):
+        """The per-slot sampler arrays every step/verify call passes
+        (fresh copies: the device call must see this iteration's
+        snapshot even if host bookkeeping advances meanwhile)."""
+        return (
+            self._temps.copy(), self._topk.copy(), self._topp.copy(),
+            self._seeds.copy(), self._spos.copy(),
+        )
+
+    @property
+    def can_fork(self) -> bool:
+        """Whether n-parallel completions can be scheduled here
+        (``fork_slot`` needs the paged CoW machinery)."""
+        return self.paged
+
+    def fork_pages_for(self, prompt_len: int, max_new: int) -> int:
+        """FRESH pages one fork of a just-prefilled slot allocates
+        (full history pages below the frontier are shared) — what the
+        scheduler adds per extra completion when gating a group
+        admission on the pool."""
+        need = self.pages_for(prompt_len, max_new)
+        frontier = (max(1, int(prompt_len)) - 1) // self.page_size
+        return max(0, need - frontier)
+
     # -- param plumbing -----------------------------------------------------
 
     def _unpack(self, params):
@@ -571,11 +707,15 @@ class DecodeStepper:
 
     # -- admission ----------------------------------------------------------
 
-    def admit(self, slot: int, prompt, max_new=None) -> None:
+    def admit(self, slot: int, prompt, max_new=None, sampling=None,
+              eos_id=None) -> None:
         """One-shot admission: ``begin_admit`` plus prefill drained to
         completion in a single call (the unlimited-budget degenerate of
         the chunked lifecycle — what the PR 1 scheduler always did)."""
-        left = self.begin_admit(slot, prompt, max_new=max_new)
+        left = self.begin_admit(
+            slot, prompt, max_new=max_new, sampling=sampling,
+            eos_id=eos_id,
+        )
         while left > 0:
             left = self.prefill_chunk(slot, left)
 
@@ -631,13 +771,21 @@ class DecodeStepper:
                 slot=slot,
             )
 
-    def begin_admit(self, slot: int, prompt, max_new=None) -> int:
+    def begin_admit(self, slot: int, prompt, max_new=None,
+                    sampling=None, eos_id=None) -> int:
         """Start admitting ``prompt`` into ``slot``: write its context
         row, restore the longest ``prefix_cache`` hit's K/V rows, and
         return the number of prefill positions STILL to compute (0 =
         ready to decode). ``prefill_chunk`` advances the remainder —
         the scheduler spreads it over iterations so a long prompt never
         stalls the decoding slots beyond its per-iteration budget.
+
+        ``sampling``: this request's ``SamplingParams`` (None = the
+        engine default). Admission resets the slot's emitted-position
+        RNG counter, which is what makes any re-admission of the same
+        (prompt, params) — retry after restart, quarantine
+        re-verification, another replica — replay token-identically.
+        ``eos_id`` feeds the grammar mask state's forced-EOS fallback.
 
         Paged mode additionally RESERVES the slot's page table first
         (``max_new`` bounds the reservation; None reserves to capacity)
@@ -669,6 +817,10 @@ class DecodeStepper:
             except Exception as e:  # noqa: BLE001 — cache is best-effort
                 self._record_prefix_error("lookup", e, slot)
                 host_hit = None  # a broken cache degrades to a miss
+        # sampling binds AFTER the page reservation: a PoolExhausted
+        # admission must leave the slot (sampler state included)
+        # exactly as it was
+        self.set_sampling(slot, sampling, eos_id=eos_id)
         row = np.zeros((1, self.max_len), np.int32)
         row[0, :plen] = prompt
         if self._row_fn is None:
@@ -752,7 +904,8 @@ class DecodeStepper:
         if pages:
             self._kv_alloc.free(pages, reason="release")
 
-    def fork_slot(self, src: int, dst: int, max_new=None) -> None:
+    def fork_slot(self, src: int, dst: int, max_new=None,
+                  completion=1) -> None:
         """Copy-on-write fork: ``dst`` becomes a divergent continuation
         of ``src`` — n-parallel sampling and beam candidates pay only
         their divergent pages instead of a full-cache copy. Full pages
@@ -765,7 +918,14 @@ class DecodeStepper:
         sequence state — and a greedy fork is pinned token-identical to
         its source's solo decode. ``src`` must be a DECODING slot (not
         mid-prefill); ``dst`` must be free. Raises ``PoolExhaustedError``
-        (nothing mutated) when the pool cannot cover the fork."""
+        (nothing mutated) when the pool cannot cover the fork.
+
+        ``completion``: the fork's completion index within its request
+        — ``dst`` copies ``src``'s sampling params and emitted-position
+        counter but samples under ``seed_for_completion(seed,
+        completion)``, so its stream is exactly what an independent
+        admission with that derived seed would produce (grammar mask
+        state is CLONED: each completion walks the grammar alone)."""
         if not self.paged:
             raise ValueError("fork_slot requires paged=True")
         if src in self._pending or not self._tables[src]:
@@ -822,6 +982,21 @@ class DecodeStepper:
             self._ctx, np.int32(src), np.int32(dst)
         )
         self._lens[dst] = ln
+        # divergence is the SEED: dst copies src's sampler state and
+        # position counter, keyed to its own completion stream
+        from distkeras_tpu.serving.sampling import seed_for_completion
+
+        src_p = self._slot_params[src] or self.default_sampling
+        self._slot_params[dst] = src_p
+        self._temps[dst] = self._temps[src]
+        self._topk[dst] = self._topk[src]
+        self._topp[dst] = self._topp[src]
+        self._seeds[dst] = seed_for_completion(src_p.seed, completion)
+        self._spos[dst] = self._spos[src]
+        if src in self._grammar:
+            self._grammar[dst] = self._grammar[src].clone()
+        else:
+            self._grammar.pop(dst, None)
         if self.drafter is not None:
             sp = self._spec_prompts.get(src)
             if sp is not None:
@@ -1064,6 +1239,7 @@ class DecodeStepper:
         self._lens[slot] = 1  # keep pos = lens-1 in range while parked
         self._pending.pop(slot, None)  # eviction mid-prefill
         self._prefill_pos.pop(slot, None)
+        self._reset_slot_sampling(slot)  # parked slots sample nothing
         if self.paged:
             # a quarantined / evicted slot must give its pages back the
             # moment it leaves the bank (shared prefix pages survive
@@ -1087,6 +1263,7 @@ class DecodeStepper:
         ``step()`` — warmup must not trip armed ``stepper.step`` fault
         seams meant for live traffic."""
         active = np.zeros(self.num_slots, bool)
+        sargs = self._sampling_args()  # parked slots = greedy defaults
         if self.paged:
             # warm EVERY pow2 table bucket of the step program (the one
             # paged family with a dynamic extent): the bucket tracks
@@ -1094,24 +1271,28 @@ class DecodeStepper:
             # bucket change must find its program compiled — a live-
             # path step compile is exactly the stall paging must not
             # reintroduce. O(log pages) programs, off the serving path.
+            # Only the UNMASKED variants warm here: grammar traffic is
+            # the rare case and its first mask may compile on-path
+            # (graced via on_compile, like a fresh prefill bucket).
             pbt = 1
             while True:
-                fn = self._pstep_fns.get(pbt)
+                fn = self._pstep_fns.get((pbt, False))
                 if fn is None:
                     fn = self._build_step_fn_paged(pbt)
-                    self._pstep_fns = {**self._pstep_fns, pbt: fn}
+                    self._pstep_fns = {
+                        **self._pstep_fns, (pbt, False): fn
+                    }
                 table = np.zeros((self.num_slots, pbt), np.int32)
                 with annotate("serving/warmup"):
                     self._ctx, self._pools, _ = fn(
                         self.model.params, self._ctx, self._pools,
-                        self._lens.copy(), active, table,
-                        np.int32(self._step_idx),
+                        self._lens.copy(), active, table, *sargs,
                     )
                 if pbt >= self._max_pages_bucket:
                     break
                 pbt *= 2
             if self.drafter is not None:
-                key = (self._kb + 1, self._max_pages_bucket)
+                key = (self._kb + 1, self._max_pages_bucket, False)
                 vfn = self._pverify_fns.get(key)
                 if vfn is None:
                     vfn = self._build_verify_fn_paged(*key)
@@ -1122,31 +1303,34 @@ class DecodeStepper:
                         self._lens.copy(), active,
                         np.zeros((self.num_slots, self._kb), np.int32),
                         np.zeros((self.num_slots,), np.int32), table,
+                        *sargs,
                     )
                 self.drafter.warmup()
             return
-        if self._step_fn is None:
-            self._step_fn = self._build_step_fn()
+        fn = self._step_fns.get(False)
+        if fn is None:
+            fn = self._build_step_fn()
+            self._step_fns = {**self._step_fns, False: fn}
         with annotate("serving/warmup"):
-            self._ctx, self._caches, _ = self._step_fn(
+            self._ctx, self._caches, _ = fn(
                 self.model.params, self._ctx, self._caches,
-                self._lens.copy(), active, np.int32(self._step_idx),
+                self._lens.copy(), active, *sargs,
             )
         if self.drafter is not None:
             # compile the verify (all writes masked: numerically a
             # no-op) and let the drafter warm its own programs, so a
             # supervisor restart never compiles on the serving path
             c = self._kb + 1
-            fn = self._verify_fns.get(c)
+            fn = self._verify_fns.get((c, False))
             if fn is None:
                 fn = self._build_verify_fn(c)
-                self._verify_fns = {**self._verify_fns, c: fn}
+                self._verify_fns = {**self._verify_fns, (c, False): fn}
             with annotate("serving/warmup"):
                 self._ctx, self._caches, _, _ = fn(
                     self.model.params, self._ctx, self._caches,
                     self._lens.copy(), active,
                     np.zeros((self.num_slots, self._kb), np.int32),
-                    np.zeros((self.num_slots,), np.int32),
+                    np.zeros((self.num_slots,), np.int32), *sargs,
                 )
             self.drafter.warmup()
 
@@ -1277,23 +1461,25 @@ class DecodeStepper:
     # and the sampling tail are the dense bodies verbatim, which is
     # what keeps paged greedy output pinned token-identical.
 
-    def _build_step_fn_paged(self, pbt: int):
+    def _build_step_fn_paged(self, pbt: int, masked=False):
         """Compiled paged decode step for table bucket ``pbt``: the
         dense ``_build_step_fn`` with the per-row cache write scattered
         to ``table[row][pos // ps]`` and attention over the gathered
         pages. Inactive / short rows pad their tables with the null
         sentinel page (writes masked to read-back, reads masked by the
-        position mask), so one program serves every occupancy."""
+        position mask), so one program serves every occupancy. Sampling
+        params are data (see ``_build_step_fn``); ``masked`` adds the
+        grammar-mask argument."""
         import jax
         import jax.numpy as jnp
 
         from distkeras_tpu.ops.quantization import qmatmul, qshape
+        from distkeras_tpu.serving import sampling as _sp
 
         gen = self._gen
-        temp, b, ps = gen.temperature, self.num_slots, self.page_size
+        b, ps = self.num_slots, self.page_size
         t = pbt * ps  # gathered (logical) attention extent
         tp = self._tp
-        base_key = jax.random.PRNGKey(self.seed)
 
         def stage_step(blk, moe, p, pm, x, ck, cv, phys, off, table,
                        pos, active):
@@ -1330,7 +1516,8 @@ class DecodeStepper:
                 x = x + gen._moe_nodrop(pm, x)
             return x, ck, cv
 
-        def step(params, ctx, pools, lens, active, table, step_idx):
+        def step(params, ctx, pools, lens, active, table, temps, topk,
+                 topp, seeds, spos, *rest):
             bp, p_emb, p_ln, p_head = self._unpack(params)
             pos = jnp.clip(lens - 1, 0, tp - 1)  # (B,) per-slot position
             rows = jnp.arange(b)
@@ -1349,13 +1536,15 @@ class DecodeStepper:
                 new_pools.append((ck, cv))
             x, _ = gen._final_ln.apply(p_ln, {}, x)
             logit, _ = gen._head.apply(p_head, {}, x)  # (B, V)
-            if temp == 0.0:
-                nxt = jnp.argmax(logit, axis=-1).astype(ctx.dtype)
-            else:
-                sub = jax.random.fold_in(base_key, step_idx)
-                nxt = jax.random.categorical(
-                    sub, gen._filter_logits(logit / temp), axis=-1
-                ).astype(ctx.dtype)
+            if masked:
+                logit = logit + rest[0]  # grammar mask (0 / -inf rows)
+            nxt = jax.lax.cond(
+                jnp.any(temps > 0.0),
+                lambda: _sp.sample_tokens(
+                    logit, temps, topk, topp, seeds, spos
+                ),
+                lambda: jnp.argmax(logit, axis=-1).astype(jnp.int32),
+            ).astype(ctx.dtype)
             wpos = jnp.clip(pos + 1, 0, tp - 1)
             cur = ctx[rows, wpos]
             write = active & (pos + 1 <= tp - 1)
@@ -1448,17 +1637,20 @@ class DecodeStepper:
 
         return jax.jit(copy, donate_argnums=(0,))
 
-    def _build_verify_fn_paged(self, c: int, pbt: int):
+    def _build_verify_fn_paged(self, c: int, pbt: int, masked=False):
         """Compiled paged speculative verify for (``c`` candidates,
         table bucket ``pbt``): the dense ``_build_verify_fn`` with the
         (B, C) candidate K/V writes scattered to their physical pages
         and attention over the gathered extent. Scratch overrun lands
         in the slot's reserved scratch pages (``pages_for`` includes
-        the verify window), exactly as the dense pad absorbs it."""
+        the verify window), exactly as the dense pad absorbs it.
+        Sampling/acceptance and the ``masked`` grammar variant follow
+        ``_build_verify_fn``."""
         import jax
         import jax.numpy as jnp
 
         from distkeras_tpu.ops.quantization import qmatmul, qshape
+        from distkeras_tpu.serving import sampling as _sp
 
         gen = self._gen
         b, tp, ml = self.num_slots, self._tp, self.max_len
@@ -1503,7 +1695,7 @@ class DecodeStepper:
             return x, ck, cv
 
         def verify(params, ctx, pools, lens, active, dtoks, dcnt,
-                   table):
+                   table, temps, topk, topp, seeds, spos, *rest):
             bp, p_emb, p_ln, p_head = self._unpack(params)
             pos = jnp.clip(lens - 1, 0, ml - 1)  # (B,)
             rows = jnp.arange(b)
@@ -1526,25 +1718,24 @@ class DecodeStepper:
                 new_pools.append((ck, cv))
             x, _ = gen._final_ln.apply(p_ln, {}, x)
             logit, _ = gen._head.apply(p_head, {}, x)  # (B, C, V)
-            t_arg = jnp.argmax(logit, axis=-1).astype(ctx.dtype)
-            agree = (dtoks == t_arg[:, : c - 1]) & (
-                jnp.arange(c - 1)[None, :] < dcnt[:, None]
+            if masked:
+                logit = logit.at[:, 0].add(rest[0])
+            out, n_new = jax.lax.cond(
+                jnp.any(temps > 0.0),
+                lambda: _sp.spec_window_tokens(
+                    logit, dtoks, dcnt, temps, topk, topp, seeds, spos
+                ),
+                lambda: _sp.greedy_window_tokens(logit, dtoks, dcnt),
             )
-            n_acc = jnp.argmin(  # first disagreement; c-1 if all agree
-                jnp.concatenate(
-                    [agree, jnp.zeros((b, 1), bool)], axis=1
-                ).astype(jnp.int32),
-                axis=1,
-            )
-            n_new = n_acc + 1
+            out = out.astype(ctx.dtype)
             wpos = cpos + 1  # <= ml-1 + c < tp: scratch absorbs overrun
             keep = active[:, None] & (
                 jnp.arange(c)[None, :] < n_new[:, None]
             )
             rows2 = rows[:, None]
             cur = ctx[rows2, wpos]
-            ctx = ctx.at[rows2, wpos].set(jnp.where(keep, t_arg, cur))
-            return ctx, new_pools, t_arg, n_new
+            ctx = ctx.at[rows2, wpos].set(jnp.where(keep, out, cur))
+            return ctx, new_pools, out, n_new
 
         return jax.jit(verify, donate_argnums=(1, 2))
 
@@ -1560,44 +1751,65 @@ class DecodeStepper:
         # bookkeeping: a failed step leaves the slot bank exactly as it
         # was, which is what makes the batcher's blame retries sound
         self._fire("stepper.step", active=active)
+        tmask = self._build_tmask(active)  # None unless constrained
+        masked = tmask is not None
+        sargs = self._sampling_args()
+        extra = (tmask,) if masked else ()
         if self.paged:
             pbt = self._table_bucket()
-            fn = self._pstep_fns.get(pbt)
+            key = (pbt, masked)
+            fn = self._pstep_fns.get(key)
             if fn is None:
                 self._compiling()
-                fn = self._build_step_fn_paged(pbt)
-                self._pstep_fns = {**self._pstep_fns, pbt: fn}
+                fn = self._build_step_fn_paged(pbt, masked)
+                self._pstep_fns = {**self._pstep_fns, key: fn}
             with annotate("serving/step"):
                 self._ctx, self._pools, toks = fn(
                     self.model.params, self._ctx, self._pools,
                     self._lens.copy(), active,
-                    self._tables_array(pbt), np.int32(self._step_idx),
+                    self._tables_array(pbt), *sargs, *extra,
                 )
         else:
-            if self._step_fn is None:
+            fn = self._step_fns.get(masked)
+            if fn is None:
                 self._compiling()
-                self._step_fn = self._build_step_fn()
+                fn = self._build_step_fn(masked)
+                self._step_fns = {**self._step_fns, masked: fn}
             with annotate("serving/step"):
-                self._ctx, self._caches, toks = self._step_fn(
+                self._ctx, self._caches, toks = fn(
                     self.model.params, self._ctx, self._caches,
-                    self._lens.copy(), active, np.int32(self._step_idx),
+                    self._lens.copy(), active, *sargs, *extra,
                 )
-        self._step_idx += 1
         toks = np.asarray(toks)
         self._lens[active] = np.minimum(
             self._lens[active] + 1, self._lens_cap
         )
+        # the RNG counter mirrors the length discipline exactly: a
+        # failed call advanced nothing, a successful one advanced each
+        # active slot once — replay through blame probes is this line
+        self._spos[active] += 1
+        if self._grammar:
+            self._advance_grammar(
+                toks.reshape(-1, 1), np.where(active, 1, 0)
+            )
         return toks
 
-    def _build_step_fn(self):
+    def _build_step_fn(self, masked=False):
+        """Compiled dense decode step. Sampling params are DATA (per-
+        slot arrays), never part of the compile key: one program serves
+        greedy and sampled slots mixed, and an all-greedy batch takes
+        the argmax fast path (``lax.cond`` on ``any(temps > 0)``) —
+        output bit-identical to the pre-sampling program. ``masked``
+        selects the grammar variant (an extra (B, V) additive mask
+        argument); unconstrained traffic never compiles or pays it."""
         import jax
         import jax.numpy as jnp
 
         from distkeras_tpu.ops.quantization import qmatmul, qshape
+        from distkeras_tpu.serving import sampling as _sp
 
         gen = self._gen
-        temp, b, t = gen.temperature, self.num_slots, self._tp
-        base_key = jax.random.PRNGKey(self.seed)
+        b, t = self.num_slots, self._tp
 
         def stage_step(blk, moe, p, pm, x, ck, cv, pos, active):
             """One token per slot through one (block, optional MoE)
@@ -1636,7 +1848,8 @@ class DecodeStepper:
                 x = x + gen._moe_nodrop(pm, x)
             return x, ck, cv
 
-        def step(params, ctx, caches, lens, active, step_idx):
+        def step(params, ctx, caches, lens, active, temps, topk, topp,
+                 seeds, spos, *rest):
             bp, p_emb, p_ln, p_head = self._unpack(params)
             pos = jnp.clip(lens - 1, 0, t - 1)  # (B,) per-slot position
             tok = jnp.take_along_axis(ctx, pos[:, None], axis=1)[:, 0]
@@ -1651,13 +1864,15 @@ class DecodeStepper:
                 new_caches.append((ck, cv))
             x, _ = gen._final_ln.apply(p_ln, {}, x)
             logit, _ = gen._head.apply(p_head, {}, x)  # (B, V)
-            if temp == 0.0:
-                nxt = jnp.argmax(logit, axis=-1).astype(ctx.dtype)
-            else:
-                sub = jax.random.fold_in(base_key, step_idx)
-                nxt = jax.random.categorical(
-                    sub, gen._filter_logits(logit / temp), axis=-1
-                ).astype(ctx.dtype)
+            if masked:
+                logit = logit + rest[0]  # grammar mask (0 / -inf rows)
+            nxt = jax.lax.cond(
+                jnp.any(temps > 0.0),
+                lambda: _sp.sample_tokens(
+                    logit, temps, topk, topp, seeds, spos
+                ),
+                lambda: jnp.argmax(logit, axis=-1).astype(jnp.int32),
+            ).astype(ctx.dtype)
             wpos = jnp.clip(pos + 1, 0, t - 1)
             rows = jnp.arange(b)
             cur = ctx[rows, wpos]
@@ -1731,6 +1946,17 @@ class DecodeStepper:
                     ],
                     axis=1,
                 )
+            if self._grammar:
+                # grammar-constrained slots never ride a draft window:
+                # the host cannot know a future position's mask before
+                # the tokens leading to it exist. They advance one
+                # masked token per iteration (candidate 0 of the
+                # verify, or the plain step on fallback) — zeroed HERE,
+                # before the proposal cache, so blame-probe replay sees
+                # the same zeroed drafts
+                for i in self._grammar:
+                    dtoks[i] = 0
+                    dcnt[i] = 0
             self._spec_pending = (self._lens.copy(), dtoks, dcnt)
         if int(dcnt[active].sum()) == 0:
             self.spec_fallback_steps += 1
@@ -1746,43 +1972,52 @@ class DecodeStepper:
         self._fire("stepper.verify", active=active)
         c = k + 1
         lens0 = self._lens.copy()
+        tmask = self._build_tmask(active)
+        vmasked = tmask is not None
+        sargs = self._sampling_args()
+        extra = (tmask,) if vmasked else ()
         if self.paged:
             # verify windows amortize over k+1 candidate tokens, so
             # they too run at the fixed extent (one program per c)
             pbt = self._max_pages_bucket
-            key = (c, pbt)
+            key = (c, pbt, vmasked)
             fn = self._pverify_fns.get(key)
             if fn is None:
                 self._compiling()
-                fn = self._build_verify_fn_paged(c, pbt)
+                fn = self._build_verify_fn_paged(c, pbt, vmasked)
                 self._pverify_fns = {**self._pverify_fns, key: fn}
             with annotate("serving/verify"):
-                self._ctx, self._pools, t_arg, n_new = fn(
+                self._ctx, self._pools, t_out, n_new = fn(
                     self.model.params, self._ctx, self._pools, lens0,
                     active, dtoks.astype(np.int32),
                     dcnt.astype(np.int32), self._tables_array(pbt),
+                    *sargs, *extra,
                 )
         else:
-            fn = self._verify_fns.get(c)
+            key = (c, vmasked)
+            fn = self._verify_fns.get(key)
             if fn is None:
                 self._compiling()
-                fn = self._build_verify_fn(c)
-                self._verify_fns = {**self._verify_fns, c: fn}
+                fn = self._build_verify_fn(c, vmasked)
+                self._verify_fns = {**self._verify_fns, key: fn}
             with annotate("serving/verify"):
-                self._ctx, self._caches, t_arg, n_new = fn(
+                self._ctx, self._caches, t_out, n_new = fn(
                     self.model.params, self._ctx, self._caches, lens0,
                     active, dtoks.astype(np.int32),
-                    dcnt.astype(np.int32),
+                    dcnt.astype(np.int32), *sargs, *extra,
                 )
-        t_arg = np.asarray(t_arg)
+        t_out = np.asarray(t_out)
         counts = np.where(active, np.asarray(n_new), 0).astype(np.int64)
         self._lens[active] = np.minimum(
             self._lens[active] + counts[active], self._lens_cap
         )
+        self._spos[active] += counts[active].astype(np.int32)
+        if self._grammar:
+            self._advance_grammar(t_out, counts)
         self.spec_verify_steps += 1
         self.spec_drafted_tokens += int(dcnt[active].sum())
-        drafter.sync(active, t_arg, counts, lens0)
-        return t_arg, counts, True
+        drafter.sync(active, t_out, counts, lens0)
+        return t_out, counts, True
 
     def write_segment(self, active, toks, counts, lens0) -> None:
         """Write each active row's first ``counts[i]`` tokens at
@@ -1814,22 +2049,28 @@ class DecodeStepper:
             np.asarray(active, bool),
         )
 
-    def _build_verify_fn(self, c: int):
+    def _build_verify_fn(self, c: int, masked=False):
         """Compiled speculative verify for ``c`` candidates per slot
         (the slot's last real token plus ``c-1`` draft proposals —
         ``c`` is the pow2 ``draft_k`` bucket + 1, the chunk-program
         discipline). One call scores every candidate position of every
         active slot against the live caches (the generators'
         ``_stage_chunk`` math restated with PER-ROW write offsets,
-        like the decode step), computes the longest greedy-agreeing
-        prefix, and writes the accepted tokens into the context rows —
-        the scheduler reads back only (tokens, counts). K/V and
-        context writes past the real sequence land in the scratch pad
-        (``_tp``); inactive slots are frozen throughout."""
+        like the decode step), computes the accepted window — greedy
+        rows by longest argmax agreement, sampled rows by rejection
+        sampling (``sampling.spec_window_tokens``) — and writes the
+        accepted tokens into the context rows; the scheduler reads
+        back only (tokens, counts). K/V and context writes past the
+        real sequence land in the scratch pad (``_tp``); inactive
+        slots are frozen throughout. ``masked`` adds the grammar mask
+        argument, applied to candidate 0 only: constrained slots never
+        draft (``spec_step`` zeroes their proposals), so candidate 0
+        is the single token they emit per window."""
         import jax
         import jax.numpy as jnp
 
         from distkeras_tpu.ops.quantization import qmatmul, qshape
+        from distkeras_tpu.serving import sampling as _sp
 
         gen = self._gen
         b, tp, ml = self.num_slots, self._tp, self.max_len
@@ -1872,7 +2113,8 @@ class DecodeStepper:
                 x = x + gen._moe_nodrop(pm, x)
             return x, ck, cv
 
-        def verify(params, ctx, caches, lens, active, dtoks, dcnt):
+        def verify(params, ctx, caches, lens, active, dtoks, dcnt,
+                   temps, topk, topp, seeds, spos, *rest):
             bp, p_emb, p_ln, p_head = self._unpack(params)
             pos = jnp.clip(lens - 1, 0, ml - 1)  # (B,)
             rows = jnp.arange(b)
@@ -1890,27 +2132,26 @@ class DecodeStepper:
                 new_caches.append((ck, cv))
             x, _ = gen._final_ln.apply(p_ln, {}, x)
             logit, _ = gen._head.apply(p_head, {}, x)  # (B, C, V)
-            t_arg = jnp.argmax(logit, axis=-1).astype(ctx.dtype)
-            # accept the agreeing prefix + the target's correction;
-            # padded / absent proposals can never be "accepted"
-            agree = (dtoks == t_arg[:, : c - 1]) & (
-                jnp.arange(c - 1)[None, :] < dcnt[:, None]
+            if masked:
+                # constrained slots never draft: candidate 0 is their
+                # one emission, so the mask applies there alone
+                logit = logit.at[:, 0].add(rest[0])
+            out, n_new = jax.lax.cond(
+                jnp.any(temps > 0.0),
+                lambda: _sp.spec_window_tokens(
+                    logit, dtoks, dcnt, temps, topk, topp, seeds, spos
+                ),
+                lambda: _sp.greedy_window_tokens(logit, dtoks, dcnt),
             )
-            n_acc = jnp.argmin(  # first disagreement; c-1 if all agree
-                jnp.concatenate(
-                    [agree, jnp.zeros((b, 1), bool)], axis=1
-                ).astype(jnp.int32),
-                axis=1,
-            )
-            n_new = n_acc + 1
+            out = out.astype(ctx.dtype)
             wpos = cpos + 1  # <= ml-1 + c < tp: scratch absorbs overrun
             keep = active[:, None] & (
                 jnp.arange(c)[None, :] < n_new[:, None]
             )
             rows2 = rows[:, None]
             cur = ctx[rows2, wpos]
-            ctx = ctx.at[rows2, wpos].set(jnp.where(keep, t_arg, cur))
-            return ctx, new_caches, t_arg, n_new
+            ctx = ctx.at[rows2, wpos].set(jnp.where(keep, out, cur))
+            return ctx, new_caches, out, n_new
 
         return jax.jit(verify, donate_argnums=(1, 2))
 
@@ -1936,7 +2177,8 @@ class ServingEngine:
                  watchdog_interval=10.0, watchdog_grace=None,
                  max_restarts=3, restart_backoff=0.05,
                  metrics_path=None, speculative=None, draft_bundle=None,
-                 draft_k=4, ngram_max=3, flight_recorder=True,
+                 draft_k=4, ngram_max=3, spec_mode="rejection",
+                 flight_recorder=True,
                  recorder_capacity=2048, postmortem_dir=None,
                  slos=None, slo_interval=5.0, paged=False,
                  page_size=16, num_pages=None):
@@ -1956,9 +2198,14 @@ class ServingEngine:
         ``True`` picks ``"draft"`` when a bundle is given else
         ``"ngram"``, or pass a drafter instance directly. ``draft_k``
         is the proposals-per-window budget; each scheduler iteration
-        then emits 1..draft_k+1 tokens per slot, output still pinned
-        token-identical to solo greedy decode. Greedy only
-        (temperature=0, no top_k/top_p).
+        then emits 1..draft_k+1 tokens per slot, greedy output still
+        pinned token-identical to solo greedy decode. Under
+        ``spec_mode="rejection"`` (the default) SAMPLED requests ride
+        the same verify machinery via rejection sampling
+        (distribution-preserving, same-seed replay-exact);
+        ``spec_mode="strict"`` is the legacy greedy-only mode
+        (temperature=0, no top_k/top_p — anything else refused with
+        the historical ValueError).
 
         Self-healing knobs: ``quarantine_steps`` (scheduler iterations
         a blamed slot sits out — see ``ContinuousBatcher``),
@@ -2052,15 +2299,16 @@ class ServingEngine:
         drafter = self._resolve_drafter(
             speculative, draft_bundle, ngram_max
         )
-        if drafter is not None and (
-            temperature != 0.0 or top_k is not None or top_p is not None
-        ):
-            # a config error, not a model limitation: raise here rather
-            # than letting the stepper's ValueError silently demote the
-            # engine to predict-only
-            raise ValueError(
-                "speculative serving verifies GREEDY agreement; it is "
-                "only defined for temperature=0 without top_k/top_p"
+        self.spec_mode = spec_mode
+        if drafter is not None:
+            # a config error, not a model limitation: validate here
+            # (the ONE shared helper — the stepper re-checks through
+            # the same code) rather than letting a stepper ValueError
+            # silently demote the engine to predict-only
+            from distkeras_tpu.serving.sampling import check_spec_sampling
+
+            self.spec_mode = check_spec_sampling(
+                spec_mode, temperature, top_k, top_p
             )
         # everything a supervisor restart needs to rebuild the device
         # face from scratch (fresh slot bank, fresh caches, recompiled
@@ -2070,8 +2318,8 @@ class ServingEngine:
             num_slots=num_slots, temperature=temperature, seed=seed,
             top_k=top_k, top_p=top_p, kv_dtype=kv_dtype,
             prefix_cache=store, speculative=drafter, draft_k=draft_k,
-            paged=paged, page_size=page_size, num_pages=num_pages,
-            recorder=self.recorder,
+            spec_mode=self.spec_mode, paged=paged, page_size=page_size,
+            num_pages=num_pages, recorder=self.recorder,
         )
         try:
             self._stepper = DecodeStepper(model, **self._stepper_cfg)
@@ -2160,6 +2408,24 @@ class ServingEngine:
             fn=lambda: (
                 0 if self._stepper is None
                 else self._stepper.prefix_fetch_failures
+            ),
+        )
+        # sampling & structured-decoding observability: device-side
+        # grammar masks applied and all-candidates-zeroed forced-EOS
+        # fallbacks (both live on the stepper, like the prefix ledger;
+        # sampled-request and forked-slot counters live on the batcher)
+        reg.gauge(
+            "serving_constrained_masks",
+            fn=lambda: (
+                0 if self._stepper is None
+                else self._stepper.constrained_masks
+            ),
+        )
+        reg.gauge(
+            "serving_mask_exhaustions",
+            fn=lambda: (
+                0 if self._stepper is None
+                else self._stepper.mask_exhaustions
             ),
         )
         if paged:
@@ -2481,11 +2747,22 @@ class ServingEngine:
     # -- generate -----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               deadline=None, trace=None) -> ServeRequest:
+               deadline=None, trace=None, sampling=None) -> ServeRequest:
         """``trace``: an optional ``obs.TraceContext`` — the scheduler
         then keeps the per-request event ledger ``obs.request_spans``
         turns into the server-side phase timeline. None (the default)
-        costs nothing."""
+        costs nothing.
+
+        ``sampling``: per-request ``SamplingParams`` (or its wire
+        dict). None = the engine-wide defaults (greedy unless the
+        engine was built with a temperature). ``n > 1`` schedules n
+        parallel completions via CoW ``fork_slot`` (paged engines);
+        a grammar constrains decoding with device-side token masks."""
+        from distkeras_tpu.serving.sampling import (
+            SamplingParams,
+            check_spec_sampling,
+        )
+
         batcher = self.batcher  # one read: restarts swap the attribute
         if batcher is None:
             raise EngineStoppedError(
@@ -2498,9 +2775,20 @@ class ServingEngine:
                 f"engine is degraded: {self._failed_reason} "
                 f"(last crash: {self._last_crash})"
             )
+        sampling = SamplingParams.from_wire(sampling)
+        if sampling is not None and self._stepper is not None and (
+            self._stepper.speculative
+        ):
+            # the strict (legacy greedy-agreement) mode refuses sampled
+            # requests through the SAME shared validation the
+            # constructors use — rejection mode accepts them
+            check_spec_sampling(
+                self.spec_mode, sampling.temperature, sampling.top_k,
+                sampling.top_p,
+            )
         req = ServeRequest(
             prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
-            trace=trace,
+            trace=trace, sampling=sampling,
         )
         try:
             try:
@@ -2526,10 +2814,13 @@ class ServingEngine:
                 )
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
-                 deadline=None, timeout=None, trace=None) -> np.ndarray:
+                 deadline=None, timeout=None, trace=None,
+                 sampling=None) -> np.ndarray:
+        """Returns the full sequence (prompt + generated, eos-trimmed);
+        with ``sampling.n > 1``, a LIST of n such sequences."""
         req = self.submit(
             prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
-            trace=trace,
+            trace=trace, sampling=sampling,
         )
         return self.wait(req, timeout)
 

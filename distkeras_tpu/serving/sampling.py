@@ -1,0 +1,658 @@
+"""Per-request sampling & structured decoding — params, RNG, masks.
+
+This module is the sampling subsystem's spine, shared by every layer:
+
+- :class:`SamplingParams`: the per-request record (temperature / top_k
+  / top_p / seed / n / optional grammar) that rides
+  ``ServingClient.generate`` -> the DKT1 frame header -> router
+  forwarding -> ``ServeRequest`` -> per-slot sampler state in
+  ``DecodeStepper``. Params omitted (or ``temperature=0`` with no
+  grammar) mean GREEDY — pinned token-identical to the pre-sampling
+  serving tier on every admission path.
+- Counter-based RNG: every sampled token draws from a key derived as
+  ``fold_in(fold_in(PRNGKey(0), request_seed), emitted_position)`` —
+  a pure function of the REQUEST (never the global step index, never
+  batch composition), so the same request replays token-identically
+  across blame probes, quarantine re-verification, watchdog restarts,
+  paged restore, and a fresh admission on another replica. The solo
+  ``CachedSequenceGenerator`` samples through the very same functions,
+  making solo sampled decode the identity reference for served
+  sampled decode (same seed => same tokens), mirroring how greedy is
+  pinned today.
+- ``seed_for_completion``: n-parallel completions fork one prefill
+  (``fork_slot`` CoW) and diverge ONLY through their derived seeds —
+  completion 0 keeps the request seed (it IS the solo reference), and
+  completion j's stream is exactly what an independent admission with
+  ``seed_for_completion(seed, j)`` would produce (the bench pins this).
+- :class:`TokenMaskCompiler`: pure-host grammar -> incremental
+  per-position token masks, applied device-side as additive ``0/-inf``
+  rows. Specs: a fixed ``allow`` list, a position-indexed
+  ``sequence``, a ``choice`` over token sequences (the JSON-schema
+  "enum of literals" shape, compiled to a trie), or an explicit
+  ``fsm`` (token-level DFA). A mask that zeroes out every candidate
+  falls back to forced-EOS (recorded, never a hang).
+- ``check_spec_sampling``: THE shared speculative-sampling validation
+  (previously copy-pasted in two places). Under the default
+  ``"rejection"`` mode, speculative decoding generalizes from greedy
+  agreement to rejection sampling (a drafted token is accepted with
+  probability ``p_target(token)``; the correction draws from the
+  residual), so the verify machinery keeps paying at temperature > 0;
+  ``"strict"`` is the legacy greedy-agreement-only mode, selected
+  explicitly.
+
+No JAX at module import time: the scheduler (pure host logic) imports
+this module for :class:`SamplingParams`; the device-side helpers import
+``jax`` inside the functions that trace them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: the legacy greedy-agreement refusal, now raised only by the explicit
+#: strict mode (one copy; engine + stepper both validate through here)
+SPEC_GREEDY_MSG = (
+    "speculative serving verifies GREEDY agreement; it is only defined "
+    "for temperature=0 without top_k/top_p (spec_mode='strict' — use "
+    "the default spec_mode='rejection' to serve sampled requests "
+    "speculatively)"
+)
+
+_GOLDEN = 0x9E3779B1  # 32-bit golden-ratio increment (completion seeds)
+_SEED_MOD = 1 << 31
+
+
+def seed_for_completion(seed: int, completion: int) -> int:
+    """The seed completion ``completion`` of a request samples under.
+    Completion 0 keeps the request seed verbatim (it is the solo
+    identity reference); siblings derive disjoint streams. Pure and
+    documented so "n=4 via fork" and "4 independent admissions with
+    the derived seeds" are the SAME computation — the bench asserts
+    their outputs token-identical."""
+    if completion == 0:
+        return int(seed) % _SEED_MOD
+    return (int(seed) + _GOLDEN * int(completion)) % _SEED_MOD
+
+
+def check_spec_sampling(spec_mode: str, temperature=0.0, top_k=None,
+                        top_p=None) -> str:
+    """Validate a speculative engine's sampling posture; returns the
+    normalized mode. ``"rejection"`` (default) accepts any sampling
+    config; ``"strict"`` raises the legacy ValueError for anything
+    non-greedy."""
+    if spec_mode not in ("rejection", "strict"):
+        raise ValueError(
+            f"spec_mode must be 'rejection' or 'strict'; got {spec_mode!r}"
+        )
+    if spec_mode == "strict" and (
+        temperature != 0.0 or top_k is not None or top_p is not None
+    ):
+        raise ValueError(SPEC_GREEDY_MSG)
+    return spec_mode
+
+
+class SamplingParams:
+    """Per-request sampling & structured-decoding parameters.
+
+    ``temperature=0`` (the default) is greedy argmax; ``top_k`` /
+    ``top_p`` filter sampling and therefore require ``temperature > 0``
+    (the solo generators' rule, applied at the request boundary so a
+    bad config is a submit-time ``ValueError``, not a device surprise).
+    ``seed`` keys the counter-based RNG: same (prompt, params) => same
+    tokens, on any replica, through any restart. ``n`` asks for n
+    parallel completions (CoW ``fork_slot`` after prefill; completion
+    j samples under ``seed_for_completion(seed, j)``). ``grammar`` is
+    a :class:`TokenMaskCompiler` spec dict — constrained decoding via
+    per-position token masks, combinable with greedy OR sampled
+    decode.
+    """
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed", "n", "grammar")
+
+    def __init__(self, temperature=0.0, top_k=None, top_p=None, seed=0,
+                 n=1, grammar=None):
+        self.temperature = float(temperature)
+        self.top_k = None if top_k is None else int(top_k)
+        self.top_p = None if top_p is None else float(top_p)
+        self.seed = int(seed) % _SEED_MOD
+        self.n = int(n)
+        self.grammar = grammar
+        self.validate()
+
+    def validate(self):
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0; got {self.temperature}"
+            )
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1; got {self.top_k}")
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1]; got {self.top_p}"
+            )
+        if (
+            (self.top_k is not None or self.top_p is not None)
+            and self.temperature == 0.0
+        ):
+            raise ValueError(
+                "top_k/top_p filter SAMPLING; temperature=0 is greedy "
+                "argmax — pass a temperature > 0"
+            )
+        if self.n < 1:
+            raise ValueError(f"n must be >= 1; got {self.n}")
+        if self.grammar is not None:
+            TokenMaskCompiler.check(self.grammar)
+
+    @property
+    def is_greedy(self) -> bool:
+        """True when token SELECTION is argmax (a grammar may still
+        constrain the candidates)."""
+        return self.temperature == 0.0
+
+    @property
+    def is_default(self) -> bool:
+        """True when these params reproduce the no-params path exactly:
+        greedy, unconstrained, single completion. The
+        ``serving_sampled_requests`` counter counts the complement."""
+        return (
+            self.temperature == 0.0 and self.grammar is None
+            and self.n == 1
+        )
+
+    def to_wire(self) -> dict:
+        """JSON-able dict for the DKT1 ``sampling`` header field (the
+        router forwards it untouched; absent fields mean defaults)."""
+        out = {}
+        if self.temperature != 0.0:
+            out["temperature"] = self.temperature
+        if self.top_k is not None:
+            out["top_k"] = self.top_k
+        if self.top_p is not None:
+            out["top_p"] = self.top_p
+        if self.seed:
+            out["seed"] = self.seed
+        if self.n != 1:
+            out["n"] = self.n
+        if self.grammar is not None:
+            out["grammar"] = self.grammar
+        return out
+
+    @classmethod
+    def from_wire(cls, d) -> "SamplingParams | None":
+        """None / empty dict -> None (the greedy no-params path costs
+        nothing); unknown keys raise (a typo'd knob must not silently
+        serve greedy)."""
+        if not d:
+            return None
+        if isinstance(d, SamplingParams):
+            return d
+        extra = set(d) - {"temperature", "top_k", "top_p", "seed", "n",
+                          "grammar"}
+        if extra:
+            raise ValueError(f"unknown sampling fields {sorted(extra)}")
+        return cls(**d)
+
+    def __repr__(self):
+        return f"SamplingParams({self.to_wire()})"
+
+
+# --------------------------------------------------------------------------
+# Device-side sampling (shared by the solo generators and every serving
+# step / verify program — the same-seed identity contract lives here).
+# --------------------------------------------------------------------------
+
+
+def _row_keys(seeds, spos):
+    """One PRNG key per row: ``fold_in(fold_in(PRNGKey(0), seed),
+    emitted_position)``. The base key is a CONSTANT: the request seed
+    carries the entropy, and solo/served must derive identical keys
+    without sharing an engine object."""
+    import jax
+
+    base = jax.random.PRNGKey(0)
+
+    def one(s, p):
+        return jax.random.fold_in(jax.random.fold_in(base, s), p)
+
+    return jax.vmap(one)(seeds, spos)
+
+
+def filter_logits(scaled, top_k, top_p):
+    """Vectorized per-row top-k / nucleus filtering of (B, V) logits
+    (already temperature-scaled): -inf out the excluded tokens;
+    ``jax.random.categorical`` renormalizes. ``top_k[i] <= 0`` and
+    ``top_p[i] >= 1`` disable the respective filter for row i. When
+    both are set, the nucleus runs over the distribution that SURVIVED
+    top-k (renormalized) — the solo generators' documented combined
+    semantics. ONE sort total: the k-filtered sorted view is the full
+    descending sort with ranks >= k dropped to -inf, so the nucleus
+    never pays a second sort (XLA:CPU sorts are the dominant cost of
+    this transform — see PERF.md r15)."""
+    import jax
+    import jax.numpy as jnp
+
+    v = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k = jnp.clip(jnp.where(top_k <= 0, v, top_k), 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    out = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # nucleus over the k-survivors (excluded entries carry zero mass)
+    sorted2 = jnp.where(
+        jnp.arange(v)[None, :] < k[:, None], sorted_desc, -jnp.inf
+    )
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < jnp.minimum(top_p, 1.0)[:, None]
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted2, jnp.inf), axis=-1, keepdims=True
+    )
+    thresh = jnp.where(top_p[:, None] >= 1.0, -jnp.inf, thresh)
+    return jnp.where(out < thresh, -jnp.inf, out)
+
+
+def _maybe_filter(scaled, top_k, top_p):
+    """``filter_logits`` behind a runtime guard: a batch where no row
+    filters (pure-temperature traffic) skips the sort entirely —
+    ``lax.cond`` executes one branch, and the sort IS the transform's
+    cost."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.lax.cond(
+        jnp.any(top_k > 0) | jnp.any(top_p < 1.0),
+        lambda: filter_logits(scaled, top_k, top_p),
+        lambda: scaled,
+    )
+
+
+def sample_tokens(logit, temps, top_k, top_p, seeds, spos):
+    """(B, V) logits -> (B,) int32 tokens under per-row params. Greedy
+    rows (``temps[i] == 0``) take exact argmax — bit-for-bit the
+    pre-sampling behavior; sampled rows draw
+    ``categorical(key(seed_i, spos_i), filtered(logit_i / temp_i))``.
+    Apply any grammar mask to ``logit`` BEFORE calling (it constrains
+    greedy and sampled selection alike)."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logit, axis=-1).astype(jnp.int32)
+    scaled = logit / jnp.maximum(temps, 1e-6)[:, None]
+    filt = _maybe_filter(scaled, top_k, top_p)
+    keys = _row_keys(seeds, spos)
+    samp = jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
+    return jnp.where(temps > 0.0, samp, greedy)
+
+
+def greedy_window_tokens(logit, dtoks, dcnt):
+    """The PR 4 greedy verify rule, factored: (B, C, V) logits ->
+    ``(argmax tokens (B, C), n_new (B,))`` accepting the longest
+    argmax-agreeing drafted prefix plus the target's correction. The
+    all-greedy fast path of a verify window (no sort, no gumbel)."""
+    import jax  # noqa: F401 — jnp ships with jax
+    import jax.numpy as jnp
+
+    b, c, _ = logit.shape
+    greedy = jnp.argmax(logit, axis=-1).astype(jnp.int32)
+    agree = (dtoks.astype(jnp.int32) == greedy[:, : c - 1]) & (
+        jnp.arange(c - 1)[None, :] < dcnt[:, None]
+    )
+    n_acc = jnp.argmin(  # first disagreement; c-1 if all agree
+        jnp.concatenate(
+            [agree, jnp.zeros((b, 1), bool)], axis=1
+        ).astype(jnp.int32),
+        axis=1,
+    )
+    return greedy, (n_acc + 1).astype(jnp.int32)
+
+
+def spec_window_tokens(logit, dtoks, dcnt, temps, top_k, top_p, seeds,
+                       spos):
+    """Mixed greedy / rejection-sampling acceptance over one verify
+    window. ``logit`` is (B, C, V) — target logits at the C candidate
+    positions (position j's logits distribute the token at emitted
+    index ``spos + j``); ``dtoks`` (B, C-1) are the draft proposals,
+    ``dcnt`` how many are real. Returns ``(out (B, C) int32, n_new
+    (B,) int32)``: row i emits its first ``n_new[i]`` tokens of
+    ``out[i]``.
+
+    Greedy rows keep the PR 4 rule exactly: accept the longest
+    argmax-agreeing prefix plus the target's correction. Sampled rows
+    use rejection sampling against the per-position target
+    distribution p (temperature/top-k/top-p applied): draft token d at
+    position e is accepted iff ``uniform(fold_in(key(seed, e), 1)) <
+    p(d)``; the first rejection draws its correction from the residual
+    (p with d masked out, renormalized), and a fully-accepted window's
+    bonus token — like every fresh (undrafted) position — draws
+    ``categorical(key(seed, e), p)``, the SAME draw the plain decode
+    step would make at that position, so replay never depends on
+    whether a position was reached through a verify window or a
+    fallback step. Acceptance preserves the sampling distribution;
+    the token SEQUENCE matches plain sampled decode only in
+    distribution (stated in ARCHITECTURE.md), while same-seed REPLAY
+    is exact."""
+    import jax
+    import jax.numpy as jnp
+
+    b, c, v = logit.shape
+    greedy = jnp.argmax(logit, axis=-1).astype(jnp.int32)  # (B, C)
+    scaled = logit / jnp.maximum(temps, 1e-6)[:, None, None]
+    flat_k = jnp.repeat(top_k, c)
+    flat_p = jnp.repeat(top_p, c)
+    filt = _maybe_filter(
+        scaled.reshape(b * c, v), flat_k, flat_p
+    )  # (B*C, V)
+    spos_c = (spos[:, None] + jnp.arange(c)[None, :]).reshape(-1)
+    keys = _row_keys(jnp.repeat(seeds, c), spos_c)  # (B*C, key)
+    fresh = jax.vmap(jax.random.categorical)(keys, filt).astype(
+        jnp.int32
+    ).reshape(b, c)
+    # residual draw: the rejected draft token masked out of p (guard:
+    # a draft holding ALL surviving mass cannot be rejected in exact
+    # arithmetic, but FP p=1-eps can — fall back to the fresh draw)
+    dtoks_pad = jnp.concatenate(
+        [dtoks, jnp.zeros((b, 1), dtoks.dtype)], axis=1
+    ).astype(jnp.int32)
+    onehot = (
+        jnp.arange(v)[None, :] == dtoks_pad.reshape(-1)[:, None]
+    )  # (B*C, V)
+    resid = jnp.where(onehot, -jnp.inf, filt)
+    resid_ok = jnp.isfinite(resid).any(axis=-1, keepdims=True)
+    resid = jnp.where(resid_ok, resid, filt)
+    resid_tok = jax.vmap(jax.random.categorical)(keys, resid).astype(
+        jnp.int32
+    ).reshape(b, c)
+    # acceptance: greedy rows by argmax agreement, sampled rows by
+    # u < p(draft) with u from the ACCEPT stream (fold_in(key, 1) —
+    # disjoint from the token-draw stream keyed by position alone)
+    probs = jax.nn.softmax(filt, axis=-1).reshape(b, c, v)
+    dprob = jnp.take_along_axis(
+        probs[:, : c - 1], dtoks_pad[:, : c - 1][..., None], axis=-1
+    )[..., 0]  # (B, C-1)
+    ukeys = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+    u = jax.vmap(lambda k: jax.random.uniform(k, ()))(ukeys).reshape(
+        b, c
+    )
+    accept_sampled = u[:, : c - 1] < dprob
+    accept_greedy = dtoks.astype(jnp.int32) == greedy[:, : c - 1]
+    proposed = jnp.arange(c - 1)[None, :] < dcnt[:, None]
+    acc = proposed & jnp.where(
+        temps[:, None] > 0.0, accept_sampled, accept_greedy
+    )
+    n_acc = jnp.argmin(  # first rejection; c-1 if all accepted
+        jnp.concatenate(
+            [acc, jnp.zeros((b, 1), bool)], axis=1
+        ).astype(jnp.int32),
+        axis=1,
+    )
+    n_new = (n_acc + 1).astype(jnp.int32)
+    # emitted tokens: accepted drafts verbatim, then the boundary token
+    # (residual at a rejected draft position, fresh past the drafts);
+    # greedy rows emit argmax everywhere (the PR 4 emission, verbatim)
+    j = jnp.arange(c)[None, :]
+    boundary = jnp.where(j < dcnt[:, None], resid_tok, fresh)
+    out_sampled = jnp.where(j < n_acc[:, None], dtoks_pad, boundary)
+    out = jnp.where(temps[:, None] > 0.0, out_sampled, greedy)
+    return out, n_new
+
+
+# --------------------------------------------------------------------------
+# Grammar-constrained decoding: pure-host mask compiler.
+# --------------------------------------------------------------------------
+
+
+class _GrammarState:
+    """Per-slot incremental mask state: ``mask()`` yields the (V,) bool
+    allowed-token mask for the NEXT position, ``advance(tok)`` consumes
+    the emitted token, ``clone()`` branches state for a CoW fork (each
+    completion walks the grammar independently)."""
+
+    def __init__(self, vocab_size, eos_id):
+        self.vocab_size = int(vocab_size)
+        self.eos_id = eos_id
+
+    def _base(self, allow_eos=False):
+        m = np.zeros(self.vocab_size, bool)
+        if allow_eos and self.eos_id is not None and (
+            0 <= self.eos_id < self.vocab_size
+        ):
+            m[self.eos_id] = True
+        return m
+
+    def mask(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def advance(self, tok: int) -> None:
+        raise NotImplementedError
+
+    def clone(self) -> "_GrammarState":
+        raise NotImplementedError
+
+
+class _AllowState(_GrammarState):
+    """Fixed whitelist every position (eos always allowed, so the
+    request can finish)."""
+
+    def __init__(self, vocab_size, eos_id, tokens):
+        super().__init__(vocab_size, eos_id)
+        self._mask = self._base(allow_eos=True)
+        for t in tokens:
+            if 0 <= int(t) < vocab_size:
+                self._mask[int(t)] = True
+
+    def mask(self):
+        return self._mask
+
+    def advance(self, tok):
+        pass
+
+    def clone(self):
+        c = _AllowState.__new__(_AllowState)
+        c.vocab_size, c.eos_id, c._mask = self.vocab_size, self.eos_id, self._mask
+        return c
+
+
+class _SequenceState(_GrammarState):
+    """Position-indexed allowed sets; past the last step: eos-only
+    (``loop=False``, the default) or wrap to step 0 (``loop=True``)."""
+
+    def __init__(self, vocab_size, eos_id, steps, loop=False):
+        super().__init__(vocab_size, eos_id)
+        self.steps = [
+            [int(t) for t in step if 0 <= int(t) < vocab_size]
+            for step in steps
+        ]
+        self.loop = bool(loop)
+        self.pos = 0
+
+    def mask(self):
+        if self.pos >= len(self.steps):
+            if self.loop:
+                idx = self.pos % len(self.steps)
+            else:
+                return self._base(allow_eos=True)  # forced finish
+        else:
+            idx = self.pos
+        m = self._base(allow_eos=self.pos >= len(self.steps))
+        for t in self.steps[idx]:
+            m[t] = True
+        return m
+
+    def advance(self, tok):
+        self.pos += 1
+
+    def clone(self):
+        c = _SequenceState(self.vocab_size, self.eos_id, [], self.loop)
+        c.steps, c.pos = self.steps, self.pos
+        return c
+
+
+class _ChoiceState(_GrammarState):
+    """Trie over a finite set of allowed token sequences (the
+    JSON-schema "enum of literal values" shape, token-level): at each
+    position, the allowed tokens are the next tokens of every sequence
+    still consistent with what was emitted; a fully-matched sequence
+    allows eos. An off-grammar token (possible only through forced-EOS
+    fallback interplay) dead-ends the state — the next mask is empty
+    and the fallback fires."""
+
+    def __init__(self, vocab_size, eos_id, sequences):
+        super().__init__(vocab_size, eos_id)
+        self.sequences = [
+            [int(t) for t in s] for s in sequences if len(s)
+        ]
+        self.live = list(range(len(self.sequences)))
+        self.pos = 0
+
+    def mask(self):
+        done = False
+        m = self._base()
+        for i in self.live:
+            seq = self.sequences[i]
+            if self.pos < len(seq):
+                if 0 <= seq[self.pos] < self.vocab_size:
+                    m[seq[self.pos]] = True
+            else:
+                done = True
+        if done and self.eos_id is not None and (
+            0 <= self.eos_id < self.vocab_size
+        ):
+            m[self.eos_id] = True
+        return m
+
+    def advance(self, tok):
+        tok = int(tok)
+        self.live = [
+            i for i in self.live
+            if self.pos < len(self.sequences[i])
+            and self.sequences[i][self.pos] == tok
+        ]
+        self.pos += 1
+
+    def clone(self):
+        c = _ChoiceState(self.vocab_size, self.eos_id, [])
+        c.sequences, c.live, c.pos = self.sequences, list(self.live), self.pos
+        return c
+
+
+class _FsmState(_GrammarState):
+    """Explicit token-level DFA: ``states[s]`` maps token id -> next
+    state; accept states additionally allow eos. Tokens without an
+    edge are masked off; an emitted token without an edge (forced-EOS
+    interplay) dead-ends the state."""
+
+    def __init__(self, vocab_size, eos_id, start, states, accept):
+        super().__init__(vocab_size, eos_id)
+        self.states = {
+            str(s): {int(t): str(n) for t, n in edges.items()}
+            for s, edges in states.items()
+        }
+        self.accept = {str(s) for s in (accept or [])}
+        self.state = str(start)
+
+    def mask(self):
+        edges = self.states.get(self.state, {})
+        m = self._base(allow_eos=self.state in self.accept)
+        for t in edges:
+            if 0 <= t < self.vocab_size:
+                m[t] = True
+        return m
+
+    def advance(self, tok):
+        self.state = self.states.get(self.state, {}).get(int(tok), "\0dead")
+
+    def clone(self):
+        c = _FsmState.__new__(_FsmState)
+        c.vocab_size, c.eos_id = self.vocab_size, self.eos_id
+        c.states, c.accept, c.state = self.states, self.accept, self.state
+        return c
+
+
+class TokenMaskCompiler:
+    """Compile grammar specs into per-slot incremental mask state.
+
+    Specs are JSON-able dicts (they ride the wire inside
+    ``SamplingParams.grammar``):
+
+    - ``{"kind": "allow", "tokens": [...]}`` — fixed whitelist.
+    - ``{"kind": "sequence", "steps": [[...], ...], "loop": false}`` —
+      position i must come from ``steps[i]``; past the end, eos only
+      (or wrap when ``loop``).
+    - ``{"kind": "choice", "sequences": [[...], ...]}`` — one of a
+      finite set of token sequences (trie-compiled; the JSON-schema
+      enum shape at token level).
+    - ``{"kind": "fsm", "start": s, "states": {s: {tok: s'}},
+      "accept": [...]}`` — explicit token-level DFA.
+
+    ``check`` validates STRUCTURE without a vocabulary (submit-time,
+    so a bad spec is a client ``ValueError``); ``compile`` binds a
+    vocab size + the request's eos id and returns the mutable state.
+    """
+
+    KINDS = ("allow", "sequence", "choice", "fsm")
+
+    def __init__(self, vocab_size: int):
+        self.vocab_size = int(vocab_size)
+
+    @staticmethod
+    def check(spec) -> None:
+        """Structural validation (raises ``ValueError``); shared by
+        ``SamplingParams.validate`` so malformed grammars die at the
+        submit boundary, typed, before any slot state exists."""
+        if not isinstance(spec, dict):
+            raise ValueError(f"grammar spec must be a dict; got {type(spec).__name__}")
+        kind = spec.get("kind")
+        if kind not in TokenMaskCompiler.KINDS:
+            raise ValueError(
+                f"grammar kind must be one of {TokenMaskCompiler.KINDS}; "
+                f"got {kind!r}"
+            )
+        if kind == "allow":
+            toks = spec.get("tokens")
+            if not isinstance(toks, (list, tuple)) or not toks:
+                raise ValueError("allow grammar needs a non-empty 'tokens' list")
+        elif kind == "sequence":
+            steps = spec.get("steps")
+            if not isinstance(steps, (list, tuple)) or not steps or any(
+                not isinstance(s, (list, tuple)) or not s for s in steps
+            ):
+                raise ValueError(
+                    "sequence grammar needs non-empty 'steps' of non-empty "
+                    "token lists"
+                )
+        elif kind == "choice":
+            seqs = spec.get("sequences")
+            if not isinstance(seqs, (list, tuple)) or not seqs or any(
+                not isinstance(s, (list, tuple)) or not s for s in seqs
+            ):
+                raise ValueError(
+                    "choice grammar needs non-empty 'sequences' of non-empty "
+                    "token lists"
+                )
+        else:  # fsm
+            states = spec.get("states")
+            if not isinstance(states, dict) or not states:
+                raise ValueError("fsm grammar needs a non-empty 'states' dict")
+            if str(spec.get("start")) not in {str(s) for s in states}:
+                raise ValueError(
+                    f"fsm start state {spec.get('start')!r} not in states"
+                )
+            for s, edges in states.items():
+                if not isinstance(edges, dict):
+                    raise ValueError(f"fsm state {s!r} edges must be a dict")
+
+    def compile(self, spec, eos_id=None) -> _GrammarState:
+        self.check(spec)
+        kind = spec["kind"]
+        if kind == "allow":
+            return _AllowState(self.vocab_size, eos_id, spec["tokens"])
+        if kind == "sequence":
+            return _SequenceState(
+                self.vocab_size, eos_id, spec["steps"],
+                loop=bool(spec.get("loop")),
+            )
+        if kind == "choice":
+            return _ChoiceState(self.vocab_size, eos_id, spec["sequences"])
+        return _FsmState(
+            self.vocab_size, eos_id, spec["start"], spec["states"],
+            spec.get("accept"),
+        )

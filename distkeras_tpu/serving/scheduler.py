@@ -124,13 +124,21 @@ class ServeRequest:
     ``obs.tracing.request_spans`` turns into the server-side phase
     timeline; untraced requests skip the ledger entirely (the
     timestamps below are always stamped — they feed ``latency()``).
+
+    ``sampling``: an optional ``sampling.SamplingParams``. ``n > 1``
+    makes this a COMPLETION GROUP: the request holds n slots (one
+    prefill + n-1 CoW forks), ``completions`` collects each stream's
+    tokens, and ``result()`` returns a LIST of n sequences. The group
+    finishes when every completion finishes; any typed failure fails
+    the whole group (all complete, or all typed — never a partial
+    reply).
     """
 
     _ids = iter(range(1, 1 << 62))
     _ids_lock = threading.Lock()
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None,
-                 trace=None):
+                 trace=None, sampling=None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -145,12 +153,17 @@ class ServeRequest:
         self.max_new_tokens = max_new_tokens
         self.eos_id = None if eos_id is None else int(eos_id)
         self.deadline = None if deadline is None else float(deadline)
+        self.sampling = sampling  # SamplingParams | None (= greedy)
+        self.n = 1 if sampling is None else int(sampling.n)
         self.created = time.monotonic()
         self.started = None  # admission instant (queue wait ends)
         self.prefill_finished = None  # slot became decodable
         self.first_token = None  # first generated token appended (TTFT)
         self.finished = None
-        self.tokens: list[int] = []  # generated tokens, in order
+        # per-completion token lists; ``tokens`` IS completions[0] (the
+        # n=1 fast path every existing call site reads)
+        self.completions: list[list[int]] = [[] for _ in range(self.n)]
+        self.tokens: list[int] = self.completions[0]
         self.error: ServingError | None = None
         self.trace = trace  # TraceContext | None (None = no ledger)
         self.events: list[dict] = []  # trace ledger (traced reqs only)
@@ -174,16 +187,22 @@ class ServeRequest:
     def done(self) -> bool:
         return self._done.is_set()
 
-    def result(self, timeout=None) -> np.ndarray:
+    def result(self, timeout=None):
+        """The full sequence (prompt + generated, cut after the first
+        generated eos) — or, for a completion group (``n > 1``), the
+        LIST of n such sequences in completion order."""
         if not self._done.wait(timeout):
             raise TimeoutError(f"request {self.id} still running")
         if self.error is not None:
             raise self.error
-        seq = np.concatenate(
-            [self.prompt, np.asarray(self.tokens, np.int32)]
-        )
-        if self.eos_id is not None and self.eos_id in self.tokens:
-            cut = self.prompt.size + self.tokens.index(self.eos_id) + 1
+        if self.n == 1:
+            return self._seq(self.tokens)
+        return [self._seq(c) for c in self.completions]
+
+    def _seq(self, toks) -> np.ndarray:
+        seq = np.concatenate([self.prompt, np.asarray(toks, np.int32)])
+        if self.eos_id is not None and self.eos_id in toks:
+            cut = self.prompt.size + list(toks).index(self.eos_id) + 1
             seq = seq[:cut]
         return seq
 
@@ -260,6 +279,11 @@ class ContinuousBatcher:
             raise ValueError("quarantine_steps must be >= 1")
         self._queue: collections.deque[ServeRequest] = collections.deque()
         self._slots: list[ServeRequest | None] = [None] * stepper.num_slots
+        # completion-group bookkeeping: which completion index each
+        # slot serves (0 for singles and group primaries) and which
+        # reserved slots still await their post-prefill CoW fork
+        self._slot_comp = [0] * stepper.num_slots
+        self._awaiting_fork: dict[int, int] = {}  # slot -> completion
         # slot -> prefill positions remaining; membership IS the
         # "prefilling" state. FIFO order = admission order (fairness:
         # the oldest admission reaches its first token first).
@@ -337,6 +361,15 @@ class ContinuousBatcher:
         # emitted per slot index — stats() reports the per-slot rates
         self._spec_windows = np.zeros(stepper.num_slots, np.int64)
         self._spec_emitted = np.zeros(stepper.num_slots, np.int64)
+        # sampling observability (engine-registry names, per the
+        # subsystem contract): requests that asked for anything beyond
+        # plain greedy, and slots created by completion-group forks
+        self.sampled_requests = self.registry.counter(
+            "serving_sampled_requests", fresh=True
+        )
+        self.forked_slots = self.registry.counter(
+            "serving_forked_slots", fresh=True
+        )
 
     # -- submission ---------------------------------------------------------
 
@@ -351,10 +384,19 @@ class ContinuousBatcher:
                 f"({req.max_new_tokens}) exceeds the serving capacity "
                 f"({self.stepper.max_len})"
             )
+        if req.n > 1:
+            if not getattr(self.stepper, "can_fork", False):
+                raise ValueError(
+                    f"n={req.n} parallel completions need CoW slot "
+                    "forking — serve with paged=True"
+                )
+            if req.n > len(self._slots):
+                raise ValueError(
+                    f"n={req.n} completions exceed the "
+                    f"{len(self._slots)}-slot bank"
+                )
         if getattr(self.stepper, "paged", False):
-            need = self.stepper.pages_for(
-                req.prompt.size, req.max_new_tokens
-            )
+            need = self._pages_for_request(req)
             if need > self.stepper.total_pages:
                 # can NEVER fit the pool — a caller error like the
                 # max_len check above, not transient backpressure
@@ -372,8 +414,25 @@ class ContinuousBatcher:
                 )
             self._queue.append(req)
             self.counters["submitted"] += 1
+            if req.sampling is not None and not req.sampling.is_default:
+                self.sampled_requests.inc()
         self._work.set()
         return req
+
+    def _pages_for_request(self, req) -> int:
+        """Pages a whole request reserves end to end: the primary's
+        admission plus the fresh pages of its n-1 forks (history pages
+        are CoW-shared) — what group admission gates on."""
+        need = self.stepper.pages_for(req.prompt.size, req.max_new_tokens)
+        if req.n > 1:
+            fork_for = getattr(self.stepper, "fork_pages_for", None)
+            per_fork = (
+                fork_for(req.prompt.size, req.max_new_tokens)
+                if fork_for is not None
+                else need
+            )
+            need += (req.n - 1) * per_fork
+        return need
 
     # -- scheduler iteration ------------------------------------------------
 
@@ -394,11 +453,22 @@ class ContinuousBatcher:
             for s, until in list(self._quarantined.items()):
                 if self._sched_iters >= until:
                     del self._quarantined[s]  # probation served
-            for i, slot in enumerate(self._slots):
-                if slot is not None or i in self._quarantined:
-                    continue
+            free = [
+                i for i, slot in enumerate(self._slots)
+                if slot is None and i not in self._quarantined
+            ]
+            taken = 0
+            while taken < len(free):
                 req = self._pop_live(now)
                 if req is None:
+                    break
+                if req.n > len(free) - taken:
+                    # a completion group needs its n slots TOGETHER
+                    # (forks happen the moment prefill finishes, before
+                    # the primary emits — that is what keeps completion
+                    # j identical to an independent derived-seed
+                    # admission); head-of-line FIFO waits for evictions
+                    self._queue.appendleft(req)
                     break
                 if paged:
                     # admission reserves pages: gate on the pool, not
@@ -407,24 +477,31 @@ class ContinuousBatcher:
                     # WAITS for eviction to free pages (FIFO fairness);
                     # begin_admit's typed PoolExhaustedError is the
                     # backstop for races and shared-page estimates.
-                    need = self.stepper.pages_for(
-                        req.prompt.size, req.max_new_tokens
-                    )
+                    need = self._pages_for_request(req)
                     if need > page_budget:
                         self._queue.appendleft(req)
                         break
                     page_budget -= need
-                self._slots[i] = req
+                group = free[taken:taken + req.n]
+                taken += req.n
                 req.started = now
                 self._admit_seq += 1
-                self._admit_order[i] = self._admit_seq
-                admitted.append((i, req))
+                for j, s in enumerate(group):
+                    self._slots[s] = req
+                    self._slot_comp[s] = j
+                    self._admit_order[s] = self._admit_seq
+                    if j > 0:
+                        self._awaiting_fork[s] = j
+                admitted.append((group[0], req))
         # device work outside the lock: submit() must never block on a
         # compile or a step (backpressure replies stay fast under load)
         began = []
         for i, req in admitted:
             try:
                 kw = {"max_new": req.max_new_tokens} if paged else {}
+                if req.sampling is not None:
+                    kw["sampling"] = req.sampling
+                    kw["eos_id"] = req.eos_id
                 began.append(
                     (i, req, self.stepper.begin_admit(i, req.prompt, **kw))
                 )
@@ -443,12 +520,18 @@ class ContinuousBatcher:
                 else:
                     req.prefill_finished = now
         progressed = self._spend_prefill_budget()
+        progressed = self._fork_completions() or progressed
         now = time.monotonic()
         with self._lock:
-            # deadline sweep for slots still mid-prefill (they produce
-            # no tokens, so the post-step check never sees them)
+            # deadline sweep for slots still mid-prefill AND groups
+            # still waiting on their forks (both produce no tokens, so
+            # the post-step check never sees them; a fork stalled on
+            # pool pressure must time out typed, never wait forever)
             for i, req in enumerate(self._slots):
-                if req is None or i not in self._prefill_left:
+                if req is None or (
+                    i not in self._prefill_left
+                    and i not in self._awaiting_fork
+                ):
                     continue
                 if req._expired(now):
                     self._evict(
@@ -458,9 +541,21 @@ class ContinuousBatcher:
                             "deadline passed during prefill"
                         ),
                     )
+            # slots awaiting their fork — and the primaries they fork
+            # FROM — sit this step out: the primary must not emit a
+            # token its siblings' forks would then silently inherit
+            fork_held = set(self._awaiting_fork)
+            for s in self._awaiting_fork:
+                req = self._slots[s]
+                if req is None:
+                    continue
+                for i, r in enumerate(self._slots):
+                    if r is req and self._slot_comp[i] == 0:
+                        fork_held.add(i)
             active = np.array(
                 [
                     s is not None and i not in self._prefill_left
+                    and i not in fork_held
                     for i, s in enumerate(self._slots)
                 ],
                 bool,
@@ -475,7 +570,7 @@ class ContinuousBatcher:
                 # drafter may materialize just the slots it actually
                 # searches (throttled slots cost nothing per iteration)
                 seqs = [
-                    (req.prompt, req.tokens)
+                    (req.prompt, req.completions[self._slot_comp[i]])
                     if req is not None and active[i]
                     else None
                     for i, req in enumerate(self._slots)
@@ -539,16 +634,17 @@ class ContinuousBatcher:
                 # emission order — a window's tail past the first
                 # finish/expiry condition is never emitted
                 req.iterations += 1
+                comp = req.completions[self._slot_comp[i]]
                 emitted = 0
                 for tok in np.atleast_1d(toks[i])[: int(counts[i])]:
                     tok = int(tok)
-                    req.tokens.append(tok)
+                    comp.append(tok)
                     emitted += 1
                     if req.first_token is None:
                         req.first_token = now
                     self.counters["tokens_generated"] += 1
                     finished = (
-                        len(req.tokens) >= req.max_new_tokens
+                        len(comp) >= req.max_new_tokens
                         or (req.eos_id is not None and tok == req.eos_id)
                     )
                     if finished:
@@ -791,6 +887,75 @@ class ContinuousBatcher:
         except ValueError:
             pass
 
+    def _fork_completions(self) -> bool:
+        """CoW-fork a completion group's reserved slots the moment its
+        primary finishes prefill — BEFORE the primary emits a single
+        token, so every completion's stream starts at emitted position
+        0 under its own derived seed (completion j is token-identical
+        to an independent admission with ``seed_for_completion(seed,
+        j)``). Device work outside the lock.
+
+        Failure semantics: POOL EXHAUSTION at fork time is capacity
+        pressure, not a fault — admission's page gating is advisory
+        (the fork's pages are not physically reserved through a
+        multi-iteration prefill), so a raced-away pool makes the group
+        WAIT (primary stays held, the fork retries next iteration as
+        evictions free pages — the same head-of-line discipline as
+        page-gated admission; the deadline sweep bounds the wait).
+        Any OTHER fork failure fails the WHOLE group typed."""
+        if not self._awaiting_fork:
+            return False
+        with self._lock:
+            ready = []
+            for s, j in list(self._awaiting_fork.items()):
+                req = self._slots[s]
+                if req is None:
+                    self._awaiting_fork.pop(s)
+                    continue
+                primary = next(
+                    (i for i, r in enumerate(self._slots)
+                     if r is req and self._slot_comp[i] == 0),
+                    None,
+                )
+                if primary is None:
+                    # the primary died (its failure already completed
+                    # the group) — clean the orphaned reservation
+                    self._awaiting_fork.pop(s)
+                    self._slots[s] = None
+                    self.stepper.release(s)
+                    continue
+                if primary not in self._prefill_left:
+                    ready.append((primary, s, j, req))
+        progressed = False
+        for primary, s, j, req in ready:
+            with self._lock:
+                if (
+                    self._slots[s] is not req
+                    or self._slots[primary] is not req
+                ):
+                    # a sibling's failure already evicted this group —
+                    # never fork from a released primary (and never
+                    # record a second, mistyped failure for it)
+                    continue
+            try:
+                self.stepper.fork_slot(
+                    primary, s, max_new=req.max_new_tokens, completion=j
+                )
+            except OverloadedError:
+                # pool pressure: leave the reservation in place and
+                # retry next iteration (evictions free pages); the
+                # whole group keeps waiting un-started
+                continue
+            except Exception as e:  # noqa: BLE001 — admission boundary
+                self._fail_admission(s, req, e)
+                continue
+            progressed = True
+            with self._lock:
+                if self._slots[s] is req:
+                    self._awaiting_fork.pop(s, None)
+                    self.forked_slots.inc()
+        return progressed
+
     def _pop_live(self, now) -> ServeRequest | None:
         """Next queued request whose deadline has not already expired;
         expired ones complete immediately with DeadlineExceededError.
@@ -807,19 +972,37 @@ class ContinuousBatcher:
         return None
 
     def _evict(self, slot_idx, req, error):
-        """Free a slot and complete its request. Caller holds the lock."""
+        """Free a slot and complete its request (or, for a completion
+        group, one completion of it). Caller holds the lock.
+
+        Group semantics ("all complete or all typed"): a clean finish
+        of one completion releases only its slot — the request finishes
+        when its LAST completion does; any typed error releases every
+        sibling slot immediately and fails the whole request with it.
+        """
         self._slots[slot_idx] = None
         self._drop_prefill(slot_idx)
+        self._awaiting_fork.pop(slot_idx, None)
         self.stepper.release(slot_idx)
-        if error is None:
-            self.counters["completed"] += 1
-        elif isinstance(error, InternalError):
-            self.counters["internal_errors"] += 1
-        elif isinstance(error, OverloadedError):
-            self.counters["pool_exhausted"] += 1
-        else:
-            self.counters["deadline_exceeded"] += 1
-        req._finish(error)
+        if error is not None:
+            for i, r in enumerate(self._slots):
+                if r is req:  # group siblings die with the request
+                    self._slots[i] = None
+                    self._drop_prefill(i)
+                    self._awaiting_fork.pop(i, None)
+                    self.stepper.release(i)
+            if isinstance(error, InternalError):
+                self.counters["internal_errors"] += 1
+            elif isinstance(error, OverloadedError):
+                self.counters["pool_exhausted"] += 1
+            else:
+                self.counters["deadline_exceeded"] += 1
+            req._finish(error)
+            return
+        if any(r is req for r in self._slots):
+            return  # sibling completions still decoding / forking
+        self.counters["completed"] += 1
+        req._finish(None)
 
     # -- drain / shutdown ---------------------------------------------------
 
@@ -849,11 +1032,15 @@ class ContinuousBatcher:
                 self._queue.popleft()._finish(fail())
             self._prefill_left.clear()
             self._prefill_fifo.clear()
+            self._awaiting_fork.clear()
+            failed = set()  # a completion group holds several slots
             for i, req in enumerate(self._slots):
                 if req is not None:
                     self._slots[i] = None
                     self.stepper.release(i)
-                    req._finish(fail())
+                    if id(req) not in failed:
+                        failed.add(id(req))
+                        req._finish(fail())
         self._work.set()
 
     # -- introspection ------------------------------------------------------
@@ -878,7 +1065,7 @@ class ContinuousBatcher:
                 "slot": slot,
                 "prompt_len": int(req.prompt.size),
                 "max_new_tokens": req.max_new_tokens,
-                "tokens_emitted": len(req.tokens),
+                "tokens_emitted": sum(len(c) for c in req.completions),
                 "trace_id": (
                     None if req.trace is None else req.trace.trace_id
                 ),
@@ -913,6 +1100,8 @@ class ContinuousBatcher:
         with self._lock:
             active = sum(s is not None for s in self._slots)
             out = dict(self.counters)
+            out["sampled_requests"] = self.sampled_requests.value
+            out["forked_slots"] = self.forked_slots.value
             out["queue_depth"] = len(self._queue)
             out["active_slots"] = active
             out["prefilling_slots"] = len(self._prefill_left)

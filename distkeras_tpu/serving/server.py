@@ -12,8 +12,12 @@ Verbs (header ``{"verb": ...}``):
 
 - ``generate``: payload = 1-D int prompt; header carries
   ``max_new_tokens``, optional ``eos_id``, optional ``deadline_ms``
-  (budget relative to arrival). Reply payload = the full sequence
-  (prompt + generated, eos-trimmed). Failures reply
+  (budget relative to arrival), optional ``sampling`` (a
+  ``sampling.SamplingParams`` wire dict: temperature / top_k / top_p /
+  seed / n / grammar; absent = greedy). Reply payload = the full
+  sequence (prompt + generated, eos-trimmed) — or, for ``n > 1``
+  parallel completions, the list of n sequences with ``n`` on the
+  reply header. Failures reply
   ``{"ok": false, "error": code}`` with code ``overloaded`` (bounded
   admission queue full — explicit backpressure), ``deadline_exceeded``,
   or ``stopping`` (drain in progress).
@@ -324,11 +328,16 @@ class ServingServer:
 
     def _generate(self, header: dict, payload: bytes) -> bytes:
         from distkeras_tpu.obs import TraceContext, request_spans, start_span
+        from distkeras_tpu.serving.sampling import SamplingParams
 
         prompt = np.asarray(deserialize_params(payload))
         deadline = None
         if header.get("deadline_ms") is not None:
             deadline = time.monotonic() + float(header["deadline_ms"]) / 1e3
+        # per-request sampling params ride an optional header field
+        # (absent = the greedy no-params path, one dict lookup); a
+        # malformed spec is a submit-boundary ValueError -> bad_request
+        sampling = SamplingParams.from_wire(header.get("sampling"))
         # opt-in tracing: absent field = one dict lookup and nothing
         # else; present = a server.generate span plus the scheduler's
         # per-request phase timeline, returned on the reply when the
@@ -341,10 +350,16 @@ class ServingServer:
 
             # this engine's own span ring (drained to ITS MetricsLogger)
             col = getattr(self.engine, "trace_collector", None) or COLLECTOR
+            attrs = {}
+            if sampling is not None:
+                # sampler params on the span: a sampled request's trace
+                # names what it asked for (replayable from the trace)
+                attrs["sampling"] = sampling.to_wire()
             span = start_span(
                 "server.generate", ctx, collector=col,
                 prompt_len=int(prompt.size),
                 max_new_tokens=int(header["max_new_tokens"]),
+                **attrs,
             )
         req = None
 
@@ -371,6 +386,7 @@ class ServingServer:
                 eos_id=header.get("eos_id"),
                 deadline=deadline,
                 trace=ctx,
+                sampling=sampling,
             )
             seq = self.engine.wait(req)
         except ServingError as e:
@@ -388,6 +404,19 @@ class ServingServer:
                 except AttributeError:
                     pass  # exotic exception refusing attributes
             raise
+        if isinstance(seq, list):
+            # n-parallel completions: the payload is the LIST of
+            # sequences (the pytree codec carries ragged lengths)
+            reply = {
+                "ok": True,
+                "n": len(seq),
+                "tokens": int(sum(s.size - prompt.size for s in seq)),
+            }
+            if ctx is not None:
+                reply["trace"] = assemble_trace("ok")
+            return pack_frame(
+                reply, serialize_params([np.asarray(s) for s in seq])
+            )
         reply = {"ok": True, "tokens": int(seq.size - prompt.size)}
         if ctx is not None:
             reply["trace"] = assemble_trace("ok")

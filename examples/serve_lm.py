@@ -13,6 +13,13 @@ Usage:
     python examples/serve_lm.py [--cpu] [--seq 64] [--slots 4]
                                 [--speculative [--draft-bundle PATH]]
                                 [--fleet N]
+                                [--temperature T [--top-p P] [--n N]]
+
+``--temperature`` adds the per-request SAMPLING demo: a seeded sampled
+generate (replayed and asserted token-identical — serving sampling is
+replay-deterministic), and with ``--n N`` the request decodes N
+parallel completions via copy-on-write page forks, printing the n
+streams and the pool's shared-page stats.
 """
 
 from __future__ import annotations
@@ -50,8 +57,27 @@ def main():
                     "affinity FleetRouter (all booted from the one "
                     "bundle), then demo a zero-downtime rolling bundle "
                     "upgrade")
+    ap.add_argument("--temperature", type=float, default=None,
+                    help="demo per-request SAMPLED decode at this "
+                    "temperature (seeded: same seed, same tokens — "
+                    "replayed and asserted)")
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="nucleus filter for the sampled demo "
+                    "(requires --temperature)")
+    ap.add_argument("--n", type=int, default=1, metavar="N",
+                    help="parallel completions per sampled request, "
+                    "decoded via copy-on-write slot forks on the paged "
+                    "KV cache (prints shared-page stats)")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
+    if args.top_p is not None and args.temperature is None:
+        ap.error("--top-p filters sampling; pass --temperature too")
+    if args.n < 1:
+        ap.error("--n must be >= 1")
+    if args.n > 1 and args.temperature is None:
+        ap.error("--n N parallel completions sample; pass --temperature")
+    if (args.temperature is not None) and args.fleet:
+        ap.error("--temperature and --fleet are separate demos; pick one")
     if args.draft_bundle and not args.speculative:
         # fail BEFORE training, not after a long run
         ap.error("--draft-bundle feeds the speculative drafter; "
@@ -123,8 +149,15 @@ def main():
         if args.fleet:
             serve_fleet(args, bundle)
             return
+        paged_kw = {}
+        if args.n > 1:
+            # n-parallel completions ride copy-on-write page forks:
+            # serve the paged KV cache and keep n slots available
+            paged_kw = dict(paged=True, page_size=8)
+            args.slots = max(args.slots, args.n)
         engine = ServingEngine.from_bundle(
             bundle, num_slots=args.slots, queue_capacity=32, **spec_kw,
+            **paged_kw,
         )
         server = ServingServer(engine).start()
         print(f"serving on {server.host}:{server.port} "
@@ -158,6 +191,37 @@ def main():
             print("served decode:", row.tolist())  # must count upward
         print(f"{len(prompts)} concurrent requests x {steps} tokens "
               f"in {dt:.2f}s")
+
+        # -- per-request sampling demo (--temperature [--top-p] [--n]) ------
+        if args.temperature is not None:
+            from distkeras_tpu.serving import SamplingParams
+
+            sp = SamplingParams(
+                temperature=args.temperature, top_p=args.top_p,
+                seed=7, n=args.n,
+            )
+            with ServingClient(server.host, server.port) as c:
+                out = c.generate(prompts[0], steps, sampling=sp)
+                outs = out if isinstance(out, list) else [out]
+                for j, row in enumerate(outs):
+                    print(f"sampled completion {j}: {row.tolist()}")
+                replay = c.generate(prompts[0], steps, sampling=sp)
+                replays = (
+                    replay if isinstance(replay, list) else [replay]
+                )
+                assert all(
+                    np.array_equal(a, b)
+                    for a, b in zip(outs, replays)
+                ), "same seed must replay identical samples"
+                print(f"replayed {len(outs)} completion(s) "
+                      f"token-identically (seed {sp.seed})")
+                if args.n > 1:
+                    pg = c.stats()["paged"]
+                    print(f"shared pages: {pg['shared_pages']} shared / "
+                          f"{pg['pages_in_use']} in use, "
+                          f"{pg['cow_copies']} CoW copies "
+                          f"({args.n} completions forked from one "
+                          f"prefill)")
 
         with ServingClient(server.host, server.port) as c:
             logits = c.predict(xs[:2])

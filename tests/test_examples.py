@@ -115,6 +115,28 @@ def test_serve_lm_draft_bundle_cpu(tmp_path):
     assert rate > 1.0, line  # the trained draft actually accepts
 
 
+def test_serve_lm_sampled_n_completions_cpu():
+    """--temperature --top-p --n 2: the per-request sampling demo —
+    greedy burst still counts upward, the sampled request decodes TWO
+    parallel completions via CoW page forks, the same seed replays
+    token-identically (asserted inside the script), and the
+    shared-page stats line prints."""
+    out = run_example("serve_lm.py", "--cpu", "--temperature", "0.8",
+                      "--top-p", "0.9", "--n", "2")
+    rows = [l for l in out.splitlines() if l.startswith("served decode:")]
+    assert len(rows) == 4, out  # the greedy burst is untouched
+    for line in rows:
+        toks = [int(t) for t in line.split("[", 1)[1].rstrip("]").split(",")]
+        for a, b in zip(toks[-5:], toks[-4:]):
+            assert b == (a + 1) % 32, (toks, out)
+    comps = [l for l in out.splitlines()
+             if l.startswith("sampled completion ")]
+    assert len(comps) == 2, out
+    assert "replayed 2 completion(s) token-identically" in out
+    assert "CoW copies" in out
+    assert "drained and stopped" in out
+
+
 def test_serve_lm_fleet_cpu():
     """--fleet 2: the replicated flow — two replicas booted from ONE
     bundle behind the prefix-affinity router, concurrent shared-header

@@ -688,8 +688,12 @@ def test_client_survives_overloaded_burst(lm, lm_ref):
         errors = []
 
         def worker(i):
+            # the hint-paced retries must outlast the blocker's first-
+            # compile window; the r15 step program (sampling tail
+            # traced into both lax.cond branches) compiles longer than
+            # the r3-era 40 attempts budgeted for on a loaded machine
             policy = RetryPolicy(
-                max_attempts=40, base_delay=0.01, budget=90.0, seed=i
+                max_attempts=120, base_delay=0.01, budget=90.0, seed=i
             )
             try:
                 with _retry_client(srv, retry=policy) as c:
@@ -806,12 +810,19 @@ def test_soak_serving_smoke(lm):
     finally:
         sys.path.pop(0)
     summary = soak_serving.run_soak(
-        model=lm, clients=3, duration=2.0, seed=0, fault_every=5,
+        model=lm, clients=3, duration=3.0, seed=0, fault_every=5,
     )
     assert summary["hung"] == 0
     assert summary["untyped_errors"] == 0
     assert summary["corrupt_outputs"] == 0
     assert summary["completed"] > 0
+    # the mixed client set's sampled family: same-seed re-serves under
+    # chaos (blame probes, quarantines, restarts) reproduced the
+    # fault-free canonical sample exactly, and constrained outputs
+    # never left their grammar
+    assert summary["sampled_completed"] > 0
+    assert summary["divergent_replays"] == 0
+    assert summary["grammar_violations"] == 0
     assert summary["faults_fired"] > 0
     assert summary["fired_by_site"]["stepper.verify"] > 0
     assert summary["speculative"]["windows"] > 0

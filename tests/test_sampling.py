@@ -1,0 +1,743 @@
+"""Sampling & structured decoding subsystem (serving/sampling.py).
+
+Four tiers, matching the subsystem's layering:
+
+- pure units: ``SamplingParams`` validation/wire roundtrip, the
+  top-k/top-p logit transform against an independent NumPy oracle,
+  ``TokenMaskCompiler`` mask semantics, ``seed_for_completion``;
+- the non-negotiable pin: ``temperature=0`` (and params omitted)
+  reproduces solo greedy decode token-identically on EVERY admission
+  path — fresh, chunked, prefix-hit, CoW fork;
+- replay determinism: a sampled request with a fixed seed replays
+  token-identically through an injected blame probe, an engine
+  restart, quarantine re-admission, and across solo-vs-served (the
+  solo sampled decode IS the served identity reference);
+- scheduler accounting: n-parallel completion groups reserve n slots,
+  finish all-or-typed, and fork only after prefill.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.serving.sampling import (
+    SamplingParams,
+    TokenMaskCompiler,
+    check_spec_sampling,
+    seed_for_completion,
+)
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=VOCAB, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_ref(lm):
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+
+    return CachedSequenceGenerator(lm)
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(0, VOCAB, n).astype(
+        np.int32
+    )
+
+
+# ------------------------------------------------------------ pure units
+
+
+def test_sampling_params_validation_and_wire_roundtrip():
+    p = SamplingParams(temperature=0.7, top_k=5, top_p=0.9, seed=42,
+                       n=3, grammar={"kind": "allow", "tokens": [1]})
+    q = SamplingParams.from_wire(p.to_wire())
+    assert (q.temperature, q.top_k, q.top_p, q.seed, q.n) == (
+        0.7, 5, 0.9, 42, 3
+    )
+    assert q.grammar == p.grammar
+    assert SamplingParams.from_wire(None) is None
+    assert SamplingParams.from_wire({}) is None
+    assert SamplingParams().is_default
+    assert not p.is_default
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=-0.1)
+    with pytest.raises(ValueError):
+        SamplingParams(top_k=3)  # filters need temperature > 0
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError):
+        SamplingParams(n=0)
+    with pytest.raises(ValueError):
+        SamplingParams.from_wire({"temprature": 1.0})  # typo'd knob
+    with pytest.raises(ValueError):
+        SamplingParams(grammar={"kind": "nope"})
+
+
+def test_seed_for_completion_disjoint_and_stable():
+    assert seed_for_completion(7, 0) == 7  # completion 0 = the request
+    seeds = {seed_for_completion(7, j) for j in range(8)}
+    assert len(seeds) == 8
+    assert seed_for_completion(7, 3) == seed_for_completion(7, 3)
+
+
+def test_check_spec_sampling_shared_helper():
+    assert check_spec_sampling("rejection", 0.9, 5, 0.9) == "rejection"
+    assert check_spec_sampling("strict", 0.0, None, None) == "strict"
+    with pytest.raises(ValueError, match="GREEDY"):
+        check_spec_sampling("strict", 0.5, None, None)
+    with pytest.raises(ValueError):
+        check_spec_sampling("bogus")
+
+
+def test_filter_logits_matches_numpy_oracle():
+    """Per-row vectorized top-k / top-p against an independent NumPy
+    reference (the solo generators' documented combined semantics:
+    nucleus over the distribution that survived top-k)."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.serving.sampling import filter_logits
+
+    rng = np.random.default_rng(3)
+    b, v = 6, 16
+    logits = rng.normal(size=(b, v)).astype(np.float32)
+    top_k = np.array([0, 3, 1, 0, 5, 16], np.int32)  # 0 = off
+    top_p = np.array([1.0, 1.0, 1.0, 0.5, 0.8, 0.3], np.float32)
+
+    got = np.asarray(
+        filter_logits(jnp.asarray(logits), jnp.asarray(top_k),
+                      jnp.asarray(top_p))
+    )
+
+    for i in range(b):
+        keep = np.ones(v, bool)
+        if top_k[i] > 0:
+            kth = np.sort(logits[i])[-top_k[i]]
+            keep &= logits[i] >= kth
+        if top_p[i] < 1.0:
+            l_masked = np.where(keep, logits[i], -np.inf)
+            order = np.argsort(-l_masked)
+            p = np.exp(l_masked[order] - l_masked[order].max())
+            p = p / p.sum()
+            cum = np.cumsum(p) - p
+            allowed = set(order[cum < top_p[i]])
+            keep &= np.isin(np.arange(v), list(allowed))
+        exp = np.where(keep, logits[i], -np.inf)
+        np.testing.assert_array_equal(got[i], exp, err_msg=f"row {i}")
+
+
+def test_mask_compiler_allow_sequence_choice_fsm():
+    mc = TokenMaskCompiler(8)
+    st = mc.compile({"kind": "allow", "tokens": [1, 2]}, eos_id=7)
+    m = st.mask()
+    assert set(np.flatnonzero(m)) == {1, 2, 7}
+    st.advance(1)
+    assert set(np.flatnonzero(st.mask())) == {1, 2, 7}
+
+    st = mc.compile(
+        {"kind": "sequence", "steps": [[3], [4, 5]]}, eos_id=7
+    )
+    assert set(np.flatnonzero(st.mask())) == {3}
+    st.advance(3)
+    assert set(np.flatnonzero(st.mask())) == {4, 5}
+    st.advance(4)
+    assert set(np.flatnonzero(st.mask())) == {7}  # forced finish
+
+    st = mc.compile(
+        {"kind": "choice", "sequences": [[1, 2], [1, 3], [4]]},
+        eos_id=7,
+    )
+    assert set(np.flatnonzero(st.mask())) == {1, 4}
+    st.advance(1)
+    assert set(np.flatnonzero(st.mask())) == {2, 3}
+    st.advance(3)
+    assert set(np.flatnonzero(st.mask())) == {7}  # matched -> eos
+    c = st.clone()
+    c.advance(7)
+
+    st = mc.compile(
+        {
+            "kind": "fsm",
+            "start": "a",
+            "states": {"a": {"1": "b"}, "b": {"2": "a"}},
+            "accept": ["b"],
+        },
+        eos_id=7,
+    )
+    assert set(np.flatnonzero(st.mask())) == {1}
+    st.advance(1)
+    assert set(np.flatnonzero(st.mask())) == {2, 7}  # accept: eos too
+
+
+def test_mask_compiler_dead_state_yields_empty_mask():
+    mc = TokenMaskCompiler(8)
+    st = mc.compile({"kind": "choice", "sequences": [[1, 2]]}, eos_id=None)
+    st.advance(5)  # off-grammar
+    assert not st.mask().any()
+
+
+def test_mask_compiler_check_rejects_malformed():
+    for bad in (
+        "nope",
+        {"kind": "allow", "tokens": []},
+        {"kind": "sequence", "steps": []},
+        {"kind": "sequence", "steps": [[]]},
+        {"kind": "choice", "sequences": []},
+        {"kind": "fsm", "start": "x", "states": {}},
+        {"kind": "fsm", "start": "x", "states": {"a": {}}},
+    ):
+        with pytest.raises(ValueError):
+            TokenMaskCompiler.check(bad)
+
+
+# ------------------------------------- temperature->0 identity pins
+
+
+def test_greedy_pin_every_admission_path(lm, lm_ref):
+    """``temperature=0`` explicit AND params-omitted reproduce solo
+    greedy decode on fresh, chunked, prefix-hit, and forked
+    admissions (paged engine — the production config)."""
+    from distkeras_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, VOCAB, n).astype(np.int32)
+               for n in (3, 9, 17)]
+    refs = [lm_ref.generate(p[None], steps=6)[0] for p in prompts]
+    eng = ServingEngine(
+        lm, num_slots=4, paged=True, page_size=4, prefill_chunk=4,
+        prefix_cache=True, watchdog_interval=30.0,
+    ).start()
+    try:
+        for p, r in zip(prompts, refs):  # fresh + chunked
+            np.testing.assert_array_equal(eng.generate(p, 6), r)
+        for p, r in zip(prompts, refs):  # explicit temperature=0
+            np.testing.assert_array_equal(
+                eng.generate(p, 6, sampling=SamplingParams()), r
+            )
+        # prefix-hit path: repeat admissions reuse pages/store
+        for p, r in zip(prompts, refs):
+            np.testing.assert_array_equal(eng.generate(p, 6), r)
+        # fork admission: greedy n=2 — both completions ARE the solo
+        # greedy decode (greedy diverges nowhere)
+        outs = eng.generate(
+            prompts[1], 6, sampling=SamplingParams(n=2)
+        )
+        np.testing.assert_array_equal(outs[0], refs[1])
+        np.testing.assert_array_equal(outs[1], refs[1])
+    finally:
+        eng.stop()
+
+
+def test_dense_engine_greedy_pin_with_sampled_neighbours(lm, lm_ref):
+    """A greedy request sharing the bank with SAMPLED neighbours stays
+    token-identical to solo decode — per-slot sampling is per-slot."""
+    from distkeras_tpu.serving import ServingEngine
+
+    p_g = _prompt(5, 1)
+    p_s = _prompt(7, 2)
+    ref = lm_ref.generate(p_g[None], steps=8)[0]
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False, watchdog_interval=30.0,
+    ).start()
+    try:
+        h_g = eng.submit(p_g, 8)
+        h_s = eng.submit(
+            p_s, 8, sampling=SamplingParams(temperature=1.0, seed=4)
+        )
+        np.testing.assert_array_equal(eng.wait(h_g), ref)
+        eng.wait(h_s)
+    finally:
+        eng.stop()
+
+
+# --------------------------------------------- replay determinism
+
+
+def test_solo_sampled_is_the_served_identity_reference(lm):
+    """Same (prompt, seed, knobs): solo CachedSequenceGenerator sampled
+    decode == served sampled decode, dense AND paged."""
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+    from distkeras_tpu.serving import ServingEngine
+
+    p = _prompt(6, 5)
+    solo = CachedSequenceGenerator(
+        lm, temperature=0.8, top_k=9, seed=13
+    ).generate(p[None], steps=8)[0]
+    sp = SamplingParams(temperature=0.8, top_k=9, seed=13)
+    for paged in (False, True):
+        eng = ServingEngine(
+            lm, num_slots=2, prefix_cache=False,
+            watchdog_interval=30.0,
+            **(dict(paged=True, page_size=4) if paged else {}),
+        ).start()
+        try:
+            got = eng.generate(p, 8, sampling=sp)
+            np.testing.assert_array_equal(got, solo, err_msg=f"paged={paged}")
+        finally:
+            eng.stop()
+
+
+@pytest.mark.chaos
+def test_sampled_replay_through_blame_probe_and_quarantine(lm):
+    """An injected step fault triggers blame probes against the live
+    bank; the surviving sampled stream AND the re-submitted blamed
+    request must reproduce the exact same tokens (position-keyed RNG —
+    probes advance nothing, re-admission restarts the counter)."""
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.serving import InternalError, ServingEngine
+
+    p1, p2 = _prompt(5, 7), _prompt(6, 8)
+    sp1 = SamplingParams(temperature=0.9, seed=21)
+    sp2 = SamplingParams(temperature=0.9, seed=22)
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False, quarantine_steps=2,
+        watchdog_interval=30.0,
+    ).start()
+    try:
+        a1 = eng.generate(p1, 8, sampling=sp1)  # fault-free reference
+        a2 = eng.generate(p2, 8, sampling=sp2)
+        with FaultPlan(seed=0).arm("stepper.step", times=1, after=2):
+            h1 = eng.submit(p1, 8, sampling=sp1)
+            h2 = eng.submit(p2, 8, sampling=sp2)
+            outs, errs = [], 0
+            for h, want in ((h1, a1), (h2, a2)):
+                try:
+                    outs.append((eng.wait(h), want))
+                except InternalError:
+                    errs += 1
+            assert errs >= 1  # the fault blamed someone
+            for got, want in outs:  # survivors replayed exactly
+                np.testing.assert_array_equal(got, want)
+        # quarantine re-verification: the same requests, re-submitted,
+        # reproduce the references exactly
+        np.testing.assert_array_equal(eng.generate(p1, 8, sampling=sp1), a1)
+        np.testing.assert_array_equal(eng.generate(p2, 8, sampling=sp2), a2)
+    finally:
+        eng.stop()
+
+
+@pytest.mark.chaos
+def test_sampled_replay_across_engine_restart(lm):
+    """Kill the scheduler thread (watchdog restart rebuilds the
+    stepper from scratch) — a re-served sampled request must be
+    token-identical to its pre-restart serve."""
+    import time
+
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.serving import ServingEngine, ServingError
+
+    p = _prompt(5, 9)
+    sp = SamplingParams(temperature=0.8, top_p=0.9, seed=33)
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False, watchdog_interval=0.3,
+        watchdog_grace=30.0, max_restarts=3, restart_backoff=0.01,
+    ).start()
+    try:
+        before = eng.generate(p, 8, sampling=sp)
+        with FaultPlan(seed=0).arm("scheduler.loop", times=1):
+            try:
+                eng.generate(p, 8, sampling=sp, timeout=10)
+            except ServingError:
+                pass
+            deadline = time.monotonic() + 10
+            while eng._restarts < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+        assert eng._restarts >= 1
+        after = eng.generate(p, 8, sampling=sp, timeout=30)
+        np.testing.assert_array_equal(after, before)
+    finally:
+        eng.stop()
+
+
+def test_spec_rejection_sampled_replay_and_greedy_pin(lm, lm_ref):
+    """Rejection-sampling speculative serving: greedy stays pinned to
+    solo decode; a sampled request replays token-identically (and a
+    second engine instance reproduces it — no hidden engine state)."""
+    from distkeras_tpu.serving import ServingEngine
+
+    p = _prompt(5, 11)
+    ref = lm_ref.generate(p[None], steps=8)[0]
+    sp = SamplingParams(temperature=0.8, seed=17)
+    outs = []
+    for _ in range(2):
+        eng = ServingEngine(
+            lm, num_slots=2, speculative="draft", draft_bundle=lm,
+            draft_k=3, prefix_cache=False, watchdog_interval=30.0,
+        ).start()
+        try:
+            np.testing.assert_array_equal(eng.generate(p, 8), ref)
+            a = eng.generate(p, 8, sampling=sp)
+            b = eng.generate(p, 8, sampling=sp)
+            np.testing.assert_array_equal(a, b)
+            outs.append(a)
+            spst = eng.stats()["speculative"]
+            assert spst["windows"] > 0  # verify actually ran
+        finally:
+            eng.stop()
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_strict_mode_is_the_legacy_refusal(lm):
+    from distkeras_tpu.serving import ServingEngine
+
+    with pytest.raises(ValueError, match="GREEDY"):
+        ServingEngine(
+            lm, speculative="draft", draft_bundle=lm,
+            spec_mode="strict", temperature=0.5,
+        )
+    eng = ServingEngine(
+        lm, num_slots=2, speculative="draft", draft_bundle=lm,
+        spec_mode="strict", prefix_cache=False, watchdog_interval=30.0,
+    ).start()
+    try:
+        with pytest.raises(ValueError, match="GREEDY"):
+            eng.submit(
+                _prompt(4), 4,
+                sampling=SamplingParams(temperature=0.5),
+            )
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------- constrained decoding
+
+
+def test_constrained_decode_and_forced_eos_fallback(lm):
+    """Grammar masks bind greedy AND sampled selection; a choice
+    grammar that dead-ends forces EOS (recorded) instead of hanging."""
+    from distkeras_tpu.serving import ServingEngine
+
+    p = _prompt(5, 13)
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False, watchdog_interval=30.0,
+    ).start()
+    try:
+        allow = {"kind": "allow", "tokens": [2, 4, 6]}
+        out = eng.generate(
+            p, 6, eos_id=60, sampling=SamplingParams(grammar=allow)
+        )
+        assert all(t in (2, 4, 6, 60) for t in out[5:].tolist())
+        sampled = eng.generate(
+            p, 6, eos_id=60,
+            sampling=SamplingParams(
+                temperature=1.0, seed=2, grammar=allow
+            ),
+        )
+        assert all(t in (2, 4, 6, 60) for t in sampled[5:].tolist())
+        # replay holds for constrained sampling too
+        again = eng.generate(
+            p, 6, eos_id=60,
+            sampling=SamplingParams(
+                temperature=1.0, seed=2, grammar=allow
+            ),
+        )
+        np.testing.assert_array_equal(sampled, again)
+        # a one-sequence choice grammar: decode the sequence, then the
+        # state allows eos only -> the request finishes, never hangs
+        seq = {"kind": "choice", "sequences": [[7, 8]]}
+        out = eng.generate(
+            p, 6, eos_id=60, sampling=SamplingParams(grammar=seq)
+        )
+        assert out[5:].tolist() == [7, 8, 60]
+        ms = {m["name"]: m.get("value")
+              for m in eng.metrics_snapshot()}
+        assert ms["serving_constrained_masks"] > 0
+    finally:
+        eng.stop()
+
+
+def test_mask_exhaustion_records_flight_event(lm):
+    """An exhausted mask (empty allowed set) forces EOS and lands a
+    ``sampling.mask_exhausted`` event on the flight recorder."""
+    from distkeras_tpu.serving.engine import DecodeStepper
+    from distkeras_tpu.obs import FlightRecorder
+
+    rec = FlightRecorder(capacity=64)
+    st = DecodeStepper(lm, num_slots=1, recorder=rec)
+    # a choice grammar exhausted immediately: its only sequence is
+    # empty-filtered (token ids outside the vocab)
+    st.admit(0, _prompt(4),
+             sampling=SamplingParams(
+                 grammar={"kind": "choice", "sequences": [[500]]}
+             ),
+             eos_id=60)
+    toks = st.step(np.array([True]))
+    assert int(toks[0]) == 60  # forced EOS
+    kinds = {e["kind"] for e in rec.snapshot()}
+    assert "sampling.mask_exhausted" in kinds
+    assert st.mask_exhaustions >= 1
+
+
+# ------------------------------------------- n-completion accounting
+
+
+class FakeForkStepper:
+    """Pure-host stepper with fork support for scheduler group units."""
+
+    can_fork = True
+    speculative = False
+    wants_sequences = False
+
+    def __init__(self, num_slots=4, max_len=32, fail_fork=False):
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.fail_fork = fail_fork
+        self._n = np.zeros(num_slots, int)
+        self.forked = []  # (src, dst, completion)
+        self.released = []
+        self.admitted = []
+
+    def begin_admit(self, slot, prompt, max_new=None, sampling=None,
+                    eos_id=None):
+        self.admitted.append(slot)
+        self._n[slot] = 0
+        return 0
+
+    def prefill_chunk(self, slot, budget):
+        return 0
+
+    def fork_slot(self, src, dst, max_new=None, completion=1):
+        if self.fail_fork:
+            raise RuntimeError("fork exploded")
+        self.forked.append((src, dst, completion))
+
+    def release(self, slot):
+        self.released.append(slot)
+
+    def step(self, active):
+        toks = np.full(self.num_slots, -1)
+        for i in np.flatnonzero(active):
+            toks[i] = 100 * (i + 1) + self._n[i]
+            self._n[i] += 1
+        return toks
+
+
+def test_group_reserves_n_slots_and_all_complete():
+    from distkeras_tpu.serving.scheduler import ContinuousBatcher, ServeRequest
+
+    st = FakeForkStepper(num_slots=4)
+    b = ContinuousBatcher(st, queue_capacity=8)
+    req = b.submit(ServeRequest(
+        [1, 2], 3, sampling=SamplingParams(temperature=0.5, n=3)
+    ))
+    # single competing request must wait: only 1 slot left after the
+    # group takes 3 — admitted alongside
+    solo = b.submit(ServeRequest([9], 3))
+    for _ in range(10):
+        b.step()
+        if req.done and solo.done:
+            break
+    outs = req.result(timeout=1)
+    assert len(outs) == 3
+    assert len(st.forked) == 2  # completions 1 and 2
+    assert {c for _, _, c in st.forked} == {1, 2}
+    # every completion emitted its own slot's stream, full budget
+    for o in outs:
+        assert o.size == 2 + 3
+    solo.result(timeout=1)
+    assert b.counters["completed"] == 2  # one per REQUEST
+    assert b.forked_slots.value == 2
+    assert b.sampled_requests.value == 1
+
+
+def test_group_fork_waits_out_pool_pressure():
+    """A fork racing pool exhaustion WAITS (the group's pages are only
+    advisorily gated through a multi-iteration prefill): the primary
+    stays held un-started, the fork retries as evictions free pages,
+    and the group completes normally once the pool clears — the same
+    head-of-line discipline as page-gated admission, never a spurious
+    typed failure."""
+    from distkeras_tpu.serving.scheduler import (
+        ContinuousBatcher,
+        PoolExhaustedError,
+        ServeRequest,
+    )
+
+    st = FakeForkStepper(num_slots=4)
+    pressure = {"left": 2}  # first two fork attempts find no pages
+
+    real_fork = st.fork_slot.__func__
+
+    def fork(src, dst, max_new=None, completion=1):
+        if pressure["left"] > 0:
+            pressure["left"] -= 1
+            raise PoolExhaustedError("raced away")
+        real_fork(st, src, dst, max_new=max_new, completion=completion)
+
+    st.fork_slot = fork
+    b = ContinuousBatcher(st, queue_capacity=8)
+    req = b.submit(ServeRequest(
+        [1, 2], 3, sampling=SamplingParams(temperature=0.5, n=2)
+    ))
+    for _ in range(12):
+        b.step()
+        if req.done:
+            break
+    outs = req.result(timeout=1)
+    assert len(outs) == 2 and all(o.size == 5 for o in outs)
+    assert pressure["left"] == 0  # the exhaustion path actually fired
+    assert b.counters["prefill_failures"] == 0  # a wait, not a failure
+    assert b.forked_slots.value == 1
+
+
+def test_group_fork_failure_fails_whole_request_typed():
+    from distkeras_tpu.serving.scheduler import (
+        ContinuousBatcher,
+        InternalError,
+        ServeRequest,
+    )
+
+    st = FakeForkStepper(num_slots=4, fail_fork=True)
+    b = ContinuousBatcher(st, queue_capacity=8)
+    req = b.submit(ServeRequest(
+        [1, 2], 3, sampling=SamplingParams(temperature=0.5, n=2)
+    ))
+    for _ in range(5):
+        b.step()
+        if req.done:
+            break
+    with pytest.raises(InternalError):
+        req.result(timeout=1)
+    # every group slot released; the bank is clean for the next wave
+    assert b.idle
+    nxt = b.submit(ServeRequest([3], 2))
+    for _ in range(5):
+        b.step()
+        if nxt.done:
+            break
+    nxt.result(timeout=1)
+
+
+def test_group_requires_fork_capable_stepper_and_fitting_n():
+    from distkeras_tpu.serving.scheduler import ContinuousBatcher, ServeRequest
+
+    st = FakeForkStepper(num_slots=2)
+    b = ContinuousBatcher(st, queue_capacity=8)
+    with pytest.raises(ValueError, match="exceed"):
+        b.submit(ServeRequest(
+            [1], 2, sampling=SamplingParams(temperature=0.5, n=3)
+        ))
+    st2 = FakeForkStepper(num_slots=4)
+    st2.can_fork = False
+    b2 = ContinuousBatcher(st2, queue_capacity=8)
+    with pytest.raises(ValueError, match="fork"):
+        b2.submit(ServeRequest(
+            [1], 2, sampling=SamplingParams(temperature=0.5, n=2)
+        ))
+
+
+def test_n_completions_match_independent_derived_seed_admissions(lm):
+    """THE fork-economics pin: n=3 via CoW fork produces exactly the
+    sequences three independent admissions with
+    ``seed_for_completion(seed, j)`` produce — shared prefill +
+    shared pages buy the speed, the tokens do not move."""
+    from distkeras_tpu.serving import ServingEngine
+
+    # prompt length 10 on page_size 4: the fork frontier page is
+    # PARTIAL, so divergence costs exactly the one CoW device copy
+    p = _prompt(10, 15)
+    eng = ServingEngine(
+        lm, num_slots=4, paged=True, page_size=4, prefix_cache=False,
+        watchdog_interval=30.0,
+    ).start()
+    try:
+        group = eng.generate(
+            p, 6, sampling=SamplingParams(temperature=0.9, seed=41, n=3)
+        )
+        singles = [
+            eng.generate(
+                p, 6,
+                sampling=SamplingParams(
+                    temperature=0.9, seed=seed_for_completion(41, j)
+                ),
+            )
+            for j in range(3)
+        ]
+        for j, (g, s) in enumerate(zip(group, singles)):
+            np.testing.assert_array_equal(g, s, err_msg=f"completion {j}")
+        # pages were genuinely shared by the forks
+        assert eng.stats()["paged"]["cow_copies"] >= 1
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------- wire / TCP
+
+
+def test_sampling_rides_the_wire_end_to_end(lm):
+    """Client -> server over TCP: sampled generate (replay-equal to
+    the embedded engine), n>1 returning n sequences, grammar
+    constrained output, and a malformed grammar answering bad_request."""
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+
+    p = _prompt(5, 17)
+    solo = CachedSequenceGenerator(
+        lm, temperature=0.8, seed=23
+    ).generate(p[None], steps=6)[0]
+    eng = ServingEngine(
+        lm, num_slots=4, paged=True, page_size=4, prefix_cache=False,
+        watchdog_interval=30.0,
+    ).start()
+    srv = ServingServer(eng).start()
+    try:
+        with ServingClient(srv.host, srv.port) as c:
+            got = c.generate(
+                p, 6, sampling={"temperature": 0.8, "seed": 23}
+            )
+            np.testing.assert_array_equal(got, solo)
+            outs = c.generate(
+                p, 6,
+                sampling=SamplingParams(temperature=0.8, seed=23, n=2),
+            )
+            assert isinstance(outs, list) and len(outs) == 2
+            np.testing.assert_array_equal(outs[0], solo)
+            constrained = c.generate(
+                p, 4, eos_id=60,
+                sampling={"grammar": {"kind": "allow",
+                                      "tokens": [3, 5]}},
+            )
+            assert all(
+                t in (3, 5, 60) for t in constrained[5:].tolist()
+            )
+            # a malformed grammar dies at the CLIENT boundary (the
+            # same SamplingParams validation the server runs — a typo
+            # never costs a round trip, let alone serves greedy)
+            with pytest.raises(ValueError):
+                c.generate(p, 4, sampling={"grammar": {"kind": "bad"}})
+            # a structurally-valid wire dict the client passes but the
+            # server cannot satisfy still answers typed bad_request
+            raw = {"verb": "generate", "max_new_tokens": 4,
+                   "sampling": {"grammar": {"kind": "bad"}}}
+            from distkeras_tpu.utils.serialization import serialize_params
+            reply, _ = c._roundtrip(
+                raw, serialize_params(p), raise_on_error=False
+            )
+            assert reply["ok"] is False
+            assert reply["error"] == "bad_request"
+            # sampler params land on the traced server span
+            c.generate(
+                p, 4, trace=True,
+                sampling={"temperature": 0.8, "seed": 23},
+            )
+            spans = {
+                s["name"]: s for s in c.last_trace["spans"]
+            }
+            assert spans["server.generate"]["attrs"]["sampling"] == {
+                "temperature": 0.8, "seed": 23,
+            }
+    finally:
+        srv.shutdown()

@@ -806,8 +806,13 @@ def test_engine_speculative_wiring_and_validation(lm, lm_ref):
         assert "speculative_tokens_per_window" in eng.health()
     finally:
         eng.stop()
+    # sampled speculative serving is now legal under the default
+    # rejection mode; the legacy greedy-agreement refusal survives as
+    # the EXPLICIT strict mode (one shared validation helper)
+    ServingEngine(lm, speculative="ngram", temperature=0.7)
     with pytest.raises(ValueError, match="GREEDY"):
-        ServingEngine(lm, speculative="ngram", temperature=0.7)
+        ServingEngine(lm, speculative="ngram", temperature=0.7,
+                      spec_mode="strict")
     with pytest.raises(ValueError, match="draft_bundle"):
         ServingEngine(lm, speculative="draft")
     with pytest.raises(ValueError, match="draft_bundle"):

@@ -11,9 +11,18 @@ The acceptance bar it asserts (and prints as JSON):
   ``ServingError`` subclass (``overloaded`` bursts and connection
   resets are absorbed by the default ``RetryPolicy``; blamed poison
   steps and supervisor restarts surface as ``internal``);
-- ZERO corrupt outputs — every successful generate is token-identical
-  to its solo ``CachedSequenceGenerator`` reference, restarts and
-  quarantines notwithstanding;
+- ZERO corrupt outputs — every successful GREEDY generate is token-
+  identical to its solo ``CachedSequenceGenerator`` reference,
+  restarts and quarantines notwithstanding;
+- ZERO divergent replays — the client mix is greedy / SAMPLED /
+  grammar-CONSTRAINED / n=2-parallel; every sampled-family request
+  carries a fixed seed and its canonical output is captured once,
+  fault-free, before chaos arms. Under chaos, every successful serve
+  of the same (prompt, params) — through blame probes, quarantine
+  re-admissions, and watchdog restarts — must reproduce the canonical
+  sample token-identically (the position-keyed RNG claim, asserted
+  under fire), and constrained outputs must stay inside their
+  grammar;
 - ZERO incomplete traces — every client request runs ``trace=True``,
   and every attempt (completed or typed-error alike) must assemble a
   timeline with EXACTLY ONE terminal span. "0 hung / 0 untyped" stops
@@ -83,12 +92,32 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
             seed=0,
         )
 
+    from distkeras_tpu.serving import SamplingParams
+
     rng = np.random.default_rng(seed)
     prompts = [
         rng.integers(0, 61, n).astype(np.int32) for n in (3, 5, 7, 9)
     ]
     ref_gen = CachedSequenceGenerator(model)
     refs = [ref_gen.generate(p[None], steps=max_new)[0] for p in prompts]
+    # the sampled-family request mix: per-prompt params with FIXED
+    # seeds (replay is the acceptance bar), a grammar-constrained
+    # shape, and an n=2 completion group (paged engines fork it);
+    # n>1 needs fork_slot, so the dense opt-out drops the group shape
+    grammar = {"kind": "allow", "tokens": list(range(0, 61, 2))}
+    sampled_params = [
+        SamplingParams(temperature=0.8, seed=100 + i)
+        for i in range(len(prompts))
+    ] + [
+        SamplingParams(temperature=0.9, top_p=0.9, seed=200,
+                       grammar=grammar),
+    ] + ([SamplingParams(temperature=0.8, seed=300, n=2)] if paged
+         else [])
+    # sampled request i pairs params i with prompt i % len(prompts)
+    sampled_reqs = [
+        (prompts[i % len(prompts)], sp)
+        for i, sp in enumerate(sampled_params)
+    ]
 
     postmortem_dir = tempfile.mkdtemp(prefix="soak_serving_pm_")
     engine = ServingEngine(
@@ -117,6 +146,24 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     server = ServingServer(engine, retry_after_ms=20.0).start()
     for p in prompts:  # fault-free warmup: compile every bucket + the step
         engine.generate(p, max_new)
+    # canonical sampled outputs, captured FAULT-FREE: under chaos,
+    # every successful serve of the same (prompt, params) must
+    # reproduce these token-identically — the replay-determinism bar
+    # (this also warms the sampled/masked program variants)
+    canon = [
+        engine.generate(p, max_new, sampling=sp)
+        for p, sp in sampled_reqs
+    ]
+
+    def matches_canon(si, out):
+        want = canon[si]
+        if isinstance(want, list):
+            return isinstance(out, list) and len(out) == len(want) and all(
+                np.array_equal(a, b) for a, b in zip(out, want)
+            )
+        return np.array_equal(out, want)
+
+    allowed_toks = set(grammar["tokens"])
 
     plan = (
         FaultPlan(seed=seed)
@@ -145,10 +192,13 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     lock = threading.Lock()
     summary = {
         "completed": 0,
+        "sampled_completed": 0,
         "typed_errors": {},
         "untyped_errors": 0,
         "untyped_samples": [],
         "corrupt_outputs": 0,
+        "divergent_replays": 0,
+        "grammar_violations": 0,
         "trace_attempts": 0,
         "trace_incomplete": 0,
         "trace_incomplete_samples": [],
@@ -177,10 +227,22 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         crng = np.random.default_rng(seed * 100 + ci)
         with ServingClient("127.0.0.1", server.port, retry=policy) as c:
             while time.monotonic() < stop_at:
-                pi = int(crng.integers(0, len(prompts)))
+                # mixed traffic: greedy shapes AND the sampled family
+                # (sampled / constrained / n=2) share the bank, an
+                # even split so a short smoke still completes both
+                # kinds under load
+                si = None
+                if crng.random() < 0.5:
+                    pi = int(crng.integers(0, len(prompts)))
+                    prompt, sp = prompts[pi], None
+                else:
+                    si = int(crng.integers(0, len(sampled_reqs)))
+                    prompt, sp = sampled_reqs[si]
                 c.last_trace = None  # fresh per attempt
                 try:
-                    out = c.generate(prompts[pi], max_new, trace=True)
+                    out = c.generate(
+                        prompt, max_new, trace=True, sampling=sp
+                    )
                 except ServingError as e:
                     code = getattr(e, "code", type(e).__name__)
                     with lock:
@@ -197,10 +259,20 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
                     check_trace(c)
                     continue
                 with lock:
-                    if np.array_equal(out, refs[pi]):
-                        summary["completed"] += 1
+                    if si is None:
+                        if np.array_equal(out, refs[pi]):
+                            summary["completed"] += 1
+                        else:
+                            summary["corrupt_outputs"] += 1
                     else:
-                        summary["corrupt_outputs"] += 1
+                        if matches_canon(si, out):
+                            summary["sampled_completed"] += 1
+                        else:
+                            summary["divergent_replays"] += 1
+                        if sampled_reqs[si][1].grammar is not None:
+                            gen = np.asarray(out)[prompt.size:]
+                            if not set(gen.tolist()) <= allowed_toks:
+                                summary["grammar_violations"] += 1
                 check_trace(c)
 
     threads = [
@@ -230,6 +302,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
             "step_failures", "blame_probes", "internal_errors",
             "quarantines", "restarts", "watchdog_trips", "status",
             "completed", "rejected_overloaded", "pool_exhausted",
+            "sampled_requests", "forked_slots",
         )
     }
     if paged:
@@ -277,6 +350,9 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         hung == 0
         and summary["untyped_errors"] == 0
         and summary["corrupt_outputs"] == 0
+        and summary["divergent_replays"] == 0
+        and summary["grammar_violations"] == 0
+        and summary["sampled_completed"] > 0
         and summary["trace_incomplete"] == 0
         and summary["trace_attempts"] > 0
         and trips >= 1
