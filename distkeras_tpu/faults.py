@@ -24,6 +24,14 @@ Seam catalogue (the hook points that exist today)::
                         demand; the scheduler surfaces an exhausted
                         admission as typed retriable ``overloaded``,
                         never a hung slot or a corrupt stream
+    kv.swap             DecodeStepper.swap_out / swap_in (QoS
+                        preemption), before any device work or state
+                        change; ``ctx["direction"]`` is "out"/"in".
+                        A failed swap-out ABORTS the preemption (the
+                        victim keeps decoding untouched); a failed
+                        swap-in fails only the preempted request,
+                        typed — the scheduler never wedges and no
+                        page or host swap state leaks
     server.dispatch     ServingServer verb dispatch (typed-reply path)
     server.reply        ServingServer before sending a reply frame
     router.dispatch     FleetRouter verb dispatch, before a replica is
@@ -93,6 +101,7 @@ SITES = frozenset(
         "stepper.prefill",
         "prefix_cache.fetch",
         "kv.alloc",
+        "kv.swap",
         "server.dispatch",
         "server.reply",
         "router.dispatch",

@@ -47,15 +47,21 @@ OK, WARN, BREACH = "ok", "warn", "breach"
 
 
 class SloSpec:
-    """One service-level objective over one registry series."""
+    """One service-level objective over one registry series.
+
+    ``labels``: optional label constraints — the spec then grades the
+    first sample of ``series`` whose labels CONTAIN them (e.g.
+    ``{"tenant": "interactive"}`` picks that tenant's labeled latency
+    histogram). Empty/None keeps the historical behavior: the first
+    sample under the name (a component's own book)."""
 
     __slots__ = ("name", "series", "threshold", "warn", "agg", "bound",
-                 "per", "min_count")
+                 "per", "min_count", "labels")
 
     def __init__(self, name: str, series: str, threshold: float,
                  warn: float | None = None, agg: str = "value",
                  bound: str = "max", per: str | None = None,
-                 min_count: int = 1):
+                 min_count: int = 1, labels: dict | None = None):
         if agg not in ("value", "p50", "p99", "mean", "rate"):
             raise ValueError(f"unknown agg {agg!r}")
         if bound not in ("max", "min"):
@@ -70,12 +76,14 @@ class SloSpec:
         self.bound = bound
         self.per = per
         self.min_count = int(min_count)
+        self.labels = dict(labels or {})
 
     def describe(self) -> dict:
         return {
             "name": self.name, "series": self.series,
             "threshold": self.threshold, "warn": self.warn,
             "agg": self.agg, "bound": self.bound, "per": self.per,
+            "labels": dict(self.labels),
         }
 
 
@@ -95,11 +103,22 @@ def _hist_quantile(sample: dict, q: float):
     return last
 
 
+def _pick_sample(by_name: dict, series: str, labels: dict):
+    """The sample a spec grades: first sample under the name whose
+    labels contain ``labels`` (empty labels = the first sample, the
+    historical component-own-book behavior)."""
+    for s in by_name.get(series, ()):
+        have = s.get("labels") or {}
+        if all(have.get(k) == v for k, v in labels.items()):
+            return s
+    return None
+
+
 def _reduce(spec: SloSpec, by_name: dict):
     """Reduce ``spec``'s series to ``(value, count)`` from the sample
     index; value None = not judgeable (missing series, empty
     histogram, zero denominator)."""
-    s = by_name.get(spec.series)
+    s = _pick_sample(by_name, spec.series, spec.labels)
     if s is None:
         return None, 0
     if spec.agg == "value":
@@ -114,7 +133,7 @@ def _reduce(spec: SloSpec, by_name: dict):
             return None, 0
         return float(s["sum"]) / count, count
     # rate: numerator value / denominator value
-    den = by_name.get(spec.per)
+    den = _pick_sample(by_name, spec.per, spec.labels)
     num_v = s.get("value")
     den_v = None if den is None else den.get("value")
     if num_v is None or not den_v:
@@ -128,9 +147,12 @@ def evaluate_slos(samples, specs) -> dict:
     [...]}`` — ``violations`` names the violating series with the
     measured value and the crossed threshold (what the ``health``
     verb ships), ``specs`` is the full per-spec detail."""
-    by_name = {}
+    by_name: dict = {}
     for s in samples:
-        by_name.setdefault(s["name"], s)  # first sample wins (own book)
+        # every sample under the name, in arrival order: unlabeled
+        # specs read the first (own book — the historical behavior),
+        # labeled specs find their (e.g. per-tenant) twin
+        by_name.setdefault(s["name"], []).append(s)
     detail = []
     worst = OK
     violations = []
@@ -161,6 +183,8 @@ def evaluate_slos(samples, specs) -> dict:
             "bound": spec.bound,
             "verdict": verdict,
         }
+        if spec.labels:
+            row["labels"] = dict(spec.labels)  # names WHOSE series
         detail.append(row)
         if verdict != OK:
             violations.append(
@@ -232,14 +256,27 @@ class SloEvaluator:
 
 def default_serving_slos(latency_p99_s=None, ttft_p99_s=None,
                          error_rate=None, acceptance_rate=None,
-                         min_count=20) -> list[SloSpec]:
+                         min_count=20,
+                         tenant_latency_p99_s=None) -> list[SloSpec]:
     """The serving-tier spec set, opt-in per knob (None = not
     enforced): end-to-end p99 latency, TTFT p99, typed-internal error
     rate (internal errors / submitted — the denominator includes
     rejected and in-flight requests, so set the ceiling against total
     offered load), and the speculative acceptance floor (mean tokens
-    per verify window)."""
+    per verify window).
+
+    ``tenant_latency_p99_s``: tenant name -> p99 bound (seconds) —
+    one spec per tenant over that tenant's LABELED latency histogram
+    (``serving_request_total_seconds{tenant=...}``), so a QoS
+    violation is attributable to the tenant whose SLO it broke, not
+    smeared into the fleet-wide tail."""
     specs = []
+    for t, bound in (tenant_latency_p99_s or {}).items():
+        specs.append(SloSpec(
+            f"latency_p99[{t}]", "serving_request_total_seconds",
+            bound, agg="p99", min_count=min_count,
+            labels={"tenant": str(t)},
+        ))
     if latency_p99_s is not None:
         specs.append(SloSpec(
             "latency_p99", "serving_request_total_seconds",

@@ -53,11 +53,13 @@ from distkeras_tpu.serving.scheduler import (
     InternalError,
     OverloadedError,
     PoolExhaustedError,
+    QuotaExhaustedError,
     ServeRequest,
     ServingError,
     WindowedBatcher,
 )
 from distkeras_tpu.serving.paging import PageAllocator
+from distkeras_tpu.serving.qos import QosPolicy, TokenBucket
 from distkeras_tpu.serving.sampling import (
     SamplingParams,
     TokenMaskCompiler,
@@ -97,7 +99,10 @@ __all__ = [
     "PageAllocator",
     "PoolExhaustedError",
     "PrefixStore",
+    "QosPolicy",
+    "QuotaExhaustedError",
     "SamplingParams",
+    "TokenBucket",
     "ServeRequest",
     "ServingClient",
     "ServingEngine",

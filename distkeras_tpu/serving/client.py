@@ -42,6 +42,7 @@ from distkeras_tpu.serving.scheduler import (
     EngineStoppedError,
     InternalError,
     OverloadedError,
+    QuotaExhaustedError,
     ServingError,
 )
 from distkeras_tpu.utils.serialization import (
@@ -53,6 +54,10 @@ from distkeras_tpu.utils.serialization import (
 
 _ERRORS = {
     OverloadedError.code: OverloadedError,
+    # per-tenant admission refusal (router token bucket): retriable
+    # like overloaded (it subclasses it), with the bucket's honest
+    # refill time riding retry_after_ms
+    QuotaExhaustedError.code: QuotaExhaustedError,
     DeadlineExceededError.code: DeadlineExceededError,
     EngineStoppedError.code: EngineStoppedError,
     InternalError.code: InternalError,
@@ -250,7 +255,8 @@ class ServingClient:
     # -- verbs --------------------------------------------------------------
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
-                 deadline_ms=None, trace=False, sampling=None):
+                 deadline_ms=None, trace=False, sampling=None,
+                 tenant=None, priority=None):
         """Continue ``prompt`` (1-D int tokens) by up to
         ``max_new_tokens``; returns the full sequence (prompt +
         generated, trimmed after the first generated ``eos_id``).
@@ -264,6 +270,14 @@ class ServingClient:
         position), so a retried/resent request reproduces the same
         tokens — which is also why routing through the fleet router
         needs no sampling awareness at all.
+
+        ``tenant``/``priority``: the request's QoS identity, riding
+        two optional header fields client → router → server →
+        scheduler (absent = the pre-QoS wire: default tenant,
+        priority 0). The router's per-tenant token bucket may refuse
+        with typed retriable ``quota_exhausted`` (``retry_after_ms``
+        = the honest refill time); a QoS-scheduled engine uses them
+        for WFQ shares and priority-class admission/preemption.
 
         ``trace=True`` propagates a trace context end to end (client →
         router → server → scheduler) and assembles the per-request
@@ -286,6 +300,10 @@ class ServingClient:
         sampling = SamplingParams.from_wire(sampling)
         if sampling is not None:
             header["sampling"] = sampling.to_wire()
+        if tenant is not None:
+            header["tenant"] = str(tenant)
+        if priority is not None:
+            header["priority"] = int(priority)
         ctx = span = None
         if trace:
             ctx = TraceContext.new(want_timeline=True)

@@ -1012,6 +1012,146 @@ class DecodeStepper:
             self.drafter.invalidate(np.arange(self.num_slots) == dst)
             self._spec_pending = None
 
+    # -- preemption swap (multi-tenant QoS) ---------------------------------
+
+    def swap_out(self, slot: int) -> dict:
+        """Serialize a DECODABLE slot's live state to host memory —
+        the preemption path's first half. Fetches the slot's written
+        K/V cache positions (``0 .. len-2``) per stage in the SAME
+        host row format the ``PrefixStore`` ladder serializes
+        (per-stage ``(p, H, Dh)`` numpy in ``kv_dtype`` — bit-exact,
+        so restore reproduces the device state and the resumed stream
+        stays token-identical to an uninterrupted decode), plus the
+        context row, host length, and the sampler/grammar state the
+        position-keyed RNG needs to continue mid-stream.
+
+        READ-ONLY: no slot state mutates here — the caller (the
+        scheduler) releases the slot (freeing its pages) only after a
+        successful swap-out, so a failure at the ``kv.swap`` seam
+        leaves the victim decoding untouched. The returned dict rides
+        the preempted request; dropping it (typed failure, stop) is
+        the only cleanup."""
+        self._fire("kv.swap", slot=slot, direction="out")
+        if slot in self._pending:
+            raise ValueError(
+                f"slot {slot} is mid-prefill; only decodable slots "
+                "can be swapped out"
+            )
+        ln = int(self._lens[slot])
+        if ln > self.max_len:
+            raise ValueError(
+                f"slot {slot} context ({ln}) has walked past the "
+                f"prompt row ({self.max_len}); not swappable"
+            )
+        p = ln - 1  # written cache positions
+        nh, hd = self._nh, self._hd
+        if p < 1:
+            kv = [
+                (
+                    np.zeros((0, nh, hd), np.dtype(self._gen.kv_dtype)),
+                    np.zeros((0, nh, hd), np.dtype(self._gen.kv_dtype)),
+                )
+                for _ in self._gen._stages
+            ]
+        elif self.paged:
+            npg = -(-p // self.page_size)
+            pages = np.asarray(self._tables[slot][:npg], np.int32)
+            kv = [
+                (
+                    np.asarray(ck[pages]).reshape(-1, nh, hd)[:p].copy(),
+                    np.asarray(cv[pages]).reshape(-1, nh, hd)[:p].copy(),
+                )
+                for ck, cv in self._pools
+            ]
+        else:
+            kv = [
+                (
+                    np.asarray(ck[slot, :p]).copy(),
+                    np.asarray(cv[slot, :p]).copy(),
+                )
+                for ck, cv in self._caches
+            ]
+        return {
+            "len": ln,
+            "ctx": np.asarray(self._ctx[slot, :ln]).copy(),
+            "kv": kv,
+            "spos": int(self._spos[slot]),
+            "seed": int(self._seeds[slot]),
+            "params": self._slot_params[slot],
+            "grammar": self._grammar.get(slot),
+            "spec_prompt": self._spec_prompts.get(slot),
+        }
+
+    def swap_in(self, slot: int, state: dict, max_new=None) -> None:
+        """Restore a swapped-out request into a FREE slot — resume is
+        re-reserve + restore. Paged mode first reserves the full page
+        budget (``len + remaining`` positions — the same total the
+        original admission reserved; all PRIVATE pages, since the
+        restore writes every position); exhaustion raises the typed
+        retriable ``PoolExhaustedError`` BEFORE any slot state
+        mutates. Then the context row and the host K/V rows are
+        written back through the same bucketed restore programs a
+        prefix-cache hit uses, and the host length + sampler counter
+        resume exactly where the swap-out left them — the next step
+        computes precisely what an uninterrupted decode would have
+        (garbage at positions >= len-1 is overwritten by that step's
+        own K/V write before anything attends it, the standing
+        restore argument)."""
+        self._fire("kv.swap", slot=slot, direction="in")
+        ln = int(state["len"])
+        remaining = (
+            (self.max_len - ln) if max_new is None else int(max_new)
+        )
+        if self.paged:
+            if self._tables[slot]:
+                self._free_slot_pages(slot)
+            need = self.pages_for(ln, max(1, remaining))
+            self._tables[slot] = self._alloc_pages(need, "swap_in")
+        row = np.zeros((1, self.max_len), np.int32)
+        row[0, :ln] = state["ctx"]
+        if self._row_fn is None:
+            import jax
+
+            self._compiling()
+            self._row_fn = jax.jit(
+                lambda ctx, r, s: jax.lax.dynamic_update_slice(
+                    ctx, r, (s, 0)
+                ),
+                donate_argnums=(0,),
+            )
+        self._ctx = self._row_fn(self._ctx, row, np.int32(slot))
+        if state["kv"][0][0].shape[0] >= 1:
+            self._restore_prefix(slot, state["kv"])
+        self._lens[slot] = ln
+        # sampler state resumes mid-stream: the position-keyed RNG
+        # continues from the exact emitted-token counter, so a sampled
+        # stream's post-resume draws equal the uninterrupted ones
+        p = state["params"] if state["params"] is not None else (
+            self.default_sampling
+        )
+        self._slot_params[slot] = p
+        self._temps[slot] = p.temperature
+        self._topk[slot] = 0 if p.top_k is None else p.top_k
+        self._topp[slot] = 1.0 if p.top_p is None else p.top_p
+        self._seeds[slot] = state["seed"]
+        self._spos[slot] = state["spos"]
+        if state["grammar"] is not None:
+            self._grammar[slot] = state["grammar"]
+        else:
+            self._grammar.pop(slot, None)
+        self._pending.pop(slot, None)
+        self._prefill_pos.pop(slot, None)
+        if self.drafter is not None:
+            # like fork_slot: the draft bank holds no K/V for this
+            # stream, so mark the slot admitted but INVALID — model
+            # drafters stop proposing (plain-decode pace), host-
+            # sequence drafters (ngram) keep working from true tokens
+            if state["spec_prompt"] is not None:
+                self._spec_prompts[slot] = state["spec_prompt"]
+            self._spec_admitted.add(slot)
+            self.drafter.invalidate(np.arange(self.num_slots) == slot)
+            self._spec_pending = None
+
     def prefill_chunk(self, slot: int, budget: int) -> int:
         """Prefill up to ``budget`` more positions of ``slot``'s pending
         prompt; returns positions remaining (0 = ready to decode). A
@@ -2181,7 +2321,7 @@ class ServingEngine:
                  flight_recorder=True,
                  recorder_capacity=2048, postmortem_dir=None,
                  slos=None, slo_interval=5.0, paged=False,
-                 page_size=16, num_pages=None):
+                 page_size=16, num_pages=None, qos=None):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -2237,6 +2377,15 @@ class ServingEngine:
         as ``slo``/``slo_violations``, re-evaluated at most every
         ``slo_interval`` seconds; breaches count in
         ``serving_slo_breaches`` and land in the recorder).
+
+        QoS knob: ``qos`` — an optional ``qos.QosPolicy``. None keeps
+        the single-FIFO scheduler. A policy turns the queue into
+        priority classes + per-tenant weighted fair queuing, and
+        (``preempt=True``) lets a higher-priority arrival displace
+        the lowest-priority decodable slot by serializing its KV out
+        to host (``swap_out``) and freeing its pages; resume is
+        restore + re-reserve, token-identical across the boundary.
+        Requests carry ``tenant``/``priority`` via ``submit``.
 
         Capacity knobs: ``paged=True`` swaps the stepper's per-slot
         contiguous K/V caches for the block-paged pool (``page_size``
@@ -2334,8 +2483,9 @@ class ServingEngine:
         self._batcher_cfg = dict(
             queue_capacity=queue_capacity, prefill_chunk=prefill_chunk,
             quarantine_steps=quarantine_steps, registry=self.registry,
-            recorder=self.recorder,
+            recorder=self.recorder, qos=qos,
         )
+        self.qos = qos
         self.batcher = (
             None
             if self._stepper is None
@@ -2471,6 +2621,14 @@ class ServingEngine:
             for phase in ("queue_wait", "prefill", "decode", "ttft",
                           "total")
         }
+        # per-tenant latency histograms (tenant-labeled twins of the
+        # above, created lazily per tenant seen in ``wait``) — what
+        # per-tenant SLO specs grade, so a QoS violation names WHO.
+        # Cardinality-bounded (qos.MAX_TENANT_LABELS): tenant is a
+        # client-chosen wire string, and the tail folds rather than
+        # growing two histograms per unique name forever
+        self._tenant_lat_hists: dict[tuple, object] = {}
+        self._tenant_hist_seen: set[str] = set()
         # SLO watchdog: declarative specs graded from THIS registry,
         # cadence-guarded (health polls between evaluations read the
         # cached verdict); breaches count + land in the recorder
@@ -2747,7 +2905,8 @@ class ServingEngine:
     # -- generate -----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               deadline=None, trace=None, sampling=None) -> ServeRequest:
+               deadline=None, trace=None, sampling=None, tenant=None,
+               priority=0) -> ServeRequest:
         """``trace``: an optional ``obs.TraceContext`` — the scheduler
         then keeps the per-request event ledger ``obs.request_spans``
         turns into the server-side phase timeline. None (the default)
@@ -2757,7 +2916,12 @@ class ServingEngine:
         dict). None = the engine-wide defaults (greedy unless the
         engine was built with a temperature). ``n > 1`` schedules n
         parallel completions via CoW ``fork_slot`` (paged engines);
-        a grammar constrains decoding with device-side token masks."""
+        a grammar constrains decoding with device-side token masks.
+
+        ``tenant``/``priority``: the request's QoS identity (default
+        tenant "default", priority 0). Without a ``qos`` policy they
+        only label metrics; with one they pick the WFQ share and the
+        priority class (higher = more urgent, may preempt)."""
         from distkeras_tpu.serving.sampling import (
             SamplingParams,
             check_spec_sampling,
@@ -2788,7 +2952,8 @@ class ServingEngine:
             )
         req = ServeRequest(
             prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
-            trace=trace, sampling=sampling,
+            trace=trace, sampling=sampling, tenant=tenant,
+            priority=priority,
         )
         try:
             try:
@@ -2815,12 +2980,13 @@ class ServingEngine:
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
                  deadline=None, timeout=None, trace=None,
-                 sampling=None) -> np.ndarray:
+                 sampling=None, tenant=None, priority=0) -> np.ndarray:
         """Returns the full sequence (prompt + generated, eos-trimmed);
         with ``sampling.n > 1``, a LIST of n such sequences."""
         req = self.submit(
             prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
-            trace=trace, sampling=sampling,
+            trace=trace, sampling=sampling, tenant=tenant,
+            priority=priority,
         )
         return self.wait(req, timeout)
 
@@ -2839,6 +3005,25 @@ class ServingEngine:
             for phase, hist in self._lat_hists.items():
                 if lat[phase] is not None:
                     hist.observe(lat[phase])
+            tenant = getattr(req, "tenant", "default")
+            if tenant != "default":
+                from distkeras_tpu.serving.qos import fold_tenant
+
+                # tenant-labeled twins of the ttft/total histograms —
+                # the series per-tenant SLO specs grade
+                tenant = fold_tenant(self._tenant_hist_seen, tenant)
+                for phase in ("ttft", "total"):
+                    if lat[phase] is None:
+                        continue
+                    key = (tenant, phase)
+                    h = self._tenant_lat_hists.get(key)
+                    if h is None:
+                        h = self.registry.histogram(
+                            f"serving_request_{phase}_seconds",
+                            labels={"tenant": tenant},
+                        )
+                        self._tenant_lat_hists[key] = h
+                    h.observe(lat[phase])
             if self.metrics is not None:
                 self.metrics.log(
                     event="serving_complete", request_id=req.id,

@@ -76,7 +76,8 @@ from distkeras_tpu import faults
 from distkeras_tpu.networking import probe, recv_data, send_data
 from distkeras_tpu.obs import stamp_error_trace as _stamp_trace
 from distkeras_tpu.serving.prefix_cache import _pow2_ladder
-from distkeras_tpu.serving.scheduler import ServingError
+from distkeras_tpu.serving.qos import as_bucket
+from distkeras_tpu.serving.scheduler import QuotaExhaustedError, ServingError
 from distkeras_tpu.utils.serialization import (
     deserialize_params,
     pack_frame,
@@ -163,7 +164,8 @@ class FleetRouter:
                  request_timeout=120.0, retry_after_ms=50.0,
                  affinity=True, affinity_min_len=8,
                  postmortem_dir=None, eject_on_slo_breach=0,
-                 recorder_capacity=1024):
+                 recorder_capacity=1024, tenant_quotas=None,
+                 quota_default=None):
         """``eject_after``: consecutive failed health polls before an
         ACTIVE replica leaves rotation (a mid-forward connection death
         ejects immediately — the poll budget is for the quiet path).
@@ -180,7 +182,18 @@ class FleetRouter:
         replica whose health reply reports ``slo: "breach"`` for that
         many CONSECUTIVE polls is ejected like a degraded one, and
         cannot rejoin until a poll shows the breach cleared (0 — the
-        default — never ejects on SLO: verdicts stay advisory)."""
+        default — never ejects on SLO: verdicts stay advisory).
+
+        ``tenant_quotas``: per-tenant admission rate limits — tenant
+        name -> a ``qos.TokenBucket``, a ``{"rate":, "burst":}`` dict,
+        a ``(rate, burst)`` pair, or a bare rate (requests/second).
+        A ``generate`` whose tenant's bucket cannot cover it is
+        refused AT THE DOOR with typed retriable ``quota_exhausted``
+        carrying the bucket's honest refill time as
+        ``retry_after_ms`` — one tenant's burst is shed before it
+        holds pages or queue slots anywhere in the fleet.
+        ``quota_default``: the bucket spec applied to tenants not
+        named in ``tenant_quotas`` (None = unlimited)."""
         self.max_frame_bytes = int(max_frame_bytes)
         self.health_interval = float(health_interval)
         self.health_timeout = float(health_timeout)
@@ -192,6 +205,19 @@ class FleetRouter:
         self.affinity_min_len = int(affinity_min_len)
         self.postmortem_dir = postmortem_dir
         self.eject_on_slo_breach = int(eject_on_slo_breach)
+        # per-tenant admission buckets, built lazily from the specs
+        # (a bucket's refill clock starts at first sight of the
+        # tenant). Cardinality-bounded for DEFAULT-quota tenants:
+        # tenant is a client-chosen wire string, so past
+        # qos.MAX_TENANT_LABELS distinct unconfigured names the tail
+        # SHARES one bucket/label — bounded memory beats per-name
+        # isolation for an unauthenticated long tail; operator-named
+        # tenants in ``tenant_quotas`` are always honored by name
+        self._quota_specs = dict(tenant_quotas or {})
+        self._quota_default = quota_default
+        self._quota_buckets: dict[str, object] = {}
+        self._quota_counters: dict[str, object] = {}
+        self._quota_seen: set[str] = set(self._quota_specs)
         self.last_postmortem = None
         self.last_postmortem_path = None
         self._lock = threading.Lock()
@@ -223,6 +249,7 @@ class FleetRouter:
                 "unavailable",    # every replica unreachable
                 "ejections",
                 "rejoins",
+                "quota_rejections",  # per-tenant admission refusals
             ),
         )
         self.registry.gauge(
@@ -695,9 +722,56 @@ class FleetRouter:
 
     # -- verbs --------------------------------------------------------------
 
+    def _bucket_for(self, tenant: str):
+        bucket = self._quota_buckets.get(tenant)
+        if bucket is None:
+            spec = self._quota_specs.get(tenant, self._quota_default)
+            bucket = as_bucket(spec)
+            if bucket is None:
+                return None
+            with self._lock:
+                bucket = self._quota_buckets.setdefault(tenant, bucket)
+        return bucket
+
+    def _check_quota(self, header: dict) -> None:
+        """Per-tenant admission: a ``generate`` whose tenant's token
+        bucket cannot cover it is shed AT THE DOOR — typed retriable
+        ``quota_exhausted`` with the bucket's refill time as the
+        backoff hint — instead of after it holds pages on a replica."""
+        from distkeras_tpu.serving.qos import fold_tenant
+
+        tenant = str(header.get("tenant") or "default")
+        with self._lock:
+            tenant = fold_tenant(self._quota_seen, tenant)
+        bucket = self._bucket_for(tenant)
+        if bucket is None:
+            return
+        wait = bucket.take()
+        if wait <= 0:
+            return
+        with self._lock:
+            self.counters["quota_rejections"] += 1
+            c = self._quota_counters.get(tenant)
+            if c is None:
+                c = self._quota_counters[tenant] = self.registry.counter(
+                    "serving_quota_rejections",
+                    labels={"tenant": tenant},
+                )
+            c.inc()
+        self.recorder.record(
+            "qos.quota_reject", tenant=tenant,
+            retry_after_ms=round(wait * 1e3, 3),
+        )
+        raise QuotaExhaustedError(
+            f"tenant {tenant!r} admission quota exhausted",
+            retry_after_ms=wait * 1e3,
+        )
+
     def _dispatch(self, header: dict, payload: bytes) -> bytes:
         verb = header.get("verb")
         faults.fire("router.dispatch", verb=verb)
+        if verb == "generate":
+            self._check_quota(header)
         if verb in ("generate", "predict"):
             reply, body = self._route(header, payload)
             return pack_frame(reply, body)

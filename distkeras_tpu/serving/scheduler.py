@@ -88,6 +88,20 @@ class PoolExhaustedError(OverloadedError):
         self.retry_after = self.retry_after_ms / 1e3
 
 
+class QuotaExhaustedError(OverloadedError):
+    """A tenant's admission quota (router-side token bucket) cannot
+    cover this request — per-tenant backpressure, shed AT THE DOOR so
+    one tenant's burst never holds pages or queue slots another tenant
+    needs. Retriable; ``retry_after_ms`` is the honest refill time."""
+
+    code = "quota_exhausted"
+
+    def __init__(self, msg, retry_after_ms: float = 50.0):
+        super().__init__(msg)
+        self.retry_after_ms = float(retry_after_ms)
+        self.retry_after = self.retry_after_ms / 1e3
+
+
 class DeadlineExceededError(ServingError):
     """The request's deadline expired before it finished decoding."""
 
@@ -138,7 +152,7 @@ class ServeRequest:
     _ids_lock = threading.Lock()
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None,
-                 trace=None, sampling=None):
+                 trace=None, sampling=None, tenant=None, priority=0):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -153,6 +167,17 @@ class ServeRequest:
         self.max_new_tokens = max_new_tokens
         self.eos_id = None if eos_id is None else int(eos_id)
         self.deadline = None if deadline is None else float(deadline)
+        # multi-tenant QoS identity: the tenant name scopes WFQ shares,
+        # quotas, and metric labels; the priority class orders
+        # admission and licenses preemption (higher = more urgent)
+        self.tenant = "default" if tenant is None else str(tenant)
+        self.priority = int(priority)
+        self.preemptions = 0  # times this request was swapped out
+        # when swapped out: the stepper's host-side swap state (KV rows
+        # in the PrefixStore serialization format + ctx/sampler state);
+        # rides the REQUEST so a stop/deadline/restart that fails a
+        # swapped request drops the host state with it — nothing leaks
+        self._swap = None
         self.sampling = sampling  # SamplingParams | None (= greedy)
         self.n = 1 if sampling is None else int(sampling.n)
         self.created = time.monotonic()
@@ -176,6 +201,7 @@ class ServeRequest:
     def _finish(self, error: ServingError | None = None):
         self.error = error
         self.finished = time.monotonic()
+        self._swap = None  # host KV rows released with the request
         self._done.set()
 
     def _expired(self, now) -> bool:
@@ -244,7 +270,8 @@ class ContinuousBatcher:
     """
 
     def __init__(self, stepper, queue_capacity=64, prefill_chunk=None,
-                 quarantine_steps=64, registry=None, recorder=None):
+                 quarantine_steps=64, registry=None, recorder=None,
+                 qos=None):
         """``quarantine_steps``: scheduler iterations a slot sits out
         after a device step is blamed on its request (its cache rows are
         suspect, and a systematically poisonous traffic shape should not
@@ -262,11 +289,29 @@ class ContinuousBatcher:
         own) — the batcher then records iteration summaries, blame and
         quarantine decisions, and prefill failures ALWAYS-ON (one
         bounded-deque append per working iteration; idle iterations
-        record nothing). None disables recording."""
+        record nothing). None disables recording.
+
+        ``qos``: an optional ``qos.QosPolicy``. None (the default)
+        keeps the single-FIFO scheduler exactly as it was. A policy
+        replaces the queue with priority classes + per-tenant weighted
+        fair queuing, and (``preempt=True``) lets a higher-priority
+        arrival that cannot be admitted DISPLACE the lowest-priority
+        decodable slot: the victim's KV swaps out to host through the
+        stepper (``swap_out``), its pages free, and it re-queues at
+        the front of its class with the swap state riding the request;
+        resume is ``swap_in`` (restore + re-reserve), token-identical
+        across the boundary. ``max_preemptions`` bounds displacement
+        per request so nothing livelocks."""
+        from distkeras_tpu.serving.qos import _QosQueues
+
         self.stepper = stepper
         self.queue_capacity = int(queue_capacity)
         if self.queue_capacity < 1:
             raise ValueError("queue_capacity must be >= 1")
+        self.qos = qos
+        self._preemptible = qos is not None and qos.preempt and hasattr(
+            stepper, "swap_out"
+        )
         self.prefill_chunk = (
             None if prefill_chunk is None else int(prefill_chunk)
         )
@@ -277,7 +322,13 @@ class ContinuousBatcher:
         self.quarantine_steps = int(quarantine_steps)
         if self.quarantine_steps < 1:
             raise ValueError("quarantine_steps must be >= 1")
-        self._queue: collections.deque[ServeRequest] = collections.deque()
+        # the request queue: a plain FIFO deque, or (under a QoS
+        # policy) priority-classed per-tenant WFQ queues speaking the
+        # same deque face — head-of-line discipline is unchanged, only
+        # WHICH request is at the head becomes policy
+        self._queue = (
+            collections.deque() if qos is None else _QosQueues(qos)
+        )
         self._slots: list[ServeRequest | None] = [None] * stepper.num_slots
         # completion-group bookkeeping: which completion index each
         # slot serves (0 for singles and group primaries) and which
@@ -334,6 +385,17 @@ class ContinuousBatcher:
                 "spec_windows",  # slot-windows processed via verify
                 "spec_tokens",  # tokens emitted from verify windows
                 "spec_draft_accepted",  # emitted tokens DRAFT sourced
+                # multi-tenant QoS / preemption (0 without a policy).
+                # Pairing invariant at quiescence: preemptions ==
+                # resumes + swap_in_failures + swapped_failed — every
+                # swap-out ends in a resume or a TYPED failure, never
+                # a stranded request
+                "preemptions",  # successful swap-outs (victims)
+                "resumes",  # swapped requests restored + decoding
+                "preempt_aborted",  # swap-out failed; victim untouched
+                "swap_in_failures",  # restore failed; request typed
+                "swapped_failed",  # failed (stop/deadline) while out
+                "swapped_tokens",  # context tokens serialized to host
             ),
         )
         # occupancy gauges, computed at scrape time from state the
@@ -370,6 +432,25 @@ class ContinuousBatcher:
         self.forked_slots = self.registry.counter(
             "serving_forked_slots", fresh=True
         )
+        # per-tenant labeled counters (created lazily per tenant seen):
+        # serving_preemptions{tenant=}, serving_swapped_tokens{tenant=}
+        # — QoS violations must be ATTRIBUTABLE, not just counted.
+        # Cardinality-bounded: tenant is a client-chosen wire string,
+        # so past MAX_TENANT_LABELS distinct names the tail folds into
+        # one label instead of growing the registry forever
+        self._tenant_counters: dict[tuple, object] = {}
+        self._tenant_label_seen: set[str] = set()
+
+    def _tenant_counter(self, name: str, tenant: str):
+        from distkeras_tpu.serving.qos import fold_tenant
+
+        tenant = fold_tenant(self._tenant_label_seen, tenant)
+        key = (name, tenant)
+        c = self._tenant_counters.get(key)
+        if c is None:
+            c = self.registry.counter(name, labels={"tenant": tenant})
+            self._tenant_counters[key] = c
+        return c
 
     # -- submission ---------------------------------------------------------
 
@@ -448,6 +529,8 @@ class ContinuousBatcher:
         admitted = []
         paged = getattr(self.stepper, "paged", False)
         page_budget = self.stepper.available_pages if paged else None
+        blocked = None  # head-of-line candidate admission could not place
+        preempt = None
         with self._lock:
             self._sched_iters += 1
             for s, until in list(self._quarantined.items()):
@@ -458,7 +541,7 @@ class ContinuousBatcher:
                 if slot is None and i not in self._quarantined
             ]
             taken = 0
-            while taken < len(free):
+            while True:
                 req = self._pop_live(now)
                 if req is None:
                     break
@@ -469,6 +552,7 @@ class ContinuousBatcher:
                     # j identical to an independent derived-seed
                     # admission); head-of-line FIFO waits for evictions
                     self._queue.appendleft(req)
+                    blocked = req
                     break
                 if paged:
                     # admission reserves pages: gate on the pool, not
@@ -480,11 +564,13 @@ class ContinuousBatcher:
                     need = self._pages_for_request(req)
                     if need > page_budget:
                         self._queue.appendleft(req)
+                        blocked = req
                         break
                     page_budget -= need
                 group = free[taken:taken + req.n]
                 taken += req.n
-                req.started = now
+                if req.started is None:  # a resume keeps its stamps
+                    req.started = now
                 self._admit_seq += 1
                 for j, s in enumerate(group):
                     self._slots[s] = req
@@ -493,10 +579,24 @@ class ContinuousBatcher:
                     if j > 0:
                         self._awaiting_fork[s] = j
                 admitted.append((group[0], req))
+            if blocked is not None and self._preemptible:
+                # a higher-priority arrival blocked on capacity may
+                # displace the lowest-priority decodable slot — picked
+                # under the lock, swapped outside it (device fetch)
+                preempt = self._pick_victim_locked(blocked)
+        preempted = False
+        if preempt is not None:
+            preempted = self._preempt(*preempt)
         # device work outside the lock: submit() must never block on a
         # compile or a step (backpressure replies stay fast under load)
         began = []
         for i, req in admitted:
+            if req._swap is not None:
+                # a preempted request resuming: restore + re-reserve;
+                # the slot is decodable immediately (its prefill ran
+                # before the preemption)
+                self._resume(i, req)
+                continue
             try:
                 kw = {"max_new": req.max_new_tokens} if paged else {}
                 if req.sampling is not None:
@@ -519,7 +619,7 @@ class ContinuousBatcher:
                     self._prefill_fifo.append(i)
                 else:
                     req.prefill_finished = now
-        progressed = self._spend_prefill_budget()
+        progressed = self._spend_prefill_budget() or preempted
         progressed = self._fork_completions() or progressed
         now = time.monotonic()
         with self._lock:
@@ -661,6 +761,10 @@ class ContinuousBatcher:
                         )
                         break
                 emitted_total += emitted
+                if self.qos is not None and emitted:
+                    # WFQ service accounting: decode tokens actually
+                    # generated, normalized by the tenant's weight
+                    self._queue.charge(req.tenant, emitted)
                 if used_verify[i]:
                     self.counters["spec_windows"] += 1
                     self.counters["spec_tokens"] += emitted
@@ -682,6 +786,135 @@ class ContinuousBatcher:
                 blamed=blamed if blamed else None,
             )
         return True
+
+    # -- preemption by KV swap (multi-tenant QoS) ---------------------------
+
+    def _record_swap_error(self, op, slot, req, exc):
+        """The swap paths' sibling of the engine's
+        ``_record_prefix_error``: every swallowed swap/restore failure
+        leaves its EXCEPTION CLASS on the tape — a swap path failing
+        every call must not look identical to a quiet one from the
+        counters alone. Caller holds the lock."""
+        if self.recorder is not None:
+            self.recorder.record(
+                "qos.swap_error", op=op, slot=slot,
+                request_id=req.id, tenant=req.tenant,
+                error=type(exc).__name__, detail=repr(exc)[:200],
+            )
+
+    def _pick_victim_locked(self, blocked):
+        """The slot a blocked higher-priority arrival may displace:
+        DECODING (not mid-prefill, not part of a completion group),
+        strictly lower priority than ``blocked``, preemption budget
+        not exhausted (``qos.max_preemptions`` — the livelock bound:
+        a request displaced that many times becomes immune), and
+        short enough that its context row round-trips the swap.
+        Among candidates: lowest priority first, then fewest emitted
+        tokens (cheapest swap, least work parked). Caller holds the
+        lock. Returns ``(slot, request)`` or None."""
+        best = None
+        max_len = self.stepper.max_len
+        for i, req in enumerate(self._slots):
+            if req is None or i in self._prefill_left:
+                continue
+            if req.n > 1 or i in self._awaiting_fork:
+                continue  # completion groups are never preempted
+            if req.priority >= blocked.priority:
+                continue
+            if req.preemptions >= self.qos.max_preemptions:
+                continue  # immune: nothing livelocks
+            if req.prompt.size + len(req.tokens) >= max_len:
+                continue  # context cannot round-trip the prompt row
+            key = (req.priority, len(req.tokens), i)
+            if best is None or key < best[0]:
+                best = (key, i, req)
+        if best is None:
+            return None
+        return best[1], best[2]
+
+    def _preempt(self, slot, vreq) -> bool:
+        """Swap the victim out (device->host fetch OUTSIDE the lock,
+        like every other device call), free its slot and pages, and
+        re-queue it at the FRONT of its class with the swap state
+        riding the request. A failed swap-out ABORTS the preemption —
+        the ``kv.swap`` seam fires before any state changes, so the
+        victim keeps decoding untouched — and the recorder names the
+        exception class (a silently failing swap path must not look
+        like a quiet one)."""
+        try:
+            state = self.stepper.swap_out(slot)
+        except Exception as e:  # noqa: BLE001 — preemption is optional
+            with self._lock:
+                self.counters["preempt_aborted"] += 1
+                self._record_swap_error("swap_out", slot, vreq, e)
+            return False
+        with self._lock:
+            if self._slots[slot] is not vreq:
+                return False  # stopped/evicted underneath the fetch
+            vreq._swap = state
+            vreq.preemptions += 1
+            self.counters["preemptions"] += 1
+            self.counters["swapped_tokens"] += int(state["len"])
+            self._tenant_counter(
+                "serving_preemptions", vreq.tenant
+            ).inc()
+            self._tenant_counter(
+                "serving_swapped_tokens", vreq.tenant
+            ).inc(int(state["len"]))
+            self._slots[slot] = None
+            self.stepper.release(slot)  # pages freed; host state rides req
+            self._queue.appendleft(vreq)
+            if self.recorder is not None:
+                self.recorder.record(
+                    "qos.preempt", slot=slot, request_id=vreq.id,
+                    tenant=vreq.tenant, priority=vreq.priority,
+                    tokens=int(state["len"]),
+                    preemptions=vreq.preemptions,
+                )
+        self._work.set()
+        return True
+
+    def _resume(self, i, req):
+        """Swap a preempted request back in: re-reserve + restore
+        (``stepper.swap_in``); the slot is decodable immediately.
+        Failure semantics: a failed swap-in fails ONLY this request,
+        typed — a ``ServingError`` (notably ``PoolExhaustedError``)
+        passes through as itself so pool pressure stays retriable
+        ``overloaded``, anything else becomes ``internal`` — and the
+        recorder names the exception class. The scheduler never
+        wedges on a failed restore."""
+        import copy
+
+        try:
+            self.stepper.swap_in(
+                i, req._swap,
+                max_new=req.max_new_tokens - len(req.tokens),
+            )
+        except Exception as e:  # noqa: BLE001 — admission boundary
+            err = (
+                copy.copy(e)
+                if isinstance(e, ServingError)
+                else InternalError(
+                    f"swap-in failed for this request: {e!r}"
+                )
+            )
+            with self._lock:
+                self.counters["swap_in_failures"] += 1
+                self._record_swap_error("swap_in", i, req, e)
+                if self._slots[i] is req:
+                    self._evict(i, req, err)
+            return
+        with self._lock:
+            if self._slots[i] is not req:
+                return  # stopped underneath us
+            req._swap = None
+            self.counters["resumes"] += 1
+            if self.recorder is not None:
+                self.recorder.record(
+                    "qos.resume", slot=i, request_id=req.id,
+                    tenant=req.tenant, priority=req.priority,
+                    tokens=len(req.tokens),
+                )
 
     # -- blame assignment ----------------------------------------------------
 
@@ -964,6 +1197,10 @@ class ContinuousBatcher:
             req = self._queue.popleft()
             if req._expired(now):
                 self.counters["deadline_exceeded"] += 1
+                if req._swap is not None:
+                    # preemption pairing: a swapped request dying typed
+                    # in the queue is its swap-out's terminal partner
+                    self.counters["swapped_failed"] += 1
                 req._finish(
                     DeadlineExceededError("deadline expired in queue")
                 )
@@ -1029,7 +1266,14 @@ class ContinuousBatcher:
         with self._lock:
             self._draining = self._stopped = True
             while self._queue:
-                self._queue.popleft()._finish(fail())
+                req = self._queue.popleft()
+                if req._swap is not None:
+                    # a restart/stop racing a swapped-out request: the
+                    # typed failure below drops its host swap state
+                    # with it (pairing: preemptions == resumes +
+                    # swap_in_failures + swapped_failed)
+                    self.counters["swapped_failed"] += 1
+                req._finish(fail())
             self._prefill_left.clear()
             self._prefill_fifo.clear()
             self._awaiting_fork.clear()
@@ -1063,6 +1307,9 @@ class ContinuousBatcher:
                 "request_id": req.id,
                 "state": state,
                 "slot": slot,
+                "tenant": req.tenant,
+                "priority": req.priority,
+                "preemptions": req.preemptions,
                 "prompt_len": int(req.prompt.size),
                 "max_new_tokens": req.max_new_tokens,
                 "tokens_emitted": sum(len(c) for c in req.completions),
@@ -1072,7 +1319,10 @@ class ContinuousBatcher:
             }
 
         with self._lock:
-            out = [row(r, "queued") for r in self._queue]
+            out = [
+                row(r, "swapped" if r._swap is not None else "queued")
+                for r in self._queue
+            ]
             for i, req in enumerate(self._slots):
                 if req is None:
                     continue
@@ -1113,6 +1363,15 @@ class ContinuousBatcher:
         out["mean_batch_occupancy"] = (
             out["occupancy_sum"] / steps if steps else 0.0
         )
+        if self.qos is not None:
+            out["qos"] = {
+                "enabled": True,
+                "preempt": self.qos.preempt,
+                "max_preemptions": self.qos.max_preemptions,
+                "tenant_service": self._queue.service_snapshot(),
+            }
+        else:
+            out["qos"] = {"enabled": False}
         st = self.stepper
         if getattr(st, "speculative", False):
             drafted = int(getattr(st, "spec_drafted_tokens", 0))

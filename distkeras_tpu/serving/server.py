@@ -387,6 +387,10 @@ class ServingServer:
                 deadline=deadline,
                 trace=ctx,
                 sampling=sampling,
+                # QoS identity rides two optional header fields (absent
+                # = default tenant, priority 0 — the pre-QoS wire)
+                tenant=header.get("tenant"),
+                priority=int(header.get("priority") or 0),
             )
             seq = self.engine.wait(req)
         except ServingError as e:

@@ -826,6 +826,12 @@ def test_soak_serving_smoke(lm):
     assert summary["faults_fired"] > 0
     assert summary["fired_by_site"]["stepper.verify"] > 0
     assert summary["speculative"]["windows"] > 0
+    # the multi-tenant QoS bars: every preemption (KV swap-out) paired
+    # with a resume or a typed failure, and the page pool balanced at
+    # shutdown (no slot-held page, index clear empties the pool) —
+    # under the same chaos as everything else, kv.swap included
+    assert summary["qos"]["paired"], summary["qos"]
+    assert summary["paged"]["pool_balanced"], summary["paged"]
     # the soak serves the PAGED cache by default with kv.alloc armed:
     # the pool must be live and leak-free at the end (every page is
     # either free or held by the device prefix index — no slot holds)

@@ -1,0 +1,948 @@
+"""Multi-tenant QoS: priority classes, WFQ shares, preemption by KV
+swap, per-tenant admission quotas, and the loadgen workload harness.
+
+Four tiers, matching the subsystem's layering:
+
+- policy units: ``_QosQueues`` pop order (priority then WFQ virtual
+  time), share accounting under emission charges, ``TokenBucket``
+  grant/refuse arithmetic — pure host logic, no engines;
+- scheduler units on a fake swap-capable stepper: priority-ordered
+  admission, preemption victim selection, the per-request preemption
+  budget (the livelock bound), resume continuity, pairing counters;
+- device-face pins on the real ``DecodeStepper``: the preempt/resume
+  boundary is TOKEN-IDENTICAL to uninterrupted solo decode — greedy
+  and sampled, dense and paged — plus ``kv.swap`` chaos in both
+  directions (a failed swap-out aborts the preemption with the victim
+  untouched; a failed swap-in fails only the preempted request,
+  typed, with the page ledger balanced);
+- wire: router per-tenant token-bucket admission over real TCP
+  (typed retriable ``quota_exhausted`` with the refill hint), and the
+  loadgen harness's determinism contract.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.serving.qos import QosPolicy, TokenBucket, _QosQueues
+from distkeras_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    InternalError,
+    QuotaExhaustedError,
+    ServeRequest,
+)
+
+from test_serving import FakeStepper
+
+
+def _req(plen=3, max_new=4, tenant="default", priority=0, **kw):
+    return ServeRequest(
+        np.arange(1, plen + 1), max_new, tenant=tenant,
+        priority=priority, **kw,
+    )
+
+
+# --------------------------------------------------------- policy units
+
+
+def test_qos_queue_priority_ordering():
+    """Higher priority classes pop first, regardless of arrival order
+    or tenant service state."""
+    q = _QosQueues(QosPolicy())
+    lo = _req(tenant="a", priority=0)
+    hi = _req(tenant="b", priority=2)
+    mid = _req(tenant="a", priority=1)
+    q.append(lo)
+    q.append(hi)
+    q.append(mid)
+    assert q.popleft() is hi
+    assert q.popleft() is mid
+    assert q.popleft() is lo
+    assert len(q) == 0
+
+
+def test_qos_queue_wfq_order_follows_charges():
+    """Within one priority class, the tenant with the least normalized
+    service pops first; charges move the order, weights scale it (a
+    weight-3 tenant's tokens cost a third of a weight-1 tenant's)."""
+    q = _QosQueues(QosPolicy(weights={"heavy": 3.0, "light": 1.0}))
+    a = [_req(tenant="light") for _ in range(3)]
+    b = [_req(tenant="heavy") for _ in range(3)]
+    for r in a + b:
+        q.append(r)
+    # fresh tenants tie at vtime 0: name order breaks the tie
+    first = q.popleft()
+    assert first.tenant == "heavy"
+    q.charge("heavy", 9)  # 9 / weight 3 = 3.0 normalized
+    assert q.popleft().tenant == "light"
+    q.charge("light", 9)  # 9 / weight 1 = 9.0 > heavy's 3.0
+    assert q.popleft().tenant == "heavy"
+    assert q.service_snapshot() == {"heavy": 3.0, "light": 9.0}
+
+
+def test_qos_queue_appendleft_keeps_class_head():
+    """A pushed-back candidate (head-of-line wait, preemption requeue)
+    re-pops FIRST within its own class."""
+    q = _QosQueues(QosPolicy())
+    r1, r2 = _req(tenant="t"), _req(tenant="t")
+    q.append(r1)
+    q.append(r2)
+    head = q.popleft()
+    q.appendleft(head)
+    assert q.popleft() is head
+
+
+def test_qos_queue_idle_tenant_vtime_lags_to_floor():
+    """A tenant activating while others are BUSY starts at the current
+    virtual-time floor — it cannot burn 'savings' banked while
+    absent."""
+    q = _QosQueues(QosPolicy())
+    q.append(_req(tenant="busy"))
+    q.append(_req(tenant="busy"))
+    q.popleft()  # one still queued: the system never goes idle
+    q.charge("busy", 100)
+    late = _req(tenant="late")
+    q.append(late)
+    # late lags to the floor (busy's 100), so the next pop is a tie
+    # broken by name, not an infinite run of 'late'
+    assert q.service_snapshot()["late"] == 100.0
+
+
+def test_qos_queue_idle_reset_clears_service_debt():
+    """When the WHOLE system drains, virtual time restarts: fairness
+    after an idle period must not depend on arrival order (a
+    historically-busy tenant re-activating after a brand-new one
+    would otherwise inherit its lifetime debt and starve)."""
+    q = _QosQueues(QosPolicy())
+    q.append(_req(tenant="old"))
+    q.popleft()
+    q.charge("old", 10_000)
+    assert len(q) == 0  # fully idle
+    q.append(_req(tenant="new"))  # first arrival after idle: reset
+    q.append(_req(tenant="old"))
+    snap = q.service_snapshot()
+    assert snap.get("old", 0.0) == 0.0  # debt forgiven at idle
+    assert snap.get("new", 0.0) == 0.0
+
+
+def test_tenant_label_cardinality_is_bounded():
+    """tenant rides the unauthenticated wire header: past
+    MAX_TENANT_LABELS distinct names, new tenants fold into the
+    OTHER_TENANTS label instead of growing the registry forever."""
+    from distkeras_tpu.serving.qos import (
+        MAX_TENANT_LABELS,
+        OTHER_TENANTS,
+        fold_tenant,
+    )
+
+    seen: set = set()
+    for i in range(MAX_TENANT_LABELS):
+        assert fold_tenant(seen, f"t{i}") == f"t{i}"
+    assert fold_tenant(seen, "attacker") == OTHER_TENANTS
+    assert fold_tenant(seen, "t0") == "t0"  # known names keep theirs
+    assert len(seen) == MAX_TENANT_LABELS
+
+
+def test_token_bucket_accepts_sub_unit_rates():
+    """One request per N seconds is a legitimate quota: a defaulted
+    burst floors at 1 instead of rejecting rate < 1."""
+    clock = [0.0]
+    b = TokenBucket(rate=0.5, clock=lambda: clock[0])
+    assert b.burst == 1.0
+    assert b.take() == 0.0
+    assert b.take() == pytest.approx(2.0)  # refill time for 1 token
+
+
+def test_wfq_shares_converge_to_weights():
+    """Saturated two-tenant traffic through a 1-slot bank splits
+    admissions ~ by weight once emission charges accumulate."""
+    st = FakeStepper(num_slots=1)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(weights={"a": 1.0, "b": 3.0}),
+        queue_capacity=64,
+    )
+    reqs = []
+    for i in range(8):
+        for t in ("a", "b"):
+            r = _req(plen=2, max_new=4, tenant=t)
+            reqs.append(r)
+            bat.submit(r)
+    served = []
+    for _ in range(200):
+        bat.step()
+        for r in reqs:
+            if r.done and r not in served:
+                served.append(r)
+        if len(served) == len(reqs):
+            break
+    assert len(served) == len(reqs)
+    first_half = [r.tenant for r in served[: len(served) // 2]]
+    # b (weight 3) dominates the early admissions ~3:1
+    assert first_half.count("b") >= 2 * first_half.count("a")
+
+
+def test_token_bucket_grant_refuse_and_refill():
+    clock = [0.0]
+    b = TokenBucket(rate=2.0, burst=2.0, clock=lambda: clock[0])
+    assert b.take() == 0.0
+    assert b.take() == 0.0
+    wait = b.take()
+    assert wait == pytest.approx(0.5)  # 1 token / 2 per s
+    clock[0] += 0.5
+    assert b.take() == 0.0  # refilled exactly one
+    assert b.take() > 0.0
+
+
+def test_as_bucket_spec_coercions():
+    from distkeras_tpu.serving.qos import as_bucket
+
+    assert as_bucket(None) is None
+    assert as_bucket(5.0).rate == 5.0
+    assert as_bucket({"rate": 2, "burst": 7}).burst == 7.0
+    assert as_bucket((3, 9)).burst == 9.0
+    b = TokenBucket(1.0)
+    assert as_bucket(b) is b
+
+
+def test_quota_exhausted_error_is_typed_retriable():
+    e = QuotaExhaustedError("t over quota", retry_after_ms=123.0)
+    assert e.code == "quota_exhausted"
+    assert e.retry_after == pytest.approx(0.123)
+    from distkeras_tpu.serving.scheduler import OverloadedError
+
+    assert isinstance(e, OverloadedError)  # clients auto-retry it
+
+
+# ------------------------------------------- scheduler units (fake swap)
+
+
+class FakeSwapStepper(FakeStepper):
+    """Swap-capable fake: slot streams are a pure function of a
+    per-request counter carried through the swap state, so a resumed
+    stream continues exactly where it left off (the fake's version of
+    the token-identity pin) and every swap direction is observable."""
+
+    def __init__(self, num_slots=2, max_len=32, base=1000):
+        super().__init__(num_slots, max_len, base)
+        self.swapped_out = []  # slot per swap_out
+        self.swapped_in = []  # slot per swap_in
+        self.fail_swap_out = False
+        self.fail_swap_in = False
+
+    def step(self, active):
+        toks = np.full(self.num_slots, -1)
+        for i in np.flatnonzero(active):
+            self._n[i] += 1
+            toks[i] = self.base + self._n[i]  # slot-INDEPENDENT stream
+        return toks
+
+    def swap_out(self, slot):
+        if self.fail_swap_out:
+            raise RuntimeError("injected swap-out failure")
+        self.swapped_out.append(slot)
+        return {"len": int(self._n[slot]) + 1, "n": int(self._n[slot])}
+
+    def swap_in(self, slot, state, max_new=None):
+        if self.fail_swap_in:
+            raise RuntimeError("injected swap-in failure")
+        self.swapped_in.append(slot)
+        self._n[slot] = state["n"]
+        self._left[slot] = 0
+
+
+def _drain(bat, reqs, iters=300):
+    for _ in range(iters):
+        bat.step()
+        if all(r.done for r in reqs):
+            return
+    raise AssertionError(
+        f"requests still pending: "
+        f"{[(r.id, r.done) for r in reqs]}"
+    )
+
+
+def test_priority_admission_order():
+    """With the bank full, a later high-priority submit is admitted
+    before earlier low-priority queue residents."""
+    st = FakeSwapStepper(num_slots=1)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=False), queue_capacity=16
+    )
+    running = _req(plen=2, max_new=6, tenant="a", priority=1)
+    lo = _req(plen=2, max_new=2, tenant="a", priority=0)
+    hi = _req(plen=2, max_new=2, tenant="b", priority=2)
+    bat.submit(running)
+    bat.step()  # running admitted
+    bat.submit(lo)
+    bat.submit(hi)
+    _drain(bat, [running, lo, hi])
+    assert hi.finished < lo.finished  # hi jumped the queue
+    assert st.swapped_out == []  # preempt=False: ordering only
+
+
+def test_preemption_victim_selection_lowest_priority_fewest_tokens():
+    """Victim = the lowest-priority decodable slot; ties break toward
+    the fewest emitted tokens (cheapest swap)."""
+    st = FakeSwapStepper(num_slots=2)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True, max_preemptions=2),
+        queue_capacity=16,
+    )
+    a = _req(plen=2, max_new=8, tenant="a", priority=1)
+    b = _req(plen=2, max_new=8, tenant="b", priority=0)
+    bat.submit(a)
+    bat.step()
+    bat.submit(b)
+    bat.step()  # both decoding; b has fewer tokens AND lower priority
+    slot_of_b = next(
+        i for i, r in enumerate(bat._slots) if r is b
+    )
+    hi = _req(plen=2, max_new=2, tenant="c", priority=2)
+    bat.submit(hi)
+    for _ in range(4):
+        bat.step()
+        if st.swapped_out:
+            break
+    assert st.swapped_out == [slot_of_b]
+    assert b.preemptions == 1
+    _drain(bat, [a, b, hi])
+    assert b.error is None and len(b.tokens) == 8
+    s = bat.stats()
+    assert s["preemptions"] == 1 and s["resumes"] == 1
+    assert s["qos"]["enabled"] is True
+
+
+def test_preemption_budget_bounds_displacement():
+    """A request preempted ``max_preemptions`` times becomes IMMUNE:
+    later high-priority arrivals wait instead of livelocking it."""
+    st = FakeSwapStepper(num_slots=1)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True, max_preemptions=1),
+        queue_capacity=16,
+    )
+    lo = _req(plen=2, max_new=10, tenant="a", priority=0)
+    bat.submit(lo)
+    bat.step()
+    hi1 = _req(plen=2, max_new=2, tenant="b", priority=2)
+    bat.submit(hi1)
+    for _ in range(3):
+        bat.step()
+        if lo.preemptions:
+            break
+    assert lo.preemptions == 1
+    # while lo decodes again, a second hi arrival must NOT displace it
+    _drain(bat, [hi1])
+    for _ in range(30):
+        bat.step()
+        if lo._swap is None and not lo.done and any(
+            r is lo for r in bat._slots
+        ):
+            break
+    hi2 = _req(plen=2, max_new=2, tenant="b", priority=2)
+    bat.submit(hi2)
+    _drain(bat, [lo, hi2])
+    assert lo.preemptions == 1  # the budget held
+    assert bat.stats()["preemptions"] == 1
+
+
+def test_failed_swap_out_aborts_preemption_victim_untouched():
+    st = FakeSwapStepper(num_slots=1)
+    st.fail_swap_out = True
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=16
+    )
+    lo = _req(plen=2, max_new=4, tenant="a", priority=0)
+    bat.submit(lo)
+    bat.step()
+    hi = _req(plen=2, max_new=2, tenant="b", priority=2)
+    bat.submit(hi)
+    _drain(bat, [lo, hi])
+    assert lo.error is None and hi.error is None
+    assert lo.preemptions == 0
+    s = bat.stats()
+    assert s["preemptions"] == 0 and s["preempt_aborted"] >= 1
+
+
+def test_failed_swap_in_fails_only_the_preempted_request_typed():
+    st = FakeSwapStepper(num_slots=1)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=16
+    )
+    lo = _req(plen=2, max_new=6, tenant="a", priority=0)
+    bat.submit(lo)
+    bat.step()
+    st.fail_swap_in = True
+    hi = _req(plen=2, max_new=2, tenant="b", priority=2)
+    bat.submit(hi)
+    _drain(bat, [lo, hi])
+    assert hi.error is None
+    with pytest.raises(InternalError, match="swap-in failed"):
+        lo.result(0)
+    s = bat.stats()
+    assert s["preemptions"] == 1 and s["swap_in_failures"] == 1
+    assert s["preemptions"] == (
+        s["resumes"] + s["swap_in_failures"] + s["swapped_failed"]
+    )
+    # the scheduler still serves after the failed restore
+    nxt = _req(plen=2, max_new=2)
+    bat.submit(nxt)
+    _drain(bat, [nxt])
+    assert nxt.error is None
+
+
+def test_stop_racing_swapped_request_fails_it_typed_and_counted():
+    """A watchdog restart (batcher.stop) racing a swapped-out request
+    fails it TYPED and drops its host swap state — the pairing
+    counters still balance."""
+    st = FakeSwapStepper(num_slots=1)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=16
+    )
+    lo = _req(plen=2, max_new=8, tenant="a", priority=0)
+    bat.submit(lo)
+    bat.step()
+    hi = _req(plen=2, max_new=8, tenant="b", priority=2)
+    bat.submit(hi)
+    for _ in range(4):
+        bat.step()
+        if lo._swap is not None:
+            break
+    assert lo._swap is not None  # parked off-device
+    bat.stop(error=InternalError("restart"))
+    with pytest.raises(InternalError):
+        lo.result(0)
+    assert lo._swap is None  # host state dropped with the request
+    s = bat.stats()
+    assert s["swapped_failed"] == 1
+    assert s["preemptions"] == (
+        s["resumes"] + s["swap_in_failures"] + s["swapped_failed"]
+    )
+
+
+def test_inflight_snapshot_carries_tenant_and_swapped_state():
+    st = FakeSwapStepper(num_slots=1)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=16
+    )
+    lo = _req(plen=2, max_new=8, tenant="acme", priority=0)
+    bat.submit(lo)
+    bat.step()
+    hi = _req(plen=2, max_new=8, tenant="live", priority=2)
+    bat.submit(hi)
+    for _ in range(4):
+        bat.step()
+        if lo._swap is not None:
+            break
+    rows = {r["request_id"]: r for r in bat.inflight_snapshot()}
+    assert rows[lo.id]["tenant"] == "acme"
+    assert rows[lo.id]["state"] == "swapped"
+    assert rows[lo.id]["preemptions"] == 1
+    assert rows[hi.id]["tenant"] == "live"
+    assert rows[hi.id]["priority"] == 2
+    bat.stop()
+
+
+def test_per_tenant_preemption_counters_labeled():
+    st = FakeSwapStepper(num_slots=1)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=16
+    )
+    lo = _req(plen=2, max_new=6, tenant="acme", priority=0)
+    bat.submit(lo)
+    bat.step()
+    hi = _req(plen=2, max_new=2, tenant="live", priority=2)
+    bat.submit(hi)
+    _drain(bat, [lo, hi])
+    samples = {
+        (s["name"], s["labels"].get("tenant")): s
+        for s in bat.registry.snapshot()
+    }
+    assert samples[("serving_preemptions", "acme")]["value"] == 1
+    assert samples[("serving_swapped_tokens", "acme")]["value"] >= 1
+
+
+# ------------------------------------- device-face identity pins (real)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_ref(lm):
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+
+    return CachedSequenceGenerator(lm)
+
+
+def _preempted_run(lm, paged, sampling=None, lo_new=10, hi_new=4):
+    """Drive a 1-slot batcher so the low-priority request is preempted
+    mid-decode by a high-priority arrival, then both complete.
+    Returns (lo_request, hi_request, batcher_stats)."""
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    rng = np.random.default_rng(7)
+    p_lo = rng.integers(0, 61, 7).astype(np.int32)
+    p_hi = rng.integers(0, 61, 5).astype(np.int32)
+    st = DecodeStepper(lm, num_slots=1, paged=paged, page_size=4)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True, max_preemptions=3),
+        queue_capacity=8,
+    )
+    lo = ServeRequest(p_lo, lo_new, tenant="batch", priority=0,
+                      sampling=sampling)
+    hi = ServeRequest(p_hi, hi_new, tenant="live", priority=2)
+    bat.submit(lo)
+    for _ in range(30):
+        bat.step()
+        if len(lo.tokens) >= 3:
+            break
+    assert len(lo.tokens) >= 3
+    bat.submit(hi)
+    for _ in range(120):
+        bat.step()
+        if lo.done and hi.done:
+            break
+    assert lo.done and hi.done
+    stats = bat.stats()
+    assert stats["preemptions"] >= 1, "preemption never fired"
+    return lo, hi, (st, stats)
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_preempt_resume_greedy_token_identity(lm, lm_ref, paged):
+    """ACCEPTANCE: a greedy stream preempted mid-decode (KV swapped to
+    host, pages freed, restored later) equals its uninterrupted solo
+    decode token for token — on the dense bank and the paged pool."""
+    lo, hi, (st, stats) = _preempted_run(lm, paged)
+    np.testing.assert_array_equal(
+        lo.result(1), lm_ref.generate(lo.prompt[None], steps=10)[0]
+    )
+    np.testing.assert_array_equal(
+        hi.result(1), lm_ref.generate(hi.prompt[None], steps=4)[0]
+    )
+    assert stats["resumes"] == stats["preemptions"]
+    if paged:
+        assert not {p for t in st._tables for p in t}  # all released
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_preempt_resume_sampled_token_identity(lm, paged):
+    """ACCEPTANCE: a SAMPLED stream crosses the preempt/resume
+    boundary replay-exact — the position-keyed RNG resumes at the
+    saved emitted-token counter, so the post-resume draws equal the
+    uninterrupted ones."""
+    from distkeras_tpu.serving import SamplingParams
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    sp = SamplingParams(temperature=0.8, seed=42)
+    # the uninterrupted reference: same params through a solo batcher
+    rng = np.random.default_rng(7)
+    p_lo = rng.integers(0, 61, 7).astype(np.int32)
+    st = DecodeStepper(lm, num_slots=1, paged=paged, page_size=4)
+    bat = ContinuousBatcher(st, queue_capacity=8)
+    solo = ServeRequest(p_lo, 10, sampling=sp)
+    bat.submit(solo)
+    while not solo.done:
+        bat.step()
+    want = solo.result(1)
+    lo, _, _ = _preempted_run(lm, paged, sampling=sp)
+    np.testing.assert_array_equal(want, lo.result(1))
+
+
+@pytest.mark.chaos
+def test_kv_swap_chaos_out_and_in(lm, lm_ref):
+    """ACCEPTANCE (kv.swap): injected swap faults never hang a
+    request, never produce an untyped error, and never leak a page —
+    swap-out failure aborts the preemption (victim completes pinned),
+    swap-in failure fails only the preempted request typed while the
+    pool ledger stays balanced."""
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    rng = np.random.default_rng(3)
+    p_lo = rng.integers(0, 61, 7).astype(np.int32)
+    p_hi = rng.integers(0, 61, 5).astype(np.int32)
+
+    # direction=out: preemption aborted, everyone completes pinned
+    st = DecodeStepper(lm, num_slots=1, paged=True, page_size=4)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=8
+    )
+    lo = ServeRequest(p_lo, 8, tenant="b", priority=0)
+    hi = ServeRequest(p_hi, 4, tenant="i", priority=2)
+    plan = FaultPlan(seed=0).arm(
+        "kv.swap", times=None,
+        when=lambda ctx: ctx.get("direction") == "out",
+    )
+    bat.submit(lo)
+    for _ in range(30):
+        bat.step()
+        if len(lo.tokens) >= 2:
+            break
+    bat.submit(hi)
+    with plan:
+        for _ in range(120):
+            bat.step()
+            if lo.done and hi.done:
+                break
+    assert lo.done and hi.done
+    np.testing.assert_array_equal(
+        lo.result(1), lm_ref.generate(p_lo[None], steps=8)[0]
+    )
+    np.testing.assert_array_equal(
+        hi.result(1), lm_ref.generate(p_hi[None], steps=4)[0]
+    )
+    s = bat.stats()
+    assert s["preemptions"] == 0 and s["preempt_aborted"] >= 1
+    assert plan.fired("kv.swap") >= 1
+
+    # direction=in: the preempted request fails TYPED, the high-
+    # priority one completes, no page leaks, the scheduler lives
+    st = DecodeStepper(lm, num_slots=1, paged=True, page_size=4)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=8
+    )
+    lo = ServeRequest(p_lo, 8, tenant="b", priority=0)
+    hi = ServeRequest(p_hi, 4, tenant="i", priority=2)
+    plan = FaultPlan(seed=0).arm(
+        "kv.swap", times=1,
+        when=lambda ctx: ctx.get("direction") == "in",
+    )
+    bat.submit(lo)
+    for _ in range(30):
+        bat.step()
+        if len(lo.tokens) >= 2:
+            break
+    bat.submit(hi)
+    with plan:
+        for _ in range(120):
+            bat.step()
+            if lo.done and hi.done:
+                break
+    assert lo.done and hi.done
+    np.testing.assert_array_equal(
+        hi.result(1), lm_ref.generate(p_hi[None], steps=4)[0]
+    )
+    with pytest.raises(InternalError, match="swap-in failed"):
+        lo.result(0)
+    s = bat.stats()
+    assert s["preemptions"] == 1 and s["swap_in_failures"] == 1
+    assert s["preemptions"] == (
+        s["resumes"] + s["swap_in_failures"] + s["swapped_failed"]
+    )
+    assert not {p for t in st._tables for p in t}  # ledger balanced
+    # the recorder-equivalent: a fresh request still serves
+    nxt = ServeRequest(p_hi, 3)
+    bat.submit(nxt)
+    while not nxt.done:
+        bat.step()
+    np.testing.assert_array_equal(
+        nxt.result(1), lm_ref.generate(p_hi[None], steps=3)[0]
+    )
+
+
+def test_qos_swap_error_recorder_events_name_exception_class(lm):
+    """The silent-degrade audit: a swallowed swap failure (either
+    direction) lands a ``qos.swap_error`` recorder event naming the
+    exception CLASS — a failing swap path must be distinguishable
+    from a quiet one on the tape alone."""
+    from distkeras_tpu.faults import FaultPlan, InjectedFault
+    from distkeras_tpu.obs import FlightRecorder
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    del InjectedFault  # the class name asserted below
+    rng = np.random.default_rng(3)
+    p_lo = rng.integers(0, 61, 7).astype(np.int32)
+    p_hi = rng.integers(0, 61, 5).astype(np.int32)
+    rec = FlightRecorder(capacity=256)
+    st = DecodeStepper(lm, num_slots=1, paged=True, page_size=4)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=8,
+        recorder=rec,
+    )
+    lo = ServeRequest(p_lo, 8, tenant="b", priority=0)
+    hi = ServeRequest(p_hi, 4, tenant="i", priority=2)
+    plan = FaultPlan(seed=0).arm(
+        "kv.swap", times=1,
+        when=lambda ctx: ctx.get("direction") == "out",
+    )
+    bat.submit(lo)
+    for _ in range(30):
+        bat.step()
+        if len(lo.tokens) >= 2:
+            break
+    bat.submit(hi)
+    with plan:
+        for _ in range(120):
+            bat.step()
+            if lo.done and hi.done:
+                break
+    events = [
+        e for e in rec.snapshot() if e["kind"] == "qos.swap_error"
+    ]
+    assert events, "no qos.swap_error event on the tape"
+    assert events[0]["error"] == "InjectedFault"
+    assert events[0]["op"] == "swap_out"
+
+
+def test_qos_preempt_and_resume_recorder_events_pair(lm):
+    from distkeras_tpu.obs import FlightRecorder
+    from distkeras_tpu.serving.engine import DecodeStepper
+
+    rng = np.random.default_rng(3)
+    p_lo = rng.integers(0, 61, 7).astype(np.int32)
+    p_hi = rng.integers(0, 61, 5).astype(np.int32)
+    rec = FlightRecorder(capacity=256)
+    st = DecodeStepper(lm, num_slots=1, paged=True, page_size=4)
+    bat = ContinuousBatcher(
+        st, qos=QosPolicy(preempt=True), queue_capacity=8,
+        recorder=rec,
+    )
+    lo = ServeRequest(p_lo, 8, tenant="b", priority=0)
+    hi = ServeRequest(p_hi, 4, tenant="i", priority=2)
+    bat.submit(lo)
+    for _ in range(30):
+        bat.step()
+        if len(lo.tokens) >= 2:
+            break
+    bat.submit(hi)
+    for _ in range(120):
+        bat.step()
+        if lo.done and hi.done:
+            break
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert kinds.count("qos.preempt") == kinds.count("qos.resume") >= 1
+    pre = next(
+        e for e in rec.snapshot() if e["kind"] == "qos.preempt"
+    )
+    assert pre["tenant"] == "b" and pre["request_id"] == lo.id
+
+
+# ----------------------------------------------------- router quota e2e
+
+
+def test_router_tenant_quota_e2e_over_tcp(lm):
+    """Per-tenant admission at the fleet door: the throttled tenant's
+    burst is refused typed retriable ``quota_exhausted`` (with the
+    bucket's refill hint), the unthrottled tenant sails through, and
+    the rejection counters are tenant-labeled."""
+    from distkeras_tpu.serving import (
+        FleetRouter,
+        ServingClient,
+        ServingEngine,
+        ServingError,
+        ServingServer,
+    )
+
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False, watchdog_interval=30.0
+    )
+    srv = ServingServer(eng).start()
+    router = FleetRouter(
+        endpoints=[(srv.host, srv.port)],
+        tenant_quotas={"noisy": {"rate": 0.001, "burst": 2}},
+    ).start()
+    try:
+        assert router.wait_in_rotation((srv.host, srv.port))
+        prompt = np.arange(1, 6, dtype=np.int32)
+        with ServingClient(
+            "127.0.0.1", router.port, retry=False
+        ) as c:
+            # two grants from the burst, then the typed refusal
+            c.generate(prompt, 3, tenant="noisy")
+            c.generate(prompt, 3, tenant="noisy")
+            with pytest.raises(ServingError) as ei:
+                c.generate(prompt, 3, tenant="noisy")
+            assert ei.value.code == "quota_exhausted"
+            assert ei.value.retry_after > 0  # the honest refill hint
+            # an unthrottled tenant is untouched by the noisy one
+            out = c.generate(prompt, 3, tenant="quiet")
+            assert out.size == prompt.size + 3
+        st = router.stats()
+        assert st["quota_rejections"] == 1
+        labeled = {
+            (s["name"], s["labels"].get("tenant")): s["value"]
+            for s in router.registry.snapshot()
+            if s["kind"] == "counter"
+        }
+        assert labeled[("serving_quota_rejections", "noisy")] == 1
+        kinds = [e["kind"] for e in router.recorder.snapshot()]
+        assert "qos.quota_reject" in kinds
+    finally:
+        router.shutdown()
+        srv.shutdown()
+
+
+def test_tenant_priority_ride_the_wire_to_the_scheduler(lm):
+    """Client -> server -> scheduler: the header fields land on the
+    ServeRequest (visible through the inflight snapshot's tenant
+    column after completion via per-tenant latency histograms)."""
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+
+    eng = ServingEngine(
+        lm, num_slots=2, prefix_cache=False, watchdog_interval=30.0
+    )
+    srv = ServingServer(eng).start()
+    try:
+        prompt = np.arange(1, 6, dtype=np.int32)
+        with ServingClient("127.0.0.1", srv.port) as c:
+            c.generate(prompt, 3, tenant="acme", priority=2)
+        names = {
+            (s["name"], s["labels"].get("tenant"))
+            for s in eng.metrics_snapshot()
+        }
+        assert ("serving_request_total_seconds", "acme") in names
+    finally:
+        srv.shutdown()
+
+
+def test_per_tenant_slo_specs_grade_labeled_series():
+    from distkeras_tpu.obs import default_serving_slos, evaluate_slos
+
+    samples = [
+        {"name": "serving_request_total_seconds", "kind": "histogram",
+         "labels": {}, "count": 50, "sum": 1.0,
+         "buckets": [[0.05, 50], ["+Inf", 50]]},
+        {"name": "serving_request_total_seconds", "kind": "histogram",
+         "labels": {"tenant": "slow"}, "count": 50, "sum": 25.0,
+         "buckets": [[0.05, 0], [0.8, 50], ["+Inf", 50]]},
+    ]
+    specs = default_serving_slos(
+        latency_p99_s=1.0, tenant_latency_p99_s={"slow": 0.1},
+        min_count=10,
+    )
+    v = evaluate_slos(samples, specs)
+    assert v["slo"] == "breach"
+    assert v["violations"][0]["name"] == "latency_p99[slow]"
+
+
+# ------------------------------------------------- loadgen determinism
+
+
+def _loadgen():
+    import os
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+    return loadgen
+
+
+def test_loadgen_trace_is_seed_deterministic():
+    lg = _loadgen()
+    kw = dict(
+        process="bursty", rate=40.0, n=30, vocab=61, seed=5,
+        tenants=[
+            {"name": "a", "weight": 1, "priority": 0,
+             "prompt_len": (2, 9), "steps": (2, 6)},
+            {"name": "b", "weight": 2, "priority": 2,
+             "prompt_len": (3, 7), "steps": (3, 8)},
+        ],
+    )
+    t1, t2 = lg.make_trace(**kw), lg.make_trace(**kw)
+    assert len(t1) == len(t2) == 30
+    for a, b in zip(t1, t2):
+        assert a["t"] == b["t"] and a["tenant"] == b["tenant"]
+        assert np.array_equal(a["prompt"], b["prompt"])
+        assert a["steps"] == b["steps"]
+    t3 = lg.make_trace(**{**kw, "seed": 6})
+    assert any(
+        not np.array_equal(a["prompt"], b["prompt"])
+        for a, b in zip(t1, t3)
+    )
+
+
+def test_loadgen_processes_and_roundtrip():
+    lg = _loadgen()
+    for proc in ("poisson", "bursty", "diurnal", "heavy_tail"):
+        tr = lg.make_trace(process=proc, rate=50.0, duration=2.0,
+                           vocab=61, seed=1)
+        ts = [ev["t"] for ev in tr]
+        assert ts == sorted(ts) and all(0 <= t < 2.0 for t in ts)
+        assert len(tr) > 10, proc  # ~100 expected events
+    tr = lg.make_trace(process="heavy_tail", rate=30.0, n=20,
+                       vocab=61, seed=2)
+    rt = lg.trace_from_jsonable(lg.trace_to_jsonable(tr))
+    for a, b in zip(tr, rt):
+        assert np.array_equal(a["prompt"], b["prompt"])
+        assert a["tenant"] == b["tenant"]
+    s = lg.summarize(tr)
+    assert s["events"] == 20 and "default" in s["tenants"]
+
+
+def test_loadgen_rejects_bad_specs():
+    lg = _loadgen()
+    with pytest.raises(ValueError):
+        lg.arrivals("poisson", 0.0, n=5)
+    with pytest.raises(ValueError):
+        lg.arrivals("heavy_tail", 5.0, n=5, alpha=1.0)
+    with pytest.raises(ValueError):
+        lg.arrivals("martian", 5.0, n=5)
+    with pytest.raises(ValueError):
+        lg.make_trace(n=5, tenants=[{"name": "x", "weight": 0}])
+
+
+# ------------------------------------------------------ engine-level e2e
+
+
+def test_engine_qos_end_to_end_priority_wins_under_saturation(lm, lm_ref):
+    """Through the real engine + scheduler thread: with the bank
+    saturated by low-priority work, a high-priority request finishes
+    far sooner than FIFO order would allow, everything stays pinned,
+    and the preemption counters pair."""
+    from distkeras_tpu.serving import ServingEngine
+
+    eng = ServingEngine(
+        lm, num_slots=1, prefix_cache=False, paged=True, page_size=4,
+        qos=QosPolicy(preempt=True, max_preemptions=2),
+        watchdog_interval=30.0,
+    ).start()
+    try:
+        rng = np.random.default_rng(11)
+        prompts = [
+            rng.integers(0, 61, 6).astype(np.int32) for _ in range(3)
+        ]
+        eng.generate(prompts[0], 2)  # warm the programs
+        los = [
+            eng.submit(p, 8, tenant="batch", priority=0)
+            for p in prompts
+        ]
+        time.sleep(0.05)  # let the first admission start decoding
+        hi = eng.submit(prompts[0], 3, tenant="live", priority=2)
+        out_hi = eng.wait(hi, timeout=60)
+        outs = [eng.wait(h, timeout=60) for h in los]
+        np.testing.assert_array_equal(
+            out_hi, lm_ref.generate(prompts[0][None], steps=3)[0]
+        )
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(
+                o, lm_ref.generate(p[None], steps=8)[0]
+            )
+        s = eng.batcher.stats()
+        assert hi.finished <= max(r.finished for r in los)
+        assert s["preemptions"] == (
+            s["resumes"] + s["swap_in_failures"] + s["swapped_failed"]
+        )
+    finally:
+        eng.stop()

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Trace-driven workload generator for the serving benches and soaks.
+
+Every serving bench so far hand-rolled its arrival schedule (one
+`rng.exponential` per workload) — scenario diversity lived in one-off
+bench configs. This module makes the WORKLOAD a first-class, seeded,
+replayable object: a trace is a list of events ``{"t", "tenant",
+"priority", "prompt", "steps"}`` drawn from
+
+- an **arrival process** — how load arrives over time:
+
+  * ``poisson``     — memoryless at ``rate`` req/s (the classic
+    open-loop baseline);
+  * ``bursty``      — an on/off modulated Poisson: ``duty`` of every
+    ``period`` seconds runs at ``rate * burst_factor``, the rest at a
+    trickle (the noisy-neighbour shape QoS admission exists for);
+  * ``diurnal``     — sinusoidally modulated Poisson (``amplitude``
+    swing over ``period`` seconds): the day/night ramp an autoscaler
+    and a quota policy both have to ride;
+  * ``heavy_tail``  — Pareto(``alpha``) inter-arrivals with mean
+    ``1/rate``: arrivals cluster, gaps stretch (the self-similar
+    traffic real serving logs show, not smooth Poisson);
+
+- a **tenant mix** — each tenant a dict of ``name``, ``weight``
+  (traffic share), ``priority`` (QoS class), ``prompt_len`` and
+  ``steps`` ranges — so one trace carries an interactive tenant's
+  short urgent requests interleaved with a batch tenant's long
+  low-priority ones.
+
+Determinism is the contract: the same ``(spec, seed)`` produces the
+identical trace, event for event (``numpy.default_rng(seed)`` is the
+only entropy), so a bench A/B drives BOTH sides with one trace and a
+failing soak replays exactly. ``trace_to_jsonable``/
+``trace_from_jsonable`` round-trip a trace through JSON for archival.
+
+Usage (summary of a trace, as JSON)::
+
+    python tools/loadgen.py --process bursty --rate 50 --duration 10 \
+        --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+import numpy as np
+
+DEFAULT_TENANTS = (
+    {"name": "default", "weight": 1.0, "priority": 0,
+     "prompt_len": (4, 64), "steps": (8, 32)},
+)
+
+
+def _rate_fn(process: str, rate: float, *, burst_factor=8.0,
+             period=1.0, duty=0.2, amplitude=0.8, floor_frac=0.05):
+    """The instantaneous-rate function r(t) of a modulated process
+    (None for processes that do not thin a Poisson stream)."""
+    if process == "poisson":
+        return lambda t: rate
+    if process == "bursty":
+        # duty * period seconds of burst at rate*burst_factor, the
+        # rest at whatever off-rate keeps the MEAN near ``rate`` —
+        # floored at a trickle when duty*burst_factor already exceeds
+        # the budget (then the mean runs hot; the burst IS the point)
+        hi = rate * burst_factor
+        lo = max(rate * floor_frac,
+                 rate * (1 - duty * burst_factor) / max(1e-9, 1 - duty))
+        return lambda t: hi if (t % period) < duty * period else lo
+    if process == "diurnal":
+        return lambda t: max(
+            rate * floor_frac,
+            rate * (1 + amplitude * math.sin(2 * math.pi * t / period)),
+        )
+    raise ValueError(f"unknown arrival process {process!r}")
+
+
+def arrivals(process: str, rate: float, *, duration=None, n=None,
+             seed=0, alpha=1.5, **kw) -> np.ndarray:
+    """Arrival instants (seconds from 0, ascending) for ``process`` at
+    mean ``rate`` req/s — bounded by ``duration`` seconds or ``n``
+    events (at least one required). Seeded and deterministic."""
+    if duration is None and n is None:
+        raise ValueError("need duration= or n=")
+    rate = float(rate)
+    if rate <= 0:
+        raise ValueError(f"rate must be > 0; got {rate}")
+    rng = np.random.default_rng(seed)
+    out = []
+    t = 0.0
+    if process == "heavy_tail":
+        # classical Pareto inter-arrivals with mean 1/rate: gaps
+        # cluster then stretch (alpha -> 1 = heavier tail; needs
+        # alpha > 1 for the mean to exist)
+        if alpha <= 1.0:
+            raise ValueError(f"heavy_tail needs alpha > 1; got {alpha}")
+        xm = (alpha - 1.0) / (alpha * rate)
+        while True:
+            t += xm * (1.0 + rng.pareto(alpha))
+            if duration is not None and t >= duration:
+                break
+            out.append(t)
+            if n is not None and len(out) >= n:
+                break
+        return np.asarray(out)
+    r = _rate_fn(process, rate, **kw)
+    while True:
+        t += rng.exponential(1.0 / r(t))
+        if duration is not None and t >= duration:
+            break
+        out.append(t)
+        if n is not None and len(out) >= n:
+            break
+    return np.asarray(out)
+
+
+def make_trace(*, process="poisson", rate=10.0, duration=None, n=None,
+               tenants=DEFAULT_TENANTS, vocab=256, seed=0,
+               **proc_kw) -> list[dict]:
+    """A full workload trace: arrival instants from ``process``, each
+    event assigned a tenant by weighted draw and given a prompt /
+    decode budget from that tenant's ranges. Deterministic in
+    ``seed`` (one rng drives arrivals, a derived one the mixes)."""
+    ts = arrivals(process, rate, duration=duration, n=n, seed=seed,
+                  **proc_kw)
+    rng = np.random.default_rng((int(seed) << 8) + 1)
+    tenants = [dict(t) for t in tenants]
+    weights = np.asarray([float(t.get("weight", 1.0)) for t in tenants])
+    if (weights <= 0).any():
+        raise ValueError("tenant weights must be > 0")
+    weights = weights / weights.sum()
+    trace = []
+    for t in ts:
+        ti = int(rng.choice(len(tenants), p=weights))
+        spec = tenants[ti]
+        plo, phi = spec.get("prompt_len", (4, 64))
+        slo_, shi = spec.get("steps", (8, 32))
+        plen = int(rng.integers(plo, max(plo + 1, phi)))
+        steps = int(rng.integers(slo_, max(slo_ + 1, shi)))
+        trace.append({
+            "t": float(t),
+            "tenant": str(spec.get("name", f"tenant{ti}")),
+            "priority": int(spec.get("priority", 0)),
+            "prompt": rng.integers(0, vocab, plen).astype(np.int32),
+            "steps": steps,
+        })
+    return trace
+
+
+def trace_to_jsonable(trace) -> list[dict]:
+    return [
+        {**ev, "t": round(ev["t"], 6),
+         "prompt": np.asarray(ev["prompt"]).tolist()}
+        for ev in trace
+    ]
+
+
+def trace_from_jsonable(rows) -> list[dict]:
+    return [
+        {**row, "prompt": np.asarray(row["prompt"], np.int32)}
+        for row in rows
+    ]
+
+
+def summarize(trace) -> dict:
+    """Per-tenant counts + global arrival stats — what the CLI prints
+    and a bench artifact records next to its numbers."""
+    ts = np.asarray([ev["t"] for ev in trace])
+    by_tenant: dict = {}
+    for ev in trace:
+        b = by_tenant.setdefault(
+            ev["tenant"],
+            {"requests": 0, "priority": ev["priority"],
+             "prompt_tokens": 0, "decode_tokens": 0},
+        )
+        b["requests"] += 1
+        b["prompt_tokens"] += int(np.asarray(ev["prompt"]).size)
+        b["decode_tokens"] += int(ev["steps"])
+    gaps = np.diff(ts) if ts.size > 1 else np.asarray([0.0])
+    return {
+        "events": len(trace),
+        "span_seconds": round(float(ts[-1] - ts[0]), 4) if len(trace)
+        else 0.0,
+        "gap_ms": {
+            "mean": round(float(gaps.mean()) * 1e3, 3),
+            "p50": round(float(np.percentile(gaps, 50)) * 1e3, 3),
+            "p99": round(float(np.percentile(gaps, 99)) * 1e3, 3),
+            "max": round(float(gaps.max()) * 1e3, 3),
+        },
+        "tenants": by_tenant,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--process", default="poisson",
+                    choices=("poisson", "bursty", "diurnal",
+                             "heavy_tail"))
+    ap.add_argument("--rate", type=float, default=10.0,
+                    help="mean arrivals per second")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--tenants", default=None,
+                    help="JSON list of tenant specs (name/weight/"
+                         "priority/prompt_len/steps)")
+    ap.add_argument("--dump", action="store_true",
+                    help="print the full trace (JSON rows) instead of "
+                         "the summary")
+    args = ap.parse_args(argv)
+    tenants = (
+        json.loads(args.tenants) if args.tenants else DEFAULT_TENANTS
+    )
+    trace = make_trace(
+        process=args.process, rate=args.rate, duration=args.duration,
+        tenants=tenants, vocab=args.vocab, seed=args.seed,
+    )
+    out = trace_to_jsonable(trace) if args.dump else summarize(trace)
+    json.dump(out, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,18 @@ The acceptance bar it asserts (and prints as JSON):
   NAME the injected seam (a ``fault.fired`` event at
   ``scheduler.loop``) — failure triage without a seed replay is the
   acceptance bar, asserted here, not eyeballed.
+- QOS PREEMPTION PAIRING under chaos — the client set is MULTI-TENANT
+  and MIXED-PRIORITY (three tenants at priorities 2/1/0 against a
+  deliberately tight page pool), the engine schedules with a
+  ``QosPolicy(preempt=True)``, and the ``kv.swap`` seam is in the
+  armed set: every preemption (KV swap-out) must pair with a resume
+  or a TYPED failure — ``preemptions == resumes + swap_in_failures +
+  swapped_failed`` on the final counters — and the pool ledger must
+  balance at shutdown (zero slot-held pages; the device prefix index
+  cleared leaves zero pages in use). Preempted/resumed GREEDY streams
+  still match solo decode and preempted SAMPLED streams still replay
+  canonically — the identity bars above already cover the swap path
+  because preemption hits the same client traffic.
 
 The fault mix is seeded (``FaultPlan`` draws probabilistic seams from
 its own RNG), so a failing soak replays exactly with the same seed::
@@ -78,6 +90,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     from distkeras_tpu.predictors import CachedSequenceGenerator
     from distkeras_tpu.serving import (
         PoolExhaustedError,
+        QosPolicy,
         ServingClient,
         ServingEngine,
         ServingError,
@@ -130,10 +143,17 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         restart_backoff=0.01, quarantine_steps=8,
         postmortem_dir=postmortem_dir,
         # paged KV (the production capacity path): small pages so the
-        # soak's short prompts still span multiple pages, pool at the
-        # dense-equivalent budget so organic exhaustion stays rare and
-        # the armed kv.alloc seam provides the injected pressure
-        **(dict(paged=True, page_size=4) if paged else {}),
+        # soak's short prompts still span multiple pages; the pool is
+        # deliberately TIGHT (≈3 concurrent requests across 4 slots)
+        # so the mixed-priority client set's high-priority arrivals
+        # actually block and PREEMPT — organic pool pressure plus the
+        # armed kv.alloc/kv.swap seams is the point of this soak
+        **(dict(paged=True, page_size=4, num_pages=16) if paged
+           else {}),
+        # multi-tenant QoS: the client set is mixed-priority, so the
+        # scheduler runs priorities + WFQ + preemption-by-swap under
+        # the same chaos as everything else
+        qos=QosPolicy(preempt=True, max_preemptions=2),
         # self-draft: k proposals that always agree, so every scheduler
         # iteration runs the VERIFY program and the armed stepper.verify
         # seam sees real traffic
@@ -179,6 +199,11 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         .arm("kv.alloc", times=None, probability=0.03)
         .arm("kv.alloc", times=None, probability=0.03,
              exc=PoolExhaustedError("injected pool exhaustion"))
+        # QoS swap chaos, BOTH directions: a failed swap-out aborts
+        # the preemption (victim untouched), a failed swap-in fails
+        # only the preempted request typed — the pairing invariant
+        # below must hold regardless
+        .arm("kv.swap", times=None, probability=0.05)
         # the TERMINAL seam: kill the scheduler thread outright — once
         # deterministically (the guaranteed trip even at smoke scale)
         # and then probabilistically — so every watchdog trip's
@@ -225,6 +250,11 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
             budget=duration + 30.0, seed=seed * 1000 + ci,
         )
         crng = np.random.default_rng(seed * 100 + ci)
+        # multi-tenant mixed-priority identity: client ci speaks for
+        # tenant{ci%3} at priority 2/1/0 — high-priority arrivals into
+        # the tight pool drive real preemptions of the lower classes
+        tenant = f"tenant{ci % 3}"
+        prio = (2, 1, 0)[ci % 3]
         with ServingClient("127.0.0.1", server.port, retry=policy) as c:
             while time.monotonic() < stop_at:
                 # mixed traffic: greedy shapes AND the sampled family
@@ -241,7 +271,8 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
                 c.last_trace = None  # fresh per attempt
                 try:
                     out = c.generate(
-                        prompt, max_new, trace=True, sampling=sp
+                        prompt, max_new, trace=True, sampling=sp,
+                        tenant=tenant, priority=prio,
                     )
                 except ServingError as e:
                     code = getattr(e, "code", type(e).__name__)
@@ -293,7 +324,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     summary["fired_by_site"] = {
         s: plan.fired(s)
         for s in ("stepper.step", "stepper.verify", "server.reply",
-                  "net.send", "scheduler.loop", "kv.alloc")
+                  "net.send", "scheduler.loop", "kv.alloc", "kv.swap")
     }
     engine_stats = engine.stats()
     summary["engine"] = {
@@ -305,6 +336,21 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
             "sampled_requests", "forked_slots",
         )
     }
+    # QoS preemption ledger (counters are per scheduler GENERATION —
+    # a supervisor restart rebuilds them at zero after the old
+    # generation's stop() finalized its own ledger — so the pairing
+    # invariant holds within the reported generation)
+    summary["qos"] = {
+        k: engine_stats[k]
+        for k in ("preemptions", "resumes", "preempt_aborted",
+                  "swap_in_failures", "swapped_failed",
+                  "swapped_tokens")
+    }
+    summary["qos"]["paired"] = (
+        engine_stats["preemptions"]
+        == engine_stats["resumes"] + engine_stats["swap_in_failures"]
+        + engine_stats["swapped_failed"]
+    )
     if paged:
         pg = engine_stats["paged"]
         summary["paged"] = {
@@ -320,6 +366,26 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
                       "accepted_draft_tokens", "rejected_draft_tokens")
         }
     server.shutdown()  # joins the supervisor: every dump has landed
+    if paged:
+        # the pool ledger balances at shutdown: no slot holds a page,
+        # and clearing the device prefix index (the one legitimate
+        # remaining holder) returns the pool to empty — a preemption/
+        # swap/restart path that leaked a page or a host-ladder entry
+        # fails here
+        st = engine._stepper
+        slot_held = sorted(
+            {p for t in st._tables for p in t}
+        ) if st is not None else []
+        if st is not None and st.prefix_index is not None:
+            st.prefix_index.clear()
+        in_use_after = (
+            st._kv_alloc.pages_in_use if st is not None else 0
+        )
+        summary["paged"]["slot_held_pages_at_shutdown"] = slot_held
+        summary["paged"]["pages_in_use_after_index_clear"] = in_use_after
+        summary["paged"]["pool_balanced"] = (
+            not slot_held and in_use_after == 0
+        )
     # the post-mortem bar: one bundle PER watchdog trip, and every
     # bundle's recorder timeline names the injected seam that killed
     # the scheduler (fault.fired at scheduler.loop)
@@ -358,6 +424,10 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         and trips >= 1
         and len(bundles) == trips
         and named_seam == len(bundles)
+        # the QoS bars: every swap-out paired with a resume or a
+        # typed failure, and (paged) the pool ledger balanced
+        and summary["qos"]["paired"]
+        and (not paged or summary["paged"]["pool_balanced"])
     )
     return summary
 
