@@ -182,20 +182,33 @@ def detach_expert_mesh(model) -> int:
     return count
 
 
+def is_moe_group(node) -> bool:
+    """Whether ``node`` is an MoE param group — {"router", "wi", "wo"},
+    the layout ``MoE.init`` emits. STRUCTURAL detection, shared by the
+    training placement below and the serving-tier decode placement
+    (``tensor_parallel.decode_param_specs``): other layers also name
+    weights ``wo`` (TransformerBlock's attention output projection),
+    and sharding those over the expert axis would be wrong."""
+    return isinstance(node, dict) and {"router", "wi", "wo"} <= set(node)
+
+
+def moe_group_specs(axis_name: str = "expert") -> dict:
+    """Partition specs for one MoE param group: the (E, ...) expert
+    stacks shard their leading (expert) dim over ``axis_name``, the
+    router replicates. The serving tier reuses this with its own axis
+    name ("model"): at decode time the expert FFNs route through the
+    same placement the training tier uses, just over the serving mesh."""
+    return {"router": P(), "wi": P(axis_name), "wo": P(axis_name)}
+
+
 def shard_moe_params(params, mesh: Mesh, axis_name: str = "expert"):
     """Place a built model's params with every MoE expert stack sharded
     over ``axis_name``; everything else replicated.
 
-    An expert stack is identified STRUCTURALLY — a ``wi``/``wo`` leaf whose
-    parent dict is an MoE param group ({"router", "wi", "wo"}, the layout
-    ``MoE.init`` emits) — not by leaf name alone: other layers also name
-    weights ``wo`` (TransformerBlock's attention output projection), and
-    sharding those over the expert axis would be wrong."""
+    An expert stack is identified structurally via :func:`is_moe_group`
+    — see its docstring for why leaf names alone are not enough."""
     repl = NamedSharding(mesh, P())
     exp = NamedSharding(mesh, P(axis_name))
-
-    def is_moe_group(node):
-        return isinstance(node, dict) and {"router", "wi", "wo"} <= set(node)
 
     def place_tree(node):
         if node is None:

@@ -77,6 +77,54 @@ def force_cpu_mesh(num_devices: int = 8) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def serving_mesh(spec, *, devices=None, axis_name: str = "model") -> Mesh:
+    """THE serving-mesh constructor: every consumer (``ServingEngine``,
+    the decode bench, the soak, the tests) resolves its tensor-parallel
+    mesh here instead of re-rolling ``Mesh(jax.devices()[:n], ...)``.
+
+    ``spec`` forms:
+
+    - ``"tp:N"`` — N-way tensor parallelism over the first N devices
+      (``devices`` overrides the pool);
+    - an int ``N`` — same as ``"tp:N"``;
+    - a ``jax.sharding.Mesh`` — passed through after validating it
+      carries ``axis_name`` (an engine cannot shard over an axis its
+      partition specs never name).
+
+    Validation is LOUD and happens at construction (bundle load), not
+    at the first decode step: asking for more ways than there are
+    devices raises ``ValueError`` naming both numbers, so a misplaced
+    replica fails its boot health-check instead of wedging later.
+    """
+    if isinstance(spec, Mesh):
+        if axis_name not in spec.axis_names:
+            raise ValueError(
+                f"serving mesh must carry a {axis_name!r} axis; got "
+                f"axes {spec.axis_names}"
+            )
+        return spec
+    if isinstance(spec, str):
+        kind, sep, num = spec.partition(":")
+        if kind != "tp" or not sep or not num.isdigit():
+            raise ValueError(
+                f"unrecognized serving mesh spec {spec!r}; expected "
+                f"'tp:N', an int, or a jax.sharding.Mesh"
+            )
+        n = int(num)
+    else:
+        n = int(spec)
+    if n < 1:
+        raise ValueError(f"serving mesh needs >= 1 device; got tp:{n}")
+    devs = devices if devices is not None else jax.devices()
+    if n > len(devs):
+        raise ValueError(
+            f"serving mesh 'tp:{n}' needs {n} devices but only "
+            f"{len(devs)} are available — shrink the mesh or run on a "
+            f"host with more devices"
+        )
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

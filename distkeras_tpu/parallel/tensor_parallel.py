@@ -69,3 +69,156 @@ def describe_shardings(params, mesh: Mesh, axis_name: str = "model"):
         )
         for path, leaf in flat
     }
+
+
+# -- serving-tier (decode) placement ------------------------------------------
+#
+# The trainer's rule above (shard every trailing dim) is wrong for a
+# decode step: sharding BOTH matmuls of a pair column-wise leaves the
+# activations sharded between them and XLA inserts an all-gather per
+# layer. Decode wants the classic Megatron pairing instead — attention
+# QKV column-sharded (equivalently: HEAD-sharded, since the out dim is
+# heads x head_dim) with the output projection ROW-sharded, and MLP
+# fc1 column / fc2 row — so each block runs shard-local until one
+# psum at each pair's row matmul returns the activations to
+# replicated. Embeddings, layer norms, and the vocab head replicate
+# (they are a rounding error of the weight bytes decode streams).
+# MoE expert stacks shard their expert dim over the SAME serving axis
+# via ``expert_parallel.moe_group_specs`` — decode-time expert
+# parallelism rides the one mesh.
+
+
+def _pair_specs(spec, leaf, axis_size, axis_name):
+    """Resolve ``spec`` ("col" | "row") for one weight leaf, handling
+    the quantized forms: an int8 ``{"q", "s"}`` group shards ``q``
+    like the f32 matrix would (the per-output-column scales follow a
+    column shard, replicate under a row shard); a packed
+    ``Int4Weight`` replicates (its two-values-per-byte IN-dim packing
+    does not split cleanly over a row shard, and a half-sharded int4
+    matrix is not worth a special program). Non-divisible dims
+    replicate rather than raise — head divisibility, the one
+    correctness-critical constraint, is validated loudly by the engine
+    before placement ever runs."""
+    from distkeras_tpu.ops.quantization import Int4Weight
+
+    if isinstance(leaf, Int4Weight):
+        return P()
+    mat = leaf["q"] if isinstance(leaf, dict) else leaf
+    shape = np.shape(mat)
+    if len(shape) != 2:
+        return P()
+    d = shape[1] if spec == "col" else shape[0]
+    if d % axis_size or d < axis_size:
+        return P()
+    qspec = P(None, axis_name) if spec == "col" else P(axis_name, None)
+    if isinstance(leaf, dict):  # int8 {"q", "s"}
+        return {"q": qspec, "s": P(axis_name) if spec == "col" else P()}
+    return qspec
+
+
+def decode_param_specs(params, axis_size: int, axis_name: str = "model"):
+    """Partition specs for a causal-LM param tree under serving tensor
+    parallelism — the structure-matched tree ``shard_decode_params``
+    places and tests/docs introspect. Returns a pytree shaped like
+    ``params`` whose leaves are ``PartitionSpec`` (quantized int8
+    groups expand to per-field specs)."""
+    from distkeras_tpu.parallel.expert_parallel import (
+        is_moe_group,
+        moe_group_specs,
+    )
+
+    def vec_spec(leaf):
+        n = np.shape(leaf)
+        if len(n) == 1 and n[0] % axis_size == 0 and n[0] >= axis_size:
+            return P(axis_name)
+        return P()
+
+    def group(node, kind):
+        out = {}
+        for k, v in node.items():
+            if kind == "mhsa" and k in ("wq", "wk", "wv"):
+                out[k] = _pair_specs("col", v, axis_size, axis_name)
+            elif kind == "mhsa" and k == "wo":
+                out[k] = _pair_specs("row", v, axis_size, axis_name)
+            elif kind == "fc1" and k == "kernel":
+                out[k] = _pair_specs("col", v, axis_size, axis_name)
+            elif kind == "fc1" and k == "bias":
+                out[k] = vec_spec(v)
+            elif kind == "fc2" and k == "kernel":
+                out[k] = _pair_specs("row", v, axis_size, axis_name)
+            else:
+                out[k] = P()  # bo, fc2 bias, anything unrecognized
+        return out
+
+    def moe_specs(node):
+        tmpl = moe_group_specs(axis_name)
+        out = {}
+        for k, v in node.items():
+            spec = tmpl.get(k, P())
+            if spec != P():
+                e = np.shape(v)[0] if np.ndim(v) else 0
+                if e % axis_size or e < axis_size:
+                    spec = P()
+            out[k] = spec
+        return out
+
+    def walk(node):
+        if is_moe_group(node):
+            return moe_specs(node)
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k in ("mhsa", "fc1", "fc2") and isinstance(v, dict):
+                    out[k] = group(v, k)
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return P()  # embeddings, LN, head, biases: replicated
+
+    return walk(params)
+
+
+def shard_decode_params(params, mesh: Mesh, axis_name: str = "model"):
+    """Place a causal-LM param tree for serving decode: Megatron-paired
+    attention/MLP shards (see the module note above), MoE expert stacks
+    expert-sharded over the same axis, everything else replicated.
+    Returns a NEW placed tree — the caller's tree (the trainable f32
+    master, the predict path's copy) is untouched."""
+    specs = decode_param_specs(params, mesh.shape[axis_name], axis_name)
+
+    def walk(node, spec):
+        # NOTE: PartitionSpec is a tuple subclass on some JAX versions,
+        # so the P check must come before any tuple/list branch
+        if isinstance(spec, P):
+            return jax.device_put(node, NamedSharding(mesh, spec))
+        if isinstance(node, dict):
+            return {k: walk(node[k], spec[k]) for k in node}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v, s) for v, s in zip(node, spec))
+        return jax.device_put(node, NamedSharding(mesh, P()))
+
+    return walk(params, specs)
+
+
+def describe_decode_shardings(params, mesh: Mesh,
+                              axis_name: str = "model"):
+    """{dotted path: spec} over ``decode_param_specs`` — tests/docs."""
+    specs = decode_param_specs(params, mesh.shape[axis_name], axis_name)
+    out = {}
+
+    def walk(node, path):
+        if isinstance(node, P):  # before tuple: P subclasses tuple
+            out[path] = node
+        elif isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{path}/{k}" if path else k)
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, f"{path}/{i}")
+        else:
+            out[path] = node
+
+    walk(specs, "")
+    return out

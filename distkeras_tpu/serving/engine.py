@@ -312,7 +312,7 @@ class DecodeStepper:
                  prefix_cache=None, speculative=None, draft_k=4,
                  spec_mode="rejection", scratch=None, paged=False,
                  page_size=16, num_pages=None, recorder=None,
-                 _quiet=False):
+                 mesh=None, _quiet=False):
         """``prefix_cache``: an optional ``prefix_cache.PrefixStore``.
         When set, ``begin_admit`` restores the longest cached prefix's
         K/V rows into the slot before any prefill compute, and every
@@ -368,7 +368,29 @@ class DecodeStepper:
         land past the real sequence instead of clamping onto it
         (default: sized from ``draft_k`` when speculative, else 0).
         ``_quiet``: skip the fault seams — the draft model's nested
-        stepper must not trip seams armed for live target traffic."""
+        stepper must not trip seams armed for live target traffic.
+
+        ``mesh``: tensor-parallel serving mesh — ``"tp:N"``, an int, or
+        a ``jax.sharding.Mesh`` carrying a ``"model"`` axis (resolved
+        through ``parallel.mesh.serving_mesh``). The stepper then
+        places its OWN copy of the weights with the Megatron-paired
+        decode specs (``parallel.tensor_parallel.shard_decode_params``:
+        attention QKV/O head-sharded, MLP column/row, MoE expert stacks
+        expert-sharded over the same axis, embeddings/LN/head
+        replicated) and shards every K/V pool / cache bank HEAD-wise
+        over the same axis, so the weight-read-bound step streams 1/N
+        of the bytes per shard. All host bookkeeping — page tables,
+        ``PageAllocator`` refcounts, prefix-index entries, sampler
+        state — is mesh-oblivious: a page id names a (page_size, H,
+        Dh) extent whose bytes happen to live split across shards.
+        The compiled programs are the SAME bodies as solo; XLA's
+        partitioner inserts the collectives (one psum per attention/
+        MLP pair). ``mesh=None`` (the default) leaves every code path
+        byte-for-byte as before. Requires ``num_heads %% N == 0`` —
+        validated loudly here, at bundle load. The nested draft
+        stepper (``ModelDrafter``) always runs solo: a draft worth
+        serving fits one device, and its proposals are verified by the
+        sharded target anyway."""
         import jax.numpy as jnp
 
         from distkeras_tpu.predictors import CachedSequenceGenerator
@@ -414,7 +436,45 @@ class DecodeStepper:
             model.params[str(self._gen._stages[0][1])]["mhsa"]["wq"]
         )[1] // nh
         b, t = self.num_slots, self._tp
-        self._ctx = jnp.zeros((b, t), jnp.int32)
+        # -- serving mesh (tensor-parallel decode) ------------------------
+        # Resolved FIRST (before any device allocation): a bad mesh must
+        # fail the boot, not the first step. The two shardings every
+        # program output is pinned to: K/V head-sharded, everything else
+        # replicated.
+        self.mesh = None
+        self._kv_sh = None
+        self._repl_sh = None
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from distkeras_tpu.parallel.mesh import serving_mesh
+            from distkeras_tpu.parallel.tensor_parallel import (
+                shard_decode_params,
+            )
+
+            self.mesh = serving_mesh(mesh)
+            tp_ways = int(self.mesh.shape["model"])
+            if nh % tp_ways:
+                raise ValueError(
+                    f"cannot shard {nh} attention heads over mesh "
+                    f"'tp:{tp_ways}': the model axis must divide "
+                    f"num_heads — pick a mesh that divides the head "
+                    f"count or serve this bundle solo"
+                )
+            self._kv_sh = NamedSharding(
+                self.mesh, PartitionSpec(None, None, "model")
+            )
+            self._repl_sh = NamedSharding(self.mesh, PartitionSpec())
+            # the stepper's OWN placed copy: the trainable master tree
+            # (and the predict path reading it) stays untouched
+            self._params = shard_decode_params(model.params, self.mesh)
+            self._ctx = jax.device_put(
+                jnp.zeros((b, t), jnp.int32), self._repl_sh
+            )
+        else:
+            self._params = model.params
+            self._ctx = jnp.zeros((b, t), jnp.int32)
         self.paged = bool(paged)
         self.page_size = int(page_size)
         self.recorder = recorder
@@ -443,14 +503,14 @@ class DecodeStepper:
             self._caches = None
             self._pools = [
                 (
-                    jnp.zeros(
+                    self._place_kv(jnp.zeros(
                         (int(num_pages), self.page_size, nh, hd),
                         self._gen.kv_dtype,
-                    ),
-                    jnp.zeros(
+                    )),
+                    self._place_kv(jnp.zeros(
                         (int(num_pages), self.page_size, nh, hd),
                         self._gen.kv_dtype,
-                    ),
+                    )),
                 )
                 for _ in self._gen._stages
             ]
@@ -470,8 +530,12 @@ class DecodeStepper:
             self.prefix_index = None
             self._caches = [
                 (
-                    jnp.zeros((b, t, nh, hd), self._gen.kv_dtype),
-                    jnp.zeros((b, t, nh, hd), self._gen.kv_dtype),
+                    self._place_kv(
+                        jnp.zeros((b, t, nh, hd), self._gen.kv_dtype)
+                    ),
+                    self._place_kv(
+                        jnp.zeros((b, t, nh, hd), self._gen.kv_dtype)
+                    ),
                 )
                 for _ in self._gen._stages
             ]
@@ -553,6 +617,11 @@ class DecodeStepper:
             return {"enabled": False}
         out = {"enabled": True}
         out.update(self._kv_alloc.stats())
+        # mesh geometry: the pool's TOTAL bytes are mesh-invariant;
+        # what changes with tp:N is how many land per shard
+        out["mesh"] = self.mesh_spec
+        out["kv_bytes_total"] = self.kv_bytes_total()
+        out["kv_shard_bytes"] = self.kv_shard_bytes()
         out["device_prefix"] = (
             self.prefix_index.stats()
             if self.prefix_index is not None
@@ -581,6 +650,65 @@ class DecodeStepper:
         hook = self.on_compile
         if hook is not None:
             hook()
+
+    # -- serving mesh -------------------------------------------------------
+
+    def _place_kv(self, arr):
+        """Pin one K/V pool/cache array to the head shard (identity
+        when solo)."""
+        if self.mesh is None:
+            return arr
+        import jax
+
+        return jax.device_put(arr, self._kv_sh)
+
+    def _jit(self, fn, donate=(), out="kv"):
+        """``jax.jit`` with mesh-pinned OUTPUT shardings. Solo this is
+        plain jit; under a mesh every program's K/V outputs are pinned
+        back to the head shard and ctx/token outputs to replicated, so
+        the layout never drifts across the donation chain — a program
+        whose reshape/scatter left the compiler free to re-lay-out a
+        pool would silently retrace every subsequent program (a fresh
+        input sharding is a fresh compile key)."""
+        import jax
+
+        if self.mesh is None:
+            return jax.jit(fn, donate_argnums=donate)
+        kv, rp = self._kv_sh, self._repl_sh
+        outs = {
+            "kv": kv,  # a caches/pools pytree alone
+            "ctx": rp,  # the context rows alone
+            "step": (rp, kv, rp),  # (ctx, caches/pools, tokens)
+            "verify": (rp, kv, rp, rp),  # (ctx, kv, tokens, counts)
+        }[out]
+        return jax.jit(fn, donate_argnums=donate, out_shardings=outs)
+
+    @property
+    def mesh_spec(self):
+        """``"tp:N"`` under a serving mesh, None solo — the geometry
+        string ``health``/``stats``/the fleet router surface."""
+        if self.mesh is None:
+            return None
+        return f"tp:{int(self.mesh.shape['model'])}"
+
+    @property
+    def mesh_devices(self) -> int:
+        return 1 if self.mesh is None else int(self.mesh.size)
+
+    def kv_bytes_total(self) -> int:
+        """Total K/V bytes across all stages and shards (pool or dense
+        bank) — constant across mesh sizes at a fixed config, which is
+        what makes tp1/tp2/tp4 bench rows an equal-byte comparison."""
+        arrs = self._pools if self.paged else self._caches
+        return sum(
+            2 * int(np.prod(ck.shape)) * ck.dtype.itemsize
+            for ck, _ in arrs
+        )
+
+    def kv_shard_bytes(self) -> int:
+        """K/V bytes RESIDENT PER SHARD — the number a capacity planner
+        compares against one device's HBM."""
+        return self.kv_bytes_total() // self.mesh_devices
 
     # -- per-slot sampler state ---------------------------------------------
 
@@ -827,11 +955,11 @@ class DecodeStepper:
             import jax
 
             self._compiling()
-            self._row_fn = jax.jit(
+            self._row_fn = self._jit(
                 lambda ctx, r, s: jax.lax.dynamic_update_slice(
                     ctx, r, (s, 0)
                 ),
-                donate_argnums=(0,),
+                donate=(0,), out="ctx",
             )
         self._ctx = self._row_fn(self._ctx, row, np.int32(slot))
         if host_hit is not None:
@@ -956,12 +1084,12 @@ class DecodeStepper:
                 import jax
 
                 self._compiling()
-                self._page_copy_fn = jax.jit(
+                self._page_copy_fn = self._jit(
                     lambda pools, s, d: [
                         (ck.at[d].set(ck[s]), cv.at[d].set(cv[s]))
                         for ck, cv in pools
                     ],
-                    donate_argnums=(0,),
+                    donate=(0,), out="kv",
                 )
             with annotate("serving/page_cow"):
                 self._pools = self._page_copy_fn(
@@ -974,9 +1102,9 @@ class DecodeStepper:
             import jax
 
             self._compiling()
-            self._row_copy_fn = jax.jit(
+            self._row_copy_fn = self._jit(
                 lambda ctx, s, d: ctx.at[d].set(ctx[s]),
-                donate_argnums=(0,),
+                donate=(0,), out="ctx",
             )
         self._ctx = self._row_copy_fn(
             self._ctx, np.int32(src), np.int32(dst)
@@ -1113,11 +1241,11 @@ class DecodeStepper:
             import jax
 
             self._compiling()
-            self._row_fn = jax.jit(
+            self._row_fn = self._jit(
                 lambda ctx, r, s: jax.lax.dynamic_update_slice(
                     ctx, r, (s, 0)
                 ),
-                donate_argnums=(0,),
+                donate=(0,), out="ctx",
             )
         self._ctx = self._row_fn(self._ctx, row, np.int32(slot))
         if state["kv"][0][0].shape[0] >= 1:
@@ -1205,7 +1333,7 @@ class DecodeStepper:
             self._admit_fns = {**self._admit_fns, pb: fn}
         with annotate("serving/prefill"):
             self._caches = fn(
-                self.model.params, self._caches, row, np.int32(slot),
+                self._params, self._caches, row, np.int32(slot),
             )
 
     def _prefill_mid(self, slot, prompt, pos, n) -> int:
@@ -1244,7 +1372,7 @@ class DecodeStepper:
                 self._pchunk_fns = {**self._pchunk_fns, key: fn}
             with annotate("serving/prefill_chunk"):
                 self._pools = fn(
-                    self.model.params, self._pools, toks,
+                    self._params, self._pools, toks,
                     self._table_row(slot, pbt), np.int32(pos),
                 )
             return n
@@ -1255,7 +1383,7 @@ class DecodeStepper:
             self._chunk_fns = {**self._chunk_fns, cb: fn}
         with annotate("serving/prefill_chunk"):
             self._caches = fn(
-                self.model.params, self._caches, toks, np.int32(slot),
+                self._params, self._caches, toks, np.int32(slot),
                 np.int32(pos),
             )
         return n
@@ -1425,7 +1553,7 @@ class DecodeStepper:
                 table = np.zeros((self.num_slots, pbt), np.int32)
                 with annotate("serving/warmup"):
                     self._ctx, self._pools, _ = fn(
-                        self.model.params, self._ctx, self._pools,
+                        self._params, self._ctx, self._pools,
                         self._lens.copy(), active, table, *sargs,
                     )
                 if pbt >= self._max_pages_bucket:
@@ -1439,7 +1567,7 @@ class DecodeStepper:
                     self._pverify_fns = {**self._pverify_fns, key: vfn}
                 with annotate("serving/warmup"):
                     self._ctx, self._pools, _, _ = vfn(
-                        self.model.params, self._ctx, self._pools,
+                        self._params, self._ctx, self._pools,
                         self._lens.copy(), active,
                         np.zeros((self.num_slots, self._kb), np.int32),
                         np.zeros((self.num_slots,), np.int32), table,
@@ -1453,7 +1581,7 @@ class DecodeStepper:
             self._step_fns = {**self._step_fns, False: fn}
         with annotate("serving/warmup"):
             self._ctx, self._caches, _ = fn(
-                self.model.params, self._ctx, self._caches,
+                self._params, self._ctx, self._caches,
                 self._lens.copy(), active, *sargs,
             )
         if self.drafter is not None:
@@ -1467,7 +1595,7 @@ class DecodeStepper:
                 self._verify_fns = {**self._verify_fns, (c, False): fn}
             with annotate("serving/warmup"):
                 self._ctx, self._caches, _, _ = fn(
-                    self.model.params, self._ctx, self._caches,
+                    self._params, self._ctx, self._caches,
                     self._lens.copy(), active,
                     np.zeros((self.num_slots, self._kb), np.int32),
                     np.zeros((self.num_slots,), np.int32), *sargs,
@@ -1513,7 +1641,7 @@ class DecodeStepper:
                 ]
             return caches
 
-        return jax.jit(admit, donate_argnums=(1,))
+        return self._jit(admit, donate=(1,), out="kv")
 
     def _build_chunk_fn(self, cb: int):
         """Compiled mid-prompt prefill chunk for bucket ``cb``: run the
@@ -1559,7 +1687,7 @@ class DecodeStepper:
                 )
             return out
 
-        return jax.jit(chunk, donate_argnums=(1,))
+        return self._jit(chunk, donate=(1,), out="kv")
 
     def _build_copy_fn(self):
         """Compiled prefix-cache restore: write the stacked per-stage
@@ -1584,7 +1712,7 @@ class DecodeStepper:
                 )
             return out
 
-        return jax.jit(copy, donate_argnums=(0,))
+        return self._jit(copy, donate=(0,), out="kv")
 
     # -- paged programs (gather-based attention over page pools) ------------
     #
@@ -1691,7 +1819,7 @@ class DecodeStepper:
             ctx = ctx.at[rows, wpos].set(jnp.where(write, nxt, cur))
             return ctx, new_pools, nxt
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return self._jit(step, donate=(1, 2), out="step")
 
     def _build_chunk_fn_paged(self, cb: int, pbt: int):
         """Compiled paged prefill chunk for (chunk bucket ``cb``, table
@@ -1743,7 +1871,7 @@ class DecodeStepper:
                 out.append((ck, cv))
             return out
 
-        return jax.jit(chunk, donate_argnums=(1,))
+        return self._jit(chunk, donate=(1,), out="kv")
 
     def _build_copy_fn_paged(self, pbk: int, pbt: int):
         """Compiled paged prefix restore: scatter the stacked per-stage
@@ -1775,7 +1903,7 @@ class DecodeStepper:
                 )
             return out
 
-        return jax.jit(copy, donate_argnums=(0,))
+        return self._jit(copy, donate=(0,), out="kv")
 
     def _build_verify_fn_paged(self, c: int, pbt: int, masked=False):
         """Compiled paged speculative verify for (``c`` candidates,
@@ -1877,7 +2005,7 @@ class DecodeStepper:
             ctx = ctx.at[rows2, wpos].set(jnp.where(keep, out, cur))
             return ctx, new_pools, out, n_new
 
-        return jax.jit(verify, donate_argnums=(1, 2))
+        return self._jit(verify, donate=(1, 2), out="verify")
 
     # -- the decode step ----------------------------------------------------
 
@@ -1905,7 +2033,7 @@ class DecodeStepper:
                 self._pstep_fns = {**self._pstep_fns, key: fn}
             with annotate("serving/step"):
                 self._ctx, self._pools, toks = fn(
-                    self.model.params, self._ctx, self._pools,
+                    self._params, self._ctx, self._pools,
                     self._lens.copy(), active,
                     self._tables_array(pbt), *sargs, *extra,
                 )
@@ -1917,7 +2045,7 @@ class DecodeStepper:
                 self._step_fns = {**self._step_fns, masked: fn}
             with annotate("serving/step"):
                 self._ctx, self._caches, toks = fn(
-                    self.model.params, self._ctx, self._caches,
+                    self._params, self._ctx, self._caches,
                     self._lens.copy(), active, *sargs, *extra,
                 )
         toks = np.asarray(toks)
@@ -2020,7 +2148,7 @@ class DecodeStepper:
             ctx = ctx.at[rows, wpos].set(jnp.where(write, nxt, cur))
             return ctx, new_caches, nxt
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return self._jit(step, donate=(1, 2), out="step")
 
     # -- speculative decode (draft -> verify -> rollback) -------------------
 
@@ -2128,7 +2256,7 @@ class DecodeStepper:
                 self._pverify_fns = {**self._pverify_fns, key: fn}
             with annotate("serving/verify"):
                 self._ctx, self._pools, t_out, n_new = fn(
-                    self.model.params, self._ctx, self._pools, lens0,
+                    self._params, self._ctx, self._pools, lens0,
                     active, dtoks.astype(np.int32),
                     dcnt.astype(np.int32), self._tables_array(pbt),
                     *sargs, *extra,
@@ -2142,7 +2270,7 @@ class DecodeStepper:
                 self._verify_fns = {**self._verify_fns, key: fn}
             with annotate("serving/verify"):
                 self._ctx, self._caches, t_out, n_new = fn(
-                    self.model.params, self._ctx, self._caches, lens0,
+                    self._params, self._ctx, self._caches, lens0,
                     active, dtoks.astype(np.int32),
                     dcnt.astype(np.int32), *sargs, *extra,
                 )
@@ -2182,7 +2310,7 @@ class DecodeStepper:
                     jnp.where(keep, toks.astype(ctx.dtype), cur)
                 )
 
-            self._seg_fn = jax.jit(seg, donate_argnums=(0,))
+            self._seg_fn = self._jit(seg, donate=(0,), out="ctx")
         self._ctx = self._seg_fn(
             self._ctx, np.asarray(toks, np.int32),
             lens0.astype(np.int32), counts.astype(np.int32),
@@ -2293,7 +2421,7 @@ class DecodeStepper:
             ctx = ctx.at[rows2, wpos].set(jnp.where(keep, out, cur))
             return ctx, new_caches, out, n_new
 
-        return jax.jit(verify, donate_argnums=(1, 2))
+        return self._jit(verify, donate=(1, 2), out="verify")
 
 
 class ServingEngine:
@@ -2321,7 +2449,7 @@ class ServingEngine:
                  flight_recorder=True,
                  recorder_capacity=2048, postmortem_dir=None,
                  slos=None, slo_interval=5.0, paged=False,
-                 page_size=16, num_pages=None, qos=None):
+                 page_size=16, num_pages=None, qos=None, mesh=None):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -2394,7 +2522,21 @@ class ServingEngine:
         request's pages, device-resident prefix pages are shared
         copy-on-write across slots, and pool exhaustion surfaces as
         the typed retriable ``overloaded`` (with ``retry_after_ms``)
-        instead of a hung or failed request. See ``DecodeStepper``."""
+        instead of a hung or failed request. See ``DecodeStepper``.
+
+        Scale-up knob: ``mesh`` — tensor-parallel decode over a
+        ``NamedSharding`` mesh (``"tp:N"``, an int, or a live
+        ``jax.sharding.Mesh``; see ``DecodeStepper``). Weights split
+        N ways (models larger than one chip serve at all; the
+        weight-read-bound step gets N memory systems), the paged K/V
+        pools shard head-wise over the same axis, and EVERY admission
+        path — chunked prefill, prefix hits, CoW forks, speculative
+        verify, QoS swap — stays pinned token-identical to solo
+        decode. Supervisor restarts rebuild the sharded stepper from
+        the same config. Mesh geometry rides ``health()`` (``mesh``,
+        ``kv_shard_bytes``) and the ``serving_mesh_devices`` /
+        ``serving_kv_shard_bytes`` gauges, so the fleet router and
+        the autoscaler can see per-replica geometry."""
         from distkeras_tpu.obs import MetricsRegistry
 
         self.model = model
@@ -2445,6 +2587,14 @@ class ServingEngine:
                     max_bytes=prefix_cache_bytes, registry=self.registry
                 )
             )
+        # resolve the serving mesh LOUDLY at bundle load: an
+        # unparseable spec or a mesh wider than the device pool must
+        # fail the boot health-check, not the first step
+        self._mesh = None
+        if mesh is not None:
+            from distkeras_tpu.parallel.mesh import serving_mesh
+
+            self._mesh = serving_mesh(mesh)
         drafter = self._resolve_drafter(
             speculative, draft_bundle, ngram_max
         )
@@ -2469,12 +2619,18 @@ class ServingEngine:
             prefix_cache=store, speculative=drafter, draft_k=draft_k,
             spec_mode=self.spec_mode, paged=paged, page_size=page_size,
             num_pages=num_pages, recorder=self.recorder,
+            mesh=self._mesh,
         )
         try:
             self._stepper = DecodeStepper(model, **self._stepper_cfg)
             self._stepper.on_compile = self._extend_grace
             self.prefix_store = store
         except ValueError as e:
+            if self._mesh is not None:
+                # a mesh was requested explicitly for sharded decode:
+                # demoting to predict-only would hide a config error
+                # (e.g. heads not divisible by tp) — fail the boot
+                raise
             # non-LM models still serve the predict verb; generate
             # replies with this error instead of refusing to boot
             self._decode_err = e
@@ -2576,6 +2732,24 @@ class ServingEngine:
             fn=lambda: (
                 0 if self._stepper is None
                 else self._stepper.mask_exhaustions
+            ),
+        )
+        # mesh geometry gauges: devices this replica's decode spans
+        # (1 = solo) and the K/V bytes resident per shard — what a
+        # capacity planner compares against one device's HBM, and the
+        # ``mesh`` column ``dkt_top`` renders per replica
+        reg.gauge(
+            "serving_mesh_devices",
+            fn=lambda: (
+                None if self._stepper is None
+                else self._stepper.mesh_devices
+            ),
+        )
+        reg.gauge(
+            "serving_kv_shard_bytes",
+            fn=lambda: (
+                None if self._stepper is None
+                else self._stepper.kv_shard_bytes()
             ),
         )
         if paged:
@@ -3211,6 +3385,11 @@ class ServingEngine:
             out["kv_page_util"] = round(
                 self._stepper._kv_alloc.utilization(), 4
             )
+        if batcher is not None and self._stepper is not None:
+            # per-replica geometry for the router/autoscaler: how many
+            # devices this replica's decode spans and the K/V bytes
+            # each shard holds (mesh also rides ``batcher.load()``)
+            out["kv_shard_bytes"] = self._stepper.kv_shard_bytes()
         out["heartbeat_age"] = (
             None
             if batcher is None or not self._started

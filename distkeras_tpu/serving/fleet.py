@@ -133,6 +133,7 @@ class _Replica:
         self.last_health = None
 
     def snapshot(self) -> dict:
+        h = self.last_health or {}
         return {
             "endpoint": [self.endpoint[0], self.endpoint[1]],
             "state": self.state,
@@ -142,6 +143,11 @@ class _Replica:
             "failovers": self.failovers,
             "consecutive_poll_failures": self.fails,
             "consecutive_slo_breaches": self.slo_breaches,
+            # per-replica decode geometry ("tp:N" / None), from the
+            # replica's own health: the autoscaler places models that
+            # need N devices only where an N-way replica runs, and the
+            # router's books show a heterogeneous fleet honestly
+            "mesh": h.get("mesh"),
         }
 
 

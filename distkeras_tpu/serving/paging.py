@@ -40,6 +40,16 @@ Failure injection: every allocation path fires the ``kv.alloc`` seam
 (``faults.py``) BEFORE touching state, so chaos tests can make
 exhaustion and allocator failure happen on demand; an armed seam that
 raises leaves the allocator exactly as it was.
+
+Mesh obliviousness, stated as a contract: under tensor-parallel
+serving (``DecodeStepper(mesh=...)``) each device pool is HEAD-SHARDED
+over the mesh, so one page id names a ``(page_size, H, Dh)`` extent
+whose bytes live split 1/N per shard. Nothing in this module knows or
+cares: ids, free lists, refcounts, CoW bookkeeping, and the exhaustion
+contract are identical at tp:1 and tp:8, which is exactly why paging /
+prefix sharing / fork / QoS swap logic needed zero changes when
+serving went sharded. Byte-geometry observability (``kv_shard_bytes``)
+therefore lives on the stepper, which owns the device arrays.
 """
 
 from __future__ import annotations

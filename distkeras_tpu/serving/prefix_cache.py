@@ -261,6 +261,14 @@ class DevicePrefixIndex:
     transfer format between engines and the fleet router's affinity
     key. This index is intra-engine reuse only (page ids are meaning-
     less outside their pool, and a stepper rebuild clears it).
+
+    Sharded pools (``DecodeStepper(mesh=...)``) change NOTHING here:
+    an entry's page ids name head-sharded extents, sharing is still a
+    host-side refcount (zero bytes moved on a hit, per shard or
+    otherwise), and the ``PrefixStore`` row format stays the gathered
+    full-head layout — ``np.asarray`` on a sharded pool row assembles
+    the shards, so host-ladder entries written by a tp:N engine
+    restore bit-exactly into a solo one and vice versa.
     """
 
     def __init__(self, allocator, max_entries: int = 1024):

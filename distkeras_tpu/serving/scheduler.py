@@ -1344,6 +1344,9 @@ class ContinuousBatcher:
                 "active_slots": sum(s is not None for s in self._slots),
                 "prefilling_slots": len(self._prefill_left),
                 "num_slots": len(self._slots),
+                # decode geometry ("tp:N" / None): rides health so the
+                # fleet router and autoscaler see per-replica meshes
+                "mesh": getattr(self.stepper, "mesh_spec", None),
             }
 
     def stats(self) -> dict:
@@ -1357,6 +1360,7 @@ class ContinuousBatcher:
             out["prefilling_slots"] = len(self._prefill_left)
             out["quarantined_slots"] = len(self._quarantined)
             out["num_slots"] = len(self._slots)
+            out["mesh"] = getattr(self.stepper, "mesh_spec", None)
             out["prefill_chunk"] = self.prefill_chunk
             out["draining"] = self._draining
         steps = out["steps"]

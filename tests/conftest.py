@@ -18,3 +18,25 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session", autouse=True)
 def _assert_cpu_mesh():
     assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    """The 8-virtual-device topology, as a fixture: serving/parallel
+    tests that need devices take this instead of re-rolling
+    ``jax.devices()`` behind their own ad-hoc setup — the dependency
+    makes the required topology explicit in each test's signature."""
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def tp_mesh(cpu_devices):
+    """Factory for serving tensor-parallel meshes on the shared CPU
+    topology: ``tp_mesh(2)`` -> the 2-way ``serving_mesh`` every
+    sharded-serving test (and the decode bench) uses."""
+    from distkeras_tpu.parallel.mesh import serving_mesh
+
+    def make(n: int):
+        return serving_mesh(f"tp:{n}", devices=cpu_devices)
+
+    return make

@@ -219,6 +219,82 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
         ), name
 
 
+def test_bench_decode_sharded_smoke_end_to_end(tmp_path, monkeypatch):
+    """``bench_decode.py --sharded-only --smoke`` runs the tp1/tp2/tp4
+    grid end to end on the 8-virtual-device CPU mesh and the artifact
+    carries the committed schema: per-row tokens/sec + ratio, the
+    per-pass identity flag, the equal-total-KV-bytes contract, the
+    single-host caveat, and the mandatory adversarial small-model tp4
+    row — then the fresh block must clear the ``check_bench`` decode
+    gate against the committed artifact (ratio bands + floors), so a
+    sharding collapse fails tier-1 instead of rotting the numbers."""
+    import bench_decode
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(
+        sys, "argv",
+        ["bench_decode.py", "--sharded-only", "--smoke", "--cpu"],
+    )
+    bench_decode.main()
+    rec = json.loads((tmp_path / "BENCH_DECODE.json").read_text())
+    sh = rec["sharded"]
+    assert sh["devices_available"] >= 4
+    assert "single_host_caveat" in sh
+    assert set(sh["rows"]) == {"tp1", "tp2", "tp4"}
+    for name, row in sh["rows"].items():
+        assert row["outputs_identical"] is True, name
+        assert row["tokens_per_sec"] > 0, name
+        assert row["ratio_vs_tp1"] > 0, name
+        ways = int(name[2:])
+        assert row["kv_shard_bytes"] * ways == sh["kv_bytes_total"], name
+    adv = sh["adversarial_small_tp4"]
+    assert adv["outputs_identical"] is True
+    assert adv["ratio_vs_tp1"] > 0
+    committed = json.loads(
+        open(os.path.join(REPO, "BENCH_DECODE.json")).read()
+    )
+    violations = check_bench.compare_decode(rec, committed)
+    assert violations == [], violations
+
+
+def test_committed_bench_decode_sharded_block():
+    """The COMMITTED sharded block carries THIS PR's claims honestly:
+    every tp:N row token-identical to solo, equal total KV bytes
+    across geometries, the single-host caveat stated, the ratios above
+    their collapse floors, and the adversarial small-model tp4 row —
+    where per-step collectives dominate and sharding LOSES — committed
+    as measured."""
+    rec = json.loads(
+        open(os.path.join(REPO, "BENCH_DECODE.json")).read()
+    )
+    # self-comparison exercises every invariant and the floors (the
+    # floor values live in check_bench.COMMITTED_FLOORS — the one
+    # source of truth; asserting literals here would silently drift)
+    assert check_bench.compare_decode(rec, rec) == []
+    assert set(check_bench.COMMITTED_FLOORS["decode"]) == {
+        "sharded.rows.tp2.ratio_vs_tp1",
+        "sharded.rows.tp4.ratio_vs_tp1",
+        "sharded.adversarial_small_tp4.ratio_vs_tp1",
+    }
+    sh = rec["sharded"]
+    adv = sh["adversarial_small_tp4"]
+    assert adv["ratio_vs_tp1"] < 1.0  # it IS the honesty row on CPU
+    # gate plumbing: a flipped identity flag or a dropped row is a
+    # violation, not a silent pass
+    import copy
+
+    bad = copy.deepcopy(rec)
+    bad["sharded"]["rows"]["tp2"]["outputs_identical"] = False
+    assert any(
+        "tp2" in v for v in check_bench.compare_decode(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    del bad["sharded"]["adversarial_small_tp4"]
+    assert any(
+        "adversarial" in v for v in check_bench.compare_decode(bad, rec)
+    )
+
+
 def _check_fleet_record(rec):
     """The BENCH_FLEET.json contract both the smoke artifact and the
     committed artifact must meet: three sides per workload (single /

@@ -75,7 +75,16 @@ def format_table(samples, width: int = 78) -> str:
         groups.setdefault(replica, []).append((s, labels))
     lines = []
     for replica in sorted(groups):
-        lines.append(f"== {replica} ".ljust(width, "="))
+        # the mesh column: a replica serving over a tensor-parallel
+        # mesh says so in its header (from the serving_mesh_devices
+        # gauge), so a heterogeneous fleet reads at a glance
+        mesh = ""
+        for s, _ in groups[replica]:
+            if s["name"] == "serving_mesh_devices" and s.get("value"):
+                n = int(s["value"])
+                mesh = f"  mesh=tp:{n}" if n > 1 else "  mesh=solo"
+                break
+        lines.append(f"== {replica}{mesh} ".ljust(width, "="))
         rows = []
         for s, labels in sorted(
             groups[replica], key=lambda p: p[0]["name"]

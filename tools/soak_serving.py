@@ -70,7 +70,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def run_soak(model=None, clients=4, duration=5.0, seed=0,
              fault_every=7, max_new=6, speculative=True,
-             paged=True) -> dict:
+             paged=True, mesh=None) -> dict:
     """Drive the soak; returns the summary dict (also what ``main``
     prints). ``fault_every``: mean steps between injected device-step
     faults (the blame-path pressure); wire faults ride fixed seeded
@@ -82,7 +82,13 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     covers the capacity path production runs) with the ``kv.alloc``
     seam in the armed set: injected allocator failures must surface
     typed (``internal`` for a generic crash, retriable ``overloaded``
-    for exhaustion), never hang a slot or corrupt a stream."""
+    for exhaustion), never hang a slot or corrupt a stream.
+    ``mesh``: serve tensor-parallel over a serving mesh (e.g.
+    ``"tp:2"`` — needs the multi-device topology; ``--cpu`` forces the
+    8-virtual-device CPU mesh): every identity/pairing/ledger bar
+    above holds UNCHANGED on a sharded engine, and a watchdog restart
+    must rebuild the sharded stepper and re-warm the sharded buckets
+    (the stepper config carries the mesh through ``_restart``)."""
     import numpy as np
 
     from distkeras_tpu.faults import FaultPlan
@@ -154,6 +160,8 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         # scheduler runs priorities + WFQ + preemption-by-swap under
         # the same chaos as everything else
         qos=QosPolicy(preempt=True, max_preemptions=2),
+        # tensor-parallel arm: the same chaos over a sharded stepper
+        **(dict(mesh=mesh) if mesh else {}),
         # self-draft: k proposals that always agree, so every scheduler
         # iteration runs the VERIFY program and the armed stepper.verify
         # seam sees real traffic
@@ -320,6 +328,7 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     hung = sum(t.is_alive() for t in threads)
 
     summary["hung"] = hung
+    summary["mesh"] = engine._stepper.mesh_spec if engine._stepper else None
     summary["faults_fired"] = plan.fired()
     summary["fired_by_site"] = {
         s: plan.fired(s)
@@ -450,18 +459,22 @@ def main(argv=None) -> int:
                          "traffic)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU platform before JAX initializes")
+    ap.add_argument("--mesh", default=None,
+                    help="serve tensor-parallel over a serving mesh "
+                         "(e.g. tp:2); with --cpu the 8-virtual-device "
+                         "topology is forced so the mesh has devices")
     args = ap.parse_args(argv)
 
     if args.cpu:
         from distkeras_tpu.parallel.mesh import force_cpu_mesh
 
-        force_cpu_mesh(1)
+        force_cpu_mesh(8 if args.mesh else 1)
 
     summary = run_soak(
         clients=args.clients, duration=args.duration, seed=args.seed,
         fault_every=args.fault_every,
         speculative=not args.no_speculative,
-        paged=not args.dense,
+        paged=not args.dense, mesh=args.mesh,
     )
     json.dump(summary, sys.stdout, indent=2, default=str)
     print()
