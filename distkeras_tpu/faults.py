@@ -32,6 +32,18 @@ Seam catalogue (the hook points that exist today)::
                         swap-in fails only the preempted request,
                         typed — the scheduler never wedges and no
                         page or host swap state leaks
+    kv.transfer         the disaggregated prefill/decode transfer hop
+                        (serving/kv_transfer.py): fires in
+                        ``ServingEngine.prefill`` before the finished
+                        slot's state is encoded for the wire
+                        (``ctx["direction"]`` "send") and in
+                        ``ServingEngine.resume`` before a received
+                        frame is decoded ("recv"). A send failure
+                        fails only its own request, typed; a recv
+                        failure replies typed to the router, which
+                        retries the SAME bytes on a sibling decode
+                        worker (bounded) — no direction can hang a
+                        client or strand a slot
     server.dispatch     ServingServer verb dispatch (typed-reply path)
     server.reply        ServingServer before sending a reply frame
     router.dispatch     FleetRouter verb dispatch, before a replica is
@@ -102,6 +114,7 @@ SITES = frozenset(
         "prefix_cache.fetch",
         "kv.alloc",
         "kv.swap",
+        "kv.transfer",
         "server.dispatch",
         "server.reply",
         "router.dispatch",

@@ -287,10 +287,25 @@ def request_spans(req, ctx: TraceContext, collector=COLLECTOR) -> list[dict]:
             if collector is not None:
                 collector.record(rec)
     if req.prefill_finished is not None and req.finished is not None:
-        phase(
+        dec = phase(
             "serving.decode", req.prefill_finished, req.finished,
             iterations=int(req.iterations), tokens=len(req.tokens),
         )
+        for ev in req.events:
+            # streaming delivery: one child span per chunk frame the
+            # server flushed (the per-chunk trace of the streaming
+            # generate verb), parented under the decode phase
+            if ev["name"] != "serving.stream_chunk":
+                continue
+            rec = span_record(
+                ev["name"], ctx.trace_id, new_id(), dec["span_id"],
+                off + ev["t0"], ev["t1"] - ev["t0"],
+                **{k: v for k, v in ev.items()
+                   if k not in ("name", "t0", "t1")},
+            )
+            out.append(rec)
+            if collector is not None:
+                collector.record(rec)
     for ev in req.events:
         if ev["name"] == "scheduler.blame":
             phase(

@@ -27,7 +27,18 @@ decode path (scheduler -> engine -> server, plus the client).
   K/V for prompt prefixes other requests already prefilled.
 - ``server``/``client``: the length-prefixed TCP wire
   (``networking``) carrying pickle-free ``DKT1`` frames
-  (``utils.serialization``), verbs generate/predict/health/stats/stop.
+  (``utils.serialization``), verbs generate/predict/health/stats/stop
+  — plus STREAMING generate (per-scheduler-iteration token chunk
+  frames, ``ServingClient.generate_stream`` / ``TokenStream`` with
+  deterministic resend-and-skip recovery; TTFT measured at first
+  DELIVERED chunk) and the disaggregation verbs ``prefill`` /
+  ``kv.transfer``.
+- ``kv_transfer``: the versioned byte codec of a slot's host state
+  (the PrefixStore/QoS-swap row format + ctx/sampler state) — the
+  disaggregated prefill/decode transfer frame. ``ServingEngine(role=
+  "prefill")`` exports finished prefills through it; ``role="decode"``
+  resumes them token-identically; the ``FleetRouter`` dispatches by
+  role with bounded typed retries.
 - ``fleet``: N replica servers behind a ``FleetRouter`` speaking the
   same wire — health-gated rotation, prefix-affinity routing (shared
   headers land where their KV already lives), fleet-wide overload
@@ -57,6 +68,12 @@ from distkeras_tpu.serving.scheduler import (
     ServeRequest,
     ServingError,
     WindowedBatcher,
+    WrongRoleError,
+)
+from distkeras_tpu.serving.kv_transfer import (
+    KvTransferError,
+    decode_state,
+    encode_state,
 )
 from distkeras_tpu.serving.paging import PageAllocator
 from distkeras_tpu.serving.qos import QosPolicy, TokenBucket
@@ -76,7 +93,7 @@ from distkeras_tpu.serving.prefix_cache import (
     PrefixStore,
 )
 from distkeras_tpu.serving.server import ServingServer, serve
-from distkeras_tpu.serving.client import ServingClient
+from distkeras_tpu.serving.client import ServingClient, TokenStream
 from distkeras_tpu.serving.fleet import (
     FleetController,
     FleetRouter,
@@ -93,6 +110,7 @@ __all__ = [
     "FleetController",
     "FleetRouter",
     "InternalError",
+    "KvTransferError",
     "ModelDrafter",
     "NgramDrafter",
     "OverloadedError",
@@ -109,8 +127,12 @@ __all__ = [
     "ServingError",
     "ServingServer",
     "TokenMaskCompiler",
+    "TokenStream",
     "WindowedBatcher",
+    "WrongRoleError",
     "affinity_key",
+    "decode_state",
+    "encode_state",
     "local_replica_factory",
     "seed_for_completion",
     "serve",

@@ -34,6 +34,8 @@ into router internals.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from distkeras_tpu.networking import RetryPolicy, connect, recv_data, send_data
@@ -344,6 +346,57 @@ class ServingClient:
             return [np.asarray(s) for s in out]  # n parallel completions
         return np.asarray(out)
 
+    def generate_stream(self, prompt, max_new_tokens, eos_id=None,
+                        deadline_ms=None, sampling=None, tenant=None,
+                        priority=None, trace=False) -> "TokenStream":
+        """Streaming generate: returns a :class:`TokenStream` iterator
+        yielding each scheduler iteration's newly emitted tokens as
+        they arrive over the wire. After exhaustion, ``.sequence``
+        holds the full eos-trimmed sequence (identical to what plain
+        ``generate`` returns) and ``.ttft_s`` the REAL time to first
+        byte — request send to first chunk frame received.
+
+        Resilience: greedy and seeded-sampled streams are
+        deterministic, so a stream is idempotent the same way a
+        generate is — on a mid-stream connection death (or a retriable
+        typed refusal) the client RESENDS the whole request and SKIPS
+        the tokens it already yielded, bounded by the client's
+        ``RetryPolicy``. The caller's iterator never sees a duplicate
+        or a gap. One stream at a time per client (it occupies the
+        connection until the terminal frame)."""
+        from distkeras_tpu.serving.sampling import SamplingParams
+
+        header = {
+            "verb": "generate",
+            "stream": True,
+            "max_new_tokens": int(max_new_tokens),
+        }
+        if eos_id is not None:
+            header["eos_id"] = int(eos_id)
+        if deadline_ms is not None:
+            header["deadline_ms"] = float(deadline_ms)
+        sampling = SamplingParams.from_wire(sampling)
+        if sampling is not None:
+            header["sampling"] = sampling.to_wire()
+        if tenant is not None:
+            header["tenant"] = str(tenant)
+        if priority is not None:
+            header["priority"] = int(priority)
+        ctx = None
+        if trace:
+            # like generate(trace=True): a terminal client.request
+            # span plus whatever timeline the terminal frame returns
+            # (incl. the per-chunk serving.stream_chunk spans),
+            # assembled onto client.last_trace at stream end
+            from distkeras_tpu.obs import TraceContext
+
+            ctx = TraceContext.new(want_timeline=True)
+        return TokenStream(
+            self, header,
+            serialize_params(np.asarray(prompt, np.int32)),
+            trace_ctx=ctx,
+        )
+
     def _assemble_trace(self, ctx, wire_trace, client_record) -> dict:
         spans = list((wire_trace or {}).get("timeline") or [])
         spans.append(client_record)
@@ -406,3 +459,176 @@ class ServingClient:
         here usually IS the shutdown taking effect."""
         reply, _ = self._call({"verb": "stop"}, idempotent=False)
         return reply
+
+
+class TokenStream:
+    """Client face of a streaming generate: iterate for per-iteration
+    token chunks (1-D int32 arrays of NEW tokens); after exhaustion
+    read ``.sequence`` (the full eos-trimmed sequence), ``.ttft_s``
+    (first send -> first chunk frame received — the honest first-byte
+    TTFT), ``.tokens`` (every token yielded, in order), and
+    ``.inter_token_s`` (per-chunk arrival gaps after the first — the
+    inter-token latency samples the disagg bench aggregates).
+
+    Retry semantics: the stream RESENDS the whole request after a
+    mid-stream connection death or a retriable typed refusal
+    (``overloaded`` / ``unavailable``), then discards the tokens it
+    already yielded — safe because served decode is deterministic in
+    (prompt, params). Bounded by the owning client's ``RetryPolicy``
+    (no policy = no resends, failures surface raw)."""
+
+    def __init__(self, client: ServingClient, header: dict,
+                 payload: bytes, trace_ctx=None):
+        self._client = client
+        self._header = header
+        self._payload = payload
+        self._ctx = trace_ctx
+        self._span = None
+        self._started = False
+        self._done = False
+        self._skip = 0          # tokens to swallow after a resend
+        self._attempt = 0       # retries consumed (the policy budget)
+        self._sends = 0         # wire attempts (trace span attribute)
+        self._t0 = None         # first send instant (TTFT anchor)
+        self._t_start = None    # wall anchor of retry budget
+        self._last_chunk_t = None
+        self.tokens: list[int] = []
+        self.sequence = None
+        self.ttft_s = None
+        self.inter_token_s: list[float] = []
+        self.served_by = None
+
+    def __iter__(self) -> "TokenStream":
+        return self
+
+    def _send(self):
+        cli = self._client
+        if self._ctx is not None:
+            if self._span is None:
+                from distkeras_tpu.obs import start_span
+
+                self._span = start_span(
+                    "client.request", self._ctx, verb="generate",
+                    stream=True,
+                    endpoint=f"{cli._host}:{cli._port}",
+                )
+            # a fresh child context per attempt, like generate's
+            self._header["trace"] = self._ctx.child().to_wire()
+        # anchor the TTFT / retry-budget clocks BEFORE the dial: a
+        # refused first dial must still have a budget to reason about
+        # (and connect time is part of the honest first-byte TTFT)
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+            self._t_start = time.monotonic()
+        if cli._sock is None:
+            cli._sock = cli._dial()
+        send_data(cli._sock, pack_frame(self._header, self._payload))
+        self._started = True
+        self._sends += 1
+
+    def _end_trace(self, status, wire_trace):
+        if self._span is None:
+            return
+        rec = self._span.end(
+            status=status, terminal=True, attempts=max(1, self._sends),
+        )
+        self._client._assemble_trace(self._ctx, wire_trace, rec)
+        self._span = None
+
+    def _maybe_retry(self, exc) -> bool:
+        """One resend decision under the client's policy: True =
+        resend scheduled (skip set), False = surface ``exc``."""
+        policy = self._client._retry
+        if policy is None:
+            return False
+        self._attempt += 1
+        if self._attempt >= policy.max_attempts:
+            return False
+        d = policy.delay(
+            self._attempt - 1, hint=getattr(exc, "retry_after", None)
+        )
+        start = (
+            self._t_start if self._t_start is not None
+            else time.monotonic()
+        )
+        if policy.budget is not None and (
+            time.monotonic() - start + d > policy.budget
+        ):
+            return False
+        time.sleep(d)
+        self._skip = len(self.tokens)
+        self._started = False
+        return True
+
+    def __next__(self) -> np.ndarray:
+        cli = self._client
+        while True:
+            if self._done:
+                raise StopIteration
+            try:
+                if not self._started:
+                    self._send()
+                raw = recv_data(cli._sock)
+            except (ConnectionError, OSError) as e:
+                cli._drop()
+                if self._maybe_retry(e):
+                    continue
+                self._done = True
+                self._end_trace("connection_error", None)
+                raise
+            reply, body = unpack_frame(raw)
+            kind = reply.get("stream")
+            if kind == "chunk":
+                now = time.perf_counter()
+                if self.ttft_s is None:
+                    self.ttft_s = now - self._t0
+                else:
+                    self.inter_token_s.append(now - self._last_chunk_t)
+                self._last_chunk_t = now
+                toks = [int(t) for t in reply["tokens"]]
+                if self._skip:
+                    # replayed prefix of a resent stream: identical by
+                    # determinism, already delivered — swallow it
+                    take = toks[self._skip:]
+                    self._skip = max(0, self._skip - len(toks))
+                    if not take:
+                        continue
+                    toks = take
+                self.tokens.extend(toks)
+                return np.asarray(toks, np.int32)
+            if kind == "end":
+                self.sequence = np.asarray(deserialize_params(body))
+                ep = cli.connected_endpoint
+                reply.setdefault(
+                    "served_by",
+                    None if ep is None else [ep[0], ep[1]],
+                )
+                if reply.get("served_by") is not None:
+                    self.served_by = (
+                        reply["served_by"][0],
+                        int(reply["served_by"][1]),
+                    )
+                    cli.last_served_by = self.served_by
+                self._done = True
+                self._end_trace("ok", reply.get("trace"))
+                raise StopIteration
+            # typed error frame (terminal for this attempt)
+            err = cli._typed_error({**reply, "ok": False})
+            if isinstance(err, OverloadedError) or (
+                getattr(err, "code", None) == "unavailable"
+            ):
+                if self._maybe_retry(err):
+                    continue
+            self._done = True
+            self._end_trace(
+                getattr(err, "code", "error"), reply.get("trace")
+            )
+            raise err
+
+    def result(self) -> np.ndarray:
+        """Drain the rest of the stream and return the full
+        sequence — the one-call face for callers that wanted
+        streaming TTFT but not incremental consumption."""
+        for _ in self:
+            pass
+        return self.sequence

@@ -45,7 +45,9 @@ from distkeras_tpu.serving.scheduler import (
     EngineStoppedError,
     InternalError,
     ServeRequest,
+    ServingError,
     WindowedBatcher,
+    WrongRoleError,
 )
 from distkeras_tpu.utils.profiling import annotate
 
@@ -2449,7 +2451,8 @@ class ServingEngine:
                  flight_recorder=True,
                  recorder_capacity=2048, postmortem_dir=None,
                  slos=None, slo_interval=5.0, paged=False,
-                 page_size=16, num_pages=None, qos=None, mesh=None):
+                 page_size=16, num_pages=None, qos=None, mesh=None,
+                 role="unified"):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -2540,6 +2543,20 @@ class ServingEngine:
         from distkeras_tpu.obs import MetricsRegistry
 
         self.model = model
+        # disaggregated serving role: "unified" (the default — both
+        # prefill and decode, every path byte-for-byte as before),
+        # "prefill" (admission + chunked prefill only; finished slots
+        # are EXPORTED in the kv_transfer wire format instead of
+        # decoded — plain generate is refused typed ``wrong_role``),
+        # or "decode" (decodes transferred slots via ``resume``; the
+        # ``prefill`` face is refused — plain generate stays allowed,
+        # a decode worker CAN serve from scratch and warmups use it).
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'unified', 'prefill', or 'decode'; "
+                f"got {role!r}"
+            )
+        self.role = str(role)
         self._stepper = None
         self._decode_err = None
         self.prefix_store = None
@@ -2751,6 +2768,38 @@ class ServingEngine:
                 None if self._stepper is None
                 else self._stepper.kv_shard_bytes()
             ),
+        )
+        # disaggregated-serving observability: the role as a stable id
+        # (0 unified / 1 prefill / 2 decode — ``dkt_top`` renders the
+        # name), the transfer ledger (sends/recvs/errors + bytes both
+        # directions), and the in-flight transfer queue depth (prefill
+        # requests admitted but not yet exported+encoded, resumes not
+        # yet admitted) — the "is the transfer path backing up" gauge
+        reg.gauge(
+            "serving_engine_role_id",
+            fn=lambda: {"unified": 0, "prefill": 1, "decode": 2}[
+                self.role
+            ],
+        )
+        self._transfer_pending = 0
+        reg.gauge(
+            "serving_transfer_pending",
+            fn=lambda: self._transfer_pending,
+        )
+        self.transfer_sends = reg.counter(
+            "serving_transfer_sends", fresh=True
+        )
+        self.transfer_recvs = reg.counter(
+            "serving_transfer_recvs", fresh=True
+        )
+        self.transfer_errors = reg.counter(
+            "serving_transfer_errors", fresh=True
+        )
+        self.transfer_bytes_out = reg.counter(
+            "serving_transfer_bytes_out", fresh=True
+        )
+        self.transfer_bytes_in = reg.counter(
+            "serving_transfer_bytes_in", fresh=True
         )
         if paged:
             # page-pool occupancy gauges, read from whichever stepper
@@ -3080,7 +3129,8 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens, eos_id=None,
                deadline=None, trace=None, sampling=None, tenant=None,
-               priority=0) -> ServeRequest:
+               priority=0, stream=False,
+               _prefill_only=False) -> ServeRequest:
         """``trace``: an optional ``obs.TraceContext`` — the scheduler
         then keeps the per-request event ledger ``obs.request_spans``
         turns into the server-side phase timeline. None (the default)
@@ -3095,12 +3145,23 @@ class ServingEngine:
         ``tenant``/``priority``: the request's QoS identity (default
         tenant "default", priority 0). Without a ``qos`` policy they
         only label metrics; with one they pick the WFQ share and the
-        priority class (higher = more urgent, may preempt)."""
+        priority class (higher = more urgent, may preempt).
+
+        ``stream``: the scheduler pushes each iteration's emitted
+        tokens into the request's chunk FIFO (``req.next_chunk``) as
+        they are generated — the server's streaming ``generate``
+        drains it to the wire per chunk."""
         from distkeras_tpu.serving.sampling import (
             SamplingParams,
             check_spec_sampling,
         )
 
+        if self.role == "prefill" and not _prefill_only:
+            raise WrongRoleError(
+                "this engine serves role 'prefill': plain generate is "
+                "not served here — route prompts through the prefill "
+                "verb (the fleet router does this by role)"
+            )
         batcher = self.batcher  # one read: restarts swap the attribute
         if batcher is None:
             raise EngineStoppedError(
@@ -3127,8 +3188,16 @@ class ServingEngine:
         req = ServeRequest(
             prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
             trace=trace, sampling=sampling, tenant=tenant,
-            priority=priority,
+            priority=priority, stream=stream,
+            prefill_only=_prefill_only,
         )
+        return self._admit(req)
+
+    def _admit(self, req: ServeRequest) -> ServeRequest:
+        """The one admission path ``submit`` and ``resume`` share:
+        batcher submit with the restart-race translation, plus the
+        submit-time metrics line."""
+        batcher = self.batcher
         try:
             try:
                 return batcher.submit(req)
@@ -3207,6 +3276,174 @@ class ServingEngine:
                 )
                 if req.trace is not None:
                     self.drain_traces()
+
+    # -- disaggregated prefill/decode ---------------------------------------
+
+    def _record_transfer(self, event, **fields):
+        if self.recorder is not None:
+            self.recorder.record(event, **fields)
+
+    def prefill(self, prompt, max_new_tokens, eos_id=None,
+                deadline=None, sampling=None, tenant=None, priority=0,
+                timeout=None):
+        """The prefill worker's half of the role split: admit +
+        chunked-prefill ``prompt``, then serialize the finished slot
+        (KV rows in the PR 12 swap format + ctx/sampler state) into
+        one ``kv_transfer`` wire frame and free the slot — the decode
+        half is ``resume`` on another engine. Returns ``(blob, meta)``
+        where ``meta`` is the JSON-able transfer summary the wire
+        reply header carries.
+
+        Failure contract: the ``kv.transfer`` fault seam fires
+        (direction "send") before the state is encoded; any failure —
+        seam, export, codec — fails ONLY this request, typed (a
+        ``ServingError`` passes through, anything else becomes
+        ``internal``), counts in ``serving_transfer_errors``, and
+        lands on the flight tape as ``kv.transfer.error`` naming the
+        exception class."""
+        from distkeras_tpu.serving import kv_transfer
+
+        if self.role == "decode":
+            raise WrongRoleError(
+                "this engine serves role 'decode': it resumes "
+                "transferred slots, it does not prefill for export"
+            )
+        from distkeras_tpu.serving.sampling import SamplingParams
+
+        sampling = SamplingParams.from_wire(sampling)
+        req = self.submit(
+            prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
+            sampling=sampling, tenant=tenant, priority=priority,
+            _prefill_only=True,
+        )
+        self._transfer_pending += 1
+        try:
+            faults.fire("kv.transfer", direction="send",
+                        request_id=req.id)
+            self.wait(req, timeout)  # raises the typed failure, if any
+            blob = kv_transfer.encode_state(
+                req.export, prompt_len=int(req.prompt.size),
+                sampling=sampling, eos_id=req.eos_id,
+            )
+        except Exception as e:  # noqa: BLE001 — transfer boundary
+            self.transfer_errors.inc()
+            self._record_transfer(
+                "kv.transfer.error", op="send", request_id=req.id,
+                error=type(e).__name__, detail=repr(e)[:200],
+            )
+            if isinstance(e, ServingError):
+                raise
+            raise InternalError(
+                f"kv transfer send failed: {e!r}"
+            ) from e
+        finally:
+            self._transfer_pending -= 1
+            req.export = None  # host KV rows released with the frame
+        self.transfer_sends.inc()
+        self.transfer_bytes_out.inc(len(blob))
+        meta = {
+            "len": int(req.prompt.size),
+            "prompt_len": int(req.prompt.size),
+            "bytes": len(blob),
+            "version": kv_transfer.VERSION,
+        }
+        self._record_transfer(
+            "kv.transfer.send", request_id=req.id, bytes=len(blob),
+            tokens=int(req.prompt.size),
+        )
+        return blob, meta
+
+    def resume(self, state, max_new_tokens, eos_id=None, deadline=None,
+               trace=None, tenant=None, priority=0,
+               stream=False) -> ServeRequest:
+        """The decode worker's half: admit a TRANSFERRED slot — a
+        ``kv_transfer`` wire frame (bytes) or an already-decoded state
+        dict — and decode it to completion. Returns the ``ServeRequest``
+        handle (``wait`` for the sequence; ``stream=True`` for the
+        chunk FIFO the server drains). The resumed stream is pinned
+        token-identical to an uninterrupted decode of the same
+        (prompt, params) on one engine — the PR 12 swap identity,
+        now crossing a process boundary.
+
+        The ``kv.transfer`` seam fires (direction "recv") before the
+        frame is decoded; a corrupt/truncated frame raises the typed
+        ``KvTransferError`` (never a hang, nothing admitted), and
+        every failure lands on the tape naming its class."""
+        from distkeras_tpu.serving import kv_transfer
+
+        if self.role == "prefill":
+            raise WrongRoleError(
+                "this engine serves role 'prefill': transferred slots "
+                "resume on a decode worker"
+            )
+        if self.batcher is None:
+            # same typed refusal submit() gives this state — a
+            # predict-only engine must not launder it into internal
+            raise EngineStoppedError(
+                f"model does not support generate: {self._decode_err}"
+            )
+        try:
+            faults.fire("kv.transfer", direction="recv")
+            nbytes = None
+            if isinstance(state, (bytes, bytearray, memoryview)):
+                nbytes = len(state)
+                state = kv_transfer.decode_state(bytes(state))
+            sampling = state.get("sampling")
+            plen = int(state["prompt_len"])
+            ln = int(state["len"])
+            ctx = np.asarray(state["ctx"], np.int32)
+            emitted = [int(t) for t in ctx[plen:ln]]
+            grammar = None
+            if sampling is not None and sampling.grammar is not None:
+                # grammar state is a pure function of (spec, eos,
+                # consumed tokens): recompile and replay — no
+                # executable state ever rides the frame
+                grammar = self._stepper._mask_compiler.compile(
+                    sampling.grammar, eos_id=state.get("eos_id")
+                )
+                for t in emitted:
+                    grammar.advance(t)
+            req = ServeRequest(
+                ctx[:plen], max_new_tokens,
+                eos_id=(
+                    state.get("eos_id") if eos_id is None else eos_id
+                ),
+                deadline=deadline, trace=trace, sampling=sampling,
+                tenant=tenant, priority=priority, stream=stream,
+            )
+            req.tokens.extend(emitted)
+            # the stepper-format swap dict _resume hands to swap_in —
+            # exactly what a QoS preemption parks on the request
+            req._swap = {
+                "len": ln,
+                "ctx": ctx[:ln],
+                "kv": state["kv"],
+                "spos": int(state["spos"]),
+                "seed": int(state["seed"]),
+                "params": sampling,
+                "grammar": grammar,
+                "spec_prompt": state.get("spec_prompt"),
+            }
+            self._admit(req)
+        except Exception as e:  # noqa: BLE001 — transfer boundary
+            self.transfer_errors.inc()
+            self._record_transfer(
+                "kv.transfer.error", op="recv",
+                error=type(e).__name__, detail=repr(e)[:200],
+            )
+            if isinstance(e, ServingError):
+                raise
+            raise InternalError(
+                f"kv transfer receive failed: {e!r}"
+            ) from e
+        self.transfer_recvs.inc()
+        if nbytes is not None:
+            self.transfer_bytes_in.inc(nbytes)
+        self._record_transfer(
+            "kv.transfer.recv", request_id=req.id,
+            bytes=nbytes, tokens=ln,
+        )
+        return req
 
     def drain_traces(self) -> int:
         """Flush this engine's trace collector into its
@@ -3321,6 +3558,19 @@ class ServingEngine:
             return latest_postmortem(self.postmortem_dir)
         return None, None
 
+    def transfer_snapshot(self) -> dict:
+        """The kv-transfer ledger (rides ``health``/``stats``):
+        frames sent/received/errored, bytes both directions, and the
+        in-flight transfer queue depth."""
+        return {
+            "pending": self._transfer_pending,
+            "sends": self.transfer_sends.value,
+            "recvs": self.transfer_recvs.value,
+            "errors": self.transfer_errors.value,
+            "bytes_out": self.transfer_bytes_out.value,
+            "bytes_in": self.transfer_bytes_in.value,
+        }
+
     def health(self) -> dict:
         """Liveness summary, cheap enough for a load balancer to poll:
         ``status`` is ``serving`` (scheduler heartbeating), ``degraded``
@@ -3354,6 +3604,10 @@ class ServingEngine:
             status = "serving" if healthy else "degraded"
         out = {
             "status": status,
+            # the disaggregation role rides health so the fleet
+            # router's books (and its role-aware dispatch) learn each
+            # replica's role from the same poll that gates rotation
+            "role": self.role,
             "restarts": self._restarts,
             "max_restarts": self.max_restarts,
             "restart_budget_exhausted": self._failed,
@@ -3361,6 +3615,7 @@ class ServingEngine:
             "quarantined_slots": (
                 0 if batcher is None else len(batcher._quarantined)
             ),
+            "transfer": self.transfer_snapshot(),
         }
         if batcher is not None:
             # load surface for routers/load-balancers: occupancy plus
@@ -3424,6 +3679,8 @@ class ServingEngine:
         out["restarts"] = self._restarts
         out["watchdog_trips"] = self._watchdog_trips
         out["status"] = self.health()["status"]
+        out["role"] = self.role
+        out["transfer"] = self.transfer_snapshot()
         out["prefix_cache"] = (
             self.prefix_store.stats()
             if self.prefix_store is not None

@@ -118,6 +118,12 @@ DRAINING = "draining"  # router-initiated: no new work, in-flight
                        # finishes; sticky until remove_replica
 
 
+class _RetrySibling(Exception):
+    """Internal control flow of the streaming relay: the current
+    replica refused/died before any chunk reached the client — move
+    to the next candidate."""
+
+
 class _Replica:
     """Router-side book of one replica endpoint."""
 
@@ -148,6 +154,14 @@ class _Replica:
             # need N devices only where an N-way replica runs, and the
             # router's books show a heterogeneous fleet honestly
             "mesh": h.get("mesh"),
+            # the replica's disaggregation role (prefill / decode /
+            # unified), from its health — what role-aware dispatch
+            # keys on, and the role column the books render
+            "role": h.get("role"),
+            # the replica's transfer ledger (pending/sends/recvs/
+            # errors/bytes), so the fleet books show where transfer
+            # traffic queues without a per-replica metrics scrape
+            "transfer": h.get("transfer"),
         }
 
 
@@ -256,7 +270,23 @@ class FleetRouter:
                 "ejections",
                 "rejoins",
                 "quota_rejections",  # per-tenant admission refusals
+                # disaggregated dispatch (0 on a role-less fleet).
+                # Pairing invariant at quiescence: transfer_sends ==
+                # transfer_ok + transfer_typed — every transfer hop
+                # dispatched ends in a relayed reply or a typed
+                # failure, never a stranded client
+                "disagg_routed",   # generates taking the two-hop path
+                "transfer_sends",  # kv.transfer hops dispatched
+                "transfer_ok",     # ... that completed ok
+                "transfer_typed",  # ... that ended typed (any error)
+                "transfer_retries",  # mid-hop deaths retried on a
+                # sibling decode worker (same bytes, bounded)
             ),
+        )
+        self._transfer_inflight = 0
+        self.registry.gauge(
+            "fleet_router_transfer_inflight",
+            fn=lambda: self._transfer_inflight,
         )
         self.registry.gauge(
             "fleet_router_replicas", fn=lambda: len(self._replicas)
@@ -696,6 +726,16 @@ class FleetRouter:
             req_header = {}
             try:
                 req_header, payload = unpack_frame(frame)
+                if req_header.get("stream") and (
+                    req_header.get("verb") == "generate"
+                ):
+                    # streaming relay: the router pumps the replica's
+                    # chunk frames through to the client itself
+                    if not self._stream_route(conn, req_header, payload):
+                        return
+                    if self._stopping.is_set():
+                        return
+                    continue
                 reply = self._dispatch(req_header, payload)
             except ServingError as e:
                 header = {"ok": False, "error": e.code, "detail": str(e)}
@@ -773,11 +813,35 @@ class FleetRouter:
             retry_after_ms=wait * 1e3,
         )
 
+    def _roles(self):
+        """Role partition of the ACTIVE rotation: ``(prefill_n,
+        decode_n, disagg)`` — disagg dispatch engages only when BOTH
+        roles are represented (a half-provisioned role split keeps
+        routing to whatever can serve alone)."""
+        with self._lock:
+            pre = sum(
+                r.state == ACTIVE
+                and (r.last_health or {}).get("role") == "prefill"
+                for r in self._replicas.values()
+            )
+            dec = sum(
+                r.state == ACTIVE
+                and (r.last_health or {}).get("role") == "decode"
+                for r in self._replicas.values()
+            )
+        return pre, dec, bool(pre and dec)
+
     def _dispatch(self, header: dict, payload: bytes) -> bytes:
         verb = header.get("verb")
         faults.fire("router.dispatch", verb=verb)
         if verb == "generate":
             self._check_quota(header)
+            if self._roles()[2]:
+                # role-split fleet: prompts prefill on a prefill
+                # worker, the finished slot resumes on a decode
+                # worker — the two-hop disaggregated path
+                reply, body = self._route_disagg(header, payload)
+                return pack_frame(reply, body)
         if verb in ("generate", "predict"):
             reply, body = self._route(header, payload)
             return pack_frame(reply, body)
@@ -815,6 +879,12 @@ class FleetRouter:
             status = "serving"
         else:
             status = "degraded"
+        roles: dict = {}
+        for r in reps:
+            if r["state"] == ACTIVE:
+                roles[r.get("role") or "unified"] = (
+                    roles.get(r.get("role") or "unified", 0) + 1
+                )
         return {
             "ok": True,
             "protocol": _PROTOCOL,
@@ -824,6 +894,13 @@ class FleetRouter:
             "max_frame_bytes": self.max_frame_bytes,
             "replicas": reps,
             "active_replicas": active,
+            # the role census + whether two-hop dispatch is engaged —
+            # a half-provisioned role split is visible here, not just
+            # as mysteriously-unified routing
+            "roles": roles,
+            "disagg": bool(
+                roles.get("prefill") and roles.get("decode")
+            ),
         }
 
     def stats(self) -> dict:
@@ -947,13 +1024,20 @@ class FleetRouter:
             return None    # bad_request; routing must not pre-judge it
         return affinity_key(prompt, min_len=self.affinity_min_len)
 
-    def _pick(self, key, excluded):
+    def _pick(self, key, excluded, roles=None):
         """One routing decision under the lock: ``(replica, how)`` or
         ``(None, why)`` — ``why`` is "empty" (nothing in rotation),
         "tried" (every rotation member already excluded this request),
-        or "saturated" (members remain but none has capacity)."""
+        or "saturated" (members remain but none has capacity).
+        ``roles``: restrict candidates to replicas whose health
+        advertises one of these disaggregation roles (None = any —
+        the role-less fleet's behavior, byte-for-byte)."""
         cands = [
-            r for r in self._replicas.values() if r.state == ACTIVE
+            r for r in self._replicas.values()
+            if r.state == ACTIVE and (
+                roles is None
+                or (r.last_health or {}).get("role") in roles
+            )
         ]
         if not cands:
             return None, "empty"
@@ -1028,9 +1112,15 @@ class FleetRouter:
                 tr.setdefault("timeline", []).append(rec)
             return reply
 
+        # a prefill-role worker can never serve a plain generate
+        # (typed wrong_role) — keep it out of the candidate set even
+        # when the decode side of a role split is temporarily gone
+        roles = (
+            (None, "unified", "decode") if verb == "generate" else None
+        )
         while True:
             with self._lock:
-                rep, how = self._pick(key, excluded)
+                rep, how = self._pick(key, excluded, roles=roles)
                 if rep is not None:
                     rep.in_flight += 1
                     rep.forwards += 1
@@ -1135,6 +1225,470 @@ class FleetRouter:
                 "ok" if reply.get("ok") else str(reply.get("error")),
                 how=how, replica=f"{ep[0]}:{ep[1]}",
             ), body
+
+    # -- disaggregated dispatch (prefill -> kv.transfer -> decode) ----------
+
+    def _forward_loop(self, header, payload, key, roles, hops, causes,
+                      ctx=None, retry_counter=None):
+        """Bounded forward of ONE request to a role-filtered replica
+        set: pick (affinity when ``key``, else least-loaded), forward,
+        fail over on connection death / replica ``overloaded`` —
+        each replica tried at most once. Returns ``(reply, body, ep)``
+        on any relayed reply (ok or typed), or ``(None, (why, hint),
+        None)`` when no replica could take it."""
+        excluded: set = set()
+        saw_hint = None
+        while True:
+            with self._lock:
+                rep, how = self._pick(key, excluded, roles=roles)
+                if rep is not None:
+                    rep.in_flight += 1
+                    rep.forwards += 1
+                    self.counters["forwards"] += 1
+                    self.counters[
+                        {"affinity": "affinity_routed",
+                         "spill": "spilled",
+                         "least_loaded": "least_loaded_routed"}[how]
+                    ] += 1
+                    ep = rep.endpoint
+            if rep is None:
+                if saw_hint is not None and how != "saturated":
+                    how = "saturated"
+                return None, (how, saw_hint), None
+            if ctx is not None:
+                header["trace"] = ctx.child().to_wire()
+            fwd_t0 = time.monotonic()
+            try:
+                cli = self._checkout(ep)
+                try:
+                    reply, body = cli._roundtrip(
+                        header, payload, raise_on_error=False
+                    )
+                except BaseException:
+                    cli.close()
+                    raise
+                self._checkin(ep, cli)
+            except (ConnectionError, OSError) as e:
+                hops.append(f"{ep[0]}:{ep[1]} died")
+                self._forward_died(ep, e, causes, excluded)
+                if retry_counter is not None:
+                    with self._lock:
+                        self.counters[retry_counter] += 1
+                continue
+            finally:
+                self._forward_hist.observe(time.monotonic() - fwd_t0)
+                with self._lock:
+                    r = self._replicas.get(ep)
+                    if r is not None:
+                        r.in_flight -= 1
+                        self._drained.notify_all()
+            if (not reply.get("ok")
+                    and reply.get("error") == "overloaded"):
+                hops.append(f"{ep[0]}:{ep[1]} overloaded")
+                excluded.add(ep)
+                hint = reply.get("retry_after_ms")
+                if hint is not None:
+                    saw_hint = max(saw_hint or 0.0, float(hint))
+                if retry_counter is not None:
+                    with self._lock:
+                        self.counters[retry_counter] += 1
+                continue
+            hops.append(
+                f"{ep[0]}:{ep[1]} "
+                + ("ok" if reply.get("ok") else str(reply.get("error")))
+            )
+            return reply, body, ep
+
+    @staticmethod
+    def _shrink_deadline(theader: dict, hop_t0: float) -> None:
+        """Each server re-anchors ``deadline_ms`` at its own receipt,
+        so a two-hop dispatch must charge hop 1's elapsed time against
+        the budget before hop 2 — otherwise a role-split fleet quietly
+        grants ~double the deadline a unified replica enforces. An
+        exhausted budget is floored at 1 ms: the decode worker then
+        fails it typed ``deadline_exceeded`` itself (one code path for
+        the expiry, not a router-side duplicate)."""
+        if theader.get("deadline_ms") is not None:
+            theader["deadline_ms"] = max(
+                1.0,
+                float(theader["deadline_ms"])
+                - (time.monotonic() - hop_t0) * 1e3,
+            )
+
+    def _no_replica_reply(self, how, hint, causes, what):
+        """The router's own typed reply when a role pool could not
+        take a hop: fleet ``overloaded`` when members were saturated,
+        ``unavailable`` naming every cause otherwise."""
+        if how == "saturated":
+            with self._lock:
+                self.counters["fleet_overloaded"] += 1
+            return {
+                "ok": False, "error": "overloaded",
+                "detail": f"every {what} replica is saturated",
+                "retry_after_ms": float(hint or self.retry_after_ms),
+            }
+        with self._lock:
+            self.counters["unavailable"] += 1
+        detail = (
+            f"no {what} replica in rotation" if how in ("empty", "tried")
+            and not causes
+            else f"every {what} replica failed: " + "; ".join(
+                f"{h}:{p}: {e!r}" for (h, p), e in causes
+            )
+        )
+        return {
+            "ok": False, "error": "unavailable", "detail": detail,
+            "retry_after_ms": self.retry_after_ms,
+        }
+
+    def _route_disagg(self, header: dict, payload: bytes):
+        """The two-hop disaggregated generate: (1) the prompt
+        prefills on a prefill-role worker (least-loaded — prefill is
+        stateless across requests), whose reply payload is the slot's
+        ``kv_transfer`` frame; (2) the frame resumes on a decode-role
+        worker chosen by page-affinity (the same rendezvous hash of
+        the prompt's pow2 ladder key — transferred pages of a shared
+        header land where its siblings already decoded), relayed back
+        verbatim. Both hops fail over bounded and typed: a mid-hop
+        death retries a sibling (the transfer frame is re-sent
+        byte-identical — resume is deterministic and idempotent), and
+        exhaustion is the router's typed ``overloaded``/
+        ``unavailable``, never a hang."""
+        from distkeras_tpu.obs import TraceContext, start_span
+
+        ctx = TraceContext.from_wire(header.get("trace"))
+        span = None
+        hops: list[str] = []
+        causes: list = []
+        key = self._affinity_key("generate", payload)
+        if ctx is not None:
+            span = start_span(
+                "router.route", ctx, verb="generate", disagg=True,
+                affinity_key=(
+                    None if key is None
+                    else hashlib.blake2b(key, digest_size=4).hexdigest()
+                ),
+            )
+
+        def finish(reply, status, **attrs):
+            if span is None:
+                return reply
+            rec = span.end(
+                status=status, hops=hops, failovers=len(causes),
+                **attrs,
+            )
+            tr = reply.setdefault("trace", {"id": ctx.trace_id})
+            if ctx.want_timeline:
+                tr.setdefault("timeline", []).append(rec)
+            return reply
+
+        with self._lock:
+            self.counters["disagg_routed"] += 1
+        hop_t0 = time.monotonic()
+        # hop 1: prefill (role-filtered; least-loaded — no KV lives
+        # anywhere yet, so there is nothing to be affine TO)
+        pheader = dict(header)
+        pheader["verb"] = "prefill"
+        pheader.pop("stream", None)
+        reply1, blob, ep1 = self._forward_loop(
+            pheader, payload, None, ("prefill",), hops, causes, ctx=ctx,
+        )
+        if reply1 is None:
+            how, hint = blob
+            self.recorder.record(
+                "router.route", verb="generate", disagg=True,
+                outcome=f"prefill_{how}", hops=hops,
+            )
+            return finish(
+                self._no_replica_reply(how, hint, causes, "prefill"),
+                "prefill_" + how,
+            ), b""
+        if not reply1.get("ok"):
+            # the prefill worker's typed reply relays verbatim
+            self.recorder.record(
+                "router.route", verb="generate", disagg=True,
+                outcome=f"prefill_{reply1.get('error')}", hops=hops,
+            )
+            return finish(reply1, str(reply1.get("error"))), b""
+        # hop 2: kv.transfer (role-filtered; page-affinity). The
+        # sampling params already ride INSIDE the transfer frame.
+        theader = dict(header)
+        theader["verb"] = "kv.transfer"
+        theader.pop("sampling", None)
+        theader.pop("stream", None)
+        self._shrink_deadline(theader, hop_t0)
+        with self._lock:
+            self.counters["transfer_sends"] += 1
+            self._transfer_inflight += 1
+        try:
+            reply2, body2, ep2 = self._forward_loop(
+                theader, blob, key, ("decode",), hops, causes,
+                ctx=ctx, retry_counter="transfer_retries",
+            )
+        finally:
+            with self._lock:
+                self._transfer_inflight -= 1
+        if reply2 is None:
+            how, hint = body2
+            with self._lock:
+                self.counters["transfer_typed"] += 1
+            self.recorder.record(
+                "router.route", verb="generate", disagg=True,
+                outcome=f"transfer_{how}", hops=hops,
+                prefill=f"{ep1[0]}:{ep1[1]}",
+            )
+            return finish(
+                self._no_replica_reply(how, hint, causes, "decode"),
+                "transfer_" + str(how),
+            ), b""
+        with self._lock:
+            self.counters[
+                "transfer_ok" if reply2.get("ok") else "transfer_typed"
+            ] += 1
+        self.recorder.record(
+            "router.route", verb="generate", disagg=True,
+            prefill=f"{ep1[0]}:{ep1[1]}",
+            decode=f"{ep2[0]}:{ep2[1]}",
+            failovers=len(causes),
+            outcome=(
+                "ok" if reply2.get("ok") else str(reply2.get("error"))
+            ),
+        )
+        return finish(
+            reply2,
+            "ok" if reply2.get("ok") else str(reply2.get("error")),
+            prefill=f"{ep1[0]}:{ep1[1]}",
+            decode=f"{ep2[0]}:{ep2[1]}",
+        ), body2
+
+    # -- streaming relay ----------------------------------------------------
+
+    def _send_client(self, conn, frame) -> bool:
+        try:
+            send_data(conn, frame)
+            return True
+        except (ConnectionError, OSError):
+            return False
+
+    def _stream_route(self, conn, header: dict, payload: bytes) -> bool:
+        """Route one STREAMING generate and pump the serving side's
+        frames through to the client. Role-split fleets run the
+        prefill hop request/reply first, then stream the
+        ``kv.transfer`` hop; role-less fleets stream the generate
+        directly. Returns False when the CLIENT connection is gone.
+
+        Failover contract: a replica death BEFORE any chunk was
+        relayed retries a sibling transparently (deterministic decode
+        makes the resend invisible); a death AFTER tokens reached the
+        client cannot be hidden — the client gets a typed retriable
+        ``unavailable`` and its ``TokenStream`` resends the whole
+        request, skipping the tokens it already delivered."""
+        verb = header.get("verb")
+        try:
+            faults.fire("router.dispatch", verb=verb)
+            self._check_quota(header)
+            if self._roles()[2]:
+                # hop 1 (request/reply): prefill the prompt
+                hop_t0 = time.monotonic()
+                hops: list[str] = []
+                causes: list = []
+                pheader = dict(header)
+                pheader["verb"] = "prefill"
+                pheader.pop("stream", None)
+                with self._lock:
+                    self.counters["disagg_routed"] += 1
+                reply1, blob, _ep1 = self._forward_loop(
+                    pheader, payload, None, ("prefill",), hops, causes,
+                )
+                if reply1 is None:
+                    how, hint = blob
+                    return self._send_client(conn, pack_frame(
+                        self._no_replica_reply(
+                            how, hint, causes, "prefill"
+                        )
+                    ))
+                if not reply1.get("ok"):
+                    return self._send_client(conn, pack_frame(reply1))
+                theader = dict(header)
+                theader["verb"] = "kv.transfer"
+                theader.pop("sampling", None)
+                self._shrink_deadline(theader, hop_t0)
+                key = self._affinity_key("generate", payload)
+                with self._lock:
+                    self.counters["transfer_sends"] += 1
+                    self._transfer_inflight += 1
+                try:
+                    outcome = self._relay_stream(
+                        conn, theader, blob, key, ("decode",),
+                        retry_counter="transfer_retries",
+                    )
+                finally:
+                    with self._lock:
+                        self._transfer_inflight -= 1
+                with self._lock:
+                    self.counters[
+                        "transfer_ok" if outcome == "ok"
+                        else "transfer_typed"
+                    ] += 1
+                return outcome != "client_gone"
+            # role-less fleet (or a half-provisioned role split):
+            # stream the generate itself — never to a prefill-role
+            # replica, which can only refuse it typed
+            key = self._affinity_key("generate", payload)
+            outcome = self._relay_stream(
+                conn, header, payload, key,
+                (None, "unified", "decode"),
+            )
+            return outcome != "client_gone"
+        except ServingError as e:
+            h = {"ok": False, "error": e.code, "detail": str(e)}
+            if getattr(e, "retry_after", None) is not None:
+                h["retry_after_ms"] = e.retry_after * 1e3
+            _stamp_trace(h, header, e)
+            return self._send_client(conn, pack_frame(h))
+        except Exception as e:  # noqa: BLE001 — wire boundary
+            h = {"ok": False, "error": "internal", "detail": repr(e)}
+            _stamp_trace(h, header, e)
+            return self._send_client(conn, pack_frame(h))
+
+    def _relay_stream(self, conn, header, payload, key, roles,
+                      retry_counter=None) -> str:
+        """Forward a streaming request to a (role-filtered) replica
+        and pump its frames to the client until the terminal one.
+        Returns "ok", "typed" (terminal relayed either way),
+        "failed" (router's own typed reply sent), or "client_gone"."""
+        excluded: set = set()
+        causes: list = []
+        hops: list[str] = []
+        saw_hint = None
+        while True:
+            with self._lock:
+                rep, how = self._pick(key, excluded, roles=roles)
+                if rep is not None:
+                    rep.in_flight += 1
+                    rep.forwards += 1
+                    self.counters["forwards"] += 1
+                    self.counters[
+                        {"affinity": "affinity_routed",
+                         "spill": "spilled",
+                         "least_loaded": "least_loaded_routed"}[how]
+                    ] += 1
+                    ep = rep.endpoint
+            if rep is None:
+                what = "decode" if roles == ("decode",) else "serving"
+                sent = self._send_client(conn, pack_frame(
+                    self._no_replica_reply(
+                        how if saw_hint is None else "saturated",
+                        saw_hint, causes, what,
+                    )
+                ))
+                return "failed" if sent else "client_gone"
+            forwarded = 0
+            cli = None
+            try:
+                try:
+                    # checkout INSIDE the wire-death handler: the
+                    # pooled client dials eagerly, so a hard-killed
+                    # replica fails right here and must ride the same
+                    # eject-and-retry path as a mid-stream death
+                    cli = self._checkout(ep)
+                    send_data(cli._sock, pack_frame(header, payload))
+                    while True:
+                        raw = recv_data(cli._sock)
+                        reply, body = unpack_frame(raw)
+                        terminal = (
+                            not reply.get("ok")
+                            or reply.get("stream") == "end"
+                            or reply.get("stream") is None
+                        )
+                        if reply.get("error") == "overloaded" and (
+                            forwarded == 0
+                        ):
+                            # replica-level saturation: try a sibling
+                            # (the client never sees this refusal)
+                            self._checkin(ep, cli)
+                            cli = None
+                            hops.append(f"{ep[0]}:{ep[1]} overloaded")
+                            excluded.add(ep)
+                            hint = reply.get("retry_after_ms")
+                            if hint is not None:
+                                saw_hint = max(
+                                    saw_hint or 0.0, float(hint)
+                                )
+                            if retry_counter is not None:
+                                with self._lock:
+                                    self.counters[retry_counter] += 1
+                            raise _RetrySibling()
+                        if terminal:
+                            # placement truth on the terminal frame:
+                            # the replica that streamed, not the router
+                            reply.setdefault(
+                                "served_by", [ep[0], int(ep[1])]
+                            )
+                            raw = pack_frame(reply, body)
+                        if not self._send_client(conn, raw):
+                            if terminal:
+                                # stream fully consumed: the pooled
+                                # connection is at a frame boundary
+                                self._checkin(ep, cli)
+                            else:
+                                # MID-STREAM: the replica will keep
+                                # sending this stream's frames — a
+                                # check-in would poison the pool (the
+                                # next checkout reads leftover chunks
+                                # as its own reply)
+                                cli.close()
+                            cli = None
+                            return "client_gone"
+                        if terminal:
+                            self._checkin(ep, cli)
+                            cli = None
+                            self.recorder.record(
+                                "router.route", verb="generate",
+                                stream=True,
+                                replica=f"{ep[0]}:{ep[1]}",
+                                failovers=len(causes),
+                                outcome=(
+                                    "ok" if reply.get("ok")
+                                    else str(reply.get("error"))
+                                ),
+                            )
+                            return (
+                                "ok" if reply.get("ok") else "typed"
+                            )
+                        forwarded += 1
+                except (ConnectionError, OSError) as e:
+                    if cli is not None:
+                        cli.close()
+                        cli = None
+                    hops.append(f"{ep[0]}:{ep[1]} died")
+                    self._forward_died(ep, e, causes, excluded)
+                    if retry_counter is not None:
+                        with self._lock:
+                            self.counters[retry_counter] += 1
+                    if forwarded == 0:
+                        raise _RetrySibling() from None
+                    # tokens already reached the client: the death
+                    # cannot be hidden — typed retriable, and the
+                    # client's TokenStream resend-and-skip recovers
+                    sent = self._send_client(conn, pack_frame({
+                        "ok": False, "error": "unavailable",
+                        "detail": (
+                            f"decode worker died after {forwarded} "
+                            "streamed chunks; resend replays the "
+                            "stream deterministically"
+                        ),
+                        "retry_after_ms": self.retry_after_ms,
+                    }))
+                    return "failed" if sent else "client_gone"
+            except _RetrySibling:
+                continue
+            finally:
+                with self._lock:
+                    r = self._replicas.get(ep)
+                    if r is not None:
+                        r.in_flight -= 1
+                        self._drained.notify_all()
 
     def _forward_died(self, ep, exc, causes, excluded):
         """A forward connection died mid-request: eject the replica now

@@ -1,0 +1,237 @@
+"""Versioned codec for serialized slot state — the disaggregated
+prefill/decode transfer format.
+
+PR 12's ``DecodeStepper.swap_out`` established THE host representation
+of a live slot: per-stage K/V rows in the ``PrefixStore`` serialization
+layout (``(p, H, Dh)`` numpy per stage, ``kv_dtype``, bit-exact), plus
+the context row, host length, and the sampler state the position-keyed
+RNG needs to continue mid-stream. The QoS preemption path carries that
+dict in-process; PR 13 proved its entries cross mesh geometries
+bit-exactly (the rows are the GATHERED full-head format, so a tp:2
+swap-out restores onto a solo engine and vice versa). This module is
+the BYTE-LEVEL face of that one format: the wire frame a prefill
+worker ships to a decode worker, golden-pinned and versioned so the
+two ends of the hop can be different builds.
+
+Frame layout (everything before the payload is the golden-pinned
+header tests freeze)::
+
+    b"DKTX"                      magic (4 bytes)
+    version      u16 big-endian  (currently 1)
+    header_len   u32 big-endian
+    header       JSON            shapes/dtypes/sampler scalars + crc32
+    payload      raw array bytes ctx ++ per-stage K ++ V [++ spec_prompt]
+
+The K/V arrays ride as RAW bytes (shape + dtype named in the header),
+not npz: ``kv_dtype`` may be a non-native numpy extension dtype
+(bfloat16), and a byte-exact blit is both the fastest and the only
+encoding that cannot re-quantize. A crc32 over the payload rides the
+header, so a flipped byte anywhere in the bulk is a typed
+:class:`KvTransferError` at decode — never a silently-corrupt resume.
+
+Grammar state is NOT serialized as an object: it is a pure function of
+``(grammar spec, eos_id, tokens consumed)``, all three of which ride
+the frame (spec inside the sampling params, consumed tokens inside the
+context row past ``prompt_len``), so the decode side recompiles and
+replays it — no executable state crosses the wire, the same discipline
+as the DKT1 codec's no-pickle rule.
+
+Failure contract: every malformed input — truncated frame, wrong
+magic, unknown version, crc mismatch, shape arithmetic that does not
+add up — raises :class:`KvTransferError` (a ``ServingError``, code
+``kv_transfer``). Decoding never hangs, never returns partial state.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+import numpy as np
+
+from distkeras_tpu.serving.scheduler import ServingError
+
+MAGIC = b"DKTX"
+VERSION = 1
+_HEAD = struct.Struct(">HI")  # version, header_len
+
+
+class KvTransferError(ServingError):
+    """A transfer frame could not be decoded (truncated, corrupt,
+    wrong magic/version) or encoded. Typed so the router / client can
+    tell a broken transfer hop from engine internals — the retry
+    policy is the CALLER's: the prefill side re-encodes from live
+    state, the router re-sends the same bytes to a sibling decode
+    worker (decoding is read-only until the frame fully validates)."""
+
+    code = "kv_transfer"
+
+
+def _dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including JAX's extension dtypes
+    (bfloat16) which numpy only knows once ``ml_dtypes`` registered."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+        except Exception as e:  # noqa: BLE001 — decode boundary
+            raise KvTransferError(
+                f"transfer frame names unknown dtype {name!r}"
+            ) from e
+
+
+def encode_state(state: dict, *, prompt_len: int, sampling=None,
+                 eos_id=None) -> bytes:
+    """Serialize a ``swap_out`` state dict into one transfer frame.
+
+    ``prompt_len``: the original prompt's length — positions
+    ``prompt_len..len-1`` of the context row are tokens already
+    emitted (0 for the disagg prefill→decode hop, which ships the
+    slot before its first token), and the decode side needs the split
+    to reassemble the request. ``sampling``: the request's
+    ``SamplingParams`` (its wire dict rides the header; the slot's
+    live sampler scalars — seed, position counter — ride separately
+    from ``state`` because a completion fork's derived seed differs
+    from the params' seed)."""
+    ln = int(state["len"])
+    plen = int(prompt_len)
+    if not 1 <= plen <= ln:
+        raise KvTransferError(
+            f"prompt_len {plen} outside [1, len={ln}]"
+        )
+    ctx = np.ascontiguousarray(np.asarray(state["ctx"], np.int32))
+    if ctx.shape != (ln,):
+        raise KvTransferError(
+            f"ctx shape {ctx.shape} does not match len {ln}"
+        )
+    kv = state["kv"]
+    chunks = [ctx.tobytes()]
+    stages = []
+    kv_dtype = None
+    for k, v in kv:
+        k = np.ascontiguousarray(np.asarray(k))
+        v = np.ascontiguousarray(np.asarray(v))
+        if k.shape != v.shape or k.dtype != v.dtype or k.ndim != 3:
+            raise KvTransferError(
+                f"malformed K/V stage rows: {k.shape}/{k.dtype} vs "
+                f"{v.shape}/{v.dtype}"
+            )
+        if kv_dtype is None:
+            kv_dtype = k.dtype
+        stages.append(list(k.shape))
+        chunks.append(k.tobytes())
+        chunks.append(v.tobytes())
+    sp = state.get("spec_prompt")
+    if sp is not None:
+        sp = np.ascontiguousarray(np.asarray(sp, np.int32))
+        chunks.append(sp.tobytes())
+    payload = b"".join(chunks)
+    header = {
+        "len": ln,
+        "prompt_len": plen,
+        "spos": int(state["spos"]),
+        "seed": int(state["seed"]),
+        "sampling": None if sampling is None else sampling.to_wire(),
+        "eos_id": None if eos_id is None else int(eos_id),
+        "stages": stages,
+        "kv_dtype": "float32" if kv_dtype is None else str(kv_dtype),
+        "spec_prompt_len": None if sp is None else int(sp.size),
+        "crc": zlib.crc32(payload) & 0xFFFFFFFF,
+    }
+    h = json.dumps(header).encode()
+    return MAGIC + _HEAD.pack(VERSION, len(h)) + h + payload
+
+
+def decode_state(blob: bytes) -> dict:
+    """One transfer frame -> the wire-state dict: every ``swap_out``
+    field reconstructed bit-exactly, plus ``prompt_len`` / ``sampling``
+    (a ``SamplingParams`` or None) / ``eos_id`` for request
+    reassembly. Any malformation raises :class:`KvTransferError`."""
+    from distkeras_tpu.serving.sampling import SamplingParams
+
+    if len(blob) < len(MAGIC) + _HEAD.size:
+        raise KvTransferError(
+            f"truncated transfer frame ({len(blob)} bytes)"
+        )
+    if blob[: len(MAGIC)] != MAGIC:
+        raise KvTransferError("bad transfer frame: missing DKTX magic")
+    version, hlen = _HEAD.unpack_from(blob, len(MAGIC))
+    if version != VERSION:
+        raise KvTransferError(
+            f"unsupported transfer format version {version} "
+            f"(this build speaks {VERSION})"
+        )
+    off = len(MAGIC) + _HEAD.size
+    if len(blob) < off + hlen:
+        raise KvTransferError("truncated transfer frame header")
+    try:
+        header = json.loads(blob[off : off + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise KvTransferError(
+            f"unreadable transfer frame header: {e!r}"
+        ) from e
+    payload = blob[off + hlen :]
+    try:
+        want_crc = int(header["crc"])
+        ln = int(header["len"])
+        plen = int(header["prompt_len"])
+        stages = [tuple(int(d) for d in s) for s in header["stages"]]
+        kv_dtype = _dtype(header["kv_dtype"])
+        sp_len = header.get("spec_prompt_len")
+    except (KeyError, TypeError, ValueError) as e:
+        raise KvTransferError(
+            f"transfer frame header missing/invalid field: {e!r}"
+        ) from e
+    if zlib.crc32(payload) & 0xFFFFFFFF != want_crc:
+        raise KvTransferError(
+            "transfer frame payload crc mismatch (corrupt or "
+            "truncated in flight)"
+        )
+    need = ln * 4 + sum(
+        2 * int(np.prod(s)) * kv_dtype.itemsize for s in stages
+    ) + (0 if sp_len is None else int(sp_len) * 4)
+    if len(payload) != need:
+        raise KvTransferError(
+            f"transfer frame payload is {len(payload)} bytes, header "
+            f"describes {need}"
+        )
+    if not 1 <= plen <= ln:
+        raise KvTransferError(
+            f"transfer frame prompt_len {plen} outside [1, len={ln}]"
+        )
+    pos = 0
+
+    def take(nbytes, dtype, shape):
+        nonlocal pos
+        arr = np.frombuffer(
+            payload, dtype=dtype, count=int(np.prod(shape)), offset=pos
+        ).reshape(shape)
+        pos += nbytes
+        return arr.copy()  # writable, detached from the frame buffer
+
+    ctx = take(ln * 4, np.int32, (ln,))
+    kv = []
+    for shape in stages:
+        n = int(np.prod(shape)) * kv_dtype.itemsize
+        k = take(n, kv_dtype, shape)
+        v = take(n, kv_dtype, shape)
+        kv.append((k, v))
+    sp = None
+    if sp_len is not None:
+        sp = take(int(sp_len) * 4, np.int32, (int(sp_len),))
+    return {
+        "version": version,
+        "len": ln,
+        "prompt_len": plen,
+        "ctx": ctx,
+        "kv": kv,
+        "spos": int(header["spos"]),
+        "seed": int(header["seed"]),
+        "sampling": SamplingParams.from_wire(header.get("sampling")),
+        "eos_id": header.get("eos_id"),
+        "spec_prompt": sp,
+    }

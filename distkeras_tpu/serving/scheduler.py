@@ -54,10 +54,14 @@ docs/ARCHITECTURE.md "Failure modes & recovery".
 from __future__ import annotations
 
 import collections
+import queue as _queue
 import threading
 import time
 
 import numpy as np
+
+
+_NO_EVICT = object()  # "no eviction pending" sentinel (step loop)
 
 
 class ServingError(RuntimeError):
@@ -100,6 +104,15 @@ class QuotaExhaustedError(OverloadedError):
         super().__init__(msg)
         self.retry_after_ms = float(retry_after_ms)
         self.retry_after = self.retry_after_ms / 1e3
+
+
+class WrongRoleError(ServingError):
+    """The verb is not served by this engine's disaggregation role —
+    a prefill worker refuses plain ``generate``/``resume``, a decode
+    worker refuses the ``prefill`` face. A routing error (the fleet
+    router dispatches by role), not backpressure: never retried."""
+
+    code = "wrong_role"
 
 
 class DeadlineExceededError(ServingError):
@@ -152,7 +165,8 @@ class ServeRequest:
     _ids_lock = threading.Lock()
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, deadline=None,
-                 trace=None, sampling=None, tenant=None, priority=0):
+                 trace=None, sampling=None, tenant=None, priority=0,
+                 stream=False, prefill_only=False):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -180,6 +194,23 @@ class ServeRequest:
         self._swap = None
         self.sampling = sampling  # SamplingParams | None (= greedy)
         self.n = 1 if sampling is None else int(sampling.n)
+        # streaming delivery: the scheduler pushes each iteration's
+        # emitted tokens into a bounded-by-construction FIFO (at most
+        # max_new_tokens entries + one sentinel) that the server's
+        # connection thread drains — token delivery never runs under
+        # the scheduler lock or blocks on a slow client socket
+        self.stream = bool(stream)
+        self._chunks = _queue.SimpleQueue() if self.stream else None
+        # first CHUNK FLUSHED to the wire (streaming path) — stamped by
+        # the server thread after the send completes; the honest TTFT
+        # (``latency()`` prefers it over the scheduler-side append)
+        self.first_sent = None
+        # disaggregated prefill: the request completes the moment its
+        # prefill finishes, with the slot's swap-format state on
+        # ``export`` instead of decoded tokens (the prefill worker's
+        # half of the prefill/decode role split)
+        self.prefill_only = bool(prefill_only)
+        self.export = None
         self.created = time.monotonic()
         self.started = None  # admission instant (queue wait ends)
         self.prefill_finished = None  # slot became decodable
@@ -203,6 +234,32 @@ class ServeRequest:
         self.finished = time.monotonic()
         self._swap = None  # host KV rows released with the request
         self._done.set()
+        if self._chunks is not None:
+            # terminal sentinel AFTER the result is readable: the
+            # draining thread sees every chunk, then None, then reads
+            # ``error``/``result()`` without racing the finish
+            self._chunks.put(None)
+
+    def _push_chunk(self, toks) -> None:
+        """One scheduler iteration's emitted tokens for the draining
+        (server) thread. Called by the batcher BEFORE any eviction this
+        iteration triggers, so the sentinel can never overtake data."""
+        if self._chunks is not None:
+            self._chunks.put(list(toks))
+
+    def next_chunk(self, timeout=None):
+        """Blocking read of the stream FIFO: a list of newly emitted
+        tokens, or None when the request finished (read ``error`` /
+        ``result()`` after the sentinel). Raises ``TimeoutError`` when
+        nothing arrived in ``timeout`` seconds — the draining thread's
+        guard against a wedged scheduler (the engine watchdog fails the
+        request typed long before a sane timeout elapses)."""
+        try:
+            return self._chunks.get(timeout=timeout)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"request {self.id}: no stream progress in {timeout}s"
+            ) from None
 
     def _expired(self, now) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -235,18 +292,31 @@ class ServeRequest:
     def latency(self) -> dict:
         """Per-request timing breakdown (seconds) for the metrics sink:
         queue wait (submit -> admission), prefill (admission -> slot
-        decodable), decode (decodable -> done), plus ``ttft`` (submit ->
-        first generated token) and ``total``. Phases a failed request
-        never reached stay None."""
+        decodable), decode (decodable -> done), plus ``ttft`` and
+        ``total``. Phases a failed request never reached stay None.
+
+        TTFT accounting: on the STREAMING path ``ttft`` measures to
+        the first token's DELIVERY (the server thread's stamp after
+        the first chunk frame flushed to the socket) — the number a
+        client actually experiences. The non-streaming path keeps the
+        scheduler-side first-append stamp, which UNDERCOUNTS by
+        however long the finished response then waits behind decode
+        and the reply serialization; PERF.md r18 states the measured
+        before/after of that correction."""
 
         def span(a, b):
             return None if a is None or b is None else b - a
 
+        first = (
+            self.first_sent
+            if self.first_sent is not None
+            else self.first_token
+        )
         return {
             "queue_wait": span(self.created, self.started),
             "prefill": span(self.started, self.prefill_finished),
             "decode": span(self.prefill_finished, self.finished),
-            "ttft": span(self.created, self.first_token),
+            "ttft": span(self.created, first),
             "total": span(self.created, self.finished),
         }
 
@@ -396,6 +466,10 @@ class ContinuousBatcher:
                 "swap_in_failures",  # restore failed; request typed
                 "swapped_failed",  # failed (stop/deadline) while out
                 "swapped_tokens",  # context tokens serialized to host
+                # disaggregated prefill/decode (0 on unified engines)
+                "exports",  # prefill-only slots serialized + completed
+                "export_failures",  # swap-out at export raised; typed
+                "streamed_chunks",  # per-iteration token chunks pushed
             ),
         )
         # occupancy gauges, computed at scrape time from state the
@@ -476,6 +550,22 @@ class ContinuousBatcher:
                     f"n={req.n} completions exceed the "
                     f"{len(self._slots)}-slot bank"
                 )
+            if req.stream or req.prefill_only:
+                # a completion group has no single token order to
+                # stream, and a prefill-only export is one slot's
+                # state — both are caller errors, not backpressure
+                raise ValueError(
+                    f"n={req.n} completion groups cannot be streamed "
+                    "or prefill-exported"
+                )
+        if req.prefill_only and req.stream:
+            raise ValueError(
+                "prefill_only requests produce no tokens to stream"
+            )
+        if req.prefill_only and not hasattr(self.stepper, "swap_out"):
+            raise ValueError(
+                "prefill export needs a stepper with swap_out support"
+            )
         if getattr(self.stepper, "paged", False):
             need = self._pages_for_request(req)
             if need > self.stepper.total_pages:
@@ -620,6 +710,7 @@ class ContinuousBatcher:
                 else:
                     req.prefill_finished = now
         progressed = self._spend_prefill_budget() or preempted
+        progressed = self._export_prefilled() or progressed
         progressed = self._fork_completions() or progressed
         now = time.monotonic()
         with self._lock:
@@ -736,9 +827,12 @@ class ContinuousBatcher:
                 req.iterations += 1
                 comp = req.completions[self._slot_comp[i]]
                 emitted = 0
+                new_toks = []
+                pending_evict = _NO_EVICT  # deferred past the chunk push
                 for tok in np.atleast_1d(toks[i])[: int(counts[i])]:
                     tok = int(tok)
                     comp.append(tok)
+                    new_toks.append(tok)
                     emitted += 1
                     if req.first_token is None:
                         req.first_token = now
@@ -748,18 +842,22 @@ class ContinuousBatcher:
                         or (req.eos_id is not None and tok == req.eos_id)
                     )
                     if finished:
-                        self._evict(i, req, None)
+                        pending_evict = None
                         break
                     if req._expired(now):
-                        self._evict(
-                            i,
-                            req,
-                            DeadlineExceededError(
-                                f"deadline passed after "
-                                f"{len(req.tokens)} tokens"
-                            ),
+                        pending_evict = DeadlineExceededError(
+                            f"deadline passed after "
+                            f"{len(req.tokens)} tokens"
                         )
                         break
+                if req.stream and new_toks:
+                    # the streaming push happens BEFORE any eviction
+                    # this iteration triggers: _finish's terminal
+                    # sentinel must never overtake the final tokens
+                    self.counters["streamed_chunks"] += 1
+                    req._push_chunk(new_toks)
+                if pending_evict is not _NO_EVICT:
+                    self._evict(i, req, pending_evict)
                 emitted_total += emitted
                 if self.qos is not None and emitted:
                     # WFQ service accounting: decode tokens actually
@@ -786,6 +884,59 @@ class ContinuousBatcher:
                 blamed=blamed if blamed else None,
             )
         return True
+
+    # -- disaggregated prefill export ---------------------------------------
+
+    def _export_prefilled(self) -> bool:
+        """Complete every ``prefill_only`` request whose prefill just
+        finished: fetch the slot's state through ``stepper.swap_out``
+        (the SAME host format QoS preemption rides — the disagg
+        transfer hop serializes exactly this dict), park it on
+        ``req.export``, and free the slot. Runs BEFORE the decode
+        active mask is computed, so a prefill-only slot never takes a
+        decode step — the whole point of the prefill role.
+
+        Failure semantics mirror ``_preempt``'s: the device fetch runs
+        outside the lock; a failed swap-out fails ONLY this request,
+        typed (a ``ServingError`` passes through as itself, anything
+        else becomes ``internal``), and the recorder names the
+        exception class."""
+        import copy
+
+        with self._lock:
+            ready = [
+                (i, req)
+                for i, req in enumerate(self._slots)
+                if req is not None and req.prefill_only
+                and i not in self._prefill_left
+            ]
+        progressed = False
+        for i, req in ready:
+            try:
+                state = self.stepper.swap_out(i)  # device fetch
+            except Exception as e:  # noqa: BLE001 — export boundary
+                err = (
+                    copy.copy(e)
+                    if isinstance(e, ServingError)
+                    else InternalError(
+                        f"prefill export failed for this request: {e!r}"
+                    )
+                )
+                with self._lock:
+                    self.counters["export_failures"] += 1
+                    self._record_swap_error("export", i, req, e)
+                    if self._slots[i] is req:
+                        self._evict(i, req, err)
+                progressed = True
+                continue
+            with self._lock:
+                if self._slots[i] is not req:
+                    continue  # stopped underneath the fetch
+                req.export = state
+                self.counters["exports"] += 1
+                self._evict(i, req, None)
+            progressed = True
+        return progressed
 
     # -- preemption by KV swap (multi-tenant QoS) ---------------------------
 
@@ -908,6 +1059,11 @@ class ContinuousBatcher:
             if self._slots[i] is not req:
                 return  # stopped underneath us
             req._swap = None
+            if req.prefill_finished is None:
+                # a WIRE-resumed request (disagg transfer) was
+                # prefilled on another engine: its decode phase starts
+                # here, so the local timeline needs the boundary stamp
+                req.prefill_finished = time.monotonic()
             self.counters["resumes"] += 1
             if self.recorder is not None:
                 self.recorder.record(

@@ -21,6 +21,24 @@ Verbs (header ``{"verb": ...}``):
   ``{"ok": false, "error": code}`` with code ``overloaded`` (bounded
   admission queue full — explicit backpressure), ``deadline_exceeded``,
   or ``stopping`` (drain in progress).
+- ``generate`` with ``stream: true``: the one verb that replies with
+  MULTIPLE frames on the connection — zero or more
+  ``{"stream": "chunk", "tokens": [...]}`` frames pushed as the
+  scheduler emits them (one per scheduler iteration that advanced the
+  slot), then a terminal ``{"stream": "end"}`` frame carrying the full
+  sequence payload (or a typed error frame). TTFT becomes a real
+  first-byte measurement: ``ServeRequest.first_sent`` is stamped when
+  the first chunk frame flushes. After the terminal frame the
+  connection returns to request/reply discipline.
+- ``prefill`` (disaggregated serving): same request shape as
+  ``generate``; the engine runs admission + chunked prefill only and
+  replies with the finished slot's state as a ``kv_transfer`` wire
+  frame (reply payload) plus a ``transfer`` summary header — the
+  prefill worker's half of the prefill/decode role split.
+- ``kv.transfer``: payload = a ``kv_transfer`` frame from ``prefill``;
+  the engine resumes the slot and decodes to completion (streamable
+  with ``stream: true``). A corrupt/truncated frame replies typed
+  ``kv_transfer``, never hangs.
 - ``predict``: payload = (N, ...) feature rows; reply payload = the
   model's outputs (windowed-batched server-side).
 - ``health`` / ``stats``: JSON-only replies. ``health`` carries engine
@@ -238,6 +256,16 @@ class ServingServer:
             header = {}
             try:
                 header, payload = unpack_frame(frame)
+                if header.get("stream") and header.get("verb") in (
+                    "generate", "kv.transfer"
+                ):
+                    # the streaming path sends its own frames (chunks
+                    # + terminal); everything else stays one-reply
+                    if not self._serve_stream(conn, header, payload):
+                        return
+                    if self._stopping.is_set():
+                        return
+                    continue
                 reply = self._dispatch(header, payload)
             except ServingError as e:
                 h = {"ok": False, "error": e.code, "detail": str(e)}
@@ -269,6 +297,10 @@ class ServingServer:
         faults.fire("server.dispatch", verb=verb)
         if verb == "generate":
             return self._generate(header, payload)
+        if verb == "prefill":
+            return self._prefill(header, payload)
+        if verb == "kv.transfer":
+            return self._transfer(header, payload)
         if verb == "predict":
             return self._predict(payload)
         if verb == "metrics":
@@ -425,6 +457,184 @@ class ServingServer:
         if ctx is not None:
             reply["trace"] = assemble_trace("ok")
         return pack_frame(reply, serialize_params(np.asarray(seq)))
+
+    @staticmethod
+    def _deadline_of(header: dict):
+        if header.get("deadline_ms") is None:
+            return None
+        return time.monotonic() + float(header["deadline_ms"]) / 1e3
+
+    def _prefill(self, header: dict, payload: bytes) -> bytes:
+        """Disaggregated prefill: admission + chunked prefill, then
+        the finished slot's state as a ``kv_transfer`` frame (the
+        reply payload). Typed failures ride the normal error path —
+        ``wrong_role`` on a decode engine, ``overloaded`` under
+        pressure, ``kv_transfer`` if encoding failed."""
+        prompt = np.asarray(deserialize_params(payload))
+        blob, meta = self.engine.prefill(
+            prompt, int(header["max_new_tokens"]),
+            eos_id=header.get("eos_id"),
+            deadline=self._deadline_of(header),
+            sampling=header.get("sampling"),
+            tenant=header.get("tenant"),
+            priority=int(header.get("priority") or 0),
+        )
+        return pack_frame({"ok": True, "transfer": meta}, blob)
+
+    def _transfer(self, header: dict, payload: bytes) -> bytes:
+        """Disaggregated decode (non-streaming): resume a transferred
+        slot and decode it to completion. The reply mirrors
+        ``generate``'s (full sequence payload), so the router can
+        relay either interchangeably."""
+        req = self.engine.resume(
+            payload, int(header["max_new_tokens"]),
+            eos_id=header.get("eos_id"),
+            deadline=self._deadline_of(header),
+            tenant=header.get("tenant"),
+            priority=int(header.get("priority") or 0),
+        )
+        seq = self.engine.wait(req)
+        return pack_frame(
+            {"ok": True,
+             "tokens": int(np.asarray(seq).size - req.prompt.size)},
+            serialize_params(np.asarray(seq)),
+        )
+
+    def _serve_stream(self, conn: socket.socket, header: dict,
+                      payload: bytes) -> bool:
+        """Streaming ``generate`` / ``kv.transfer``: submit with a
+        chunk FIFO, then drain it to the connection — one
+        ``stream: "chunk"`` frame per scheduler iteration that
+        advanced the slot, then the terminal ``stream: "end"`` frame
+        with the full sequence payload (identity stays assertable
+        downstream) or a typed error frame. Returns False when the
+        connection is no longer usable (died mid-stream / injected
+        drop). The first chunk's flush stamps ``req.first_sent`` —
+        the delivery-time TTFT ``latency()`` reports."""
+        from distkeras_tpu.obs import TraceContext, request_spans, start_span
+
+        verb = header.get("verb")
+        faults.fire("server.dispatch", verb=verb)
+        ctx = TraceContext.from_wire(header.get("trace"))
+        span = col = None
+        if ctx is not None:
+            from distkeras_tpu.obs import COLLECTOR
+
+            col = getattr(self.engine, "trace_collector", None) or COLLECTOR
+            span = start_span(
+                "server.generate", ctx, collector=col, stream=True,
+                max_new_tokens=int(header["max_new_tokens"]),
+            )
+        req = None
+
+        def send_error(e, code=None):
+            h = {"ok": False, "error": code or getattr(e, "code", "bad_request"),
+                 "detail": repr(e) if code == "bad_request" else str(e)}
+            if getattr(e, "retry_after", None) is not None:
+                h["retry_after_ms"] = e.retry_after * 1e3
+            elif h["error"] == "overloaded":
+                h["retry_after_ms"] = self.retry_after_ms
+            if span is not None:
+                spans = (
+                    [] if req is None
+                    else request_spans(req, ctx, collector=col)
+                )
+                spans.append(span.end(status=h["error"]))
+                h["trace"] = {"id": ctx.trace_id}
+                if ctx.want_timeline:
+                    h["trace"]["timeline"] = spans
+            else:
+                _stamp_trace(h, header, e)
+            try:
+                send_data(conn, pack_frame(h))
+                return True
+            except (ConnectionError, OSError):
+                return False
+
+        try:
+            if verb == "generate":
+                from distkeras_tpu.serving.sampling import SamplingParams
+
+                prompt = np.asarray(deserialize_params(payload))
+                req = self.engine.submit(
+                    prompt, int(header["max_new_tokens"]),
+                    eos_id=header.get("eos_id"),
+                    deadline=self._deadline_of(header),
+                    trace=ctx,
+                    sampling=SamplingParams.from_wire(
+                        header.get("sampling")
+                    ),
+                    tenant=header.get("tenant"),
+                    priority=int(header.get("priority") or 0),
+                    stream=True,
+                )
+            else:
+                req = self.engine.resume(
+                    payload, int(header["max_new_tokens"]),
+                    eos_id=header.get("eos_id"),
+                    deadline=self._deadline_of(header),
+                    trace=ctx,
+                    tenant=header.get("tenant"),
+                    priority=int(header.get("priority") or 0),
+                    stream=True,
+                )
+        except ServingError as e:
+            return send_error(e)
+        except Exception as e:  # noqa: BLE001 — wire boundary
+            return send_error(e, code="bad_request")
+        while True:
+            t0 = time.monotonic()
+            try:
+                # generous bound: the engine watchdog fails a wedged
+                # scheduler's requests typed long before this fires —
+                # the timeout is the belt to that suspender
+                chunk = req.next_chunk(timeout=600.0)
+            except TimeoutError as e:
+                send_error(e, code="internal")
+                return False
+            if chunk is None:
+                break
+            frame = pack_frame(
+                {"ok": True, "stream": "chunk",
+                 "tokens": [int(t) for t in chunk]}
+            )
+            act = faults.fire("server.reply", nbytes=len(frame))
+            if act == "drop":
+                return False  # injected: vanish mid-stream
+            try:
+                send_data(conn, frame)
+            except (ConnectionError, OSError):
+                return False  # client went away; decode completes idle
+            now = time.monotonic()
+            if req.first_sent is None:
+                req.first_sent = now  # DELIVERY-time TTFT stamp
+            if ctx is not None:
+                # per-chunk trace span (rides the request ledger; the
+                # timeline's serving.stream_chunk children)
+                req.events.append({
+                    "name": "serving.stream_chunk", "t0": t0,
+                    "t1": now, "tokens": len(chunk),
+                })
+        try:
+            seq = self.engine.wait(req)  # completion bookkeeping
+        except ServingError as e:
+            return send_error(e)
+        reply = {"ok": True, "stream": "end", "tokens": len(req.tokens)}
+        if span is not None:
+            spans = request_spans(req, ctx, collector=col)
+            spans.append(span.end(status="ok"))
+            reply["trace"] = {"id": ctx.trace_id}
+            if ctx.want_timeline:
+                reply["trace"]["timeline"] = spans
+        frame = pack_frame(reply, serialize_params(np.asarray(seq)))
+        act = faults.fire("server.reply", nbytes=len(frame))
+        if act == "drop":
+            return False
+        try:
+            send_data(conn, frame)
+        except (ConnectionError, OSError):
+            return False
+        return True
 
     def _predict(self, payload: bytes) -> bytes:
         x = np.asarray(deserialize_params(payload))

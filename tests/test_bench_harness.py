@@ -188,6 +188,25 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
             else {"lo", "hi"}
         ), name
     assert qb["scenarios"]["two_tenant_burst"]["hi_p99_speedup"] > 0
+    # disaggregated prefill/decode block: both scenarios ran the
+    # two-hop path over real TCP with outputs identity-asserted across
+    # the transfer, streamed requests measured TTFT at first DELIVERED
+    # chunk, and the router's transfer ledger balanced (RATIO
+    # magnitudes are only meaningful in the full run — the committed
+    # artifact carries the inter-token isolation claim)
+    dg = rec["disagg"]
+    assert set(dg["scenarios"]) == {
+        "interactive", "short_uniform_overhead"
+    }
+    for name, sc in dg["scenarios"].items():
+        assert sc["outputs_identical"] is True, name
+        assert sc["transfer_balanced"] is True, (name, sc["transfer"])
+        assert sc["streamed_requests"] > 0, name
+        assert sc["transfer"]["transfer_sends"] > 0, name
+        for side in ("disagg", "unified"):
+            assert sc[side]["tokens_per_sec"] > 0, (name, side)
+            assert sc[side]["ttft_ms"]["p99"] > 0, (name, side)
+            assert sc[side]["inter_token_ms"]["p99"] >= 0, (name, side)
     # the regression gate: the fresh smoke ratios must land within the
     # stated band of the COMMITTED artifact (a perf collapse fails
     # tier-1 here instead of silently rotting the committed numbers)
@@ -195,6 +214,8 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
         open(os.path.join(REPO, "BENCH_SERVING.json")).read()
     )
     violations = check_bench.compare_serving(rec, committed)
+    assert violations == [], violations
+    violations = check_bench.compare_disagg(rec, committed)
     assert violations == [], violations
     # speculative A/B schema: both traffic shapes, both sides, the
     # acceptance ledger, and the identity flag (win/cost RATIOS are
@@ -489,6 +510,51 @@ def test_committed_bench_serving_qos_block():
     assert thrash["outputs_identical"] is True
     assert thrash["tokens_per_sec_ratio"] > 0  # no floor on honesty rows
     assert thrash["qos_counters"]["preemptions"] >= 1  # it DID thrash
+
+
+def test_committed_bench_serving_disagg_block():
+    """The COMMITTED disagg block carries THIS PR's claims honestly:
+    under the interactive trace's long-prompt arrivals the role split
+    holds inter-token p99 at least the floored factor better than two
+    unified replicas at equal hardware (decode iterations never share
+    a device with prefill chunks), with every output token-identical
+    across the wire transfer, TTFT measured at first DELIVERED chunk,
+    the transfer ledger balanced, and the short-uniform adversarial
+    row — where the transfer hop is pure overhead — committed as
+    measured."""
+    rec = json.loads(
+        open(os.path.join(REPO, "BENCH_SERVING.json")).read()
+    )
+    # self-comparison exercises every invariant + the committed floors
+    # (floor values live in check_bench.COMMITTED_FLOORS — the one
+    # source of truth)
+    assert check_bench.compare_disagg(rec, rec) == []
+    assert set(check_bench.COMMITTED_FLOORS["disagg"]) == {
+        "disagg.scenarios.interactive.inter_token_p99_ratio",
+    }
+    dg = rec["disagg"]
+    inter = dg["scenarios"]["interactive"]
+    assert inter["transfer"]["transfer_sends"] >= 1
+    assert inter["streamed_requests"] > 0
+    # the honest adversarial row exists and is a real measurement
+    adv = dg["scenarios"]["short_uniform_overhead"]
+    assert adv["tokens_per_sec_ratio"] > 0
+    # gate plumbing: a flipped identity flag or broken pairing is a
+    # violation, not a silent pass
+    import copy
+
+    bad = copy.deepcopy(rec)
+    bad["disagg"]["scenarios"]["interactive"][
+        "outputs_identical"] = False
+    assert any(
+        "interactive" in v for v in check_bench.compare_disagg(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    bad["disagg"]["scenarios"]["interactive"][
+        "transfer_balanced"] = False
+    assert any(
+        "pairing" in v for v in check_bench.compare_disagg(bad, rec)
+    )
 
 
 def test_committed_bench_fleet_artifact_schema():

@@ -1,0 +1,651 @@
+"""Disaggregated prefill/decode: the kv_transfer codec, engine roles,
+streaming delivery, and the router's two-hop dispatch.
+
+The correctness bar everything here pins: a request prefilled on one
+engine and decoded on another — through the versioned wire codec, any
+mix of dense/paged and solo/tp:2 geometries, greedy or sampled or
+grammar-constrained — produces TOKEN-IDENTICAL output to an
+uninterrupted generate on a single engine. The failure bar: every
+malformed frame, wrong-role dispatch, and mid-transfer death surfaces
+TYPED (never a hang), and the router's transfer ledger pairs every
+dispatched hop with a relayed reply or a typed failure.
+"""
+
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import zoo
+from distkeras_tpu.predictors import CachedSequenceGenerator
+from distkeras_tpu.serving import (
+    ContinuousBatcher,
+    FleetRouter,
+    KvTransferError,
+    SamplingParams,
+    ServeRequest,
+    ServingClient,
+    ServingEngine,
+    ServingError,
+    ServingServer,
+    WrongRoleError,
+    decode_state,
+    encode_state,
+)
+from distkeras_tpu.serving import kv_transfer
+
+
+VOCAB, SEQ = 61, 32
+
+
+@pytest.fixture(scope="module")
+def model():
+    return zoo.transformer_lm(
+        vocab_size=VOCAB, seq_len=SEQ, d_model=32, num_heads=2,
+        depth=2, seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def ref_gen(model):
+    return CachedSequenceGenerator(model)
+
+
+def _prompt(n=9, seed=3):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, VOCAB, n).astype(np.int32)
+
+
+# ---------------------------------------------------------------- codec
+
+
+def _tiny_state(stages=2, p=5, nh=2, hd=4):
+    rng = np.random.default_rng(0)
+    return {
+        "len": p + 1,
+        "ctx": rng.integers(0, VOCAB, p + 1).astype(np.int32),
+        "kv": [
+            (
+                rng.standard_normal((p, nh, hd)).astype(np.float32),
+                rng.standard_normal((p, nh, hd)).astype(np.float32),
+            )
+            for _ in range(stages)
+        ],
+        "spos": 1,
+        "seed": 42,
+        "spec_prompt": None,
+    }
+
+
+def test_codec_golden_header_and_roundtrip():
+    """The frame's leading bytes are the GOLDEN-PINNED contract two
+    different builds meet on: magic b"DKTX" + big-endian u16 version.
+    Roundtrip reproduces every field bit-exactly."""
+    state = _tiny_state()
+    blob = encode_state(state, prompt_len=4, eos_id=7)
+    assert blob[:4] == b"DKTX"
+    (version,) = struct.unpack_from(">H", blob, 4)
+    assert version == 1 == kv_transfer.VERSION
+    out = decode_state(blob)
+    assert out["version"] == 1
+    assert out["len"] == state["len"]
+    assert out["prompt_len"] == 4
+    assert out["spos"] == 1 and out["seed"] == 42
+    assert out["eos_id"] == 7 and out["sampling"] is None
+    assert np.array_equal(out["ctx"], state["ctx"])
+    assert out["ctx"].dtype == np.int32
+    for (k0, v0), (k1, v1) in zip(state["kv"], out["kv"]):
+        assert k0.dtype == k1.dtype
+        assert np.array_equal(k0, k1) and np.array_equal(v0, v1)
+
+
+def test_codec_sampling_rides_the_frame():
+    sp = SamplingParams(temperature=0.7, top_p=0.9, seed=11,
+                        grammar={"kind": "allow", "tokens": [1, 2, 3]})
+    blob = encode_state(_tiny_state(), prompt_len=6, sampling=sp)
+    out = decode_state(blob)
+    got = out["sampling"]
+    assert got is not None
+    assert got.temperature == pytest.approx(0.7)
+    assert got.top_p == pytest.approx(0.9)
+    assert got.seed == 11
+    assert got.grammar == {"kind": "allow", "tokens": [1, 2, 3]}
+
+
+def test_codec_truncation_and_corruption_are_typed():
+    """A broken frame is ALWAYS a typed KvTransferError — truncated at
+    any boundary, flipped payload byte (crc), wrong magic, future
+    version — never a hang, never partial state."""
+    blob = encode_state(_tiny_state(), prompt_len=4)
+    for cut in (0, 3, 5, 9, len(blob) // 2, len(blob) - 1):
+        with pytest.raises(KvTransferError):
+            decode_state(blob[:cut])
+    corrupt = bytearray(blob)
+    corrupt[-8] ^= 0xFF  # deep in the payload: only the crc can see it
+    with pytest.raises(KvTransferError):
+        decode_state(bytes(corrupt))
+    with pytest.raises(KvTransferError):
+        decode_state(b"NOPE" + blob[4:])
+    future = bytearray(blob)
+    struct.pack_into(">H", future, 4, 99)
+    with pytest.raises(KvTransferError):
+        decode_state(bytes(future))
+    # KvTransferError is a ServingError with a stable wire code
+    assert issubclass(KvTransferError, ServingError)
+    assert KvTransferError.code == "kv_transfer"
+
+
+def test_codec_roundtrip_dense_and_paged_stepper_state(model):
+    """The codec reproduces a REAL swap_out dict bit-exactly on both
+    cache layouts (the rows are the PrefixStore serialization format
+    either way)."""
+    for kw in ({}, {"paged": True, "page_size": 4}):
+        eng = ServingEngine(
+            model, num_slots=2, prefix_cache=False, **kw
+        ).start()
+        try:
+            st = eng._stepper
+            st.admit(0, _prompt(7), max_new=4)
+            state = st.swap_out(0)
+            out = decode_state(encode_state(
+                state, prompt_len=7,
+            ))
+            assert out["len"] == state["len"]
+            assert np.array_equal(out["ctx"], state["ctx"])
+            for (k0, v0), (k1, v1) in zip(state["kv"], out["kv"]):
+                assert np.array_equal(k0, k1)
+                assert np.array_equal(v0, v1)
+        finally:
+            eng.stop()
+
+
+# ------------------------------------------------- engine prefill/resume
+
+
+def test_prefill_resume_identity_dense_paged_sampled(model, ref_gen):
+    """The acceptance pin: prefill on one engine, resume on another —
+    greedy (vs the solo generator) and sampled and grammar-constrained
+    (vs an uninterrupted single-engine generate) — across dense and
+    paged layouts, token-identical."""
+    p = _prompt(9)
+    solo = ref_gen.generate(p[None], steps=8)[0]
+    grammar = {"kind": "allow", "tokens": list(range(0, VOCAB, 2))}
+    cases = [({}, {}), ({"paged": True, "page_size": 4},
+                        {"paged": True, "page_size": 4})]
+    for pre_kw, dec_kw in cases:
+        pre = ServingEngine(model, num_slots=2, role="prefill",
+                            prefill_chunk=4, prefix_cache=False,
+                            **pre_kw).start()
+        dec = ServingEngine(model, num_slots=2, role="decode",
+                            prefix_cache=False, **dec_kw).start()
+        try:
+            blob, meta = pre.prefill(p, 8)
+            assert meta["bytes"] == len(blob)
+            assert meta["version"] == kv_transfer.VERSION
+            out = dec.wait(dec.resume(blob, 8))
+            assert np.array_equal(out, solo)
+            for sp in (
+                SamplingParams(temperature=0.8, seed=5),
+                SamplingParams(temperature=0.9, top_p=0.9, seed=6,
+                               grammar=grammar),
+            ):
+                want = dec.generate(p, 8, sampling=sp)
+                blob, _ = pre.prefill(p, 8, sampling=sp)
+                got = dec.wait(dec.resume(blob, 8))
+                assert np.array_equal(got, want), sp.to_wire()
+                if sp.grammar is not None:
+                    gen = np.asarray(got)[p.size:]
+                    assert set(gen.tolist()) <= set(grammar["tokens"])
+            # the transfer ledger saw the traffic
+            assert pre.transfer_snapshot()["sends"] >= 3
+            assert dec.transfer_snapshot()["recvs"] >= 3
+        finally:
+            pre.stop()
+            dec.stop()
+
+
+def test_prefill_resume_crosses_mesh_geometries(model, ref_gen, tp_mesh):
+    """The PR 13 claim cashed in over the wire format: a slot
+    prefilled on a tp:2 SHARDED engine resumes on a SOLO engine
+    token-identically (the codec rows are the gathered full-head
+    format, so geometry never leaks into the frame)."""
+    p = _prompt(9, seed=5)
+    solo = ref_gen.generate(p[None], steps=6)[0]
+    pre = ServingEngine(model, num_slots=2, role="prefill",
+                        prefill_chunk=4, prefix_cache=False,
+                        mesh=tp_mesh(2)).start()
+    dec = ServingEngine(model, num_slots=2, role="decode",
+                        prefix_cache=False).start()
+    try:
+        blob, _ = pre.prefill(p, 6)
+        out = dec.wait(dec.resume(blob, 6))
+        assert np.array_equal(out, solo)
+    finally:
+        pre.stop()
+        dec.stop()
+
+
+def test_wrong_role_is_typed(model):
+    pre = ServingEngine(model, num_slots=2, role="prefill",
+                        prefix_cache=False).start()
+    dec = ServingEngine(model, num_slots=2, role="decode",
+                        prefix_cache=False).start()
+    try:
+        with pytest.raises(WrongRoleError):
+            pre.generate(_prompt(5), 4)
+        with pytest.raises(WrongRoleError):
+            dec.prefill(_prompt(5), 4)
+        with pytest.raises(ValueError):
+            ServingEngine(model, role="nonsense")
+    finally:
+        pre.stop()
+        dec.stop()
+
+
+def test_resume_rejects_corrupt_frame_typed(model):
+    dec = ServingEngine(model, num_slots=2, prefix_cache=False).start()
+    try:
+        with pytest.raises(KvTransferError):
+            dec.resume(b"DKTXgarbage", 4)
+        assert dec.transfer_snapshot()["errors"] == 1
+        # the tape names the exception class
+        events = [
+            e for e in dec.recorder.snapshot()
+            if e["kind"] == "kv.transfer.error"
+        ]
+        assert events and events[-1]["error"] == "KvTransferError"
+    finally:
+        dec.stop()
+
+
+# ------------------------------------------------- scheduler-level units
+
+
+class FakeSwapStepper:
+    """Pure-Python stepper with the swap face: prefill-export units
+    drive the scheduler without a device."""
+
+    def __init__(self, num_slots=2, max_len=32):
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.swapped = []
+        self.fail_swap = False
+        self._left = np.zeros(num_slots, int)
+        self._n = np.zeros(num_slots, int)
+
+    def begin_admit(self, slot, prompt):
+        self._left[slot] = max(0, len(np.asarray(prompt)) - 1)
+        self._n[slot] = 0
+        return int(self._left[slot])
+
+    def prefill_chunk(self, slot, budget):
+        n = min(int(budget), int(self._left[slot]))
+        self._left[slot] -= n
+        return int(self._left[slot])
+
+    def release(self, slot):
+        pass
+
+    def step(self, active):
+        toks = np.full(self.num_slots, -1)
+        for i in np.flatnonzero(active):
+            self._n[i] += 1
+            toks[i] = 100 + i * 10 + self._n[i]
+        return toks
+
+    def swap_out(self, slot):
+        if self.fail_swap:
+            raise RuntimeError("export boom")
+        self.swapped.append(slot)
+        return {"len": 5, "ctx": np.arange(5, dtype=np.int32),
+                "kv": [], "spos": 0, "seed": 0, "params": None,
+                "grammar": None, "spec_prompt": None}
+
+
+def test_scheduler_prefill_only_exports():
+    st = FakeSwapStepper()
+    b = ContinuousBatcher(st, prefill_chunk=2)
+    req = ServeRequest(np.arange(7), 4, prefill_only=True)
+    b.submit(req)
+    for _ in range(10):
+        if req.done:
+            break
+        b.step()
+    assert req.done and req.error is None
+    assert req.export is not None and req.export["len"] == 5
+    assert req.tokens == []  # a prefill-only request never decodes
+    assert st.swapped == [0]
+    assert b.counters["exports"] == 1
+    assert b.counters["completed"] == 1
+
+
+def test_scheduler_export_failure_is_typed():
+    st = FakeSwapStepper()
+    st.fail_swap = True
+    b = ContinuousBatcher(st, prefill_chunk=8)
+    req = ServeRequest(np.arange(4), 4, prefill_only=True)
+    b.submit(req)
+    for _ in range(10):
+        if req.done:
+            break
+        b.step()
+    assert req.done and req.error is not None
+    assert req.error.code == "internal"
+    assert b.counters["export_failures"] == 1
+    # the slot recycled: a plain request serves fine afterwards
+    st.fail_swap = False
+    req2 = ServeRequest(np.arange(3), 2)
+    b.submit(req2)
+    for _ in range(10):
+        if req2.done:
+            break
+        b.step()
+    assert req2.error is None and len(req2.tokens) == 2
+
+
+def test_scheduler_stream_chunks_and_sentinel_order():
+    st = FakeSwapStepper()
+    b = ContinuousBatcher(st)
+    req = ServeRequest(np.arange(3), 4, stream=True)
+    b.submit(req)
+    for _ in range(12):
+        if req.done:
+            break
+        b.step()
+    chunks = []
+    while True:
+        c = req.next_chunk(timeout=1.0)
+        if c is None:
+            break
+        chunks.append(c)
+    flat = [t for c in chunks for t in c]
+    assert flat == req.tokens and len(flat) == 4
+    assert b.counters["streamed_chunks"] == len(chunks)
+
+
+def test_latency_prefers_delivery_ttft():
+    """The TTFT accounting fix: with a first_sent (delivery) stamp the
+    reported ttft measures to the flush, not the scheduler append —
+    the streaming path's honest number."""
+    req = ServeRequest(np.arange(3), 4)
+    req.started = req.created + 0.01
+    req.first_token = req.created + 0.05
+    req.finished = req.created + 0.2
+    assert req.latency()["ttft"] == pytest.approx(0.05)
+    req.first_sent = req.created + 0.12
+    assert req.latency()["ttft"] == pytest.approx(0.12)
+
+
+def test_submit_refuses_streamed_groups_and_streamed_prefill():
+    st = FakeSwapStepper()
+    b = ContinuousBatcher(st)
+    with pytest.raises(ValueError):
+        b.submit(ServeRequest(np.arange(3), 2, stream=True,
+                              prefill_only=True))
+
+
+# ------------------------------------------------------------- wire e2e
+
+
+def test_wire_stream_identity_and_reuse(model):
+    eng = ServingEngine(model, num_slots=2, prefix_cache=False)
+    srv = ServingServer(eng).start()
+    try:
+        with ServingClient("127.0.0.1", srv.port) as c:
+            p = _prompt(6)
+            want = c.generate(p, 8, eos_id=3)
+            st = c.generate_stream(p, 8, eos_id=3)
+            chunks = [list(ch) for ch in st]
+            assert np.array_equal(st.sequence, want)
+            flat = [t for ch in chunks for t in ch]
+            assert flat[: want.size - p.size] == [
+                int(t) for t in want[p.size:]
+            ]
+            assert st.ttft_s is not None and st.ttft_s > 0
+            # the connection returns to request/reply discipline
+            assert np.array_equal(c.generate(p, 8, eos_id=3), want)
+            # wrong verb payloads stay typed over the wire
+            with pytest.raises(ServingError) as ei:
+                c._roundtrip(
+                    {"verb": "kv.transfer", "max_new_tokens": 4},
+                    b"DKTXjunk",
+                )
+            assert ei.value.code == "kv_transfer"
+    finally:
+        srv.shutdown()
+
+
+def test_wire_stream_trace_has_chunk_spans(model):
+    """A traced stream assembles a COMPLETE timeline (exactly one
+    terminal span) carrying one ``serving.stream_chunk`` child per
+    flushed chunk — the per-chunk trace the streaming verb promises."""
+    from distkeras_tpu.obs import timeline_complete
+
+    eng = ServingEngine(model, num_slots=2, prefix_cache=False)
+    srv = ServingServer(eng).start()
+    try:
+        with ServingClient("127.0.0.1", srv.port) as c:
+            st = c.generate_stream(_prompt(5), 6, trace=True)
+            chunks = sum(1 for _ in st)
+            tl = c.last_trace
+            assert tl is not None
+            names = [s["name"] for s in tl["spans"]]
+            assert timeline_complete(tl["spans"]), names
+            assert names.count("serving.stream_chunk") == chunks
+            assert "server.generate" in names
+            assert "serving.decode" in names
+    finally:
+        srv.shutdown()
+
+
+def test_router_disagg_e2e_identity_and_counters(model, ref_gen):
+    p = _prompt(9, seed=11)
+    solo = ref_gen.generate(p[None], steps=8)[0]
+    pre = ServingEngine(model, num_slots=2, role="prefill",
+                        prefill_chunk=4, prefix_cache=False)
+    dec = ServingEngine(model, num_slots=2, role="decode",
+                        prefix_cache=False)
+    s1, s2 = ServingServer(pre).start(), ServingServer(dec).start()
+    router = FleetRouter(
+        endpoints=[(s1.host, s1.port), (s2.host, s2.port)],
+    ).start()
+    try:
+        for s in (s1, s2):
+            assert router.wait_in_rotation((s.host, s.port))
+        with ServingClient("127.0.0.1", router.port) as c:
+            h = c.health()
+            assert h["disagg"] is True
+            assert h["roles"] == {"prefill": 1, "decode": 1}
+            out = c.generate(p, 8)
+            assert np.array_equal(out, solo)
+            st = c.generate_stream(p, 8)
+            for _ in st:
+                pass
+            assert np.array_equal(st.sequence, solo)
+            assert st.served_by == (s2.host, s2.port)  # decode served
+            stats = c.stats()
+            assert stats["disagg_routed"] == 2
+            assert stats["transfer_sends"] == 2
+            assert stats["transfer_ok"] == 2
+            assert stats["transfer_typed"] == 0
+            # pairing: every dispatched hop ended in a relayed reply
+            assert stats["transfer_sends"] == (
+                stats["transfer_ok"] + stats["transfer_typed"]
+            )
+            # replica books carry the roles
+            roles = {
+                tuple(r["endpoint"]): r["role"]
+                for r in stats["replicas"]
+            }
+            assert roles[(s1.host, s1.port)] == "prefill"
+            assert roles[(s2.host, s2.port)] == "decode"
+        # prefill worker health carries the transfer ledger
+        with ServingClient(s1.host, s1.port) as c1:
+            t = c1.health()["transfer"]
+            assert t["sends"] == 2 and t["errors"] == 0
+    finally:
+        router.shutdown()
+        s1.shutdown()
+        s2.shutdown()
+
+
+@pytest.mark.chaos
+def test_router_disagg_mid_transfer_death_fails_over(model, ref_gen):
+    """Mid-transfer decode-worker death: the router ejects the victim
+    and re-sends the SAME frame to the sibling — bounded, and the
+    client sees the identical tokens (resume is deterministic)."""
+    p = _prompt(8, seed=13)
+    solo = ref_gen.generate(p[None], steps=6)[0]
+    pre = ServingEngine(model, num_slots=2, role="prefill",
+                        prefill_chunk=4, prefix_cache=False)
+    deca = ServingEngine(model, num_slots=2, role="decode",
+                         prefix_cache=False)
+    decb = ServingEngine(model, num_slots=2, role="decode",
+                         prefix_cache=False)
+    s1 = ServingServer(pre).start()
+    s2, s3 = ServingServer(deca).start(), ServingServer(decb).start()
+    router = FleetRouter(
+        endpoints=[(s.host, s.port) for s in (s1, s2, s3)],
+    ).start()
+    try:
+        for s in (s1, s2, s3):
+            assert router.wait_in_rotation((s.host, s.port))
+        with ServingClient("127.0.0.1", router.port) as c:
+            assert np.array_equal(c.generate(p, 6), solo)  # warm
+            # hard-kill one decode worker; the next transfers ride the
+            # survivor (dial-time death or mid-forward death both end
+            # in a completed identical reply, never a hang)
+            s2.shutdown(drain=False)
+            for _ in range(3):
+                assert np.array_equal(c.generate(p, 6), solo)
+            stats = c.stats()
+            assert stats["transfer_sends"] == (
+                stats["transfer_ok"] + stats["transfer_typed"]
+            )
+    finally:
+        router.shutdown()
+        s1.shutdown()
+        s3.shutdown()
+
+
+@pytest.mark.chaos
+def test_kv_transfer_seam_both_directions_typed(model):
+    """The kv.transfer seam: an injected raise on the send side fails
+    only that request typed at the prefill engine; on the recv side
+    the decode worker replies typed and the single-decode-worker
+    router relays it — never a hang, tape names the class."""
+    from distkeras_tpu.faults import FaultPlan, InjectedFault
+
+    p = _prompt(7, seed=17)
+    pre = ServingEngine(model, num_slots=2, role="prefill",
+                        prefill_chunk=4, prefix_cache=False).start()
+    dec = ServingEngine(model, num_slots=2, role="decode",
+                        prefix_cache=False).start()
+    try:
+        blob, _ = pre.prefill(p, 4)  # warm both paths
+        assert dec.wait(dec.resume(blob, 4)) is not None
+        plan = FaultPlan(seed=0).arm(
+            "kv.transfer", times=1,
+            when=lambda ctx: ctx.get("direction") == "send",
+        )
+        with plan:
+            with pytest.raises(ServingError) as ei:
+                pre.prefill(p, 4)
+        assert ei.value.code == "internal"
+        assert plan.fired("kv.transfer") == 1
+        plan = FaultPlan(seed=0).arm(
+            "kv.transfer", times=1,
+            when=lambda ctx: ctx.get("direction") == "recv",
+        )
+        with plan:
+            with pytest.raises(ServingError) as ei:
+                dec.resume(blob, 4)
+        assert plan.fired("kv.transfer") == 1
+        tape = [
+            e for e in dec.recorder.snapshot()
+            if e["kind"] == "kv.transfer.error"
+        ]
+        assert tape and tape[-1]["error"] == InjectedFault.__name__
+    finally:
+        pre.stop()
+        dec.stop()
+
+
+@pytest.mark.chaos
+def test_disagg_soak_smoke(model):
+    """``tools/soak_serving.py --disagg`` at tier-1 scale meets its own
+    acceptance bar: kv.transfer armed, both workers hard-killed
+    mid-soak (prefill mid-transfer, decode mid-resume), 0 hung /
+    0 untyped / 0 divergent replays, transfer pairing balanced at
+    shutdown, replacements actually serving. Same rationale as the
+    other soak smokes: the chaos harness itself is pinned on CPU so a
+    drift surfaces as a red test, not a dead soak run."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ))
+    try:
+        import soak_serving
+    finally:
+        sys.path.pop(0)
+
+    summary = soak_serving.run_disagg_soak(
+        clients=3, duration=6.0, seed=0, model=model,
+    )
+    assert summary["hung"] == 0
+    assert summary["untyped_errors"] == 0, summary["untyped_samples"]
+    assert summary["corrupt_outputs"] == 0
+    assert summary["divergent_replays"] == 0
+    assert summary["router"]["transfer_paired"], summary["router"]
+    assert summary["completed"] > 0
+    assert summary["streamed_completed"] > 0
+    assert summary["ok"], summary
+
+
+# ---------------------------------------------------------- loadgen
+
+
+def test_interactive_preset_trace():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    trace = loadgen.make_trace(
+        process="poisson", rate=50.0, n=60,
+        tenants=loadgen.interactive_tenants(256), vocab=64, seed=0,
+    )
+    replay = loadgen.make_trace(
+        process="poisson", rate=50.0, n=60,
+        tenants=loadgen.interactive_tenants(256), vocab=64, seed=0,
+    )
+    # deterministic, streaming flags included
+    for a, b in zip(trace, replay):
+        assert a["stream"] == b["stream"]
+        assert np.array_equal(a["prompt"], b["prompt"])
+    names = {ev["tenant"] for ev in trace}
+    assert names == {"chat", "doc"}
+    chat = [ev for ev in trace if ev["tenant"] == "chat"]
+    doc = [ev for ev in trace if ev["tenant"] == "doc"]
+    assert chat and doc
+    assert all(ev["stream"] for ev in chat)  # chat always streams
+    assert max(ev["prompt"].size for ev in doc) >= 128  # prefill-heavy
+    assert max(ev["prompt"].size for ev in chat) <= 26
+    # summarize counts the streamed share
+    summ = loadgen.summarize(trace)
+    assert summ["tenants"]["chat"]["streamed"] == len(chat)
+    # a spec WITHOUT stream keys still produces stream-less events
+    # (byte-compatible with pre-streaming traces)
+    plain = loadgen.make_trace(process="poisson", rate=10.0, n=5,
+                               vocab=64, seed=1)
+    assert all("stream" not in ev for ev in plain)

@@ -61,6 +61,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: sides of every fresh run. The band here was also widened to 5.0.
 SERVING_RATIO_BAND = 5.0
 FLEET_RATIO_BAND = 10.0
+#: the disagg A/B runs FOUR engine processes' worth of work plus two
+#: routers time-sharing one core over real TCP — its smoke ratios
+#: swing like the fleet's, so the same wide collapse-only band
+DISAGG_RATIO_BAND = 10.0
 #: the sharded decode grid is a 1-repeat scheduler-free drive on a
 #: time-shared CPU "mesh" — smoke ratios have been observed ~1.9x off
 #: the full run's; the band gates collapse, the committed floors below
@@ -94,6 +98,11 @@ FLEET_RATIO_KEYS = (
 DECODE_RATIO_KEYS = (
     "sharded.rows.tp2.ratio_vs_tp1",
     "sharded.rows.tp4.ratio_vs_tp1",
+)
+DISAGG_RATIO_KEYS = (
+    "disagg.scenarios.interactive.inter_token_p99_ratio",
+    "disagg.scenarios.interactive.tokens_per_sec_ratio",
+    "disagg.scenarios.short_uniform_overhead.tokens_per_sec_ratio",
 )
 
 #: floors the COMMITTED artifact must clear — the claims PERF.md
@@ -142,6 +151,15 @@ COMMITTED_FLOORS = {
         "sharded.rows.tp2.ratio_vs_tp1": 0.15,
         "sharded.rows.tp4.ratio_vs_tp1": 0.1,
         "sharded.adversarial_small_tp4.ratio_vs_tp1": 0.03,
+    },
+    # disaggregated prefill/decode: under the interactive trace's
+    # long-prompt arrivals, the decode worker's inter-token p99 must
+    # stay >= 1.3x better than the unified fleet's (prefill chunks
+    # never interleave with its decode iterations — this PR's
+    # isolation claim; the short-uniform row states the transfer
+    # hop's pure-overhead cost honestly, no floor on honesty rows)
+    "disagg": {
+        "disagg.scenarios.interactive.inter_token_p99_ratio": 1.3,
     },
 }
 
@@ -377,15 +395,89 @@ def compare_decode(fresh: dict, committed: dict) -> list[str]:
     return violations
 
 
+def compare_disagg(fresh: dict, committed: dict) -> list[str]:
+    """Violations of the disaggregated prefill/decode gate (empty
+    list = pass). The invariants: both scenarios present, outputs
+    token-identical per pass (the wire transfer's identity pin),
+    streaming TTFT actually measured at delivery, and the router's
+    transfer ledger balanced (every dispatched hop ended in a relayed
+    reply or a typed failure). The committed interactive row must
+    carry REAL transfer traffic, and the short-uniform adversarial
+    row must be committed as measured."""
+    violations: list[str] = []
+    for rec, tag in ((fresh, "fresh"), (committed, "committed")):
+        dg = rec.get("disagg")
+        if dg is None:
+            violations.append(f"{tag}: missing disagg block")
+            continue
+        scenarios = dg.get("scenarios", {})
+        if set(scenarios) != {"interactive", "short_uniform_overhead"}:
+            violations.append(
+                f"{tag} disagg: scenarios are {sorted(scenarios)}"
+            )
+        for name, sc in scenarios.items():
+            if sc.get("outputs_identical") is not True:
+                violations.append(
+                    f"{tag} disagg.{name}: outputs not identical "
+                    "across the transfer"
+                )
+            if sc.get("transfer_balanced") is not True:
+                violations.append(
+                    f"{tag} disagg.{name}: transfer pairing broken: "
+                    f"{sc.get('transfer')}"
+                )
+            if not sc.get("streamed_requests", 0) > 0:
+                violations.append(
+                    f"{tag} disagg.{name}: no streamed requests — "
+                    "TTFT was not measured at delivery"
+                )
+            for side in ("disagg", "unified"):
+                if not (sc.get(side, {}).get("ttft_ms", {})
+                        .get("p99", 0) > 0):
+                    violations.append(
+                        f"{tag} disagg.{name}.{side}: no delivered "
+                        "first-byte TTFT"
+                    )
+        if "streaming_ttft" not in dg:
+            violations.append(
+                f"{tag} disagg: TTFT methodology not stated"
+            )
+    # the committed win row actually exercised the transfer hop
+    cint = (committed.get("disagg") or {}).get("scenarios", {}).get(
+        "interactive", {}
+    )
+    if not cint.get("transfer", {}).get("transfer_sends", 0) >= 1:
+        violations.append(
+            "committed disagg.interactive: no transfer hops measured"
+        )
+    cadv = (committed.get("disagg") or {}).get("scenarios", {}).get(
+        "short_uniform_overhead", {}
+    )
+    if not cadv.get("tokens_per_sec_ratio", 0) > 0:
+        violations.append(
+            "committed disagg: adversarial short-uniform row missing "
+            "a measured ratio"
+        )
+    _band_check(
+        fresh, committed, DISAGG_RATIO_KEYS, DISAGG_RATIO_BAND,
+        violations,
+    )
+    _committed_floors(committed, "disagg", violations)
+    return violations
+
+
 COMPARATORS = {
     "serving": compare_serving,
     "fleet": compare_fleet,
     "decode": compare_decode,
+    "disagg": compare_disagg,
 }
 ARTIFACTS = {
     "serving": "BENCH_SERVING.json",
     "fleet": "BENCH_FLEET.json",
     "decode": "BENCH_DECODE.json",
+    # the disagg block lives inside the serving artifact
+    "disagg": "BENCH_SERVING.json",
 }
 
 
@@ -401,6 +493,8 @@ def run_smoke(kind: str, workdir: str) -> dict:
         # bench forces it itself (--cpu routes through force_cpu_mesh)
         "decode": ["bench_decode.py", "--sharded-only", "--smoke",
                    "--cpu"],
+        # the disagg block rides the full serving smoke artifact
+        "disagg": ["bench_serving.py", "--smoke"],
     }[kind]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -414,7 +508,8 @@ def run_smoke(kind: str, workdir: str) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--kind", choices=("serving", "fleet", "decode"),
+    ap.add_argument("--kind",
+                    choices=("serving", "fleet", "decode", "disagg"),
                     required=True)
     ap.add_argument("--fresh", help="fresh --smoke artifact to grade")
     ap.add_argument("--committed",
@@ -451,6 +546,7 @@ def main(argv=None) -> int:
         "serving": SERVING_RATIO_KEYS,
         "fleet": FLEET_RATIO_KEYS,
         "decode": DECODE_RATIO_KEYS,
+        "disagg": DISAGG_RATIO_KEYS,
     }[args.kind])
     print(f"bench gate ok ({args.kind}): "
           f"{nbands} ratio bands + invariants hold")

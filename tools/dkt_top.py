@@ -84,7 +84,20 @@ def format_table(samples, width: int = 78) -> str:
                 n = int(s["value"])
                 mesh = f"  mesh=tp:{n}" if n > 1 else "  mesh=solo"
                 break
-        lines.append(f"== {replica}{mesh} ".ljust(width, "="))
+        # the disaggregation-role column: a role-split fleet's books
+        # must read at a glance which replicas prefill and which
+        # decode (from the serving_engine_role_id gauge)
+        role = ""
+        for s, _ in groups[replica]:
+            if s["name"] == "serving_engine_role_id" and (
+                s.get("value") is not None
+            ):
+                role = "  role=" + {0: "unified", 1: "prefill",
+                                    2: "decode"}.get(
+                    int(s["value"]), "?"
+                )
+                break
+        lines.append(f"== {replica}{role}{mesh} ".ljust(width, "="))
         rows = []
         for s, labels in sorted(
             groups[replica], key=lambda p: p[0]["name"]

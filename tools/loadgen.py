@@ -54,6 +54,27 @@ DEFAULT_TENANTS = (
 )
 
 
+def interactive_tenants(seq: int = 256) -> list[dict]:
+    """The ``interactive`` preset: the traffic shape disaggregated
+    prefill/decode exists for — a chat tenant's short urgent STREAMED
+    turns interleaved with a document tenant's prefill-heavy long
+    prompts. ``stream`` is a per-tenant probability: each event draws
+    its own streaming flag, so one trace carries both delivery modes
+    (the doc tenant mixes, modeling batch summarization requests that
+    sometimes stream). Prompt/step ranges scale with ``seq``."""
+    return [
+        {"name": "chat", "weight": 0.7, "priority": 0, "stream": 1.0,
+         "prompt_len": (4, max(6, seq // 10)),
+         "steps": (max(4, seq // 16), max(6, seq // 6))},
+        {"name": "doc", "weight": 0.3, "priority": 0, "stream": 0.5,
+         "prompt_len": (seq // 2, max(seq // 2 + 2, 3 * seq // 4)),
+         "steps": (max(2, seq // 32), max(4, seq // 12))},
+    ]
+
+
+PRESETS = {"interactive": interactive_tenants}
+
+
 def _rate_fn(process: str, rate: float, *, burst_factor=8.0,
              period=1.0, duty=0.2, amplitude=0.8, floor_frac=0.05):
     """The instantaneous-rate function r(t) of a modulated process
@@ -131,6 +152,10 @@ def make_trace(*, process="poisson", rate=10.0, duration=None, n=None,
     if (weights <= 0).any():
         raise ValueError("tenant weights must be > 0")
     weights = weights / weights.sum()
+    # streaming flags draw ONLY when some tenant declares a ``stream``
+    # probability: traces from stream-less specs stay byte-identical
+    # to what this generator produced before the field existed
+    has_stream = any("stream" in t for t in tenants)
     trace = []
     for t in ts:
         ti = int(rng.choice(len(tenants), p=weights))
@@ -139,13 +164,18 @@ def make_trace(*, process="poisson", rate=10.0, duration=None, n=None,
         slo_, shi = spec.get("steps", (8, 32))
         plen = int(rng.integers(plo, max(plo + 1, phi)))
         steps = int(rng.integers(slo_, max(slo_ + 1, shi)))
-        trace.append({
+        ev = {
             "t": float(t),
             "tenant": str(spec.get("name", f"tenant{ti}")),
             "priority": int(spec.get("priority", 0)),
             "prompt": rng.integers(0, vocab, plen).astype(np.int32),
             "steps": steps,
-        })
+        }
+        if has_stream:
+            ev["stream"] = bool(
+                rng.random() < float(spec.get("stream", 0.0))
+            )
+        trace.append(ev)
     return trace
 
 
@@ -173,11 +203,12 @@ def summarize(trace) -> dict:
         b = by_tenant.setdefault(
             ev["tenant"],
             {"requests": 0, "priority": ev["priority"],
-             "prompt_tokens": 0, "decode_tokens": 0},
+             "prompt_tokens": 0, "decode_tokens": 0, "streamed": 0},
         )
         b["requests"] += 1
         b["prompt_tokens"] += int(np.asarray(ev["prompt"]).size)
         b["decode_tokens"] += int(ev["steps"])
+        b["streamed"] += int(bool(ev.get("stream")))
     gaps = np.diff(ts) if ts.size > 1 else np.asarray([0.0])
     return {
         "events": len(trace),
@@ -205,14 +236,24 @@ def main(argv=None) -> int:
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--tenants", default=None,
                     help="JSON list of tenant specs (name/weight/"
-                         "priority/prompt_len/steps)")
+                         "priority/prompt_len/steps/stream)")
+    ap.add_argument("--preset", default=None, choices=sorted(PRESETS),
+                    help="named tenant-mix preset (e.g. interactive: "
+                         "streamed short chat turns + prefill-heavy "
+                         "long documents); overrides --tenants")
+    ap.add_argument("--seq", type=int, default=256,
+                    help="sequence capacity the preset's prompt/step "
+                         "ranges scale to")
     ap.add_argument("--dump", action="store_true",
                     help="print the full trace (JSON rows) instead of "
                          "the summary")
     args = ap.parse_args(argv)
-    tenants = (
-        json.loads(args.tenants) if args.tenants else DEFAULT_TENANTS
-    )
+    if args.preset is not None:
+        tenants = PRESETS[args.preset](args.seq)
+    else:
+        tenants = (
+            json.loads(args.tenants) if args.tenants else DEFAULT_TENANTS
+        )
     trace = make_trace(
         process=args.process, rate=args.rate, duration=args.duration,
         tenants=tenants, vocab=args.vocab, seed=args.seed,

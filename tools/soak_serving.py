@@ -441,6 +441,321 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     return summary
 
 
+def run_disagg_soak(clients=4, duration=6.0, seed=0, model=None,
+                    max_new=6) -> dict:
+    """Chaos soak of the DISAGGREGATED serving path: a prefill worker
+    and a decode worker behind a role-aware router, mixed streaming /
+    non-streaming / sampled clients, the ``kv.transfer`` seam in the
+    armed set, and BOTH workers hard-killed mid-soak (the prefill
+    worker mid-transfer, the decode worker mid-resume) with
+    replacements health-gated into rotation.
+
+    Acceptance bar (the ``ok`` flag):
+
+    - 0 hung clients / 0 untyped errors / 0 corrupt greedy outputs /
+      0 divergent sampled replays (streamed or not — a resend-and-skip
+      recovered stream must still assemble the canonical tokens);
+    - the TRANSFER PAIRING invariant balanced at shutdown on the
+      router's ledger: every dispatched ``kv.transfer`` hop ended in
+      a relayed reply or a typed failure
+      (``transfer_sends == transfer_ok + transfer_typed``);
+    - completions on BOTH delivery modes, and at least one request
+      completed AFTER each kill (the replacements actually served).
+    """
+    import numpy as np
+
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.networking import RetryPolicy
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+    from distkeras_tpu.serving import (
+        FleetRouter,
+        SamplingParams,
+        ServingClient,
+        ServingEngine,
+        ServingError,
+        ServingServer,
+    )
+
+    if model is None:
+        from distkeras_tpu.models import zoo
+
+        model = zoo.transformer_lm(
+            vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+            seed=0,
+        )
+
+    import numpy as _np
+
+    warm_prompt = _np.arange(1, 5, dtype=_np.int32)
+
+    def boot(role, warm=False):
+        eng = ServingEngine(
+            model, num_slots=4, queue_capacity=8, prefix_cache=False,
+            prefill_chunk=8, watchdog_interval=1.0, watchdog_grace=60.0,
+            max_restarts=10_000, restart_backoff=0.01, role=role,
+        )
+        srv = ServingServer(eng, retry_after_ms=20.0).start()
+        if warm:
+            # compile the replacement's programs OFF the serving path:
+            # a replacement whose first live request pays multi-second
+            # XLA compiles (on a contended soak machine) would spend
+            # the whole post-kill window compiling instead of serving.
+            # warmup() is SEAM-FREE (the supervisor's restart path);
+            # the live warm drives the remaining admission/transfer
+            # programs BEST-EFFORT — the chaos plan is armed, so an
+            # injected failure here is expected and just retried
+            from distkeras_tpu.serving import ServingError, kv_transfer
+
+            eng._stepper.warmup()
+            for _ in range(4):
+                try:
+                    if role == "prefill":
+                        eng.prefill(warm_prompt, 2)
+                    else:
+                        eng.generate(warm_prompt, 2)
+                        st = eng._stepper
+                        st.admit(0, warm_prompt, max_new=2)
+                        state = st.swap_out(0)
+                        st.release(0)
+                        eng.wait(eng.resume(kv_transfer.encode_state(
+                            state, prompt_len=int(warm_prompt.size)
+                        ), 2))
+                    break
+                except ServingError:
+                    continue  # an armed seam fired mid-warm; retry
+        return eng, srv
+
+    pre_eng, pre_srv = boot("prefill")
+    dec_eng, dec_srv = boot("decode")
+    router = FleetRouter(
+        endpoints=[(pre_srv.host, pre_srv.port),
+                   (dec_srv.host, dec_srv.port)],
+        health_interval=0.1, eject_after=2, connect_timeout=2.0,
+        retry_after_ms=20.0,
+    ).start()
+    for srv in (pre_srv, dec_srv):
+        assert router.wait_in_rotation((srv.host, srv.port))
+
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(0, 61, n).astype(np.int32) for n in (3, 5, 7, 9)
+    ]
+    ref_gen = CachedSequenceGenerator(model)
+    refs = [ref_gen.generate(p[None], steps=max_new)[0] for p in prompts]
+    sampled_reqs = [
+        (prompts[i % len(prompts)],
+         SamplingParams(temperature=0.8, seed=100 + i))
+        for i in range(3)
+    ]
+    # canonical sampled outputs, captured FAULT-FREE through the
+    # DISAGG path itself (prefill worker -> transfer -> decode worker)
+    with ServingClient("127.0.0.1", router.port) as warm:
+        for p in prompts:
+            warm.generate(p, max_new)
+        canon = [
+            warm.generate(p, max_new, sampling=sp.to_wire())
+            for p, sp in sampled_reqs
+        ]
+
+    plan = (
+        FaultPlan(seed=seed)
+        # the transfer seam, BOTH directions (no ``when`` filter)
+        .arm("kv.transfer", times=None, probability=0.05)
+        .arm("stepper.step", times=None, probability=1.0 / 12)
+        .arm("stepper.prefill", times=None, probability=0.02)
+        .arm("server.reply", action="drop", times=None, probability=0.02)
+        .arm("net.send", action="reset", times=None, probability=0.01)
+    )
+
+    lock = threading.Lock()
+    summary = {
+        "completed": 0,
+        "streamed_completed": 0,
+        "sampled_completed": 0,
+        "completed_after_kill": {"prefill": 0, "decode": 0},
+        "typed_errors": {},
+        "untyped_errors": 0,
+        "untyped_samples": [],
+        "corrupt_outputs": 0,
+        "divergent_replays": 0,
+    }
+    t0 = time.monotonic()
+    # the clients run until the coordinator says stop: both kills done
+    # PLUS a grace window for the replacements to actually serve (a
+    # fixed wall-clock under a contended machine can end before the
+    # second replacement ever sees a request); the hard backstop below
+    # bounds a wedged killer
+    stop_evt = threading.Event()
+    hard_stop = t0 + 4.0 * float(duration)
+    kills_done = {"prefill": False, "decode": False}
+
+    def client_loop(ci):
+        policy = RetryPolicy(
+            max_attempts=30, base_delay=0.01, max_delay=0.2,
+            budget=3 * duration + 30.0, seed=seed * 1000 + ci,
+        )
+        crng = np.random.default_rng(seed * 100 + ci)
+        with ServingClient("127.0.0.1", router.port,
+                           retry=policy) as c:
+            while not stop_evt.is_set() and (
+                time.monotonic() < hard_stop
+            ):
+                si = None
+                if crng.random() < 0.6:
+                    pi = int(crng.integers(0, len(prompts)))
+                    prompt, sp = prompts[pi], None
+                    want = refs[pi]
+                else:
+                    si = int(crng.integers(0, len(sampled_reqs)))
+                    prompt, sp = sampled_reqs[si]
+                    sp = sp.to_wire()
+                    want = canon[si]
+                streamed = bool(crng.random() < 0.5)
+                try:
+                    if streamed:
+                        st = c.generate_stream(
+                            prompt, max_new, sampling=sp
+                        )
+                        for _ in st:
+                            pass
+                        out = st.sequence
+                    else:
+                        out = c.generate(prompt, max_new, sampling=sp)
+                except ServingError as e:
+                    code = getattr(e, "code", type(e).__name__)
+                    with lock:
+                        summary["typed_errors"][code] = (
+                            summary["typed_errors"].get(code, 0) + 1
+                        )
+                    continue
+                except (ConnectionError, OSError) as e:
+                    # a retry-budget-exhausted wire death during the
+                    # kill windows is a typed-equivalent outcome (the
+                    # soak_fleet precedent): counted, not a finding
+                    with lock:
+                        summary["typed_errors"]["connection"] = (
+                            summary["typed_errors"].get("connection", 0)
+                            + 1
+                        )
+                    continue
+                except Exception as e:  # noqa: BLE001 — the finding
+                    with lock:
+                        summary["untyped_errors"] += 1
+                        if len(summary["untyped_samples"]) < 5:
+                            summary["untyped_samples"].append(repr(e))
+                    continue
+                with lock:
+                    if np.array_equal(out, want):
+                        summary["completed"] += 1
+                        if streamed:
+                            summary["streamed_completed"] += 1
+                        if si is not None:
+                            summary["sampled_completed"] += 1
+                        for k, done in kills_done.items():
+                            if done:
+                                summary["completed_after_kill"][k] += 1
+                    elif si is None:
+                        summary["corrupt_outputs"] += 1
+                    else:
+                        summary["divergent_replays"] += 1
+
+    def killer():
+        """Hard-kill each worker mid-traffic, boot a WARMED
+        replacement, and health-gate it into rotation — the prefill
+        worker first (mid-transfer deaths), then the decode worker
+        (mid-resume). Then grant the grace window and stop the
+        clients."""
+        nonlocal pre_srv, dec_srv
+        try:
+            plans = [
+                ("prefill", t0 + duration * 0.25),
+                ("decode", t0 + duration * 0.5),
+            ]
+            for role, at in plans:
+                delay = at - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                old = pre_srv if role == "prefill" else dec_srv
+                old.shutdown(drain=False)  # RST everything in flight
+                router.remove_replica((old.host, old.port))
+                _eng, srv = boot(role, warm=True)
+                router.add_replica((srv.host, srv.port))
+                router.wait_in_rotation(
+                    (srv.host, srv.port), timeout=30.0
+                )
+                if role == "prefill":
+                    pre_srv = srv
+                else:
+                    dec_srv = srv
+                with lock:
+                    kills_done[role] = True
+            # grace: the replacements must get real traffic before
+            # the clients stand down
+            time.sleep(max(2.0, 0.5 * duration))
+        except Exception as e:  # noqa: BLE001 — a dead killer is a finding
+            with lock:
+                summary.setdefault("kill_errors", []).append(repr(e))
+        finally:
+            stop_evt.set()
+
+    threads = [
+        threading.Thread(target=client_loop, args=(i,), daemon=True)
+        for i in range(int(clients))
+    ]
+    kill_thread = threading.Thread(target=killer, daemon=True)
+    with plan:
+        for t in threads:
+            t.start()
+        kill_thread.start()
+        kill_thread.join(timeout=4.0 * duration + 90.0)
+        stop_evt.set()  # backstop: clients stand down regardless
+        for t in threads:
+            t.join(timeout=duration + 60.0)
+    hung = sum(t.is_alive() for t in threads) + int(
+        kill_thread.is_alive()
+    )
+    summary["hung"] = hung
+    summary["faults_fired"] = plan.fired()
+    summary["fired_by_site"] = {
+        s: plan.fired(s)
+        for s in ("kv.transfer", "stepper.step", "stepper.prefill",
+                  "server.reply", "net.send")
+    }
+    rstats = router.stats()
+    summary["router"] = {
+        k: rstats[k]
+        for k in ("disagg_routed", "transfer_sends", "transfer_ok",
+                  "transfer_typed", "transfer_retries", "failovers",
+                  "ejections")
+    }
+    # the transfer pairing invariant, balanced at shutdown
+    summary["router"]["transfer_paired"] = (
+        rstats["transfer_sends"]
+        == rstats["transfer_ok"] + rstats["transfer_typed"]
+    )
+    router.shutdown()
+    for srv in (pre_srv, dec_srv):
+        try:
+            srv.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+    summary["ok"] = (
+        hung == 0
+        and summary["untyped_errors"] == 0
+        and summary["corrupt_outputs"] == 0
+        and summary["divergent_replays"] == 0
+        and not summary.get("kill_errors")
+        and summary["completed"] > 0
+        and summary["streamed_completed"] > 0
+        and summary["sampled_completed"] > 0
+        and summary["completed_after_kill"]["prefill"] > 0
+        and summary["completed_after_kill"]["decode"] > 0
+        and summary["router"]["transfer_paired"]
+        and summary["router"]["disagg_routed"] > 0
+    )
+    return summary
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=4)
@@ -463,12 +778,30 @@ def main(argv=None) -> int:
                     help="serve tensor-parallel over a serving mesh "
                          "(e.g. tp:2); with --cpu the 8-virtual-device "
                          "topology is forced so the mesh has devices")
+    ap.add_argument("--disagg", action="store_true",
+                    help="soak the DISAGGREGATED path instead: prefill "
+                         "+ decode workers behind a role-aware router, "
+                         "kv.transfer in the armed set, both workers "
+                         "hard-killed mid-soak with replacements")
     args = ap.parse_args(argv)
 
     if args.cpu:
         from distkeras_tpu.parallel.mesh import force_cpu_mesh
 
         force_cpu_mesh(8 if args.mesh else 1)
+
+    if args.disagg:
+        summary = run_disagg_soak(
+            clients=args.clients, duration=args.duration,
+            seed=args.seed,
+        )
+        json.dump(summary, sys.stdout, indent=2, default=str)
+        print()
+        if not summary["ok"]:
+            print("DISAGG SOAK FAILED (see summary above)",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     summary = run_soak(
         clients=args.clients, duration=args.duration, seed=args.seed,

@@ -109,7 +109,15 @@ def run_ledger_phase(workers=4, windows=40, seed=0, join_budget=60.0) -> dict:
     def worker_loop(wid):
         client = RemoteParameterServerClient(
             endpoints=endpoints,
-            retry=RetryPolicy(max_attempts=20, base_delay=0.02,
+            # 60 attempts (~6 s of jittered sleep, still inside the
+            # wall-clock budget): the window a worker must outlast is
+            # the standby's PROMOTION — unreachable-primary detection
+            # alone costs a ~2 s dial timeout, and on a suite-loaded
+            # machine the old 20-attempt (~2 s) headroom expired
+            # mid-promotion, surfacing StandbyError refusals as soak
+            # findings (observed in repeated full-tier-1 runs; the
+            # r15 overloaded-burst budget raise is the precedent)
+            retry=RetryPolicy(max_attempts=60, base_delay=0.02,
                               max_delay=0.2, budget=join_budget,
                               seed=seed * 1000 + wid),
         )
