@@ -27,14 +27,32 @@ servers):
   (``ok``/``warn``/``breach``) ride the ``health`` verb, breaches land
   in the recorder and a registry counter, and the fleet health sweep
   can eject on sustained breach.
+- ``timeseries``: :class:`MetricsHistory` — a bounded ring of
+  periodic registry snapshots answering WINDOWED queries (reset-aware
+  counter rates, windowed histogram quantiles, EWMA/trend) and
+  multi-window burn-rate SLO verdicts (fast 1m / slow 10m); served by
+  the ``timeseries`` DKT1 verb and rendered as sparkline/trend
+  columns by ``tools/dkt_top.py``.
+- ``compile_ledger``: :class:`CompileLedger` — every runtime XLA
+  program mint recorded (key, wall seconds, warmup|serving trigger,
+  in-flight requests) at the ``DecodeStepper._jit`` chokepoint, with
+  compile-STORM detection (a post-warmup serving-path mint of a
+  never-seen program trips an ``xla.compile.storm`` event + gauge)
+  and per-request ``xla.compile`` trace spans.
 """
 
+from distkeras_tpu.obs.compile_ledger import CompileLedger
 from distkeras_tpu.obs.recorder import (
     POSTMORTEM_SCHEMA,
     FlightRecorder,
     build_postmortem,
     dump_postmortem,
     latest_postmortem,
+)
+from distkeras_tpu.obs.timeseries import (
+    FAST_WINDOW,
+    SLOW_WINDOW,
+    MetricsHistory,
 )
 from distkeras_tpu.obs.slo import (
     SloEvaluator,
@@ -68,10 +86,14 @@ from distkeras_tpu.obs.tracing import (
 
 __all__ = [
     "COLLECTOR",
+    "FAST_WINDOW",
     "POSTMORTEM_SCHEMA",
+    "SLOW_WINDOW",
+    "CompileLedger",
     "Counter",
     "CounterGroup",
     "FlightRecorder",
+    "MetricsHistory",
     "Gauge",
     "Histogram",
     "MetricsRegistry",

@@ -66,12 +66,15 @@ class Counter:
         self.value += n
 
     def sample(self) -> dict:
-        return {
+        s = {
             "name": self.name,
             "kind": self.kind,
             "labels": dict(self.labels),
             "value": self.value,
         }
+        if self.help:
+            s["help"] = self.help
+        return s
 
 
 class Gauge:
@@ -103,12 +106,15 @@ class Gauge:
                 v = None
         if v is not None and not isinstance(v, (int, float, bool)):
             v = float(v)
-        return {
+        s = {
             "name": self.name,
             "kind": self.kind,
             "labels": dict(self.labels),
             "value": v,
         }
+        if self.help:
+            s["help"] = self.help
+        return s
 
 
 class Histogram:
@@ -183,7 +189,7 @@ class Histogram:
             cum += c
             le = self.bounds[i] if i < len(self.bounds) else "+Inf"
             buckets.append([le, cum])
-        return {
+        s = {
             "name": self.name,
             "kind": self.kind,
             "labels": dict(self.labels),
@@ -191,6 +197,9 @@ class Histogram:
             "sum": total,
             "buckets": buckets,
         }
+        if self.help:
+            s["help"] = self.help
+        return s
 
 
 class CounterGroup(MutableMapping):
@@ -348,20 +357,34 @@ def _fmt_value(v) -> str:
 
 def render_prometheus(samples) -> str:
     """The Prometheus text exposition format over snapshot ``samples``
-    (``# TYPE`` headers once per metric name, counters suffixed
-    ``_total`` per convention, histograms as cumulative ``_bucket``
-    series plus ``_sum``/``_count``). Samples are grouped by metric
-    name first — the exposition format requires every line of a
-    family contiguous under its ``# TYPE``, and the fleet aggregate
-    arrives interleaved (router samples, then each replica's full
-    snapshot); first-seen name order and intra-family sample order
-    are preserved."""
+    (``# HELP``/``# TYPE`` headers once per metric name, counters
+    suffixed ``_total`` per convention, histograms as cumulative
+    ``_bucket`` series plus ``_sum``/``_count``). Samples are grouped
+    by metric name first — the exposition format requires every line
+    of a family contiguous under its ``# TYPE``, and the fleet
+    aggregate arrives interleaved (router samples, then each
+    replica's full snapshot); first-seen name order and intra-family
+    sample order are preserved. The ``# HELP`` line renders the
+    metric's registered help text when one exists (a strict scraper
+    treats a family without its comment headers as a foreign line —
+    the bare exposition parsed in our reader but not everywhere), and
+    always before ``# TYPE`` per the format's ordering rule."""
     families: dict[str, list] = {}
     for s in samples:
         name = s["name"] + ("_total" if s["kind"] == "counter" else "")
         families.setdefault(name, []).append(s)
     lines = []
     for name, family in families.items():
+        help_text = next(
+            (s["help"] for s in family if s.get("help")), None
+        )
+        if help_text:
+            lines.append(
+                "# HELP " + name + " "
+                + str(help_text).replace("\\", r"\\").replace(
+                    "\n", r"\n"
+                )
+            )
         lines.append(f"# TYPE {name} {family[0]['kind']}")
         for s in family:
             _render_sample(lines, name, s)

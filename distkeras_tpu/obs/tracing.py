@@ -165,11 +165,24 @@ def span_record(name, trace_id, span_id, parent_id, start, duration_s,
 class TraceCollector:
     """Bounded, thread-safe ring of finished span records. Keeps the
     most recent ``capacity`` spans; ``dropped`` counts what the bound
-    discarded (never silently — the JSONL drain records it)."""
+    discarded (never silently — the JSONL drain records it).
 
-    def __init__(self, capacity: int = 8192):
-        self._spans: deque = deque(maxlen=int(capacity))
+    ``on_drop``: optional zero-arg callback fired ONCE, on the ring's
+    first-ever drop (the 0 -> nonzero transition of
+    ``dropped_total``). The engine wires it to a ``trace.drops``
+    flight-recorder event so silent span loss under load is on the
+    incident tape, not only a gauge nobody watches; called outside
+    the collector lock (the recorder takes its own)."""
+
+    def __init__(self, capacity: int = 8192, on_drop=None):
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(
+                f"capacity must be >= 1; got {capacity}"
+            )
+        self._spans: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
+        self.on_drop = on_drop
         self.dropped = 0
         # lifetime total: ``dropped`` is read-and-reset by the JSONL
         # drain, so a scrape-time gauge over it would zero whenever the
@@ -177,11 +190,18 @@ class TraceCollector:
         self.dropped_total = 0
 
     def record(self, span: dict) -> None:
+        first_drop = False
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
                 self.dropped_total += 1
+                first_drop = self.dropped_total == 1
             self._spans.append(span)
+        if first_drop and self.on_drop is not None:
+            try:
+                self.on_drop()
+            except Exception:  # noqa: BLE001 — observability boundary
+                pass
 
     def drain(self) -> list[dict]:
         with self._lock:
@@ -311,5 +331,16 @@ def request_spans(req, ctx: TraceContext, collector=COLLECTOR) -> list[dict]:
             phase(
                 "scheduler.blame", ev["t0"], ev["t1"],
                 status="internal", slot=ev.get("slot"),
+            )
+        elif ev["name"] == "xla.compile":
+            # a program mint landed inside this traced request's
+            # lifetime (compile-ledger attribution in the scheduler):
+            # the stall is VISIBLE in the client-assembled timeline —
+            # exactly the class the r14/r16 bench post-mortems hit
+            # blind
+            phase(
+                "xla.compile", ev["t0"], ev["t1"],
+                **{k: v for k, v in ev.items()
+                   if k not in ("name", "t0", "t1")},
             )
     return out

@@ -290,9 +290,22 @@ class ParameterServer:
         # the serving tier exposes over its ``metrics`` verb. Per-PS
         # registry: multi-PS processes (tests, standby pairs) keep
         # separate books. ``metrics_snapshot()`` is the read face.
-        from distkeras_tpu.obs import FlightRecorder, MetricsRegistry
+        from distkeras_tpu.obs import (
+            FlightRecorder,
+            MetricsHistory,
+            MetricsRegistry,
+        )
 
         self.registry = MetricsRegistry()
+        # the training tier's performance time-series ring (the
+        # serving engine's sibling): snapped cadence-guarded from the
+        # traffic path (pull/commit — one float compare between
+        # snapshots, no new thread), served over the socket tier's
+        # b"t" action — windowed commit/pull rates and straggler
+        # trends for dkt_top and the autoscaling control loop
+        self.history = MetricsHistory(
+            self.registry.snapshot, interval=1.0, capacity=600,
+        )
         self._metrics = self.registry.group(
             "training_ps",
             ("pulls", "commits", "commits_refused_no_replica"),
@@ -367,6 +380,7 @@ class ParameterServer:
             # explicit chaos hook: fires for worker-facing pulls on BOTH
             # transports (in-process and socket), never for replication
             faults.fire("ps.pull", worker_id=worker_id)
+            self.history.maybe_snap()  # traffic IS the cadence
         with self._lock:
             if _via == "client":
                 # counter increments ride the commit lock (the
@@ -417,6 +431,7 @@ class ParameterServer:
             # raise rejects the commit wholesale and the worker's
             # commit_id resend is the (exactly-once) recovery path
             faults.fire("ps.commit", commit_id=commit_id, tag=tag)
+            self.history.maybe_snap()  # traffic IS the cadence
         delta = maybe_decompress(delta)
         snap = None
         with self._lock:
@@ -816,6 +831,11 @@ class SocketParameterServer:
     - b"m": metrics scrape -> b"k" + frame {"metrics", "role", "port"}
       (the typed-registry snapshot; served in BOTH roles so a standby
       is observable before it promotes — ``dkt_top --ps`` polls this);
+    - b"t": timeseries digest; the action byte is followed by a knob
+      frame ({"window", "names", "points"}, {} = defaults) ->
+      b"k" + frame {"timeseries", "role", "port"} (the history ring's
+      windowed rates/trends — the training-tier face of the serving
+      ``timeseries`` verb; ``dkt_top --ps --window`` rides the knob);
     - b"s": stop the server;
     - anything else: b"e" + ``unknown_action`` frame and the connection
       closes — the old server silently ignored unknown bytes and re-read
@@ -1230,6 +1250,30 @@ class SocketParameterServer:
                             "port": self.port,
                         }),
                     )
+                elif action == b"t":
+                    # timeseries digest (both roles, like b"m"): the
+                    # PS history ring's windowed commit/pull rates,
+                    # straggler trend, and sparkline points. The
+                    # action carries a knob frame (window/names/
+                    # points — ``dkt_top --ps --window`` rides it)
+                    knobs, _ = unpack_frame(networking.recv_data(conn))
+                    self.ps.history.maybe_snap()
+                    kw = {}
+                    if knobs.get("window") is not None:
+                        kw["window"] = float(knobs["window"])
+                    if knobs.get("names") is not None:
+                        kw["names"] = list(knobs["names"])
+                    if knobs.get("points") is not None:
+                        kw["points"] = int(knobs["points"])
+                    conn.sendall(b"k")
+                    networking.send_data(
+                        conn,
+                        pack_frame({
+                            "timeseries": self.ps.history.digest(**kw),
+                            "role": self.role,
+                            "port": self.port,
+                        }),
+                    )
                 elif action == b"s":
                     self.stop()
                     break
@@ -1498,6 +1542,30 @@ class RemoteParameterServerClient:
         def op():
             with self._lock:
                 self._sock.sendall(b"m")
+                _read_reply_status(self._sock)
+                header, _ = unpack_frame(networking.recv_data(self._sock))
+            return header
+
+        return self._with_failover(op)
+
+    def timeseries(self, window=None, names=None, points=None) -> dict:
+        """The connected PS's windowed time-series digest
+        (``obs.MetricsHistory.digest`` over the training registry;
+        works on a standby too): ``{"timeseries": digest, "role",
+        "port"}``. ``window``/``names``/``points`` ride a knob frame
+        to the ``b"t"`` action (None = the digest defaults)."""
+        knobs = {}
+        if window is not None:
+            knobs["window"] = float(window)
+        if names is not None:
+            knobs["names"] = list(names)
+        if points is not None:
+            knobs["points"] = int(points)
+
+        def op():
+            with self._lock:
+                self._sock.sendall(b"t")
+                networking.send_data(self._sock, pack_frame(knobs))
                 _read_reply_status(self._sock)
                 header, _ = unpack_frame(networking.recv_data(self._sock))
             return header

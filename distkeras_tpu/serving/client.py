@@ -444,6 +444,24 @@ class ServingClient:
         self.last_metrics_unreachable = reply.get("unreachable") or []
         return reply["metrics"]
 
+    def timeseries(self, window=None, names=None, points=30) -> dict:
+        """Windowed performance time-series of whatever answers — a
+        lone server's engine history, or the router's per-replica
+        aggregate (every series row labeled ``replica=``, per-replica
+        burn verdicts under ``burn``, skipped replicas named in
+        ``unreachable`` and mirrored on ``last_metrics_unreachable``).
+        ``window``: seconds of history to digest (default: the 60 s
+        fast burn window); ``names``: optional series filter;
+        ``points``: sparkline resampling resolution."""
+        h = {"verb": "timeseries", "points": int(points)}
+        if window is not None:
+            h["window"] = float(window)
+        if names is not None:
+            h["names"] = list(names)
+        reply, _ = self._call(h)
+        self.last_metrics_unreachable = reply.get("unreachable") or []
+        return reply
+
     def postmortem(self):
         """The latest post-mortem bundle of whatever answers (a lone
         server's engine, or the router's own book), or None when

@@ -61,6 +61,99 @@ def _bucket_pow2(n: int, cap: int) -> int:
     return min(1 << (n - 1).bit_length(), cap)
 
 
+class _MintScope(threading.local):
+    """Thread-local attribution slot for the compile listener: the
+    ``_MintTimer`` currently executing on this thread, if any."""
+
+    def __init__(self):
+        self.key = None
+        self.compiles = 0
+
+
+_MINT_SCOPE = _MintScope()
+_MINT_LISTENER_ON = False
+_MINT_LISTENER_LOCK = threading.Lock()
+
+
+def _on_backend_compile(event, secs, **_kw):
+    """jax monitoring listener: one firing per REAL backend compile,
+    synchronous inside the triggering call — the ground truth the
+    mint detector keys on (an executable-cache-size heuristic was
+    observed to lag the compile by several calls and then attribute
+    the mint to an innocent later call)."""
+    if _MINT_SCOPE.key is not None and event.endswith(
+        "backend_compile_duration"
+    ):
+        _MINT_SCOPE.compiles += 1
+
+
+def _ensure_mint_listener() -> bool:
+    """Register the process-wide compile listener once; False when
+    the monitoring API is unavailable (the wrapper then degrades to
+    first-call-per-program detection)."""
+    global _MINT_LISTENER_ON
+    if _MINT_LISTENER_ON:
+        return True
+    with _MINT_LISTENER_LOCK:
+        if _MINT_LISTENER_ON:
+            return True
+        try:
+            from jax._src import monitoring
+
+            monitoring.register_event_duration_secs_listener(
+                _on_backend_compile
+            )
+        except Exception:  # noqa: BLE001 — private-API boundary
+            return False
+        _MINT_LISTENER_ON = True
+        return True
+
+
+class _MintTimer:
+    """Transparent wrapper around one jitted program that detects XLA
+    mints at call time: jax's monitoring hook fires (synchronously,
+    on the calling thread) once per real backend compile, so a call
+    during which it fired records the wall time the calling thread
+    just lost on the stepper's ``obs.CompileLedger``. Off the mint
+    path this costs two thread-local attribute writes per call; when
+    the monitoring API is absent (an exotic jax build) it degrades
+    to first-call-per-program detection, which still catches every
+    bucketed family's one compile."""
+
+    __slots__ = ("fn", "key", "stepper", "_monitored", "_called")
+
+    def __init__(self, fn, key, stepper):
+        self.fn = fn
+        self.key = str(key)
+        self.stepper = stepper
+        self._monitored = _ensure_mint_listener()
+        self._called = False
+
+    def __call__(self, *args):
+        if not self._monitored:
+            first, self._called = not self._called, True
+            t0 = time.perf_counter()
+            out = self.fn(*args)
+            if first:
+                self.stepper._record_mint(
+                    self.key, time.perf_counter() - t0, args
+                )
+            return out
+        scope = _MINT_SCOPE
+        prev_key, prev_n = scope.key, scope.compiles
+        scope.key, scope.compiles = self.key, 0
+        t0 = time.perf_counter()
+        try:
+            out = self.fn(*args)
+            if scope.compiles:
+                self.stepper._record_mint(
+                    self.key, time.perf_counter() - t0, args
+                )
+        finally:
+            scope.key, scope.compiles = prev_key, prev_n
+        return out
+
+
 class NgramDrafter:
     """Model-free draft source: prompt-lookup (n-gram) drafting.
 
@@ -314,7 +407,7 @@ class DecodeStepper:
                  prefix_cache=None, speculative=None, draft_k=4,
                  spec_mode="rejection", scratch=None, paged=False,
                  page_size=16, num_pages=None, recorder=None,
-                 mesh=None, _quiet=False):
+                 mesh=None, compile_ledger=None, _quiet=False):
         """``prefix_cache``: an optional ``prefix_cache.PrefixStore``.
         When set, ``begin_admit`` restores the longest cached prefix's
         K/V rows into the slot before any prefill compute, and every
@@ -431,6 +524,13 @@ class DecodeStepper:
         # (max_len); scratch-padded ones may walk into the pad
         self._lens_cap = self.max_len + max(0, int(scratch) - 1)
         self._quiet = bool(_quiet)
+        # the compile ledger (``obs.CompileLedger``): engine-owned and
+        # passed through the stepper config so it SURVIVES supervisor
+        # restarts — a restart's recompiles are attributed (rewarm),
+        # never counted from zero. The nested draft stepper gets none
+        # (its programs belong to the drafter, not the serving path).
+        self.ledger = None if _quiet else compile_ledger
+        self._warming = False  # True inside warmup(): mints off-path
         nh = self._gen._blocks[0].mhsa.num_heads
         from distkeras_tpu.ops.quantization import qshape
 
@@ -664,26 +764,72 @@ class DecodeStepper:
 
         return jax.device_put(arr, self._kv_sh)
 
-    def _jit(self, fn, donate=(), out="kv"):
+    def _jit(self, fn, donate=(), out="kv", key=None):
         """``jax.jit`` with mesh-pinned OUTPUT shardings. Solo this is
         plain jit; under a mesh every program's K/V outputs are pinned
         back to the head shard and ctx/token outputs to replicated, so
         the layout never drifts across the donation chain — a program
         whose reshape/scatter left the compiler free to re-lay-out a
         pool would silently retrace every subsequent program (a fresh
-        input sharding is a fresh compile key)."""
+        input sharding is a fresh compile key).
+
+        THE compile chokepoint: every serving program is created here,
+        so when a ``compile_ledger`` is attached the jitted callable
+        is wrapped in a mint detector — a call during which jax's
+        backend-compile monitoring event fired (a genuinely new
+        program OR a silent retrace of an old one) records (``key``,
+        wall seconds, warmup|serving trigger, in-flight requests) on
+        the ledger. Off the mint path the wrapper costs two
+        thread-local writes per call. ``key``: the ledger's program
+        name, stamped at the call site with its bucket (e.g.
+        ``"admit[16]"``); defaults to the function's name."""
         import jax
 
         if self.mesh is None:
-            return jax.jit(fn, donate_argnums=donate)
-        kv, rp = self._kv_sh, self._repl_sh
-        outs = {
-            "kv": kv,  # a caches/pools pytree alone
-            "ctx": rp,  # the context rows alone
-            "step": (rp, kv, rp),  # (ctx, caches/pools, tokens)
-            "verify": (rp, kv, rp, rp),  # (ctx, kv, tokens, counts)
-        }[out]
-        return jax.jit(fn, donate_argnums=donate, out_shardings=outs)
+            jitted = jax.jit(fn, donate_argnums=donate)
+        else:
+            kv, rp = self._kv_sh, self._repl_sh
+            outs = {
+                "kv": kv,  # a caches/pools pytree alone
+                "ctx": rp,  # the context rows alone
+                "step": (rp, kv, rp),  # (ctx, caches/pools, tokens)
+                "verify": (rp, kv, rp, rp),  # (ctx, kv, tokens, counts)
+            }[out]
+            jitted = jax.jit(fn, donate_argnums=donate,
+                             out_shardings=outs)
+        if self.ledger is None:
+            return jitted
+        return _MintTimer(
+            jitted, key or getattr(fn, "__name__", "program"), self
+        )
+
+    def _record_mint(self, key, seconds, args):
+        """One detected program mint (called by ``_MintTimer``): build
+        the hashable shape/dtype signature (metadata only — donated
+        buffers keep their avals readable) and hand it to the ledger.
+        Never raises: the mint already happened, the serving path must
+        not fail over its bookkeeping."""
+        led = self.ledger
+        if led is None:
+            return
+        try:
+            import jax
+
+            sig = tuple(
+                (
+                    tuple(getattr(leaf, "shape", ()) or ()),
+                    str(getattr(leaf, "dtype", type(leaf).__name__)),
+                )
+                for leaf in jax.tree_util.tree_leaves(args)
+            )
+        except Exception:  # noqa: BLE001 — observability boundary
+            sig = ()
+        try:
+            led.record_mint(
+                key, seconds, signature=sig, warming=self._warming
+            )
+        except Exception:  # noqa: BLE001 — observability boundary
+            pass
 
     @property
     def mesh_spec(self):
@@ -961,7 +1107,7 @@ class DecodeStepper:
                 lambda ctx, r, s: jax.lax.dynamic_update_slice(
                     ctx, r, (s, 0)
                 ),
-                donate=(0,), out="ctx",
+                donate=(0,), out="ctx", key="ctx_row",
             )
         self._ctx = self._row_fn(self._ctx, row, np.int32(slot))
         if host_hit is not None:
@@ -1091,7 +1237,7 @@ class DecodeStepper:
                         (ck.at[d].set(ck[s]), cv.at[d].set(cv[s]))
                         for ck, cv in pools
                     ],
-                    donate=(0,), out="kv",
+                    donate=(0,), out="kv", key="page_cow",
                 )
             with annotate("serving/page_cow"):
                 self._pools = self._page_copy_fn(
@@ -1106,7 +1252,7 @@ class DecodeStepper:
             self._compiling()
             self._row_copy_fn = self._jit(
                 lambda ctx, s, d: ctx.at[d].set(ctx[s]),
-                donate=(0,), out="ctx",
+                donate=(0,), out="ctx", key="ctx_row_copy",
             )
         self._ctx = self._row_copy_fn(
             self._ctx, np.int32(src), np.int32(dst)
@@ -1247,7 +1393,7 @@ class DecodeStepper:
                 lambda ctx, r, s: jax.lax.dynamic_update_slice(
                     ctx, r, (s, 0)
                 ),
-                donate=(0,), out="ctx",
+                donate=(0,), out="ctx", key="ctx_row",
             )
         self._ctx = self._row_fn(self._ctx, row, np.int32(slot))
         if state["kv"][0][0].shape[0] >= 1:
@@ -1531,7 +1677,27 @@ class DecodeStepper:
         step-index argument is traced data, so the program is the same
         one live traffic uses. Deliberately does NOT route through
         ``step()`` — warmup must not trip armed ``stepper.step`` fault
-        seams meant for live traffic."""
+        seams meant for live traffic.
+
+        Compile-ledger semantics: everything minted inside this call
+        records ``trigger="warmup"``. It deliberately does NOT call
+        ``ledger.mark_warmed()`` — this method covers only the
+        step/verify families (prefill buckets, restores, and grammar
+        variants compile elsewhere), so declaring warmup COMPLETE is
+        the harness's call, made explicitly after whatever warm set
+        its traffic needs (``warm_prefill_buckets`` /
+        ``warm_restore_buckets`` / ``warm_constrained_buckets``).
+        From that mark on, a serving-path mint of a program signature
+        no generation has ever compiled is a compile STORM
+        (``xla.compile.storm`` on the tape + the
+        ``serving_compile_storms`` gauge)."""
+        self._warming = True
+        try:
+            self._warmup()
+        finally:
+            self._warming = False
+
+    def _warmup(self) -> None:
         active = np.zeros(self.num_slots, bool)
         sargs = self._sampling_args()  # parked slots = greedy defaults
         if self.paged:
@@ -1604,6 +1770,246 @@ class DecodeStepper:
                 )
             self.drafter.warmup()
 
+    def warm_prefill_buckets(self) -> None:
+        """Compile every pow2 admit / chunk-prefill bucket OFF the
+        serving path. A serial warm drive CANNOT cover these: which
+        chunk bucket a prefill hits depends on how the scheduler's
+        per-iteration budget splits across concurrently-admitted
+        prompts (a 3-deep prefill queue hands the second slot
+        whatever budget the first left), so the bucket set is
+        traffic-shape-dependent even for a fixed prompt mix — exactly
+        the mid-serving mint class the compile ledger flags. O(log T)
+        programs per family; mints record ``trigger="warmup"``. Only
+        safe on an IDLE bank (the dense paths write masked-garbage
+        rows through slot 0, overwritten before anything attends
+        them — the standing restore argument)."""
+        self._warming = True
+        try:
+            cb = 1
+            while True:
+                cbb = min(cb, self.max_len)
+                toks = np.zeros((1, cbb), np.int32)
+                if self.paged:
+                    # paged admission runs ONE program family (every
+                    # chunk, whole-prefix included, is the paged
+                    # gather/scatter chunk at fixed extent). Slot 0's
+                    # table must be empty (the writes scatter into the
+                    # null sentinel page): a non-idle bank SKIPS the
+                    # bucket entirely — caching the built-but-never-
+                    # executed fn would mark the family compiled, so
+                    # the first live chunk would pay the mint without
+                    # the _compiling() watchdog grace
+                    if self._tables[0]:
+                        if cb >= self.max_len:
+                            break
+                        cb <<= 1
+                        continue
+                    pbt = self._max_pages_bucket
+                    key = (cbb, pbt)
+                    fn = self._pchunk_fns.get(key)
+                    if fn is None:
+                        fn = self._build_chunk_fn_paged(cbb, pbt)
+                        self._pchunk_fns = {
+                            **self._pchunk_fns, key: fn
+                        }
+                    # empty table row -> null sentinel page
+                    with annotate("serving/warmup"):
+                        self._pools = fn(
+                            self._params, self._pools, toks,
+                            self._table_row(0, pbt), np.int32(0),
+                        )
+                else:
+                    fn = self._chunk_fns.get(cbb)
+                    if fn is None:
+                        fn = self._build_chunk_fn(cbb)
+                        self._chunk_fns = {**self._chunk_fns, cbb: fn}
+                    with annotate("serving/warmup"):
+                        self._caches = fn(
+                            self._params, self._caches, toks,
+                            np.int32(0), np.int32(0),
+                        )
+                if cb >= self.max_len:
+                    break
+                cb <<= 1
+            if not self.paged:
+                # the dense whole-prefix (admit) family: pow2 buckets
+                # clamped to max_len - 1 (the near-capacity bucket a
+                # non-pow2 capacity keys on)
+                pb, buckets = 1, set()
+                while True:
+                    buckets.add(min(pb, self.max_len - 1))
+                    if pb >= self.max_len - 1:
+                        break
+                    pb <<= 1
+                row = np.zeros((1, self.max_len), np.int32)
+                for pb in sorted(b for b in buckets if b >= 1):
+                    fn = self._admit_fns.get(pb)
+                    if fn is None:
+                        fn = self._build_admit_fn(pb)
+                        self._admit_fns = {**self._admit_fns, pb: fn}
+                    with annotate("serving/warmup"):
+                        self._caches = fn(
+                            self._params, self._caches, row,
+                            np.int32(0),
+                        )
+        finally:
+            self._warming = False
+
+    def warm_constrained_buckets(self) -> None:
+        """Compile the grammar-MASKED step/verify variants off the
+        serving path. ``warmup()`` deliberately skips these
+        (unconstrained traffic must never pay for the grammar
+        variants), which means a constrained mix under CHURNING
+        occupancy mints them live: the paged STEP key tracks the
+        longest OCCUPIED table, so which masked-step bucket an
+        iteration needs is traffic-shape-dependent — exactly the
+        mid-serving mint class the compile ledger flags. Verify
+        windows always run at the fixed ``_max_pages_bucket`` extent,
+        so only that bucket's masked/unmasked variants are warmed.
+        Harnesses serving grammar/speculative traffic call this
+        before ``mark_warmed()``; O(log pages) masked-step programs
+        plus two verify variants. All writes masked (inactive bank):
+        the slot bank is numerically untouched."""
+        self._warming = True
+        try:
+            active = np.zeros(self.num_slots, bool)
+            sargs = self._sampling_args()
+            vocab = self._gen._emb.vocab_size
+            tmask = np.zeros((self.num_slots, vocab), np.float32)
+            cand = np.zeros((self.num_slots, self._kb), np.int32)
+            cnt = np.zeros((self.num_slots,), np.int32)
+            if not self.paged:
+                fn = self._step_fns.get(True)
+                if fn is None:
+                    fn = self._build_step_fn(True)
+                    self._step_fns = {**self._step_fns, True: fn}
+                with annotate("serving/warmup"):
+                    self._ctx, self._caches, _ = fn(
+                        self._params, self._ctx, self._caches,
+                        self._lens.copy(), active, *sargs, tmask,
+                    )
+                if self.drafter is not None:
+                    key = (self._kb + 1, True)
+                    vfn = self._verify_fns.get(key)
+                    if vfn is None:
+                        vfn = self._build_verify_fn(*key)
+                        self._verify_fns = {
+                            **self._verify_fns, key: vfn
+                        }
+                    with annotate("serving/warmup"):
+                        self._ctx, self._caches, _, _ = vfn(
+                            self._params, self._ctx, self._caches,
+                            self._lens.copy(), active, cand, cnt,
+                            *sargs, tmask,
+                        )
+                return
+            # the masked STEP tracks the longest OCCUPIED table, so
+            # it needs every pow2 bucket; verify windows always run
+            # at the fixed _max_pages_bucket extent (the live call
+            # site pins it), so warming verify at the sub-max buckets
+            # would mint programs no iteration can ever key on
+            pbt = 1
+            while True:
+                table = np.zeros((self.num_slots, pbt), np.int32)
+                key = (pbt, True)
+                fn = self._pstep_fns.get(key)
+                if fn is None:
+                    fn = self._build_step_fn_paged(pbt, True)
+                    self._pstep_fns = {**self._pstep_fns, key: fn}
+                with annotate("serving/warmup"):
+                    self._ctx, self._pools, _ = fn(
+                        self._params, self._ctx, self._pools,
+                        self._lens.copy(), active, table, *sargs,
+                        tmask,
+                    )
+                if pbt >= self._max_pages_bucket:
+                    break
+                pbt *= 2
+            if self.drafter is not None:
+                pbt = self._max_pages_bucket
+                table = np.zeros((self.num_slots, pbt), np.int32)
+                # warmup() covers the unmasked max-bucket verify; the
+                # MASKED variant is this method's contribution (warm
+                # both anyway — harnesses may call this without
+                # warmup(), and a warm re-mint costs nothing)
+                for vmasked in (False, True):
+                    vkey = (self._kb + 1, pbt, vmasked)
+                    vfn = self._pverify_fns.get(vkey)
+                    if vfn is None:
+                        vfn = self._build_verify_fn_paged(*vkey)
+                        self._pverify_fns = {
+                            **self._pverify_fns, vkey: vfn
+                        }
+                    extra = (tmask,) if vmasked else ()
+                    with annotate("serving/warmup"):
+                        self._ctx, self._pools, _, _ = vfn(
+                            self._params, self._ctx, self._pools,
+                            self._lens.copy(), active, cand, cnt,
+                            table, *sargs, *extra,
+                        )
+        finally:
+            self._warming = False
+
+    def warm_restore_buckets(self) -> None:
+        """Compile every pow2 swap-restore bucket OFF the serving
+        path: which bucket a QoS resume (or a prefix-cache hit / a
+        disagg ``resume``) needs depends on the victim's length at
+        preempt time — timing-dependent, so without this warm a mint
+        lands inside some interactive request's p99 (the exact ~240 ms
+        stall PERF.md r16 measured before the QoS bench warmed these
+        off-path; factored here from that bench so the soaks and any
+        harness share one warm). Buckets: every power of two up to
+        ``max_len`` plus the max_len-CLAMPED value a near-capacity
+        restore keys on. Only safe on an IDLE bank — the dense path
+        writes (masked-garbage) rows through slot 0. Mints record
+        ``trigger="warmup"``."""
+        self._warming = True
+        try:
+            dt = np.dtype(self._gen.kv_dtype)
+            nh, hd = self._nh, self._hd
+            pb, buckets = 1, set()
+            while True:
+                buckets.add(min(pb, self.max_len))
+                if pb >= self.max_len:
+                    break
+                pb <<= 1
+            for p in sorted(buckets):
+                kv = [
+                    (np.zeros((p, nh, hd), dt), np.zeros((p, nh, hd), dt))
+                    for _ in self._gen._stages
+                ]
+                if self.paged and not self._tables[0]:
+                    # an empty table row scatters into the null
+                    # sentinel page — garbage there is unreachable by
+                    # construction, so this is safe even mid-serving
+                    self._restore_prefix(0, kv)
+                elif not self.paged:
+                    self._restore_prefix(0, kv)
+            # the ctx-row write both swap_in and begin_admit share.
+            # Only when not yet compiled (the write exists solely to
+            # mint the program), and never over an occupied slot 0 —
+            # zeroing a live request's context row would corrupt its
+            # remaining decode, the exact class the paged restores
+            # above guard against
+            occupied = (
+                bool(self._tables[0]) if self.paged
+                else int(self._lens[0]) > 0
+            )
+            if self._row_fn is None and not occupied:
+                import jax
+
+                self._compiling()
+                self._row_fn = self._jit(
+                    lambda ctx, r, s: jax.lax.dynamic_update_slice(
+                        ctx, r, (s, 0)
+                    ),
+                    donate=(0,), out="ctx", key="ctx_row",
+                )
+                row = np.zeros((1, self.max_len), np.int32)
+                self._ctx = self._row_fn(self._ctx, row, np.int32(0))
+        finally:
+            self._warming = False
+
     def _build_admit_fn(self, pb: int):
         """Compiled whole-prefix prefill for bucket ``pb``: positions
         0..pb-1 via the generator's shared ``_prefill`` body. The
@@ -1643,7 +2049,8 @@ class DecodeStepper:
                 ]
             return caches
 
-        return self._jit(admit, donate=(1,), out="kv")
+        return self._jit(admit, donate=(1,), out="kv",
+                         key=f"admit[{pb}]")
 
     def _build_chunk_fn(self, cb: int):
         """Compiled mid-prompt prefill chunk for bucket ``cb``: run the
@@ -1689,7 +2096,8 @@ class DecodeStepper:
                 )
             return out
 
-        return self._jit(chunk, donate=(1,), out="kv")
+        return self._jit(chunk, donate=(1,), out="kv",
+                         key=f"chunk[{cb}]")
 
     def _build_copy_fn(self):
         """Compiled prefix-cache restore: write the stacked per-stage
@@ -1714,7 +2122,8 @@ class DecodeStepper:
                 )
             return out
 
-        return self._jit(copy, donate=(0,), out="kv")
+        return self._jit(copy, donate=(0,), out="kv",
+                         key="restore")
 
     # -- paged programs (gather-based attention over page pools) ------------
     #
@@ -1821,7 +2230,10 @@ class DecodeStepper:
             ctx = ctx.at[rows, wpos].set(jnp.where(write, nxt, cur))
             return ctx, new_pools, nxt
 
-        return self._jit(step, donate=(1, 2), out="step")
+        return self._jit(
+            step, donate=(1, 2), out="step",
+            key=f"paged_step[{pbt}{',masked' if masked else ''}]",
+        )
 
     def _build_chunk_fn_paged(self, cb: int, pbt: int):
         """Compiled paged prefill chunk for (chunk bucket ``cb``, table
@@ -1873,7 +2285,8 @@ class DecodeStepper:
                 out.append((ck, cv))
             return out
 
-        return self._jit(chunk, donate=(1,), out="kv")
+        return self._jit(chunk, donate=(1,), out="kv",
+                         key=f"paged_chunk[{cb},{pbt}]")
 
     def _build_copy_fn_paged(self, pbk: int, pbt: int):
         """Compiled paged prefix restore: scatter the stacked per-stage
@@ -1905,7 +2318,8 @@ class DecodeStepper:
                 )
             return out
 
-        return self._jit(copy, donate=(0,), out="kv")
+        return self._jit(copy, donate=(0,), out="kv",
+                         key=f"paged_restore[{pbk},{pbt}]")
 
     def _build_verify_fn_paged(self, c: int, pbt: int, masked=False):
         """Compiled paged speculative verify for (``c`` candidates,
@@ -2007,7 +2421,10 @@ class DecodeStepper:
             ctx = ctx.at[rows2, wpos].set(jnp.where(keep, out, cur))
             return ctx, new_pools, out, n_new
 
-        return self._jit(verify, donate=(1, 2), out="verify")
+        return self._jit(
+            verify, donate=(1, 2), out="verify",
+            key=f"paged_verify[{c},{pbt}{',masked' if masked else ''}]",
+        )
 
     # -- the decode step ----------------------------------------------------
 
@@ -2150,7 +2567,10 @@ class DecodeStepper:
             ctx = ctx.at[rows, wpos].set(jnp.where(write, nxt, cur))
             return ctx, new_caches, nxt
 
-        return self._jit(step, donate=(1, 2), out="step")
+        return self._jit(
+            step, donate=(1, 2), out="step",
+            key=f"step[{'masked' if masked else 'plain'}]",
+        )
 
     # -- speculative decode (draft -> verify -> rollback) -------------------
 
@@ -2312,7 +2732,8 @@ class DecodeStepper:
                     jnp.where(keep, toks.astype(ctx.dtype), cur)
                 )
 
-            self._seg_fn = self._jit(seg, donate=(0,), out="ctx")
+            self._seg_fn = self._jit(seg, donate=(0,), out="ctx",
+                                     key="accept_segment")
         self._ctx = self._seg_fn(
             self._ctx, np.asarray(toks, np.int32),
             lens0.astype(np.int32), counts.astype(np.int32),
@@ -2423,7 +2844,10 @@ class DecodeStepper:
             ctx = ctx.at[rows2, wpos].set(jnp.where(keep, out, cur))
             return ctx, new_caches, out, n_new
 
-        return self._jit(verify, donate=(1, 2), out="verify")
+        return self._jit(
+            verify, donate=(1, 2), out="verify",
+            key=f"verify[{c}{',masked' if masked else ''}]",
+        )
 
 
 class ServingEngine:
@@ -2452,7 +2876,8 @@ class ServingEngine:
                  recorder_capacity=2048, postmortem_dir=None,
                  slos=None, slo_interval=5.0, paged=False,
                  page_size=16, num_pages=None, qos=None, mesh=None,
-                 role="unified"):
+                 role="unified", history=True, history_interval=1.0,
+                 history_capacity=600, trace_ring=8192):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -2508,6 +2933,20 @@ class ServingEngine:
         as ``slo``/``slo_violations``, re-evaluated at most every
         ``slo_interval`` seconds; breaches count in
         ``serving_slo_breaches`` and land in the recorder).
+
+        Time-series knobs: ``history`` (True — the default — keeps an
+        ``obs.MetricsHistory`` ring of periodic registry snapshots,
+        snapped from the supervisor thread's poll loop at
+        ``history_interval`` seconds, ``history_capacity`` snapshots
+        deep: ten minutes at the defaults, exactly the slow burn
+        window; False is the bench's A/B control). The ring answers
+        the ``timeseries`` DKT1 verb (windowed rates / quantiles /
+        trends) and — when ``slos`` are configured — multi-window
+        BURN-RATE verdicts riding ``health`` as ``burn`` next to the
+        point-in-time ``slo`` block. ``trace_ring``: the span ring's
+        capacity (``obs.TraceCollector``); the first dropped span
+        lands a ``trace.drops`` event on the flight recorder, so span
+        loss under load is on the incident tape, not only a gauge.
 
         QoS knob: ``qos`` — an optional ``qos.QosPolicy``. None keeps
         the single-FIFO scheduler. A policy turns the queue into
@@ -2572,7 +3011,12 @@ class ServingEngine:
         # engine's pending spans in an in-process fleet
         from distkeras_tpu.obs import FlightRecorder, TraceCollector
 
-        self.trace_collector = TraceCollector()
+        # span ring capacity is a knob; the FIRST dropped span lands a
+        # ``trace.drops`` recorder event (the 0 -> nonzero transition)
+        # so silent span loss under load is on the incident tape
+        self.trace_collector = TraceCollector(
+            capacity=trace_ring, on_drop=self._on_trace_drop
+        )
         # span-ring drops, scrapeable (today they are counted but only
         # visible in the JSONL drain): lifetime total, so a drain's
         # read-and-reset of ``dropped`` never zeroes the gauge
@@ -2590,6 +3034,30 @@ class ServingEngine:
         )
         if self.recorder is not None:
             self.recorder.register_gauges(self.registry, "serving")
+        # the XLA compile ledger: engine-owned (it must survive
+        # supervisor restarts — a rebuilt stepper's recompiles are
+        # attributed as rewarms, and the counters never reset under
+        # the history ring), handed to every stepper generation via
+        # the config. Counts serving_compiles / _compile_seconds and
+        # detects post-warmup compile STORMS (gauge + recorder event).
+        from distkeras_tpu.obs import CompileLedger, MetricsHistory
+
+        self.compile_ledger = CompileLedger(
+            registry=self.registry, recorder=self.recorder,
+            prefix="serving", inflight_fn=self._inflight_estimate,
+        )
+        # the performance time-series ring: periodic registry
+        # snapshots (the supervisor thread's poll loop is the cadence
+        # — no new thread) answering windowed queries and burn-rate
+        # SLO verdicts; ``history=False`` is the bench's A/B control
+        self.history = (
+            MetricsHistory(
+                self.metrics_snapshot, interval=history_interval,
+                capacity=history_capacity,
+            )
+            if history
+            else None
+        )
         self.postmortem_dir = postmortem_dir
         self.last_postmortem = None
         self.last_postmortem_path = None
@@ -2636,7 +3104,7 @@ class ServingEngine:
             prefix_cache=store, speculative=drafter, draft_k=draft_k,
             spec_mode=self.spec_mode, paged=paged, page_size=page_size,
             num_pages=num_pages, recorder=self.recorder,
-            mesh=self._mesh,
+            mesh=self._mesh, compile_ledger=self.compile_ledger,
         )
         try:
             self._stepper = DecodeStepper(model, **self._stepper_cfg)
@@ -2864,6 +3332,30 @@ class ServingEngine:
                 registry=reg, recorder=self.recorder, prefix="serving",
             )
 
+    def _inflight_estimate(self):
+        """Cheap requests-in-flight read for the compile ledger's
+        per-mint stamp (queued + slotted; unlocked reads, like the
+        occupancy gauges — a torn read is fine for a blast-radius
+        number)."""
+        batcher = self.batcher
+        if batcher is None:
+            return None
+        try:
+            return len(batcher._queue) + sum(
+                s is not None for s in batcher._slots
+            )
+        except Exception:  # noqa: BLE001 — observability boundary
+            return None
+
+    def _on_trace_drop(self):
+        """First-ever span drop (TraceCollector ``on_drop``): one
+        ``trace.drops`` event so the loss is on the incident tape."""
+        if self.recorder is not None:
+            self.recorder.record(
+                "trace.drops",
+                capacity=self.trace_collector.capacity,
+            )
+
     @staticmethod
     def _resolve_drafter(speculative, draft_bundle, ngram_max):
         """Map the engine-level speculation knobs onto a draft source
@@ -3002,6 +3494,10 @@ class ServingEngine:
             self._crash_evt.clear()
             if self._stop_evt.is_set():
                 return
+            if self.history is not None:
+                # the time-series cadence rides this existing poll
+                # loop (cadence-guarded: one float compare per tick)
+                self.history.maybe_snap()
             th = self._thread
             if th is None or self._failed:
                 continue
@@ -3480,6 +3976,42 @@ class ServingEngine:
             samples = samples + store.registry.snapshot()
         return samples
 
+    def timeseries(self, window=None, names=None, points=30) -> dict:
+        """The ``timeseries`` DKT1 verb's payload: windowed rate /
+        quantile / trend digests of every registered series (see
+        ``obs.MetricsHistory.digest``) plus — when SLOs are
+        configured — the multi-window burn-rate verdict. ``window``
+        defaults to the fast burn window (60 s). Raises ``ValueError``
+        when the engine was built with ``history=False`` (the wire
+        maps it to ``bad_request``)."""
+        from distkeras_tpu.obs import FAST_WINDOW
+
+        if self.history is None:
+            raise ValueError(
+                "metrics history disabled (ServingEngine(history="
+                "False)); the timeseries verb has nothing to serve"
+            )
+        self.history.maybe_snap()  # predict-only engines have no
+        # supervisor thread; a query is its own cadence
+        out = self.history.digest(
+            window=FAST_WINDOW if window is None else float(window),
+            names=names, points=int(points),
+        )
+        out["ok"] = True
+        out["burn"] = self.burn_verdict()
+        return out
+
+    def burn_verdict(self) -> dict | None:
+        """Multi-window burn-rate verdict over the configured SLO
+        specs (None without both ``slos`` and ``history``): fast 1m /
+        slow 10m, verdicts ``ok`` / ``spiking`` (fast window only —
+        happening now) / ``burning`` (slow only — budget eroding) /
+        ``breach`` (both — sustained AND current)."""
+        if self.history is None or self.slo is None:
+            return None
+        self.history.maybe_snap()
+        return self.history.burn(self.slo.specs)
+
     def _safe_dump(self, reason, detail):
         """Supervisor-path dump: a post-mortem failure (snapshot race,
         disk) must never break the self-healing it documents."""
@@ -3654,6 +4186,14 @@ class ServingEngine:
             verdict = self.slo.maybe_evaluate()
             out["slo"] = verdict["slo"]
             out["slo_violations"] = verdict["violations"]
+            if self.history is not None:
+                # the burn-rate sibling of the point-in-time verdict:
+                # "spiking now" vs "slowly burning" vs sustained
+                # breach, from the same spec list over the history
+                # ring (fast 1m / slow 10m)
+                b = self.burn_verdict()
+                out["burn"] = b["burn"]
+                out["burn_violations"] = b["violations"]
         if self._last_crash is not None:
             out["last_crash"] = self._last_crash
         return out
@@ -3681,6 +4221,10 @@ class ServingEngine:
         out["status"] = self.health()["status"]
         out["role"] = self.role
         out["transfer"] = self.transfer_snapshot()
+        # the XLA compile ledger: every runtime mint with its trigger
+        # (warmup vs serving), wall seconds, and the storm count — the
+        # soaks assert storms == 0 from exactly this block
+        out["compiles"] = self.compile_ledger.snapshot()
         out["prefix_cache"] = (
             self.prefix_store.stats()
             if self.prefix_store is not None

@@ -321,6 +321,15 @@ class FleetRouter:
             "fleet_router_trace_collector_dropped",
             fn=lambda: COLLECTOR.dropped_total,
         )
+        # the router's own performance time-series ring, snapped from
+        # the health sweep's existing cadence loop (no new thread):
+        # windowed forward rates / ejection trends for the timeseries
+        # verb, next to every replica's own windowed digests
+        from distkeras_tpu.obs import MetricsHistory
+
+        self.history = MetricsHistory(
+            self.registry.snapshot, interval=1.0, capacity=600,
+        )
         for ep in endpoints:
             self._replicas[(ep[0], int(ep[1]))] = _Replica(ep)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -504,6 +513,9 @@ class FleetRouter:
     def _health_loop(self):
         while not self._stopping.is_set():
             self._health_sweep()
+            # the time-series cadence rides the sweep loop (cadence-
+            # guarded inside: one float compare between snapshots)
+            self.history.maybe_snap()
             self._stopping.wait(self.health_interval)
 
     def _health_sweep(self):
@@ -851,6 +863,8 @@ class FleetRouter:
             return pack_frame({"ok": True, "stats": self.stats()})
         if verb == "metrics":
             return pack_frame(self._metrics_reply(header))
+        if verb == "timeseries":
+            return pack_frame(self._timeseries_reply(header))
         if verb == "postmortem":
             # the ROUTER's latest bundle (replica ejections); replica
             # engines serve their own over their own ports
@@ -959,43 +973,9 @@ class FleetRouter:
 
         samples = label_samples(self.registry.snapshot(), replica="router")
         unreachable = []
-        with self._lock:
-            eps = list(self._replicas)
-        results: dict = {}
-        errors: dict = {}
-
-        def scrape_one(ep):
-            with self._lock:
-                plock = self._poll_locks.setdefault(ep, threading.Lock())
-            try:
-                # the persistent health client, under its poll lock so a
-                # concurrent sweep never interleaves frames with us
-                with plock:
-                    cli = self._health_client(ep)
-                    results[ep] = cli.metrics()
-            except Exception as e:  # noqa: BLE001 — scrape best-effort
-                # the shared client may be mid-frame desynced: drop it
-                # (the next poll redials) and report, don't eject
-                with plock:
-                    with self._lock:
-                        stale = self._health_clients.pop(ep, None)
-                    if stale is not None:
-                        stale.close()
-                errors[ep] = repr(e)
-
-        # scrape CONCURRENTLY, like the health sweep: serialized, one
-        # slow/dead replica stalls the whole fleet scrape (and dkt_top)
-        # by health_timeout PER dead replica while holding its poll lock
-        threads = [
-            threading.Thread(target=scrape_one, args=(ep,),
-                             name="fleet-scrape", daemon=True)
-            for ep in eps
-        ]
-        for th in threads:
-            th.start()
-        deadline = time.monotonic() + self.health_timeout + 2.0
-        for th in threads:
-            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        eps, results, errors = self._scrape_replicas(
+            lambda cli: cli.metrics(), "fleet-scrape"
+        )
         for ep in eps:
             if ep in results:
                 samples += label_samples(results[ep],
@@ -1011,6 +991,119 @@ class FleetRouter:
             reply["text"] = render_prometheus(samples)
         else:
             reply["metrics"] = samples
+        return reply
+
+    def _scrape_replicas(self, call, thread_name: str):
+        """Concurrently run ``call(client)`` against every registered
+        replica's persistent health client (under its poll lock so a
+        concurrent sweep never interleaves frames); returns ``(eps,
+        results, errors)`` keyed by endpoint. A failing client may be
+        mid-frame desynced: it is dropped (the next poll redials) and
+        reported, never ejected — scraping is observability, ejection
+        belongs to the health sweep. Serialized scraping would stall
+        the whole fleet scrape (and dkt_top) by health_timeout PER
+        dead replica while holding its poll lock, hence the fan-out.
+        Shared by the ``metrics`` and ``timeseries`` verbs."""
+        with self._lock:
+            eps = list(self._replicas)
+        results: dict = {}
+        errors: dict = {}
+
+        def scrape_one(ep):
+            with self._lock:
+                plock = self._poll_locks.setdefault(ep, threading.Lock())
+            try:
+                with plock:
+                    results[ep] = call(self._health_client(ep))
+            except Exception as e:  # noqa: BLE001 — scrape best-effort
+                with plock:
+                    with self._lock:
+                        stale = self._health_clients.pop(ep, None)
+                    if stale is not None:
+                        stale.close()
+                errors[ep] = repr(e)
+
+        threads = [
+            threading.Thread(target=scrape_one, args=(ep,),
+                             name=thread_name, daemon=True)
+            for ep in eps
+        ]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + self.health_timeout + 2.0
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        return eps, results, errors
+
+    def _timeseries_reply(self, header: dict) -> dict:
+        """The fleet-level ``timeseries`` verb: the router's own
+        windowed digest (series labeled ``replica="router"``) plus
+        every registered replica's ``timeseries`` reply, each series
+        row endpoint-labeled and merged into ONE flat ``series`` list
+        (the same shape ``metrics`` aggregation ships, so dkt_top
+        renders either). Per-replica burn verdicts land under
+        ``burn`` keyed by endpoint; a replica that fails the scrape
+        is named in ``unreachable``, never silently missing; a
+        HEALTHY replica that refuses the verb typed (history=False,
+        or a pre-timeseries build mid-rollout) is named in
+        ``no_history`` — not a fleet hole."""
+        from distkeras_tpu.obs import label_samples
+
+        window = header.get("window")
+        points = int(header.get("points") or 30)
+        names = header.get("names")
+        self.history.maybe_snap()
+        own = self.history.digest(
+            window=60.0 if window is None else float(window),
+            names=names, points=points,
+        )
+        series = label_samples(own.pop("series"), replica="router")
+        reply = {
+            "ok": True,
+            **own,
+            "burn": {},
+            "unreachable": [],
+        }
+        from distkeras_tpu.serving.scheduler import ServingError
+
+        def ts_one(cli):
+            try:
+                return cli.timeseries(
+                    window=window, names=names, points=points,
+                )
+            except ServingError as e:
+                # a typed bad_request is a HEALTHY replica that cannot
+                # serve the verb (history=False, or a pre-timeseries
+                # build mid-rollout): a clean reply, so the shared
+                # health client is NOT desynced — absorb it instead of
+                # letting the scrape close/redial the client every
+                # poll and render the replica as a fleet hole
+                if getattr(e, "code", "") == "bad_request":
+                    return {"series": [], "burn": None,
+                            "no_history": True}
+                raise
+
+        eps, results, errors = self._scrape_replicas(
+            ts_one, "fleet-ts-scrape"
+        )
+        reply["no_history"] = []
+        for ep in eps:
+            label = f"{ep[0]}:{ep[1]}"
+            if ep in results:
+                r = results[ep]
+                series += label_samples(
+                    r.get("series") or [], replica=label
+                )
+                if r.get("burn") is not None:
+                    reply["burn"][label] = r["burn"]
+                if r.get("no_history"):
+                    reply["no_history"].append(label)
+            else:
+                reply["unreachable"].append({
+                    "endpoint": [ep[0], ep[1]],
+                    "error": errors.get(ep, "scrape timed out"),
+                })
+        reply["series"] = series
         return reply
 
     # -- routing ------------------------------------------------------------

@@ -34,11 +34,13 @@ This module is the sampling subsystem's spine, shared by every layer:
 - ``check_spec_sampling``: THE shared speculative-sampling validation
   (previously copy-pasted in two places). Under the default
   ``"rejection"`` mode, speculative decoding generalizes from greedy
-  agreement to rejection sampling (a drafted token is accepted with
-  probability ``p_target(token)``; the correction draws from the
-  residual), so the verify machinery keeps paying at temperature > 0;
-  ``"strict"`` is the legacy greedy-agreement-only mode, selected
-  explicitly.
+  agreement to draw-agreement acceptance (a drafted token is accepted
+  iff it equals the position-keyed draw the plain step would make —
+  rejection sampling specialized to the deterministic drafters, with
+  accept probability ``p_target(token)`` AND pointwise identity to
+  plain sampled decode), so the verify machinery keeps paying at
+  temperature > 0; ``"strict"`` is the legacy greedy-agreement-only
+  mode, selected explicitly.
 
 No JAX at module import time: the scheduler (pure host logic) imports
 this module for :class:`SamplingParams`; the device-side helpers import
@@ -307,8 +309,8 @@ def greedy_window_tokens(logit, dtoks, dcnt):
 
 def spec_window_tokens(logit, dtoks, dcnt, temps, top_k, top_p, seeds,
                        spos):
-    """Mixed greedy / rejection-sampling acceptance over one verify
-    window. ``logit`` is (B, C, V) — target logits at the C candidate
+    """Mixed greedy / sampled acceptance over one verify window.
+    ``logit`` is (B, C, V) — target logits at the C candidate
     positions (position j's logits distribute the token at emitted
     index ``spos + j``); ``dtoks`` (B, C-1) are the draft proposals,
     ``dcnt`` how many are real. Returns ``(out (B, C) int32, n_new
@@ -317,19 +319,23 @@ def spec_window_tokens(logit, dtoks, dcnt, temps, top_k, top_p, seeds,
 
     Greedy rows keep the PR 4 rule exactly: accept the longest
     argmax-agreeing prefix plus the target's correction. Sampled rows
-    use rejection sampling against the per-position target
-    distribution p (temperature/top-k/top-p applied): draft token d at
-    position e is accepted iff ``uniform(fold_in(key(seed, e), 1)) <
-    p(d)``; the first rejection draws its correction from the residual
-    (p with d masked out, renormalized), and a fully-accepted window's
-    bonus token — like every fresh (undrafted) position — draws
-    ``categorical(key(seed, e), p)``, the SAME draw the plain decode
-    step would make at that position, so replay never depends on
-    whether a position was reached through a verify window or a
-    fallback step. Acceptance preserves the sampling distribution;
-    the token SEQUENCE matches plain sampled decode only in
-    distribution (stated in ARCHITECTURE.md), while same-seed REPLAY
-    is exact."""
+    accept draft token d at emitted position e iff d EQUALS the
+    position-keyed draw ``categorical(key(seed, e), p)`` the plain
+    decode step would make there (p = the temperature/top-k/top-p-
+    filtered target distribution), and every emitted sampled token IS
+    that draw. Both drafters propose deterministically (point-mass
+    proposal q), so this IS standard speculative rejection sampling
+    specialized to that case — accept probability ``min(1, p(d)/q(d))
+    = p(d)``, exactly the probability the draw lands on d — with the
+    stronger property that the emitted SEQUENCE is pointwise
+    identical to plain sampled decode, not merely equal in
+    distribution. A window, a fallback step, and a re-serve that lost
+    its drafter (post-resume invalidation, cold throttle) all emit
+    the same tokens — the soak's divergent-replay bar depends on
+    this. An earlier accept-with-``u < p(d)``-then-residual variant
+    emitted draft tokens the plain step would not have drawn, so any
+    chaos path that switched a request between drafted and undrafted
+    decode mid-stream diverged from its canon."""
     import jax
     import jax.numpy as jnp
 
@@ -346,33 +352,9 @@ def spec_window_tokens(logit, dtoks, dcnt, temps, top_k, top_p, seeds,
     fresh = jax.vmap(jax.random.categorical)(keys, filt).astype(
         jnp.int32
     ).reshape(b, c)
-    # residual draw: the rejected draft token masked out of p (guard:
-    # a draft holding ALL surviving mass cannot be rejected in exact
-    # arithmetic, but FP p=1-eps can — fall back to the fresh draw)
-    dtoks_pad = jnp.concatenate(
-        [dtoks, jnp.zeros((b, 1), dtoks.dtype)], axis=1
-    ).astype(jnp.int32)
-    onehot = (
-        jnp.arange(v)[None, :] == dtoks_pad.reshape(-1)[:, None]
-    )  # (B*C, V)
-    resid = jnp.where(onehot, -jnp.inf, filt)
-    resid_ok = jnp.isfinite(resid).any(axis=-1, keepdims=True)
-    resid = jnp.where(resid_ok, resid, filt)
-    resid_tok = jax.vmap(jax.random.categorical)(keys, resid).astype(
-        jnp.int32
-    ).reshape(b, c)
     # acceptance: greedy rows by argmax agreement, sampled rows by
-    # u < p(draft) with u from the ACCEPT stream (fold_in(key, 1) —
-    # disjoint from the token-draw stream keyed by position alone)
-    probs = jax.nn.softmax(filt, axis=-1).reshape(b, c, v)
-    dprob = jnp.take_along_axis(
-        probs[:, : c - 1], dtoks_pad[:, : c - 1][..., None], axis=-1
-    )[..., 0]  # (B, C-1)
-    ukeys = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
-    u = jax.vmap(lambda k: jax.random.uniform(k, ()))(ukeys).reshape(
-        b, c
-    )
-    accept_sampled = u[:, : c - 1] < dprob
+    # agreement with the position-keyed draw itself
+    accept_sampled = dtoks.astype(jnp.int32) == fresh[:, : c - 1]
     accept_greedy = dtoks.astype(jnp.int32) == greedy[:, : c - 1]
     proposed = jnp.arange(c - 1)[None, :] < dcnt[:, None]
     acc = proposed & jnp.where(
@@ -385,13 +367,10 @@ def spec_window_tokens(logit, dtoks, dcnt, temps, top_k, top_p, seeds,
         axis=1,
     )
     n_new = (n_acc + 1).astype(jnp.int32)
-    # emitted tokens: accepted drafts verbatim, then the boundary token
-    # (residual at a rejected draft position, fresh past the drafts);
+    # emitted tokens: sampled rows emit the position-keyed draw at
+    # EVERY position (accepted drafts equal it by construction);
     # greedy rows emit argmax everywhere (the PR 4 emission, verbatim)
-    j = jnp.arange(c)[None, :]
-    boundary = jnp.where(j < dcnt[:, None], resid_tok, fresh)
-    out_sampled = jnp.where(j < n_acc[:, None], dtoks_pad, boundary)
-    out = jnp.where(temps[:, None] > 0.0, out_sampled, greedy)
+    out = jnp.where(temps[:, None] > 0.0, fresh, greedy)
     return out, n_new
 
 

@@ -605,6 +605,41 @@ class ContinuousBatcher:
             need += (req.n - 1) * per_fork
         return need
 
+    # -- compile attribution (the ledger's trace face) ----------------------
+
+    def _led_total(self) -> int:
+        """The stepper's compile-ledger mint count (0 when no ledger —
+        fake steppers, draft banks): read before a device call so a
+        mint landing inside it can be attributed to the traced
+        request(s) it stalled."""
+        led = getattr(self.stepper, "ledger", None)
+        return 0 if led is None else led.total
+
+    def _note_mints(self, req, n0, t0, t1) -> None:
+        """Attribute compile-ledger mints that landed during a device
+        call to a TRACED request's event ledger — ``request_spans``
+        renders the entry as an ``xla.compile`` span in the
+        client-assembled timeline, making the stall visible exactly
+        where the request experienced it. Untraced requests cost one
+        int compare."""
+        if req is None or req.trace is None:
+            return
+        led = getattr(self.stepper, "ledger", None)
+        if led is None:
+            return
+        n = led.total - n0
+        if n <= 0:
+            return
+        recs = led.tail(n)
+        req.events.append({
+            "name": "xla.compile",
+            "t0": t0, "t1": t1,
+            "mints": n,
+            "keys": [r["key"] for r in recs],
+            "seconds": round(sum(r["seconds"] for r in recs), 4),
+            "trigger": recs[-1]["trigger"] if recs else None,
+        })
+
     # -- scheduler iteration ------------------------------------------------
 
     def step(self) -> bool:
@@ -692,9 +727,11 @@ class ContinuousBatcher:
                 if req.sampling is not None:
                     kw["sampling"] = req.sampling
                     kw["eos_id"] = req.eos_id
+                n0, ta = self._led_total(), time.monotonic()
                 began.append(
                     (i, req, self.stepper.begin_admit(i, req.prompt, **kw))
                 )
+                self._note_mints(req, n0, ta, time.monotonic())
             except Exception as e:  # noqa: BLE001 — admission boundary
                 # a prefill crash is attributable by construction (one
                 # slot, one request): fail IT typed, keep everything else
@@ -769,10 +806,21 @@ class ContinuousBatcher:
         if not active.any():
             return progressed
         step_t0 = time.monotonic()
+        mints0 = self._led_total()
         toks, counts, blamed, used_verify = self._step_with_blame(
             active, seqs
         )
         now = time.monotonic()
+        if self._led_total() > mints0:
+            # a mint landed inside the decode phase: every traced
+            # active request was stalled by it — the span lands on
+            # each of their timelines (the blast radius, attributed)
+            noted = set()
+            for i, r in enumerate(self._slots):
+                if r is None or not active[i] or id(r) in noted:
+                    continue
+                noted.add(id(r))
+                self._note_mints(r, mints0, step_t0, now)
         emitted_total = 0
         with self._lock:
             self.counters["steps"] += 1
@@ -1036,11 +1084,16 @@ class ContinuousBatcher:
         wedges on a failed restore."""
         import copy
 
+        mints0, t0 = self._led_total(), time.monotonic()
         try:
             self.stepper.swap_in(
                 i, req._swap,
                 max_new=req.max_new_tokens - len(req.tokens),
             )
+            # the r16 stall class: a swap-restore bucket compiling on
+            # the resume path — if it happens to a traced request, the
+            # timeline says so
+            self._note_mints(req, mints0, t0, time.monotonic())
         except Exception as e:  # noqa: BLE001 — admission boundary
             err = (
                 copy.copy(e)
@@ -1233,6 +1286,7 @@ class ContinuousBatcher:
                 give = (
                     left if budget is None else min(left, budget - spent)
                 )
+            mints0 = self._led_total()
             chunk_t0 = time.monotonic()
             try:
                 new_left = self.stepper.prefill_chunk(i, give)  # device work
@@ -1241,6 +1295,7 @@ class ContinuousBatcher:
                 progressed = True  # the queue can move into this slot now
                 continue
             now = time.monotonic()
+            self._note_mints(req, mints0, chunk_t0, now)
             with self._lock:
                 if self._slots[i] is not req:
                     continue  # stopped/evicted underneath us
@@ -1326,10 +1381,12 @@ class ContinuousBatcher:
                     # never fork from a released primary (and never
                     # record a second, mistyped failure for it)
                     continue
+            mints0, t0 = self._led_total(), time.monotonic()
             try:
                 self.stepper.fork_slot(
                     primary, s, max_new=req.max_new_tokens, completion=j
                 )
+                self._note_mints(req, mints0, t0, time.monotonic())
             except OverloadedError:
                 # pool pressure: leave the reservation in place and
                 # retry next iteration (evictions free pages); the

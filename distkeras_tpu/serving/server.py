@@ -54,6 +54,12 @@ Verbs (header ``{"verb": ...}``):
   scheduler/engine/prefix-cache counters, gauges, and latency
   histograms as JSON samples; ``format: "prometheus"`` returns the
   text exposition dump instead (``tools/dkt_top.py`` polls this verb).
+- ``timeseries``: windowed digests over the engine's metrics-history
+  ring (``obs.MetricsHistory``) — per-series reset-aware rates,
+  windowed histogram quantiles, EWMA/trend, sparkline-ready resampled
+  points — plus the multi-window burn-rate SLO verdict when SLOs are
+  configured. Header knobs: ``window`` (seconds, default 60),
+  ``names`` (series filter), ``points`` (sparkline resolution).
 - ``postmortem``: the engine's latest crash bundle (watchdog trip or
   permanent degradation — ``obs.dump_postmortem`` schema), or None;
   ``tools/dkt_postmortem.py`` renders it into an incident timeline.
@@ -316,6 +322,17 @@ class ServingServer:
                      "text": render_prometheus(samples)}
                 )
             return pack_frame({"ok": True, "metrics": samples})
+        if verb == "timeseries":
+            # windowed rate/quantile/trend digests over the engine's
+            # metrics-history ring + the burn-rate SLO verdict; header
+            # knobs: window (seconds), names (series filter), points
+            # (sparkline resolution). history=False engines refuse
+            # with bad_request (a ValueError at this boundary).
+            return pack_frame(self.engine.timeseries(
+                window=header.get("window"),
+                names=header.get("names"),
+                points=int(header.get("points") or 30),
+            ))
         if verb == "postmortem":
             # the latest crash bundle (watchdog trip / degradation),
             # retrievable remotely so soak triage never needs shell

@@ -386,6 +386,46 @@ def test_spec_rejection_sampled_replay_and_greedy_pin(lm, lm_ref):
     np.testing.assert_array_equal(outs[0], outs[1])
 
 
+def test_spec_sampled_decode_is_pointwise_plain_sampled_decode(lm):
+    """ACCEPTANCE (the divergent-replay fix): speculative sampled
+    decode emits the SAME token sequence as plain sampled decode for
+    the same (prompt, params) — pointwise, not merely in
+    distribution. Draw-agreement acceptance makes the drafted path,
+    the fallback step, and a re-serve that lost its drafter
+    interchangeable mid-stream; before this pin, a chaos path that
+    switched a request between drafted and undrafted decode diverged
+    from its canon (the soak's latent divergent-replay flake)."""
+    from distkeras_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, VOCAB, n).astype(np.int32) for n in (3, 5, 7, 9)
+    ]
+    params = [
+        SamplingParams(temperature=0.8, seed=101),
+        SamplingParams(temperature=0.8, seed=101),
+        SamplingParams(temperature=1.1, top_k=7, seed=33),
+        SamplingParams(temperature=0.7, top_p=0.9, seed=5),
+    ]
+    plain = ServingEngine(
+        lm, num_slots=4, prefix_cache=False, watchdog_interval=30.0,
+    ).start()
+    spec = ServingEngine(
+        lm, num_slots=4, prefix_cache=False, watchdog_interval=30.0,
+        speculative="draft", draft_bundle=lm, draft_k=3,
+    ).start()
+    try:
+        for p, sp in zip(prompts, params):
+            a = plain.generate(p, 8, sampling=sp)
+            b = spec.generate(p, 8, sampling=sp)
+            np.testing.assert_array_equal(a, b)
+        # the drafted path actually ran (agreement can be accepted)
+        assert spec.stats()["speculative"]["windows"] > 0
+    finally:
+        plain.stop()
+        spec.stop()
+
+
 def test_strict_mode_is_the_legacy_refusal(lm):
     from distkeras_tpu.serving import ServingEngine
 

@@ -1,0 +1,770 @@
+"""Performance time-series + XLA compile ledger (obs.timeseries /
+obs.compile_ledger) and their wiring through the serving stack.
+
+Four tiers:
+
+- ``MetricsHistory`` units under a FROZEN fake clock: windowed
+  reset-aware rates (a scheduler-generation counter reset must never
+  produce a negative rate), empty and stale windows answering None
+  (unknown, not zero), windows older than the ring, windowed
+  histogram quantiles, EWMA/trend, the digest's sparkline resampling,
+  and multi-window burn-rate verdicts (ok / spiking / burning /
+  breach);
+- ``CompileLedger`` units: warmup vs serving triggers, cross-
+  generation rewarm attribution, storm detection arming on
+  ``mark_warmed``, the registry counters and recorder events;
+- satellites: ``render_prometheus`` ``# HELP``/``# TYPE`` family
+  headers (and that the parser still skips them), the
+  ``ServingEngine(trace_ring=)`` knob + first-drop ``trace.drops``
+  recorder event, ``dkt_top`` sparkline columns socketless;
+- end-to-end ACCEPTANCE: the ``timeseries`` verb returns windowed
+  rate/quantile/trend series for engine AND router registries
+  (router rows endpoint-labeled), burn verdicts ride ``health`` next
+  to the SLO block, and a deliberately-triggered post-warmup compile
+  inside a traced request yields all three signals — the
+  ``xla.compile`` span in the client-assembled timeline, the
+  ``xla.compile.storm`` recorder event, and the storm gauge — while
+  a supervisor restart's re-warm trips none of them (the regression
+  pin on the supervisor's warmup path).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ),
+)  # tools/dkt_top.py is a script, not a package
+
+from distkeras_tpu.obs import (
+    CompileLedger,
+    FlightRecorder,
+    MetricsHistory,
+    MetricsRegistry,
+    SloSpec,
+    TraceCollector,
+    parse_prometheus,
+    render_prometheus,
+)
+
+# ---------------------------------------------------- MetricsHistory units
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def _feeder(rows):
+    """A snapshot_fn fed from a mutable list of sample lists."""
+    it = iter(rows)
+    return lambda: next(it)
+
+
+def _counter(v, name="serving_x", labels=None):
+    return {"name": name, "kind": "counter",
+            "labels": dict(labels or {}), "value": v}
+
+
+def _gauge(v, name="serving_g"):
+    return {"name": name, "kind": "gauge", "labels": {}, "value": v}
+
+
+def _hist(buckets, count, total, name="serving_h"):
+    return {"name": name, "kind": "histogram", "labels": {},
+            "count": count, "sum": total, "buckets": buckets}
+
+
+def _feed(hist, clock, series, dt=1.0):
+    """Append one snapshot per entry of ``series`` (each a sample
+    list), advancing the fake clock ``dt`` between them."""
+    for samples in series:
+        hist._snapshot_fn = lambda s=samples: s
+        hist.snap()
+        clock.tick(dt)
+
+
+def test_windowed_rate_and_increase():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=64,
+                       clock=clock)
+    _feed(h, clock, [[_counter(v)] for v in (0, 5, 10, 30)])
+    # 4 snaps at t, t+1, t+2, t+3; now = t+4
+    assert h.increase("serving_x", window=10) == 30
+    assert h.rate("serving_x", window=10) == pytest.approx(10.0)
+    # a tighter window sees only its own increase
+    assert h.increase("serving_x", window=2.5) == 20
+
+
+def test_empty_and_stale_windows_answer_none():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=64,
+                       clock=clock)
+    assert h.rate("serving_x", window=60) is None  # nothing ever
+    _feed(h, clock, [[_counter(v)] for v in (0, 4)])
+    # rate = increase / elapsed BETWEEN the window's edge snapshots
+    # (4 in 1 s), not divided by the nominal window width
+    assert h.rate("serving_x", window=60) == pytest.approx(4.0)
+    clock.tick(500)  # the ring's newest entry predates the window
+    assert h.rate("serving_x", window=60) is None
+    assert h.quantile_over("serving_h", 60, 0.99) is None
+    assert h.series("missing", 60) == []
+    # a single snapshot inside the window: no pair to difference
+    h2 = MetricsHistory(lambda: [_counter(9)], interval=1.0,
+                        capacity=64, clock=clock)
+    h2.snap()
+    assert h2.rate("serving_x", window=60) is None
+
+
+def test_window_older_than_ring_uses_what_is_known():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=4,
+                       clock=clock)
+    _feed(h, clock, [[_counter(v)] for v in (0, 10, 20, 30, 40, 50)])
+    assert len(h) == 4  # ring bounded: oldest two evicted
+    # a window wider than the ring returns the ring's span honestly
+    # (the evicted 0->10 increase is gone, not guessed)
+    assert h.increase("serving_x", window=1e6) == 30
+
+
+def test_counter_reset_never_negative_rate():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=64,
+                       clock=clock)
+    # a supervisor restart rebuilds the scheduler's fresh counters at
+    # zero mid-window: 0 -> 10 -> (reset) 3 -> 5
+    _feed(h, clock, [[_counter(v)] for v in (0, 10, 3, 5)])
+    inc = h.increase("serving_x", window=10)
+    assert inc == 10 + 3 + 2  # post-reset value counts, never negative
+    assert h.rate("serving_x", window=10) >= 0
+
+
+def test_windowed_histogram_quantile_vs_lifetime():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=64,
+                       clock=clock)
+    # lifetime: 100 fast observations (le=0.01), then the window adds
+    # 10 slow ones (le=1.0) — the lifetime p99 stays fast, the
+    # WINDOWED p99 must see the regression
+    b0 = [[0.01, 100], [1.0, 100], ["+Inf", 100]]
+    b1 = [[0.01, 100], [1.0, 110], ["+Inf", 110]]
+    _feed(h, clock, [
+        [_hist(b0, 100, 1.0)],
+        [_hist(b1, 110, 11.0)],
+    ])
+    assert h.quantile_over("serving_h", window=10, q=0.99) == 1.0
+    st = h.hist_stats("serving_h", window=10)
+    assert st["count"] == 10
+    assert st["mean"] == pytest.approx(1.0)
+    # a histogram REBUILT mid-window (bucket ran backwards): the last
+    # snapshot alone — everything since the reset — is the window's
+    # honest content (2 fast + 1 slow: count 3, p50 fast)
+    b_reset = [[0.01, 2], [1.0, 3], ["+Inf", 3]]
+    _feed(h, clock, [[_hist(b_reset, 3, 0.1)]])
+    assert h.hist_stats("serving_h", window=10)["count"] == 3
+    assert h.quantile_over("serving_h", window=10, q=0.5) == 0.01
+
+
+def test_ewma_and_trend():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=64,
+                       clock=clock)
+    _feed(h, clock, [[_gauge(v)] for v in (1.0, 2.0, 3.0, 4.0)])
+    assert h.trend("serving_g", window=10) == pytest.approx(1.0)
+    ew = h.ewma("serving_g", window=10)
+    assert 1.0 < ew <= 4.0
+    _feed(h, clock, [[_gauge(v)] for v in (3.0, 2.0, 1.0)])
+    assert h.trend("serving_g", window=3.5) < 0
+
+
+def test_maybe_snap_is_cadence_guarded():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [_gauge(1)], interval=5.0, capacity=8,
+                       clock=clock)
+    assert h.maybe_snap() is True
+    assert h.maybe_snap() is False  # same instant: guarded
+    clock.tick(4.9)
+    assert h.maybe_snap() is False
+    clock.tick(0.2)
+    assert h.maybe_snap() is True
+    assert h.snaps_total == 2
+    # a crashing snapshot callable is skipped, never raises
+    h._snapshot_fn = lambda: 1 / 0
+    clock.tick(10)
+    h.snap()
+    assert h.snaps_total == 2
+
+
+def test_digest_rows_and_sparkline_resample():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=64,
+                       clock=clock)
+    _feed(h, clock, [
+        [_counter(v), _gauge(g),
+         _hist([[0.01, v], ["+Inf", v]], v, v * 0.01)]
+        for v, g in ((0, 5.0), (10, 6.0), (20, 7.0), (30, 8.0))
+    ])
+    d = h.digest(window=10, points=5)
+    assert d["snapshots"] == 4
+    rows = {r["name"]: r for r in d["series"]}
+    c = rows["serving_x"]
+    assert c["kind"] == "counter" and c["rate"] == pytest.approx(10.0)
+    assert len(c["points"]) == 5
+    assert any(p is not None for p in c["points"])
+    g = rows["serving_g"]
+    assert g["value"] == 8.0 and g["trend"] > 0
+    hh = rows["serving_h"]
+    assert hh["count"] == 30 and hh["p50"] == 0.01
+    # the names filter restricts the walk
+    only = h.digest(window=10, names=["serving_g"])["series"]
+    assert {r["name"] for r in only} == {"serving_g"}
+
+
+def test_burn_rate_verdicts_under_fake_clock():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=2048,
+                       clock=clock)
+    spec = SloSpec("error_rate", "serving_err", 0.1, agg="rate",
+                   per="serving_req", min_count=1)
+
+    def snaps(err_req_pairs):
+        return [
+            [_counter(e, name="serving_err"),
+             _counter(r, name="serving_req")]
+            for e, r in err_req_pairs
+        ]
+
+    # 10 minutes of clean traffic (1 err / 100 req per tick), then a
+    # hot last minute (50/100 per tick): fast window burns, slow
+    # window still inside budget -> "spiking"
+    pairs, e, r = [], 0, 0
+    for _ in range(540):
+        e += 1
+        r += 100
+        pairs.append((e, r))
+    for _ in range(60):
+        e += 50
+        r += 100
+        pairs.append((e, r))
+    _feed(h, clock, snaps(pairs))
+    v = h.burn(
+        [spec], fast=60, slow=600
+    )
+    assert v["burn"] == "spiking"
+    row = v["specs"][0]
+    assert row["fast_burn"] >= 1.0 > row["slow_burn"]
+    assert v["violations"] and v["violations"][0]["verdict"] == "spiking"
+
+    # the inverse shape: an old sustained burn, recovered in the last
+    # minute -> "burning" (budget eroded though now looks fine)
+    clock2 = FakeClock()
+    h2 = MetricsHistory(lambda: [], interval=1.0, capacity=2048,
+                        clock=clock2)
+    pairs, e, r = [], 0, 0
+    for _ in range(540):
+        e += 50
+        r += 100
+        pairs.append((e, r))
+    for _ in range(60):
+        r += 100
+        pairs.append((e, r))
+    _feed(h2, clock2, snaps(pairs))
+    v2 = h2.burn([spec], fast=60, slow=600)
+    assert v2["burn"] == "burning"
+
+    # hot everywhere -> breach; and a min_count too high -> unjudged ok
+    clock3 = FakeClock()
+    h3 = MetricsHistory(lambda: [], interval=1.0, capacity=2048,
+                        clock=clock3)
+    pairs, e, r = [], 0, 0
+    for _ in range(120):
+        e += 50
+        r += 100
+        pairs.append((e, r))
+    _feed(h3, clock3, snaps(pairs))
+    assert h3.burn([spec], fast=60, slow=600)["burn"] == "breach"
+    picky = SloSpec("error_rate", "serving_err", 0.1, agg="rate",
+                    per="serving_req", min_count=10 ** 9)
+    assert h3.burn([picky], fast=60, slow=600)["burn"] == "ok"
+
+
+def test_burn_min_bound_floor():
+    clock = FakeClock()
+    h = MetricsHistory(lambda: [], interval=1.0, capacity=256,
+                       clock=clock)
+    spec = SloSpec("acceptance", "serving_acc", 4.0, agg="value",
+                   bound="min", min_count=1)
+    _feed(h, clock, [[_gauge(2.0, name="serving_acc")]
+                     for _ in range(120)])
+    v = h.burn([spec], fast=60, slow=600)
+    row = v["specs"][0]
+    # measured 2.0 against a >= 4.0 floor burns at 2x in the fast
+    # window; the slow window (only ~120s of data, all hot) burns too
+    assert row["fast_burn"] == pytest.approx(2.0)
+    assert v["burn"] in ("breach", "spiking")
+
+
+# ------------------------------------------------------ CompileLedger units
+
+
+def test_compile_ledger_triggers_rewarm_and_storms():
+    reg = MetricsRegistry()
+    rec = FlightRecorder(capacity=64)
+    led = CompileLedger(registry=reg, recorder=rec,
+                        inflight_fn=lambda: 3)
+    led.record_mint("step[plain]", 0.5, signature=("s1",),
+                    warming=True)
+    assert led.warmup_mints == 1 and led.storms == 0
+    led.mark_warmed()
+    # a rebuilt generation recompiling a KNOWN program = rewarm
+    led.record_mint("step[plain]", 0.4, signature=("s1",))
+    assert led.rewarms == 1 and led.storms == 0
+    # a NEVER-seen program on the serving path post-warmup = storm
+    led.record_mint("admit[64]", 0.2, signature=("s2",))
+    assert led.storms == 1
+    snap = led.snapshot()
+    assert snap["total"] == 3 and snap["warmed"] is True
+    assert snap["seconds"] == pytest.approx(1.1)
+    assert snap["recent"][-1]["storm"] is True
+    assert snap["recent"][-1]["inflight"] == 3
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert kinds.count("xla.compile") == 3
+    assert kinds.count("xla.compile.storm") == 1
+    by_name = {s["name"]: s for s in reg.snapshot()}
+    assert by_name["serving_compiles"]["value"] == 3
+    assert by_name["serving_compile_seconds"]["value"] == (
+        pytest.approx(1.1)
+    )
+    assert by_name["serving_compile_storms"]["value"] == 1
+    # pre-warmup serving mints are recorded but never storms
+    led2 = CompileLedger()
+    led2.record_mint("x", 0.1, signature=())
+    assert led2.serving_mints == 1 and led2.storms == 0
+    assert led2.tail(1)[0]["trigger"] == "serving"
+
+
+# ------------------------------------------------- satellite: prometheus
+
+
+def test_render_prometheus_help_and_type_headers():
+    reg = MetricsRegistry()
+    reg.counter("serving_widgets", help="widgets made")
+    reg.histogram("serving_lat_seconds", help="latency").observe(0.01)
+    reg.gauge("serving_depth")  # no help: TYPE only, no HELP line
+    text = render_prometheus(reg.snapshot())
+    lines = text.splitlines()
+    assert "# HELP serving_widgets_total widgets made" in lines
+    assert "# TYPE serving_widgets_total counter" in lines
+    assert "# HELP serving_lat_seconds latency" in lines
+    assert "# TYPE serving_lat_seconds histogram" in lines
+    assert "# TYPE serving_depth gauge" in lines
+    assert not any("# HELP serving_depth" in ln for ln in lines)
+    # HELP precedes TYPE within a family (the format's ordering rule)
+    hi = lines.index("# HELP serving_widgets_total widgets made")
+    ti = lines.index("# TYPE serving_widgets_total counter")
+    assert hi == ti - 1
+    # cumulative buckets for the histogram family, and the parser
+    # (comment-skipping) still reads every series
+    series = {n for n, _, _ in parse_prometheus(text)}
+    assert "serving_lat_seconds_bucket" in series
+    assert "serving_widgets_total" in series
+
+
+# --------------------------------------- satellite: trace ring + dkt_top
+
+
+def test_trace_collector_on_drop_fires_once():
+    fired = []
+    col = TraceCollector(capacity=2, on_drop=lambda: fired.append(1))
+    col.record({"trace_id": "a"})
+    col.record({"trace_id": "b"})
+    assert fired == []
+    col.record({"trace_id": "c"})  # first drop
+    col.record({"trace_id": "d"})  # second drop: no re-fire
+    assert fired == [1]
+    assert col.dropped_total == 2
+    with pytest.raises(ValueError):
+        TraceCollector(capacity=0)
+
+
+def test_dkt_top_sparkline_and_trend_columns_socketless():
+    import dkt_top
+
+    assert dkt_top._sparkline([0, 1, 2, 3]) == "▁▃▆█"
+    assert dkt_top._sparkline([1, None, 2]) == "▁ █"
+    assert dkt_top._sparkline([]) == ""
+    assert dkt_top._trend_arrow(1.0) == "↑"
+    assert dkt_top._trend_arrow(-1.0) == "↓"
+    assert dkt_top._trend_arrow(0.0) == "→"
+    samples = [
+        {"name": "serving_scheduler_completed", "kind": "counter",
+         "labels": {}, "value": 12},
+    ]
+    ts_reply = {"series": [
+        {"name": "serving_scheduler_completed", "kind": "counter",
+         "labels": {}, "rate": 2.5, "trend": 0.3,
+         "points": [1, 2, 3, 4]},
+    ]}
+    out = dkt_top.format_table(
+        samples, series=dkt_top.series_index(ts_reply)
+    )
+    assert "▁▃▆█" in out and "↑" in out and "2.5/s" in out
+    # without a series index the table renders exactly as before
+    plain = dkt_top.format_table(samples)
+    assert "▁" not in plain
+
+
+# --------------------------------------------------------- e2e acceptance
+
+
+@pytest.fixture(scope="module")
+def lm_model():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def served_ts(lm_model):
+    """Engine with SLOs + a tight history cadence behind a TCP server
+    — the timeseries/burn/storm acceptance surface. The storm test
+    deliberately mints post-warmup, so it runs LAST in this module
+    (the fixture's ledger is shared)."""
+    from distkeras_tpu.obs import default_serving_slos
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+
+    eng = ServingEngine(
+        lm_model, num_slots=2, prefill_chunk=16,
+        history_interval=0.05,
+        slos=default_serving_slos(latency_p99_s=600.0, error_rate=0.5,
+                                  min_count=1),
+        slo_interval=0.05,
+    )
+    srv = ServingServer(eng).start()
+    cli = ServingClient("127.0.0.1", srv.port)
+    for _ in range(2):  # warm the short-prompt buckets + the step
+        cli.generate(np.arange(1, 6, dtype=np.int32), 4)
+    yield eng, srv, cli
+    cli.close()
+    srv.shutdown()
+
+
+def test_timeseries_verb_engine_windowed_series(served_ts):
+    eng, _, cli = served_ts
+    time.sleep(0.3)  # a few history ticks past the warm traffic
+    reply = cli.timeseries(window=60, points=12)
+    assert reply["ok"] is True and reply["snapshots"] >= 2
+    rows = {
+        (r["name"], tuple(sorted(r["labels"].items()))): r
+        for r in reply["series"]
+    }
+    comp = rows[("serving_scheduler_completed", ())]
+    assert comp["kind"] == "counter"
+    assert comp["increase"] >= 1  # the warm generates completed
+    assert comp["rate"] is not None and comp["rate"] > 0
+    assert len(comp["points"]) == 12
+    lat = rows[("serving_request_total_seconds", ())]
+    assert lat["kind"] == "histogram"
+    assert lat["count"] >= 1 and lat["p99"] is not None
+    gauge_rows = [r for r in reply["series"] if r["kind"] == "gauge"]
+    assert gauge_rows and all("trend" in r for r in gauge_rows)
+    # the names filter
+    only = cli.timeseries(
+        window=60, names=["serving_scheduler_completed"]
+    )
+    assert {r["name"] for r in only["series"]} == {
+        "serving_scheduler_completed"
+    }
+
+
+def test_burn_verdict_rides_health_next_to_slo(served_ts):
+    _, _, cli = served_ts
+    h = cli.health()
+    assert h["slo"] in ("ok", "warn", "breach")  # the PR 8 block
+    assert h["burn"] in ("ok", "spiking", "burning", "breach")
+    assert isinstance(h["burn_violations"], list)
+    # quiet warm traffic far inside the loose bounds: nothing burns
+    assert h["burn"] == "ok" and h["burn_violations"] == []
+    # and the verb carries the full per-spec detail
+    reply = cli.timeseries(window=60)
+    burn = reply["burn"]
+    assert burn is not None and {"burn", "windows", "specs"} <= set(
+        burn
+    )
+    assert burn["windows"] == {"fast": 60.0, "slow": 600.0}
+
+
+def test_history_disabled_engine_refuses_timeseries(lm_model):
+    from distkeras_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm_model, num_slots=2, history=False)
+    assert eng.history is None
+    with pytest.raises(ValueError, match="history"):
+        eng.timeseries()
+    eng.stop()
+
+
+def test_trace_ring_knob_and_trace_drops_event(lm_model):
+    from distkeras_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm_model, num_slots=2, trace_ring=3)
+    assert eng.trace_collector.capacity == 3
+    for i in range(5):
+        eng.trace_collector.record({"trace_id": f"t{i}"})
+    kinds = [e for e in eng.recorder.snapshot()
+             if e["kind"] == "trace.drops"]
+    assert len(kinds) == 1  # the 0 -> nonzero transition, once
+    assert kinds[0]["capacity"] == 3
+    eng.stop()
+
+
+def test_router_timeseries_aggregates_endpoint_labeled(lm_model):
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+    from distkeras_tpu.serving.fleet import FleetRouter
+
+    eng = ServingEngine(lm_model, num_slots=2, history_interval=0.05)
+    srv = ServingServer(eng).start()
+    router = FleetRouter(
+        endpoints=[(srv.host, srv.port)], health_interval=0.05,
+    ).start()
+    cli = ServingClient("127.0.0.1", router.port)
+    try:
+        cli.generate(np.arange(1, 6, dtype=np.int32), 3)
+        time.sleep(0.3)  # both histories tick
+        reply = cli.timeseries(window=60)
+        assert reply["ok"] is True
+        assert reply["unreachable"] == []
+        reps = {
+            (r.get("labels") or {}).get("replica")
+            for r in reply["series"]
+        }
+        # the router's own windowed book AND the replica's, labeled
+        assert "router" in reps
+        assert f"{srv.host}:{srv.port}" in reps
+        router_rows = {
+            r["name"] for r in reply["series"]
+            if r["labels"].get("replica") == "router"
+        }
+        assert "fleet_router_forwards" in router_rows
+        replica_rows = {
+            r["name"] for r in reply["series"]
+            if r["labels"].get("replica") == f"{srv.host}:{srv.port}"
+        }
+        assert "serving_scheduler_completed" in replica_rows
+    finally:
+        cli.close()
+        router.shutdown()
+        srv.shutdown()
+
+
+def test_router_timeseries_history_off_replica_is_not_a_hole(lm_model):
+    """A HEALTHY replica built with ``history=False`` refuses the
+    verb typed (bad_request) — the fleet scrape must name it under
+    ``no_history``, NOT ``unreachable``, and must not churn the
+    shared health client (the typed refusal is a clean reply; only a
+    transport failure desyncs the connection)."""
+    from distkeras_tpu.serving import (
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+    from distkeras_tpu.serving.fleet import FleetRouter
+
+    eng = ServingEngine(lm_model, num_slots=2, history=False)
+    srv = ServingServer(eng).start()
+    router = FleetRouter(
+        endpoints=[(srv.host, srv.port)], health_interval=0.05,
+    ).start()
+    cli = ServingClient("127.0.0.1", router.port)
+    try:
+        cli.generate(np.arange(1, 6, dtype=np.int32), 3)
+        label = f"{srv.host}:{srv.port}"
+        for _ in range(2):  # repeat: the client must survive reuse
+            reply = cli.timeseries(window=60)
+            assert reply["ok"] is True
+            assert reply["unreachable"] == []
+            assert reply["no_history"] == [label]
+            reps = {
+                (r.get("labels") or {}).get("replica")
+                for r in reply["series"]
+            }
+            assert reps == {"router"}  # only the router's own rows
+        # the replica itself still refuses typed, directly
+        direct = ServingClient(srv.host, srv.port, retry=False)
+        try:
+            with pytest.raises(Exception, match="history"):
+                direct.timeseries()
+        finally:
+            direct.close()
+    finally:
+        cli.close()
+        router.shutdown()
+        srv.shutdown()
+
+
+@pytest.mark.chaos
+def test_supervisor_restart_rewarm_is_not_a_storm(lm_model):
+    """REGRESSION PIN on the supervisor's warmup path: a watchdog
+    restart rebuilds the stepper and recompiles — those mints must be
+    ``trigger="warmup"`` (inside ``stepper.warmup()``) or rewarm
+    (known program, serving path) and NEVER a storm, while the
+    counters keep accumulating across the generation bump (the
+    history layer's reset-awareness is for the scheduler counters,
+    not the ledger)."""
+    from distkeras_tpu.faults import FaultPlan
+    from distkeras_tpu.serving import ServingEngine
+
+    prompt = np.arange(1, 6, dtype=np.int32)
+    eng = ServingEngine(
+        lm_model, num_slots=2, prefix_cache=False,
+        watchdog_interval=0.3, watchdog_grace=30.0,
+        max_restarts=3, restart_backoff=0.01,
+    ).start()
+    try:
+        eng.generate(prompt, 4)  # warm the live-path programs
+        eng._stepper.warm_restore_buckets()
+        eng.compile_ledger.mark_warmed()
+        total0 = eng.compile_ledger.total
+        plan = FaultPlan().arm(
+            "scheduler.loop", times=1, when=lambda ctx: ctx["busy"]
+        )
+        with plan:
+            req = eng.submit(prompt, 12)
+            with pytest.raises(Exception):
+                req.result(timeout=10)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                h = eng.health()
+                if h["status"] == "serving" and h["restarts"] == 1:
+                    break
+                time.sleep(0.02)
+            assert eng.health()["restarts"] == 1
+        # post-restart traffic recompiles the live-path buckets —
+        # attributed (warmup or rewarm), never a storm
+        out = eng.generate(prompt, 4)
+        assert out.size > prompt.size
+        led = eng.compile_ledger.snapshot()
+        assert led["total"] > total0  # the restart DID mint
+        assert led["warmup"] >= 1  # supervisor warmup on tape
+        assert led["storms"] == 0, led
+    finally:
+        eng.stop()
+
+
+def test_post_warmup_compile_storm_trifecta(served_ts):
+    """THE acceptance pin: a deliberately-triggered post-warmup
+    compile (a never-seen prompt-length bucket) inside a traced
+    request yields all three signals — the ``xla.compile`` span in
+    the client-assembled timeline, the ``xla.compile.storm`` recorder
+    event, and the storm gauge. Runs last against the shared fixture
+    (it dirties the ledger by design)."""
+    eng, _, cli = served_ts
+    eng.compile_ledger.mark_warmed()
+    storms0 = eng.compile_ledger.storms
+    # 28 tokens -> a fresh admit/chunk bucket, never compiled above
+    prompt = (np.arange(28, dtype=np.int32) % 60) + 1
+    cli.generate(prompt, 3, trace=True)
+    tl = cli.last_trace
+    assert tl is not None
+    names = [s["name"] for s in tl["spans"]]
+    assert "xla.compile" in names, names
+    span = next(s for s in tl["spans"] if s["name"] == "xla.compile")
+    assert span["attrs"]["mints"] >= 1
+    assert span["attrs"]["keys"]
+    assert eng.compile_ledger.storms > storms0
+    storm_events = eng.recorder.events("xla.compile.storm")
+    assert storm_events, "storm never hit the flight tape"
+    assert {"key", "seconds", "inflight"} <= set(storm_events[-1])
+    by_name = {
+        s["name"]: s for s in eng.metrics_snapshot()
+    }
+    assert by_name["serving_compile_storms"]["value"] >= 1
+    assert by_name["serving_compiles"]["value"] >= 1
+    assert by_name["serving_compile_seconds"]["value"] > 0
+    # stats() carries the ledger block the soaks assert on
+    snap = eng.stats()["compiles"]
+    assert snap["storms"] >= 1 and snap["recent"]
+
+
+# ------------------------------------------------------ PS history (b"t")
+
+
+def test_training_ps_history_digest():
+    from distkeras_tpu.parameter_servers import ParameterServer
+
+    ps = ParameterServer({"w": np.zeros(3)})
+    ps.pull(worker_id=0)
+    ps.history.snap()
+    ps.commit({"w": np.ones(3)}, commit_id=(0, 0))
+    ps.pull(worker_id=0)
+    ps.history.snap()
+    d = ps.history.digest(window=600)
+    rows = {r["name"] for r in d["series"]}
+    assert "training_ps_pulls" in rows
+    assert "training_ps_commits" in rows
+    pulls = next(
+        r for r in d["series"] if r["name"] == "training_ps_pulls"
+    )
+    assert pulls["increase"] >= 1
+
+
+def test_training_ps_timeseries_wire_action():
+    """The ``b"t"`` action over a real socket: the action byte rides
+    with a knob frame (window/points honored — the `dkt_top --ps
+    --window` path), and an empty knob frame means defaults."""
+    from distkeras_tpu.parameter_servers import (
+        ParameterServer,
+        RemoteParameterServerClient,
+        SocketParameterServer,
+    )
+
+    ps = ParameterServer({"w": np.zeros(3)})
+    server = SocketParameterServer(ps, host="127.0.0.1")
+    server.start()
+    try:
+        client = RemoteParameterServerClient("127.0.0.1", server.port)
+        _, tag = client.pull()
+        ps.history.snap()
+        client.commit({"w": np.ones(3)}, tag=tag)
+        client.pull()
+        ps.history.snap()
+        reply = client.timeseries(window=600, points=7)
+        assert reply["role"] == "primary"
+        d = reply["timeseries"]
+        assert d["window"] == 600.0 and d["points"] == 7
+        rows = {r["name"] for r in d["series"]}
+        assert "training_ps_pulls" in rows
+        # defaults path: no knobs -> the digest defaults (60 s window)
+        d2 = client.timeseries()["timeseries"]
+        assert d2["window"] == 60.0
+        # the wire hop did not desync: a pull still works after
+        client.pull()
+        client.close()
+    finally:
+        server.stop()

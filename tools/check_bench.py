@@ -104,6 +104,11 @@ DISAGG_RATIO_KEYS = (
     "disagg.scenarios.interactive.tokens_per_sec_ratio",
     "disagg.scenarios.short_uniform_overhead.tokens_per_sec_ratio",
 )
+#: the metrics-history A/B is the recorder row's sibling — same
+#: direct-drive protocol, same collapse-only band
+OBS_RATIO_KEYS = (
+    "obs.history_vs_off",
+)
 
 #: floors the COMMITTED artifact must clear — the claims PERF.md
 #: quotes; regenerating the artifact with a worse number fails here
@@ -160,6 +165,11 @@ COMMITTED_FLOORS = {
     # hop's pure-overhead cost honestly, no floor on honesty rows)
     "disagg": {
         "disagg.scenarios.interactive.inter_token_p99_ratio": 1.3,
+    },
+    # the metrics-history ring costs < 2% tokens/sec (the PR 8
+    # recorder budget applied to the time-series layer)
+    "obs": {
+        "obs.history_vs_off": 0.98,
     },
 }
 
@@ -466,11 +476,82 @@ def compare_disagg(fresh: dict, committed: dict) -> list[str]:
     return violations
 
 
+def compare_obs(fresh: dict, committed: dict) -> list[str]:
+    """Violations of the observability gate (empty list = pass). The
+    invariants: the obs block exists, outputs stayed token-identical
+    on both history sides, the ``timeseries`` digest + burn verdict
+    actually computed over the measured traffic, and — the standing
+    gate the r14 ("0.17x from mid-pass XLA compiles") and r16
+    ("~240 ms compile stall inside interactive p99") bench
+    post-mortems bought — TIMED PASSES CONTAIN NO COMPILES: any block
+    carrying ``timed_pass_compiles`` must have measured zero, fresh
+    and committed alike."""
+    violations: list[str] = []
+    for rec, tag in ((fresh, "fresh"), (committed, "committed")):
+        ob = rec.get("obs")
+        if ob is None:
+            violations.append(f"{tag}: missing obs block")
+            continue
+        if ob.get("outputs_identical") is not True:
+            violations.append(f"{tag} obs: outputs not identical")
+        ts = ob.get("timeseries") or {}
+        if not ts.get("snapshots", 0) >= 2:
+            violations.append(
+                f"{tag} obs: history ring held "
+                f"{ts.get('snapshots')} snapshots — no window to "
+                "digest"
+            )
+        if ts.get("completed_rate_positive") is not True:
+            violations.append(
+                f"{tag} obs: windowed completion rate not measured"
+            )
+        if ts.get("burn_verdict") is None:
+            violations.append(
+                f"{tag} obs: burn-rate verdict never computed"
+            )
+        # the no-compiles invariant, applied to EVERY block that
+        # records it (today the obs block; any future block that
+        # stamps timed_pass_compiles joins the gate for free)
+        for path, n in _timed_compile_fields(rec):
+            if n != 0:
+                violations.append(
+                    f"{tag} {path}: {n} XLA mints landed inside "
+                    "committed timed passes"
+                )
+        if ob.get("compile_storms", 0) != 0:
+            violations.append(
+                f"{tag} obs: {ob['compile_storms']} compile storms "
+                "during the bench"
+            )
+    _band_check(
+        fresh, committed, OBS_RATIO_KEYS, SERVING_RATIO_BAND,
+        violations,
+    )
+    _committed_floors(committed, "obs", violations)
+    return violations
+
+
+def _timed_compile_fields(rec, prefix=""):
+    """Every ``timed_pass_compiles`` field anywhere in the artifact,
+    as ``(dotted_path, value)`` pairs."""
+    out = []
+    if not isinstance(rec, dict):
+        return out
+    for k, v in rec.items():
+        path = f"{prefix}.{k}" if prefix else k
+        if k == "timed_pass_compiles":
+            out.append((path, v))
+        elif isinstance(v, dict):
+            out.extend(_timed_compile_fields(v, path))
+    return out
+
+
 COMPARATORS = {
     "serving": compare_serving,
     "fleet": compare_fleet,
     "decode": compare_decode,
     "disagg": compare_disagg,
+    "obs": compare_obs,
 }
 ARTIFACTS = {
     "serving": "BENCH_SERVING.json",
@@ -478,6 +559,8 @@ ARTIFACTS = {
     "decode": "BENCH_DECODE.json",
     # the disagg block lives inside the serving artifact
     "disagg": "BENCH_SERVING.json",
+    # so does the obs (metrics-history + compile-invariant) block
+    "obs": "BENCH_SERVING.json",
 }
 
 
@@ -495,6 +578,8 @@ def run_smoke(kind: str, workdir: str) -> dict:
                    "--cpu"],
         # the disagg block rides the full serving smoke artifact
         "disagg": ["bench_serving.py", "--smoke"],
+        # so does the obs block
+        "obs": ["bench_serving.py", "--smoke"],
     }[kind]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -509,7 +594,8 @@ def run_smoke(kind: str, workdir: str) -> dict:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--kind",
-                    choices=("serving", "fleet", "decode", "disagg"),
+                    choices=("serving", "fleet", "decode", "disagg",
+                             "obs"),
                     required=True)
     ap.add_argument("--fresh", help="fresh --smoke artifact to grade")
     ap.add_argument("--committed",
@@ -547,6 +633,7 @@ def main(argv=None) -> int:
         "fleet": FLEET_RATIO_KEYS,
         "decode": DECODE_RATIO_KEYS,
         "disagg": DISAGG_RATIO_KEYS,
+        "obs": OBS_RATIO_KEYS,
     }[args.kind])
     print(f"bench gate ok ({args.kind}): "
           f"{nbands} ratio bands + invariants hold")

@@ -6,7 +6,10 @@ Point it at a ``ServingServer`` (one engine's book) or a
 the typed-metrics registry snapshot every ``--interval`` seconds,
 rendering counters, gauges, and latency-histogram quantiles grouped by
 replica — the "where is the fleet spending its time" answer without
-grepping four logs::
+grepping four logs. When the target serves the ``timeseries`` verb
+(metrics history on, the default), every row also gets a sparkline of
+its last ``--window`` seconds plus a trend arrow and windowed
+per-second rate — "is it getting worse" at a glance::
 
     python tools/dkt_top.py 127.0.0.1 9000
     python tools/dkt_top.py 127.0.0.1 9000 --once        # one snapshot
@@ -41,6 +44,50 @@ def _fmt_value(v) -> str:
     return f"{v:,}"
 
 
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(points) -> str:
+    """Unicode sparkline over a resampled ``points`` list (the
+    ``timeseries`` verb's fixed-length buckets; None = no data in the
+    bucket, rendered as a gap)."""
+    vals = [p for p in points or [] if p is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    out = []
+    for p in points:
+        if p is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(_BLOCKS[0])
+        else:
+            out.append(_BLOCKS[min(7, int((p - lo) / span * 7.999))])
+    return "".join(out)
+
+
+def _trend_arrow(t) -> str:
+    if t is None:
+        return " "
+    if t > 1e-9:
+        return "↑"
+    if t < -1e-9:
+        return "↓"
+    return "→"
+
+
+def series_index(ts_reply) -> dict:
+    """Index a ``timeseries`` verb reply for the table renderer:
+    ``(replica, name, sorted-label-items) -> series row``."""
+    idx = {}
+    for row in (ts_reply or {}).get("series") or []:
+        labels = dict(row.get("labels") or {})
+        rep = labels.pop("replica", "") or "(local)"
+        idx[(rep, row["name"], tuple(sorted(labels.items())))] = row
+    return idx
+
+
 def _hist_line(s) -> str:
     """count / mean / p50 / p99 out of the cumulative bucket samples
     (bucket-resolution quantiles: the upper bound of the bucket that
@@ -64,10 +111,14 @@ def _hist_line(s) -> str:
     )
 
 
-def format_table(samples, width: int = 78) -> str:
+def format_table(samples, width: int = 78, series: dict | None = None
+                 ) -> str:
     """Render one registry snapshot (the ``metrics`` verb payload) as
     a replica-grouped table. Pure function of the samples — the unit
-    tests drive it without a socket."""
+    tests drive it without a socket. ``series``: an optional
+    :func:`series_index` over a ``timeseries`` reply — each metric row
+    then grows a sparkline + trend-arrow column (windowed per-second
+    rates for counters/histograms, windowed values for gauges)."""
     groups: dict[str, list] = {}
     for s in samples:
         labels = dict(s.get("labels") or {})
@@ -103,16 +154,32 @@ def format_table(samples, width: int = 78) -> str:
             groups[replica], key=lambda p: p[0]["name"]
         ):
             name = s["name"]
+            lkey = tuple(sorted(labels.items()))
             if labels:
                 name += "{" + ",".join(
                     f"{k}={v}" for k, v in sorted(labels.items())
                 ) + "}"
+            spark = ""
+            if series is not None:
+                ts = series.get((replica, s["name"], lkey))
+                if ts is not None:
+                    sl = _sparkline(ts.get("points"))
+                    if sl:
+                        rate = ts.get("rate")
+                        tail = (
+                            f" {rate:,.3g}/s"
+                            if rate is not None else ""
+                        )
+                        spark = (
+                            f"  {sl} {_trend_arrow(ts.get('trend'))}"
+                            f"{tail}"
+                        )
             if s["kind"] == "histogram":
-                rows.append((name, "H", _hist_line(s)))
+                rows.append((name, "H", _hist_line(s) + spark))
             else:
                 rows.append(
                     (name, "C" if s["kind"] == "counter" else "G",
-                     _fmt_value(s["value"]))
+                     _fmt_value(s["value"]) + spark)
                 )
         namew = max((len(n) for n, _, _ in rows), default=0)
         for name, kind, val in rows:
@@ -137,8 +204,18 @@ def _ps_loop(args) -> int:
             if args.prometheus:
                 out = render_prometheus(m["metrics"])
             else:
+                series = None
+                if not args.no_series:
+                    try:
+                        series = series_index(
+                            cli.timeseries(
+                                window=args.window
+                            ).get("timeseries")
+                        )
+                    except Exception:  # noqa: BLE001 — older PS
+                        series = None
                 out = format_table(
-                    [dict(s) for s in m["metrics"]]
+                    [dict(s) for s in m["metrics"]], series=series
                 )
             if args.once:
                 print(f"== {label}")
@@ -172,6 +249,13 @@ def main(argv=None) -> int:
     ap.add_argument("--ps", action="store_true",
                     help="the target is a parameter server (PS wire "
                          "protocol), not a serving server/router")
+    ap.add_argument("--window", type=float, default=60.0,
+                    help="timeseries window (seconds) behind the "
+                         "sparkline/trend columns")
+    ap.add_argument("--no-series", action="store_true",
+                    help="skip the timeseries scrape (plain "
+                         "point-in-time table; also the fallback when "
+                         "the target serves no history)")
     args = ap.parse_args(argv)
 
     if args.ps:
@@ -184,7 +268,19 @@ def main(argv=None) -> int:
             if args.prometheus:
                 out = cli.metrics(prometheus=True)
             else:
-                out = format_table(cli.metrics())
+                samples = cli.metrics()
+                series = None
+                if not args.no_series:
+                    try:
+                        # best-effort: a history=False engine (or a
+                        # pre-timeseries server) refuses the verb —
+                        # render the plain table rather than fail
+                        series = series_index(
+                            cli.timeseries(window=args.window)
+                        )
+                    except Exception:  # noqa: BLE001
+                        series = None
+                out = format_table(samples, series=series)
             for gap in cli.last_metrics_unreachable:
                 # a fleet scrape that skipped a replica is NOT complete
                 # — show the hole, never a silently shrunken fleet

@@ -83,6 +83,18 @@ def replica_main(args) -> int:
     # first routed request is not a multi-second XLA compile
     for n in (3, 5, 9, 13):
         engine.generate(np.arange(1, n + 1, dtype=np.int32), 6)
+    # the chunk/admit buckets the serial warm above CANNOT cover (a
+    # chunk's bucket depends on how the budget splits across
+    # concurrent admissions) and the prefix-restore buckets (a
+    # repeated prompt's store hit mints the restore program —
+    # timing-dependent, exactly the class the compile ledger exists
+    # to flag), then arm storm detection: from here any serving-path
+    # mint of a NEW program is a storm, and the parent asserts zero
+    # across the fleet
+    engine._stepper.warmup()
+    engine._stepper.warm_prefill_buckets()
+    engine._stepper.warm_restore_buckets()
+    engine.compile_ledger.mark_warmed()
     plan = FaultPlan(seed=args.seed).arm(
         "stepper.step", times=None, probability=1.0 / args.fault_every
     )
@@ -379,6 +391,33 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
             s: plan.fired(s)
             for s in ("router.dispatch", "router.health", "net.send")
         }
+        # the fleet-wide compile ledger: every LIVE replica's mint
+        # summary (survivors + rollover replacements; the kill -9
+        # victim's book died with it), asserted storm-free below —
+        # replicas warm + mark_warmed before READY, so a storm means
+        # a program family the warm missed minted on the serving path
+        from distkeras_tpu.serving import ServingClient
+
+        summary["compiles"] = {}
+        for rep in spawned:
+            if not rep.alive():
+                continue
+            ep = f"{rep.endpoint[0]}:{rep.endpoint[1]}"
+            try:
+                with ServingClient(
+                    rep.endpoint[0], rep.endpoint[1], timeout=15,
+                    retry=False,
+                ) as c:
+                    summary["compiles"][ep] = c.stats()["compiles"]
+            except Exception as e:  # noqa: BLE001 — post-run scrape
+                summary["compiles"][ep] = {"error": repr(e)}
+        summary["compile_storms"] = sum(
+            c.get("storms", 0)
+            for c in summary["compiles"].values()
+        )
+        summary["compiles_scraped"] = sum(
+            "storms" in c for c in summary["compiles"].values()
+        )
     finally:
         stop_evt.set()
         ejections_final = (
@@ -443,6 +482,11 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         and summary["postmortems"] == summary["ejections"]
         and summary["postmortems_well_formed"] == summary["postmortems"]
         and summary["postmortem_names_victim"]
+        # zero post-warmup serving-path mints anywhere in the fleet
+        # (replicas warm + arm before READY; restarts/rollovers
+        # re-warm, so they must not trip it)
+        and summary.get("compiles_scraped", 0) >= 1
+        and summary.get("compile_storms", 0) == 0
     )
     return summary
 

@@ -182,6 +182,25 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         engine.generate(p, max_new, sampling=sp)
         for p, sp in sampled_reqs
     ]
+    # the compile-warmup boundary: every program family live traffic
+    # can key on is compiled by here — the prefill/chunk buckets
+    # (which depend on how the scheduler's budget SPLITS across
+    # concurrent admissions, so the fault-free drives above cannot
+    # cover them) and QoS preemption's timing-dependent swap-restore
+    # buckets (the r16 stall class). From this line a serving-path
+    # mint of a NEW program is a compile STORM (the xla.compile.storm
+    # event + gauge) and fails the soak. Chaos restarts re-warm
+    # through the supervisor (trigger=warmup) and re-mint known
+    # programs (rewarm) — neither trips it.
+    engine._stepper.warmup()  # unmasked step buckets + verify
+    engine._stepper.warm_prefill_buckets()
+    engine._stepper.warm_restore_buckets()
+    # the soak serves grammar-constrained AND speculative traffic
+    # under churning occupancy, so the masked step/verify variants
+    # must cover every pow2 table bucket too (which variant an
+    # iteration needs tracks the longest occupied table)
+    engine._stepper.warm_constrained_buckets()
+    engine.compile_ledger.mark_warmed()
 
     def matches_canon(si, out):
         want = canon[si]
@@ -308,6 +327,21 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
                             summary["sampled_completed"] += 1
                         else:
                             summary["divergent_replays"] += 1
+                            if len(summary.setdefault(
+                                "divergent_samples", []
+                            )) < 5:
+                                want = canon[si]
+                                summary["divergent_samples"].append({
+                                    "si": si,
+                                    "got": np.asarray(out).tolist()
+                                    if not isinstance(out, list)
+                                    else [np.asarray(o).tolist()
+                                          for o in out],
+                                    "want": np.asarray(want).tolist()
+                                    if not isinstance(want, list)
+                                    else [np.asarray(w).tolist()
+                                          for w in want],
+                                })
                         if sampled_reqs[si][1].grammar is not None:
                             gen = np.asarray(out)[prompt.size:]
                             if not set(gen.tolist()) <= allowed_toks:
@@ -421,8 +455,14 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     summary["postmortems"] = len(bundles)
     summary["postmortems_naming_seam"] = named_seam
     shutil.rmtree(postmortem_dir, ignore_errors=True)
+    # the compile ledger: warmup covered every program family, chaos
+    # restarts re-warmed through the supervisor, so ZERO storms — a
+    # mint of a new program on the serving path mid-soak means warmup
+    # has a hole or a compile key regressed to traffic-dependent
+    summary["compiles"] = engine.compile_ledger.snapshot()
     summary["ok"] = (
         hung == 0
+        and summary["compiles"]["storms"] == 0
         and summary["untyped_errors"] == 0
         and summary["corrupt_outputs"] == 0
         and summary["divergent_replays"] == 0
@@ -733,6 +773,18 @@ def run_disagg_soak(clients=4, duration=6.0, seed=0, model=None,
         rstats["transfer_sends"]
         == rstats["transfer_ok"] + rstats["transfer_typed"]
     )
+    # the compile ledgers of the FINAL workers (post-kill
+    # replacements), on the summary for triage. Reported, not gated:
+    # replacements warm BEST-EFFORT under the armed chaos plan (an
+    # injected failure can cut the live warm short by design), so a
+    # post-warmup mint here is expected churn, not the storm class
+    # the main soak's fault-free-warmed engine asserts on.
+    summary["compiles"] = {
+        role: eng.compile_ledger.snapshot()
+        for role, eng in (
+            ("prefill", pre_srv.engine), ("decode", dec_srv.engine),
+        )
+    }
     router.shutdown()
     for srv in (pre_srv, dec_srv):
         try:
