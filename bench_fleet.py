@@ -37,22 +37,41 @@ is the claimed win. Interleaved timed passes (single, affinity,
 random, repeat) keep machine-speed drift fair; every output on every
 side is asserted token-identical to its solo decode.
 
+The AUTOSCALE section (full runs, or ``--autoscale-only``) is a
+load-ramp A/B: the same seeded ``loadgen`` ramp trace drives a STATIC
+single-replica fleet and an AUTOSCALED fleet (starts at 1, policy may
+grow to 2; scale-ups pre-warmed before joining rotation), interleaved,
+outputs identity-pinned on both sides. It commits per-phase p99 under
+the ramp, the replicas-provisioned-over-time curve, and the
+zero-compile-storms-on-join invariant to the ``autoscale`` block of
+BENCH_FLEET.json. Same single-core honesty: the added replica buys
+slots and queue capacity on a shared core, not compute — the gated
+claims are the scale event itself, storm-free joins, and identity,
+with only a loose band on the p99 ratio.
+
 Writes BENCH_FLEET.json and prints one JSON line.
 
 Usage: python bench_fleet.py [--cpu] [--smoke] [--slots 4]
                              [--requests 24] [--repeats 3]
+                             [--autoscale-only]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import threading
 import time
 
 import numpy as np
 
 from bench import setup_backend
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+)
 
 
 def _make_prefix_heavy(n, seq, vocab, rng, headers):
@@ -78,7 +97,22 @@ def _make_zero_reuse(n, seq, vocab, rng):
     return reqs
 
 
-def _drive_tcp(endpoint, reqs, arrivals, timeout=600.0):
+def _make_ramp_reqs(n, seq, vocab, rng):
+    """Decode-heavy random requests for the autoscale ramp: short
+    prompts, LONG decodes — per-request service time is what lets the
+    climbing arrival rate genuinely outrun one replica's service
+    rate, so the queue pressure the policy keys on is real."""
+    reqs = []
+    for _ in range(n):
+        plen = int(rng.integers(4, 17))
+        steps = min(seq - plen,
+                    int(rng.integers(seq * 3 // 4, seq * 7 // 8)))
+        reqs.append((rng.integers(0, vocab, plen).astype(np.int32),
+                     steps))
+    return reqs
+
+
+def _drive_tcp(endpoint, reqs, arrivals, timeout=600.0, retry=True):
     """Fire ``reqs`` at ``endpoint`` over TCP on the arrival schedule,
     one client connection per request (concurrent, like real traffic).
     Returns (wall_seconds, tokens, results, per-request latency ms,
@@ -100,7 +134,7 @@ def _drive_tcp(endpoint, reqs, arrivals, timeout=600.0):
         try:
             ts = time.perf_counter()
             with ServingClient(
-                endpoint[0], endpoint[1], timeout=timeout
+                endpoint[0], endpoint[1], timeout=timeout, retry=retry
             ) as c:
                 results[i] = c.generate(prompt, steps)
                 served[i] = c.last_served_by
@@ -324,6 +358,181 @@ def _measure_workload(model, reqs, refs, prime, *, slots, chunk,
     }
 
 
+def _phase_stats(lat_ms, arr, phases):
+    """Per-phase p99 latency (ms) of one timed pass: the arrival span
+    split into ``phases`` equal windows, each request binned by its
+    ARRIVAL time — so the last phase is the ramp's peak and its p99 is
+    the p99-under-ramp headline."""
+    arr = np.asarray(arr, float)
+    span = max(float(arr[-1]), 1e-9)
+    edges = np.linspace(0.0, span, phases + 1)
+    out = []
+    for i in range(phases):
+        last = i == phases - 1
+        hi = edges[i + 1]
+        mask = (arr >= edges[i]) & ((arr <= hi) if last else (arr < hi))
+        vals = [v for v, m in zip(lat_ms, mask) if m]
+        out.append(
+            round(float(np.percentile(vals, 99)), 2) if vals else None
+        )
+    return out
+
+
+def _measure_autoscale(model, reqs, refs, *, slots, chunk, arrivals,
+                       qcap=None, phases=3, repeats=1, max_replicas=2,
+                       interval=0.1):
+    """The ramp A/B: static 1-replica fleet vs an autoscaled fleet
+    (1 → up to ``max_replicas``) on the identical seeded ramp
+    schedule. Each repeat boots FRESH controllers so the growth
+    transient — the thing under measurement — replays from 1 replica
+    every time; sides alternate within a repeat (interleaved) so
+    machine drift hits both. Outputs on both sides are asserted
+    identical to the solo-decode ``refs`` every pass.
+
+    ``qcap`` (default: the trace size) admits the whole backlog — no
+    refusal/retry noise in the latencies, so p99-under-ramp is pure
+    queue wait, the thing a scale-up exists to relieve. The pressure
+    threshold is sized accordingly: ~5% of a trace-deep queue in
+    flight is already dozens of requests behind a 1-slot replica.
+    Initial replicas are pre-warmed bench-side (``replica.warm()``),
+    so timed passes start with every program compiled and the storm
+    detectors armed."""
+    from distkeras_tpu.serving import (
+        AutoscalePolicy,
+        Autoscaler,
+        FleetController,
+    )
+
+    qcap = len(reqs) if qcap is None else qcap
+    engine_kw = dict(
+        num_slots=slots, queue_capacity=qcap,
+        prefill_chunk=chunk, prefix_cache=True,
+    )
+    router_kw = dict(health_interval=0.1, request_timeout=600.0)
+    policy_kw = dict(
+        min_replicas=1, max_replicas=max_replicas,
+        up_threshold=0.05, down_threshold=0.01,
+        up_ticks=2, down_ticks=10**6,     # never shrink mid-bench
+        up_cooldown=1.0, down_cooldown=3600.0,
+    )
+    sides: dict = {
+        "static": {"lat": [], "p99": [], "tps": []},
+        "autoscaled": {"lat": [], "p99": [], "tps": [],
+                       "scaled_to": 1, "scale_ups": 0,
+                       "join_compile_storms": 0,
+                       "replicas_over_time": None},
+    }
+
+    def check_identity(results, side):
+        for i, (got, want) in enumerate(zip(results, refs)):
+            assert np.array_equal(got, want), (
+                f"autoscale {side} req {i}: output != solo decode"
+            )
+
+    for _ in range(repeats):
+        # -- static side ----------------------------------------------------
+        ctl = FleetController(
+            model, replicas=1, router_kw=dict(router_kw), **engine_kw
+        ).start()
+        try:
+            for r in ctl.replicas:
+                r.warm()
+            wall, toks, results, lat, _ = _drive_tcp(
+                ctl.endpoint, reqs, arrivals
+            )
+        finally:
+            ctl.stop()
+        check_identity(results, "static")
+        sides["static"]["lat"].append(lat)
+        sides["static"]["p99"].append(_phase_stats(lat, arrivals, phases))
+        sides["static"]["tps"].append(toks / wall)
+
+        # -- autoscaled side ------------------------------------------------
+        ctl = FleetController(
+            model, replicas=1, router_kw=dict(router_kw), **engine_kw
+        ).start()
+        scaler = Autoscaler(
+            ctl, AutoscalePolicy(**policy_kw), interval=interval
+        )
+        initial = {id(r) for r in ctl.replicas}
+        curve = []
+        stop = threading.Event()
+
+        def sample_replicas(curve=curve, ctl=ctl, stop=stop):
+            t0 = time.perf_counter()
+            while not stop.is_set():
+                pt = (round(time.perf_counter() - t0, 2),
+                      len(ctl.replicas))
+                if not curve or curve[-1][1] != pt[1]:
+                    curve.append(pt)
+                stop.wait(0.05)
+
+        try:
+            for r in ctl.replicas:
+                r.warm()
+            scaler.start()
+            th = threading.Thread(target=sample_replicas, daemon=True)
+            th.start()
+            wall, toks, results, lat, _ = _drive_tcp(
+                ctl.endpoint, reqs, arrivals
+            )
+            stop.set()
+            th.join(timeout=5.0)
+            scaler.shutdown()
+            joined = [r for r in ctl.replicas if id(r) not in initial]
+            # the invariant the gate pins: a replica that joined under
+            # live ramp traffic was pre-warmed before rotation, so its
+            # armed storm detector saw NO serving-path program mint
+            sides["autoscaled"]["join_compile_storms"] += sum(
+                r.engine.compile_ledger.snapshot()["storms"]
+                for r in joined
+            )
+            ups = (scaler._counters.get("scale_ups", 0)
+                   if scaler._counters is not None else 0)
+        finally:
+            scaler.shutdown()
+            ctl.stop()
+        check_identity(results, "autoscaled")
+        a = sides["autoscaled"]
+        a["lat"].append(lat)
+        a["p99"].append(_phase_stats(lat, arrivals, phases))
+        a["tps"].append(toks / wall)
+        a["scaled_to"] = max(a["scaled_to"],
+                             max(c for _, c in curve))
+        a["scale_ups"] += int(ups)
+        if a["replicas_over_time"] is None:
+            a["replicas_over_time"] = [list(pt) for pt in curve]
+
+    out = {}
+    for name, s in sides.items():
+        p99s = np.asarray(
+            [p[-1] for p in s["p99"] if p[-1] is not None], float
+        )
+        out[name] = {
+            "p99_under_ramp_ms": round(float(np.median(p99s)), 2),
+            "phase_p99_ms": s["p99"][0],
+            "latency_ms": _pct(s["lat"]),
+            "tokens_per_sec": round(float(np.median(s["tps"])), 1),
+        }
+    a = sides["autoscaled"]
+    out["autoscaled"].update({
+        "start_replicas": 1,
+        "max_replicas": max_replicas,
+        "scaled_to": a["scaled_to"],
+        "scale_ups": a["scale_ups"],
+        "join_compile_storms": a["join_compile_storms"],
+        "replicas_over_time": a["replicas_over_time"],
+    })
+    out["static"]["replicas"] = 1
+    out["p99_ratio_static_over_autoscaled"] = _ratio(
+        out["static"]["p99_under_ramp_ms"],
+        out["autoscaled"]["p99_under_ramp_ms"],
+    )
+    out["policy"] = policy_kw
+    out["outputs_identical"] = True
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
@@ -336,6 +545,10 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--gap-ms", type=float, default=None,
                     help="mean request inter-arrival gap (exponential)")
+    ap.add_argument("--autoscale-only", action="store_true",
+                    help="run only the ramp autoscale A/B (the "
+                         "--kind autoscale gate's smoke path); plain "
+                         "--smoke skips it, full runs include it")
     args = ap.parse_args()
 
     platform = setup_backend(cpu=args.cpu or args.smoke)
@@ -407,41 +620,105 @@ def main() -> None:
         ),
         "workloads": {},
     }
-    for name, (timed, prime) in workloads.items():
-        smax = max(s for _, s in timed)
-        ragged = ref_gen.generate([p for p, _ in timed], steps=smax)
-        refs = [
-            np.asarray(row)[: p.size + s]
-            for row, (p, s) in zip(list(ragged), timed)
-        ]
-        arrivals = np.cumsum(rng.exponential(gap_ms / 1e3, len(timed)))
-        wl = _measure_workload(
-            model, timed, refs, prime, slots=args.slots, chunk=chunk,
-            arrivals=arrivals, repeats=args.repeats, gap_s=gap_ms / 1e3,
-            capture_obs=(name == "prefix_heavy"),
+    if not args.autoscale_only:
+        for name, (timed, prime) in workloads.items():
+            smax = max(s for _, s in timed)
+            ragged = ref_gen.generate([p for p, _ in timed], steps=smax)
+            refs = [
+                np.asarray(row)[: p.size + s]
+                for row, (p, s) in zip(list(ragged), timed)
+            ]
+            arrivals = np.cumsum(
+                rng.exponential(gap_ms / 1e3, len(timed))
+            )
+            wl = _measure_workload(
+                model, timed, refs, prime, slots=args.slots,
+                chunk=chunk, arrivals=arrivals, repeats=args.repeats,
+                gap_s=gap_ms / 1e3,
+                capture_obs=(name == "prefix_heavy"),
+            )
+            obsv = wl.pop("_observability", None)
+            if obsv is not None:
+                record["observability"] = obsv
+            record["workloads"][name] = wl
+            print(json.dumps({name: {
+                "fleet_vs_single": wl["fleet_vs_single"],
+                "affinity_hit_rate": wl["affinity_hit_rate"],
+                "random_hit_rate": wl["random_hit_rate"],
+            }}), flush=True)
+
+    if args.autoscale_only or not args.smoke:
+        # the ramp autoscale A/B: one seeded loadgen ramp trace over a
+        # static 1-replica fleet vs an autoscaled one, interleaved.
+        # The section carries its OWN model (long sequences, tiny
+        # width): per-request decode is slow enough (~25 ms) that the
+        # ramp's peak genuinely outruns one 1-slot replica, and the
+        # pass is long enough (~10 s) that the scale-up — boot +
+        # pre-warm + health-gated join, seconds of work — lands and
+        # pays off INSIDE the measured window
+        import loadgen
+
+        a_seq, a_vocab = 128, 61
+        auto_model = transformer_lm(
+            vocab_size=a_vocab, seq_len=a_seq, d_model=16,
+            num_heads=2, depth=1, seed=0,
         )
-        obsv = wl.pop("_observability", None)
-        if obsv is not None:
-            record["observability"] = obsv
-        record["workloads"][name] = wl
-        print(json.dumps({name: {
-            "fleet_vs_single": wl["fleet_vs_single"],
-            "affinity_hit_rate": wl["affinity_hit_rate"],
-            "random_hit_rate": wl["random_hit_rate"],
+        auto_ref_gen = CachedSequenceGenerator(auto_model)
+        n_auto, period, peak = 450, 6.0, 50.0
+        auto_repeats = 1 if args.smoke else 2
+        rng_a = np.random.default_rng(7)
+        auto_reqs = _make_ramp_reqs(n_auto, a_seq, a_vocab, rng_a)
+        ramp = loadgen.arrivals(
+            "ramp", peak, n=n_auto, seed=7, period=period,
+            floor_frac=0.2,
+        )
+        smax = max(s for _, s in auto_reqs)
+        ragged = auto_ref_gen.generate(
+            [p for p, _ in auto_reqs], steps=smax
+        )
+        auto_refs = [
+            np.asarray(row)[: p.size + s]
+            for row, (p, s) in zip(list(ragged), auto_reqs)
+        ]
+        record["autoscale"] = {
+            "model": "transformer_lm d16 L1 seq128",
+            "trace": {
+                "process": "ramp", "peak_rate": peak,
+                "period": period, "seed": 7, "events": n_auto,
+                "floor_frac": 0.2,
+            },
+            "repeats": auto_repeats,
+            **_measure_autoscale(
+                auto_model, auto_reqs, auto_refs, slots=1,
+                chunk=max(8, a_seq // 4), arrivals=ramp,
+                repeats=auto_repeats,
+            ),
+        }
+        a = record["autoscale"]
+        print(json.dumps({"autoscale": {
+            "scaled_to": a["autoscaled"]["scaled_to"],
+            "join_compile_storms":
+                a["autoscaled"]["join_compile_storms"],
+            "p99_ratio_static_over_autoscaled":
+                a["p99_ratio_static_over_autoscaled"],
         }}), flush=True)
 
-    record["value"] = record["workloads"]["prefix_heavy"][
-        "fleet_affinity"]["tokens_per_sec"]
+    if record["workloads"]:
+        record["value"] = record["workloads"]["prefix_heavy"][
+            "fleet_affinity"]["tokens_per_sec"]
+    else:
+        del record["workloads"]
+        record["value"] = record["autoscale"]["autoscaled"][
+            "tokens_per_sec"]
     with open("BENCH_FLEET.json", "w") as f:
         json.dump(record, f, indent=2)
-    print(json.dumps({
-        "metric": record["metric"],
-        "value": record["value"],
-        "fleet_vs_single": record["workloads"]["prefix_heavy"][
-            "fleet_vs_single"],
-        "zero_reuse_fleet_vs_single": record["workloads"]["zero_reuse"][
-            "fleet_vs_single"],
-    }))
+    line = {"metric": record["metric"], "value": record["value"]}
+    if "workloads" in record:
+        line["fleet_vs_single"] = record["workloads"]["prefix_heavy"][
+            "fleet_vs_single"]
+        line["zero_reuse_fleet_vs_single"] = record["workloads"][
+            "zero_reuse"]["fleet_vs_single"]
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -53,6 +53,7 @@ from distkeras_tpu.obs.timeseries import (
     FAST_WINDOW,
     SLOW_WINDOW,
     MetricsHistory,
+    worst_burn,
 )
 from distkeras_tpu.obs.slo import (
     SloEvaluator,
@@ -117,4 +118,5 @@ __all__ = [
     "stamp_error_trace",
     "start_span",
     "timeline_complete",
+    "worst_burn",
 ]

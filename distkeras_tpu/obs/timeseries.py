@@ -61,6 +61,19 @@ BURN_OK, BURN_BURNING, BURN_SPIKING, BURN_BREACH = (
 _BURN_SEVERITY = {BURN_OK: 0, BURN_BURNING: 1, BURN_SPIKING: 2,
                   BURN_BREACH: 3}
 
+
+def worst_burn(verdicts) -> str:
+    """The most severe verdict in ``verdicts`` — the fleet-level
+    reduction an autoscale policy runs over its replicas' burn
+    states. ``None`` entries (a replica with no SLOs or no history
+    configured) are neutral, as is anything unrecognized: absence of
+    evidence never scales a fleet."""
+    worst = BURN_OK
+    for v in verdicts:
+        if v is not None and _BURN_SEVERITY.get(v, 0) > _BURN_SEVERITY[worst]:
+            worst = v
+    return worst
+
 #: the SRE-practice default windows (seconds): fast = 1 minute
 #: ("spiking now"), slow = 10 minutes ("slowly burning").
 FAST_WINDOW, SLOW_WINDOW = 60.0, 600.0

@@ -247,6 +247,11 @@ class ParameterServer:
         # even while other workers keep committing (checkpointing uses this)
         self.snapshot_every = 0
         self.on_snapshot = None
+        # the multi-consumer face of the same cadence: (every, fn)
+        # pairs registered by add_snapshot_listener — checkpointing
+        # keeps the single legacy slot above, a serving-bundle
+        # publisher rides a listener, each at its own cadence
+        self._snapshot_listeners = []
         # fault tolerance (absent upstream — SURVEY §5.3: Spark task retry
         # silently re-trains a partition and the PS double-absorbs its
         # commits): per-worker last-seen commit sequence numbers make commits
@@ -524,12 +529,11 @@ class ParameterServer:
                 and len(self._replicas) < self.min_replicas
             )
             n = self._meta.get("num_updates", 0)
-            cb = self.on_snapshot
-            if (
-                cb is not None
-                and self.snapshot_every > 0
-                and n % self.snapshot_every == 0
-            ):
+            due = [
+                fn for every, fn in self._snapshot_cadences()
+                if n % every == 0
+            ]
+            if due:
                 snap = (
                     jax.tree.map(np.copy, self._center),
                     self._meta_copy(),
@@ -539,11 +543,15 @@ class ParameterServer:
             # heavy IO outside the lock; content still == step n. A snapshot
             # failure (disk full, perms) must not surface as a *worker*
             # failure — the committing thread is an arbitrary worker and
-            # retrying it would re-train a healthy partition.
-            try:
-                cb(n, *snap)
-            except Exception:
-                logger.exception("parameter-server snapshot at step %d failed", n)
+            # retrying it would re-train a healthy partition. One copy
+            # feeds every due consumer; each fails independently.
+            for fn in due:
+                try:
+                    fn(n, *snap)
+                except Exception:
+                    logger.exception(
+                        "parameter-server snapshot at step %d failed", n
+                    )
         if repl_lost:
             # refusing the ack is safe even though a checkpoint may carry
             # this commit: the checkpoint meta carries the dedup table
@@ -555,6 +563,43 @@ class ParameterServer:
                 detail="replication lost mid-commit; the resend is "
                        "deduplicated once a replica re-attaches",
             )
+
+    # -- checkpoint-cadence listeners ---------------------------------------
+
+    def _snapshot_cadences(self):
+        """Every (every, fn) checkpoint-cadence consumer: the legacy
+        single ``on_snapshot`` slot plus the listener list. Called
+        under the commit lock."""
+        out = []
+        if self.on_snapshot is not None and self.snapshot_every > 0:
+            out.append((self.snapshot_every, self.on_snapshot))
+        out.extend(self._snapshot_listeners)
+        return out
+
+    def add_snapshot_listener(self, fn, every=1):
+        """Register ``fn(n, center_copy, meta_copy, worker_snaps)`` to
+        fire every ``every`` commits — the multi-consumer face of the
+        ``on_snapshot`` hook (checkpointing keeps the legacy slot; a
+        serving-bundle publisher rides a listener, each cadence
+        independent). Copies are taken INSIDE the commit's locked
+        section, so the state labelled n really is the n-update
+        state; ``fn`` runs outside the lock and its failure is
+        logged, never surfaced to the committing worker. Deduped
+        replays do not fire listeners (they never re-apply)."""
+        if int(every) < 1:
+            raise ValueError(f"every must be >= 1; got {every}")
+        with self._lock:
+            self._snapshot_listeners.append((int(every), fn))
+
+    def remove_snapshot_listener(self, fn) -> bool:
+        """Detach a listener previously registered by
+        :meth:`add_snapshot_listener`; True if it was present."""
+        with self._lock:
+            for i, (_, f) in enumerate(self._snapshot_listeners):
+                if f is fn:
+                    del self._snapshot_listeners[i]
+                    return True
+        return False
 
     # -- replication --------------------------------------------------------
 

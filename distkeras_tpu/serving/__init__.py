@@ -46,6 +46,17 @@ decode path (scheduler -> engine -> server, plus the client).
   ``FleetController``'s rolling bundle upgrade (``rollover``: drain
   one replica at a time, hot-load the new bundle, health-check back
   into rotation; no request dropped or duplicated).
+- ``autoscale``: the elastic-fleet control loop — a pure
+  ``AutoscalePolicy`` (burn-rate verdicts + queue/KV-pool pressure →
+  scale_up / scale_down / hold under hysteresis, cooldowns, and
+  min/max replica bounds) driven by a cadence-guarded ``Autoscaler``
+  on the ``FleetController``: scale-ups are pre-warmed before
+  entering rotation (no compile storm under live traffic),
+  scale-downs drain (no request dropped), dead replicas are reaped
+  AND replaced in the same decision tick. ``BundlePublisher`` +
+  ``ContinuousDeployer`` close the training → serving loop: bundles
+  published on the parameter server's checkpoint cadence auto-roll
+  across the fleet via ``rollover``.
 
 Robustness (see also ``distkeras_tpu/faults.py``): the scheduler
 assigns BLAME for device-step failures (masking retries + bisection)
@@ -100,9 +111,23 @@ from distkeras_tpu.serving.fleet import (
     affinity_key,
     local_replica_factory,
 )
+from distkeras_tpu.serving.autoscale import (
+    AutoscaleDecision,
+    AutoscalePolicy,
+    Autoscaler,
+    BundlePublisher,
+    ContinuousDeployer,
+    ReplicaSignals,
+    signals_from_router,
+)
 
 __all__ = [
+    "AutoscaleDecision",
+    "AutoscalePolicy",
+    "Autoscaler",
+    "BundlePublisher",
     "ContinuousBatcher",
+    "ContinuousDeployer",
     "DeadlineExceededError",
     "DecodeStepper",
     "DevicePrefixIndex",
@@ -119,6 +144,7 @@ __all__ = [
     "PrefixStore",
     "QosPolicy",
     "QuotaExhaustedError",
+    "ReplicaSignals",
     "SamplingParams",
     "TokenBucket",
     "ServeRequest",
@@ -136,4 +162,5 @@ __all__ = [
     "local_replica_factory",
     "seed_for_completion",
     "serve",
+    "signals_from_router",
 ]

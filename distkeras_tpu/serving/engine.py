@@ -1990,10 +1990,15 @@ class DecodeStepper:
             # mint the program), and never over an occupied slot 0 —
             # zeroing a live request's context row would corrupt its
             # remaining decode, the exact class the paged restores
-            # above guard against
+            # above guard against. Dense occupancy: ``release`` parks a
+            # slot at lens == 1 (never 0 — pos = lens-1 must stay in
+            # range), so lens > 1 means a live occupant and ``_pending``
+            # covers the mid-prefill window; a ``> 0`` test here would
+            # be unsatisfiable and silently skip the warm, handing the
+            # mint to the first live admission as a compile storm
             occupied = (
                 bool(self._tables[0]) if self.paged
-                else int(self._lens[0]) > 0
+                else (int(self._lens[0]) > 1 or 0 in self._pending)
             )
             if self._row_fn is None and not occupied:
                 import jax
@@ -4177,6 +4182,20 @@ class ServingEngine:
             # devices this replica's decode spans and the K/V bytes
             # each shard holds (mesh also rides ``batcher.load()``)
             out["kv_shard_bytes"] = self._stepper.kv_shard_bytes()
+        if batcher is not None and self.history is not None:
+            # the autoscaler's windowed signals, computed replica-side
+            # over the engine's own history ring and republished by
+            # the router's books: how often admission hit an exhausted
+            # page pool in the last minute, and which way the queue
+            # is trending (req/s of depth growth — the leading
+            # indicator a point-in-time depth sample misses)
+            self.history.maybe_snap()
+            out["pool_exhausted_rate"] = self.history.rate(
+                "serving_scheduler_pool_exhausted", window=60.0
+            )
+            out["queue_depth_trend"] = self.history.trend(
+                "serving_scheduler_queue_depth", window=60.0
+            )
         out["heartbeat_age"] = (
             None
             if batcher is None or not self._started

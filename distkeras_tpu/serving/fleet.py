@@ -162,6 +162,18 @@ class _Replica:
             # errors/bytes), so the fleet books show where transfer
             # traffic queues without a per-replica metrics scrape
             "transfer": h.get("transfer"),
+            # the autoscale signal set, republished from the replica's
+            # own health reply: queue occupancy, paged-KV pool
+            # pressure, the windowed admission-failure rate and
+            # queue-depth slope, and the burn-rate verdict — the
+            # policy reads the whole fleet from one in-process
+            # ``router.replicas()`` snapshot, no extra scrape
+            "queue_depth": h.get("queue_depth"),
+            "queue_capacity": h.get("queue_capacity"),
+            "kv_page_util": h.get("kv_page_util"),
+            "pool_exhausted_rate": h.get("pool_exhausted_rate"),
+            "queue_depth_trend": h.get("queue_depth_trend"),
+            "burn": h.get("burn"),
         }
 
 
@@ -1834,6 +1846,19 @@ class _LocalReplica:
         th = self.server._accept_thread
         return th is not None and th.is_alive()
 
+    def warm(self):
+        """Pre-compile the serving path (decode buckets, prefill
+        chunks, restore shapes) and arm the compile ledger's storm
+        detector. ``scale_up`` calls this BEFORE the replica enters
+        rotation, so a join under live traffic mints no program —
+        the zero-compile-storms-on-join invariant the autoscale
+        bench gates on."""
+        stepper = self.engine._stepper
+        stepper.warmup()
+        stepper.warm_prefill_buckets()
+        stepper.warm_restore_buckets()
+        self.engine.compile_ledger.mark_warmed()
+
 
 def local_replica_factory(host="127.0.0.1", **engine_kw):
     """Factory of in-process replicas: ``factory(bundle)`` boots a
@@ -1889,6 +1914,13 @@ class FleetController:
                 endpoints=[r.endpoint for r in self.replicas],
                 **self._router_kw,
             ).start()
+            # the fleet size as a first-class time-series on the
+            # router registry (its history ring snaps every sweep):
+            # the ``timeseries`` verb sparklines it, ``dkt_top``'s
+            # replicas column reads it, the autoscale bench commits it
+            self.router.registry.gauge(
+                "fleet_replicas", fn=lambda: len(self.replicas)
+            )
             for r in self.replicas:
                 if not self.router.wait_in_rotation(r.endpoint):
                     raise RuntimeError(
@@ -1937,6 +1969,89 @@ class FleetController:
             self.router.remove_replica(r.endpoint)
             self.replicas.remove(r)
         return gone
+
+    # -- elastic scaling ----------------------------------------------------
+
+    def scale_up(self, count=1, timeout=120.0) -> list:
+        """Grow the fleet by ``count`` replicas through the same
+        boot → pre-warm → health-gated-join path a rollover uses:
+        each new replica is warmed (every decode/prefill/restore
+        bucket compiled, storm detector armed) BEFORE it enters the
+        router's rotation, so a scale-up under live traffic never
+        compile-storms. Returns the added handles; on failure the
+        half-joined replica is removed and stopped, and the fleet is
+        exactly as before."""
+        if self.router is None:
+            raise RuntimeError("controller not started")
+        added = []
+        for _ in range(int(count)):
+            new = self._factory(self._bundle)
+            try:
+                warm = getattr(new, "warm", None)
+                if warm is not None:
+                    warm()
+                self.router.add_replica(new.endpoint)
+                if not self.router.wait_in_rotation(
+                    new.endpoint, timeout=timeout
+                ):
+                    raise RuntimeError(
+                        f"scale-up replica {new.endpoint} never "
+                        "became healthy"
+                    )
+            except BaseException:
+                self.router.remove_replica(new.endpoint)
+                try:
+                    new.stop(drain=False)
+                except Exception:  # noqa: BLE001 — best-effort abort
+                    pass
+                raise
+            self.replicas.append(new)
+            added.append(new)
+        return added
+
+    def scale_down(self, endpoint=None, timeout=120.0):
+        """Shrink the fleet by one replica without dropping work:
+        drain it at the router (new work routes elsewhere, in-flight
+        forwards complete), then remove it from rotation and stop it
+        gracefully. ``endpoint`` names the victim (the policy passes
+        its least-loaded pick); default is the replica with the least
+        router-side in-flight. Refuses to empty the fleet; a drain
+        that wedges past ``timeout`` puts the replica back in
+        rotation and raises — capacity is never silently lost."""
+        if self.router is None:
+            raise RuntimeError("controller not started")
+        if len(self.replicas) <= 1:
+            raise RuntimeError("refusing to scale below 1 replica")
+        if endpoint is None:
+            books = {
+                tuple(row["endpoint"]): row
+                for row in self.router.replicas()
+            }
+            victim = min(
+                self.replicas,
+                key=lambda r: books.get(
+                    tuple(r.endpoint), {}
+                ).get("in_flight") or 0,
+            )
+        else:
+            endpoint = (endpoint[0], int(endpoint[1]))
+            victim = next(
+                (r for r in self.replicas
+                 if tuple(r.endpoint) == endpoint), None
+            )
+            if victim is None:
+                raise KeyError(f"no replica at {endpoint}")
+        self.router.drain_replica(victim.endpoint)
+        if not self.router.wait_drained(victim.endpoint, timeout=timeout):
+            self.router.add_replica(victim.endpoint)
+            raise RuntimeError(
+                f"replica {victim.endpoint} still has in-flight work "
+                f"after {timeout}s; scale-down aborted"
+            )
+        self.router.remove_replica(victim.endpoint)
+        victim.stop(drain=True)
+        self.replicas.remove(victim)
+        return victim
 
     # -- rolling upgrade ----------------------------------------------------
 

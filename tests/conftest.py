@@ -15,6 +15,23 @@ import jax  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Unit tier first, harness tier last — deterministically.
+
+    ``chaos`` (subprocess fleets, seeded fault storms) and ``e2e``
+    (full bench-harness runs) tests each cost tens of seconds to
+    minutes on this 1-core sandbox; alphabetical collection buries
+    them mid-suite where they starve hundreds of sub-second unit
+    tests behind them. A stable two-bucket sort keeps every test
+    selected and every relative order intact, but a time-boxed or
+    interrupted run now drains the whole unit tier before the first
+    multi-minute smoke starts — fast, broad signal first."""
+    items.sort(key=lambda it: int(
+        it.get_closest_marker("chaos") is not None
+        or it.get_closest_marker("e2e") is not None
+    ))
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _assert_cpu_mesh():
     assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"

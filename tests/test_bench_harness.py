@@ -59,6 +59,7 @@ def test_best_mode_is_an_ab():
     assert "dense seq4096" in labels and "flash seq4096" in labels
 
 
+@pytest.mark.e2e
 def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
     """``bench_serving.py --smoke`` runs tiny shapes end to end and the
     artifact carries the full A/B schema — per-request TTFT, latency
@@ -258,6 +259,7 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
         ), name
 
 
+@pytest.mark.e2e
 def test_bench_decode_sharded_smoke_end_to_end(tmp_path, monkeypatch):
     """``bench_decode.py --sharded-only --smoke`` runs the tp1/tp2/tp4
     grid end to end on the 8-virtual-device CPU mesh and the artifact
@@ -380,6 +382,7 @@ def _check_fleet_record(rec):
     assert zr["random_hit_rate"] == 0.0
 
 
+@pytest.mark.e2e
 def test_bench_fleet_smoke_mode_end_to_end(tmp_path, monkeypatch):
     """``bench_fleet.py --smoke`` boots the full three-sided harness —
     one single server plus TWO 2-replica fleets over real TCP — on tiny
@@ -637,14 +640,79 @@ def test_committed_bench_fleet_artifact_schema():
     assert ph["affinity_hit_rate"] > ph["random_hit_rate"]
 
 
+def test_committed_bench_fleet_autoscale_block():
+    """The COMMITTED autoscale block carries the elastic-fleet claims
+    honestly: the fleet grew past one replica INSIDE the measured ramp
+    (provisioning curve from 1 to scaled_to), every join under live
+    traffic compile-stormed ZERO times (the pre-warm-before-rotation
+    contract), outputs stayed token-identical to solo decode, and both
+    p99-under-ramp numbers sit under the collapse ceiling.
+    Self-comparison exercises every invariant plus the committed
+    floors — regenerating the artifact without the scale event must
+    fail here, not slip through."""
+    rec = json.loads(
+        open(os.path.join(REPO, "BENCH_FLEET.json")).read()
+    )
+    assert check_bench.compare_autoscale(rec, rec) == []
+    assert set(check_bench.COMMITTED_FLOORS["autoscale"]) == {
+        "autoscale.autoscaled.scaled_to",
+        "autoscale.autoscaled.scale_ups",
+    }
+    au = rec["autoscale"]["autoscaled"]
+    assert au["join_compile_storms"] == 0
+    assert au["scaled_to"] >= 2
+    curve = au["replicas_over_time"]
+    assert curve[0][1] == 1 and max(n for _, n in curve) == au["scaled_to"]
+    assert rec["autoscale"]["trace"]["process"] == "ramp"
+    # gate plumbing: a storm on join or a never-scaled fleet is a
+    # violation, not a silent pass
+    import copy
+
+    bad = copy.deepcopy(rec)
+    bad["autoscale"]["autoscaled"]["join_compile_storms"] = 1
+    assert any(
+        "compile storms" in v
+        for v in check_bench.compare_autoscale(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    bad["autoscale"]["autoscaled"]["scaled_to"] = 1
+    assert any(
+        "never scaled" in v
+        for v in check_bench.compare_autoscale(bad, rec)
+    )
+
+
+@pytest.mark.slow
+def test_bench_fleet_autoscale_smoke_end_to_end(tmp_path, monkeypatch):
+    """``bench_fleet.py --smoke --autoscale-only`` (the ``--kind
+    autoscale`` gate's fresh side) runs the interleaved ramp A/B —
+    static-1 vs autoscaled, identity-pinned — end to end on CPU and
+    the fresh artifact passes the autoscale gate against the committed
+    one: the fleet scales mid-ramp, the join is storm-free, and the
+    p99 ratio lands inside the band."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(
+        sys, "argv", ["bench_fleet.py", "--smoke", "--autoscale-only"]
+    )
+    bench_fleet.main()
+    rec = json.loads((tmp_path / "BENCH_FLEET.json").read_text())
+    committed = json.loads(
+        open(os.path.join(REPO, "BENCH_FLEET.json")).read()
+    )
+    violations = check_bench.compare_autoscale(rec, committed)
+    assert violations == [], violations
+
+
 @pytest.mark.chaos
 def test_soak_fleet_smoke():
     """``tools/soak_fleet.py --smoke`` runs end to end at tier-1 scale
     and meets its own acceptance bar: a REAL subprocess replica
     kill -9'd mid-stream under armed ``router.*``/``net.*``/
     ``stepper.step`` seams, zero hung clients, zero untyped errors,
-    zero corrupt outputs, exact attempt accounting, and a mid-soak
-    rolling upgrade of every survivor. Mirrors the ``soak_serving``/
+    zero corrupt outputs, exact attempt accounting, the autoscaler
+    reaping AND replacing the victim in one tick, and a
+    checkpoint-triggered rollover of the full fleet. Mirrors the
+    ``soak_serving``/
     ``soak_training`` treatment: the chaos harness itself is pinned on
     CPU so a drift surfaces as a red test, not a dead soak run."""
     import soak_fleet  # REPO/tools is on sys.path (module top)
@@ -663,8 +731,24 @@ def test_soak_fleet_smoke():
     )
     assert summary["control_errors"] == []
     assert summary["kill"]["in_flight_at_kill"]
-    # 2 smoke replicas: the victim is reaped, the survivor upgrades
-    assert len(summary["rollover"]["replaced"]) == 1
+    # the elastic control loop: the kill -9'd victim was reaped AND
+    # replaced by the autoscaler's below_min row (same tick), so the
+    # fleet is back at strength before the rollover
+    assert summary["autoscale"]["reaps"] >= 1
+    assert summary["autoscale"]["scale_ups"] >= 1
+    assert summary["autoscale"]["errors"] == 0
+    assert summary["autoscale"]["fleet_size_after_replace"] == 2
+    # checkpoint-cadence publish -> continuous deploy: the PS commit
+    # stream published ONE bundle (byte-identical to the boot bundle —
+    # zero deltas) and the deployer rolled the FULL 2-replica fleet
+    assert summary["deploy"]["published"] == 1
+    assert summary["deploy"]["publish_errors"] == 0
+    assert summary["deploy"]["bundle_identical_to_boot"] is True
+    assert len(summary["rollover"]["replaced"]) == 2
+    # replicas pre-warm + mark_warmed before READY: a compile storm
+    # anywhere in the soak (including the autoscaler's replacement
+    # joining under traffic) fails the bar
+    assert summary["compile_storms"] == 0
     assert summary["completed"] > 0
     assert summary["ok"]
 

@@ -109,6 +109,16 @@ DISAGG_RATIO_KEYS = (
 OBS_RATIO_KEYS = (
     "obs.history_vs_off",
 )
+#: the ramp A/B's p99 ratio is owned by JOIN TIMING — when inside the
+#: measured pass the scale-up lands, and how much of the single
+#: bench core its boot steals — so the band only gates collapse;
+#: the autoscale claims live in the invariants below (scaled mid-pass,
+#: zero compile storms on join, outputs identical) and the committed
+#: floors, not in a speedup number
+AUTOSCALE_RATIO_BAND = 10.0
+AUTOSCALE_RATIO_KEYS = (
+    "autoscale.p99_ratio_static_over_autoscaled",
+)
 
 #: floors the COMMITTED artifact must clear — the claims PERF.md
 #: quotes; regenerating the artifact with a worse number fails here
@@ -171,7 +181,20 @@ COMMITTED_FLOORS = {
     "obs": {
         "obs.history_vs_off": 0.98,
     },
+    # elastic fleet: the committed ramp must have actually grown the
+    # fleet (a curve that never left 1 replica proves nothing)
+    "autoscale": {
+        "autoscale.autoscaled.scaled_to": 2,
+        "autoscale.autoscaled.scale_ups": 1,
+    },
 }
+
+#: the committed p99-under-ramp ceiling (ms): lower is better, so
+#: this claim is a CEILING, not a floor — no request in the committed
+#: ramp's final phase waited this long on either side. Sized ~4x the
+#: committed autoscaled number: catches an admission/queueing collapse
+#: while riding out join-timing wobble between regenerations.
+AUTOSCALE_P99_CEILING_MS = 60_000.0
 
 
 def _get(record: dict, dotted: str):
@@ -531,6 +554,83 @@ def compare_obs(fresh: dict, committed: dict) -> list[str]:
     return violations
 
 
+def compare_autoscale(fresh: dict, committed: dict) -> list[str]:
+    """Violations of the elastic-fleet gate (empty list = pass). The
+    invariants, fresh and committed alike: the autoscaled side grew
+    past 1 replica INSIDE the measured ramp (the provisioning curve
+    starts at 1 and reaches ``scaled_to``), every replica that joined
+    under live traffic did so with ZERO compile storms (the pre-warm-
+    before-rotation contract), both sides' outputs stayed token-
+    identical to solo decode, and the static baseline really was one
+    replica. The p99 claim is a committed CEILING plus a collapse-only
+    ratio band — on a single bench core the join steals compute from
+    the only replica serving, so the gate never demands a speedup."""
+    violations: list[str] = []
+    for rec, tag in ((fresh, "fresh"), (committed, "committed")):
+        a = rec.get("autoscale")
+        if a is None:
+            violations.append(f"{tag}: missing autoscale block")
+            continue
+        if a.get("outputs_identical") is not True:
+            violations.append(
+                f"{tag} autoscale: outputs not identical to solo decode"
+            )
+        if (a.get("trace") or {}).get("process") != "ramp":
+            violations.append(
+                f"{tag} autoscale: not driven by the seeded ramp trace"
+            )
+        au = a.get("autoscaled") or {}
+        if au.get("join_compile_storms", None) != 0:
+            # the acceptance bar: a scale-up under live ramp traffic
+            # pre-warms BEFORE rotation, so its armed storm detector
+            # saw no serving-path program mint
+            violations.append(
+                f"{tag} autoscale: {au.get('join_compile_storms')} "
+                "compile storms on replicas joining under traffic"
+            )
+        if not au.get("scaled_to", 0) >= 2:
+            violations.append(
+                f"{tag} autoscale: fleet never scaled past "
+                f"{au.get('scaled_to')} replica(s) under the ramp"
+            )
+        curve = au.get("replicas_over_time") or []
+        if not curve or curve[0][1] != au.get("start_replicas", 1):
+            violations.append(
+                f"{tag} autoscale: provisioning curve missing or not "
+                f"starting at {au.get('start_replicas', 1)} replica(s)"
+            )
+        elif max(n for _, n in curve) != au.get("scaled_to"):
+            violations.append(
+                f"{tag} autoscale: provisioning curve peak disagrees "
+                f"with scaled_to={au.get('scaled_to')}"
+            )
+        if (a.get("static") or {}).get("replicas") != 1:
+            violations.append(
+                f"{tag} autoscale: static baseline is not 1 replica"
+            )
+        for side in ("static", "autoscaled"):
+            p99 = (a.get(side) or {}).get("p99_under_ramp_ms")
+            if not (p99 and p99 > 0):
+                violations.append(
+                    f"{tag} autoscale.{side}: p99-under-ramp not "
+                    "measured"
+                )
+    ca = committed.get("autoscale") or {}
+    for side in ("static", "autoscaled"):
+        p99 = (ca.get(side) or {}).get("p99_under_ramp_ms") or 0
+        if p99 > AUTOSCALE_P99_CEILING_MS:
+            violations.append(
+                f"committed autoscale.{side}: p99_under_ramp_ms {p99} "
+                f"over the {AUTOSCALE_P99_CEILING_MS:g} ms ceiling"
+            )
+    _band_check(
+        fresh, committed, AUTOSCALE_RATIO_KEYS, AUTOSCALE_RATIO_BAND,
+        violations,
+    )
+    _committed_floors(committed, "autoscale", violations)
+    return violations
+
+
 def _timed_compile_fields(rec, prefix=""):
     """Every ``timed_pass_compiles`` field anywhere in the artifact,
     as ``(dotted_path, value)`` pairs."""
@@ -552,6 +652,7 @@ COMPARATORS = {
     "decode": compare_decode,
     "disagg": compare_disagg,
     "obs": compare_obs,
+    "autoscale": compare_autoscale,
 }
 ARTIFACTS = {
     "serving": "BENCH_SERVING.json",
@@ -561,6 +662,9 @@ ARTIFACTS = {
     "disagg": "BENCH_SERVING.json",
     # so does the obs (metrics-history + compile-invariant) block
     "obs": "BENCH_SERVING.json",
+    # the autoscale (elastic fleet ramp A/B) block rides the fleet
+    # artifact, but its smoke path runs ONLY the ramp section
+    "autoscale": "BENCH_FLEET.json",
 }
 
 
@@ -580,6 +684,9 @@ def run_smoke(kind: str, workdir: str) -> dict:
         "disagg": ["bench_serving.py", "--smoke"],
         # so does the obs block
         "obs": ["bench_serving.py", "--smoke"],
+        # the ramp A/B alone — the fleet workloads' smoke is --kind
+        # fleet's job
+        "autoscale": ["bench_fleet.py", "--smoke", "--autoscale-only"],
     }[kind]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -595,7 +702,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--kind",
                     choices=("serving", "fleet", "decode", "disagg",
-                             "obs"),
+                             "obs", "autoscale"),
                     required=True)
     ap.add_argument("--fresh", help="fresh --smoke artifact to grade")
     ap.add_argument("--committed",
@@ -634,6 +741,7 @@ def main(argv=None) -> int:
         "decode": DECODE_RATIO_KEYS,
         "disagg": DISAGG_RATIO_KEYS,
         "obs": OBS_RATIO_KEYS,
+        "autoscale": AUTOSCALE_RATIO_KEYS,
     }[args.kind])
     print(f"bench gate ok ({args.kind}): "
           f"{nbands} ratio bands + invariants hold")

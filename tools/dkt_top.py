@@ -148,7 +148,36 @@ def format_table(samples, width: int = 78, series: dict | None = None
                     int(s["value"]), "?"
                 )
                 break
-        lines.append(f"== {replica}{role}{mesh} ".ljust(width, "="))
+        # the elastic-fleet column: the controller's fleet_replicas
+        # gauge puts the CURRENT fleet size in the router group's
+        # header, the autoscale counters mark how it got there
+        # (↑ scale-ups / ↓ scale-downs), and — when the target serves
+        # history — a sparkline of fleet_replicas draws the
+        # provisioned-capacity curve next to the load that drove it
+        fleet = ""
+        for s, _ in groups[replica]:
+            if s["name"] == "fleet_replicas" and (
+                s.get("value") is not None
+            ):
+                fleet = f"  replicas={int(s['value'])}"
+                ups = downs = 0
+                for s2, _ in groups[replica]:
+                    if s2["name"] == "fleet_autoscale_scale_ups":
+                        ups = int(s2.get("value") or 0)
+                    elif s2["name"] == "fleet_autoscale_scale_downs":
+                        downs = int(s2.get("value") or 0)
+                if ups or downs:
+                    fleet += f" ↑{ups}↓{downs}"
+                if series is not None:
+                    ts = series.get((replica, "fleet_replicas", ()))
+                    if ts is not None:
+                        sl = _sparkline(ts.get("points"))
+                        if sl:
+                            fleet += f" {sl}"
+                break
+        lines.append(
+            f"== {replica}{role}{mesh}{fleet} ".ljust(width, "=")
+        )
         rows = []
         for s, labels in sorted(
             groups[replica], key=lambda p: p[0]["name"]

@@ -20,6 +20,11 @@ replayable object: a trace is a list of events ``{"t", "tenant",
   * ``heavy_tail``  — Pareto(``alpha``) inter-arrivals with mean
     ``1/rate``: arrivals cluster, gaps stretch (the self-similar
     traffic real serving logs show, not smooth Poisson);
+  * ``ramp``        — a load RAMP from a trickle up to ``rate``
+    (here the PEAK, not the mean) over ``period`` seconds, then a
+    hold at peak: linear when ``ramp_steps=0``, else a staircase of
+    that many flat steps. The standard autoscale stimulus — the
+    bench and the soak drive the same seeded, replayable climb;
 
 - a **tenant mix** — each tenant a dict of ``name``, ``weight``
   (traffic share), ``priority`` (QoS class), ``prompt_len`` and
@@ -76,11 +81,27 @@ PRESETS = {"interactive": interactive_tenants}
 
 
 def _rate_fn(process: str, rate: float, *, burst_factor=8.0,
-             period=1.0, duty=0.2, amplitude=0.8, floor_frac=0.05):
+             period=1.0, duty=0.2, amplitude=0.8, floor_frac=0.05,
+             ramp_steps=0):
     """The instantaneous-rate function r(t) of a modulated process
     (None for processes that do not thin a Poisson stream)."""
     if process == "poisson":
         return lambda t: rate
+    if process == "ramp":
+        # trickle -> peak over ``period`` seconds, then hold: ``rate``
+        # is the PEAK here (an autoscaler is sized against what the
+        # climb reaches, not the average of the climb). ``ramp_steps``
+        # > 0 quantizes the climb into flat steps — the staircase
+        # shape a step-provisioned fleet actually experiences
+        lo = max(1e-9, rate * floor_frac)
+
+        def ramp(t):
+            frac = min(1.0, t / period) if period > 0 else 1.0
+            if ramp_steps and frac < 1.0:
+                frac = math.floor(frac * ramp_steps) / ramp_steps
+            return lo + (rate - lo) * frac
+
+        return ramp
     if process == "bursty":
         # duty * period seconds of burst at rate*burst_factor, the
         # rest at whatever off-rate keeps the MEAN near ``rate`` —
@@ -194,9 +215,13 @@ def trace_from_jsonable(rows) -> list[dict]:
     ]
 
 
-def summarize(trace) -> dict:
+def summarize(trace, phases: int = 0) -> dict:
     """Per-tenant counts + global arrival stats — what the CLI prints
-    and a bench artifact records next to its numbers."""
+    and a bench artifact records next to its numbers. ``phases`` > 0
+    additionally splits the trace's span into that many equal windows
+    and reports the arrival rate of each (``phase_rates``) — how a
+    ramp trace documents its own climb; the base schema is unchanged
+    when 0."""
     ts = np.asarray([ev["t"] for ev in trace])
     by_tenant: dict = {}
     for ev in trace:
@@ -210,7 +235,7 @@ def summarize(trace) -> dict:
         b["decode_tokens"] += int(ev["steps"])
         b["streamed"] += int(bool(ev.get("stream")))
     gaps = np.diff(ts) if ts.size > 1 else np.asarray([0.0])
-    return {
+    out = {
         "events": len(trace),
         "span_seconds": round(float(ts[-1] - ts[0]), 4) if len(trace)
         else 0.0,
@@ -222,15 +247,41 @@ def summarize(trace) -> dict:
         },
         "tenants": by_tenant,
     }
+    if phases > 0 and len(trace):
+        span = float(ts[-1] - ts[0])
+        edges = np.linspace(0.0, max(span, 1e-9), int(phases) + 1)
+        rel = ts - ts[0]
+        rows = []
+        for i in range(int(phases)):
+            lo, hi = float(edges[i]), float(edges[i + 1])
+            last = i == int(phases) - 1
+            mask = (rel >= lo) & ((rel <= hi) if last else (rel < hi))
+            n = int(mask.sum())
+            dur = hi - lo
+            rows.append({
+                "t0": round(lo, 4), "t1": round(hi, 4), "events": n,
+                "rate": round(n / dur, 3) if dur > 0 else 0.0,
+            })
+        out["phase_rates"] = rows
+    return out
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--process", default="poisson",
                     choices=("poisson", "bursty", "diurnal",
-                             "heavy_tail"))
+                             "heavy_tail", "ramp"))
     ap.add_argument("--rate", type=float, default=10.0,
-                    help="mean arrivals per second")
+                    help="mean arrivals per second (PEAK for ramp)")
+    ap.add_argument("--period", type=float, default=None,
+                    help="modulation period seconds (ramp: the climb "
+                         "duration before the hold at peak)")
+    ap.add_argument("--ramp-steps", type=int, default=0,
+                    help="ramp only: quantize the climb into this "
+                         "many flat steps (0 = linear)")
+    ap.add_argument("--phases", type=int, default=0,
+                    help="split the summary into this many equal "
+                         "windows with per-phase arrival rates")
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--vocab", type=int, default=256)
@@ -254,11 +305,17 @@ def main(argv=None) -> int:
         tenants = (
             json.loads(args.tenants) if args.tenants else DEFAULT_TENANTS
         )
+    proc_kw = {}
+    if args.period is not None:
+        proc_kw["period"] = args.period
+    if args.ramp_steps:
+        proc_kw["ramp_steps"] = args.ramp_steps
     trace = make_trace(
         process=args.process, rate=args.rate, duration=args.duration,
-        tenants=tenants, vocab=args.vocab, seed=args.seed,
+        tenants=tenants, vocab=args.vocab, seed=args.seed, **proc_kw,
     )
-    out = trace_to_jsonable(trace) if args.dump else summarize(trace)
+    out = (trace_to_jsonable(trace) if args.dump
+           else summarize(trace, phases=args.phases))
     json.dump(out, sys.stdout, indent=2)
     print()
     return 0

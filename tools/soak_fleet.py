@@ -1,7 +1,15 @@
 #!/usr/bin/env python
 """Chaos soak for the serving FLEET: N clients against a router over
 real replica SUBPROCESSES, one of which is kill -9'd mid-stream, with
-a rolling bundle upgrade completing under the same traffic.
+the ELASTIC control loop cleaning up after it — the ``Autoscaler``
+reaps the corpse and boots a replacement in the same decision tick —
+and a CHECKPOINT-TRIGGERED rollover completing under the same
+traffic: a real parameter server's snapshot cadence publishes a
+serving bundle (``BundlePublisher``) that the ``ContinuousDeployer``
+rolls across the whole fleet from the autoscaler's own hold ticks.
+The trainer commits ZERO deltas, so the published bundle is
+byte-identical to the boot bundle (asserted) and every post-deploy
+output stays checkable against the same solo references.
 
 The acceptance bar it asserts (and prints as JSON):
 
@@ -30,6 +38,13 @@ The acceptance bar it asserts (and prints as JSON):
   ``router.eject`` event naming the ejected endpoint, and at least
   one must name the kill victim — the injected terminal failure is
   explainable from the bundle alone, asserted, not eyeballed.
+- REAP-AND-REPLACE BY THE CONTROL LOOP — no manual ``reap_dead``:
+  once the router has ejected the victim, the autoscaler's tick must
+  both reap it AND (``below_min``) boot a pre-warmed replacement, the
+  fleet returning to full strength under live chaotic traffic.
+- A CHECKPOINT-TRIGGERED FULL-FLEET ROLLOVER — the PS snapshot
+  cadence → publish → deploy chain replaces EVERY replica (the
+  replacement included), no request dropped, outputs still identical.
 
 Topology: replicas are REAL subprocesses (``--replica`` runs one)
 booted from a shared quantized serving bundle, each arming its OWN
@@ -68,8 +83,6 @@ def replica_main(args) -> int:
     ``stepper.step`` seam, print ``READY <port>``, serve until a
     ``stop`` verb (rollover) or a signal (the kill) ends us."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    import numpy as np
-
     from distkeras_tpu.faults import FaultPlan
     from distkeras_tpu.serving import ServingEngine, ServingServer
 
@@ -79,18 +92,13 @@ def replica_main(args) -> int:
         max_restarts=10_000, restart_backoff=0.01, quarantine_steps=8,
     )
     server = ServingServer(engine, retry_after_ms=20.0).start()
-    # warm every prefill bucket the soak's prompt lengths touch, so the
-    # first routed request is not a multi-second XLA compile
-    for n in (3, 5, 9, 13):
-        engine.generate(np.arange(1, n + 1, dtype=np.int32), 6)
-    # the chunk/admit buckets the serial warm above CANNOT cover (a
-    # chunk's bucket depends on how the budget splits across
-    # concurrent admissions) and the prefix-restore buckets (a
-    # repeated prompt's store hit mints the restore program —
-    # timing-dependent, exactly the class the compile ledger exists
-    # to flag), then arm storm detection: from here any serving-path
-    # mint of a NEW program is a storm, and the parent asserts zero
-    # across the fleet
+    # the full warm recipe (decode step, every prefill/admit chunk
+    # bucket, every prefix-restore bucket), then arm storm detection:
+    # from here any serving-path mint of a NEW program is a storm, and
+    # the parent asserts zero across the fleet. Same recipe a
+    # controller scale-up applies before rotation — the soak's boots
+    # (initial, autoscale replacement, rollover replacements) all pay
+    # it BEFORE printing READY, so no routed request ever compiles.
     engine._stepper.warmup()
     engine._stepper.warm_prefill_buckets()
     engine._stepper.warm_restore_buckets()
@@ -173,12 +181,23 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
     replica subprocess boots, not by waiting)."""
     import numpy as np
 
+    import jax
+
     from distkeras_tpu.faults import FaultPlan
     from distkeras_tpu.models import zoo
     from distkeras_tpu.networking import RetryPolicy
     from distkeras_tpu.ops.quantization import quantize_model
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
     from distkeras_tpu.predictors import CachedSequenceGenerator
-    from distkeras_tpu.serving import FleetController, ServingClient, ServingError
+    from distkeras_tpu.serving import (
+        AutoscalePolicy,
+        Autoscaler,
+        BundlePublisher,
+        ContinuousDeployer,
+        FleetController,
+        ServingClient,
+        ServingError,
+    )
     from distkeras_tpu.utils.serialization import (
         load_serving_bundle,
         save_serving_bundle,
@@ -194,7 +213,9 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
         seed=0,
     )
-    save_serving_bundle(bundle, quantize_model(model))
+    # quantize a COPY: `model` stays the float training master the
+    # parameter server below is seeded from (quantize_model mutates)
+    save_serving_bundle(bundle, quantize_model(model.copy()))
     # solo references decode the SAME bundle the replicas serve — the
     # quantized weights, reloaded off disk, are the identity baseline
     ref_model = load_serving_bundle(bundle)
@@ -227,6 +248,39 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
             postmortem_dir=pm_dir,
         ),
     ).start()
+
+    # training → serving: a REAL parameter server seeded with the same
+    # float params the boot bundle was quantized from. The soak's
+    # trainer commits ZERO deltas, so the checkpoint-cadence publish
+    # reproduces the boot bundle byte for byte (asserted below) — the
+    # whole publish → deploy chain is exercised under chaos while the
+    # solo references stay valid across the rollover.
+    publish_every = 3
+    ps = DeltaParameterServer(model.params)
+    zero_delta = jax.tree.map(np.zeros_like, model.params)
+
+    def build_bundle(center, meta, path):
+        m = model.copy()
+        m.params = center  # the float master at update N, republished
+        save_serving_bundle(path, quantize_model(m))
+
+    publisher = BundlePublisher(
+        ps, build_bundle, os.path.join(workdir, "bundles"),
+        every=publish_every,
+    )
+    deployer = ContinuousDeployer(ctl, publisher, timeout=300.0)
+    # min == max == fleet size: the loop's only growth row is
+    # below_min — replacing the kill -9 victim — and every quiet tick
+    # is a hold tick, where the deployer runs
+    scaler = Autoscaler(
+        ctl,
+        AutoscalePolicy(
+            min_replicas=replicas, max_replicas=replicas,
+            up_cooldown=0.0, down_cooldown=3600.0,
+        ),
+        interval=min(0.2, pace),
+        deployer=deployer,
+    )
 
     plan = (
         FaultPlan(seed=seed)
@@ -316,8 +370,15 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
                 check_trace(c)
 
     def control_loop():
-        """warm traffic → kill -9 a loaded replica → reap → rolling
-        upgrade of the survivors → tail traffic → stop."""
+        """warm traffic → kill -9 a loaded replica → router ejects it
+        → START the autoscaler (its tick reaps the corpse and boots a
+        pre-warmed replacement in the same decision cycle) → quiesce
+        traffic → trainer commits hit the checkpoint cadence → publish
+        → the deployer rolls the WHOLE fleet on a hold tick → stop.
+        The kill/replace race runs under live load (the chaos claim);
+        the rollover runs quiesced — on one core a replica boot under
+        client load takes ~6x longer, and the rollover's own
+        drain/join state machine is identical either way."""
         try:
             time.sleep(pace)
             victim = ctl.replicas[0]
@@ -338,9 +399,11 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
                 "in_flight_at_kill": loaded,
             }
             # let the ROUTER notice the death (mid-forward failover or
-            # failed polls -> ejection + post-mortem dump) before the
+            # failed polls -> ejection + post-mortem dump) before any
             # reap deregisters the endpoint — reaping first would
-            # remove the book entry the ejection path records against
+            # remove the book entry the ejection path records against.
+            # The autoscaler starts only after this, for the same
+            # reason: its every tick reaps.
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
                 states = {
@@ -353,10 +416,60 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
             summary["kill"]["ejected_before_reap"] = (
                 states.get(vep) == "ejected"
             )
-            ctl.reap_dead()
-            time.sleep(pace)
-            summary["rollover"] = ctl.rollover(timeout=300)
-            time.sleep(pace)
+            # from here the CONTROL LOOP owns repair: no manual
+            # reap_dead, no manual rollover
+            scaler.start()
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                if len(ctl.replicas) == replicas and all(
+                    r.alive() for r in ctl.replicas
+                ):
+                    break
+                time.sleep(0.05)
+            g = scaler._counters
+            summary["autoscale"] = {
+                "fleet_size_after_replace": len(ctl.replicas),
+                "reaps": g.get("reaps", 0) if g is not None else 0,
+                "scale_ups": (
+                    g.get("scale_ups", 0) if g is not None else 0
+                ),
+                "errors": g.get("errors", 0) if g is not None else 0,
+            }
+            time.sleep(pace)  # tail traffic over the replaced fleet
+            # quiesce before the rollover: clients stop issuing, the
+            # autoscaler keeps ticking (min == max → every tick holds,
+            # so the deployer still runs). In-flight requests drain
+            # through the rollover's own per-replica drain.
+            stop_evt.set()
+            # the trainer: zero-delta commits up to the checkpoint
+            # cadence — commit publish_every fires the snapshot
+            # listener, the publisher writes bundle_v3, and the next
+            # hold tick deploys it
+            for _ in range(publish_every):
+                ps.commit(zero_delta)
+            deadline = time.monotonic() + 300
+            while (time.monotonic() < deadline
+                   and scaler.last_deploy is None):
+                time.sleep(0.05)
+            dep = scaler.last_deploy
+            if dep is None:
+                raise RuntimeError(
+                    "checkpoint-triggered deploy never landed: "
+                    f"published={publisher.published} "
+                    f"publish_errors={publisher.publish_errors} "
+                    f"last_decision={scaler.last_decision}"
+                )
+            summary["rollover"] = dep["ledger"]
+            with open(dep["path"], "rb") as f_new:
+                new_bytes = f_new.read()
+            with open(bundle, "rb") as f_old:
+                identical = new_bytes == f_old.read()
+            summary["deploy"] = {
+                "version": dep["version"],
+                "published": publisher.published,
+                "publish_errors": publisher.publish_errors,
+                "bundle_identical_to_boot": identical,
+            }
         except Exception as e:  # noqa: BLE001 — surfaced in summary
             control_err.append(repr(e))
         finally:
@@ -420,6 +533,8 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         )
     finally:
         stop_evt.set()
+        scaler.shutdown()
+        publisher.close()
         ejections_final = (
             0 if ctl.router is None else ctl.router.stats()["ejections"]
         )
@@ -474,9 +589,21 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         and summary["trace_incomplete"] == 0
         and summary["trace_attempts"] > 0
         and not control_err
+        # the autoscaler repaired the kill: reaped the corpse AND
+        # booted a replacement, fleet back to full strength
+        and summary.get("autoscale", {}).get("reaps", 0) >= 1
+        and summary.get("autoscale", {}).get("scale_ups", 0) >= 1
+        and summary.get("autoscale", {}).get(
+            "fleet_size_after_replace"
+        ) == replicas
+        # the checkpoint-triggered deploy rolled the WHOLE fleet (the
+        # replacement included) to a bundle byte-identical to boot
         and len(summary.get("rollover", {}).get("replaced", ())) == (
-            replicas - 1  # the kill -9 victim is reaped, not upgraded
+            replicas
         )
+        and summary.get("deploy", {}).get(
+            "bundle_identical_to_boot"
+        ) is True
         and summary["completed"] > 0
         and summary["ejections"] >= 1
         and summary["postmortems"] == summary["ejections"]
