@@ -253,7 +253,8 @@ def _pct(per_repeat):
 def _engine(model, reqs, *, slots, prefill_chunk, prefix_cache,
             speculative=None, draft_k=4, flight_recorder=True,
             paged=False, page_size=16, num_pages=None, qos=None,
-            history=True, history_interval=1.0, slos=None):
+            history=True, history_interval=1.0, slos=None,
+            overlap=True):
     from distkeras_tpu.serving import ServingEngine
 
     return ServingEngine(
@@ -263,7 +264,7 @@ def _engine(model, reqs, *, slots, prefill_chunk, prefix_cache,
         flight_recorder=flight_recorder,
         paged=paged, page_size=page_size, num_pages=num_pages,
         qos=qos, history=history, history_interval=history_interval,
-        slos=slos,
+        slos=slos, overlap=overlap,
     ).start()
 
 
@@ -1044,11 +1045,16 @@ def _measure_sampling_block(model, reqs, refs, *, slots, chunk,
     }
 
 
-def _drive_trace(engine, trace, timeout=600.0):
+def _drive_trace(engine, trace, timeout=600.0, stream=False):
     """Submit a ``tools/loadgen.py`` trace on its arrival schedule —
     tenant and priority ride each submit — and wait for all. Returns
     ``(wall_seconds, decode_tokens, results, latencies)``; latencies
-    are per-event dicts with the event's tenant attached."""
+    are per-event dicts with the event's tenant attached. With
+    ``stream=True``, events carrying a truthy ``stream`` flag submit
+    as streaming requests and their retained chunk FIFOs are drained
+    post-completion and asserted to flatten to EXACTLY the decode
+    tail — the chunk-order identity pin, per drive (opt-in so the
+    QoS block's timings stay untouched)."""
     t0 = time.perf_counter()
     handles = []
     for ev in trace:
@@ -1058,9 +1064,24 @@ def _drive_trace(engine, trace, timeout=600.0):
         handles.append(engine.submit(
             ev["prompt"], ev["steps"], tenant=ev["tenant"],
             priority=ev["priority"],
+            stream=bool(stream and ev.get("stream")),
         ))
     results = [h.result(timeout) for h in handles]
     dt = time.perf_counter() - t0
+    if stream:
+        for h, ev, res in zip(handles, trace, results):
+            if not ev.get("stream"):
+                continue
+            toks = []
+            while True:  # FIFO retains everything; drain to sentinel
+                c = h.next_chunk(timeout=5.0)
+                if c is None:
+                    break
+                toks.extend(int(x) for x in c)
+            tail = [int(x) for x in res[len(ev["prompt"]):]]
+            assert toks == tail, (
+                f"streamed chunks flatten to {toks[:8]}..., decode "
+                f"tail is {tail[:8]}... — chunk order broke")
     toks = sum(ev["steps"] for ev in trace)
     lats = [
         {**h.latency(), "tenant": ev["tenant"]}
@@ -1282,6 +1303,245 @@ def _measure_qos_block(model, ref_gen, *, seq, vocab, slots, chunk,
             **({"hi_p99_speedup": sc["hi_p99_speedup"]}
                if name == "two_tenant_burst" else {}),
         }}), flush=True)
+    return block
+
+
+def _overlap_row(make_engine, drive, *, repeats, n, refs=None,
+                 pair_identity=False, extra_warm=None,
+                 record_preemptions=False):
+    """One overlapped-vs-sequential A/B row: the SAME engine config
+    built twice (``make_engine(overlap)``), INTERLEAVED timed passes
+    per the PERF.md protocol, outputs pinned every pass — to the solo
+    ``refs`` when greedy, or overlapped==sequential + replay-stable
+    across passes (``pair_identity``, the sampled row where no greedy
+    solo reference exists). Both loop modes stamp the same
+    ``OverlapLedger``, so the bubble fraction on each side is read
+    from ONE instrument: per-pass device/iteration-second deltas
+    summed over the timed window (warm drives excluded by
+    construction). Ledger-warmed after the warm drives; a mint inside
+    any timed pass is an assertion failure, not a footnote."""
+    sq = make_engine(False)
+    ov = make_engine(True)
+    sides = {"sq": sq, "ov": ov}
+    tps = {"sq": [], "ov": []}
+    dev = {"sq": 0.0, "ov": 0.0}
+    itw = {"sq": 0.0, "ov": 0.0}
+    preempts = {"sq": 0, "ov": 0}
+    last = {"sq": None, "ov": None}
+    timed_mints = 0
+    try:
+        for eng in (sq, ov):  # warm every program family per side
+            drive(eng)
+            drive(eng)
+            eng._stepper.warm_prefill_buckets()
+            if extra_warm is not None:
+                extra_warm(eng)
+            eng.compile_ledger.mark_warmed()
+        for _ in range(repeats):
+            for name in ("sq", "ov"):
+                eng = sides[name]
+                _reset(eng, None)
+                led = eng.batcher.overlap_ledger
+                m0 = eng.compile_ledger.total
+                dev0, it0 = led.device_seconds, led.iteration_seconds
+                d, t, res = drive(eng)
+                timed_mints += eng.compile_ledger.total - m0
+                dev[name] += led.device_seconds - dev0
+                itw[name] += led.iteration_seconds - it0
+                preempts[name] += eng.stats().get("preemptions", 0)
+                tps[name].append(t / d)
+                if refs is not None:
+                    for i, (a, r) in enumerate(zip(res, refs)):
+                        assert np.array_equal(a, r), (
+                            f"overlap A/B [{name}] req {i}: != solo")
+                if last[name] is not None:
+                    for a, b in zip(last[name], res):
+                        assert np.array_equal(a, b), (
+                            f"overlap A/B [{name}]: repeat drift")
+                last[name] = res
+            if pair_identity:
+                for i, (a, b) in enumerate(zip(last["sq"], last["ov"])):
+                    assert np.array_equal(a, b), (
+                        f"overlap A/B req {i}: overlapped != sequential")
+        assert timed_mints == 0, (
+            f"{timed_mints} XLA mints landed inside timed passes "
+            f"(ledger: {ov.compile_ledger.snapshot()} / "
+            f"{sq.compile_ledger.snapshot()})"
+        )
+        storms = sq.compile_ledger.storms + ov.compile_ledger.storms
+    finally:
+        sq.stop()
+        ov.stop()
+    bf = {
+        name: (1.0 - dev[name] / itw[name]) if itw[name] > 0 else None
+        for name in ("sq", "ov")
+    }
+    row = {
+        "num_requests": n,
+        "sequential_tokens_per_sec": round(
+            float(np.median(tps["sq"])), 1),
+        "sequential_spread": [
+            round(min(tps["sq"]), 1), round(max(tps["sq"]), 1)],
+        "overlapped_tokens_per_sec": round(
+            float(np.median(tps["ov"])), 1),
+        "overlapped_spread": [
+            round(min(tps["ov"]), 1), round(max(tps["ov"]), 1)],
+        "tokens_per_sec_ratio": _ratio(
+            float(np.median(tps["ov"])), float(np.median(tps["sq"]))),
+        "sequential_bubble_fraction": round(bf["sq"], 4),
+        "overlapped_bubble_fraction": round(bf["ov"], 4),
+        "bubble_reduction": round(bf["sq"] - bf["ov"], 4),
+        "timed_pass_compiles": int(timed_mints),
+        "compile_storms": int(storms),
+        "outputs_identical": True,
+    }
+    if record_preemptions:
+        row["preemptions"] = {
+            "sequential": preempts["sq"], "overlapped": preempts["ov"]
+        }
+    return row
+
+
+def _measure_overlap_block(model, ref_gen, *, seq, vocab, slots, chunk,
+                           requests, repeats, rng):
+    """Zero-bubble decode: the overlapped scheduler loop (host
+    admission/emission for iteration N+1 under iteration N's device
+    step) vs the sequential control, same engine config otherwise.
+    Four traffic shapes:
+
+    - ``decode_heavy`` is the claimed win — long decode runs and a
+      streamed tenant, the regime where per-iteration host work is a
+      fixed tax the overlap can hide;
+    - ``short_uniform`` is the honest adversarial row: short uniform
+      bursts are host-work-LIGHT (admission once, then tight decode),
+      so there is little bubble to reclaim — committed as measured;
+    - ``sampled`` re-proves identity where no greedy solo reference
+      exists: overlapped == sequential per pass AND seeded replay
+      stable across passes;
+    - ``preempt`` pins the deferred-preemption path: a paged + QoS
+      engine under a two-tenant burst, identity asserted ACROSS the
+      preempt/resume boundary on both sides, per-side preemption
+      counts committed (the committed overlapped side must actually
+      have preempted — check_bench gates it).
+
+    Every pass is identity-asserted, zero compiles inside timed
+    windows, and the bubble reduction on decode_heavy carries a
+    committed floor in ``check_bench --kind overlap``."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"
+    ))
+    try:
+        import loadgen
+    finally:
+        _sys.path.pop(0)
+    from distkeras_tpu.serving import QosPolicy, SamplingParams
+
+    block = {"rows": {}}
+
+    # -- decode_heavy: the claimed win, streamed tenant riding along --
+    trace = loadgen.make_trace(
+        process="poisson", rate=max(50.0, 12000.0 / seq),
+        n=3 * requests, tenants=loadgen.decode_heavy_tenants(seq),
+        vocab=vocab, seed=11,
+    )
+    assert any(ev.get("stream") for ev in trace), (
+        "decode_heavy trace drew no streamed events — pick a seed "
+        "that exercises the stream-push ordering")
+    refs = _solo_refs(
+        ref_gen, [(ev["prompt"], ev["steps"]) for ev in trace]
+    )
+    row = _overlap_row(
+        lambda overlap: _engine(
+            model, trace, slots=slots, prefill_chunk=chunk,
+            prefix_cache=False, overlap=overlap),
+        lambda eng: _drive_trace(eng, trace, stream=True)[:3],
+        repeats=repeats, n=len(trace), refs=refs,
+    )
+    row["streamed_requests"] = sum(
+        bool(ev.get("stream")) for ev in trace
+    )
+    row["trace"] = {
+        "process": "poisson",
+        "rate": max(50.0, 12000.0 / seq),
+        "summary": loadgen.summarize(trace),
+    }
+    block["rows"]["decode_heavy"] = row
+
+    # -- short_uniform: host-work-light, the adversarial row ----------
+    reqs = _make_short_uniform(requests, seq, vocab, rng)
+    block["rows"]["short_uniform"] = _overlap_row(
+        lambda overlap: _engine(
+            model, reqs, slots=slots, prefill_chunk=chunk,
+            prefix_cache=False, overlap=overlap),
+        lambda eng: _drive(eng, reqs)[:3],
+        repeats=repeats, n=len(reqs),
+        refs=_solo_refs(ref_gen, reqs),
+    )
+
+    # -- sampled: identity without a greedy solo reference ------------
+    sreqs = _make_mixed_long(requests, seq, vocab, rng)
+    sampling = [
+        SamplingParams(temperature=0.7, top_p=0.9, seed=2000 + i)
+        for i in range(len(sreqs))
+    ]
+    block["rows"]["sampled"] = _overlap_row(
+        lambda overlap: _engine(
+            model, sreqs, slots=slots, prefill_chunk=chunk,
+            prefix_cache=False, overlap=overlap),
+        lambda eng: _drive(eng, sreqs, sampling=sampling)[:3],
+        repeats=repeats, n=len(sreqs), pair_identity=True,
+    )
+
+    # -- preempt: deferred preemption under a paged + QoS burst -------
+    page_size = 16
+    paged_slots = 2 * slots
+    num_pages = slots * (-(-seq // page_size)) + 1  # dense-equal pool
+    policy = QosPolicy(preempt=True, max_preemptions=2)
+    batch = {
+        "name": "batch", "weight": 0.8, "priority": 0,
+        "prompt_len": (seq // 3, seq // 2 + 1),
+        "steps": (max(2, seq // 6), max(3, seq // 3)),
+    }
+    interactive = {
+        "name": "interactive", "weight": 0.2, "priority": 2,
+        "prompt_len": (4, max(5, seq // 8)),
+        "steps": (max(2, seq // 16), max(3, seq // 8)),
+    }
+    burst_rate = max(60.0, 16000.0 / seq)
+    ptrace = loadgen.make_trace(
+        process="bursty", rate=burst_rate, n=3 * requests,
+        tenants=[batch, interactive], vocab=vocab, seed=13,
+        burst_factor=8.0, period=1.0, duty=0.4,
+    )
+    block["rows"]["preempt"] = _overlap_row(
+        lambda overlap: _engine(
+            model, ptrace, slots=paged_slots, prefill_chunk=chunk,
+            prefix_cache=False, paged=True, page_size=page_size,
+            num_pages=num_pages, qos=policy, overlap=overlap),
+        lambda eng: _drive_trace(eng, ptrace)[:3],
+        repeats=repeats, n=len(ptrace),
+        refs=_solo_refs(
+            ref_gen, [(ev["prompt"], ev["steps"]) for ev in ptrace]
+        ),
+        extra_warm=lambda eng: eng._stepper.warm_restore_buckets(),
+        record_preemptions=True,
+    )
+
+    for name, row in block["rows"].items():
+        print(json.dumps({f"overlap_{name}": {
+            "tokens_per_sec_ratio": row["tokens_per_sec_ratio"],
+            "bubble_reduction": row["bubble_reduction"],
+        }}), flush=True)
+    block["timed_pass_compiles"] = sum(
+        r["timed_pass_compiles"] for r in block["rows"].values()
+    )
+    block["compile_storms"] = sum(
+        r["compile_storms"] for r in block["rows"].values()
+    )
+    block["outputs_identical"] = True
     return block
 
 
@@ -1612,6 +1872,13 @@ def main() -> None:
                          "vs QoS under a two-tenant burst + the "
                          "swap-thrash adversarial row) and merge it "
                          "into the existing BENCH_SERVING.json")
+    ap.add_argument("--overlap-only", action="store_true",
+                    help="run ONLY the zero-bubble decode block "
+                         "(overlapped vs sequential scheduler loop "
+                         "across decode-heavy / short-uniform / "
+                         "sampled / preempt traffic, every pass "
+                         "identity-asserted) and merge it into the "
+                         "existing BENCH_SERVING.json")
     ap.add_argument("--disagg-only", action="store_true",
                     help="run ONLY the disaggregated prefill/decode "
                          "block (1 prefill + 1 decode worker vs 2 "
@@ -1703,6 +1970,27 @@ def main() -> None:
         print(json.dumps({"paged": {
             n: w["tokens_per_sec_ratio"]
             for n, w in record["paged"]["workloads"].items()
+        }}))
+        return
+
+    if args.overlap_only:
+        # merge-mode sibling of --qos-only: measure just the
+        # zero-bubble decode block into the committed record
+        with open("BENCH_SERVING.json") as f:
+            record = json.load(f)
+        record["overlap"] = _measure_overlap_block(
+            model, ref_gen, seq=seq, vocab=vocab, slots=args.slots,
+            chunk=chunk, requests=args.requests, repeats=args.repeats,
+            rng=np.random.default_rng(170),
+        )
+        with open("BENCH_SERVING.json", "w") as f:
+            json.dump(record, f, indent=2)
+        print(json.dumps({"overlap": {
+            n: {
+                "tokens_per_sec_ratio": r["tokens_per_sec_ratio"],
+                "bubble_reduction": r["bubble_reduction"],
+            }
+            for n, r in record["overlap"]["rows"].items()
         }}))
         return
 
@@ -1937,6 +2225,19 @@ def main() -> None:
         "history_vs_off": record["obs"]["history_vs_off"],
         "timed_pass_compiles": record["obs"]["timed_pass_compiles"],
     }}), flush=True)
+
+    # -- zero-bubble decode A/B (overlapped vs sequential loop) -------------
+    # dedicated rng: the downstream blocks (paged, sampling, qos, ...)
+    # replay the SAME shared-stream draws their committed numbers were
+    # measured with — consuming from ``rng`` here would silently deal
+    # every later workload a different hand; the fixed seed also makes
+    # the overlap workloads identical between --overlap-only and the
+    # full run
+    record["overlap"] = _measure_overlap_block(
+        model, ref_gen, seq=seq, vocab=vocab, slots=args.slots,
+        chunk=chunk, requests=args.requests, repeats=args.repeats,
+        rng=np.random.default_rng(170),
+    )
 
     # -- paged-vs-dense KV cache A/B (equal byte budget) --------------------
     record["paged"] = _measure_paged_block(

@@ -39,9 +39,16 @@ servers):
   compile-STORM detection (a post-warmup serving-path mint of a
   never-seen program trips an ``xla.compile.storm`` event + gauge)
   and per-request ``xla.compile`` trace spans.
+- ``overlap``: :class:`OverlapLedger` — per-scheduler-iteration
+  dispatch/ready/collect stamps turning the decode loop's host bubble
+  (iteration wall minus device wall) into the
+  ``serving_step_bubble_seconds`` histogram and the
+  ``serving_overlap_efficiency`` gauge, the committed zero-bubble
+  numbers ``bench_serving.py`` and ``dkt_top`` read.
 """
 
 from distkeras_tpu.obs.compile_ledger import CompileLedger
+from distkeras_tpu.obs.overlap import OverlapLedger
 from distkeras_tpu.obs.recorder import (
     POSTMORTEM_SCHEMA,
     FlightRecorder,
@@ -98,6 +105,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "OverlapLedger",
     "SloEvaluator",
     "SloSpec",
     "Span",

@@ -257,13 +257,15 @@ class SloEvaluator:
 def default_serving_slos(latency_p99_s=None, ttft_p99_s=None,
                          error_rate=None, acceptance_rate=None,
                          min_count=20,
-                         tenant_latency_p99_s=None) -> list[SloSpec]:
+                         tenant_latency_p99_s=None,
+                         overlap_efficiency_min=None) -> list[SloSpec]:
     """The serving-tier spec set, opt-in per knob (None = not
     enforced): end-to-end p99 latency, TTFT p99, typed-internal error
     rate (internal errors / submitted — the denominator includes
     rejected and in-flight requests, so set the ceiling against total
-    offered load), and the speculative acceptance floor (mean tokens
-    per verify window).
+    offered load), the speculative acceptance floor (mean tokens per
+    verify window), and the overlap-efficiency floor (device-wall /
+    iteration-wall from the zero-bubble decode ledger).
 
     ``tenant_latency_p99_s``: tenant name -> p99 bound (seconds) —
     one spec per tenant over that tenant's LABELED latency histogram
@@ -299,6 +301,14 @@ def default_serving_slos(latency_p99_s=None, ttft_p99_s=None,
             acceptance_rate, agg="rate",
             per="serving_scheduler_spec_windows", bound="min",
             min_count=min_count,
+        ))
+    if overlap_efficiency_min is not None:
+        # the zero-bubble floor: cumulative device-wall / iteration-
+        # wall from the overlap ledger (gauge is None before the
+        # first completed iteration — not judgeable, not a breach)
+        specs.append(SloSpec(
+            "overlap_efficiency", "serving_overlap_efficiency",
+            overlap_efficiency_min, agg="value", bound="min",
         ))
     return specs
 

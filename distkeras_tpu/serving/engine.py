@@ -383,6 +383,63 @@ class ModelDrafter:
         self._st._lens[act] = lens0[act] + counts[act]
 
 
+class _InflightStep:
+    """One dispatched-but-uncollected decode step (the zero-bubble
+    handle): holds the stepper, the active mask the step was issued
+    with, and the UN-MATERIALIZED device token array. ``ready()`` is a
+    non-blocking poll; ``collect()`` is the single host sync point —
+    it fetches the tokens AND applies the host bookkeeping a
+    successful step implies (length/RNG-position advance, grammar
+    cursors), so nothing advances until the step is known good.
+    Single-consumer, collect-once (the scheduler thread)."""
+
+    __slots__ = ("_stepper", "active", "_toks")
+
+    def __init__(self, stepper, active, toks):
+        self._stepper = stepper
+        self.active = active
+        self._toks = toks
+
+    def ready(self) -> bool:
+        """True when the device result is available (collect would not
+        block). Best-effort: backends/arrays without a readiness probe
+        report True — the overlap ledger then measures the blocking
+        collect honestly instead of guessing."""
+        if self._toks is None:
+            return True
+        is_ready = getattr(self._toks, "is_ready", None)
+        if is_ready is None:
+            return True  # already host-side (numpy fallback paths)
+        try:
+            return bool(is_ready())
+        except Exception:  # noqa: BLE001 — a poll must never crash
+            return True
+
+    def collect(self) -> np.ndarray:
+        """Materialize the step's tokens (THE host sync point) and
+        advance the host bookkeeping. Raises whatever the device call
+        deferred; in that case nothing has advanced — the same "a
+        failed call advanced nothing" contract the blame probes rely
+        on."""
+        if self._toks is None:
+            raise RuntimeError("decode step already collected")
+        st, active = self._stepper, self.active
+        toks = np.asarray(self._toks)  # the one device->host fetch
+        self._toks = None
+        st._lens[active] = np.minimum(
+            st._lens[active] + 1, st._lens_cap
+        )
+        # the RNG counter mirrors the length discipline exactly: a
+        # failed call advanced nothing, a successful one advanced each
+        # active slot once — replay through blame probes is this line
+        st._spos[active] += 1
+        if st._grammar:
+            st._advance_grammar(
+                toks.reshape(-1, 1), np.where(active, 1, 0)
+            )
+        return toks
+
+
 class DecodeStepper:
     """Slot-bank decode over a causal-LM-family model.
 
@@ -2437,7 +2494,23 @@ class DecodeStepper:
         """Advance every active slot one token; returns the (B,) tokens
         appended this step (entries for inactive slots are meaningless).
         One compiled call plus one small host fetch per step — the
-        iteration-level scheduling loop the batcher drives."""
+        iteration-level scheduling loop the batcher drives. Dispatch +
+        immediate collect of :meth:`step_async`, so the sequential
+        control path and the overlapped loop run the SAME program with
+        the same host bookkeeping, in the same order."""
+        return self.step_async(active).collect()
+
+    def step_async(self, active) -> "_InflightStep":
+        """Dispatch one decode step WITHOUT materializing its result:
+        the jitted call returns device futures, ``self._ctx`` and the
+        KV state take them immediately (later admissions/prefills chain
+        on the step through the donation arguments — no explicit sync
+        needed), and the un-fetched token array rides the returned
+        :class:`_InflightStep`. The host bookkeeping a successful step
+        implies (``_lens``/``_spos`` advance, grammar cursors) is
+        DEFERRED to ``collect()`` so a failed call still advances
+        nothing — the blame-retry discipline is unchanged, it just
+        surfaces at the collect of the step's own iteration."""
         active = np.asarray(active, bool)
         # the injection seam fires BEFORE any device work or host
         # bookkeeping: a failed step leaves the slot bank exactly as it
@@ -2472,19 +2545,7 @@ class DecodeStepper:
                     self._params, self._ctx, self._caches,
                     self._lens.copy(), active, *sargs, *extra,
                 )
-        toks = np.asarray(toks)
-        self._lens[active] = np.minimum(
-            self._lens[active] + 1, self._lens_cap
-        )
-        # the RNG counter mirrors the length discipline exactly: a
-        # failed call advanced nothing, a successful one advanced each
-        # active slot once — replay through blame probes is this line
-        self._spos[active] += 1
-        if self._grammar:
-            self._advance_grammar(
-                toks.reshape(-1, 1), np.where(active, 1, 0)
-            )
-        return toks
+        return _InflightStep(self, active, toks)
 
     def _build_step_fn(self, masked=False):
         """Compiled dense decode step. Sampling params are DATA (per-
@@ -2882,7 +2943,7 @@ class ServingEngine:
                  slos=None, slo_interval=5.0, paged=False,
                  page_size=16, num_pages=None, qos=None, mesh=None,
                  role="unified", history=True, history_interval=1.0,
-                 history_capacity=600, trace_ring=8192):
+                 history_capacity=600, trace_ring=8192, overlap=True):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -2983,7 +3044,22 @@ class ServingEngine:
         the same config. Mesh geometry rides ``health()`` (``mesh``,
         ``kv_shard_bytes``) and the ``serving_mesh_devices`` /
         ``serving_kv_shard_bytes`` gauges, so the fleet router and
-        the autoscaler can see per-replica geometry."""
+        the autoscaler can see per-replica geometry.
+
+        Loop-structure knob: ``overlap`` (True — the default) runs the
+        scheduler's ZERO-BUBBLE loop: the compiled decode step for
+        iteration N is dispatched asynchronously and iteration N+1's
+        host work (admission, chunked prefill, stream pushes, deadline
+        sweeps) executes while the device runs, with the host
+        synchronizing on N's tokens only at emission time. Emitted
+        token ORDER is unchanged — the overlap moves wall-clock, not
+        semantics — and a step that fails surfaces at the collect of
+        its own iteration with blame/quarantine behavior identical to
+        the sequential loop. ``overlap=False`` is the bit-identical
+        sequential control (the bench A/B's baseline side). The bubble
+        is measured either way: ``serving_step_bubble_seconds`` /
+        ``serving_overlap_efficiency`` in the registry and an
+        ``overlap`` block on ``health()``."""
         from distkeras_tpu.obs import MetricsRegistry
 
         self.model = model
@@ -3129,7 +3205,7 @@ class ServingEngine:
         self._batcher_cfg = dict(
             queue_capacity=queue_capacity, prefill_chunk=prefill_chunk,
             quarantine_steps=quarantine_steps, registry=self.registry,
-            recorder=self.recorder, qos=qos,
+            recorder=self.recorder, qos=qos, overlap=overlap,
         )
         self.qos = qos
         self.batcher = (
@@ -4196,6 +4272,14 @@ class ServingEngine:
             out["queue_depth_trend"] = self.history.trend(
                 "serving_scheduler_queue_depth", window=60.0
             )
+        if batcher is not None:
+            # the zero-bubble ledger: how much of decode wall-clock the
+            # device actually computed (overlap mode or the sequential
+            # control — the instrument reads the same either way)
+            out["overlap"] = {
+                "enabled": batcher.overlap,
+                **batcher.overlap_ledger.snapshot(),
+            }
         out["heartbeat_age"] = (
             None
             if batcher is None or not self._started

@@ -28,6 +28,19 @@ pure-Python fake in the unit tests) with::
     release(slot)          # slot freed (bookkeeping hook)
     step(active) -> (num_slots,) int array, the token appended per slot
 
+A stepper MAY additionally expose ``step_async(active)`` returning a
+handle with ``ready() -> bool`` and ``collect() -> tokens``: with
+``overlap=True`` the batcher then dispatches iteration N's device
+step and runs iteration N+1's host work (admission, emission,
+deferred preemption) UNDER it, syncing on N's tokens only at the
+next call's collect — the zero-bubble loop. Steppers without the
+async face still work under ``overlap=True`` (the device call runs
+synchronously at dispatch; the loop shape and outputs are
+unchanged), and ``overlap=False`` keeps the strict one-call-emits
+sequential control. Both modes stamp the same ``OverlapLedger``
+(``serving_step_bubble_seconds`` / ``serving_overlap_efficiency``),
+so the bubble is one instrument read either way.
+
 Speculative steppers additionally expose ``speculative`` (truthy),
 ``wants_sequences`` (the batcher then passes each active slot's host
 sequence so far), and ``spec_step(active, seqs) -> (toks, counts,
@@ -62,6 +75,36 @@ import numpy as np
 
 
 _NO_EVICT = object()  # "no eviction pending" sentinel (step loop)
+
+
+class _Inflight:
+    """One dispatched-but-uncollected device step, scheduler-side: the
+    active mask / sequences it was issued against, the wall/mint
+    stamps its collect needs for attribution, and exactly one of — an
+    engine ``step_async`` handle (async dispatch), a held synchronous
+    result tuple (steppers without an async face: speculative
+    drafters materialize host-side mid-call, unit-test fakes), or a
+    stashed dispatch exception (a failure at dispatch surfaces at the
+    COLLECT of this step's own iteration, where the blame machinery
+    runs)."""
+
+    __slots__ = (
+        "active", "seqs", "t0", "mints0", "handle", "result", "exc",
+    )
+
+    def __init__(self, active, seqs, t0, mints0):
+        self.active = active
+        self.seqs = seqs
+        self.t0 = t0
+        self.mints0 = mints0
+        self.handle = None
+        self.result = None
+        self.exc = None
+
+    def ready(self) -> bool:
+        if self.handle is not None:
+            return self.handle.ready()
+        return True  # held result / stashed exception: nothing to wait on
 
 
 class ServingError(RuntimeError):
@@ -341,7 +384,7 @@ class ContinuousBatcher:
 
     def __init__(self, stepper, queue_capacity=64, prefill_chunk=None,
                  quarantine_steps=64, registry=None, recorder=None,
-                 qos=None):
+                 qos=None, overlap=False):
         """``quarantine_steps``: scheduler iterations a slot sits out
         after a device step is blamed on its request (its cache rows are
         suspect, and a systematically poisonous traffic shape should not
@@ -371,7 +414,24 @@ class ContinuousBatcher:
         the front of its class with the swap state riding the request;
         resume is ``swap_in`` (restore + re-reserve), token-identical
         across the boundary. ``max_preemptions`` bounds displacement
-        per request so nothing livelocks."""
+        per request so nothing livelocks.
+
+        ``overlap``: True runs the ZERO-BUBBLE loop — each ``step()``
+        call first does the host scheduling work (admission, chunked
+        prefill, exports, forks, deadline sweeps) while the PREVIOUS
+        iteration's device step runs, then collects that step's tokens
+        (emission/eviction — the only host sync point), then dispatches
+        the next step asynchronously. Token order per request is
+        UNCHANGED; a step that fails surfaces at the collect of its own
+        iteration with the same blame/quarantine semantics. False (the
+        default here; the ``ServingEngine`` defaults to True) is the
+        strictly sequential dispatch-and-wait loop — the bit-identical
+        control side of the bench A/B, and what raw-batcher unit tests
+        drive so one ``step()`` call emits its own tokens. Steppers
+        without a ``step_async`` face (fakes, speculative draft/verify
+        — the drafter materializes host state mid-call) run their
+        device call synchronously at dispatch; the loop structure and
+        failure surfacing stay identical."""
         from distkeras_tpu.serving.qos import _QosQueues
 
         self.stepper = stepper
@@ -417,14 +477,23 @@ class ContinuousBatcher:
         self._admit_order = [0] * stepper.num_slots
         self._quarantined: dict[int, int] = {}
         self._sched_iters = 0  # step() calls (not device steps)
+        # zero-bubble decode: the dispatched-but-uncollected step (at
+        # most one — the loop collects before it dispatches again).
+        # Only the scheduler thread touches it outside stop().
+        self.overlap = bool(overlap)
+        self._inflight: _Inflight | None = None
         self._lock = threading.Lock()
         self._work = threading.Event()  # signals the engine loop
         self._draining = False
         self._stopped = False
         self.recorder = recorder
-        from distkeras_tpu.obs import MetricsRegistry
+        from distkeras_tpu.obs import MetricsRegistry, OverlapLedger
 
         self.registry = registry if registry is not None else MetricsRegistry()
+        # the bubble instrument (serving_step_bubble_seconds /
+        # serving_overlap_efficiency) — stamped by BOTH loop modes, so
+        # the overlapped-vs-sequential A/B reads the same meter
+        self.overlap_ledger = OverlapLedger(self.registry)
         # the old hand-rolled counter dict, now a CounterGroup over
         # typed registry counters (``serving_scheduler_<key>``): every
         # ``counters["key"] += 1`` call site, test, and bench counter
@@ -649,7 +718,180 @@ class ContinuousBatcher:
         advance every DECODING slot one token (with blame assignment on
         a step failure — see ``_step_with_blame``), evict finished
         sequences. Returns True when any slot made progress (the engine
-        loop idles when False)."""
+        loop idles when False).
+
+        Two loop shapes, one contract: sequential mode runs host-work
+        -> dispatch+wait -> emit in one pass; overlapped mode
+        (``overlap=True``) runs host-work (the PREVIOUS step still on
+        the device) -> collect+emit that step -> preemption -> dispatch
+        the next step and return without waiting on it. Emitted token
+        order per request is identical — only where the wall-clock goes
+        differs."""
+        if self.overlap:
+            return self._step_overlapped()
+        return self._step_sequential()
+
+    def _step_sequential(self) -> bool:
+        """The strictly sequential iteration (the pre-overlap loop,
+        kept verbatim as the bit-identical control side of the
+        overlap bench A/B): every phase waits for the previous one,
+        so the device idles through all the host work and vice
+        versa — the bubble the ledger measures."""
+        progressed, _ = self._admit_phase(preempt_now=True)
+        active, seqs = self._mask_phase()
+        if not active.any():
+            return progressed
+        step_t0 = time.monotonic()
+        mints0 = self._led_total()
+        self.overlap_ledger.note_dispatch()
+        toks, counts, blamed, used_verify = self._step_with_blame(
+            active, seqs
+        )
+        self.overlap_ledger.note_collect()
+        return self._finish_step(
+            active, step_t0, mints0, toks, counts, blamed, used_verify
+        )
+
+    def _step_overlapped(self) -> bool:
+        """The zero-bubble iteration: iteration N+1's host scheduling
+        work executes while step N runs on the device; the host syncs
+        on N's tokens at the last moment it needs them (emission /
+        eviction), then dispatches N+1 and returns.
+
+        Why this is loop structure, not semantics:
+
+        - Admission / chunked-prefill / export device calls CHAIN
+          behind the in-flight step through its un-materialized
+          arrays and touch only slots the in-flight mask excludes —
+          per-slot device state is disjoint, so the collected tokens
+          are unaffected.
+        - Slots freed by this call's collect admit on the NEXT call
+          (one device-step later than the sequential loop under slot
+          contention); each request's own token stream is unchanged.
+        - QoS preemption picks its victim AFTER collect — swapping a
+          slot out from under an in-flight step would fetch post-step
+          KV against pre-step host token lists.
+        - A step that raises (at dispatch or inside the device call)
+          surfaces at the COLLECT of its own iteration, where the
+          blame probes run synchronously against unadvanced state —
+          identical containment to the sequential loop.
+        """
+        inflight = self._inflight
+        if inflight is not None and inflight.ready():
+            # opportunistic poll: the device finished while the host
+            # was away — stamp it so the ledger's device wall is
+            # measured, not inferred from the blocking collect
+            self.overlap_ledger.note_ready()
+        progressed, blocked = self._admit_phase(preempt_now=False)
+        if inflight is not None:
+            self._inflight = None
+            if inflight.ready():
+                self.overlap_ledger.note_ready()
+            toks, counts, blamed, used_verify = (
+                self._collect_with_blame(inflight)
+            )
+            self.overlap_ledger.note_collect()
+            self._finish_step(
+                inflight.active, inflight.t0, inflight.mints0,
+                toks, counts, blamed, used_verify,
+            )
+            progressed = True
+        if blocked is not None and self._preempt_phase(blocked):
+            progressed = True
+        active, seqs = self._mask_phase()
+        if not active.any():
+            return progressed
+        t0 = time.monotonic()
+        mints0 = self._led_total()
+        self.overlap_ledger.note_dispatch()
+        self._inflight = self._dispatch(active, seqs, t0, mints0)
+        return True
+
+    def _dispatch(self, active, seqs, t0, mints0) -> _Inflight:
+        """Issue the device step for ``active`` without waiting on it.
+        Async when the stepper exposes ``step_async`` and is not
+        speculative (the draft->verify path materializes host state
+        mid-call); otherwise the device call runs synchronously HERE
+        and its result — or exception — rides the handle to this
+        iteration's collect, so loop structure and failure surfacing
+        are stepper-independent."""
+        inf = _Inflight(active, seqs, t0, mints0)
+        st = self.stepper
+        try:
+            if (
+                not getattr(st, "speculative", False)
+                and hasattr(st, "step_async")
+            ):
+                inf.handle = st.step_async(active)
+            else:
+                inf.result = self._device_step(active, seqs)
+        except Exception as e:  # noqa: BLE001 — device crash boundary
+            inf.exc = e
+        return inf
+
+    def _collect_with_blame(self, inf: _Inflight):
+        """The overlapped loop's sync point: materialize the in-flight
+        step's tokens (or re-raise its deferred failure) and assign
+        blame exactly like ``_step_with_blame`` — a failed call
+        advanced nothing, so the synchronous probes retry from the
+        same state the failed dispatch saw. Returns ``(toks, counts,
+        blamed, used_verify)`` in the variable-advance shape."""
+        active = inf.active
+        try:
+            if inf.exc is not None:
+                raise inf.exc
+            if inf.handle is not None:
+                # collect() already materialized host-side — take the
+                # array as-is into the (B, 1) shape the emit path wants
+                toks = inf.handle.collect()
+                return (
+                    toks.reshape(-1, 1),
+                    np.where(active, 1, 0).astype(np.int64),
+                    [],
+                    np.zeros(len(active), bool),
+                )
+            toks, counts, used = inf.result
+            return toks, counts, [], used
+        except Exception:  # noqa: BLE001 — device crash boundary
+            with self._lock:
+                self.counters["step_failures"] += 1
+        return self._assign_blame(active, inf.seqs)
+
+    def _preempt_phase(self, blocked) -> bool:
+        """The overlapped loop's deferred preemption: decided AFTER
+        collect (nothing in flight), re-validated against post-collect
+        state — an eviction that just freed the capacity the blocked
+        request needs makes displacement unnecessary (admission places
+        it next call), where the sequential loop would have preempted
+        on its earlier, pre-step view."""
+        if not self._preemptible:
+            return False
+        with self._lock:
+            free = sum(
+                s is None and i not in self._quarantined
+                for i, s in enumerate(self._slots)
+            )
+            fits = free >= blocked.n and (
+                not getattr(self.stepper, "paged", False)
+                or self._pages_for_request(blocked)
+                <= self.stepper.available_pages
+            )
+            preempt = (
+                None if fits else self._pick_victim_locked(blocked)
+            )
+        if preempt is None:
+            return False
+        return self._preempt(*preempt)
+
+    def _admit_phase(self, preempt_now: bool):
+        """Host scheduling work at the top of an iteration: quarantine
+        recycle, admission of queued requests into free slots (page-
+        gated when paged), swap-in resumes, the chunked-prefill
+        budget, prefill-only exports, completion-group forks. Returns
+        ``(progressed, blocked)`` — ``blocked`` is the head-of-line
+        request admission could not place (the preemption candidate).
+        ``preempt_now``: the sequential loop preempts here; the
+        overlapped loop defers to ``_preempt_phase`` after collect."""
         now = time.monotonic()
         admitted = []
         paged = getattr(self.stepper, "paged", False)
@@ -704,7 +946,10 @@ class ContinuousBatcher:
                     if j > 0:
                         self._awaiting_fork[s] = j
                 admitted.append((group[0], req))
-            if blocked is not None and self._preemptible:
+            if (
+                blocked is not None and self._preemptible
+                and preempt_now
+            ):
                 # a higher-priority arrival blocked on capacity may
                 # displace the lowest-priority decodable slot — picked
                 # under the lock, swapped outside it (device fetch)
@@ -749,6 +994,13 @@ class ContinuousBatcher:
         progressed = self._spend_prefill_budget() or preempted
         progressed = self._export_prefilled() or progressed
         progressed = self._fork_completions() or progressed
+        return progressed, blocked
+
+    def _mask_phase(self):
+        """Deadline-sweep slots that produce no tokens (mid-prefill,
+        awaiting-fork) and compute the decode active mask + optional
+        per-slot host sequences. Runs immediately before dispatch in
+        both loop modes."""
         now = time.monotonic()
         with self._lock:
             # deadline sweep for slots still mid-prefill AND groups
@@ -803,13 +1055,16 @@ class ContinuousBatcher:
                     else None
                     for i, req in enumerate(self._slots)
                 ]
-        if not active.any():
-            return progressed
-        step_t0 = time.monotonic()
-        mints0 = self._led_total()
-        toks, counts, blamed, used_verify = self._step_with_blame(
-            active, seqs
-        )
+        return active, seqs
+
+    def _finish_step(self, active, step_t0, mints0, toks, counts,
+                     blamed, used_verify) -> bool:
+        """Emission/eviction for one collected device step (the former
+        tail of the monolithic ``step``): decode-phase mint
+        attribution, blame eviction + quarantine, per-token budget /
+        EOS / deadline checks in emission order, stream pushes (before
+        any eviction they trigger), WFQ charging, speculative
+        acceptance counters, and the recorder's iteration line."""
         now = time.monotonic()
         if self._led_total() > mints0:
             # a mint landed inside the decode phase: every traced
@@ -1143,7 +1398,11 @@ class ContinuousBatcher:
                 np.asarray(counts),
                 np.asarray(active, bool) & bool(used),
             )
-        toks = np.asarray(st.step(active))
+        toks = st.step(active)
+        if not isinstance(toks, np.ndarray):
+            # real steppers collect() host-side already; only fakes
+            # handing back lists/device arrays need the copy
+            toks = np.asarray(toks)
         return (
             toks.reshape(-1, 1),
             np.where(active, 1, 0).astype(np.int64),
@@ -1174,6 +1433,15 @@ class ContinuousBatcher:
         except Exception:  # noqa: BLE001 — device crash boundary
             with self._lock:
                 self.counters["step_failures"] += 1
+        return self._assign_blame(active, seqs)
+
+    def _assign_blame(self, active, seqs):
+        """The probe cascade after a failed device step (shared by the
+        sequential ``_step_with_blame`` and the overlapped
+        ``_collect_with_blame`` — by the time either gets here the
+        failed call has advanced nothing, so the probes are ordinary
+        synchronous steps): newest-admission masked retry, then
+        bisection. Same return shape as ``_step_with_blame``."""
         idxs = [int(i) for i in np.flatnonzero(active)]
         if len(idxs) == 1:
             # alone in the batch = culpable by elimination
@@ -1478,6 +1746,12 @@ class ContinuousBatcher:
 
         with self._lock:
             self._draining = self._stopped = True
+            # an in-flight step's results die with the requests: the
+            # handle is dropped UNCOLLECTED (every slot is released
+            # below, re-admission re-initializes per-slot state, and a
+            # supervisor restart rebuilds the stepper outright)
+            self._inflight = None
+            self.overlap_ledger.discard()
             while self._queue:
                 req = self._queue.popleft()
                 if req._swap is not None:
@@ -1505,8 +1779,10 @@ class ContinuousBatcher:
     @property
     def idle(self) -> bool:
         with self._lock:
-            return not self._queue and all(
-                s is None for s in self._slots
+            return (
+                self._inflight is None
+                and not self._queue
+                and all(s is None for s in self._slots)
             )
 
     def inflight_snapshot(self) -> list[dict]:
@@ -1589,6 +1865,10 @@ class ContinuousBatcher:
             }
         else:
             out["qos"] = {"enabled": False}
+        out["overlap"] = {
+            "enabled": self.overlap,
+            **self.overlap_ledger.snapshot(),
+        }
         st = self.stepper
         if getattr(st, "speculative", False):
             drafted = int(getattr(st, "spec_drafted_tokens", 0))

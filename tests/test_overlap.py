@@ -1,0 +1,359 @@
+"""Zero-bubble decode: the overlap ledger and the overlapped loop.
+
+Three tiers, no device work anywhere:
+
+- ledger arithmetic under a fake clock: the bubble histogram and the
+  efficiency gauge are pure functions of the dispatch/ready/collect
+  stamps, pinned to hand-computed values;
+- loop structure against fake steppers: tokens dispatched by
+  iteration N emit at iteration N+1's collect, final outputs are
+  identical to the sequential loop, and the trailing flush/idle/stop
+  semantics hold with a step still in the air;
+- failure containment: a step that raises — at dispatch or deferred
+  into the handle's collect — surfaces on the collect of its OWN
+  iteration with the sequential loop's blame/quarantine semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.obs import MetricsRegistry, OverlapLedger
+from distkeras_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    InternalError,
+)
+
+from test_serving import FakeStepper, _req
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _ledger():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    return OverlapLedger(reg, clock=clock), reg, clock
+
+
+# ------------------------------------------------------- ledger arithmetic
+
+
+def test_ledger_bubble_and_efficiency_arithmetic():
+    led, reg, clock = _ledger()
+    assert led.efficiency is None and led.bubble_fraction is None
+
+    # iteration 1: dispatch @0, ready observed @3, collect @5 —
+    # device wall 3, iteration wall 5 (no predecessor), bubble 2
+    led.note_dispatch()
+    clock.t = 3.0
+    led.note_ready()
+    clock.t = 5.0
+    led.note_collect()
+    assert led.iterations == 1
+    assert led.device_seconds == pytest.approx(3.0)
+    assert led.iteration_seconds == pytest.approx(5.0)
+
+    # iteration 2: dispatch @6, never polled ready, collect @9 —
+    # device ran up to the collect (device wall 3), iteration wall is
+    # collect-to-collect (9 - 5 = 4), bubble 1
+    clock.t = 6.0
+    led.note_dispatch()
+    clock.t = 9.0
+    led.note_collect()
+    assert led.iterations == 2
+    assert led.device_seconds == pytest.approx(6.0)
+    assert led.iteration_seconds == pytest.approx(9.0)
+    assert led.efficiency == pytest.approx(6.0 / 9.0)
+    assert led.bubble_fraction == pytest.approx(1.0 - 6.0 / 9.0)
+
+    hist = next(
+        s for s in reg.snapshot()
+        if s["name"] == "serving_step_bubble_seconds"
+    )
+    assert hist["count"] == 2
+    assert hist["sum"] == pytest.approx(3.0)  # bubbles 2 + 1
+
+    snap = led.snapshot()
+    assert snap["iterations"] == 2
+    assert snap["efficiency"] == pytest.approx(2 / 3, abs=1e-4)
+    assert snap["bubble_fraction"] == pytest.approx(1 / 3, abs=1e-4)
+
+
+def test_ledger_gauge_rides_registry_and_gaps_before_first_iteration():
+    led, reg, clock = _ledger()
+    gauge = next(
+        s for s in reg.snapshot()
+        if s["name"] == "serving_overlap_efficiency"
+    )
+    assert gauge["value"] is None  # a gap, not a fake 0 or 1
+    led.note_dispatch()
+    clock.t = 2.0
+    led.note_ready()
+    led.note_collect()
+    gauge = next(
+        s for s in reg.snapshot()
+        if s["name"] == "serving_overlap_efficiency"
+    )
+    assert gauge["value"] == pytest.approx(1.0)  # zero bubble
+
+
+def test_ledger_first_ready_observation_wins():
+    led, _, clock = _ledger()
+    led.note_dispatch()
+    clock.t = 1.0
+    led.note_ready()
+    clock.t = 4.0
+    led.note_ready()  # later poll must not move the stamp
+    clock.t = 4.0
+    led.note_collect()
+    assert led.device_seconds == pytest.approx(1.0)
+
+
+def test_ledger_collect_without_dispatch_and_discard_are_noops():
+    led, _, clock = _ledger()
+    led.note_ready()
+    led.note_collect()  # idle scheduler pass
+    assert led.iterations == 0
+    led.note_dispatch()
+    clock.t = 7.0
+    led.discard()  # abandoned step (stop with a handle in the air)
+    led.note_collect()
+    assert led.iterations == 0 and led.efficiency is None
+
+
+# --------------------------------------------------- overlapped loop shape
+
+
+class AsyncFakeStepper(FakeStepper):
+    """FakeStepper with the ``step_async`` face: the token math runs
+    eagerly (host fake), but the result rides a handle that reports
+    not-ready for ``delay_polls`` ready() calls and only hands the
+    tokens out at collect() — the un-materialized device array shape
+    of the real stepper."""
+
+    def __init__(self, *a, delay_polls=1, **kw):
+        super().__init__(*a, **kw)
+        self.delay_polls = delay_polls
+        self.collected = 0
+
+    def step_async(self, active):
+        toks = super().step(active)
+        stepper = self
+
+        class Handle:
+            def __init__(self):
+                self.polls = 0
+
+            def ready(self):
+                self.polls += 1
+                return self.polls > stepper.delay_polls
+
+            def collect(self):
+                stepper.collected += 1
+                return toks
+
+        return Handle()
+
+
+def _drain(b, n=50):
+    for _ in range(n):
+        if b.idle:
+            return
+        b.step()
+    raise AssertionError("batcher did not drain")
+
+
+def test_overlap_tokens_emit_on_the_next_call_and_match_sequential():
+    seq_st = FakeStepper(num_slots=2)
+    seq_b = ContinuousBatcher(seq_st)
+    seq_reqs = [seq_b.submit(_req(max_new=3)) for _ in range(3)]
+    while not seq_b.idle:
+        seq_b.step()
+
+    st = AsyncFakeStepper(num_slots=2)
+    b = ContinuousBatcher(st, overlap=True)
+    assert b.overlap
+    reqs = [b.submit(_req(max_new=3)) for _ in range(3)]
+    b.step()  # admit + dispatch — tokens still in the air
+    assert not any(r.done for r in reqs)
+    assert not b.idle  # an in-flight step is live work
+    _drain(b)
+    assert st.collected > 0  # the async face actually carried them
+    for r, sr in zip(reqs, seq_reqs):
+        assert r.result().tolist() == sr.result().tolist()
+    assert b.counters["tokens_generated"] == 9
+    # the ledger closed one entry per collected step
+    assert b.overlap_ledger.iterations >= 3
+    assert b.stats()["overlap"]["enabled"] is True
+
+
+def test_overlap_without_step_async_falls_back_and_matches():
+    # FakeStepper has no step_async: the device call runs
+    # synchronously at dispatch, but the loop shape (emit on the NEXT
+    # call) and the final outputs are unchanged
+    seq_b = ContinuousBatcher(FakeStepper(num_slots=2))
+    seq_reqs = [seq_b.submit(_req(max_new=4)) for _ in range(2)]
+    while not seq_b.idle:
+        seq_b.step()
+
+    b = ContinuousBatcher(FakeStepper(num_slots=2), overlap=True)
+    reqs = [b.submit(_req(max_new=4)) for _ in range(2)]
+    b.step()
+    assert not any(r.done for r in reqs)
+    _drain(b)
+    for r, sr in zip(reqs, seq_reqs):
+        assert r.result().tolist() == sr.result().tolist()
+
+
+def test_overlap_streamed_chunk_order_matches_sequential():
+    def run(overlap):
+        b = ContinuousBatcher(AsyncFakeStepper(num_slots=2),
+                              overlap=overlap)
+        r = b.submit(_req(max_new=5, stream=True))
+        while not b.idle:
+            b.step()
+        chunks = []
+        while True:  # FIFO retains everything; drain to the sentinel
+            c = r.next_chunk(timeout=0.1)
+            if c is None:
+                break
+            chunks.append(list(c))
+        return chunks, r.result().tolist()
+
+    # stream chunk flattening must equal the final tokens, both modes
+    seq_chunks, seq_final = run(False)
+    ov_chunks, ov_final = run(True)
+    assert ov_final == seq_final
+    assert [t for c in ov_chunks for t in c] == [
+        t for c in seq_chunks for t in c
+    ]
+
+
+def test_overlap_stop_with_step_in_the_air():
+    b = ContinuousBatcher(AsyncFakeStepper(num_slots=1), overlap=True)
+    r = b.submit(_req(max_new=5))
+    b.step()  # dispatched, uncollected
+    assert not b.idle
+    b.stop()
+    assert b.idle  # the handle was dropped with the requests
+    assert r.done
+    with pytest.raises(Exception):
+        r.result()
+
+
+# ----------------------------------------------------- failure containment
+
+
+def test_dispatch_raise_surfaces_at_its_own_collect():
+    class BoomStepper(FakeStepper):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.booms = 0
+
+        def step(self, active):
+            self.booms += 1
+            raise RuntimeError("injected step crash")
+
+    st = BoomStepper(num_slots=1)
+    b = ContinuousBatcher(st, overlap=True, quarantine_steps=2)
+    r = b.submit(_req(max_new=4))
+    b.step()  # dispatch: the failure is stashed on the handle
+    assert not r.done  # not surfaced early
+    assert b.counters["step_failures"] == 0
+    b.step()  # collect of its own iteration: blame by elimination
+    assert r.done
+    with pytest.raises(InternalError, match="blamed"):
+        r.result()
+    assert b.counters["step_failures"] == 1
+    assert b.counters["quarantines"] == 1
+
+
+def test_deferred_collect_raise_surfaces_at_its_own_collect():
+    class DeferredBoomStepper(AsyncFakeStepper):
+        def step_async(self, active):
+            class Handle:
+                @staticmethod
+                def ready():
+                    return True
+
+                @staticmethod
+                def collect():
+                    raise RuntimeError("deferred device failure")
+
+            return Handle()
+
+    b = ContinuousBatcher(DeferredBoomStepper(num_slots=1),
+                          overlap=True, quarantine_steps=2)
+    r = b.submit(_req(max_new=4))
+    b.step()
+    assert not r.done
+    b.step()
+    assert r.done
+    with pytest.raises(InternalError, match="blamed"):
+        r.result()
+    assert b.counters["step_failures"] == 1
+
+
+def test_overlap_blame_isolates_poison_slot_among_survivors():
+    class PoisonStepper(AsyncFakeStepper):
+        """Any batch containing the poison slot fails; probes that
+        mask it out succeed — the bisection must isolate it."""
+
+        poison = 1
+
+        def step(self, active):
+            if np.asarray(active, bool)[self.poison]:
+                raise RuntimeError("poison slot in batch")
+            return super().step(active)
+
+        def step_async(self, active):
+            # fail at the HANDLE, after a successful dispatch
+            toks_or_exc = None
+            try:
+                toks_or_exc = self.step(active)
+            except RuntimeError as e:
+                toks_or_exc = e
+
+            class Handle:
+                @staticmethod
+                def ready():
+                    return True
+
+                @staticmethod
+                def collect():
+                    if isinstance(toks_or_exc, Exception):
+                        raise toks_or_exc
+                    return toks_or_exc
+
+            return Handle()
+
+    st = PoisonStepper(num_slots=2)
+    b = ContinuousBatcher(st, overlap=True, quarantine_steps=100)
+    good = b.submit(_req(max_new=2))
+    bad = b.submit(_req(plen=4, max_new=2))  # admitted second -> slot 1
+    _drain(b)
+    with pytest.raises(InternalError, match="blamed"):
+        bad.result()
+    # the survivor decoded to completion, token-identical to solo
+    assert good.result().tolist() == [1, 2, 3, 1001, 1002]
+    assert b.counters["step_failures"] >= 1
+    assert b.counters["blame_probes"] >= 1
+
+
+def test_sequential_mode_is_unchanged_one_call_emits():
+    st = FakeStepper(num_slots=1)
+    b = ContinuousBatcher(st)  # overlap defaults False on the raw batcher
+    assert not b.overlap
+    r = b.submit(_req(max_new=1))
+    b.step()
+    assert r.done  # same-call emission, the pre-overlap contract
+    assert r.result().tolist() == [1, 2, 3, 1001]
+    # the sequential control stamps the same ledger
+    assert b.overlap_ledger.iterations == 1

@@ -109,6 +109,20 @@ DISAGG_RATIO_KEYS = (
 OBS_RATIO_KEYS = (
     "obs.history_vs_off",
 )
+#: zero-bubble decode: the overlapped-vs-sequential throughput ratios
+#: ride the serving collapse band; the bubble-reduction CLAIM is a
+#: committed floor (decode_heavy row), never a fresh-smoke demand —
+#: a 2-slot smoke bank's bubble is scheduling noise
+OVERLAP_RATIO_KEYS = (
+    "overlap.rows.decode_heavy.tokens_per_sec_ratio",
+    "overlap.rows.short_uniform.tokens_per_sec_ratio",
+    "overlap.rows.sampled.tokens_per_sec_ratio",
+    # the preempt row is band-EXEMPT (the QoS-row precedent): its
+    # wall clock is owned by bursty-swap timing, which at smoke scale
+    # swings far past any honest band — its gate is the identity +
+    # preemption invariants in compare_overlap plus the committed
+    # preemptions floor
+)
 #: the ramp A/B's p99 ratio is owned by JOIN TIMING — when inside the
 #: measured pass the scale-up lands, and how much of the single
 #: bench core its boot steals — so the band only gates collapse;
@@ -180,6 +194,18 @@ COMMITTED_FLOORS = {
     # recorder budget applied to the time-series layer)
     "obs": {
         "obs.history_vs_off": 0.98,
+    },
+    # zero-bubble decode: on the decode-heavy trace the overlapped
+    # loop must reclaim a committed fraction of the sequential loop's
+    # host bubble (this PR's claim — sized below the measured CPU-tier
+    # reduction so regeneration wobble does not flake the gate; the
+    # short_uniform honesty row carries NO floor), and the committed
+    # preempt row must have actually preempted on the overlapped side
+    # (a burst that never triggered the deferred-preemption path
+    # proves nothing about it)
+    "overlap": {
+        "overlap.rows.decode_heavy.bubble_reduction": 0.05,
+        "overlap.rows.preempt.preemptions.overlapped": 1,
     },
     # elastic fleet: the committed ramp must have actually grown the
     # fleet (a curve that never left 1 replica proves nothing)
@@ -554,6 +580,76 @@ def compare_obs(fresh: dict, committed: dict) -> list[str]:
     return violations
 
 
+OVERLAP_ROWS = ("decode_heavy", "short_uniform", "sampled", "preempt")
+
+
+def compare_overlap(fresh: dict, committed: dict) -> list[str]:
+    """Violations of the zero-bubble decode gate (empty list = pass).
+    The invariants, fresh and committed alike: all four traffic rows
+    present (dropping the host-work-light ``short_uniform`` honesty
+    row is a violation, not a tidier artifact), outputs identical on
+    EVERY row (for sampled that means overlapped == sequential +
+    seeded replay; for preempt it crosses the preempt/resume
+    boundary), both sides' bubble fractions actually measured from
+    the ledger, the decode_heavy trace exercised streamed delivery,
+    and — the r14/r16 standing gate — zero XLA mints and zero storms
+    inside timed passes. The committed artifact additionally clears
+    the bubble-reduction floor and proves its preempt row preempted
+    (``COMMITTED_FLOORS['overlap']``)."""
+    violations: list[str] = []
+    for rec, tag in ((fresh, "fresh"), (committed, "committed")):
+        ov = rec.get("overlap")
+        if ov is None:
+            violations.append(f"{tag}: missing overlap block")
+            continue
+        rows = ov.get("rows") or {}
+        missing = set(OVERLAP_ROWS) - set(rows)
+        if missing:
+            violations.append(
+                f"{tag} overlap: rows missing {sorted(missing)}"
+            )
+        for name, row in rows.items():
+            if row.get("outputs_identical") is not True:
+                violations.append(
+                    f"{tag} overlap.{name}: outputs not identical"
+                )
+            for side in ("sequential", "overlapped"):
+                bf = row.get(f"{side}_bubble_fraction")
+                if bf is None or not (0.0 <= bf <= 1.0):
+                    violations.append(
+                        f"{tag} overlap.{name}: {side} bubble "
+                        f"fraction {bf} not a measured [0, 1] value"
+                    )
+            if row.get("compile_storms", 0) != 0:
+                violations.append(
+                    f"{tag} overlap.{name}: "
+                    f"{row['compile_storms']} compile storms"
+                )
+        if not (rows.get("decode_heavy") or {}).get(
+                "streamed_requests", 0) > 0:
+            violations.append(
+                f"{tag} overlap.decode_heavy: no streamed requests — "
+                "the chunk-order pin never ran"
+            )
+        if "preemptions" not in (rows.get("preempt") or {}):
+            violations.append(
+                f"{tag} overlap.preempt: per-side preemption counts "
+                "not recorded"
+            )
+        for path, n in _timed_compile_fields(ov, "overlap"):
+            if n != 0:
+                violations.append(
+                    f"{tag} {path}: {n} XLA mints landed inside "
+                    "timed passes"
+                )
+    _band_check(
+        fresh, committed, OVERLAP_RATIO_KEYS, SERVING_RATIO_BAND,
+        violations,
+    )
+    _committed_floors(committed, "overlap", violations)
+    return violations
+
+
 def compare_autoscale(fresh: dict, committed: dict) -> list[str]:
     """Violations of the elastic-fleet gate (empty list = pass). The
     invariants, fresh and committed alike: the autoscaled side grew
@@ -652,6 +748,7 @@ COMPARATORS = {
     "decode": compare_decode,
     "disagg": compare_disagg,
     "obs": compare_obs,
+    "overlap": compare_overlap,
     "autoscale": compare_autoscale,
 }
 ARTIFACTS = {
@@ -662,6 +759,8 @@ ARTIFACTS = {
     "disagg": "BENCH_SERVING.json",
     # so does the obs (metrics-history + compile-invariant) block
     "obs": "BENCH_SERVING.json",
+    # and the zero-bubble decode (overlap) block
+    "overlap": "BENCH_SERVING.json",
     # the autoscale (elastic fleet ramp A/B) block rides the fleet
     # artifact, but its smoke path runs ONLY the ramp section
     "autoscale": "BENCH_FLEET.json",
@@ -684,6 +783,8 @@ def run_smoke(kind: str, workdir: str) -> dict:
         "disagg": ["bench_serving.py", "--smoke"],
         # so does the obs block
         "obs": ["bench_serving.py", "--smoke"],
+        # and the overlap block
+        "overlap": ["bench_serving.py", "--smoke"],
         # the ramp A/B alone — the fleet workloads' smoke is --kind
         # fleet's job
         "autoscale": ["bench_fleet.py", "--smoke", "--autoscale-only"],
@@ -702,7 +803,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--kind",
                     choices=("serving", "fleet", "decode", "disagg",
-                             "obs", "autoscale"),
+                             "obs", "overlap", "autoscale"),
                     required=True)
     ap.add_argument("--fresh", help="fresh --smoke artifact to grade")
     ap.add_argument("--committed",
@@ -741,6 +842,7 @@ def main(argv=None) -> int:
         "decode": DECODE_RATIO_KEYS,
         "disagg": DISAGG_RATIO_KEYS,
         "obs": OBS_RATIO_KEYS,
+        "overlap": OVERLAP_RATIO_KEYS,
         "autoscale": AUTOSCALE_RATIO_KEYS,
     }[args.kind])
     print(f"bench gate ok ({args.kind}): "

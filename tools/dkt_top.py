@@ -175,8 +175,31 @@ def format_table(samples, width: int = 78, series: dict | None = None
                         if sl:
                             fleet += f" {sl}"
                 break
+        # the zero-bubble column: the fraction of decode wall-clock
+        # the device sat idle (1 - overlap efficiency, from the
+        # overlap ledger's gauge); when the target serves history, the
+        # windowed mean bubble per iteration and a sparkline of the
+        # serving_step_bubble_seconds histogram's observation rate
+        bubble = ""
+        for s, _ in groups[replica]:
+            if s["name"] == "serving_overlap_efficiency" and (
+                s.get("value") is not None
+            ):
+                frac = 100.0 * (1.0 - float(s["value"]))
+                bubble = f"  bubble={frac:.1f}%"
+                if series is not None:
+                    ts = series.get(
+                        (replica, "serving_step_bubble_seconds", ())
+                    )
+                    if ts is not None:
+                        if ts.get("mean") is not None:
+                            bubble += f" ~{ts['mean']:.2g}s/it"
+                        sl = _sparkline(ts.get("points"))
+                        if sl:
+                            bubble += f" {sl}"
+                break
         lines.append(
-            f"== {replica}{role}{mesh}{fleet} ".ljust(width, "=")
+            f"== {replica}{role}{mesh}{fleet}{bubble} ".ljust(width, "=")
         )
         rows = []
         for s, labels in sorted(

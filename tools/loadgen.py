@@ -77,7 +77,29 @@ def interactive_tenants(seq: int = 256) -> list[dict]:
     ]
 
 
-PRESETS = {"interactive": interactive_tenants}
+def decode_heavy_tenants(seq: int = 256) -> list[dict]:
+    """The ``decode_heavy`` preset: the traffic shape the zero-bubble
+    overlap exists for — short prompts (admission/prefill is cheap)
+    with LONG generations that keep every slot decoding, so the
+    scheduler's per-iteration host work is the dominant non-device
+    cost and the bubble is measurable at saturation. One streamed
+    tenant rides along so the overlapped loop's stream-push ordering
+    is exercised under the same load."""
+    return [
+        {"name": "gen", "weight": 0.75, "priority": 0,
+         "prompt_len": (4, max(6, seq // 16)),
+         "steps": (max(8, seq // 3), max(10, 2 * seq // 3))},
+        {"name": "gen_stream", "weight": 0.25, "priority": 0,
+         "stream": 1.0,
+         "prompt_len": (4, max(6, seq // 16)),
+         "steps": (max(8, seq // 3), max(10, 2 * seq // 3))},
+    ]
+
+
+PRESETS = {
+    "interactive": interactive_tenants,
+    "decode_heavy": decode_heavy_tenants,
+}
 
 
 def _rate_fn(process: str, rate: float, *, burst_factor=8.0,
@@ -235,8 +257,15 @@ def summarize(trace, phases: int = 0) -> dict:
         b["decode_tokens"] += int(ev["steps"])
         b["streamed"] += int(bool(ev.get("stream")))
     gaps = np.diff(ts) if ts.size > 1 else np.asarray([0.0])
+    prompt_total = sum(b["prompt_tokens"] for b in by_tenant.values())
+    decode_total = sum(b["decode_tokens"] for b in by_tenant.values())
     out = {
         "events": len(trace),
+        # decode tokens per prompt token: how decode-bound the trace
+        # is (the decode_heavy preset exists to push this high)
+        "decode_per_prompt": round(
+            decode_total / max(1, prompt_total), 3
+        ),
         "span_seconds": round(float(ts[-1] - ts[0]), 4) if len(trace)
         else 0.0,
         "gap_ms": {
@@ -289,9 +318,11 @@ def main(argv=None) -> int:
                     help="JSON list of tenant specs (name/weight/"
                          "priority/prompt_len/steps/stream)")
     ap.add_argument("--preset", default=None, choices=sorted(PRESETS),
-                    help="named tenant-mix preset (e.g. interactive: "
+                    help="named tenant-mix preset (interactive: "
                          "streamed short chat turns + prefill-heavy "
-                         "long documents); overrides --tenants")
+                         "long documents; decode_heavy: short prompts "
+                         "with long generations — slot-saturating "
+                         "decode); overrides --tenants")
     ap.add_argument("--seq", type=int, default=256,
                     help="sequence capacity the preset's prompt/step "
                          "ranges scale to")

@@ -460,6 +460,16 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     # mint of a new program on the serving path mid-soak means warmup
     # has a hole or a compile key regressed to traffic-dependent
     summary["compiles"] = engine.compile_ledger.snapshot()
+    # the soak runs the OVERLAPPED loop (engine default): record the
+    # bubble ledger so a zero-bubble regression shows up in the same
+    # artifact as the chaos bars it must hold under
+    summary["overlap"] = {
+        "enabled": engine.batcher.overlap if engine.batcher else None,
+        **(
+            engine.batcher.overlap_ledger.snapshot()
+            if engine.batcher else {}
+        ),
+    }
     summary["ok"] = (
         hung == 0
         and summary["compiles"]["storms"] == 0
