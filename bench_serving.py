@@ -1805,6 +1805,581 @@ def _measure_disagg_block(model, ref_gen, *, seq, vocab, slots, chunk,
     return block
 
 
+def _drive_waves(port, reqs, *, wave=4, timeout=600.0):
+    """Fire ``reqs`` at a live server/router over TCP in concurrent
+    waves of ``wave`` clients (waves keep a least-loaded router
+    honestly choosing under load without melting the 1-core bench
+    box). Every request must succeed; returns
+    ``(wall, results, latencies)`` with per-request client wall
+    latencies in seconds."""
+    import threading
+
+    from distkeras_tpu.serving import ServingClient
+
+    results = [None] * len(reqs)
+    lats = [None] * len(reqs)
+    errors = []
+    t0 = time.perf_counter()
+
+    def worker(i):
+        prompt, steps = reqs[i]
+        try:
+            ta = time.perf_counter()
+            with ServingClient("127.0.0.1", port, timeout=timeout) as c:
+                results[i] = c.generate(prompt, steps)
+            lats[i] = time.perf_counter() - ta
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append((i, repr(e)))
+
+    for base in range(0, len(reqs), wave):
+        ths = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(base, min(base + wave, len(reqs)))
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=timeout)
+    assert not errors, f"resilience bench requests failed: {errors[:3]}"
+    return time.perf_counter() - t0, results, lats
+
+
+def _drive_storm(port, hi_reqs, storm_reqs, *, budget, timeout=600.0):
+    """One storm pass: every ``storm_reqs`` launched AT ONCE as a
+    priority-0 no-retry burst (tenant ``storm``, all clients sharing
+    ``budget`` so the pass's attempt accounting is one ledger) while
+    the priority-2 interactive requests ride through concurrently.
+    Returns ``(wall, hi_results, hi_lats, storm_results, outcomes)``;
+    ``outcomes`` classifies every storm reply — ``ok`` /
+    ``typed_overloaded`` (checked to carry an honest ``retry_after``
+    hint; a refusal without one counts ``hint_missing``) /
+    ``typed_other`` / ``untyped`` — so a silent hang or a raw socket
+    error is a counted finding, not a lost thread."""
+    import threading
+
+    from distkeras_tpu.serving import ServingClient, ServingError
+
+    hi_res = [None] * len(hi_reqs)
+    hi_lat = [None] * len(hi_reqs)
+    st_res = [None] * len(storm_reqs)
+    outcomes = {"ok": 0, "typed_overloaded": 0, "typed_other": 0,
+                "untyped": 0, "hint_missing": 0}
+    olock = threading.Lock()
+    errors = []
+
+    def hi(i):
+        prompt, steps = hi_reqs[i]
+        try:
+            ta = time.perf_counter()
+            with ServingClient("127.0.0.1", port, timeout=timeout) as c:
+                hi_res[i] = c.generate(
+                    prompt, steps, tenant="interactive", priority=2
+                )
+            hi_lat[i] = time.perf_counter() - ta
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append((i, repr(e)))
+
+    def storm(i):
+        prompt, steps = storm_reqs[i]
+        try:
+            with ServingClient("127.0.0.1", port, timeout=timeout,
+                               retry=False, retry_budget=budget) as c:
+                st_res[i] = c.generate(
+                    prompt, steps, tenant="storm", priority=0
+                )
+            with olock:
+                outcomes["ok"] += 1
+        except ServingError as e:
+            with olock:
+                if getattr(e, "code", None) == "overloaded":
+                    outcomes["typed_overloaded"] += 1
+                    if getattr(e, "retry_after", None) is None:
+                        outcomes["hint_missing"] += 1
+                else:
+                    outcomes["typed_other"] += 1
+        except Exception:  # noqa: BLE001 — untyped = a counted finding
+            with olock:
+                outcomes["untyped"] += 1
+
+    ths = [
+        threading.Thread(target=storm, args=(i,))
+        for i in range(len(storm_reqs))
+    ] + [
+        threading.Thread(target=hi, args=(i,))
+        for i in range(len(hi_reqs))
+    ]
+    t0 = time.perf_counter()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=timeout)
+    assert not errors, f"hi-priority requests failed: {errors[:3]}"
+    return time.perf_counter() - t0, hi_res, hi_lat, st_res, outcomes
+
+
+def _measure_storm_row(model, ref_gen, *, seq, vocab, slots, chunk,
+                       requests, repeats, rng):
+    """Adaptive load shedding under a 5x storm: shedding-off vs
+    shedding-on, SAME engine config otherwise, over real TCP. Each
+    timed pass fires a 5x burst of priority-0 storm requests while
+    priority-2 interactive requests ride through; goodput is the
+    interactive tokens delivered per wall second. On the shedding
+    side the operator seam DECLARES the brownout for the storm window
+    (``burn_fn`` -> "burning": rung 1 sheds priority<=0 at the door
+    and NEVER clamps, so replies stay token-identical) — the rung-1
+    machinery exercised is the real one end to end (typed
+    ``overloaded`` over the wire with honest sojourn-derived
+    ``retry_after_ms`` hints), made deterministic where organic CoDel
+    latching at bench scale is seed-dependent; the sojourn gate still
+    rides on top. Pairing is exact by construction and GATED: gate
+    sheds == typed overloaded refusals received, every refusal
+    hinted, zero untyped errors on either side."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+    from distkeras_tpu.serving.resilience import RetryBudget
+
+    hi_reqs = [
+        (rng.integers(0, vocab, max(2, seq // 8)).astype(np.int32),
+         max(2, seq // 8))
+        for _ in range(requests)
+    ]
+    storm_reqs = [
+        (rng.integers(0, vocab, max(2, seq // 8)).astype(np.int32),
+         max(2, seq // 16))
+        for _ in range(5 * requests)
+    ]
+    hi_refs = _solo_refs(ref_gen, hi_reqs)
+    storm_refs = _solo_refs(ref_gen, storm_reqs)
+    # capacity covers the whole burst on BOTH sides: the off side must
+    # queue (not capacity-refuse) so the only typed refusals anywhere
+    # come from the shed gate — the exact-pairing precondition
+    cap = 2 * (len(hi_reqs) + len(storm_reqs)) + 8
+
+    def boot(shed):
+        eng = ServingEngine(
+            model, num_slots=slots, queue_capacity=cap,
+            prefill_chunk=chunk, prefix_cache=False,
+            shed=dict(burn_interval=0.05) if shed else False,
+        ).start()
+        return eng, ServingServer(eng).start()
+
+    eng_on, srv_on = boot(True)
+    eng_off, srv_off = boot(False)
+    sides = {"shed_off": (eng_off, srv_off),
+             "shed_on": (eng_on, srv_on)}
+    budget = RetryBudget(ratio=0.5, burst=max(10.0, len(storm_reqs)))
+    goodput = {name: [] for name in sides}
+    hi_lats = {name: [] for name in sides}
+    tally = {
+        name: {"ok": 0, "typed_overloaded": 0, "typed_other": 0,
+               "untyped": 0, "hint_missing": 0}
+        for name in sides
+    }
+    hi_tokens = sum(s for _, s in hi_reqs)
+    timed_mints = 0
+    gate = eng_on.shed_gate
+    steady_burn = gate.burn_fn
+    try:
+        for eng, srv in sides.values():  # warm every bucket, both sides
+            for _ in range(2):
+                _drive_waves(srv.port, hi_reqs + storm_reqs,
+                             wave=2 * slots)
+            eng.compile_ledger.mark_warmed()
+        # snapshot AFTER warm: the warm waves queue deep enough to
+        # latch the sojourn gate organically, and the warm clients'
+        # default retry policy absorbs those sheds silently — they are
+        # not part of the timed-window pairing ledger
+        sheds0 = gate.state()["sheds"]
+        for _ in range(repeats):
+            for name in ("shed_off", "shed_on"):
+                eng, srv = sides[name]
+                if name == "shed_on":
+                    gate.burn_fn = lambda: "burning"
+                    deadline = time.monotonic() + 10.0
+                    while gate.rung() < 1:  # brownout engaged
+                        assert time.monotonic() < deadline
+                        time.sleep(0.01)
+                m0 = eng.compile_ledger.total
+                wall, hi_res, hi_lat, st_res, outc = _drive_storm(
+                    srv.port, hi_reqs, storm_reqs, budget=budget
+                )
+                if name == "shed_on":
+                    gate.burn_fn = steady_burn
+                timed_mints += eng.compile_ledger.total - m0
+                for i, (a, r) in enumerate(zip(hi_res, hi_refs)):
+                    assert np.array_equal(a, r), (
+                        f"storm A/B [{name}] hi req {i}: != solo")
+                for i, (a, r) in enumerate(zip(st_res, storm_refs)):
+                    if a is not None:  # refused requests have no reply
+                        assert np.array_equal(a, r), (
+                            f"storm A/B [{name}] storm req {i}: != solo")
+                goodput[name].append(hi_tokens / wall)
+                hi_lats[name].append([t * 1e3 for t in hi_lat])
+                for k, v in outc.items():
+                    tally[name][k] += v
+        storms = sum(
+            e.compile_ledger.storms for e, _ in sides.values()
+        )
+    finally:
+        gate.burn_fn = steady_burn
+        for eng, srv in sides.values():
+            srv.shutdown()
+            eng.stop()
+    # the declared brownout must RELEASE: rung back to 0 once the
+    # operator seam reads "ok" again (burn_interval-paced refresh)
+    deadline = time.monotonic() + 10.0
+    while gate.rung() != 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    sheds = gate.state()["sheds"] - sheds0
+    for name in sides:
+        assert tally[name]["untyped"] == 0, (name, tally[name])
+        assert tally[name]["typed_other"] == 0, (name, tally[name])
+        assert tally[name]["hint_missing"] == 0, (name, tally[name])
+    p_off, p_on = _pct(hi_lats["shed_off"]), _pct(hi_lats["shed_on"])
+    return {
+        "num_hi_requests": len(hi_reqs),
+        "num_storm_requests": len(storm_reqs),
+        "storm_multiplier": 5,
+        "hi_tokens_per_pass": hi_tokens,
+        "shed_off": {
+            "goodput_tokens_per_sec": round(
+                float(np.median(goodput["shed_off"])), 1),
+            "hi_latency_ms": p_off,
+            "storm_outcomes": tally["shed_off"],
+        },
+        "shed_on": {
+            "goodput_tokens_per_sec": round(
+                float(np.median(goodput["shed_on"])), 1),
+            "hi_latency_ms": p_on,
+            "storm_outcomes": tally["shed_on"],
+        },
+        "goodput_ratio": _ratio(
+            float(np.median(goodput["shed_on"])),
+            float(np.median(goodput["shed_off"])),
+        ),
+        "hi_p99_improvement": _ratio(p_off["p99"], p_on["p99"]),
+        "shed_pairing": {
+            "gate_sheds": int(sheds),
+            "typed_overloaded": tally["shed_on"]["typed_overloaded"],
+            "exact": int(sheds)
+            == tally["shed_on"]["typed_overloaded"],
+        },
+        "hints_honest": True,
+        "retry_budget": budget.snapshot(),
+        # the LIVE rung, not state()["rung"]: that one is the
+        # last-admission snapshot and goes stale once traffic stops
+        "shed_rung_released": gate.rung() == 0,
+        "brownout": (
+            "declared via the operator burn seam for each storm "
+            "window (rung 1: shed priority<=0, never clamp — "
+            "identity-safe); the CoDel sojourn gate rides on top "
+            "organically"
+        ),
+        "timed_pass_compiles": int(timed_mints),
+        "compile_storms": int(storms),
+        "outputs_identical": True,
+    }
+
+
+def _measure_gray_row(model, ref_gen, *, seq, vocab, slots, chunk,
+                      requests, repeats, rng):
+    """Gray failure vs circuit breakers: a 2-replica fleet whose first
+    replica is slowed 250 ms per data-path request via the
+    ``net.delay`` seam — health polls stay GREEN the whole time
+    (asserted every pass on both routers: ejection never fires, the
+    failure shape binary health cannot see) — routed through a
+    breaker-armed router vs a plain one, SHARED replicas, interleaved
+    timed passes. The breaker is tripped OFF the timed path and its
+    ``open_secs`` outlives the whole measured window, so no half-open
+    probe's stall pollutes a committed p99 (``probes_in_timed_window``
+    is committed and gated at 0). Every reply on both sides is
+    token-identical to its solo reference — a gray replica delays,
+    it must never corrupt."""
+    from distkeras_tpu import faults
+    from distkeras_tpu.serving import (
+        FleetRouter,
+        ServingEngine,
+        ServingServer,
+    )
+
+    reqs = _make_short_uniform(requests, seq, vocab, rng)
+    refs = _solo_refs(ref_gen, reqs)
+    engines, servers = [], []
+    routers = {}
+    plan = faults.FaultPlan()
+    lats = {"breaker_off": [], "breaker_on": []}
+    timed_mints = 0
+    probes_in_window = 0
+    try:
+        for _ in range(2):
+            eng = ServingEngine(
+                model, num_slots=slots,
+                queue_capacity=4 * len(reqs) + 8,
+                prefill_chunk=chunk, prefix_cache=False,
+            ).start()
+            servers.append(ServingServer(eng).start())
+            engines.append(eng)
+        slow_port = int(servers[0].port)
+        slow_ep = (servers[0].host, slow_port)
+        for srv in servers:  # warm each replica directly, seam disarmed
+            for _ in range(2):
+                _drive_waves(srv.port, reqs, wave=2 * slots)
+        for eng in engines:
+            eng.compile_ledger.mark_warmed()
+        routers["breaker_on"] = FleetRouter(
+            endpoints=[(s.host, s.port) for s in servers],
+            health_interval=0.1, affinity=False,
+            # open_secs outlives every timed pass: once open the
+            # breaker STAYS open through the measured window
+            breaker=dict(open_secs=120.0, outlier_trips=2,
+                         outlier_factor=3.0, min_latency=0.02),
+        ).start()
+        routers["breaker_off"] = FleetRouter(
+            endpoints=[(s.host, s.port) for s in servers],
+            health_interval=0.1, affinity=False,
+        ).start()
+        for rt in routers.values():
+            for s in servers:
+                assert rt.wait_in_rotation(
+                    (s.host, s.port), timeout=60.0
+                )
+        plan.arm(
+            "net.delay", action="delay", delay=0.25, times=None,
+            when=lambda ctx: ctx.get("port") == slow_port,
+        ).activate()
+
+        def slow_state(rt):
+            for r in rt.replicas():
+                if tuple(r["endpoint"]) == slow_ep:
+                    return r
+            raise AssertionError("slow replica left the books")
+
+        # trip the breaker OFF the timed path: concurrent bursts give
+        # both replicas windowed latency until the outlier sweep opens
+        rt_on = routers["breaker_on"]
+        deadline = time.monotonic() + 120.0
+        while slow_state(rt_on)["breaker"]["state"] != "open":
+            assert time.monotonic() < deadline, "breaker never opened"
+            _drive_waves(rt_on.port, reqs[: 2 * slots], wave=2 * slots)
+        for _ in range(repeats):
+            for name in ("breaker_off", "breaker_on"):
+                rt = routers[name]
+                m0 = sum(e.compile_ledger.total for e in engines)
+                p0 = rt.counters.get("breaker_probes", 0)
+                _, res, lat = _drive_waves(rt.port, reqs, wave=4)
+                timed_mints += (
+                    sum(e.compile_ledger.total for e in engines) - m0
+                )
+                if name == "breaker_on":
+                    probes_in_window += (
+                        rt.counters.get("breaker_probes", 0) - p0
+                    )
+                    assert (
+                        slow_state(rt)["breaker"]["state"] == "open"
+                    )
+                # the gray property: health stays green on BOTH
+                # routers the whole time — ejection never fires
+                st = slow_state(rt)
+                assert st["state"] == "active", st
+                for i, (a, r) in enumerate(zip(res, refs)):
+                    assert np.array_equal(a, r), (
+                        f"gray A/B [{name}] req {i}: != solo")
+                lats[name].append([t * 1e3 for t in lat])
+        on_counters = {
+            k: int(routers["breaker_on"].counters[k])
+            for k in ("breaker_opens", "breaker_half_opens",
+                      "breaker_closes", "breaker_probes",
+                      "breaker_bypass_forwards")
+        }
+        storms = sum(e.compile_ledger.storms for e in engines)
+    finally:
+        plan.deactivate()
+        for rt in routers.values():
+            rt.shutdown()
+        for s in servers:
+            s.shutdown()
+        for e in engines:
+            e.stop()
+    assert on_counters["breaker_bypass_forwards"] == 0
+    p_off, p_on = _pct(lats["breaker_off"]), _pct(lats["breaker_on"])
+    return {
+        "num_requests": len(reqs),
+        "injected_delay_ms": 250.0,
+        "breaker_off": {"latency_ms": p_off},
+        "breaker_on": {"latency_ms": p_on, "counters": on_counters},
+        "routed_p99_ratio": _ratio(p_off["p99"], p_on["p99"]),
+        "slow_replica_health_green": True,
+        "probes_in_timed_window": int(probes_in_window),
+        "timed_pass_compiles": int(timed_mints),
+        "compile_storms": int(storms),
+        "outputs_identical": True,
+    }
+
+
+def _measure_hedge_row(model, ref_gen, *, seq, vocab, slots, chunk,
+                       requests, repeats, rng):
+    """Hedged requests vs the stalled-primary tail: the same 2-replica
+    fleet (first replica stalled 300 ms per request via ``net.delay``,
+    breakers OFF — hedging is the defense under test), routed through
+    a hedging router (``hedge_after=50 ms``) vs a plain one, SHARED
+    replicas, serial requests so each one honestly faces the
+    least-loaded choice. Winners are token-identical to the solo
+    references every pass (the hedging identity rule: greedy decode
+    makes the hedge a replay, so whichever reply wins IS the answer),
+    and the hedge ledger must balance at scrape:
+    launched == wins + losers, no lost hedge threads."""
+    from distkeras_tpu import faults
+    from distkeras_tpu.serving import (
+        FleetRouter,
+        ServingClient,
+        ServingEngine,
+        ServingServer,
+    )
+
+    reqs = _make_short_uniform(requests, seq, vocab, rng)
+    refs = _solo_refs(ref_gen, reqs)
+    engines, servers = [], []
+    routers = {}
+    plan = faults.FaultPlan()
+    lats = {"hedge_off": [], "hedge_on": []}
+    timed_mints = 0
+    try:
+        for _ in range(2):
+            eng = ServingEngine(
+                model, num_slots=slots,
+                queue_capacity=4 * len(reqs) + 8,
+                prefill_chunk=chunk, prefix_cache=False,
+            ).start()
+            servers.append(ServingServer(eng).start())
+            engines.append(eng)
+        slow_port = int(servers[0].port)
+        for srv in servers:  # warm each replica directly, seam disarmed
+            for _ in range(2):
+                _drive_waves(srv.port, reqs, wave=2 * slots)
+        for eng in engines:
+            eng.compile_ledger.mark_warmed()
+        routers["hedge_on"] = FleetRouter(
+            endpoints=[(s.host, s.port) for s in servers],
+            health_interval=0.1, affinity=False, hedge_after=0.05,
+        ).start()
+        routers["hedge_off"] = FleetRouter(
+            endpoints=[(s.host, s.port) for s in servers],
+            health_interval=0.1, affinity=False,
+        ).start()
+        for rt in routers.values():
+            for s in servers:
+                assert rt.wait_in_rotation(
+                    (s.host, s.port), timeout=60.0
+                )
+        plan.arm(
+            "net.delay", action="delay", delay=0.3, times=None,
+            when=lambda ctx: ctx.get("port") == slow_port,
+        ).activate()
+        for _ in range(repeats):
+            for name in ("hedge_off", "hedge_on"):
+                rt = routers[name]
+                m0 = sum(e.compile_ledger.total for e in engines)
+                lat = []
+                with ServingClient(
+                    "127.0.0.1", rt.port, timeout=600.0
+                ) as c:
+                    for i, (p, s) in enumerate(reqs):
+                        ta = time.perf_counter()
+                        out = c.generate(p, s)
+                        lat.append((time.perf_counter() - ta) * 1e3)
+                        assert np.array_equal(out, refs[i]), (
+                            f"hedge A/B [{name}] req {i}: != solo")
+                timed_mints += (
+                    sum(e.compile_ledger.total for e in engines) - m0
+                )
+                lats[name].append(lat)
+        hedge_counters = {
+            k: int(routers["hedge_on"].counters[k])
+            for k in ("hedges_launched", "hedge_wins", "hedge_losers")
+        }
+        storms = sum(e.compile_ledger.storms for e in engines)
+    finally:
+        plan.deactivate()
+        for rt in routers.values():
+            rt.shutdown()
+        for s in servers:
+            s.shutdown()
+        for e in engines:
+            e.stop()
+    assert hedge_counters["hedges_launched"] >= 1, hedge_counters
+    assert hedge_counters["hedges_launched"] == (
+        hedge_counters["hedge_wins"] + hedge_counters["hedge_losers"]
+    ), hedge_counters
+    p_off, p_on = _pct(lats["hedge_off"]), _pct(lats["hedge_on"])
+    return {
+        "num_requests": len(reqs),
+        "injected_delay_ms": 300.0,
+        "hedge_after_ms": 50.0,
+        "hedge_off": {"latency_ms": p_off},
+        "hedge_on": {"latency_ms": p_on, "counters": hedge_counters},
+        "p99_ratio": _ratio(p_off["p99"], p_on["p99"]),
+        "hedges_balanced": True,
+        "timed_pass_compiles": int(timed_mints),
+        "compile_storms": int(storms),
+        "outputs_identical": True,
+    }
+
+
+def _measure_resilience_block(model, ref_gen, *, seq, vocab, slots,
+                              chunk, requests, repeats, rng):
+    """Overload defense & gray-failure resilience: three A/B rows.
+
+    - ``storm``: adaptive load shedding under a 5x priority-0 storm —
+      shedding-on goodput (interactive tokens delivered per second)
+      vs shedding-off, exact shed/refusal pairing, honest retry
+      hints, zero untyped errors (committed goodput floor in
+      ``check_bench --kind resilience``);
+    - ``gray``: a health-green replica stalling every data-path
+      request — breaker-armed routing vs plain, routed p99 recovery
+      with zero probes inside timed windows (committed recovery
+      floor);
+    - ``hedge``: a stalled primary vs tail-latency hedging — the
+      hedge ledger balanced, winners token-identical (committed as
+      measured plus the ledger invariants).
+
+    Every pass identity-asserted, zero compiles inside timed windows
+    across all three rows."""
+    repeats = max(1, min(int(repeats), 3))
+    block = {"rows": {}}
+    block["rows"]["storm"] = _measure_storm_row(
+        model, ref_gen, seq=seq, vocab=vocab, slots=slots, chunk=chunk,
+        requests=requests, repeats=repeats, rng=rng,
+    )
+    print(json.dumps({"resilience_storm": {
+        "goodput_ratio": block["rows"]["storm"]["goodput_ratio"],
+        "hi_p99_improvement": block["rows"]["storm"][
+            "hi_p99_improvement"],
+    }}), flush=True)
+    block["rows"]["gray"] = _measure_gray_row(
+        model, ref_gen, seq=seq, vocab=vocab, slots=slots, chunk=chunk,
+        requests=requests, repeats=repeats, rng=rng,
+    )
+    print(json.dumps({"resilience_gray": {
+        "routed_p99_ratio": block["rows"]["gray"]["routed_p99_ratio"],
+    }}), flush=True)
+    block["rows"]["hedge"] = _measure_hedge_row(
+        model, ref_gen, seq=seq, vocab=vocab, slots=slots, chunk=chunk,
+        requests=requests, repeats=repeats, rng=rng,
+    )
+    print(json.dumps({"resilience_hedge": {
+        "p99_ratio": block["rows"]["hedge"]["p99_ratio"],
+        "hedges_launched": block["rows"]["hedge"]["hedge_on"][
+            "counters"]["hedges_launched"],
+    }}), flush=True)
+    block["timed_pass_compiles"] = sum(
+        r["timed_pass_compiles"] for r in block["rows"].values()
+    )
+    block["compile_storms"] = sum(
+        r["compile_storms"] for r in block["rows"].values()
+    )
+    block["outputs_identical"] = True
+    return block
+
+
 def _measure_serial(model, reqs, *, arrivals=None, repeats=1):
     """1 slot + PR 1 config = serve-one-at-a-time through identical
     code (the PR 1 continuity ratio)."""
@@ -1879,6 +2454,11 @@ def main() -> None:
                          "sampled / preempt traffic, every pass "
                          "identity-asserted) and merge it into the "
                          "existing BENCH_SERVING.json")
+    ap.add_argument("--resilience-only", action="store_true",
+                    help="run ONLY the overload-defense block (storm "
+                         "shedding goodput A/B, gray-failure breaker "
+                         "A/B, hedged-request tail A/B) and merge it "
+                         "into the existing BENCH_SERVING.json")
     ap.add_argument("--disagg-only", action="store_true",
                     help="run ONLY the disaggregated prefill/decode "
                          "block (1 prefill + 1 decode worker vs 2 "
@@ -2011,6 +2591,26 @@ def main() -> None:
                 "tokens_per_sec_ratio": sc["tokens_per_sec_ratio"],
             }
             for n, sc in record["disagg"]["scenarios"].items()
+        }}))
+        return
+
+    if args.resilience_only:
+        # merge-mode sibling of --disagg-only: measure just the
+        # overload-defense block into the committed record
+        with open("BENCH_SERVING.json") as f:
+            record = json.load(f)
+        record["resilience"] = _measure_resilience_block(
+            model, ref_gen, seq=seq, vocab=vocab, slots=args.slots,
+            chunk=chunk, requests=args.requests, repeats=args.repeats,
+            rng=np.random.default_rng(180),
+        )
+        with open("BENCH_SERVING.json", "w") as f:
+            json.dump(record, f, indent=2)
+        rows = record["resilience"]["rows"]
+        print(json.dumps({"resilience": {
+            "storm_goodput_ratio": rows["storm"]["goodput_ratio"],
+            "gray_routed_p99_ratio": rows["gray"]["routed_p99_ratio"],
+            "hedge_p99_ratio": rows["hedge"]["p99_ratio"],
         }}))
         return
 
@@ -2271,6 +2871,15 @@ def main() -> None:
     record["disagg"] = _measure_disagg_block(
         model, ref_gen, seq=seq, vocab=vocab, slots=args.slots,
         chunk=chunk, requests=args.requests, repeats=args.repeats,
+    )
+
+    # -- overload defense & gray-failure resilience A/B ---------------------
+    # dedicated rng (the overlap-block precedent): the resilience rows
+    # draw the same hand in --resilience-only and the full run
+    record["resilience"] = _measure_resilience_block(
+        model, ref_gen, seq=seq, vocab=vocab, slots=args.slots,
+        chunk=chunk, requests=args.requests, repeats=args.repeats,
+        rng=np.random.default_rng(180),
     )
 
     # -- speculative decoding A/B (prompt-lookup drafter) -------------------

@@ -56,6 +56,15 @@ Seam catalogue (the hook points that exist today)::
                         it)
     net.send            networking.send_data (both PS and serving wire)
     net.recv            networking.recv_data
+    net.delay           ServingServer data-path verbs (generate /
+                        predict / prefill / kv.transfer), fired with
+                        ``ctx["verb"]`` and ``ctx["port"]`` before the
+                        verb runs — arm with ``action="delay"`` and a
+                        ``when`` filter on the port to make ONE
+                        replica slow while its health polls stay
+                        green: the gray failure binary health can't
+                        see, which the router's per-replica circuit
+                        breakers (latency-outlier trip) must catch
     ps.pull             ParameterServer.pull, client-facing entry (both
                         the in-process and socket transports), before
                         any state is read
@@ -121,6 +130,7 @@ SITES = frozenset(
         "router.health",
         "net.send",
         "net.recv",
+        "net.delay",
         "ps.pull",
         "ps.commit",
         "ps.replicate",

@@ -34,11 +34,17 @@ into router internals.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
 
 from distkeras_tpu.networking import RetryPolicy, connect, recv_data, send_data
+from distkeras_tpu.serving.resilience import (
+    LatencyTracker,
+    as_retry_budget,
+    resolve_hedge_delay,
+)
 from distkeras_tpu.serving.scheduler import (
     DeadlineExceededError,
     EngineStoppedError,
@@ -65,17 +71,65 @@ _ERRORS = {
     InternalError.code: InternalError,
 }
 
+#: verbs a hedge sibling may duplicate: deterministic in (input,
+#: params), so a duplicated attempt costs compute, never correctness
+_HEDGEABLE = ("generate", "predict")
+
+
+def _reply_error(reply: dict) -> ServingError:
+    """Build the typed error for an error reply WITHOUT touching any
+    client state — the hedge sibling's error path (a losing hedge must
+    never drop the primary's connection or poison its fatal ledger)."""
+    code = reply.get("error", "error")
+    err = _ERRORS.get(code, ServingError)(reply.get("detail", code))
+    err.code = code  # wire code survives even for unmapped errors
+    if reply.get("trace") is not None:
+        err.trace = reply["trace"]
+        err.trace_id = reply["trace"].get("id")
+    if reply.get("retry_after_ms") is not None:
+        # RetryPolicy reads this attribute as its backoff hint
+        err.retry_after = float(reply["retry_after_ms"]) / 1e3
+    return err
+
+
+class _HedgeAbandoned(Exception):
+    """Raised inside an abandoned primary attempt after its hedge
+    sibling already won — never surfaces to callers (the winner's
+    reply was already returned) and never retried."""
+
 
 class ServingClient:
     def __init__(self, host, port, timeout=120.0, retry=True,
-                 connect_timeout=None):
+                 connect_timeout=None, retry_budget=None, hedge_after=None):
         """``retry``: True (default) builds a ``RetryPolicy()``; a
         ``RetryPolicy`` instance is used as-is; False/None disables all
         retrying and reconnecting (every failure surfaces raw).
         ``connect_timeout``: dial budget per connection attempt (default
         ``timeout``) — the fleet router dials with a short one so a
         silently dead replica fails over in seconds, while the operation
-        timeout stays long enough for a full generate."""
+        timeout stays long enough for a full generate.
+
+        ``retry_budget``: a ``resilience.RetryBudget`` (True = defaults,
+        a dict = kwargs, an instance = as-is and SHAREABLE across
+        clients — the budget caps the fleet's retry amplification, not
+        one socket's). When the budget is exhausted a retriable failure
+        surfaces as its ORIGINAL typed error immediately instead of
+        retrying; retries that do go out are wire-marked (``retry``
+        header field) so the router can enforce its own budget on top.
+        Budgeted verbs are ``generate``/``predict`` — control-plane
+        retries (health, stats) never spend data-plane tokens.
+
+        ``hedge_after``: tail-latency hedging for idempotent
+        non-streaming ``generate``/``predict``: seconds, or ``"p95"``
+        style (resolved against this client's own completed-call
+        latency window — no hedging until it has samples). When the
+        primary attempt is still in flight after the delay, a sibling
+        attempt launches on a FRESH connection and the first usable
+        reply wins; the loser's connection is discarded, never pooled.
+        Safe because served decode is deterministic in (prompt,
+        params) — a hedged winner is token-identical to the solo
+        reply. Hedges spend the retry budget when one is set (no
+        tokens = no hedge: a hedge is a retry that didn't wait)."""
         self._host, self._port = host, int(port)
         self._timeout = timeout
         self._connect_timeout = (
@@ -86,6 +140,23 @@ class ServingClient:
         elif not retry:
             retry = None
         self._retry = retry
+        self._retry_budget = as_retry_budget(retry_budget)
+        self.hedge_after = hedge_after
+        if isinstance(hedge_after, (str, int, float)):
+            # validate the spec now (a typo'd "95p" must fail at
+            # construction, not on the thousandth request)
+            resolve_hedge_delay(hedge_after, None)
+        self._lat = LatencyTracker()
+        # resilience ledgers (the bench's pairing invariants read
+        # these): retries that went out, retries refused by the
+        # budget, and the hedge triple (launched == wins + losers at
+        # quiescence — every launched sibling resolves exactly once)
+        self._tally_lock = threading.Lock()
+        self.retries = 0
+        self.budget_refused = 0
+        self.hedges_launched = 0
+        self.hedge_wins = 0
+        self.hedge_losers = 0
         self._last_fatal = None  # last fatal typed reply on this client
         self._sock = self._dial()
         self.max_frame_bytes = None  # learned from health(), if called
@@ -190,17 +261,10 @@ class ServingClient:
         return reply, body
 
     def _typed_error(self, reply: dict) -> ServingError:
-        code = reply.get("error", "error")
-        err = _ERRORS.get(code, ServingError)(reply.get("detail", code))
-        err.code = code  # wire code survives even for unmapped errors
-        if reply.get("trace") is not None:
-            # typed failures stay joinable to server-side spans: the
-            # reply's trace stamp (id + any timeline) rides the error
-            err.trace = reply["trace"]
-            err.trace_id = reply["trace"].get("id")
-        if reply.get("retry_after_ms") is not None:
-            # RetryPolicy reads this attribute as its backoff hint
-            err.retry_after = float(reply["retry_after_ms"]) / 1e3
+        # typed failures stay joinable to server-side spans: the
+        # reply's trace stamp (id + any timeline) rides the error
+        err = _reply_error(reply)
+        code = err.code
         if reply.get("fatal"):
             # the server closes this connection right after a fatal
             # reply (e.g. frame_too_large: the stream is unrecoverable);
@@ -237,22 +301,180 @@ class ServingClient:
         included) carries a FRESH child context on the wire, so each
         server-side span gets its own id under the same trace; the
         attempt count lands on ``last_attempts``."""
+        cancel = threading.Event()  # set when a hedge sibling won
+
         if trace_ctx is None:
-            roundtrip = lambda: self._roundtrip(header, payload)  # noqa: E731
+            def roundtrip():
+                if cancel.is_set():
+                    raise _HedgeAbandoned()
+                return self._roundtrip(header, payload)
         else:
             self.last_attempts = 0
 
             def roundtrip():
+                if cancel.is_set():
+                    raise _HedgeAbandoned()
                 self.last_attempts += 1
                 header["trace"] = trace_ctx.child().to_wire()
                 return self._roundtrip(header, payload)
 
+        verb = header.get("verb")
+        data_verb = verb in _HEDGEABLE and not header.get("stream")
+        budget = self._retry_budget if data_verb else None
+        if budget is not None:
+            budget.note_attempt()  # the original attempt's deposit
         if self._retry is None:
-            return roundtrip()
-        retry_on = (OverloadedError,)
-        if idempotent:
-            retry_on = retry_on + (ConnectionError, OSError)
-        return self._retry.call(roundtrip, retry_on=retry_on)
+            runner = roundtrip
+        else:
+            retry_on = (OverloadedError,)
+            if idempotent:
+                retry_on = retry_on + (ConnectionError, OSError)
+
+            def on_retry(e, attempt, d):
+                if cancel.is_set():
+                    raise e  # abandoned primary: stop, spend nothing
+                if budget is not None and not budget.acquire():
+                    # budget exhausted: surface the ORIGINAL typed
+                    # error immediately — a budget never amplifies
+                    with self._tally_lock:
+                        self.budget_refused += 1
+                    raise e
+                with self._tally_lock:
+                    self.retries += 1
+                # wire-mark the resend so the router can enforce its
+                # own fleet-wide budget on top of this client's
+                header["retry"] = attempt
+
+            def runner():
+                return self._retry.call(
+                    roundtrip, retry_on=retry_on, on_retry=on_retry
+                )
+
+        hedge_wanted = (
+            self.hedge_after is not None and idempotent and data_verb
+        )
+        if not hedge_wanted:
+            if not data_verb:
+                return runner()
+            t0 = time.monotonic()
+            out = runner()
+            self._lat.note(time.monotonic() - t0)
+            return out
+        return self._hedged(runner, header, payload, cancel)
+
+    def _hedged(self, primary_fn, header, payload, cancel):
+        """Run ``primary_fn`` with a hedge sibling: if the primary is
+        still in flight after the resolved hedge delay (and the retry
+        budget grants a token), a one-shot duplicate goes out on a
+        FRESH connection; the first usable (ok) reply wins. The
+        loser's connection is discarded, never pooled — a hedge-beaten
+        primary's socket still has a reply in flight on it."""
+        delay = resolve_hedge_delay(self.hedge_after, self._lat)
+        budget = self._retry_budget
+        if delay is None:  # not enough latency evidence yet
+            t0 = time.monotonic()
+            out = primary_fn()
+            self._lat.note(time.monotonic() - t0)
+            return out
+        t0 = time.monotonic()
+        cv = threading.Condition()
+        state = {"primary": None, "hedge": None, "winner": None}
+
+        def finish(kind, result=None, exc=None):
+            """Record a side's outcome; returns True when this side
+            became the winner (first usable reply)."""
+            with cv:
+                state[kind] = (result, exc)
+                won = exc is None and state["winner"] is None
+                if won:
+                    state["winner"] = kind
+                cv.notify_all()
+                return won
+
+        def run_primary():
+            try:
+                finish("primary", result=primary_fn())
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                finish("primary", exc=e)
+
+        hedged = False
+
+        def run_hedge():
+            try:
+                won = finish(
+                    "hedge", result=self._hedge_roundtrip(header, payload)
+                )
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                finish("hedge", exc=e)
+                won = False
+            if not won:
+                with self._tally_lock:
+                    self.hedge_losers += 1
+
+        threading.Thread(target=run_primary, daemon=True).start()
+        with cv:
+            cv.wait_for(
+                lambda: state["primary"] is not None, timeout=delay
+            )
+            primary_done = state["primary"] is not None
+        if not primary_done and (budget is None or budget.acquire()):
+            hedged = True
+            with self._tally_lock:
+                self.hedges_launched += 1
+            threading.Thread(target=run_hedge, daemon=True).start()
+        with cv:
+            cv.wait_for(
+                lambda: state["winner"] is not None
+                or (
+                    state["primary"] is not None
+                    and (not hedged or state["hedge"] is not None)
+                )
+            )
+            winner = state["winner"]
+        if winner == "hedge":
+            # abandon the primary: its socket has a stale reply in
+            # flight — drop it (never pool it) and stop its retry loop
+            cancel.set()
+            self._drop()
+            with self._tally_lock:
+                self.hedge_wins += 1
+            reply, body = state["hedge"][0]
+            if reply.get("served_by") is not None:
+                self.last_served_by = (
+                    reply["served_by"][0], int(reply["served_by"][1])
+                )
+            self._lat.note(time.monotonic() - t0)
+            return reply, body
+        if winner == "primary":
+            self._lat.note(time.monotonic() - t0)
+            return state["primary"][0]
+        # both sides failed: surface the PRIMARY's error (it carries
+        # this client's fatal bookkeeping and retry history)
+        raise state["primary"][1]
+
+    def _hedge_roundtrip(self, header, payload):
+        """The hedge sibling's one-shot attempt: fresh dial, one
+        request/reply, socket ALWAYS closed (a loser's connection must
+        never rejoin the pool), no retries (the hedge IS the retry),
+        and no shared-state side effects — a losing hedge must not
+        drop the primary's connection or poison its fatal ledger."""
+        sock = self._dial()
+        try:
+            hdr = dict(header)
+            hdr["hedge"] = True  # observability: mark the duplicate
+            send_data(sock, pack_frame(hdr, payload))
+            reply, body = unpack_frame(recv_data(sock))
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        # a routed reply already carries the replica's stamp; a direct
+        # server reply gets this client's target endpoint
+        reply.setdefault("served_by", [self._host, self._port])
+        if not reply.get("ok"):
+            raise _reply_error(reply)
+        return reply, body
 
     # -- verbs --------------------------------------------------------------
 
@@ -501,6 +723,10 @@ class TokenStream:
         self._header = header
         self._payload = payload
         self._ctx = trace_ctx
+        if client._retry_budget is not None:
+            # the stream's original send is this budget's deposit;
+            # resends withdraw in _maybe_retry
+            client._retry_budget.note_attempt()
         self._span = None
         self._started = False
         self._done = False
@@ -556,7 +782,8 @@ class TokenStream:
     def _maybe_retry(self, exc) -> bool:
         """One resend decision under the client's policy: True =
         resend scheduled (skip set), False = surface ``exc``."""
-        policy = self._client._retry
+        cli = self._client
+        policy = cli._retry
         if policy is None:
             return False
         self._attempt += 1
@@ -573,6 +800,17 @@ class TokenStream:
             time.monotonic() - start + d > policy.budget
         ):
             return False
+        if cli._retry_budget is not None:
+            if not cli._retry_budget.acquire():
+                # budget exhausted: surface the original typed error
+                # now instead of amplifying the storm with a resend
+                with cli._tally_lock:
+                    cli.budget_refused += 1
+                return False
+            with cli._tally_lock:
+                cli.retries += 1
+        # wire-mark the resend for the router's fleet-wide budget
+        self._header["retry"] = self._attempt
         time.sleep(d)
         self._skip = len(self.tokens)
         self._started = False

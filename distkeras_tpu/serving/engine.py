@@ -2943,7 +2943,8 @@ class ServingEngine:
                  slos=None, slo_interval=5.0, paged=False,
                  page_size=16, num_pages=None, qos=None, mesh=None,
                  role="unified", history=True, history_interval=1.0,
-                 history_capacity=600, trace_ring=8192, overlap=True):
+                 history_capacity=600, trace_ring=8192, overlap=True,
+                 shed=False):
         """``prefill_chunk``: per-scheduler-iteration prefill token
         budget — "auto" picks ``max(16, seq_len // 8)``, an int sets it
         directly, None disables chunking (full synchronous prefill at
@@ -3059,7 +3060,19 @@ class ServingEngine:
         sequential control (the bench A/B's baseline side). The bubble
         is measured either way: ``serving_step_bubble_seconds`` /
         ``serving_overlap_efficiency`` in the registry and an
-        ``overlap`` block on ``health()``."""
+        ``overlap`` block on ``health()``.
+
+        ``shed``: adaptive load shedding at the admission door. False
+        (the default) keeps the door exactly as it was. True builds a
+        ``resilience.AdmissionController`` with defaults, a dict
+        passes constructor kwargs, an instance is used as-is; the
+        gate's brownout ladder is driven by THIS engine's burn-rate
+        verdicts (``burn_verdict``), its CoDel side by admitted
+        queue sojourns, and its refusals are typed ``overloaded``
+        with honest sojourn-derived ``retry_after_ms``. State rides
+        ``health()["shed"]``; the gate object survives supervisor
+        restarts (its congestion history is evidence, not state to
+        reset)."""
         from distkeras_tpu.obs import MetricsRegistry
 
         self.model = model
@@ -3202,10 +3215,26 @@ class ServingEngine:
             self._decode_err = e
         if self._stepper is not None and prefill_chunk == "auto":
             prefill_chunk = max(16, self._stepper.max_len // 8)
+        from distkeras_tpu.serving.resilience import as_shed_gate
+
+        # the overload gate rides _batcher_cfg so a supervisor-rebuilt
+        # batcher keeps the SAME gate (sojourn history and brownout
+        # state are evidence about the host, not about one batcher)
+        self.shed_gate = as_shed_gate(shed, burn_fn=self.burn_verdict)
+        if self.shed_gate is not None:
+            # brownout rung as a gauge (0=ok..3=refuse) so dkt_top and
+            # the history rings can see shedding without a stats RPC;
+            # registered only when shedding is enabled so default
+            # metric sets stay byte-identical
+            self.registry.gauge(
+                "serving_shed_rung",
+                fn=lambda: self.shed_gate.state()["rung"],
+            )
         self._batcher_cfg = dict(
             queue_capacity=queue_capacity, prefill_chunk=prefill_chunk,
             quarantine_steps=quarantine_steps, registry=self.registry,
             recorder=self.recorder, qos=qos, overlap=overlap,
+            shed_gate=self.shed_gate,
         )
         self.qos = qos
         self.batcher = (
@@ -4129,6 +4158,9 @@ class ServingEngine:
         cfg = dict(self._batcher_cfg)
         cfg.pop("registry", None)
         cfg.pop("recorder", None)
+        gate = cfg.pop("shed_gate", None)
+        if gate is not None:
+            cfg["shed"] = gate.state()
         cfg.update(
             model=type(self.model).__name__,
             num_slots=(
@@ -4280,6 +4312,11 @@ class ServingEngine:
                 "enabled": batcher.overlap,
                 **batcher.overlap_ledger.snapshot(),
             }
+        if self.shed_gate is not None:
+            # overload-gate state for routers and dkt_top: the current
+            # brownout rung, whether the CoDel side is shedding, and
+            # the sojourn EWMA behind the honest retry_after hints
+            out["shed"] = self.shed_gate.state()
         out["heartbeat_age"] = (
             None
             if batcher is None or not self._started

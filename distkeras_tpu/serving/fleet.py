@@ -77,7 +77,11 @@ from distkeras_tpu.networking import probe, recv_data, send_data
 from distkeras_tpu.obs import stamp_error_trace as _stamp_trace
 from distkeras_tpu.serving.prefix_cache import _pow2_ladder
 from distkeras_tpu.serving.qos import as_bucket
-from distkeras_tpu.serving.scheduler import QuotaExhaustedError, ServingError
+from distkeras_tpu.serving.scheduler import (
+    QuotaExhaustedError,
+    ServingError,
+    ShedError,
+)
 from distkeras_tpu.utils.serialization import (
     deserialize_params,
     pack_frame,
@@ -127,7 +131,7 @@ class _RetrySibling(Exception):
 class _Replica:
     """Router-side book of one replica endpoint."""
 
-    def __init__(self, endpoint):
+    def __init__(self, endpoint, breaker=None, hist=None):
         self.endpoint = (endpoint[0], int(endpoint[1]))
         self.state = JOINING
         self.fails = 0          # consecutive failed health polls
@@ -137,6 +141,11 @@ class _Replica:
         self.failovers = 0      # forwards that died here and moved on
         self.slo_breaches = 0   # consecutive polls reporting slo breach
         self.last_health = None
+        # gray-failure defense (None on a breaker-less router): the
+        # per-replica circuit breaker and the labeled forward-latency
+        # histogram its latency-outlier judgment is computed from
+        self.breaker = breaker
+        self.hist = hist
 
     def snapshot(self) -> dict:
         h = self.last_health or {}
@@ -174,6 +183,12 @@ class _Replica:
             "pool_exhausted_rate": h.get("pool_exhausted_rate"),
             "queue_depth_trend": h.get("queue_depth_trend"),
             "burn": h.get("burn"),
+            # circuit-breaker state (None on a breaker-less router):
+            # closed / open / half_open + the cause of the last open —
+            # rides health replies and the dkt_top fleet table
+            "breaker": (
+                None if self.breaker is None else self.breaker.snapshot()
+            ),
         }
 
 
@@ -197,7 +212,8 @@ class FleetRouter:
                  affinity=True, affinity_min_len=8,
                  postmortem_dir=None, eject_on_slo_breach=0,
                  recorder_capacity=1024, tenant_quotas=None,
-                 quota_default=None):
+                 quota_default=None, breaker=None, retry_budget=None,
+                 hedge_after=None):
         """``eject_after``: consecutive failed health polls before an
         ACTIVE replica leaves rotation (a mid-forward connection death
         ejects immediately — the poll budget is for the quiet path).
@@ -225,7 +241,37 @@ class FleetRouter:
         ``retry_after_ms`` — one tenant's burst is shed before it
         holds pages or queue slots anywhere in the fleet.
         ``quota_default``: the bucket spec applied to tenants not
-        named in ``tenant_quotas`` (None = unlimited)."""
+        named in ``tenant_quotas`` (None = unlimited).
+
+        ``breaker``: per-replica circuit breakers (None — the default
+        — disables them; True = defaults; a dict passes
+        ``resilience.CircuitBreaker`` kwargs, plus three router-side
+        sweep knobs it may carry: ``outlier_factor`` (trip when a
+        replica's windowed forward p-quantile exceeds factor × the
+        fleet median, default 3.0), ``min_latency`` (seconds — below
+        this, never an outlier: microsecond jitter is not gray
+        failure; default 0.010), ``quantile`` (default 0.99)).
+        Breakers trip on typed-error rate AND on latency outliers —
+        the slow-but-health-green replica binary ejection can't see —
+        and COMPOSE with ejection: a dead replica still ejects, a
+        gray one opens its breaker and stops receiving traffic until
+        a half-open probe proves it recovered.
+
+        ``retry_budget``: a fleet-wide ``resilience.RetryBudget``
+        (True = defaults, dict = kwargs, instance = as-is) enforced on
+        retry-MARKED requests (clients stamp resends with a ``retry``
+        header field): original attempts deposit, retries withdraw,
+        and an exhausted budget refuses the retry typed ``overloaded``
+        (``serving_retry_budget_exhausted`` counter) so a thousand
+        clients' individually-sane retries cannot compound into a
+        storm that keeps the brownout alive.
+
+        ``hedge_after``: router-side request hedging for idempotent
+        verbs — seconds, or ``"p95"`` style (resolved from the
+        router's own windowed forward-latency history). When the
+        primary forward is still in flight after the delay, a sibling
+        forward launches against a DIFFERENT replica and the first ok
+        reply wins; hedges spend the retry budget when one is set."""
         self.max_frame_bytes = int(max_frame_bytes)
         self.health_interval = float(health_interval)
         self.health_timeout = float(health_timeout)
@@ -250,6 +296,27 @@ class FleetRouter:
         self._quota_buckets: dict[str, object] = {}
         self._quota_counters: dict[str, object] = {}
         self._quota_seen: set[str] = set(self._quota_specs)
+        # overload / gray-failure defense config (resilience.py)
+        from distkeras_tpu.serving.resilience import (
+            as_breaker_config,
+            as_retry_budget,
+            resolve_hedge_delay,
+        )
+
+        cfg = as_breaker_config(breaker)
+        self.breaker_outlier_factor = 3.0
+        self.breaker_min_latency = 0.010
+        self.breaker_quantile = 0.99
+        if cfg is not None:
+            self.breaker_outlier_factor = float(cfg.pop("outlier_factor", 3.0))
+            self.breaker_min_latency = float(cfg.pop("min_latency", 0.010))
+            self.breaker_quantile = float(cfg.pop("quantile", 0.99))
+        self._breaker_cfg = cfg
+        self.breaker_window = float((cfg or {}).get("window", 30.0))
+        self.retry_budget = as_retry_budget(retry_budget)
+        self.hedge_after = hedge_after
+        if isinstance(hedge_after, (str, int, float)):
+            resolve_hedge_delay(hedge_after, None)  # validate the spec
         self.last_postmortem = None
         self.last_postmortem_path = None
         self._lock = threading.Lock()
@@ -293,8 +360,40 @@ class FleetRouter:
                 "transfer_typed",  # ... that ended typed (any error)
                 "transfer_retries",  # mid-hop deaths retried on a
                 # sibling decode worker (same bytes, bounded)
+                # circuit breakers (0 on a breaker-less router)
+                "breaker_opens",       # closed/half_open -> open
+                "breaker_half_opens",  # open -> half_open (probe armed)
+                "breaker_closes",      # half_open -> closed (recovered)
+                "breaker_probes",      # live requests routed as probes
+                "breaker_bypass_forwards",  # non-probe forwards to a
+                # non-closed breaker — 0 BY CONSTRUCTION; the bench
+                # gates on it (no breaker-open replica receives a
+                # non-probe request)
+                # router-side hedging (0 without hedge_after). Pairing
+                # invariant at quiescence: launched == wins + losers
+                "hedges_launched",
+                "hedge_wins",
+                "hedge_losers",
             ),
         )
+        # the fleet-wide retry-budget refusal counter: refusals here
+        # are typed ``overloaded`` replies that deliberately did NOT
+        # amplify a retry storm
+        self.retry_budget_exhausted = self.registry.counter(
+            "serving_retry_budget_exhausted", fresh=True
+        )
+        if self._breaker_cfg is not None:
+            # how many replicas are currently cut off (open or probing)
+            # — the dkt_top header column; registered only on a
+            # breaker-enabled router so default metric sets are
+            # byte-identical to before
+            self.registry.gauge(
+                "fleet_router_breaker_open_replicas",
+                fn=lambda: sum(
+                    1 for r in list(self._replicas.values())
+                    if r.breaker is not None and r.breaker.state != "closed"
+                ),
+            )
         self._transfer_inflight = 0
         self.registry.gauge(
             "fleet_router_transfer_inflight",
@@ -343,7 +442,7 @@ class FleetRouter:
             self.registry.snapshot, interval=1.0, capacity=600,
         )
         for ep in endpoints:
-            self._replicas[(ep[0], int(ep[1]))] = _Replica(ep)
+            self._replicas[(ep[0], int(ep[1]))] = self._new_replica(ep)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, int(port)))
@@ -442,6 +541,23 @@ class FleetRouter:
 
     # -- rotation management (the controller's face) ------------------------
 
+    def _new_replica(self, ep):
+        """Build a ``_Replica``, attaching a circuit breaker and a
+        per-replica labeled forward-latency histogram when breakers are
+        configured. Breaker-less routers keep the exact metric set they
+        had before (no stray labeled series)."""
+        if self._breaker_cfg is None:
+            return _Replica(ep)
+        from distkeras_tpu.serving.resilience import CircuitBreaker
+
+        hist = self.registry.histogram(
+            "fleet_router_forward_seconds",
+            labels={"replica": f"{ep[0]}:{ep[1]}"},
+        )
+        return _Replica(
+            ep, breaker=CircuitBreaker(**self._breaker_cfg), hist=hist
+        )
+
     def add_replica(self, endpoint) -> None:
         """Register an endpoint. It enters rotation only after a clean
         health poll (health-gated admission) — call
@@ -450,7 +566,7 @@ class FleetRouter:
         with self._lock:
             rep = self._replicas.get(ep)
             if rep is None:
-                self._replicas[ep] = _Replica(ep)
+                self._replicas[ep] = self._new_replica(ep)
             elif rep.state == DRAINING:
                 # re-adding a drained replica UN-drains it (the aborted-
                 # rollover path); it still re-enters via the health gate
@@ -563,6 +679,9 @@ class FleetRouter:
         deadline = time.monotonic() + self.health_timeout + 2.0
         for th in threads:
             th.join(timeout=max(0.0, deadline - time.monotonic()))
+        # gray-failure detection rides the sweep cadence: compare each
+        # replica's windowed forward quantile against the fleet median
+        self._breaker_latency_sweep()
 
     def _poll_one(self, ep):
         with self._lock:
@@ -837,6 +956,34 @@ class FleetRouter:
             retry_after_ms=wait * 1e3,
         )
 
+    def _check_retry_budget(self, header: dict) -> None:
+        """Fleet-side retry-storm damping: original attempts deposit
+        into the shared budget, retry-marked requests (the client
+        stamps resends with a ``retry`` header field) withdraw — and
+        when the fleet-wide budget is dry the retry is refused typed
+        ``overloaded`` IMMEDIATELY, without touching a replica. This
+        is the second enforcement point behind the client's own
+        budget: a thousand clients each retrying within their
+        individual budgets still cannot compound into a fleet-wide
+        amplification storm."""
+        if self.retry_budget is None:
+            return
+        if not header.get("retry"):
+            self.retry_budget.note_attempt()
+            return
+        if self.retry_budget.acquire():
+            return
+        self.retry_budget_exhausted.inc()
+        self.recorder.record(
+            "router.retry_budget_exhausted",
+            verb=header.get("verb"),
+            attempt=header.get("retry"),
+        )
+        raise ShedError(
+            "fleet retry budget exhausted; not amplifying retries",
+            retry_after_ms=self.retry_after_ms,
+        )
+
     def _roles(self):
         """Role partition of the ACTIVE rotation: ``(prefill_n,
         decode_n, disagg)`` — disagg dispatch engages only when BOTH
@@ -858,6 +1005,8 @@ class FleetRouter:
     def _dispatch(self, header: dict, payload: bytes) -> bytes:
         verb = header.get("verb")
         faults.fire("router.dispatch", verb=verb)
+        if verb in ("generate", "predict"):
+            self._check_retry_budget(header)
         if verb == "generate":
             self._check_quota(header)
             if self._roles()[2]:
@@ -867,7 +1016,7 @@ class FleetRouter:
                 reply, body = self._route_disagg(header, payload)
                 return pack_frame(reply, body)
         if verb in ("generate", "predict"):
-            reply, body = self._route(header, payload)
+            reply, body = self._route_maybe_hedged(header, payload)
             return pack_frame(reply, body)
         if verb == "health":
             return pack_frame(self._health_reply())
@@ -1130,10 +1279,13 @@ class FleetRouter:
         return affinity_key(prompt, min_len=self.affinity_min_len)
 
     def _pick(self, key, excluded, roles=None):
-        """One routing decision under the lock: ``(replica, how)`` or
-        ``(None, why)`` — ``why`` is "empty" (nothing in rotation),
-        "tried" (every rotation member already excluded this request),
-        or "saturated" (members remain but none has capacity).
+        """One routing decision under the lock: ``(replica, how,
+        probe)`` or ``(None, why, False)`` — ``why`` is "empty"
+        (nothing in rotation), "tried" (every rotation member already
+        excluded this request), or "saturated" (members remain but
+        none has capacity). ``probe`` is True when the pick is a
+        half-open breaker probe: the request is the live canary that
+        decides whether the breaker closes.
         ``roles``: restrict candidates to replicas whose health
         advertises one of these disaggregation roles (None = any —
         the role-less fleet's behavior, byte-for-byte)."""
@@ -1145,10 +1297,43 @@ class FleetRouter:
             )
         ]
         if not cands:
-            return None, "empty"
+            return None, "empty", False
         fresh = [r for r in cands if r.endpoint not in excluded]
         if not fresh:
-            return None, "tried"
+            return None, "tried", False
+        if self._breaker_cfg is not None:
+            from distkeras_tpu.serving import resilience
+
+            # probes preempt normal routing: an open breaker must not
+            # starve its own recovery behind healthy siblings
+            due = [
+                r for r in fresh
+                if r.breaker is not None and r.breaker.probe_due()
+            ]
+            if due:
+                rep = min(due, key=lambda r: r.breaker.opened_at or 0.0)
+                granted, change = rep.breaker.try_probe()
+                if granted:
+                    self._breaker_change(rep.endpoint, change)
+                    return rep, "probe", True
+            allowed = [
+                r for r in fresh
+                if r.breaker is None
+                or r.breaker.state == resilience.CLOSED
+            ]
+            if not allowed:
+                # every candidate's breaker is open/probing: force one
+                # probe through rather than refusing a fleet that may
+                # have recovered (least-recently-opened goes first)
+                for rep in sorted(
+                    fresh, key=lambda r: r.breaker.opened_at or 0.0
+                ):
+                    granted, change = rep.breaker.try_probe(force=True)
+                    if granted:
+                        self._breaker_change(rep.endpoint, change)
+                        return rep, "probe", True
+                return None, "saturated", False
+            fresh = allowed
         if key is not None:
             order = sorted(
                 fresh,
@@ -1157,8 +1342,8 @@ class FleetRouter:
             )
             for i, rep in enumerate(order):
                 if rep.capacity is None or rep.in_flight < rep.capacity:
-                    return rep, ("affinity" if i == 0 else "spill")
-            return None, "saturated"
+                    return rep, ("affinity" if i == 0 else "spill"), False
+            return None, "saturated", False
         order = sorted(
             fresh,
             key=lambda r: (
@@ -1167,10 +1352,106 @@ class FleetRouter:
         )
         for rep in order:
             if rep.capacity is None or rep.in_flight < rep.capacity:
-                return rep, "least_loaded"
-        return None, "saturated"
+                return rep, "least_loaded", False
+        return None, "saturated", False
 
-    def _route(self, header: dict, payload: bytes):
+    _HOW_COUNTER = {
+        "affinity": "affinity_routed",
+        "spill": "spilled",
+        "least_loaded": "least_loaded_routed",
+        "probe": "breaker_probes",
+    }
+
+    def _breaker_change(self, ep, change, cause=None):
+        """Account a breaker state transition (counter + recorder).
+        Lock-free leaves only — safe under or outside the router
+        lock; no-op when ``change`` is None."""
+        if change is None:
+            return
+        old, new = change
+        from distkeras_tpu.serving import resilience
+
+        key = {
+            resilience.OPEN: "breaker_opens",
+            resilience.HALF_OPEN: "breaker_half_opens",
+            resilience.CLOSED: "breaker_closes",
+        }[new]
+        self.counters[key] += 1
+        self.recorder.record(
+            "router.breaker", endpoint=f"{ep[0]}:{ep[1]}",
+            old=old, new=new, cause=cause,
+        )
+
+    def _note_breaker(self, ep, ok, probe):
+        """Feed one forward outcome to ``ep``'s breaker (no-op on a
+        breaker-less router). ``probe`` outcomes settle the half-open
+        state; normal outcomes feed the windowed error rate."""
+        if self._breaker_cfg is None:
+            return
+        with self._lock:
+            rep = self._replicas.get(ep)
+            br = rep.breaker if rep is not None else None
+        if br is None:
+            return
+        if probe:
+            change = br.record_probe(ok)
+        elif ok:
+            change = br.record_success()
+        else:
+            change = br.record_failure()
+        self._breaker_change(ep, change, cause=br.open_cause)
+
+    def _breaker_latency_sweep(self):
+        """Latency-outlier detection: compare each ACTIVE replica's
+        windowed forward-latency quantile against the fleet median and
+        feed ``note_latency`` streaks. This is the gray-failure seam —
+        a replica whose health polls stay green but whose forwards run
+        3× the fleet is tripped here, where binary ejection never
+        would. Replicas with no windowed data are SKIPPED (unknown is
+        neutral, not healthy: a silent streak reset would mask an
+        outlier that briefly stopped receiving traffic)."""
+        if self._breaker_cfg is None or self.history is None:
+            return
+        with self._lock:
+            reps = [
+                r for r in self._replicas.values()
+                if r.state == ACTIVE and r.breaker is not None
+            ]
+        if len(reps) < 2:
+            return
+        vals = {}
+        for r in reps:
+            ep = r.endpoint
+            q = self.history.quantile_over(
+                "fleet_router_forward_seconds",
+                window=self.breaker_window, q=self.breaker_quantile,
+                labels={"replica": f"{ep[0]}:{ep[1]}"},
+            )
+            if q is not None:
+                vals[ep] = q
+        if len(vals) < 2:
+            return
+        ordered = sorted(vals.values())
+        # LOWER median: with 2 replicas the upper median IS the slow
+        # one's own quantile, which could never exceed 3× itself — a
+        # two-replica fleet with one gray member must still trip
+        med = ordered[(len(ordered) - 1) // 2]
+        for r in reps:
+            ep = r.endpoint
+            if ep not in vals:
+                continue  # no data: neither outlier nor reset
+            v = vals[ep]
+            outlier = (
+                v > self.breaker_outlier_factor * max(med, 1e-9)
+                and v >= self.breaker_min_latency
+            )
+            change = r.breaker.note_latency(outlier)
+            self._breaker_change(
+                ep, change, cause=r.breaker.open_cause
+            )
+
+    def _route(self, header: dict, payload: bytes, picked=None,
+               pre_excluded=None):
         """Pick a replica, forward, failover. Returns ``(reply, body)``
         to relay verbatim (the replica's typed errors — deadline,
         internal, bad_request — pass through untouched; only fleet-wide
@@ -1198,7 +1479,11 @@ class FleetRouter:
                 ),
             )
             header = dict(header)  # per-attempt child contexts below
-        excluded: set = set()
+        # ``picked`` (shared list): a hedged sibling call appends its
+        # endpoints here so the hedge excludes them (first-wins only
+        # means anything when the two attempts land on DIFFERENT
+        # replicas); ``pre_excluded`` is that exclusion set
+        excluded: set = set(pre_excluded or ())
         causes = []
         saw_overloaded_hint = None
 
@@ -1223,19 +1508,23 @@ class FleetRouter:
         roles = (
             (None, "unified", "decode") if verb == "generate" else None
         )
+        if picked is None:
+            picked = []
         while True:
             with self._lock:
-                rep, how = self._pick(key, excluded, roles=roles)
+                rep, how, probe = self._pick(key, excluded, roles=roles)
                 if rep is not None:
                     rep.in_flight += 1
                     rep.forwards += 1
                     self.counters["forwards"] += 1
-                    self.counters[
-                        {"affinity": "affinity_routed",
-                         "spill": "spilled",
-                         "least_loaded": "least_loaded_routed"}[how]
-                    ] += 1
+                    self.counters[self._HOW_COUNTER[how]] += 1
                     ep = rep.endpoint
+                    picked.append(ep)
+                    if (rep.breaker is not None and not probe
+                            and rep.breaker.state != "closed"):
+                        # defensive tripwire — 0 by construction; the
+                        # bench gates on it staying 0
+                        self.counters["breaker_bypass_forwards"] += 1
             if rep is None:
                 if how == "saturated" or saw_overloaded_hint is not None:
                     with self._lock:
@@ -1282,6 +1571,7 @@ class FleetRouter:
                 self._checkin(ep, cli)
             except (ConnectionError, OSError) as e:
                 hops.append(f"{ep[0]}:{ep[1]} died")
+                self._note_breaker(ep, ok=False, probe=probe)
                 self._forward_died(ep, e, causes, excluded)
                 # every verb _dispatch routes today IS idempotent, so
                 # this always continues (bounded: ep now in excluded);
@@ -1292,12 +1582,24 @@ class FleetRouter:
                     continue
                 raise
             finally:
-                self._forward_hist.observe(time.monotonic() - fwd_t0)
+                dt = time.monotonic() - fwd_t0
+                self._forward_hist.observe(dt)
                 with self._lock:
                     r = self._replicas.get(ep)
                     if r is not None:
                         r.in_flight -= 1
+                        if r.hist is not None:
+                            r.hist.observe(dt)
                         self._drained.notify_all()
+            # backpressure (overloaded/quota) is the replica WORKING,
+            # not failing — only internal errors count against the
+            # breaker's error window
+            self._note_breaker(
+                ep,
+                ok=(bool(reply.get("ok"))
+                    or reply.get("error") != "internal"),
+                probe=probe,
+            )
             if (not reply.get("ok")
                     and reply.get("error") == "overloaded"):
                 # replica-level saturation the router's accounting
@@ -1331,7 +1633,127 @@ class FleetRouter:
                 how=how, replica=f"{ep[0]}:{ep[1]}",
             ), body
 
-    # -- disaggregated dispatch (prefill -> kv.transfer -> decode) ----------
+    # -- router-side hedging ------------------------------------------------
+
+    def _route_maybe_hedged(self, header: dict, payload: bytes):
+        """``_route``, hedged when configured: when the primary
+        forward is still in flight after the hedge delay, launch a
+        sibling attempt against a replica the primary has NOT touched
+        and return the first ok reply. Safe because every hedged verb
+        is idempotent and served decode is deterministic — the two
+        replies are token-identical, so first-wins changes latency,
+        never content."""
+        delay = self._hedge_delay()
+        if delay is None:
+            return self._route(header, payload)
+        return self._route_hedged(header, payload, delay)
+
+    def _hedge_delay(self):
+        """Resolve ``hedge_after`` to seconds for THIS request: a
+        number is used as-is; a ``"p95"`` spec reads the router's own
+        windowed forward-latency history (None — no hedging — until
+        that window has data)."""
+        if self.hedge_after is None:
+            return None
+        if isinstance(self.hedge_after, str):
+            q = float(self.hedge_after[1:]) / 100.0
+            return self.history.quantile_over(
+                "fleet_router_forward_seconds", window=60.0, q=q,
+            )
+        return float(self.hedge_after)
+
+    def _route_hedged(self, header: dict, payload: bytes, delay):
+        """First-usable-reply-wins pair of ``_route`` calls. The
+        hedge excludes every replica the primary picked (a hedge
+        landing on the same gray replica defends nothing); its header
+        carries ``hedge: True`` purely for observability. The loser's
+        reply is discarded — both attempts run to completion on their
+        replicas (the router cannot cancel a forwarded request), which
+        is the standard hedging trade: bounded extra work for cut tail
+        latency. Hedges spend the retry budget when one is set, so a
+        brownout throttles hedging before hedging feeds the brownout."""
+        cond = threading.Condition()
+        state = {"primary": None, "hedge": None, "winner": None}
+
+        def finish(kind, result):
+            with cond:
+                state[kind] = result
+                if state["winner"] is None and result is not None:
+                    reply = result[0]
+                    if isinstance(reply, dict) and reply.get("ok"):
+                        state["winner"] = kind
+                cond.notify_all()
+
+        picked: list = []
+
+        def run_primary():
+            try:
+                res = self._route(header, payload, picked=picked)
+            except BaseException as e:  # noqa: BLE001 — wire boundary
+                res = (
+                    {"ok": False, "error": "internal",
+                     "detail": repr(e)},
+                    b"",
+                )
+            finish("primary", res)
+
+        t_primary = threading.Thread(
+            target=run_primary, name="fleet-hedge-primary", daemon=True
+        )
+        t_primary.start()
+        with cond:
+            cond.wait_for(
+                lambda: state["primary"] is not None, timeout=delay
+            )
+            primary_done = state["primary"] is not None
+        hedged = False
+        if not primary_done and (
+            self.retry_budget is None or self.retry_budget.acquire()
+        ):
+            hedged = True
+            with self._lock:
+                self.counters["hedges_launched"] += 1
+            self.recorder.record(
+                "router.hedge", verb=header.get("verb"),
+                delay_ms=round(delay * 1e3, 3),
+            )
+
+            def run_hedge():
+                hdr2 = dict(header)
+                hdr2["hedge"] = True
+                try:
+                    res = self._route(
+                        hdr2, payload, pre_excluded=set(picked)
+                    )
+                except BaseException as e:  # noqa: BLE001
+                    res = (
+                        {"ok": False, "error": "internal",
+                         "detail": repr(e)},
+                        b"",
+                    )
+                finish("hedge", res)
+
+            threading.Thread(
+                target=run_hedge, name="fleet-hedge", daemon=True
+            ).start()
+        with cond:
+            cond.wait_for(lambda: (
+                state["winner"] is not None
+                or (state["primary"] is not None
+                    and (not hedged or state["hedge"] is not None))
+            ))
+            winner = state["winner"]
+        if hedged:
+            # exactly one ledger entry per launched hedge — the bench
+            # gates launched == wins + losers
+            with self._lock:
+                if winner == "hedge":
+                    self.counters["hedge_wins"] += 1
+                else:
+                    self.counters["hedge_losers"] += 1
+        if winner == "hedge":
+            return state["hedge"]
+        return state["primary"]
 
     def _forward_loop(self, header, payload, key, roles, hops, causes,
                       ctx=None, retry_counter=None):
@@ -1345,16 +1767,12 @@ class FleetRouter:
         saw_hint = None
         while True:
             with self._lock:
-                rep, how = self._pick(key, excluded, roles=roles)
+                rep, how, probe = self._pick(key, excluded, roles=roles)
                 if rep is not None:
                     rep.in_flight += 1
                     rep.forwards += 1
                     self.counters["forwards"] += 1
-                    self.counters[
-                        {"affinity": "affinity_routed",
-                         "spill": "spilled",
-                         "least_loaded": "least_loaded_routed"}[how]
-                    ] += 1
+                    self.counters[self._HOW_COUNTER[how]] += 1
                     ep = rep.endpoint
             if rep is None:
                 if saw_hint is not None and how != "saturated":
@@ -1375,18 +1793,28 @@ class FleetRouter:
                 self._checkin(ep, cli)
             except (ConnectionError, OSError) as e:
                 hops.append(f"{ep[0]}:{ep[1]} died")
+                self._note_breaker(ep, ok=False, probe=probe)
                 self._forward_died(ep, e, causes, excluded)
                 if retry_counter is not None:
                     with self._lock:
                         self.counters[retry_counter] += 1
                 continue
             finally:
-                self._forward_hist.observe(time.monotonic() - fwd_t0)
+                dt = time.monotonic() - fwd_t0
+                self._forward_hist.observe(dt)
                 with self._lock:
                     r = self._replicas.get(ep)
                     if r is not None:
                         r.in_flight -= 1
+                        if r.hist is not None:
+                            r.hist.observe(dt)
                         self._drained.notify_all()
+            self._note_breaker(
+                ep,
+                ok=(bool(reply.get("ok"))
+                    or reply.get("error") != "internal"),
+                probe=probe,
+            )
             if (not reply.get("ok")
                     and reply.get("error") == "overloaded"):
                 hops.append(f"{ep[0]}:{ep[1]} overloaded")
@@ -1591,6 +2019,7 @@ class FleetRouter:
         verb = header.get("verb")
         try:
             faults.fire("router.dispatch", verb=verb)
+            self._check_retry_budget(header)
             self._check_quota(header)
             if self._roles()[2]:
                 # hop 1 (request/reply): prefill the prompt
@@ -1668,16 +2097,12 @@ class FleetRouter:
         saw_hint = None
         while True:
             with self._lock:
-                rep, how = self._pick(key, excluded, roles=roles)
+                rep, how, probe = self._pick(key, excluded, roles=roles)
                 if rep is not None:
                     rep.in_flight += 1
                     rep.forwards += 1
                     self.counters["forwards"] += 1
-                    self.counters[
-                        {"affinity": "affinity_routed",
-                         "spill": "spilled",
-                         "least_loaded": "least_loaded_routed"}[how]
-                    ] += 1
+                    self.counters[self._HOW_COUNTER[how]] += 1
                     ep = rep.endpoint
             if rep is None:
                 what = "decode" if roles == ("decode",) else "serving"
@@ -1748,6 +2173,12 @@ class FleetRouter:
                         if terminal:
                             self._checkin(ep, cli)
                             cli = None
+                            self._note_breaker(
+                                ep,
+                                ok=(bool(reply.get("ok"))
+                                    or reply.get("error") != "internal"),
+                                probe=probe,
+                            )
                             self.recorder.record(
                                 "router.route", verb="generate",
                                 stream=True,
@@ -1767,6 +2198,7 @@ class FleetRouter:
                         cli.close()
                         cli = None
                     hops.append(f"{ep[0]}:{ep[1]} died")
+                    self._note_breaker(ep, ok=False, probe=probe)
                     self._forward_died(ep, e, causes, excluded)
                     if retry_counter is not None:
                         with self._lock:

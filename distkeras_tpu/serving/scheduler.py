@@ -135,6 +135,19 @@ class PoolExhaustedError(OverloadedError):
         self.retry_after = self.retry_after_ms / 1e3
 
 
+class ShedError(OverloadedError):
+    """Refused at the door by the adaptive overload gate
+    (``resilience.AdmissionController``) — plain ``overloaded`` on the
+    wire, but the ``retry_after_ms`` hint is HONEST: the gate's recent
+    observed queue sojourn, not a server-wide constant, so shed
+    clients back off by how congested the queue actually is."""
+
+    def __init__(self, msg, retry_after_ms: float = 50.0):
+        super().__init__(msg)
+        self.retry_after_ms = float(retry_after_ms)
+        self.retry_after = self.retry_after_ms / 1e3
+
+
 class QuotaExhaustedError(OverloadedError):
     """A tenant's admission quota (router-side token bucket) cannot
     cover this request — per-tenant backpressure, shed AT THE DOOR so
@@ -384,7 +397,7 @@ class ContinuousBatcher:
 
     def __init__(self, stepper, queue_capacity=64, prefill_chunk=None,
                  quarantine_steps=64, registry=None, recorder=None,
-                 qos=None, overlap=False):
+                 qos=None, overlap=False, shed_gate=None):
         """``quarantine_steps``: scheduler iterations a slot sits out
         after a device step is blamed on its request (its cache rows are
         suspect, and a systematically poisonous traffic shape should not
@@ -431,7 +444,19 @@ class ContinuousBatcher:
         without a ``step_async`` face (fakes, speculative draft/verify
         — the drafter materializes host state mid-call) run their
         device call synchronously at dispatch; the loop structure and
-        failure surfacing stay identical."""
+        failure surfacing stay identical.
+
+        ``shed_gate``: an optional
+        ``resilience.AdmissionController``. None (the default) keeps
+        the door exactly as it was — admit until ``queue_capacity``,
+        then typed ``overloaded``. A gate is consulted BEFORE the
+        capacity check on every ``submit``: it may shed the request
+        (typed ``overloaded`` with an honest sojourn-derived
+        ``retry_after_ms``) or clamp its ``max_new_tokens`` (brownout
+        rung 2 — deterministic decode makes the clamped reply an
+        exact prefix of the full one), and the admission phase feeds
+        it each admitted request's queue sojourn so the CoDel side
+        has a signal."""
         from distkeras_tpu.serving.qos import _QosQueues
 
         self.stepper = stepper
@@ -439,6 +464,7 @@ class ContinuousBatcher:
         if self.queue_capacity < 1:
             raise ValueError("queue_capacity must be >= 1")
         self.qos = qos
+        self.shed_gate = shed_gate
         self._preemptible = qos is not None and qos.preempt and hasattr(
             stepper, "swap_out"
         )
@@ -505,6 +531,11 @@ class ContinuousBatcher:
             (
                 "submitted",
                 "rejected_overloaded",
+                # adaptive load shedding (0 without a shed gate).
+                # Pairing invariant: every shed is a typed
+                # ``overloaded`` reply carrying ``retry_after_ms``
+                "shed_overloaded",  # refused at the door by the gate
+                "shed_clamped",  # admitted with max_new_tokens clamped
                 "completed",
                 "deadline_exceeded",
                 "steps",
@@ -644,6 +675,31 @@ class ContinuousBatcher:
                     f"request needs {need} KV pages but the pool holds "
                     f"{self.stepper.total_pages}"
                 )
+        if self.shed_gate is not None:
+            # the overload-defense door, OUTSIDE the batcher lock (the
+            # gate has its own leaf lock; its burn_fn walks the
+            # metrics registry): shed/refuse surface as typed
+            # ``overloaded`` with the gate's honest sojourn-derived
+            # retry hint, clamp trims the ask before it queues
+            action, hint_ms, clamp = self.shed_gate.admit(
+                getattr(req, "priority", 0), req.max_new_tokens
+            )
+            t = self.shed_gate.poll_transition()
+            if t is not None and self.recorder is not None:
+                self.recorder.record(
+                    "scheduler.shed_rung", old=t[0], new=t[1],
+                    **self.shed_gate.state(),
+                )
+            if action != "admit":
+                self.counters["shed_overloaded"] += 1
+                raise ShedError(
+                    "admission shed by overload gate "
+                    f"(rung {self.shed_gate.state()['rung']})",
+                    retry_after_ms=hint_ms,
+                )
+            if clamp is not None and clamp < req.max_new_tokens:
+                req.max_new_tokens = clamp
+                self.counters["shed_clamped"] += 1
         with self._lock:
             if self._draining or self._stopped:
                 raise EngineStoppedError("engine is draining; not accepting")
@@ -938,6 +994,10 @@ class ContinuousBatcher:
                 taken += req.n
                 if req.started is None:  # a resume keeps its stamps
                     req.started = now
+                    if self.shed_gate is not None:
+                        # queue sojourn (submit -> admission): the
+                        # CoDel signal the gate sheds on
+                        self.shed_gate.note_delay(now - req.created)
                 self._admit_seq += 1
                 for j, s in enumerate(group):
                     self._slots[s] = req

@@ -275,9 +275,13 @@ class ServingServer:
                 reply = self._dispatch(header, payload)
             except ServingError as e:
                 h = {"ok": False, "error": e.code, "detail": str(e)}
-                if e.code == "overloaded":
-                    # Retry-After semantics: tell the client how long to
-                    # back off instead of letting the fleet guess
+                # Retry-After semantics: tell the client how long to
+                # back off instead of letting the fleet guess — and
+                # prefer the error's OWN hint (quota waits, shed-gate
+                # sojourn estimates) over the server-wide constant
+                if getattr(e, "retry_after", None) is not None:
+                    h["retry_after_ms"] = e.retry_after * 1e3
+                elif e.code == "overloaded":
                     h["retry_after_ms"] = self.retry_after_ms
                 _stamp_trace(h, header, e)
                 reply = pack_frame(h)
@@ -301,6 +305,12 @@ class ServingServer:
     def _dispatch(self, header: dict, payload: bytes) -> bytes:
         verb = header.get("verb")
         faults.fire("server.dispatch", verb=verb)
+        if verb in ("generate", "predict", "prefill", "kv.transfer"):
+            # the gray-failure seam: a delay armed here (filtered by
+            # port) slows this replica's DATA path while its health
+            # polls stay green — the failure shape circuit breakers
+            # exist to catch
+            faults.fire("net.delay", verb=verb, port=int(self.port))
         if verb == "generate":
             return self._generate(header, payload)
         if verb == "prefill":
@@ -532,6 +542,7 @@ class ServingServer:
 
         verb = header.get("verb")
         faults.fire("server.dispatch", verb=verb)
+        faults.fire("net.delay", verb=verb, port=int(self.port))
         ctx = TraceContext.from_wire(header.get("trace"))
         span = col = None
         if ctx is not None:

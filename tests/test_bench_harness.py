@@ -252,6 +252,47 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
     }
     assert ovb["timed_pass_compiles"] == 0
     assert ovb["compile_storms"] == 0
+    # overload-defense block: storm shedding, gray-failure breaker,
+    # and hedged-request A/Bs, every survivor identity-asserted, all
+    # three pairing ledgers balanced (gate sheds == typed refusals,
+    # hedges launched == wins + losers, zero breaker bypasses), the
+    # slow replica health-GREEN on both routers, and zero compiles
+    # inside timed windows (RATIO magnitudes are only meaningful in
+    # the full run — the committed artifact carries the goodput and
+    # p99-recovery floors under check_bench --kind resilience)
+    rs = rec["resilience"]
+    assert set(rs["rows"]) == {"storm", "gray", "hedge"}
+    for name, row in rs["rows"].items():
+        assert row["outputs_identical"] is True, name
+        assert row["timed_pass_compiles"] == 0, name
+        assert row["compile_storms"] == 0, name
+    st = rs["rows"]["storm"]
+    assert st["goodput_ratio"] > 0
+    assert st["shed_pairing"]["exact"] is True, st["shed_pairing"]
+    assert st["hints_honest"] is True
+    assert st["shed_rung_released"] is True
+    for side in ("shed_off", "shed_on"):
+        oc = st[side]["storm_outcomes"]
+        assert oc["untyped"] == 0, (side, oc)
+        assert oc["typed_other"] == 0, (side, oc)
+    assert st["retry_budget"]["attempts"] >= st["num_storm_requests"]
+    gr = rs["rows"]["gray"]
+    assert gr["routed_p99_ratio"] > 0
+    assert gr["slow_replica_health_green"] is True
+    assert gr["probes_in_timed_window"] == 0
+    gc = gr["breaker_on"]["counters"]
+    assert gc["breaker_opens"] >= 1
+    assert gc["breaker_bypass_forwards"] == 0
+    hd = rs["rows"]["hedge"]
+    assert hd["p99_ratio"] > 0
+    assert hd["hedges_balanced"] is True
+    hc = hd["hedge_on"]["counters"]
+    assert hc["hedges_launched"] >= 1
+    assert hc["hedges_launched"] == (
+        hc["hedge_wins"] + hc["hedge_losers"]
+    ), hc
+    assert rs["timed_pass_compiles"] == 0
+    assert rs["compile_storms"] == 0
     # the regression gate: the fresh smoke ratios must land within the
     # stated band of the COMMITTED artifact (a perf collapse fails
     # tier-1 here instead of silently rotting the committed numbers)
@@ -265,6 +306,8 @@ def test_bench_serving_smoke_mode_end_to_end(tmp_path, monkeypatch):
     violations = check_bench.compare_obs(rec, committed)
     assert violations == [], violations
     violations = check_bench.compare_overlap(rec, committed)
+    assert violations == [], violations
+    violations = check_bench.compare_resilience(rec, committed)
     assert violations == [], violations
     # speculative A/B schema: both traffic shapes, both sides, the
     # acceptance ledger, and the identity flag (win/cost RATIOS are
@@ -719,6 +762,81 @@ def test_committed_bench_serving_overlap_block():
     )
 
 
+def test_committed_bench_serving_resilience_block():
+    """The COMMITTED resilience block carries THIS PR's claims
+    honestly: shedding-on goodput clears its >= 1.5x floor under the
+    5x storm with the shed/refusal pairing exact and every refusal
+    hinted, breaker-on routed p99 clears the >= 2x recovery floor
+    (i.e. <= 0.5x breaker-off) with the slow replica health-GREEN on
+    both sides and zero bypass forwards, the hedge ledger balances
+    with at least one hedge launched, and zero XLA mints landed
+    inside any timed window. Self-comparison exercises every
+    invariant plus the committed floors — regenerating the artifact
+    with a broken defense must fail here, not slip through."""
+    rec = json.loads(
+        open(os.path.join(REPO, "BENCH_SERVING.json")).read()
+    )
+    assert check_bench.compare_resilience(rec, rec) == []
+    assert set(check_bench.COMMITTED_FLOORS["resilience"]) == {
+        "resilience.rows.storm.goodput_ratio",
+        "resilience.rows.gray.routed_p99_ratio",
+        "resilience.rows.hedge.hedge_on.counters.hedges_launched",
+    }
+    rs = rec["resilience"]
+    assert rs["timed_pass_compiles"] == 0
+    assert rs["compile_storms"] == 0
+    st = rs["rows"]["storm"]
+    assert st["storm_multiplier"] == 5
+    assert st["shed_pairing"]["gate_sheds"] == (
+        st["shed_pairing"]["typed_overloaded"]
+    )
+    gr = rs["rows"]["gray"]
+    assert gr["breaker_on"]["counters"]["breaker_opens"] >= 1
+    assert gr["probes_in_timed_window"] == 0
+    hd = rs["rows"]["hedge"]
+    hc = hd["hedge_on"]["counters"]
+    assert hc["hedge_wins"] + hc["hedge_losers"] == (
+        hc["hedges_launched"]
+    )
+    # gate plumbing: a broken pairing ledger, a health-red replica, an
+    # unbalanced hedge ledger, or a timed-pass mint is a violation,
+    # not a silent pass
+    import copy
+
+    bad = copy.deepcopy(rec)
+    bad["resilience"]["rows"]["storm"]["shed_pairing"]["exact"] = False
+    assert any(
+        "pairing" in v
+        for v in check_bench.compare_resilience(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    bad["resilience"]["rows"]["gray"][
+        "slow_replica_health_green"] = False
+    assert any(
+        "health-green" in v
+        for v in check_bench.compare_resilience(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    bad["resilience"]["rows"]["hedge"]["hedge_on"]["counters"][
+        "hedge_losers"] += 1
+    assert any(
+        "unbalanced" in v
+        for v in check_bench.compare_resilience(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    bad["resilience"]["rows"]["gray"]["timed_pass_compiles"] = 3
+    assert any(
+        "mints landed inside" in v
+        for v in check_bench.compare_resilience(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    del bad["resilience"]
+    assert any(
+        "missing resilience block" in v
+        for v in check_bench.compare_resilience(bad, rec)
+    )
+
+
 def test_committed_bench_fleet_artifact_schema():
     """The COMMITTED BENCH_FLEET.json (the number PERF.md quotes) still
     matches the schema this harness produces, and carries the claimed
@@ -842,6 +960,21 @@ def test_soak_fleet_smoke():
     # joining under traffic) fails the bar
     assert summary["compile_storms"] == 0
     assert summary["completed"] > 0
+    # the overload-defense ledgers: one replica is GRAY (net.delay
+    # stalls, health green) and the router runs breakers + budget +
+    # hedging — every launched hedge resolved win XOR loss, at least
+    # one launched (the gray stalls and the kill window both exceed
+    # the hedge delay), and no open-breaker replica ever received a
+    # non-probe forward
+    res = summary["resilience"]
+    assert res["hedges"]["launched"] >= 1
+    assert res["hedges"]["launched"] == (
+        res["hedges"]["wins"] + res["hedges"]["losers"]
+    )
+    assert res["breakers"]["bypass_forwards"] == 0
+    assert res["retry_budget"]["exhausted"] >= (
+        res["retry_budget_exhausted"]
+    )
     assert summary["ok"]
 
 

@@ -843,6 +843,19 @@ def test_soak_serving_smoke(lm):
     assert summary["trace_incomplete"] == 0, (
         summary["trace_incomplete_samples"]
     )
+    # the overload-storm bars: the burst's no-retry ledger is exact
+    # (every attempt resolved ok or typed, none hung/untyped), every
+    # overloaded reply carried a retry hint, the gate actually shed,
+    # and the brownout RELEASED once the burst ended (rung back to 0)
+    st = summary["storm"]
+    assert st["hung"] == 0 and st["untyped"] == 0
+    assert st["corrupt"] == 0 and st["accounting_exact"]
+    assert st["hint_missing"] == 0
+    assert st["typed"].get("overloaded", 0) >= 1
+    assert summary["shed"]["gate"]["sheds"] >= 1
+    assert summary["shed"]["gate"]["rung"] == 0
+    # summary["ok"] folds all of the above plus the steady bars
+    assert summary["ok"], summary
 
 
 # ------------------------------------------------------ paged KV chaos

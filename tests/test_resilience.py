@@ -1,0 +1,685 @@
+"""Overload defense and gray-failure resilience (serving/resilience.py
+and its wiring through client, router, and scheduler).
+
+Four tiers:
+
+- primitive units with injected clocks: retry budgets, circuit
+  breakers, latency trackers, hedge-delay resolution, and the
+  admission controller's CoDel latch + brownout ladder — no sleeps,
+  no sockets;
+- the full-jitter retry distribution pin: ``RetryPolicy.delay`` draws
+  uniformly from ``[0, min(max_delay, base * 2^attempt)]`` and passes
+  server ``retry_after`` hints through verbatim;
+- scheduler/client integration: the shed gate refusing typed at the
+  engine door, the client's budget refusing to amplify, client-side
+  hedging winning on a stalled primary;
+- router integration (FakeReplica fleets): fleet-side retry-budget
+  enforcement, hedged routing pairing invariants, and the gray-failure
+  chaos drill — a ``net.delay``-slowed but health-green replica trips
+  its breaker open, routed latency recovers, and the breaker closes
+  again after the seam disarms (marked ``chaos``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import loadgen  # noqa: E402
+
+from distkeras_tpu.serving.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    AdmissionController,
+    CircuitBreaker,
+    LatencyTracker,
+    RetryBudget,
+    as_breaker_config,
+    as_retry_budget,
+    as_shed_gate,
+    resolve_hedge_delay,
+)
+from distkeras_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    OverloadedError,
+    ServeRequest,
+    ShedError,
+)
+from test_fleet import FakeReplica, _client, _router
+from test_serving import FakeStepper
+
+
+class Tick:
+    """Injected monotonic clock: advances only when told to."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _req(plen=3, max_new=4, **kw):
+    return ServeRequest(np.arange(1, plen + 1), max_new, **kw)
+
+
+# ------------------------------------------------------ retry budget
+
+
+def test_retry_budget_deposits_grants_and_exhausts():
+    b = RetryBudget(ratio=0.25, burst=2.0)
+    # starts full: a cold client may retry immediately
+    assert b.acquire() and b.acquire()
+    assert not b.acquire()  # dry
+    assert b.exhausted == 1 and b.grants == 2
+    # 4 original attempts deposit ratio*4 = 1.0 token
+    for _ in range(4):
+        b.note_attempt()
+    assert b.acquire()
+    assert not b.acquire()
+    # deposits cap at burst, never bank unbounded credit
+    for _ in range(1000):
+        b.note_attempt()
+    assert b.tokens == pytest.approx(2.0)
+    snap = b.snapshot()
+    assert snap["attempts"] == 1004 and snap["grants"] == 3
+    assert snap["exhausted"] == 2
+
+
+def test_retry_budget_spec_coercion():
+    assert as_retry_budget(None) is None
+    assert as_retry_budget(False) is None
+    b = as_retry_budget(True)
+    assert isinstance(b, RetryBudget) and b.ratio == 0.1
+    b = as_retry_budget({"ratio": 0.5, "burst": 3.0})
+    assert b.ratio == 0.5 and b.burst == 3.0
+    inst = RetryBudget()
+    assert as_retry_budget(inst) is inst
+    with pytest.raises(TypeError):
+        as_retry_budget("lots")
+
+
+# --------------------------------------------------- circuit breaker
+
+
+def test_breaker_error_rate_trip_probe_and_close():
+    clk = Tick()
+    br = CircuitBreaker(
+        window=10.0, min_requests=4, failure_threshold=0.5,
+        open_secs=5.0, clock=clk,
+    )
+    # below min_requests nothing trips, however bad the rate
+    assert br.record_failure() is None
+    assert br.record_failure() is None
+    assert br.state == CLOSED
+    br.record_success()
+    assert br.record_failure() == (CLOSED, OPEN)  # 3/4 failed
+    assert br.open_cause == "error_rate"
+    # open: no probe before open_secs
+    assert not br.probe_due()
+    granted, change = br.try_probe()
+    assert not granted and change is None
+    clk.advance(5.1)
+    assert br.probe_due()
+    granted, change = br.try_probe()
+    assert granted and change == (OPEN, HALF_OPEN)
+    # one probe in flight at a time
+    assert not br.probe_due()
+    assert br.try_probe() == (False, None)
+    assert br.record_probe(ok=True) == (HALF_OPEN, CLOSED)
+    assert br.open_cause is None
+    assert br.snapshot()["window_outcomes"] == 0  # clean slate
+
+
+def test_breaker_probe_failure_reopens_with_fresh_timer():
+    clk = Tick()
+    br = CircuitBreaker(
+        window=10.0, min_requests=2, failure_threshold=0.5,
+        open_secs=5.0, clock=clk,
+    )
+    br.record_failure()
+    assert br.record_failure() == (CLOSED, OPEN)
+    clk.advance(5.1)
+    granted, _ = br.try_probe()
+    assert granted
+    assert br.record_probe(ok=False) == (HALF_OPEN, OPEN)
+    assert br.open_cause == "probe_failed"
+    assert not br.probe_due()  # the open timer restarted
+    clk.advance(5.1)
+    assert br.probe_due()
+
+
+def test_breaker_latency_outlier_streak_trips_and_resets():
+    clk = Tick()
+    br = CircuitBreaker(outlier_trips=3, open_secs=5.0, clock=clk)
+    assert br.note_latency(True) is None
+    assert br.note_latency(True) is None
+    assert br.note_latency(False) is None  # streak reset
+    assert br.state == CLOSED
+    br.note_latency(True)
+    br.note_latency(True)
+    assert br.note_latency(True) == (CLOSED, OPEN)
+    assert br.open_cause == "latency_outlier"
+    # error-window outcomes never reached min_requests: the trip came
+    # from the latency path alone (the gray-failure seam)
+    assert br.snapshot()["state"] == OPEN
+
+
+def test_breaker_config_coercion():
+    assert as_breaker_config(None) is None
+    assert as_breaker_config(False) is None
+    assert as_breaker_config(True) == {}
+    assert as_breaker_config({"window": 3.0}) == {"window": 3.0}
+    with pytest.raises(TypeError):
+        as_breaker_config(7)
+
+
+# ------------------------------------- latency tracker + hedge delay
+
+
+def test_latency_tracker_and_hedge_delay_resolution():
+    t = LatencyTracker(capacity=16, min_samples=4)
+    assert resolve_hedge_delay("p95", t) is None  # no evidence yet
+    for v in (0.01, 0.02, 0.03, 0.04):
+        t.note(v)
+    assert len(t) == 4
+    assert resolve_hedge_delay("p95", t) == pytest.approx(0.04)
+    assert resolve_hedge_delay("p50", t) == pytest.approx(0.03)
+    # numbers are used as-is; tracker state is irrelevant
+    assert resolve_hedge_delay(0.25, None) == pytest.approx(0.25)
+    assert resolve_hedge_delay(None, t) is None
+    with pytest.raises(ValueError):
+        resolve_hedge_delay("q95", t)
+    with pytest.raises(ValueError):
+        resolve_hedge_delay(-1.0, t)
+
+
+# ------------------------------------------------ full-jitter retry
+
+
+def test_retry_policy_full_jitter_distribution_and_hint():
+    """Satellite pin: ``delay(attempt)`` is FULL jitter — uniform on
+    ``[0, cap]`` with ``cap = min(max_delay, base * 2^attempt)`` — not
+    equal-jitter, not decorrelated; and a server ``retry_after`` hint
+    is honored verbatim (capped at max_delay), never jittered."""
+    from distkeras_tpu.networking import RetryPolicy
+
+    p = RetryPolicy(base_delay=0.1, max_delay=2.0, seed=7)
+    for attempt in (0, 1, 3):
+        cap = min(2.0, 0.1 * 2 ** attempt)
+        draws = [p.delay(attempt) for _ in range(400)]
+        assert all(0.0 <= d <= cap for d in draws)
+        # the draws SPREAD over the interval: full jitter's signature
+        # (a fixed or lower-bounded backoff would cluster high)
+        assert min(draws) < 0.2 * cap
+        assert max(draws) > 0.8 * cap
+        mean = sum(draws) / len(draws)
+        assert 0.35 * cap < mean < 0.65 * cap
+    # hints ride verbatim — coordinated pacing from the server's own
+    # estimate beats client-side guessing — but never past max_delay
+    assert p.delay(0, hint=0.75) == pytest.approx(0.75)
+    assert p.delay(5, hint=60.0) == pytest.approx(2.0)
+
+
+# ------------------------------------------- admission controller
+
+
+def test_admission_codel_latch_needs_sustained_excess():
+    clk = Tick()
+    g = AdmissionController(
+        target_ms=50.0, interval_ms=500.0, clock=clk,
+    )
+    # a single spike above target does not latch
+    g.note_delay(0.2)
+    assert g.rung() == 0
+    # sustained excess for >= interval does
+    clk.advance(0.3)
+    g.note_delay(0.2)
+    assert g.rung() == 0
+    clk.advance(0.3)
+    g.note_delay(0.2)
+    assert g.rung() == 1
+    assert g.admit(0, 64) [0] == "shed"
+    assert g.admit(1, 64)[0] == "admit"  # higher class rides through
+    # one below-target sojourn releases the latch immediately
+    g.note_delay(0.01)
+    assert g.rung() == 0
+    assert g.admit(0, 64)[0] == "admit"
+
+
+def test_admission_latch_releases_on_stale_evidence():
+    clk = Tick()
+    g = AdmissionController(
+        target_ms=50.0, interval_ms=500.0, clock=clk,
+    )
+    g.note_delay(0.2)
+    clk.advance(0.6)
+    g.note_delay(0.2)
+    assert g.rung() == 1
+    # no admissions at all for two intervals: queue is empty, not
+    # congested — shedding on stale evidence would brown out idle
+    clk.advance(1.1)
+    assert g.rung() == 0
+
+
+def test_admission_burn_ladder_clamp_and_refuse():
+    clk = Tick()
+    verdict = {"burn": "ok"}
+    g = AdmissionController(
+        target_ms=50.0, interval_ms=500.0, burn_fn=lambda: verdict,
+        burn_interval=0.0, clamp_frac=0.25, clock=clk,
+    )
+    assert g.admit(0, 64) == ("admit", None, None)
+    verdict = {"burn": "burning"}  # rung 1: shed lowest class
+    act, hint, clamp = g.admit(0, 64)
+    assert act == "shed" and hint >= 25.0 and clamp is None
+    assert g.poll_transition() == (0, 1)
+    assert g.poll_transition() is None  # once per transition
+    verdict = {"burn": "spiking"}  # rung 2: clamp survivors
+    act, hint, clamp = g.admit(3, 64)
+    assert act == "admit" and clamp == 16
+    verdict = {"burn": "breach"}  # rung 3: refuse everyone, typed
+    act, hint, clamp = g.admit(9, 64)
+    assert act == "refuse" and hint >= 25.0
+    st = g.state()
+    assert st["rung"] == 3 and st["burn_rung"] == 3
+    # a crashing burn_fn is neutral, never an implicit brownout
+    g2 = AdmissionController(
+        burn_fn=lambda: 1 / 0, burn_interval=0.0, clock=clk,
+    )
+    assert g2.admit(0, 64)[0] == "admit"
+
+
+def test_shed_gate_spec_coercion():
+    assert as_shed_gate(None) is None
+    assert as_shed_gate(False) is None
+    assert isinstance(as_shed_gate(True), AdmissionController)
+    g = as_shed_gate({"target_ms": 10.0}, burn_fn=len)
+    assert g.target == pytest.approx(0.010) and g.burn_fn is len
+    inst = AdmissionController()
+    assert as_shed_gate(inst) is inst
+
+
+# -------------------------------------------- scheduler integration
+
+
+def test_batcher_shed_gate_refuses_typed_and_clamps():
+    clk = Tick()
+    verdict = {"burn": "ok"}
+    gate = AdmissionController(
+        target_ms=50.0, burn_fn=lambda: verdict, burn_interval=0.0,
+        clamp_frac=0.25, clock=clk,
+    )
+    st = FakeStepper(num_slots=2)
+    b = ContinuousBatcher(st, queue_capacity=8, shed_gate=gate)
+    b.submit(_req(max_new=2))  # healthy: admitted untouched
+    verdict = {"burn": "burning"}
+    with pytest.raises(ShedError) as ei:
+        b.submit(_req(max_new=2, priority=0))
+    assert ei.value.code == "overloaded"
+    assert ei.value.retry_after_ms >= 25.0
+    r_hi = b.submit(_req(max_new=8, priority=2))  # class rides through
+    assert r_hi.max_new_tokens == 8
+    verdict = {"burn": "spiking"}
+    r_cl = b.submit(_req(max_new=8, priority=2))
+    assert r_cl.max_new_tokens == 2  # clamped, not refused
+    verdict = {"burn": "breach"}
+    with pytest.raises(ShedError):
+        b.submit(_req(max_new=2, priority=9))
+    s = b.stats()
+    assert s["shed_overloaded"] == 2 and s["shed_clamped"] == 1
+
+
+def test_batcher_shed_gate_sees_queue_sojourn():
+    clk = Tick()
+    gate = AdmissionController(target_ms=50.0, clock=clk)
+    st = FakeStepper(num_slots=1)
+    b = ContinuousBatcher(st, queue_capacity=8, shed_gate=gate)
+    b.submit(_req(max_new=2))
+    b.step()  # admits: sojourn ~0 -> below target, no latch
+    assert gate.state()["sojourn_ms"] is not None
+    assert not gate.state()["shedding"]
+
+
+# ------------------------------------------------- loadgen storm
+
+
+def test_loadgen_storm_three_phases():
+    """The storm process: steady baseline, a 5x rectangular burst,
+    recovery back to baseline — deterministic, and the phase summary
+    documents the burst it will drive at the shed gate."""
+    kw = dict(duration=9.0, seed=5, burst_start=3.0, burst_len=3.0,
+              burst_factor=5.0)
+    a = loadgen.arrivals("storm", 20.0, **kw)
+    b = loadgen.arrivals("storm", 20.0, **kw)
+    assert np.array_equal(a, b) and np.all(np.diff(a) >= 0)
+    phase = lambda lo, hi: int(((a >= lo) & (a < hi)).sum())  # noqa: E731
+    base, burst, rec = phase(0, 3), phase(3, 6), phase(6, 9)
+    # the burst runs ~5x the baseline; recovery returns to it
+    assert burst > 3 * base
+    assert burst > 3 * rec
+    with pytest.raises(ValueError):
+        loadgen.arrivals("storm", 20.0, duration=9.0)  # needs bounds
+    trace = loadgen.make_trace(
+        process="storm", rate=20.0, tenants=loadgen.storm_tenants(64),
+        **kw,
+    )
+    s = loadgen.summarize(trace, phases=3)
+    assert s["phase_rates"][1]["rate"] > 2.5 * s["phase_rates"][0]["rate"]
+    # the preset carries both QoS classes the brownout ladder splits
+    prios = {t["priority"] for t in map(dict, loadgen.storm_tenants())}
+    assert prios == {0, 2}
+    assert {ev["tenant"] for ev in trace} == {"hi", "lo"}
+
+
+def test_loadgen_summarize_outcomes_ledger():
+    got = loadgen.summarize_outcomes(
+        ["ok", "ok", "shed", "budget_refused", "error:unavailable"]
+    )
+    assert got == {
+        "total": 5, "ok": 2, "shed": 1, "budget_refused": 1,
+        "errors": {"unavailable": 1},
+    }
+
+
+# ----------------------------------------------- client integration
+
+
+def test_client_retry_budget_stops_amplification():
+    f = FakeReplica(1)
+    try:
+        f.overload_next = 100  # the replica sheds every generate
+        from distkeras_tpu.serving import ServingClient
+        from distkeras_tpu.networking import RetryPolicy
+
+        cli = ServingClient(
+            f.endpoint[0], f.endpoint[1], timeout=10.0,
+            retry=RetryPolicy(
+                max_attempts=8, base_delay=0.001, max_delay=0.005,
+            ),
+            retry_budget={"ratio": 0.0, "burst": 2.0},
+        )
+        with cli:
+            with pytest.raises(OverloadedError):
+                cli.generate(np.arange(4, dtype=np.int32), 2)
+            # 1 original + exactly 2 budget-granted retries hit the
+            # wire; the 4th attempt was refused LOCALLY and the typed
+            # error surfaced unamplified
+            assert cli.retries == 2
+            assert cli.budget_refused == 1
+        assert f.calls.count("generate") == 3
+    finally:
+        f.kill()
+
+
+def test_client_hedge_wins_on_stalled_primary():
+    class Stall:
+        """First generate stalls 0.6 s; later ones answer at once —
+        the hedged sibling connection beats the stalled primary."""
+
+        def __init__(self):
+            self.n = 0
+            self.lock = threading.Lock()
+
+        def wait(self, timeout=None):
+            with self.lock:
+                self.n += 1
+                first = self.n == 1
+            if first:
+                time.sleep(0.6)
+
+    f = FakeReplica(3)
+    try:
+        f.block = Stall()
+        from distkeras_tpu.serving import ServingClient
+
+        with ServingClient(
+            f.endpoint[0], f.endpoint[1], timeout=10.0,
+            hedge_after=0.1,
+        ) as cli:
+            t0 = time.monotonic()
+            out = cli.generate(np.arange(4, dtype=np.int32), 3)
+            dt = time.monotonic() - t0
+            assert out[-3:].tolist() == [3, 3, 3]
+            assert dt < 0.55  # did not wait out the stall
+            assert cli.hedges_launched == 1
+            assert cli.hedge_wins == 1
+            # a fast reply later hedges nothing
+            out2 = cli.generate(np.arange(5, dtype=np.int32), 3)
+            assert out2[-3:].tolist() == [3, 3, 3]
+            assert cli.hedges_launched == 1
+    finally:
+        f.kill()
+
+
+def test_client_hedge_spec_validated_eagerly():
+    from distkeras_tpu.serving import ServingClient
+
+    with pytest.raises(ValueError):
+        ServingClient("127.0.0.1", 1, hedge_after="q95")
+
+
+# ----------------------------------------------- router integration
+
+
+def test_router_retry_budget_refuses_marked_retries():
+    f = FakeReplica(1)
+    router = None
+    try:
+        f.overload_next = 100
+        router = _router(f, retry_budget={"ratio": 0.0, "burst": 1.0})
+        from distkeras_tpu.networking import RetryPolicy
+
+        with _client(
+            router,
+            retry=RetryPolicy(
+                max_attempts=6, base_delay=0.001, max_delay=0.005,
+            ),
+        ) as cli:
+            with pytest.raises(OverloadedError):
+                cli.generate(np.arange(4, dtype=np.int32), 2)
+        # original + ONE granted retry reached the replica; every
+        # further retry died at the router's budget, typed, without
+        # touching a replica (the no-amplification contract)
+        assert f.calls.count("generate") == 2
+        assert router.retry_budget_exhausted.value >= 1
+    finally:
+        if router is not None:
+            router.shutdown()
+        f.kill()
+
+
+def test_router_hedge_pairing_and_first_win():
+    f1, f2 = FakeReplica(1), FakeReplica(2)
+    router = None
+    try:
+        router = _router(f1, f2, affinity=False, hedge_after=0.15)
+        with _client(router) as cli:
+            cli.generate(np.arange(4, dtype=np.int32), 2)  # warm
+            f1.block = threading.Event()  # stall ONLY f1
+            t0 = time.monotonic()
+            outs = [
+                cli.generate(np.arange(5 + i, dtype=np.int32), 2)
+                for i in range(4)
+            ]
+            dt = time.monotonic() - t0
+        assert all(o[-1] in (1, 2) for o in outs)
+        assert dt < 4.0  # no request waited out a 30 s stall
+        c = router.counters
+        assert c["hedges_launched"] >= 1
+        f1.block.set()
+        time.sleep(0.3)
+        assert c["hedges_launched"] == c["hedge_wins"] + c["hedge_losers"]
+        assert c["breaker_bypass_forwards"] == 0
+    finally:
+        if router is not None:
+            router.shutdown()
+        f1.kill()
+        f2.kill()
+
+
+@pytest.mark.chaos
+def test_gray_failure_breaker_opens_recovers_and_closes(lm):
+    """ACCEPTANCE (gray failure): one replica of a REAL 2-engine fleet
+    is slowed via the ``net.delay`` seam — health polls stay green the
+    whole time, so ejection never fires — and the router's breaker (a)
+    opens on the latency-outlier path, (b) steers traffic off it so
+    routed latency recovers, then (c) half-opens and closes after the
+    seam disarms. Zero untyped errors anywhere."""
+    from distkeras_tpu import faults
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    engines, servers = [], []
+    router = None
+    plan = faults.FaultPlan()
+    try:
+        for _ in range(2):
+            eng = ServingEngine(lm, num_slots=2, queue_capacity=16).start()
+            srv = ServingServer(eng).start()
+            engines.append(eng)
+            servers.append(srv)
+        slow_port = int(servers[0].port)
+        plan.arm(
+            "net.delay", action="delay", delay=0.35, times=None,
+            when=lambda ctx: ctx.get("port") == slow_port,
+        ).activate()
+        from distkeras_tpu.serving.fleet import FleetRouter
+
+        router = FleetRouter(
+            endpoints=[(s.host, s.port) for s in servers],
+            health_interval=0.25, affinity=False,
+            breaker=dict(
+                open_secs=1.0, outlier_trips=2,
+                outlier_factor=3.0, min_latency=0.050,
+            ),
+        ).start()
+        slow_ep = (servers[0].host, int(servers[0].port))
+
+        def breaker_state():
+            for r in router.replicas():
+                if tuple(r["endpoint"]) == slow_ep:
+                    return r["breaker"]["state"], r["state"]
+            return None, None
+
+        prompt = np.arange(6, dtype=np.int32) % 11
+
+        def burst(base, n=4):
+            """n CONCURRENT generates — while the slow replica stalls
+            one, the others land on the fast sibling, so BOTH build
+            windowed latency (a serial driver would pile onto one)."""
+            errs = []
+
+            def one(i):
+                try:
+                    with _client(router) as c:
+                        c.generate((prompt + base + i) % 11, 3)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ths = [
+                threading.Thread(target=one, args=(i,)) for i in range(n)
+            ]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=60)
+            assert not errs, errs
+
+        with _client(router) as cli:
+            # drive traffic until the breaker opens (the per-replica
+            # windows need history snapshots, which land on the health
+            # loop's 1 s cadence)
+            deadline = time.monotonic() + 60.0
+            opened = False
+            while time.monotonic() < deadline:
+                burst(int(time.monotonic() * 10) % 40)
+                bstate, rstate = breaker_state()
+                assert rstate == "active"  # health-green while slow
+                if bstate == "open":
+                    opened = True
+                    break
+            assert opened, "breaker never opened on the slow replica"
+            assert router.counters["breaker_opens"] >= 1
+            # recovery: with the breaker open every request routes to
+            # the healthy sibling — no 0.35 s stalls
+            lats = []
+            for i in range(6):
+                t0 = time.monotonic()
+                cli.generate((prompt + 50 + i) % 11, 3)
+                lats.append(time.monotonic() - t0)
+            assert max(lats) < 0.3, lats
+            # disarm: the half-open probe finds a fast replica again
+            plan.deactivate()
+            deadline = time.monotonic() + 30.0
+            closed = False
+            while time.monotonic() < deadline:
+                for i in range(3):
+                    cli.generate((prompt + 100 + i) % 11, 3)
+                if breaker_state()[0] == "closed":
+                    closed = True
+                    break
+                time.sleep(0.1)
+            assert closed, "breaker never closed after disarm"
+            assert router.counters["breaker_closes"] >= 1
+            assert router.counters["breaker_probes"] >= 1
+        assert router.counters["breaker_bypass_forwards"] == 0
+    finally:
+        plan.deactivate()
+        if router is not None:
+            router.shutdown()
+        for s in servers:
+            s.shutdown()
+        for e in engines:
+            e.stop()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models import zoo
+
+    return zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+
+
+def test_dkt_top_renders_breaker_and_shed_columns():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from dkt_top import format_table
+
+    samples = [
+        {"name": "fleet_router_breaker_open_replicas", "kind": "gauge",
+         "value": 1, "labels": {"replica": "router"}},
+        {"name": "fleet_router_breaker_opens", "kind": "counter",
+         "value": 2, "labels": {"replica": "router"}},
+        {"name": "fleet_router_breaker_closes", "kind": "counter",
+         "value": 1, "labels": {"replica": "router"}},
+        {"name": "serving_shed_rung", "kind": "gauge", "value": 2,
+         "labels": {"replica": "127.0.0.1:9001"}},
+    ]
+    out = format_table(samples)
+    assert "== router  breakers=OPEN:1 ↑2↓1 " in out
+    assert "== 127.0.0.1:9001  shed=clamp " in out
+    # healthy router reads ok; columns absent when gauges absent
+    ok = format_table(
+        [{"name": "fleet_router_breaker_open_replicas", "kind": "gauge",
+          "value": 0, "labels": {"replica": "router"}}]
+    )
+    assert "breakers=ok" in ok
+    bare = format_table(
+        [{"name": "fleet_router_forwards", "kind": "counter",
+          "value": 3, "labels": {"replica": "router"}}]
+    )
+    assert "breakers" not in bare and "shed=" not in bare

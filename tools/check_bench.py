@@ -123,6 +123,19 @@ OVERLAP_RATIO_KEYS = (
     # preemption invariants in compare_overlap plus the committed
     # preemptions floor
 )
+#: the resilience rows' ratios are deterministic in DIRECTION (a shed
+#: storm always beats a queued one; an open breaker always dodges the
+#: 250 ms stall) but their MAGNITUDE is owned by how slow the stall is
+#: relative to a pass's compute — wildly different between a 6-request
+#: smoke and the CPU tier — so the band only gates collapse; the
+#: claims live in the committed floors and the pairing invariants
+RESILIENCE_RATIO_BAND = 20.0
+RESILIENCE_RATIO_KEYS = (
+    "resilience.rows.storm.goodput_ratio",
+    "resilience.rows.gray.routed_p99_ratio",
+    "resilience.rows.hedge.p99_ratio",
+)
+
 #: the ramp A/B's p99 ratio is owned by JOIN TIMING — when inside the
 #: measured pass the scale-up lands, and how much of the single
 #: bench core its boot steals — so the band only gates collapse;
@@ -206,6 +219,19 @@ COMMITTED_FLOORS = {
     "overlap": {
         "overlap.rows.decode_heavy.bubble_reduction": 0.05,
         "overlap.rows.preempt.preemptions.overlapped": 1,
+    },
+    # overload defense: under the 5x storm the shedding side must
+    # deliver >= 1.5x the interactive goodput of the queue-everything
+    # side (the adaptive-shedding claim), and with the breaker open
+    # the routed p99 past a gray replica must recover to <= half the
+    # breaker-off tail (ratio >= 2.0 — this PR's gray-failure claim).
+    # The hedge row's p99 win is committed as measured; its gated
+    # claims are the ledger invariants plus the committed floor that
+    # hedges actually launched (a row with zero hedges proves nothing)
+    "resilience": {
+        "resilience.rows.storm.goodput_ratio": 1.5,
+        "resilience.rows.gray.routed_p99_ratio": 2.0,
+        "resilience.rows.hedge.hedge_on.counters.hedges_launched": 1,
     },
     # elastic fleet: the committed ramp must have actually grown the
     # fleet (a curve that never left 1 replica proves nothing)
@@ -650,6 +676,124 @@ def compare_overlap(fresh: dict, committed: dict) -> list[str]:
     return violations
 
 
+RESILIENCE_ROWS = ("storm", "gray", "hedge")
+
+
+def compare_resilience(fresh: dict, committed: dict) -> list[str]:
+    """Violations of the overload-defense gate (empty list = pass).
+    The invariants, fresh and committed alike: all three rows present,
+    outputs token-identical everywhere (hedge winners and clamped-free
+    shed survivors included), the PAIRING LEDGERS balanced — gate
+    sheds == typed refusals received (every one carrying an honest
+    retry hint, zero untyped errors on either storm side), hedges
+    launched == wins + losers, zero breaker bypass forwards — the
+    gray replica health-GREEN on both sides (the whole point: binary
+    health cannot see the failure), zero half-open probes inside
+    timed windows, and the r14/r16 standing gate: zero XLA mints and
+    zero storms inside timed passes. The committed artifact
+    additionally clears the goodput and p99-recovery floors
+    (``COMMITTED_FLOORS['resilience']``)."""
+    violations: list[str] = []
+    for rec, tag in ((fresh, "fresh"), (committed, "committed")):
+        rs = rec.get("resilience")
+        if rs is None:
+            violations.append(f"{tag}: missing resilience block")
+            continue
+        rows = rs.get("rows") or {}
+        missing = set(RESILIENCE_ROWS) - set(rows)
+        if missing:
+            violations.append(
+                f"{tag} resilience: rows missing {sorted(missing)}"
+            )
+        for name, row in rows.items():
+            if row.get("outputs_identical") is not True:
+                violations.append(
+                    f"{tag} resilience.{name}: outputs not identical"
+                )
+            if row.get("compile_storms", 0) != 0:
+                violations.append(
+                    f"{tag} resilience.{name}: "
+                    f"{row['compile_storms']} compile storms"
+                )
+        storm = rows.get("storm") or {}
+        if storm:
+            pairing = storm.get("shed_pairing") or {}
+            if pairing.get("exact") is not True:
+                violations.append(
+                    f"{tag} resilience.storm: shed/refusal pairing "
+                    f"broken: {pairing}"
+                )
+            if storm.get("hints_honest") is not True:
+                violations.append(
+                    f"{tag} resilience.storm: refusals without an "
+                    "honest retry_after hint"
+                )
+            for side in ("shed_off", "shed_on"):
+                oc = (storm.get(side) or {}).get("storm_outcomes", {})
+                if oc.get("untyped", 1) != 0:
+                    violations.append(
+                        f"{tag} resilience.storm.{side}: "
+                        f"{oc.get('untyped')} untyped errors"
+                    )
+            budget = storm.get("retry_budget") or {}
+            if budget.get("grants", 0) > budget.get("attempts", 0):
+                violations.append(
+                    f"{tag} resilience.storm: retry grants exceed "
+                    f"attempts: {budget}"
+                )
+            if storm.get("shed_rung_released") is not True:
+                violations.append(
+                    f"{tag} resilience.storm: shed rung never "
+                    "released after the storm"
+                )
+        gray = rows.get("gray") or {}
+        if gray:
+            if gray.get("slow_replica_health_green") is not True:
+                violations.append(
+                    f"{tag} resilience.gray: slow replica not "
+                    "health-green — that is ejection's regime, not "
+                    "the breaker's"
+                )
+            if gray.get("probes_in_timed_window", 1) != 0:
+                violations.append(
+                    f"{tag} resilience.gray: "
+                    f"{gray.get('probes_in_timed_window')} half-open "
+                    "probes inside timed windows"
+                )
+            bc = (gray.get("breaker_on") or {}).get("counters", {})
+            if bc.get("breaker_bypass_forwards", 1) != 0:
+                violations.append(
+                    f"{tag} resilience.gray: non-probe requests "
+                    "reached an open-breaker replica"
+                )
+            if not bc.get("breaker_opens", 0) >= 1:
+                violations.append(
+                    f"{tag} resilience.gray: breaker never opened"
+                )
+        hedge = rows.get("hedge") or {}
+        if hedge:
+            hc = (hedge.get("hedge_on") or {}).get("counters", {})
+            if hc.get("hedges_launched") != (
+                hc.get("hedge_wins", 0) + hc.get("hedge_losers", 0)
+            ):
+                violations.append(
+                    f"{tag} resilience.hedge: hedge ledger "
+                    f"unbalanced: {hc}"
+                )
+        for path, n in _timed_compile_fields(rs, "resilience"):
+            if n != 0:
+                violations.append(
+                    f"{tag} {path}: {n} XLA mints landed inside "
+                    "timed passes"
+                )
+    _band_check(
+        fresh, committed, RESILIENCE_RATIO_KEYS, RESILIENCE_RATIO_BAND,
+        violations,
+    )
+    _committed_floors(committed, "resilience", violations)
+    return violations
+
+
 def compare_autoscale(fresh: dict, committed: dict) -> list[str]:
     """Violations of the elastic-fleet gate (empty list = pass). The
     invariants, fresh and committed alike: the autoscaled side grew
@@ -750,6 +894,7 @@ COMPARATORS = {
     "obs": compare_obs,
     "overlap": compare_overlap,
     "autoscale": compare_autoscale,
+    "resilience": compare_resilience,
 }
 ARTIFACTS = {
     "serving": "BENCH_SERVING.json",
@@ -764,6 +909,9 @@ ARTIFACTS = {
     # the autoscale (elastic fleet ramp A/B) block rides the fleet
     # artifact, but its smoke path runs ONLY the ramp section
     "autoscale": "BENCH_FLEET.json",
+    # and the overload-defense (shed / breaker / hedge A/B) block
+    # rides the serving artifact
+    "resilience": "BENCH_SERVING.json",
 }
 
 
@@ -788,6 +936,8 @@ def run_smoke(kind: str, workdir: str) -> dict:
         # the ramp A/B alone — the fleet workloads' smoke is --kind
         # fleet's job
         "autoscale": ["bench_fleet.py", "--smoke", "--autoscale-only"],
+        # the resilience block rides the full serving smoke too
+        "resilience": ["bench_serving.py", "--smoke"],
     }[kind]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -803,7 +953,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--kind",
                     choices=("serving", "fleet", "decode", "disagg",
-                             "obs", "overlap", "autoscale"),
+                             "obs", "overlap", "autoscale",
+                             "resilience"),
                     required=True)
     ap.add_argument("--fresh", help="fresh --smoke artifact to grade")
     ap.add_argument("--committed",
@@ -844,6 +995,7 @@ def main(argv=None) -> int:
         "obs": OBS_RATIO_KEYS,
         "overlap": OVERLAP_RATIO_KEYS,
         "autoscale": AUTOSCALE_RATIO_KEYS,
+        "resilience": RESILIENCE_RATIO_KEYS,
     }[args.kind])
     print(f"bench gate ok ({args.kind}): "
           f"{nbands} ratio bands + invariants hold")

@@ -198,8 +198,42 @@ def format_table(samples, width: int = 78, series: dict | None = None
                         if sl:
                             bubble += f" {sl}"
                 break
+        # the overload-defense column: a breaker-enabled router says
+        # how many replicas its breakers currently cut off (from the
+        # fleet_router_breaker_open_replicas gauge) plus the lifetime
+        # open/close ledger; a shedding engine shows its brownout rung
+        # (serving_shed_rung gauge, 0=ok..3=refuse).  Both columns are
+        # absent on targets that never enabled the feature.
+        guard = ""
+        for s, _ in groups[replica]:
+            if s["name"] == "fleet_router_breaker_open_replicas" and (
+                s.get("value") is not None
+            ):
+                n = int(s["value"])
+                guard = f"  breakers={'OPEN:%d' % n if n else 'ok'}"
+                opens = closes = 0
+                for s2, _ in groups[replica]:
+                    if s2["name"] == "fleet_router_breaker_opens":
+                        opens = int(s2.get("value") or 0)
+                    elif s2["name"] == "fleet_router_breaker_closes":
+                        closes = int(s2.get("value") or 0)
+                if opens or closes:
+                    guard += f" ↑{opens}↓{closes}"
+                break
+        shed = ""
+        for s, _ in groups[replica]:
+            if s["name"] == "serving_shed_rung" and (
+                s.get("value") is not None
+            ):
+                rung = int(s["value"])
+                shed = "  shed=" + {0: "ok", 1: "shed-lo",
+                                    2: "clamp", 3: "refuse"}.get(
+                    rung, "?"
+                )
+                break
         lines.append(
-            f"== {replica}{role}{mesh}{fleet}{bubble} ".ljust(width, "=")
+            f"== {replica}{role}{mesh}{fleet}{bubble}{guard}{shed} "
+            .ljust(width, "=")
         )
         rows = []
         for s, labels in sorted(

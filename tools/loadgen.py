@@ -25,6 +25,11 @@ replayable object: a trace is a list of events ``{"t", "tenant",
     hold at peak: linear when ``ramp_steps=0``, else a staircase of
     that many flat steps. The standard autoscale stimulus — the
     bench and the soak drive the same seeded, replayable climb;
+  * ``storm``       — steady at ``rate`` until ``burst_start``, a
+    flat overload burst at ``rate * burst_factor`` for
+    ``burst_len`` seconds, then steady again: the three-phase
+    (baseline -> 5x storm -> recovery) stimulus the overload-
+    defense bench and soak drive against the shed gate;
 
 - a **tenant mix** — each tenant a dict of ``name``, ``weight``
   (traffic share), ``priority`` (QoS class), ``prompt_len`` and
@@ -96,15 +101,33 @@ def decode_heavy_tenants(seq: int = 256) -> list[dict]:
     ]
 
 
+def storm_tenants(seq: int = 256) -> list[dict]:
+    """The ``storm`` preset: two QoS classes for the overload-defense
+    drill — a high-priority interactive tenant whose p99 the brownout
+    ladder must protect, and a low-priority bulk tenant that is the
+    FIRST to shed when the gate latches. Short decodes keep per-request
+    cost small so the storm is an arrival-rate problem, not a
+    decode-length one."""
+    return [
+        {"name": "hi", "weight": 0.3, "priority": 2,
+         "prompt_len": (4, max(6, seq // 16)),
+         "steps": (3, max(5, seq // 32))},
+        {"name": "lo", "weight": 0.7, "priority": 0,
+         "prompt_len": (4, max(6, seq // 16)),
+         "steps": (3, max(5, seq // 32))},
+    ]
+
+
 PRESETS = {
     "interactive": interactive_tenants,
     "decode_heavy": decode_heavy_tenants,
+    "storm": storm_tenants,
 }
 
 
 def _rate_fn(process: str, rate: float, *, burst_factor=8.0,
              period=1.0, duty=0.2, amplitude=0.8, floor_frac=0.05,
-             ramp_steps=0):
+             ramp_steps=0, burst_start=None, burst_len=None):
     """The instantaneous-rate function r(t) of a modulated process
     (None for processes that do not thin a Poisson stream)."""
     if process == "poisson":
@@ -138,6 +161,20 @@ def _rate_fn(process: str, rate: float, *, burst_factor=8.0,
             rate * floor_frac,
             rate * (1 + amplitude * math.sin(2 * math.pi * t / period)),
         )
+    if process == "storm":
+        # ONE rectangular overload: ``rate`` is the STEADY baseline
+        # (unlike bursty's mean-preserving duty cycle — a storm is an
+        # incident, not a shape); the burst multiplies it by
+        # ``burst_factor`` for ``burst_len`` seconds starting at
+        # ``burst_start``. Defaults carve the timeline into thirds so
+        # --process storm --duration 9 gives 3 s of each phase.
+        if burst_start is None or burst_len is None:
+            raise ValueError(
+                "storm needs burst_start= and burst_len= (the CLI "
+                "defaults both to duration/3)"
+            )
+        b0, b1 = float(burst_start), float(burst_start) + float(burst_len)
+        return lambda t: rate * burst_factor if b0 <= t < b1 else rate
     raise ValueError(f"unknown arrival process {process!r}")
 
 
@@ -295,11 +332,34 @@ def summarize(trace, phases: int = 0) -> dict:
     return out
 
 
+def summarize_outcomes(outcomes) -> dict:
+    """Tally a driven run's per-request OUTCOMES (the companion to
+    ``summarize``'s per-trace arrival stats): each entry is one of
+    ``ok`` / ``shed`` (typed overloaded with a ``retry_after_ms``
+    hint) / ``budget_refused`` (a retry the budget declined to
+    amplify) / ``error:<code>`` — plus ``hedged`` entries counted
+    separately by callers that hedge. The soaks gate their ledgers on
+    these totals balancing against the server side's counters."""
+    out = {"total": 0, "ok": 0, "shed": 0, "budget_refused": 0,
+           "errors": {}}
+    for o in outcomes:
+        out["total"] += 1
+        o = str(o)
+        if o in ("ok", "shed", "budget_refused"):
+            out[o] += 1
+        elif o.startswith("error:"):
+            code = o.split(":", 1)[1]
+            out["errors"][code] = out["errors"].get(code, 0) + 1
+        else:
+            out["errors"][o] = out["errors"].get(o, 0) + 1
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--process", default="poisson",
                     choices=("poisson", "bursty", "diurnal",
-                             "heavy_tail", "ramp"))
+                             "heavy_tail", "ramp", "storm"))
     ap.add_argument("--rate", type=float, default=10.0,
                     help="mean arrivals per second (PEAK for ramp)")
     ap.add_argument("--period", type=float, default=None,
@@ -308,6 +368,15 @@ def main(argv=None) -> int:
     ap.add_argument("--ramp-steps", type=int, default=0,
                     help="ramp only: quantize the climb into this "
                          "many flat steps (0 = linear)")
+    ap.add_argument("--burst-start", type=float, default=None,
+                    help="storm only: burst onset seconds "
+                         "(default duration/3)")
+    ap.add_argument("--burst-len", type=float, default=None,
+                    help="storm only: burst length seconds "
+                         "(default duration/3)")
+    ap.add_argument("--burst-factor", type=float, default=None,
+                    help="storm only: burst rate multiplier "
+                         "(default 5.0)")
     ap.add_argument("--phases", type=int, default=0,
                     help="split the summary into this many equal "
                          "windows with per-phase arrival rates")
@@ -341,6 +410,17 @@ def main(argv=None) -> int:
         proc_kw["period"] = args.period
     if args.ramp_steps:
         proc_kw["ramp_steps"] = args.ramp_steps
+    if args.process == "storm":
+        third = args.duration / 3.0
+        proc_kw["burst_start"] = (
+            args.burst_start if args.burst_start is not None else third
+        )
+        proc_kw["burst_len"] = (
+            args.burst_len if args.burst_len is not None else third
+        )
+        proc_kw["burst_factor"] = (
+            args.burst_factor if args.burst_factor is not None else 5.0
+        )
     trace = make_trace(
         process=args.process, rate=args.rate, duration=args.duration,
         tenants=tenants, vocab=args.vocab, seed=args.seed, **proc_kw,

@@ -45,6 +45,15 @@ The acceptance bar it asserts (and prints as JSON):
 - A CHECKPOINT-TRIGGERED FULL-FLEET ROLLOVER — the PS snapshot
   cadence → publish → deploy chain replaces EVERY replica (the
   replacement included), no request dropped, outputs still identical.
+- OVERLOAD-DEFENSE LEDGERS BALANCED THROUGH IT ALL — one replica is
+  GRAY (a probabilistic ``net.delay`` stall on its data verbs; health
+  polls stay green) and the router runs the full defense tier:
+  per-replica circuit breakers, a fleet retry budget, and hedged
+  generates. At shutdown every launched hedge must have resolved as
+  exactly one win or one loss (hedged winners are identity-checked
+  like everything else), no open-breaker replica may have received a
+  non-probe forward, and budget refusals must be typed and tallied —
+  asserted on the final counters, not eyeballed.
 
 Topology: replicas are REAL subprocesses (``--replica`` runs one)
 booted from a shared quantized serving bundle, each arming its OWN
@@ -106,6 +115,13 @@ def replica_main(args) -> int:
     plan = FaultPlan(seed=args.seed).arm(
         "stepper.step", times=None, probability=1.0 / args.fault_every
     )
+    if args.net_delay > 0:
+        # the GRAY replica: health polls answer instantly (the delay
+        # seam fires on data verbs only), but generates stall — the
+        # slow-but-health-green failure mode binary ejection can't
+        # see, which the router's breakers and hedges must absorb
+        plan.arm("net.delay", action="delay", delay=args.net_delay,
+                 times=None, probability=0.6)
     plan.activate()
     print(f"READY {server.port}", flush=True)
     try:
@@ -119,12 +135,13 @@ class SubprocessReplica:
     """``FleetController`` replica handle backed by a real process —
     the backend that makes kill -9 mean kill -9."""
 
-    def __init__(self, bundle, seed, fault_every):
+    def __init__(self, bundle, seed, fault_every, net_delay=0.0):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         self.proc = subprocess.Popen(
             [sys.executable, _HERE, "--replica", "--bundle", bundle,
-             "--seed", str(seed), "--fault-every", str(fault_every)],
+             "--seed", str(seed), "--fault-every", str(fault_every),
+             "--net-delay", str(net_delay)],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True, env=env,
         )
@@ -232,9 +249,16 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
     spawned = []
 
     def factory(bundle_path):
+        # the SECOND boot is the gray replica: a probabilistic
+        # net.delay stall on its data verbs, health polls untouched.
+        # The first boot is the kill -9 victim, and autoscale/rollover
+        # replacements boot clean — so the gray member survives the
+        # kill window and the breakers/hedges see it all soak long
+        # (until the rollover replaces the whole fleet).
         rep = SubprocessReplica(
             bundle_path, seed=seed + 100 + len(spawned),
             fault_every=fault_every,
+            net_delay=0.1 if len(spawned) == 1 else 0.0,
         )
         spawned.append(rep)
         return rep
@@ -246,6 +270,21 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
             health_interval=0.2, eject_after=2, connect_timeout=2.0,
             request_timeout=60.0, retry_after_ms=25.0,
             postmortem_dir=pm_dir,
+            # the overload-defense tier rides the same soak: breakers
+            # (error-rate threshold above the injected ~1/fault_every
+            # internal rate so only real pathologies trip), a fleet
+            # retry budget wide enough for the chaos mix's legitimate
+            # retries, and hedged generates cutting the gray replica's
+            # tail. The gates below are the LEDGERS — every launched
+            # hedge resolves win XOR loss, no open-breaker replica
+            # ever receives a non-probe forward, and budget refusals
+            # are typed — not "a breaker opened", which is timing.
+            breaker=dict(window=10.0, min_requests=10,
+                         failure_threshold=0.7, open_secs=1.0,
+                         outlier_trips=3, outlier_factor=3.0,
+                         min_latency=0.05),
+            retry_budget=dict(ratio=0.5, burst=50.0),
+            hedge_after=0.1,
         ),
     ).start()
 
@@ -504,6 +543,33 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
             s: plan.fired(s)
             for s in ("router.dispatch", "router.health", "net.send")
         }
+        # the overload-defense ledgers, read while the router lives:
+        # every launched hedge resolved win XOR loss (clients all
+        # joined, so no hedged request is still in flight), no
+        # open-breaker replica received a non-probe forward, and the
+        # budget's own tally agrees with the refusal counter
+        rc = summary["router"]
+        summary["resilience"] = {
+            "slow_replica": (
+                list(spawned[1].endpoint) if len(spawned) > 1 else None
+            ),
+            "retry_budget": ctl.router.retry_budget.snapshot(),
+            "retry_budget_exhausted": (
+                ctl.router.retry_budget_exhausted.value
+            ),
+            "hedges": {
+                "launched": rc["hedges_launched"],
+                "wins": rc["hedge_wins"],
+                "losers": rc["hedge_losers"],
+            },
+            "breakers": {
+                "opens": rc["breaker_opens"],
+                "half_opens": rc["breaker_half_opens"],
+                "closes": rc["breaker_closes"],
+                "probes": rc["breaker_probes"],
+                "bypass_forwards": rc["breaker_bypass_forwards"],
+            },
+        }
         # the fleet-wide compile ledger: every LIVE replica's mint
         # summary (survivors + rollover replacements; the kill -9
         # victim's book died with it), asserted storm-free below —
@@ -614,6 +680,20 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
         # re-warm, so they must not trip it)
         and summary.get("compiles_scraped", 0) >= 1
         and summary.get("compile_storms", 0) == 0
+        # the overload-defense ledgers: hedge accounting balanced and
+        # nonzero (the gray replica's stalls and the kill window both
+        # exceed the hedge delay, so hedges MUST have launched), no
+        # forward ever bypassed an open breaker, and every budget
+        # refusal the counter saw is in the budget's own tally
+        and summary["resilience"]["hedges"]["launched"] >= 1
+        and summary["resilience"]["hedges"]["launched"] == (
+            summary["resilience"]["hedges"]["wins"]
+            + summary["resilience"]["hedges"]["losers"]
+        )
+        and summary["resilience"]["breakers"]["bypass_forwards"] == 0
+        and summary["resilience"]["retry_budget"]["exhausted"] >= (
+            summary["resilience"]["retry_budget_exhausted"]
+        )
     )
     return summary
 
@@ -635,6 +715,8 @@ def main(argv=None) -> int:
     ap.add_argument("--replica", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--bundle", help=argparse.SUPPRESS)
+    ap.add_argument("--net-delay", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args.replica:

@@ -70,7 +70,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def run_soak(model=None, clients=4, duration=5.0, seed=0,
              fault_every=7, max_new=6, speculative=True,
-             paged=True, mesh=None) -> dict:
+             paged=True, mesh=None, storm=True) -> dict:
     """Drive the soak; returns the summary dict (also what ``main``
     prints). ``fault_every``: mean steps between injected device-step
     faults (the blame-path pressure); wire faults ride fixed seeded
@@ -88,7 +88,18 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     8-virtual-device CPU mesh): every identity/pairing/ledger bar
     above holds UNCHANGED on a sharded engine, and a watchdog restart
     must rebuild the sharded stepper and re-warm the sharded buckets
-    (the stepper config carries the mesh through ``_restart``)."""
+    (the stepper config carries the mesh through ``_restart``).
+    ``storm`` (the default): the engine runs the adaptive overload
+    gate (``shed=``) and a mid-soak STORM PHASE hammers it — a burst
+    of extra no-retry priority-0 clients, several times the steady
+    set. The shed ledger must balance: every gate refusal is a typed
+    ``overloaded`` reply carrying an honest ``retry_after_ms`` (the
+    burst clients assert the hint on every shed they see), burst
+    accounting is exact (every burst attempt resolves ok or typed,
+    none hung/untyped), the gate actually shed under the burst, and
+    the identity/trace bars above hold right through the brownout —
+    retrying steady clients ride out the storm, and every output
+    that DOES complete mid-storm still matches its reference."""
     import numpy as np
 
     from distkeras_tpu.faults import FaultPlan
@@ -160,6 +171,20 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         # scheduler runs priorities + WFQ + preemption-by-swap under
         # the same chaos as everything else
         qos=QosPolicy(preempt=True, max_preemptions=2),
+        # the overload-defense door: CoDel-style sojourn gate with a
+        # TIGHT target — the armed step seam makes requests fail fast,
+        # so the queue never builds tens-of-ms sojourns; when the
+        # burst's genuine extra queueing crosses the target it latches
+        # rung 1 organically, and the storm phase ALSO declares an
+        # operator brownout through ``burn_fn`` (below) so rung 1 is
+        # guaranteed for the burst window at any scale. Rung 1 sheds
+        # priority 0 typed; the steady mixed-priority clients at 1/2
+        # ride through, and rung 1 never clamps, so replay identity
+        # is untouched. burn_interval is short so the declared
+        # brownout engages and releases within the storm window.
+        **(dict(shed=dict(target_ms=1.5, interval_ms=100.0,
+                          burn_interval=0.2))
+           if storm else {}),
         # tensor-parallel arm: the same chaos over a sharded stepper
         **(dict(mesh=mesh) if mesh else {}),
         # self-draft: k proposals that always agree, so every scheduler
@@ -219,6 +244,12 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         .arm("server.reply", action="drop", times=None, probability=0.03)
         .arm("net.send", action="reset", times=None, probability=0.01)
         .arm("net.send", action="truncate", times=None, probability=0.01)
+        # gray-failure flavor: probabilistic server-side stalls on the
+        # data verbs (the net.delay seam) — slow replies must still be
+        # CORRECT replies, and the shed gate's sojourn signal must not
+        # confuse a stalled wire with a congested queue
+        .arm("net.delay", action="delay", delay=0.05, times=None,
+             probability=0.02)
         # paged-KV allocator chaos: a generic allocator crash (typed
         # internal via the prefill-failure path) and injected pool
         # exhaustion (typed retriable overloaded, absorbed by the
@@ -348,18 +379,122 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
                                 summary["grammar_violations"] += 1
                 check_trace(c)
 
+    storm_stats = {
+        "burst_clients": 0, "attempts": 0, "ok": 0, "corrupt": 0,
+        "typed": {}, "untyped": 0, "hung": 0, "hint_missing": 0,
+    }
+
+    def storm_loop():
+        """The storm phase: mid-soak, 5x the steady client count of
+        NO-RETRY priority-0 one-shot clients slam the gate. No retry
+        wrapper means every shed SURFACES (typed ``overloaded``), so
+        the burst ledger is exact: attempts == ok + typed + untyped,
+        every overloaded reply must carry a retry hint, and every
+        burst completion is identity-checked like steady traffic."""
+        start = stop_at - 0.65 * float(duration)
+        delay = start - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        burst_end = min(stop_at - 0.1, time.monotonic()
+                        + 0.45 * float(duration))
+        n = 5 * int(clients)
+        storm_stats["burst_clients"] = n
+        # the operator-declared brownout: for the burst window the
+        # gate's burn signal reads "burning" (the PR 15 burn-rate
+        # vocabulary, rung 1 — shed priority 0, never clamp). This is
+        # the brownout ladder's real input path, not a test shim: the
+        # ladder is DESIGNED to be driven by SLO/operator verdicts,
+        # and the soak acts as the operator for the storm's duration —
+        # so rung 1 engages deterministically at any scale, with
+        # organic CoDel latching riding on top when queueing builds.
+        gate = engine.shed_gate
+        steady_burn = gate.burn_fn
+        gate.burn_fn = lambda: "burning"
+
+        def burst(bi):
+            brng = np.random.default_rng(seed * 77 + 7 * bi + 1)
+            with ServingClient(
+                "127.0.0.1", server.port, retry=False,
+            ) as c:
+                while time.monotonic() < burst_end:
+                    pi = int(brng.integers(0, len(prompts)))
+                    with lock:
+                        storm_stats["attempts"] += 1
+                    try:
+                        out = c.generate(
+                            prompts[pi], max_new, tenant="storm",
+                            priority=0,
+                        )
+                    except ServingError as e:
+                        code = getattr(e, "code", type(e).__name__)
+                        hint = getattr(e, "retry_after_ms", None) or (
+                            getattr(e, "retry_after", None)
+                        )
+                        with lock:
+                            storm_stats["typed"][code] = (
+                                storm_stats["typed"].get(code, 0) + 1
+                            )
+                            if code == "overloaded" and not hint:
+                                storm_stats["hint_missing"] += 1
+                        continue
+                    except (ConnectionError, OSError):
+                        # wire chaos (reset/truncate/drop) with no
+                        # retry wrapper: typed-equivalent, counted,
+                        # not a finding
+                        with lock:
+                            storm_stats["typed"]["connection"] = (
+                                storm_stats["typed"].get("connection", 0)
+                                + 1
+                            )
+                        continue
+                    except Exception as e:  # noqa: BLE001 — the finding
+                        with lock:
+                            storm_stats["untyped"] += 1
+                            if len(summary["untyped_samples"]) < 5:
+                                summary["untyped_samples"].append(
+                                    "storm: " + repr(e)
+                                )
+                        continue
+                    with lock:
+                        if np.array_equal(out, refs[pi]):
+                            storm_stats["ok"] += 1
+                        else:
+                            storm_stats["corrupt"] += 1
+
+        bts = [
+            threading.Thread(target=burst, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        for t in bts:
+            t.start()
+        for t in bts:
+            t.join(timeout=duration + 60.0)
+        gate.burn_fn = steady_burn  # the brownout declaration lifts
+        with lock:
+            storm_stats["hung"] = sum(t.is_alive() for t in bts)
+
     threads = [
         threading.Thread(target=client_loop, args=(i,), daemon=True)
         for i in range(int(clients))
     ]
+    storm_thread = (
+        threading.Thread(target=storm_loop, daemon=True)
+        if storm else None
+    )
     with plan:
         for t in threads:
             t.start()
+        if storm_thread is not None:
+            storm_thread.start()
         for t in threads:
             # generous per-thread budget past the wall-clock: a thread
             # still alive after this is DEFINITIONALLY hung
             t.join(timeout=duration + 60.0)
+        if storm_thread is not None:
+            storm_thread.join(timeout=2 * duration + 90.0)
     hung = sum(t.is_alive() for t in threads)
+    if storm_thread is not None:
+        hung += int(storm_thread.is_alive())
 
     summary["hung"] = hung
     summary["mesh"] = engine._stepper.mesh_spec if engine._stepper else None
@@ -367,7 +502,8 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
     summary["fired_by_site"] = {
         s: plan.fired(s)
         for s in ("stepper.step", "stepper.verify", "server.reply",
-                  "net.send", "scheduler.loop", "kv.alloc", "kv.swap")
+                  "net.send", "net.delay", "scheduler.loop",
+                  "kv.alloc", "kv.swap")
     }
     engine_stats = engine.stats()
     summary["engine"] = {
@@ -394,6 +530,26 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         == engine_stats["resumes"] + engine_stats["swap_in_failures"]
         + engine_stats["swapped_failed"]
     )
+    if storm:
+        storm_stats["accounting_exact"] = (
+            storm_stats["attempts"]
+            == storm_stats["ok"] + storm_stats["corrupt"]
+            + sum(storm_stats["typed"].values())
+            + storm_stats["untyped"]
+        )
+        summary["storm"] = storm_stats
+        # the restart-proof shed ledger lives on the GATE (it rides
+        # the batcher config through watchdog restarts); the batcher
+        # counters below are the last scheduler generation's view
+        summary["shed"] = {
+            "gate": engine.shed_gate.state(),
+            "shed_overloaded_last_gen": engine_stats.get(
+                "shed_overloaded", 0
+            ),
+            "shed_clamped_last_gen": engine_stats.get(
+                "shed_clamped", 0
+            ),
+        }
     if paged:
         pg = engine_stats["paged"]
         summary["paged"] = {
@@ -487,6 +643,21 @@ def run_soak(model=None, clients=4, duration=5.0, seed=0,
         # typed failure, and (paged) the pool ledger balanced
         and summary["qos"]["paired"]
         and (not paged or summary["paged"]["pool_balanced"])
+        # the storm bars: the burst's no-retry ledger is exact (every
+        # attempt resolved ok or typed, none hung/untyped/corrupt),
+        # every overloaded reply carried a retry hint, and the gate
+        # actually shed under the burst (the brownout engaged — the
+        # steady clients riding it out is what the identity and trace
+        # bars above then prove)
+        and (not storm or (
+            storm_stats["hung"] == 0
+            and storm_stats["untyped"] == 0
+            and storm_stats["corrupt"] == 0
+            and storm_stats["hint_missing"] == 0
+            and storm_stats["accounting_exact"]
+            and storm_stats["attempts"] > 0
+            and summary["shed"]["gate"]["sheds"] >= 1
+        ))
     )
     return summary
 
@@ -830,6 +1001,10 @@ def main(argv=None) -> int:
                     help="serve plain decode instead of self-draft "
                          "speculative (disarms the stepper.verify seam's "
                          "traffic)")
+    ap.add_argument("--no-storm", action="store_true",
+                    help="skip the overload-storm phase and run "
+                         "without the adaptive shed gate (the "
+                         "pre-overload-defense engine door)")
     ap.add_argument("--dense", action="store_true",
                     help="serve the dense slot bank instead of the "
                          "paged KV cache (disarms the kv.alloc seam's "
@@ -870,6 +1045,7 @@ def main(argv=None) -> int:
         fault_every=args.fault_every,
         speculative=not args.no_speculative,
         paged=not args.dense, mesh=args.mesh,
+        storm=not args.no_storm,
     )
     json.dump(summary, sys.stdout, indent=2, default=str)
     print()
