@@ -49,11 +49,21 @@ slots and queue capacity on a shared core, not compute — the gated
 claims are the scale event itself, storm-free joins, and identity,
 with only a loose band on the p99 ratio.
 
+The FABRIC section (full runs, or ``--fabric-only``) is the fleet KV
+fabric A/B: a cold requester decoding prefix-heavy traffic with no
+hints (recompute), with hints naming a warm sibling (real ``kv.fetch``
+wire pulls), and with hints whose pages were churned away after the
+digest was read (the adversarial row — every fetch pays a round-trip
+for a clean miss and degrades to recompute). It commits the
+fetch-vs-recompute and churn-vs-recompute tokens/sec ratios, the
+wire-bytes-per-restored-token cost, and both sides' peer ledgers to
+the ``fabric`` block of BENCH_FLEET.json, all outputs identity-pinned.
+
 Writes BENCH_FLEET.json and prints one JSON line.
 
 Usage: python bench_fleet.py [--cpu] [--smoke] [--slots 4]
                              [--requests 24] [--repeats 3]
-                             [--autoscale-only]
+                             [--autoscale-only] [--fabric-only]
 """
 
 from __future__ import annotations
@@ -533,6 +543,187 @@ def _measure_autoscale(model, reqs, refs, *, slots, chunk, arrivals,
     return out
 
 
+def _measure_fabric(model, ref_gen, *, slots, chunk, requests, repeats,
+                    seq, vocab):
+    """Fleet KV fabric A/B: a COLD requester decoding prefix-heavy
+    traffic three ways — **recompute** (no hints: every header's
+    prefill recomputed locally), **fetch** (hints naming a warm
+    sibling: pages pulled over the real ``kv.fetch`` wire and inserted
+    locally before admission), and **churn** (the adversarial honesty
+    row: the sibling's store turned over completely after the hints
+    were cut, so every fetch pays a round-trip for a clean typed miss
+    and degrades to recompute — the worst case page-aware routing can
+    inflict). A fresh requester engine per timed pass keeps the store
+    cold (the fetch is the effect under measurement); passes are
+    interleaved so machine drift hits all three sides equally; every
+    output on every side is asserted token-identical to its solo
+    decode. Ledger invariants (fetch side clean, churn side fully
+    degraded, wire bytes paired across both ends) are asserted at
+    measurement time so a regressed fabric cannot commit a
+    green-looking artifact."""
+    from distkeras_tpu.serving import ServingEngine, ServingServer
+
+    header_len, n_headers = 16, 4
+    rng = np.random.default_rng(11)
+    headers = [
+        rng.integers(0, vocab, header_len).astype(np.int32)
+        for _ in range(n_headers)
+    ]
+    reqs = []
+    for i in range(requests):
+        h = headers[i % n_headers]
+        sfx = rng.integers(0, vocab, int(rng.integers(1, 5)))
+        prompt = np.concatenate([h, sfx]).astype(np.int32)
+        steps = int(rng.integers(max(2, seq // 8), max(3, seq // 4)))
+        reqs.append((prompt, max(1, min(steps, seq - prompt.size))))
+    smax = max(s for _, s in reqs)
+    ragged = ref_gen.generate([p for p, _ in reqs], steps=smax)
+    refs = [
+        np.asarray(row)[: p.size + s]
+        for row, (p, s) in zip(list(ragged), reqs)
+    ]
+
+    engine_kw = dict(
+        num_slots=slots, queue_capacity=2 * len(reqs) + 8,
+        prefill_chunk=chunk, prefix_cache=True,
+    )
+    peer = ServingEngine(model, **engine_kw)
+    srv = ServingServer(peer).start()
+
+    def warm_peer():
+        peer.prefix_store.clear()
+        for h in headers:  # two-touch: the second completion inserts
+            # one token past the header: the store keys prefixes of
+            # the PREFILLED positions (the prompt's last token is fed
+            # at decode), so rung 16 needs a 17-token prompt
+            wp = np.concatenate([h, h[:1]])
+            for _ in range(2):
+                peer.wait(peer.submit(wp, 1))
+        assert all(
+            peer.prefix_store.coverage(h) == header_len
+            for h in headers
+        ), "peer warm did not cover the headers"
+
+    def churn_peer():
+        # eviction-scale content turnover AFTER the hints were cut:
+        # every page the digest advertised is gone by fetch time
+        peer.prefix_store.clear()
+        junk_kv = [(
+            np.zeros((header_len, 1, 1), np.float32),
+            np.zeros((header_len, 1, 1), np.float32),
+        )]
+        for _ in range(2 * n_headers):
+            peer.prefix_store.insert_prefixes(
+                rng.integers(0, vocab, header_len).astype(np.int32),
+                junk_kv,
+            )
+
+    hints = [{"endpoint": (srv.host, srv.port),
+              "epoch": int(peer.kv_epoch), "len": header_len}]
+    serve_keys = ("fetch_served", "fetch_miss", "stale_refusals",
+                  "bytes_out")
+    peer_keys = ("fetches", "fetch_ok", "fetch_degraded",
+                 "fetch_retries", "breaker_skips", "bytes_in")
+    agg = {
+        s: {"tps": [], "peer": dict.fromkeys(peer_keys, 0),
+            "serve": dict.fromkeys(serve_keys, 0)}
+        for s in ("recompute", "fetch", "churn")
+    }
+    try:
+        for _ in range(repeats):
+            for side in ("recompute", "fetch", "churn"):
+                churn_peer() if side == "churn" else warm_peer()
+                eng = ServingEngine(model, **engine_kw).start()
+                try:
+                    kv_hints = None if side == "recompute" else hints
+                    serve0 = {
+                        k: peer.peer_fabric.counters[k]
+                        for k in serve_keys
+                    }
+                    outs = [None] * len(reqs)
+
+                    def run_one(i, out=outs, e=eng, kv=kv_hints):
+                        p, s = reqs[i]
+                        out[i] = e.wait(e.submit(p, s, kv_peers=kv))
+
+                    ths = [
+                        threading.Thread(target=run_one, args=(i,))
+                        for i in range(len(reqs))
+                    ]
+                    t0 = time.perf_counter()
+                    for t in ths:
+                        t.start()
+                    for t in ths:
+                        t.join(timeout=600)
+                    wall = time.perf_counter() - t0
+                    for i, (got, want) in enumerate(zip(outs, refs)):
+                        assert got is not None and np.array_equal(
+                            got, want
+                        ), f"fabric {side} req {i}: output != solo"
+                    agg[side]["tps"].append(
+                        sum(s for _, s in reqs) / wall
+                    )
+                    for k in peer_keys:
+                        agg[side]["peer"][k] += int(
+                            eng.peer_fabric.counters[k]
+                        )
+                    for k in serve_keys:
+                        agg[side]["serve"][k] += int(
+                            peer.peer_fabric.counters[k] - serve0[k]
+                        )
+                finally:
+                    eng.stop()
+    finally:
+        srv.shutdown()
+
+    def side_rec(side):
+        tps = agg[side]["tps"]
+        return {
+            "tokens_per_sec": round(float(np.median(tps)), 1),
+            "tokens_per_sec_spread": [
+                round(min(tps), 1), round(max(tps), 1)
+            ],
+            "peer": agg[side]["peer"],
+            "serve": agg[side]["serve"],
+        }
+
+    out = {
+        "num_requests": len(reqs),
+        "headers": n_headers,
+        "header_len": header_len,
+        "repeats": repeats,
+        "recompute": side_rec("recompute"),
+        "fetch": side_rec("fetch"),
+        "churn": side_rec("churn"),
+        "outputs_identical": True,
+        "single_core_caveat": (
+            "requester and sibling time-share ONE CPU core: the "
+            "fetch_vs_recompute ratio prices the wire hop + insert "
+            "against a recompute whose FLOPs ride the same core the "
+            "sibling serves from — par is the honest expectation "
+            "here; the claimed win is the recompute FLOPs removed "
+            "from the requester's device, visible as wire bytes "
+            "replacing prefill compute"
+        ),
+    }
+    fp, cp = out["fetch"]["peer"], out["churn"]["peer"]
+    assert fp["fetch_ok"] >= 1 and fp["fetch_degraded"] == 0, fp
+    assert cp["fetch_ok"] == 0 and cp["fetch_degraded"] >= 1, cp
+    assert fp["bytes_in"] == out["fetch"]["serve"]["bytes_out"], out
+    out["wire_bytes_per_restored_token"] = round(
+        fp["bytes_in"] / (fp["fetch_ok"] * header_len), 1
+    )
+    out["fetch_vs_recompute"] = _ratio(
+        out["fetch"]["tokens_per_sec"],
+        out["recompute"]["tokens_per_sec"],
+    )
+    out["churn_vs_recompute"] = _ratio(
+        out["churn"]["tokens_per_sec"],
+        out["recompute"]["tokens_per_sec"],
+    )
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
@@ -549,6 +740,11 @@ def main() -> None:
                     help="run only the ramp autoscale A/B (the "
                          "--kind autoscale gate's smoke path); plain "
                          "--smoke skips it, full runs include it")
+    ap.add_argument("--fabric-only", action="store_true",
+                    help="run only the KV-fabric fetch-vs-recompute "
+                         "A/B (the --kind fabric gate's smoke path); "
+                         "plain --smoke skips it, full runs include "
+                         "it")
     args = ap.parse_args()
 
     platform = setup_backend(cpu=args.cpu or args.smoke)
@@ -620,7 +816,7 @@ def main() -> None:
         ),
         "workloads": {},
     }
-    if not args.autoscale_only:
+    if not (args.autoscale_only or args.fabric_only):
         for name, (timed, prime) in workloads.items():
             smax = max(s for _, s in timed)
             ragged = ref_gen.generate([p for p, _ in timed], steps=smax)
@@ -647,7 +843,7 @@ def main() -> None:
                 "random_hit_rate": wl["random_hit_rate"],
             }}), flush=True)
 
-    if args.autoscale_only or not args.smoke:
+    if args.autoscale_only or not (args.smoke or args.fabric_only):
         # the ramp autoscale A/B: one seeded loadgen ramp trace over a
         # static 1-replica fleet vs an autoscaled one, interleaved.
         # The section carries its OWN model (long sequences, tiny
@@ -703,13 +899,30 @@ def main() -> None:
                 a["p99_ratio_static_over_autoscaled"],
         }}), flush=True)
 
+    if args.fabric_only or not (args.smoke or args.autoscale_only):
+        record["fabric"] = _measure_fabric(
+            model, ref_gen, slots=args.slots, chunk=chunk,
+            requests=args.requests, repeats=args.repeats,
+            seq=seq, vocab=vocab,
+        )
+        fb = record["fabric"]
+        print(json.dumps({"fabric": {
+            "fetch_vs_recompute": fb["fetch_vs_recompute"],
+            "churn_vs_recompute": fb["churn_vs_recompute"],
+            "wire_bytes_per_restored_token":
+                fb["wire_bytes_per_restored_token"],
+        }}), flush=True)
+
     if record["workloads"]:
         record["value"] = record["workloads"]["prefix_heavy"][
             "fleet_affinity"]["tokens_per_sec"]
-    else:
+    elif "autoscale" in record:
         del record["workloads"]
         record["value"] = record["autoscale"]["autoscaled"][
             "tokens_per_sec"]
+    else:
+        del record["workloads"]
+        record["value"] = record["fabric"]["fetch"]["tokens_per_sec"]
     with open("BENCH_FLEET.json", "w") as f:
         json.dump(record, f, indent=2)
     line = {"metric": record["metric"], "value": record["value"]}

@@ -1662,7 +1662,9 @@ def _measure_disagg_scenario(model, trace, refs, *, slots, chunk,
         transfer = {
             k: d_stats[k]
             for k in ("disagg_routed", "transfer_sends", "transfer_ok",
-                      "transfer_typed", "transfer_retries")
+                      "transfer_typed", "transfer_retries",
+                      "peer_sends", "peer_ok", "peer_typed",
+                      "peer_degraded")
         }
     finally:
         for rt in (d_router, u_router):
@@ -1712,9 +1714,15 @@ def _measure_disagg_scenario(model, trace, refs, *, slots, chunk,
             d_side["tokens_per_sec"], u_side["tokens_per_sec"]
         ),
         "transfer": transfer,
+        # both ledgers: every relay hop resolved (ok/typed) AND every
+        # direct-push pairing settled exactly once (ok/typed/degraded
+        # — a degraded pairing fell back to the relay, never stranded)
         "transfer_balanced": (
             transfer["transfer_sends"]
             == transfer["transfer_ok"] + transfer["transfer_typed"]
+            and transfer["peer_sends"]
+            == transfer["peer_ok"] + transfer["peer_typed"]
+            + transfer["peer_degraded"]
         ),
         "outputs_identical": True,
     }

@@ -44,6 +44,21 @@ Seam catalogue (the hook points that exist today)::
                         retries the SAME bytes on a sibling decode
                         worker (bounded) — no direction can hang a
                         client or strand a slot
+    kv.peer             the fleet KV fabric's worker-to-worker paths
+                        (serving/kv_transfer.py ``PeerFabric`` and the
+                        engine's ``kv.fetch`` serving half), fired
+                        BEFORE any state changes; ``ctx["direction"]``
+                        is "fetch" (requester about to dial a sibling
+                        for prefix pages), "push" (prefill worker about
+                        to push a DKTX frame point-to-point to its
+                        paired decode worker), or "serve" (a sibling's
+                        fetch request about to be answered). Every
+                        failure direction degrades: a failed fetch
+                        falls back to local recompute (token-identical
+                        to the never-fetched run), a failed push
+                        returns the frame to the router's relay path,
+                        a failed serve replies typed — no direction
+                        can hang a request or corrupt a cache
     server.dispatch     ServingServer verb dispatch (typed-reply path)
     server.reply        ServingServer before sending a reply frame
     router.dispatch     FleetRouter verb dispatch, before a replica is
@@ -124,6 +139,7 @@ SITES = frozenset(
         "kv.alloc",
         "kv.swap",
         "kv.transfer",
+        "kv.peer",
         "server.dispatch",
         "server.reply",
         "router.dispatch",

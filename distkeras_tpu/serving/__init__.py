@@ -74,15 +74,18 @@ from distkeras_tpu.serving.scheduler import (
     EngineStoppedError,
     InternalError,
     OverloadedError,
+    PeerError,
     PoolExhaustedError,
     QuotaExhaustedError,
     ServeRequest,
     ServingError,
+    StaleEpochError,
     WindowedBatcher,
     WrongRoleError,
 )
 from distkeras_tpu.serving.kv_transfer import (
     KvTransferError,
+    PeerFabric,
     decode_state,
     encode_state,
 )
@@ -140,6 +143,8 @@ __all__ = [
     "NgramDrafter",
     "OverloadedError",
     "PageAllocator",
+    "PeerError",
+    "PeerFabric",
     "PoolExhaustedError",
     "PrefixStore",
     "QosPolicy",
@@ -152,6 +157,7 @@ __all__ = [
     "ServingEngine",
     "ServingError",
     "ServingServer",
+    "StaleEpochError",
     "TokenMaskCompiler",
     "TokenStream",
     "WindowedBatcher",

@@ -33,6 +33,7 @@ around the device phases.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -44,8 +45,10 @@ from distkeras_tpu.serving.scheduler import (
     ContinuousBatcher,
     EngineStoppedError,
     InternalError,
+    PeerError,
     ServeRequest,
     ServingError,
+    StaleEpochError,
     WindowedBatcher,
     WrongRoleError,
 )
@@ -3204,6 +3207,14 @@ class ServingEngine:
             self._stepper = DecodeStepper(model, **self._stepper_cfg)
             self._stepper.on_compile = self._extend_grace
             self.prefix_store = store
+            if store is not None:
+                # fabric staleness at a glance: seconds since the
+                # store's content (and so its advertised digest) last
+                # moved — the dkt_top fabric column's "age"
+                self.registry.gauge(
+                    "serving_kv_fabric_digest_age_seconds",
+                    fn=store.digest_age,
+                )
         except ValueError as e:
             if self._mesh is not None:
                 # a mesh was requested explicitly for sharded decode:
@@ -3379,6 +3390,19 @@ class ServingEngine:
         self.transfer_bytes_in = reg.counter(
             "serving_transfer_bytes_in", fresh=True
         )
+        # the fleet KV fabric's identity + transport: ``kv_epoch`` is
+        # a RANDOM 32-bit stamp minted at construction and re-minted
+        # on every supervisor restart — random, not a counter, so a
+        # restarted process (or a rolled-over replacement on the same
+        # endpoint) can never collide with its predecessor's epoch
+        # and serve pages a sibling routed to under the old digest.
+        # ``peer_fabric`` is the pooled worker-to-worker client spine
+        # (kv.fetch pulls, direct disagg pushes); cheap until used —
+        # no sockets are opened at construction.
+        self.kv_epoch = int.from_bytes(os.urandom(4), "big")
+        from distkeras_tpu.serving.kv_transfer import PeerFabric
+
+        self.peer_fabric = PeerFabric(registry=self.registry)
         if paged:
             # page-pool occupancy gauges, read from whichever stepper
             # generation is live (supervisor restarts rebuild the pool)
@@ -3692,6 +3716,12 @@ class ServingEngine:
             return
         self._restarts += 1
         self._stepper = stepper
+        # new scheduler generation = new KV epoch: siblings holding
+        # the old digest get typed ``stale_epoch`` refusals (and fall
+        # back to recompute) until their next health poll re-learns
+        # this replica — a restarted engine can never serve pages
+        # against a promise its predecessor made
+        self.kv_epoch = int.from_bytes(os.urandom(4), "big")
         batcher = ContinuousBatcher(stepper, **self._batcher_cfg)
         self.batcher = batcher
         self._launch_scheduler(batcher)
@@ -3727,6 +3757,7 @@ class ServingEngine:
             # whose scheduler thread was already dead)
             batcher.stop()
         self._predict_batcher.close()
+        self.peer_fabric.close()  # pooled peer sockets do not leak
         if self.recorder is not None:
             faults.remove_observer(self.recorder.fault_observer)
         self.drain_traces()  # the tail of the span ring is not lost
@@ -3735,7 +3766,7 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens, eos_id=None,
                deadline=None, trace=None, sampling=None, tenant=None,
-               priority=0, stream=False,
+               priority=0, stream=False, kv_peers=None,
                _prefill_only=False) -> ServeRequest:
         """``trace``: an optional ``obs.TraceContext`` — the scheduler
         then keeps the per-request event ledger ``obs.request_spans``
@@ -3756,7 +3787,19 @@ class ServingEngine:
         ``stream``: the scheduler pushes each iteration's emitted
         tokens into the request's chunk FIFO (``req.next_chunk``) as
         they are generated — the server's streaming ``generate``
-        drains it to the wire per chunk."""
+        drains it to the wire per chunk.
+
+        ``kv_peers``: the fleet router's page-affinity hint — a list
+        of ``{"endpoint": [host, port], "epoch": E, "len": n}`` dicts
+        naming siblings whose advertised prefix digest covered this
+        prompt. Before admission, any peer promising MORE coverage
+        than the local prefix cache is dialed over the peer fabric
+        (``kv.fetch``) and the validated pages inserted locally, so
+        admission's normal prefix-restore path hits. Strictly
+        best-effort and fail-soft: every failure — dead peer, stale
+        epoch, breaker open, corrupt frame — leaves the local cache
+        untouched and admission recomputes, token-identical to the
+        never-fetched run."""
         from distkeras_tpu.serving.sampling import (
             SamplingParams,
             check_spec_sampling,
@@ -3791,6 +3834,12 @@ class ServingEngine:
                 self.spec_mode, sampling.temperature, sampling.top_k,
                 sampling.top_p,
             )
+        if kv_peers:
+            # BEFORE the request enters the batcher: the scheduler
+            # thread's begin_admit reads the prefix store after this
+            # thread's insert, so a successful fetch is visible to
+            # exactly this admission
+            self._peer_prefetch(prompt, kv_peers)
         req = ServeRequest(
             prompt, max_new_tokens, eos_id=eos_id, deadline=deadline,
             trace=trace, sampling=sampling, tenant=tenant,
@@ -4051,6 +4100,133 @@ class ServingEngine:
         )
         return req
 
+    # -- fleet KV fabric ----------------------------------------------------
+
+    def _peer_prefetch(self, prompt, kv_peers) -> None:
+        """Best-effort peer prefix fetch ahead of one admission: walk
+        the router's ``kv_peers`` hints and, for any sibling promising
+        more coverage than the local host cache holds, pull its pages
+        over the peer fabric and insert them locally (pow2 ladder,
+        direct — no two-touch gate: the pages were already proven hot
+        on the sibling). Admission's normal prefix-restore path then
+        hits exactly as if local traffic had cached them, which is
+        why identity is free: a fetch is strictly additive to the
+        cache, so success and every failure mode alike decode
+        token-identically to the never-fetched run. NEVER raises —
+        every failure is counted, recorded, and degraded to
+        recompute."""
+        store = self.prefix_store
+        fab = self.peer_fabric
+        if store is None or fab is None:
+            return
+        tokens = np.asarray(prompt, np.int32).reshape(-1)
+        have = store.coverage(tokens)
+        for peer in kv_peers:
+            try:
+                ep = peer.get("endpoint")
+                want = int(peer.get("len") or 0)
+                epoch = peer.get("epoch")
+            except AttributeError:
+                continue  # malformed hint: never worth a request
+            if ep is None or want <= have:
+                continue  # local cache already covers this promise
+            try:
+                state = fab.fetch(ep, tokens[:want], epoch=epoch)
+            except Exception as e:  # noqa: BLE001 — fail-soft boundary
+                fab.counters["fetch_degraded"] += 1
+                self._record_transfer(
+                    "kv.peer.degraded", op="fetch", endpoint=list(ep),
+                    error=type(e).__name__, detail=str(e)[:200],
+                )
+                continue
+            if state is None:
+                # clean typed miss: the digest aged out on the sibling
+                fab.counters["fetch_degraded"] += 1
+                self._record_transfer(
+                    "kv.peer.degraded", op="fetch", endpoint=list(ep),
+                    error="miss", detail="peer no longer holds pages",
+                )
+                continue
+            p = int(state["len"])
+            if p > have:
+                store.insert_prefixes(tokens[:p], state["kv"])
+                have = max(have, store.coverage(tokens))
+                self._record_transfer(
+                    "kv.peer.fetch", endpoint=list(ep), tokens=p,
+                )
+            if have >= want:
+                return  # the longest promise is covered; stop dialing
+
+    def serve_prefix(self, tokens, epoch=None):
+        """The serving half of the fabric's ``kv.fetch`` verb: the
+        longest locally-cached prefix of ``tokens`` as a DKTX frame.
+
+        Serves from the HOST prefix store only, by design: the paged
+        device pools belong to the scheduler thread (donated buffers
+        are invalidated mid-step, so a connection-thread read would
+        race the device), while the host ladder is lock-guarded,
+        survives restarts, and already mirrors everything the device
+        index holds at pow2 granularity — so a fetch hit costs the
+        sibling one locked read, never a device sync.
+
+        The epoch gate runs first: a request stamped with an epoch
+        this engine no longer serves is refused typed
+        (``stale_epoch``) — the sibling routed on a digest advertised
+        before a restart/rollover, and pages served across that
+        boundary could have been computed under different weights.
+        Returns ``(blob, reply_header)``; a miss is ``(None, header)``
+        with ``hit: false`` — typed, so the requester degrades to
+        recompute silently."""
+        from distkeras_tpu.serving import kv_transfer
+
+        fab = self.peer_fabric
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        faults.fire(
+            "kv.peer", direction="serve", tokens=int(tokens.size)
+        )
+        if epoch is not None and int(epoch) != int(self.kv_epoch):
+            fab.counters["stale_refusals"] += 1
+            self._record_transfer(
+                "kv.peer.stale", asked=int(epoch),
+                current=int(self.kv_epoch),
+            )
+            raise StaleEpochError(
+                f"kv epoch {int(epoch)} is not current (this engine "
+                f"serves epoch {self.kv_epoch}): the digest you "
+                f"routed on predates a restart or rollover"
+            )
+        store = self.prefix_store
+        if store is None:
+            raise PeerError("this replica serves no prefix cache")
+        hit = store.peek(tokens)
+        if hit is None:
+            fab.counters["fetch_miss"] += 1
+            return None, {"ok": True, "hit": False}
+        p, kv = hit
+        blob = kv_transfer.encode_prefix(
+            tokens[:p], kv, epoch=self.kv_epoch
+        )
+        fab.counters["fetch_served"] += 1
+        fab.counters["bytes_out"] += len(blob)
+        self._record_transfer(
+            "kv.peer.serve", tokens=int(p), bytes=len(blob)
+        )
+        return blob, {
+            "ok": True, "hit": True, "len": int(p),
+            "epoch": int(self.kv_epoch),
+        }
+
+    def fabric_snapshot(self) -> dict:
+        """The fleet-fabric ledger (rides ``stats`` and the dkt_top
+        fabric columns): peer transfer counters, breaker states, the
+        retry-budget ledger, this engine's KV epoch, and the prefix
+        digest siblings route on."""
+        out = self.peer_fabric.snapshot()
+        out["epoch"] = int(self.kv_epoch)
+        if self.prefix_store is not None:
+            out["digest"] = self.prefix_store.digest()
+        return out
+
     def drain_traces(self) -> int:
         """Flush this engine's trace collector into its
         ``MetricsLogger`` (one ``trace_span`` JSONL line per span);
@@ -4261,6 +4437,31 @@ class ServingEngine:
                 0 if batcher is None else len(batcher._quarantined)
             ),
             "transfer": self.transfer_snapshot(),
+            # the fleet KV fabric's routing surface: this engine's KV
+            # epoch plus the compact prefix digest (gen-memoized — an
+            # unchanged cache costs one int compare per poll). The
+            # router's page-aware routing and peer-fetch hints are
+            # computed entirely from this block.
+            "kv_fabric": {
+                "epoch": int(self.kv_epoch),
+                "digest": (
+                    None
+                    if self.prefix_store is None
+                    else self.prefix_store.digest()
+                ),
+                # the peer-transfer ledger summary (plain int reads) —
+                # republished by the router's replica books so the
+                # dkt_top fabric columns need no metrics scrape
+                "peer": {
+                    k: self.peer_fabric.counters[k]
+                    for k in (
+                        "fetches", "fetch_ok", "fetch_degraded",
+                        "fetch_served", "fetch_miss", "pushes",
+                        "push_ok", "push_degraded", "stale_refusals",
+                        "bytes_in", "bytes_out",
+                    )
+                },
+            },
         }
         if batcher is not None:
             # load surface for routers/load-balancers: occupancy plus
@@ -4361,6 +4562,7 @@ class ServingEngine:
         out["status"] = self.health()["status"]
         out["role"] = self.role
         out["transfer"] = self.transfer_snapshot()
+        out["kv_fabric"] = self.fabric_snapshot()
         # the XLA compile ledger: every runtime mint with its trigger
         # (warmup vs serving), wall seconds, and the storm count — the
         # soaks assert storms == 0 from exactly this block

@@ -75,7 +75,7 @@ import numpy as np
 from distkeras_tpu import faults
 from distkeras_tpu.networking import probe, recv_data, send_data
 from distkeras_tpu.obs import stamp_error_trace as _stamp_trace
-from distkeras_tpu.serving.prefix_cache import _pow2_ladder
+from distkeras_tpu.serving.prefix_cache import _pow2_ladder, ladder_hashes
 from distkeras_tpu.serving.qos import as_bucket
 from distkeras_tpu.serving.scheduler import (
     QuotaExhaustedError,
@@ -141,6 +141,16 @@ class _Replica:
         self.failovers = 0      # forwards that died here and moved on
         self.slo_breaches = 0   # consecutive polls reporting slo breach
         self.last_health = None
+        # fleet KV fabric, parsed out of the replica's health reply:
+        # the KV epoch its frames/digests are stamped with, the
+        # prefix-page digest as a membership set (page-aware routing
+        # tests rung hashes against it), and when the digest was last
+        # refreshed (its AGE is the staleness bound digest routing
+        # accepts — at most one health interval behind the store)
+        self.kv_epoch = None
+        self.kv_digest = None       # frozenset of 4-byte key hashes
+        self.kv_digest_gen = None
+        self.kv_digest_at = None    # monotonic stamp of last refresh
         # gray-failure defense (None on a breaker-less router): the
         # per-replica circuit breaker and the labeled forward-latency
         # histogram its latency-outlier judgment is computed from
@@ -188,6 +198,28 @@ class _Replica:
             # rides health replies and the dkt_top fleet table
             "breaker": (
                 None if self.breaker is None else self.breaker.snapshot()
+            ),
+            # fleet KV fabric books: the replica's KV epoch, the size/
+            # generation/age of its advertised prefix digest, and its
+            # own peer-transfer counters republished from health —
+            # the dkt_top fabric columns read these without a
+            # per-replica metrics scrape
+            "kv_fabric": (
+                None if self.kv_epoch is None else {
+                    "epoch": self.kv_epoch,
+                    "digest_n": (
+                        None if self.kv_digest is None
+                        else len(self.kv_digest)
+                    ),
+                    "digest_gen": self.kv_digest_gen,
+                    "digest_age_s": (
+                        None if self.kv_digest_at is None
+                        else round(
+                            time.monotonic() - self.kv_digest_at, 3
+                        )
+                    ),
+                    "peer": (h.get("kv_fabric") or {}).get("peer"),
+                }
             ),
         }
 
@@ -360,6 +392,22 @@ class FleetRouter:
                 "transfer_typed",  # ... that ended typed (any error)
                 "transfer_retries",  # mid-hop deaths retried on a
                 # sibling decode worker (same bytes, bounded)
+                # fleet KV fabric (0 before any fabric traffic).
+                # Direct-push pairing ledger, invariant at quiescence:
+                # peer_sends == peer_ok + peer_typed + peer_degraded —
+                # every prefill dispatched WITH a ``push_to`` pairing
+                # settles exactly once: the pushed decode reply relayed
+                # (ok), the request concluded typed on the prefill hop
+                # (typed), or the blob handed back and relayed over the
+                # classic hop-2 path (degraded) — never a stranded
+                # client, never a double count
+                "peer_sends",      # prefills dispatched with push_to
+                "peer_ok",         # ... whose pushed decode reply won
+                "peer_typed",      # ... that concluded typed on hop 1
+                "peer_degraded",   # ... that fell back to hop-2 relay
+                "digest_routed",   # generates routed to the sibling
+                # whose advertised prefix digest holds the pages,
+                # over the bare rendezvous order
                 # circuit breakers (0 on a breaker-less router)
                 "breaker_opens",       # closed/half_open -> open
                 "breaker_half_opens",  # open -> half_open (probe armed)
@@ -710,6 +758,39 @@ class FleetRouter:
             if rep is None:
                 return
             rep.last_health = h
+            # fleet KV fabric: cache the replica's epoch + prefix-page
+            # digest as a membership set. A malformed/absent block
+            # clears the books (a pre-fabric build mid-rollout must
+            # not keep a stale digest routable); the gen guard skips
+            # the set rebuild when the store has not moved
+            kf = h.get("kv_fabric")
+            if isinstance(kf, dict):
+                try:
+                    rep.kv_epoch = int(kf["epoch"])
+                    dg = kf.get("digest")
+                    if isinstance(dg, dict):
+                        gen = int(dg.get("gen", 0))
+                        if (gen != rep.kv_digest_gen
+                                or rep.kv_digest is None):
+                            rep.kv_digest = frozenset(
+                                int(x) for x in (dg.get("h") or ())
+                            )
+                            rep.kv_digest_gen = gen
+                        rep.kv_digest_at = time.monotonic()
+                    else:
+                        rep.kv_digest = None
+                        rep.kv_digest_gen = None
+                        rep.kv_digest_at = None
+                except (KeyError, TypeError, ValueError):
+                    rep.kv_epoch = None
+                    rep.kv_digest = None
+                    rep.kv_digest_gen = None
+                    rep.kv_digest_at = None
+            else:
+                rep.kv_epoch = None
+                rep.kv_digest = None
+                rep.kv_digest_gen = None
+                rep.kv_digest_at = None
             if h.get("num_slots") is not None:
                 rep.capacity = int(h["num_slots"]) + int(
                     h.get("queue_capacity") or 0
@@ -1270,15 +1351,109 @@ class FleetRouter:
     # -- routing ------------------------------------------------------------
 
     def _affinity_key(self, verb, payload):
+        return self._affinity_info(verb, payload)[0]
+
+    def _affinity_info(self, verb, payload):
+        """``(key, rungs)`` of one generate payload: the rendezvous
+        routing key, plus the prompt's pow2-ladder digest hashes
+        ``[(p, h)]`` that page-aware routing and peer-fetch hints test
+        against replica digests. ``(None, None)`` for non-generate
+        verbs, affinity-off routers, prompts too short to cache, and
+        undecodable payloads (routing must not pre-judge what the
+        replica will refuse typed ``bad_request``)."""
         if verb != "generate" or not self.affinity:
-            return None
+            return None, None
         try:
             prompt = deserialize_params(payload)
         except Exception:  # noqa: BLE001 — let the replica reply typed
-            return None    # bad_request; routing must not pre-judge it
-        return affinity_key(prompt, min_len=self.affinity_min_len)
+            return None, None
+        key = affinity_key(prompt, min_len=self.affinity_min_len)
+        if key is None:
+            return None, None
+        return key, ladder_hashes(prompt, min_len=self.affinity_min_len)
 
-    def _pick(self, key, excluded, roles=None):
+    def _peer_hints(self, chosen, rungs, cap=2):
+        """Sibling peer-fetch hints for one generate landing on
+        ``chosen`` (caller holds the lock): up to ``cap`` ACTIVE
+        replicas whose advertised digest holds a rung of this prompt,
+        longest-held first, each as ``{"endpoint", "epoch", "len"}``.
+        The engine fetches fail-soft: a stale digest (at most one
+        health interval old) costs one refused/missed fetch and a
+        local recompute, never a wrong token."""
+        scored = []
+        for r in self._replicas.values():
+            if r.endpoint == chosen or r.state != ACTIVE:
+                continue
+            held = r.kv_digest
+            if not held:
+                continue
+            p = max((p for p, hsh in rungs if hsh in held), default=0)
+            if p:
+                scored.append((p, r))
+        scored.sort(key=lambda t: -t[0])
+        return [
+            {
+                "endpoint": [r.endpoint[0], r.endpoint[1]],
+                "epoch": r.kv_epoch,
+                "len": int(p),
+            }
+            for p, r in scored[:cap]
+        ]
+
+    def _pick_decode_for_push(self, key, rungs):
+        """Reserve the decode half of one direct-push pairing (caller
+        holds the lock): ACTIVE decode-role replicas whose breaker is
+        CLOSED and that have capacity, preferring the digest holder,
+        then rendezvous order (least-loaded when the prompt has no
+        key). Returns ``(replica, how)`` or ``(None, None)``.
+        Half-open/open breakers deliberately disqualify here rather
+        than probe: probe grant/settle semantics live in
+        ``_forward_loop``, and a push outcome reported second-hand by
+        the prefill worker is too indirect to settle a canary — such
+        pairings fall back to the classic relay, which probes
+        properly."""
+        cands = [
+            r for r in self._replicas.values()
+            if r.state == ACTIVE
+            and (r.last_health or {}).get("role") == "decode"
+            and (r.breaker is None or r.breaker.state == "closed")
+            and (r.capacity is None or r.in_flight < r.capacity)
+        ]
+        if not cands:
+            return None, None
+        if key is not None:
+            order = sorted(
+                cands,
+                key=lambda r: _rendezvous(key, r.endpoint),
+                reverse=True,
+            )
+            if rungs:
+                best = best_i = None
+                best_p = 0
+                for i, rep in enumerate(order):
+                    held = rep.kv_digest
+                    if not held:
+                        continue
+                    p = max(
+                        (p for p, hsh in rungs if hsh in held),
+                        default=0,
+                    )
+                    if p > best_p:
+                        best, best_i, best_p = rep, i, p
+                if best is not None:
+                    return best, (
+                        "affinity" if best_i == 0 else "digest"
+                    )
+            return order[0], "affinity"
+        order = sorted(
+            cands,
+            key=lambda r: (
+                r.in_flight / r.capacity if r.capacity else r.in_flight
+            ),
+        )
+        return order[0], "least_loaded"
+
+    def _pick(self, key, excluded, roles=None, rungs=None):
         """One routing decision under the lock: ``(replica, how,
         probe)`` or ``(None, why, False)`` — ``why`` is "empty"
         (nothing in rotation), "tried" (every rotation member already
@@ -1288,7 +1463,15 @@ class FleetRouter:
         decides whether the breaker closes.
         ``roles``: restrict candidates to replicas whose health
         advertises one of these disaggregation roles (None = any —
-        the role-less fleet's behavior, byte-for-byte)."""
+        the role-less fleet's behavior, byte-for-byte).
+        ``rungs``: the prompt's pow2-ladder digest hashes ``[(p, h)]``
+        — page-aware routing: the candidate whose advertised prefix
+        digest holds the LONGEST rung wins over the bare rendezvous
+        order (the pages are warm there NOW; the hash only predicts
+        where they would have been inserted). Rendezvous order breaks
+        ties so equally-warm siblings cannot flap, and the rendezvous
+        home keeps its "affinity" label when it is itself the best
+        holder — "digest" marks a real deviation."""
         cands = [
             r for r in self._replicas.values()
             if r.state == ACTIVE and (
@@ -1340,6 +1523,26 @@ class FleetRouter:
                 key=lambda r: _rendezvous(key, r.endpoint),
                 reverse=True,
             )
+            if rungs:
+                best = best_i = None
+                best_p = 0
+                for i, rep in enumerate(order):
+                    held = rep.kv_digest
+                    if not held or not (
+                        rep.capacity is None
+                        or rep.in_flight < rep.capacity
+                    ):
+                        continue
+                    p = max(
+                        (p for p, hsh in rungs if hsh in held),
+                        default=0,
+                    )
+                    if p > best_p:
+                        best, best_i, best_p = rep, i, p
+                if best is not None:
+                    return best, (
+                        "affinity" if best_i == 0 else "digest"
+                    ), False
             for i, rep in enumerate(order):
                 if rep.capacity is None or rep.in_flight < rep.capacity:
                     return rep, ("affinity" if i == 0 else "spill"), False
@@ -1360,6 +1563,7 @@ class FleetRouter:
         "spill": "spilled",
         "least_loaded": "least_loaded_routed",
         "probe": "breaker_probes",
+        "digest": "digest_routed",
     }
 
     def _breaker_change(self, ep, change, cause=None):
@@ -1466,7 +1670,7 @@ class FleetRouter:
         from distkeras_tpu.obs import TraceContext, start_span
 
         verb = header.get("verb")
-        key = self._affinity_key(verb, payload)
+        key, rungs = self._affinity_info(verb, payload)
         ctx = TraceContext.from_wire(header.get("trace"))
         span = None
         hops: list[str] = []
@@ -1511,8 +1715,11 @@ class FleetRouter:
         if picked is None:
             picked = []
         while True:
+            peers = None
             with self._lock:
-                rep, how, probe = self._pick(key, excluded, roles=roles)
+                rep, how, probe = self._pick(
+                    key, excluded, roles=roles, rungs=rungs
+                )
                 if rep is not None:
                     rep.in_flight += 1
                     rep.forwards += 1
@@ -1520,11 +1727,26 @@ class FleetRouter:
                     self.counters[self._HOW_COUNTER[how]] += 1
                     ep = rep.endpoint
                     picked.append(ep)
+                    if rungs:
+                        # fleet KV fabric: name the siblings whose
+                        # digests hold this prompt's pages so the
+                        # chosen replica can peer-fetch instead of
+                        # recomputing the shared prefix
+                        peers = self._peer_hints(ep, rungs)
                     if (rep.breaker is not None and not probe
                             and rep.breaker.state != "closed"):
                         # defensive tripwire — 0 by construction; the
                         # bench gates on it staying 0
                         self.counters["breaker_bypass_forwards"] += 1
+            if rep is not None:
+                # per-attempt hints: a failover sibling gets hints
+                # computed against ITS endpoint (never pointing a
+                # replica at itself), and loses stale ones
+                header = dict(header)
+                if peers:
+                    header["kv_peers"] = peers
+                else:
+                    header.pop("kv_peers", None)
             if rep is None:
                 if how == "saturated" or saw_overloaded_hint is not None:
                     with self._lock:
@@ -1756,18 +1978,21 @@ class FleetRouter:
         return state["primary"]
 
     def _forward_loop(self, header, payload, key, roles, hops, causes,
-                      ctx=None, retry_counter=None):
+                      ctx=None, retry_counter=None, rungs=None):
         """Bounded forward of ONE request to a role-filtered replica
-        set: pick (affinity when ``key``, else least-loaded), forward,
-        fail over on connection death / replica ``overloaded`` —
-        each replica tried at most once. Returns ``(reply, body, ep)``
-        on any relayed reply (ok or typed), or ``(None, (why, hint),
-        None)`` when no replica could take it."""
+        set: pick (affinity when ``key``, else least-loaded; digest
+        holder first when ``rungs``), forward, fail over on connection
+        death / replica ``overloaded`` — each replica tried at most
+        once. Returns ``(reply, body, ep)`` on any relayed reply (ok
+        or typed), or ``(None, (why, hint), None)`` when no replica
+        could take it."""
         excluded: set = set()
         saw_hint = None
         while True:
             with self._lock:
-                rep, how, probe = self._pick(key, excluded, roles=roles)
+                rep, how, probe = self._pick(
+                    key, excluded, roles=roles, rungs=rungs
+                )
                 if rep is not None:
                     rep.in_flight += 1
                     rep.forwards += 1
@@ -1875,15 +2100,31 @@ class FleetRouter:
         }
 
     def _route_disagg(self, header: dict, payload: bytes):
-        """The two-hop disaggregated generate: (1) the prompt
-        prefills on a prefill-role worker (least-loaded — prefill is
-        stateless across requests), whose reply payload is the slot's
+        """The disaggregated generate. Fast path — **direct push**:
+        the router reserves a decode-role worker up front (digest
+        holder first, then page-affinity rendezvous) and hands its
+        endpoint to the prefill worker as ``push_to``; the prefill
+        worker pushes the transfer frame point-to-point over its
+        pooled peer client and relays the decode reply back, so the
+        frame crosses the wire ONCE instead of round-tripping through
+        the router. The router keeps only the pairing ledger
+        (``peer_sends == peer_ok + peer_typed + peer_degraded``).
+
+        Fallback — the classic two-hop relay: (1) the prompt prefills
+        on a prefill-role worker (least-loaded — prefill is stateless
+        across requests), whose reply payload is the slot's
         ``kv_transfer`` frame; (2) the frame resumes on a decode-role
-        worker chosen by page-affinity (the same rendezvous hash of
-        the prompt's pow2 ladder key — transferred pages of a shared
-        header land where its siblings already decoded), relayed back
-        verbatim. Both hops fail over bounded and typed: a mid-hop
-        death retries a sibling (the transfer frame is re-sent
+        worker chosen by page-affinity, relayed back verbatim. The
+        relay runs when no decode worker is eligible for a push
+        (none ACTIVE / closed-breaker / with capacity), when the
+        prefill worker is a pre-push build (no ``pushed`` key in its
+        reply), and on ANY push failure — the prefill worker hands
+        the frame back ``pushed: False`` and the pairing settles
+        ``peer_degraded``, never a stranded client. Streaming disagg
+        always relays (``_stream_route``): the client's chunk stream
+        terminates at the router, so the decode hop must too. Both
+        relay hops fail over bounded and typed: a mid-hop death
+        retries a sibling (the transfer frame is re-sent
         byte-identical — resume is deterministic and idempotent), and
         exhaustion is the router's typed ``overloaded``/
         ``unavailable``, never a hang."""
@@ -1893,7 +2134,7 @@ class FleetRouter:
         span = None
         hops: list[str] = []
         causes: list = []
-        key = self._affinity_key("generate", payload)
+        key, rungs = self._affinity_info("generate", payload)
         if ctx is not None:
             span = start_span(
                 "router.route", ctx, verb="generate", disagg=True,
@@ -1923,11 +2164,41 @@ class FleetRouter:
         pheader = dict(header)
         pheader["verb"] = "prefill"
         pheader.pop("stream", None)
-        reply1, blob, ep1 = self._forward_loop(
-            pheader, payload, None, ("prefill",), hops, causes, ctx=ctx,
-        )
+        # direct push: reserve the decode half of the pairing NOW and
+        # hold its in_flight slot for the pairing's duration, so
+        # capacity accounting sees the push traffic the router itself
+        # never carries. peer_sends counts here — the pairing ledger
+        # opens when a prefill is dispatched WITH push_to, and settles
+        # exactly once below (ok / typed / degraded)
+        drep = dep = dhow = None
+        with self._lock:
+            drep, dhow = self._pick_decode_for_push(key, rungs)
+            if drep is not None:
+                drep.in_flight += 1
+                dep = drep.endpoint
+                self.counters["peer_sends"] += 1
+                if dhow == "digest":
+                    self.counters["digest_routed"] += 1
+                pheader["push_to"] = [dep[0], dep[1]]
+        try:
+            reply1, blob, ep1 = self._forward_loop(
+                pheader, payload, None, ("prefill",), hops, causes,
+                ctx=ctx,
+            )
+        finally:
+            if drep is not None:
+                with self._lock:
+                    r = self._replicas.get(dep)
+                    if r is not None:
+                        r.in_flight -= 1
+                        self._drained.notify_all()
         if reply1 is None:
             how, hint = blob
+            if drep is not None:
+                # the pairing concluded typed on hop 1 — the decode
+                # worker was never touched
+                with self._lock:
+                    self.counters["peer_typed"] += 1
             self.recorder.record(
                 "router.route", verb="generate", disagg=True,
                 outcome=f"prefill_{how}", hops=hops,
@@ -1938,11 +2209,54 @@ class FleetRouter:
             ), b""
         if not reply1.get("ok"):
             # the prefill worker's typed reply relays verbatim
+            if drep is not None:
+                with self._lock:
+                    self.counters["peer_typed"] += 1
             self.recorder.record(
                 "router.route", verb="generate", disagg=True,
                 outcome=f"prefill_{reply1.get('error')}", hops=hops,
             )
             return finish(reply1, str(reply1.get("error"))), b""
+        if drep is not None and reply1.get("pushed") is True:
+            # the decode reply rode back through the prefill worker:
+            # the frame crossed the wire once, the pairing settles ok.
+            # The server only stamps pushed=True on an OK decode
+            # reply, so this is the success path by construction
+            with self._lock:
+                self.counters["peer_ok"] += 1
+            self._note_breaker(dep, ok=True, probe=False)
+            hops.append(f"{dep[0]}:{dep[1]} pushed")
+            self.recorder.record(
+                "router.route", verb="generate", disagg=True,
+                push=True, prefill=f"{ep1[0]}:{ep1[1]}",
+                decode=f"{dep[0]}:{dep[1]}", how=dhow,
+                failovers=len(causes), outcome="ok",
+            )
+            return finish(
+                reply1, "ok", push=True,
+                prefill=f"{ep1[0]}:{ep1[1]}",
+                decode=f"{dep[0]}:{dep[1]}",
+            ), blob
+        if drep is not None:
+            # pushed=False (the prefill worker hands the frame back
+            # with the typed cause) or no ``pushed`` key at all (a
+            # pre-push build mid-rollout): settle the pairing
+            # degraded and relay the frame over the classic hop-2
+            # path below. The decode breaker is NOT fed here — a
+            # second-hand push failure can be the prefill worker's
+            # fault (deadline burned, peer pool refused); the relay
+            # contacts decode workers first-hand and feeds breakers
+            # from what it observes
+            cause = str(reply1.get("push_error") or "not_pushed")
+            with self._lock:
+                self.counters["peer_degraded"] += 1
+            hops.append(f"{dep[0]}:{dep[1]} push:{cause}")
+            self.recorder.record(
+                "router.peer_degrade",
+                prefill=f"{ep1[0]}:{ep1[1]}",
+                decode=f"{dep[0]}:{dep[1]}", cause=cause,
+                detail=reply1.get("push_detail"),
+            )
         # hop 2: kv.transfer (role-filtered; page-affinity). The
         # sampling params already ride INSIDE the transfer frame.
         theader = dict(header)
@@ -1956,7 +2270,7 @@ class FleetRouter:
         try:
             reply2, body2, ep2 = self._forward_loop(
                 theader, blob, key, ("decode",), hops, causes,
-                ctx=ctx, retry_counter="transfer_retries",
+                ctx=ctx, retry_counter="transfer_retries", rungs=rungs,
             )
         finally:
             with self._lock:
@@ -2047,14 +2361,14 @@ class FleetRouter:
                 theader["verb"] = "kv.transfer"
                 theader.pop("sampling", None)
                 self._shrink_deadline(theader, hop_t0)
-                key = self._affinity_key("generate", payload)
+                key, rungs = self._affinity_info("generate", payload)
                 with self._lock:
                     self.counters["transfer_sends"] += 1
                     self._transfer_inflight += 1
                 try:
                     outcome = self._relay_stream(
                         conn, theader, blob, key, ("decode",),
-                        retry_counter="transfer_retries",
+                        retry_counter="transfer_retries", rungs=rungs,
                     )
                 finally:
                     with self._lock:
@@ -2068,10 +2382,10 @@ class FleetRouter:
             # role-less fleet (or a half-provisioned role split):
             # stream the generate itself — never to a prefill-role
             # replica, which can only refuse it typed
-            key = self._affinity_key("generate", payload)
+            key, rungs = self._affinity_info("generate", payload)
             outcome = self._relay_stream(
                 conn, header, payload, key,
-                (None, "unified", "decode"),
+                (None, "unified", "decode"), rungs=rungs,
             )
             return outcome != "client_gone"
         except ServingError as e:
@@ -2086,7 +2400,7 @@ class FleetRouter:
             return self._send_client(conn, pack_frame(h))
 
     def _relay_stream(self, conn, header, payload, key, roles,
-                      retry_counter=None) -> str:
+                      retry_counter=None, rungs=None) -> str:
         """Forward a streaming request to a (role-filtered) replica
         and pump its frames to the client until the terminal one.
         Returns "ok", "typed" (terminal relayed either way),
@@ -2096,14 +2410,28 @@ class FleetRouter:
         hops: list[str] = []
         saw_hint = None
         while True:
+            peers = None
             with self._lock:
-                rep, how, probe = self._pick(key, excluded, roles=roles)
+                rep, how, probe = self._pick(
+                    key, excluded, roles=roles, rungs=rungs
+                )
                 if rep is not None:
                     rep.in_flight += 1
                     rep.forwards += 1
                     self.counters["forwards"] += 1
                     self.counters[self._HOW_COUNTER[how]] += 1
                     ep = rep.endpoint
+                    if rungs and header.get("verb") == "generate":
+                        peers = self._peer_hints(ep, rungs)
+            if rep is not None and header.get("verb") == "generate":
+                # same per-attempt peer-fetch hints the non-streamed
+                # path attaches (a kv.transfer hop carries its KV in
+                # the frame — nothing for the decode worker to fetch)
+                header = dict(header)
+                if peers:
+                    header["kv_peers"] = peers
+                else:
+                    header.pop("kv_peers", None)
             if rep is None:
                 what = "decode" if roles == ("decode",) else "serving"
                 sent = self._send_client(conn, pack_frame(

@@ -46,11 +46,17 @@ from __future__ import annotations
 
 import json
 import struct
+import threading
 import zlib
 
 import numpy as np
 
-from distkeras_tpu.serving.scheduler import ServingError
+from distkeras_tpu import faults
+from distkeras_tpu.serving.scheduler import (
+    PeerError,
+    ServingError,
+    StaleEpochError,
+)
 
 MAGIC = b"DKTX"
 VERSION = 1
@@ -85,7 +91,7 @@ def _dtype(name: str) -> np.dtype:
 
 
 def encode_state(state: dict, *, prompt_len: int, sampling=None,
-                 eos_id=None) -> bytes:
+                 eos_id=None, epoch=None) -> bytes:
     """Serialize a ``swap_out`` state dict into one transfer frame.
 
     ``prompt_len``: the original prompt's length — positions
@@ -96,7 +102,11 @@ def encode_state(state: dict, *, prompt_len: int, sampling=None,
     ``SamplingParams`` (its wire dict rides the header; the slot's
     live sampler scalars — seed, position counter — ride separately
     from ``state`` because a completion fork's derived seed differs
-    from the params' seed)."""
+    from the params' seed). ``epoch``: the sender's KV epoch (fleet
+    fabric frames only — None, the default, keeps the header
+    byte-identical to the pre-fabric format): a receiver that pinned
+    an epoch refuses a mismatching frame rather than trust pages
+    across a restart/rollover boundary."""
     ln = int(state["len"])
     plen = int(prompt_len)
     if not 1 <= plen <= ln:
@@ -142,6 +152,8 @@ def encode_state(state: dict, *, prompt_len: int, sampling=None,
         "spec_prompt_len": None if sp is None else int(sp.size),
         "crc": zlib.crc32(payload) & 0xFFFFFFFF,
     }
+    if epoch is not None:
+        header["epoch"] = int(epoch) & 0xFFFFFFFF
     h = json.dumps(header).encode()
     return MAGIC + _HEAD.pack(VERSION, len(h)) + h + payload
 
@@ -234,4 +246,366 @@ def decode_state(blob: bytes) -> dict:
         "sampling": SamplingParams.from_wire(header.get("sampling")),
         "eos_id": header.get("eos_id"),
         "spec_prompt": sp,
+        # fleet-fabric epoch stamp; absent on pre-fabric frames (None)
+        "epoch": header.get("epoch"),
     }
+
+
+def encode_prefix(tokens, kv, *, epoch=None) -> bytes:
+    """Serialize a prefix-cache entry — host ``PrefixStore`` rows for
+    an exact token prefix — as one transfer frame: the ``kv.fetch``
+    reply format of the fleet KV fabric. Same codec, degenerate slot:
+    ``len == prompt_len == tokens.size`` (nothing emitted yet),
+    sampler scalars zero (the FETCHING side owns the request's
+    sampler — fetched pages only pre-warm its prefix cache, they
+    never carry request state)."""
+    tokens = np.ascontiguousarray(
+        np.asarray(tokens, np.int32)
+    ).reshape(-1)
+    if tokens.size < 1:
+        raise KvTransferError("cannot encode an empty prefix")
+    state = {
+        "len": int(tokens.size),
+        "ctx": tokens,
+        "kv": kv,
+        "spos": 0,
+        "seed": 0,
+    }
+    return encode_state(
+        state, prompt_len=int(tokens.size), epoch=epoch
+    )
+
+
+class PeerFabric:
+    """Pooled point-to-point client fabric for worker-to-worker KV
+    movement — the transport spine of the fleet KV fabric.
+
+    Two operations ride it: ``fetch`` (a replica pulls a sibling's
+    cached prefix pages into its private cache after a local miss —
+    the ``kv.fetch`` verb) and ``push`` (a prefill worker ships its
+    DKTX frame straight to the paired decode worker instead of
+    relaying through the router). Both share one resilience spine:
+
+    - per-endpoint pooled ``ServingClient``s with client-side retry
+      DISABLED — the fabric owns its retry discipline;
+    - a per-endpoint ``CircuitBreaker``: an open breaker SKIPS the
+      peer operation outright (typed :class:`PeerError`) without
+      burning retry budget — a sibling known sick is not dialed;
+    - one shared ``RetryBudget`` (PR 19): each original peer op
+      deposits, each retry withdraws, exhaustion surfaces the
+      original typed error instead of amplifying;
+    - the ``kv.peer`` fault seam, fired before any wire I/O.
+
+    Fail-soft by contract: every failure surfaces typed
+    (:class:`PeerError` / :class:`StaleEpochError`) and the CALLER
+    degrades — the fetch path to local recompute (token-identical to
+    the never-fetched run, because a failed fetch leaves the local
+    cache exactly as it was), the push path back to the router's
+    relay hop (the encoded frame is never wasted). Fetch replies are
+    fully validated (magic/version/crc/epoch/ctx-equality) before the
+    caller sees any state, so a truncated or corrupt peer frame can
+    never poison a cache."""
+
+    def __init__(self, registry=None, retry_budget=True, breaker=True,
+                 fetch_timeout=10.0, push_timeout=120.0,
+                 connect_timeout=2.0, max_fetch_retries=1):
+        from distkeras_tpu.obs import MetricsRegistry
+        from distkeras_tpu.serving.resilience import (
+            as_breaker_config,
+            as_retry_budget,
+        )
+
+        self.registry = (
+            registry if registry is not None else MetricsRegistry()
+        )
+        self.fetch_timeout = float(fetch_timeout)
+        self.push_timeout = float(push_timeout)
+        self.connect_timeout = float(connect_timeout)
+        self.max_fetch_retries = int(max_fetch_retries)
+        self.budget = as_retry_budget(retry_budget)
+        self._breaker_cfg = as_breaker_config(breaker)
+        self._breakers: dict = {}
+        # (host, port, kind) -> idle clients; kind splits the pools so
+        # a fetch (short timeout — a stalled sibling must degrade to
+        # recompute quickly) never inherits a push socket's
+        # decode-length timeout or vice versa
+        self._pool: dict = {}
+        self._lock = threading.Lock()
+        self.counters = self.registry.group(
+            "serving_kv_peer",
+            (
+                "fetches",          # fetch attempts (client side)
+                "fetch_ok",         # validated frames received
+                "fetch_degraded",   # fetches degraded to recompute
+                "fetch_retries",    # budget-granted re-dials
+                "breaker_skips",    # ops skipped, breaker open
+                "pushes",           # direct-push attempts
+                "push_ok",          # pushed + decode replied ok
+                "push_degraded",    # push failed -> router relay
+                "fetch_served",     # serving half: frames shipped
+                "fetch_miss",       # serving half: typed miss replies
+                "stale_refusals",   # serving half: epoch mismatches
+                "bytes_in",         # peer frame bytes received (fetch)
+                "bytes_out",        # peer frame bytes sent (push+serve)
+            ),
+        )
+
+    # -- pooling / breakers -------------------------------------------------
+
+    @staticmethod
+    def _ep(endpoint) -> tuple:
+        return (str(endpoint[0]), int(endpoint[1]))
+
+    def breaker(self, endpoint):
+        """This endpoint's breaker (created on first use; None when
+        breakers are disabled). Exposed so tests and the serving-side
+        snapshot can read or force its state."""
+        if self._breaker_cfg is None:
+            return None
+        key = self._ep(endpoint)
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                from distkeras_tpu.serving.resilience import (
+                    CircuitBreaker,
+                )
+
+                br = CircuitBreaker(**self._breaker_cfg)
+                self._breakers[key] = br
+            return br
+
+    def _checkout(self, endpoint, kind):
+        key = self._ep(endpoint) + (kind,)
+        with self._lock:
+            pool = self._pool.get(key)
+            if pool:
+                return pool.pop()
+        from distkeras_tpu.serving.client import ServingClient
+
+        return ServingClient(
+            key[0], key[1],
+            timeout=(
+                self.fetch_timeout if kind == "fetch"
+                else self.push_timeout
+            ),
+            retry=False, connect_timeout=self.connect_timeout,
+        )
+
+    def _checkin(self, endpoint, kind, cli, ok):
+        if not ok:
+            cli.close()
+            return
+        with self._lock:
+            self._pool.setdefault(
+                self._ep(endpoint) + (kind,), []
+            ).append(cli)
+
+    def _roundtrip(self, endpoint, kind, header, payload):
+        cli = self._checkout(endpoint, kind)
+        ok = False
+        try:
+            reply, body = cli._roundtrip(
+                header, payload, raise_on_error=False
+            )
+            ok = True
+            return reply, body
+        finally:
+            self._checkin(endpoint, kind, cli, ok)
+
+    def _gate(self, endpoint):
+        """The breaker gate every peer op passes FIRST: closed lets it
+        through, open/half-open grants at most one probe — otherwise
+        the op is skipped typed, with NO retry-budget burn (skipping
+        a known-sick sibling must never tax the budget that healthy
+        retries draw from). Returns ``(breaker, probing)``."""
+        from distkeras_tpu.serving.resilience import CLOSED
+
+        br = self.breaker(endpoint)
+        if br is None or br.state == CLOSED:
+            return br, False
+        granted, _ = br.try_probe()
+        if not granted:
+            self.counters["breaker_skips"] += 1
+            raise PeerError(
+                f"peer {self._ep(endpoint)} breaker is {br.state} "
+                f"(cause: {br.open_cause}); skipping peer op"
+            )
+        return br, True
+
+    @staticmethod
+    def _outcome(br, probing, ok):
+        if br is None:
+            return
+        if probing:
+            br.record_probe(ok)
+        elif ok:
+            br.record_success()
+        else:
+            br.record_failure()
+
+    # -- the two peer operations --------------------------------------------
+
+    def fetch(self, endpoint, tokens, epoch=None):
+        """Pull a sibling's cached prefix pages for ``tokens``: one
+        ``kv.fetch`` roundtrip, the reply frame fully validated —
+        codec (magic/version/crc), epoch equality, and ctx-equality
+        against the requested tokens (a digest-hash collision or a
+        hostile frame degrades to a typed failure, never a poisoned
+        cache). Returns the decoded state dict (``len``/``ctx``/
+        ``kv``), or None on a clean typed miss (the sibling no longer
+        holds the pages). Raises :class:`StaleEpochError` /
+        :class:`PeerError` on every failure — callers degrade to
+        local recompute."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self.counters["fetches"] += 1
+        br, probing = self._gate(endpoint)
+        faults.fire(
+            "kv.peer", direction="fetch", endpoint=self._ep(endpoint),
+            tokens=int(tokens.size),
+        )
+        if self.budget is not None:
+            self.budget.note_attempt()
+        from distkeras_tpu.utils.serialization import serialize_params
+
+        header = {"verb": "kv.fetch"}
+        if epoch is not None:
+            header["epoch"] = int(epoch)
+        payload = serialize_params(tokens)
+        attempt = 0
+        while True:
+            try:
+                reply, body = self._roundtrip(
+                    endpoint, "fetch", header, payload
+                )
+            except (ConnectionError, TimeoutError, OSError) as e:
+                self._outcome(br, probing, False)
+                err = PeerError(
+                    f"peer fetch from {self._ep(endpoint)} died on "
+                    f"the wire: {e!r}"
+                )
+                if attempt >= self.max_fetch_retries or (
+                    self.budget is not None
+                    and not self.budget.acquire()
+                ):
+                    raise err from e
+                attempt += 1
+                self.counters["fetch_retries"] += 1
+                # re-gate: this very failure may have opened the
+                # breaker, and an open breaker outranks a granted
+                # retry token
+                br, probing = self._gate(endpoint)
+                continue
+            if not reply.get("ok"):
+                code = reply.get("error")
+                detail = reply.get("detail", "")
+                # a typed reply is the sibling WORKING (it answered):
+                # never a breaker failure
+                self._outcome(br, probing, True)
+                if code == "stale_epoch":
+                    raise StaleEpochError(
+                        f"peer {self._ep(endpoint)} refused stale "
+                        f"epoch {epoch}: {detail}"
+                    )
+                raise PeerError(
+                    f"peer fetch refused by {self._ep(endpoint)}: "
+                    f"{code}: {detail}"
+                )
+            self._outcome(br, probing, True)
+            if not reply.get("hit"):
+                return None  # clean miss: digest was stale/evicted
+            try:
+                state = decode_state(bytes(body))
+            except KvTransferError as e:
+                # a corrupt/truncated frame from a LIVE sibling:
+                # typed, no retry (the sibling would resend the same
+                # bytes), caller recomputes
+                raise PeerError(
+                    f"peer fetch frame from {self._ep(endpoint)} "
+                    f"failed validation: {e}"
+                ) from e
+            if epoch is not None and state.get("epoch") != int(epoch):
+                raise PeerError(
+                    f"peer fetch frame epoch {state.get('epoch')} != "
+                    f"requested {int(epoch)} (sibling restarted "
+                    f"mid-exchange)"
+                )
+            p = int(state["len"])
+            if p > tokens.size or not np.array_equal(
+                np.asarray(state["ctx"], np.int32)[:p], tokens[:p]
+            ):
+                raise PeerError(
+                    f"peer fetch frame ctx does not match the "
+                    f"requested prefix (served {p} positions) — "
+                    f"digest hash collision or hostile frame"
+                )
+            self.counters["fetch_ok"] += 1
+            self.counters["bytes_in"] += len(body)
+            return state
+
+    def push(self, endpoint, header, payload):
+        """Direct disagg push: ship ``payload`` (a DKTX frame) to the
+        paired decode worker under ``header`` (a ``kv.transfer`` wire
+        header) and return its ``(reply, body)`` — the decode's FINAL
+        reply, relayed by the caller. No fabric-level retry: a failed
+        push raises typed :class:`PeerError` and the caller returns
+        the frame to the router, whose relay loop owns sibling
+        retries (counted there, bounded there). Typed decode replies
+        are returned, not raised — the caller decides whether the
+        decode's verdict or the relay fallback is the request's
+        fate."""
+        self.counters["pushes"] += 1
+        br, probing = self._gate(endpoint)
+        faults.fire(
+            "kv.peer", direction="push", endpoint=self._ep(endpoint),
+            nbytes=len(payload),
+        )
+        if self.budget is not None:
+            self.budget.note_attempt()
+        try:
+            reply, body = self._roundtrip(
+                endpoint, "push", header, payload
+            )
+        except (ConnectionError, TimeoutError, OSError) as e:
+            self._outcome(br, probing, False)
+            self.counters["push_degraded"] += 1
+            raise PeerError(
+                f"peer push to {self._ep(endpoint)} died on the "
+                f"wire: {e!r}"
+            ) from e
+        # the hop itself worked (wire-wise) whatever the decode said
+        self._outcome(br, probing, True)
+        if reply.get("ok"):
+            self.counters["push_ok"] += 1
+            self.counters["bytes_out"] += len(payload)
+        else:
+            self.counters["push_degraded"] += 1
+        return reply, body
+
+    # -- observability / lifecycle ------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The fabric ledger (rides ``health``/``stats`` and the
+        ``dkt_top`` fabric columns)."""
+        with self._lock:
+            breakers = {
+                f"{h}:{p}": br.snapshot()
+                for (h, p), br in self._breakers.items()
+            }
+            pooled = sum(len(v) for v in self._pool.values())
+        out = dict(self.counters)
+        out["breakers"] = breakers
+        out["budget"] = (
+            None if self.budget is None else self.budget.snapshot()
+        )
+        out["pooled_clients"] = pooled
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            clients = [c for pool in self._pool.values() for c in pool]
+            self._pool.clear()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass

@@ -32,11 +32,48 @@ is exactly the system-prompt / few-shot-header traffic shape.
 from __future__ import annotations
 
 import collections
+import hashlib
 import threading
+import time
 
 import numpy as np
 
 from distkeras_tpu import faults
+
+# the digest hash width: 4 bytes is plenty for membership HINTS (a
+# collision only costs one wasted peer fetch, which the requester's
+# ctx-equality check then degrades to a miss) and keeps a 64-entry
+# digest under ~700 JSON bytes on every health reply
+DIGEST_HASH_BYTES = 4
+# how many (most-recently-used) keys a digest advertises: routing only
+# needs the hot set, and the cap bounds health-reply growth no matter
+# how large the store's byte budget is
+DIGEST_CAP = 64
+
+
+def key_hash(tokens) -> int:
+    """The fleet-wide digest hash of one exact token prefix: truncated
+    blake2b over the store's canonical key bytes. Stable across
+    processes and builds (golden-pinned in tests) — both sides of a
+    peer fetch must compute the identical value or page-aware routing
+    silently never matches."""
+    key = np.ascontiguousarray(np.asarray(tokens, np.int32)).tobytes()
+    return int.from_bytes(
+        hashlib.blake2b(key, digest_size=DIGEST_HASH_BYTES).digest(),
+        "big",
+    )
+
+
+def ladder_hashes(tokens, min_len: int = 8) -> list[tuple[int, int]]:
+    """``(prefix_len, key_hash)`` for every pow2 rung of ``tokens`` —
+    what the fleet router matches against replica digests to find the
+    sibling already holding a prompt's prefix pages. Longest rung
+    last (callers walk it reversed for longest-match)."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    return [
+        (p, key_hash(tokens[:p]))
+        for p in _pow2_ladder(int(tokens.size), min_len=min_len)
+    ]
 
 
 def _pow2_ladder(n: int, min_len: int = 8) -> list[int]:
@@ -90,6 +127,12 @@ class PrefixStore:
         # LRU churn. Bounded keys-only LRU.
         self._seen: collections.OrderedDict = collections.OrderedDict()
         self.seen_capacity = int(seen_capacity)
+        # content generation: bumped on every insert/evict/clear and
+        # NEVER reset (the digest memo keys on it, and a sibling's
+        # staleness check needs it monotonic for the store's lifetime)
+        self._gen = 0
+        self._gen_t = time.monotonic()  # when _gen last moved
+        self._digest_memo: tuple[int, dict] | None = None
         self._lock = threading.Lock()
         # the old counter dict as a CounterGroup over typed registry
         # counters (``serving_prefix_cache_<key>``): existing call
@@ -140,6 +183,70 @@ class PrefixStore:
             self.counters["misses"] += 1
             return None
 
+    def coverage(self, tokens) -> int:
+        """Longest stored exact prefix length of ``tokens`` — a PROBE,
+        not a lookup: no hit/miss counters, no LRU refresh, no fault
+        seam. What the peer-fetch path asks before dialing a sibling
+        ("is the fetch even worth it?") without polluting the local
+        traffic ledger."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        with self._lock:
+            for p in sorted(self._len_counts, reverse=True):
+                if p > tokens.size:
+                    continue
+                if self._key(tokens[:p]) in self._entries:
+                    return p
+        return 0
+
+    def peek(self, tokens):
+        """``lookup`` minus the side effects: longest stored prefix as
+        ``(p, kv)`` or None, with no counters, no LRU refresh, and no
+        ``prefix_cache.fetch`` seam. The ``kv.fetch`` serving half
+        reads through this so remote traffic neither inflates the
+        local hit rate nor keeps entries alive that local traffic
+        has abandoned."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        with self._lock:
+            for p in sorted(self._len_counts, reverse=True):
+                if p > tokens.size:
+                    continue
+                entry = self._entries.get(self._key(tokens[:p]))
+                if entry is not None:
+                    return p, entry[1]
+        return None
+
+    def digest(self, cap: int = DIGEST_CAP) -> dict:
+        """Compact content summary for fleet page-aware routing (rides
+        every ``health`` reply): ``gen`` (the monotonic content
+        generation), ``n`` (entries), and ``h`` — the sorted truncated
+        key hashes of the ``cap`` most-recently-used entries. Routers
+        match a prompt's pow2 ladder (:func:`ladder_hashes`) against
+        ``h``; a hash hit is a HINT (collisions cost one refused
+        fetch), membership of the hot set only. Memoized on ``gen`` so
+        idle health polls cost one int compare."""
+        with self._lock:
+            memo = self._digest_memo
+            if memo is not None and memo[0] == self._gen and (
+                len(memo[1]["h"]) == min(cap, len(self._entries))
+            ):
+                return memo[1]
+            keys = list(self._entries.keys())[-int(cap):]
+            out = {
+                "gen": self._gen,
+                "n": len(self._entries),
+                "h": sorted(
+                    int.from_bytes(
+                        hashlib.blake2b(
+                            k, digest_size=DIGEST_HASH_BYTES
+                        ).digest(),
+                        "big",
+                    )
+                    for k in keys
+                ),
+            }
+            self._digest_memo = (self._gen, out)
+            return out
+
     # -- write face ---------------------------------------------------------
 
     def insert(self, tokens, kv) -> bool:
@@ -161,6 +268,8 @@ class PrefixStore:
             self._entries[key] = (p, kv, nbytes)
             self._len_counts[p] += 1
             self._bytes += nbytes
+            self._gen += 1
+            self._gen_t = time.monotonic()
             self.counters["inserts"] += 1
             while self._bytes > self.max_bytes:
                 _, (ep, _, eb) = self._entries.popitem(last=False)
@@ -168,6 +277,7 @@ class PrefixStore:
                 if not self._len_counts[ep]:
                     del self._len_counts[ep]
                 self._bytes -= eb
+                self._gen += 1
                 self.counters["evictions"] += 1
         return True
 
@@ -218,6 +328,15 @@ class PrefixStore:
             self._len_counts.clear()
             self._seen.clear()
             self._bytes = 0
+            self._gen += 1
+            self._gen_t = time.monotonic()
+
+    def digest_age(self) -> float:
+        """Seconds since the store's content generation last moved —
+        how stale the advertised digest can possibly be. Rides the
+        ``serving_kv_fabric_digest_age_seconds`` gauge and the dkt_top
+        fabric column."""
+        return time.monotonic() - self._gen_t
 
     def reset_counters(self):
         with self._lock:

@@ -171,6 +171,29 @@ class WrongRoleError(ServingError):
     code = "wrong_role"
 
 
+class PeerError(ServingError):
+    """A worker-to-worker KV fabric operation failed — a peer prefix
+    fetch, a direct prefill→decode push, or the serving half of a
+    sibling's ``kv.fetch``. Typed so every peer path stays fail-soft:
+    the requester degrades to local recompute (token-identical to the
+    never-fetched run), the router falls back to its relay hop — a
+    peer failure is never a client-visible error by itself."""
+
+    code = "kv_peer"
+
+
+class StaleEpochError(PeerError):
+    """A peer frame or fetch named a KV epoch this engine no longer
+    serves — the sibling routed on a digest advertised before this
+    engine restarted or rolled over. Refused typed (never served: a
+    restarted engine may hold different weights, and KV pages computed
+    under them would silently break the recompute-identity pin); the
+    requester falls back to local recompute and picks up the new epoch
+    on its next digest poll."""
+
+    code = "stale_epoch"
+
+
 class DeadlineExceededError(ServingError):
     """The request's deadline expired before it finished decoding."""
 

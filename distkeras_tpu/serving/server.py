@@ -305,7 +305,8 @@ class ServingServer:
     def _dispatch(self, header: dict, payload: bytes) -> bytes:
         verb = header.get("verb")
         faults.fire("server.dispatch", verb=verb)
-        if verb in ("generate", "predict", "prefill", "kv.transfer"):
+        if verb in ("generate", "predict", "prefill", "kv.transfer",
+                    "kv.fetch"):
             # the gray-failure seam: a delay armed here (filtered by
             # port) slows this replica's DATA path while its health
             # polls stay green — the failure shape circuit breakers
@@ -317,6 +318,8 @@ class ServingServer:
             return self._prefill(header, payload)
         if verb == "kv.transfer":
             return self._transfer(header, payload)
+        if verb == "kv.fetch":
+            return self._kv_fetch(header, payload)
         if verb == "predict":
             return self._predict(payload)
         if verb == "metrics":
@@ -450,6 +453,9 @@ class ServingServer:
                 # = default tenant, priority 0 — the pre-QoS wire)
                 tenant=header.get("tenant"),
                 priority=int(header.get("priority") or 0),
+                # the router's page-affinity hint: siblings whose
+                # digest covered this prompt (fail-soft peer fetch)
+                kv_peers=header.get("kv_peers"),
             )
             seq = self.engine.wait(req)
         except ServingError as e:
@@ -496,7 +502,18 @@ class ServingServer:
         the finished slot's state as a ``kv_transfer`` frame (the
         reply payload). Typed failures ride the normal error path —
         ``wrong_role`` on a decode engine, ``overloaded`` under
-        pressure, ``kv_transfer`` if encoding failed."""
+        pressure, ``kv_transfer`` if encoding failed.
+
+        With a ``push_to`` header ([host, port] — the router's chosen
+        decode worker), the frame is PUSHED point-to-point over this
+        engine's peer fabric instead of relayed through the router:
+        the decode's final reply comes back here and is relayed to
+        the router with ``pushed: true``. Fail-soft: any push failure
+        — wire death, breaker open, a typed decode refusal — returns
+        the frame to the router (``pushed: false`` + the blob as
+        payload), whose relay loop finishes the hop the pre-fabric
+        way; the prefill work is never wasted."""
+        t0 = time.monotonic()
         prompt = np.asarray(deserialize_params(payload))
         blob, meta = self.engine.prefill(
             prompt, int(header["max_new_tokens"]),
@@ -506,7 +523,71 @@ class ServingServer:
             tenant=header.get("tenant"),
             priority=int(header.get("priority") or 0),
         )
+        push_to = header.get("push_to")
+        if push_to:
+            return self._push(header, blob, meta, push_to, t0)
         return pack_frame({"ok": True, "transfer": meta}, blob)
+
+    def _push(self, header: dict, blob: bytes, meta: dict, push_to,
+              t0: float) -> bytes:
+        """The direct-push leg of the disagg hop (see ``_prefill``)."""
+
+        def degrade(code, detail):
+            return pack_frame(
+                {"ok": True, "pushed": False, "transfer": meta,
+                 "push_error": code, "push_detail": str(detail)[:200]},
+                blob,
+            )
+
+        theader = {
+            "verb": "kv.transfer",
+            "max_new_tokens": int(header["max_new_tokens"]),
+        }
+        for k in ("eos_id", "tenant", "priority", "request_id"):
+            if header.get(k) is not None:
+                theader[k] = header[k]
+        if header.get("deadline_ms") is not None:
+            # the request's budget was set at router arrival; the
+            # decode hop gets what prefill left of it — a budget
+            # already spent degrades (the router owns the deadline
+            # verdict, and the frame must not decode past it)
+            left = float(header["deadline_ms"]) - (
+                (time.monotonic() - t0) * 1e3
+            )
+            if left <= 0:
+                return degrade("deadline_exceeded",
+                               "deadline spent during prefill")
+            theader["deadline_ms"] = left
+        try:
+            reply, body = self.engine.peer_fabric.push(
+                tuple(push_to), theader, blob
+            )
+        except Exception as e:  # noqa: BLE001 — fail-soft boundary
+            return degrade(getattr(e, "code", "kv_peer"), e)
+        if not reply.get("ok"):
+            # a typed decode refusal (overloaded, kv_transfer, ...):
+            # hand the frame back — the router's relay loop owns
+            # sibling retries and must keep its PR 14 semantics
+            return degrade(reply.get("error", "kv_peer"),
+                           reply.get("detail", ""))
+        out = dict(reply)
+        out["pushed"] = True
+        out["transfer"] = meta
+        return pack_frame(out, body or b"")
+
+    def _kv_fetch(self, header: dict, payload: bytes) -> bytes:
+        """Fleet KV fabric: serve the longest locally-cached prefix
+        of the requested tokens as a DKTX frame (see
+        ``ServingEngine.serve_prefix``). Typed failures — stale
+        epoch, no cache — ride the normal error path; a plain miss
+        is an ``ok`` reply with ``hit: false``."""
+        tokens = np.asarray(deserialize_params(payload))
+        blob, reply = self.engine.serve_prefix(
+            tokens, epoch=header.get("epoch")
+        )
+        if blob is None:
+            return pack_frame(reply)
+        return pack_frame(reply, blob)
 
     def _transfer(self, header: dict, payload: bytes) -> bytes:
         """Disaggregated decode (non-streaming): resume a transferred
@@ -595,6 +676,7 @@ class ServingServer:
                     tenant=header.get("tenant"),
                     priority=int(header.get("priority") or 0),
                     stream=True,
+                    kv_peers=header.get("kv_peers"),
                 )
             else:
                 req = self.engine.resume(

@@ -978,6 +978,125 @@ def test_soak_fleet_smoke():
     assert summary["ok"]
 
 
+def test_committed_bench_fleet_fabric_block():
+    """The COMMITTED fabric block carries the fleet-KV-fabric claims
+    honestly: the fetch side actually restored prefix pages over the
+    wire (fetch_ok >= 1, zero degrades), the churned side degraded
+    EVERY dial to recompute with zero successes (the fail-soft
+    contract, measured), the wire ledger pairs byte-for-byte, and
+    outputs stayed token-identical to solo decode on all three sides.
+    Self-comparison exercises every invariant plus the committed
+    floors — regenerating the artifact with a broken fabric must fail
+    here, not slip through."""
+    rec = json.loads(
+        open(os.path.join(REPO, "BENCH_FLEET.json")).read()
+    )
+    assert check_bench.compare_fabric(rec, rec) == []
+    assert set(check_bench.COMMITTED_FLOORS["fabric"]) == {
+        "fabric.fetch.peer.fetch_ok",
+        "fabric.churn_vs_recompute",
+    }
+    fb = rec["fabric"]
+    assert fb["outputs_identical"] is True
+    assert fb["fetch"]["peer"]["fetch_ok"] >= 1
+    assert fb["fetch"]["peer"]["fetch_degraded"] == 0
+    assert fb["churn"]["peer"]["fetch_ok"] == 0
+    assert fb["churn"]["peer"]["fetch_degraded"] >= 1
+    assert (
+        fb["fetch"]["peer"]["bytes_in"]
+        == fb["fetch"]["serve"]["bytes_out"]
+        > 0
+    )
+    assert fb["wire_bytes_per_restored_token"] > 0
+    # gate plumbing: a fabric that silently stopped fetching, or one
+    # whose degrade path broke identity, is a violation — not a pass
+    import copy
+
+    bad = copy.deepcopy(rec)
+    bad["fabric"]["fetch"]["peer"]["fetch_ok"] = 0
+    bad["fabric"]["fetch"]["peer"]["fetches"] = 0
+    assert any(
+        "no peer fetch ever succeeded" in v
+        for v in check_bench.compare_fabric(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    bad["fabric"]["outputs_identical"] = False
+    assert any(
+        "outputs not identical" in v
+        for v in check_bench.compare_fabric(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    bad["fabric"]["fetch"]["peer"]["bytes_in"] += 1
+    assert any(
+        "wire bytes unpaired" in v
+        for v in check_bench.compare_fabric(bad, rec)
+    )
+    bad = copy.deepcopy(rec)
+    del bad["fabric"]
+    assert any(
+        "missing fabric block" in v
+        for v in check_bench.compare_fabric(bad, rec)
+    )
+
+
+@pytest.mark.slow
+def test_bench_fleet_fabric_smoke_end_to_end(tmp_path, monkeypatch):
+    """``bench_fleet.py --smoke --fabric-only`` (the ``--kind fabric``
+    gate's fresh side) runs the three-sided A/B — recompute vs warm
+    peer fetch vs churned-store degrade, identity-pinned — end to end
+    on CPU and the fresh artifact passes the fabric gate against the
+    committed one: pages actually crossed the wire, every churned dial
+    degraded to recompute, and the ratios land inside the band."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(
+        sys, "argv", ["bench_fleet.py", "--smoke", "--fabric-only"]
+    )
+    bench_fleet.main()
+    rec = json.loads((tmp_path / "BENCH_FLEET.json").read_text())
+    committed = json.loads(
+        open(os.path.join(REPO, "BENCH_FLEET.json")).read()
+    )
+    violations = check_bench.compare_fabric(rec, committed)
+    assert violations == [], violations
+
+
+@pytest.mark.chaos
+def test_soak_fabric_smoke():
+    """``tools/soak_fleet.py --fabric --smoke`` runs end to end at
+    tier-1 scale and meets its own acceptance bar: the prefix-digest
+    holder kill -9'd with ``kv.fetch`` transfers in flight, then a
+    reserved decode worker kill -9'd with direct pushes in flight —
+    zero hung clients, zero untyped errors, zero divergent outputs in
+    EITHER fabric direction, a healthy validated transfer proven
+    before each kill, a corpse-naming hint degrading to token-
+    identical recompute after it, and the router's pairing ledger
+    balanced exactly (``peer_sends == peer_ok + peer_typed +
+    peer_degraded``). Same treatment as the other soak smokes: the
+    chaos harness itself is pinned on CPU so a drift surfaces as a
+    red test, not a dead soak run."""
+    import soak_fleet  # REPO/tools is on sys.path (module top)
+
+    summary = soak_fleet.run_fabric_soak(seed=0, smoke=True)
+    for phase in ("fetch", "push"):
+        ph = summary[phase]
+        assert ph["hung"] == 0, phase
+        assert ph["untyped"] == 0, (phase, ph["untyped_samples"])
+        assert ph["divergent"] == 0, phase
+        assert ph["completed"] > 0, phase
+        assert ph["control_errors"] == [], phase
+    # healthy fetch before the kill, degrade-to-recompute after it —
+    # with the probe's output token-identical to solo decode
+    assert summary["fetch"]["peer"]["fetch_ok"] >= 1
+    assert summary["fetch"]["peer"]["fetch_degraded"] >= 1
+    assert summary["fetch"]["probe_identical"] is True
+    # healthy direct push before the kill, relay fallback after it,
+    # and every pairing resolved exactly once
+    assert summary["push"]["router"]["peer_ok"] >= 1
+    assert summary["push"]["router"]["peer_degraded"] >= 1
+    assert summary["push"]["pairing_balanced"]
+    assert summary["ok"]
+
+
 @pytest.mark.chaos
 def test_soak_training_smoke():
     """``tools/soak_training.py --smoke`` runs end to end at tier-1 scale

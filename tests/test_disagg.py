@@ -467,12 +467,27 @@ def test_router_disagg_e2e_identity_and_counters(model, ref_gen):
             assert st.served_by == (s2.host, s2.port)  # decode served
             stats = c.stats()
             assert stats["disagg_routed"] == 2
-            assert stats["transfer_sends"] == 2
-            assert stats["transfer_ok"] == 2
+            # the request/reply generate rode the DIRECT PUSH: the
+            # prefill worker pushed the frame point-to-point and the
+            # decode reply rode back through it — the router's relay
+            # ledger never saw that frame
+            assert stats["peer_sends"] == 1
+            assert stats["peer_ok"] == 1
+            assert stats["peer_typed"] == 0
+            assert stats["peer_degraded"] == 0
+            # the streaming generate still relays (the client's chunk
+            # stream terminates at the router, so the decode hop must)
+            assert stats["transfer_sends"] == 1
+            assert stats["transfer_ok"] == 1
             assert stats["transfer_typed"] == 0
-            # pairing: every dispatched hop ended in a relayed reply
+            # pairing: every dispatched hop ended in a relayed reply,
+            # on BOTH ledgers
             assert stats["transfer_sends"] == (
                 stats["transfer_ok"] + stats["transfer_typed"]
+            )
+            assert stats["peer_sends"] == (
+                stats["peer_ok"] + stats["peer_typed"]
+                + stats["peer_degraded"]
             )
             # replica books carry the roles
             roles = {

@@ -1,0 +1,401 @@
+"""Fleet KV fabric: page-aware digests, direct worker-to-worker
+prefix fetch, and the fail-soft contract every peer failure meets.
+
+The correctness bar: a peer fetch is STRICTLY ADDITIVE to the local
+prefix cache — success and every failure class alike (dead peer, stale
+epoch, clean miss, injected seam death on either side, serve-side
+stall past the fetch deadline, open breaker) decode TOKEN-IDENTICAL to
+the never-fetched run. The observability bar: every failure is typed,
+counted (``fetch_degraded``), and named on the recorder tape; the
+digest both sides route on is golden-pinned so two builds can meet on
+the wire.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import zoo
+from distkeras_tpu.predictors import CachedSequenceGenerator
+from distkeras_tpu.serving import (
+    PeerError,
+    PeerFabric,
+    ServingClient,
+    ServingEngine,
+    ServingServer,
+    StaleEpochError,
+)
+from distkeras_tpu.serving.prefix_cache import (
+    PrefixStore,
+    key_hash,
+    ladder_hashes,
+)
+from distkeras_tpu.utils.serialization import serialize_params
+
+
+VOCAB, SEQ = 61, 32
+
+
+@pytest.fixture(scope="module")
+def model():
+    return zoo.transformer_lm(
+        vocab_size=VOCAB, seq_len=SEQ, d_model=32, num_heads=2,
+        depth=2, seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def ref_gen(model):
+    return CachedSequenceGenerator(model)
+
+
+def _prompt(n=18, seed=3):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, VOCAB, n).astype(np.int32)
+
+
+def _kv(p=16, stages=2, nh=2, hd=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.standard_normal((p, nh, hd)).astype(np.float32),
+            rng.standard_normal((p, nh, hd)).astype(np.float32),
+        )
+        for _ in range(stages)
+    ]
+
+
+def _dead_endpoint():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return ("127.0.0.1", port)
+
+
+# ------------------------------------------------------------ digest
+
+
+def test_digest_golden_pin():
+    """The digest hash is the fleet's rendezvous value: requester-side
+    ``ladder_hashes`` and replica-side ``digest()`` must compute the
+    IDENTICAL integers across processes and builds, or page-aware
+    routing silently never matches. Golden-pinned, like the DKTX
+    header: a hash-fn or key-canonicalisation drift is a red test, not
+    a fleet that quietly stopped fetching."""
+    t16 = np.arange(16, dtype=np.int32)
+    assert key_hash(np.arange(8, dtype=np.int32)) == 2959538062
+    assert key_hash(t16) == 2239523331
+
+    store = PrefixStore(max_bytes=1 << 20)
+    assert store.insert_prefixes(t16, _kv(16)) == 2  # rungs 8 and 16
+    assert store.digest() == {
+        "gen": 2, "n": 2, "h": [2239523331, 2959538062],
+    }
+    # the requester's ladder IS the advertised membership set
+    assert sorted(h for _, h in ladder_hashes(t16)) == (
+        store.digest()["h"]
+    )
+    # gen-memoized: an idle poll returns the same object
+    assert store.digest() is store.digest()
+    # a capped digest keeps the MRU tail (rung 16 inserted last) but
+    # still reports the true entry count
+    capped = store.digest(cap=1)
+    assert capped["n"] == 2 and capped["h"] == [2239523331]
+
+
+# ----------------------------------------------------- fetch (happy)
+
+
+def test_peer_fetch_over_wire_identity_and_ledger(model, ref_gen):
+    """A sibling's hint pays: the requester pulls the peer's prefix
+    pages over the wire, inserts them locally, and decodes
+    token-identical to solo — with both sides' ledgers agreeing on
+    what moved (bytes in == bytes out, one served == one ok)."""
+    p = _prompt(19, seed=23)
+    solo = ref_gen.generate(p[None], steps=6)[0]
+    a = ServingEngine(model, num_slots=2)
+    sa = ServingServer(a).start()
+    b = ServingEngine(model, num_slots=2).start()
+    try:
+        # warm A through its own traffic: two-touch admission inserts
+        # the pow2 ladder on the second completion
+        for _ in range(2):
+            assert np.array_equal(a.wait(a.submit(p, 6)), solo)
+        assert a.prefix_store.coverage(p) == 16
+        # A's health advertises the digest the router routes on
+        with ServingClient(sa.host, sa.port) as c:
+            kf = c.health()["kv_fabric"]
+        assert kf["epoch"] == int(a.kv_epoch)
+        assert set(kf["digest"]["h"]) >= {
+            h for _, h in ladder_hashes(p[:16])
+        }
+
+        hint = [{"endpoint": (sa.host, sa.port),
+                 "epoch": int(a.kv_epoch), "len": 16}]
+        assert b.prefix_store.coverage(p) == 0
+        out = b.wait(b.submit(p, 6, kv_peers=hint))
+        assert np.array_equal(out, solo)
+        # the fetched pages landed locally (no two-touch gate: they
+        # were already proven hot on the sibling) ...
+        assert b.prefix_store.coverage(p) == 16
+        # ... BIT-EXACT: the wire moved the peer's rows, not a lossy
+        # reconstruction
+        pf, kvf = b.prefix_store.peek(p)
+        pa, kva = a.prefix_store.peek(p)
+        assert pf == pa == 16
+        for (kf_, vf), (ka, va) in zip(kvf, kva):
+            assert kf_.dtype == ka.dtype
+            assert np.array_equal(kf_, ka) and np.array_equal(vf, va)
+        fb, fa = b.peer_fabric.counters, a.peer_fabric.counters
+        assert fb["fetches"] == 1 and fb["fetch_ok"] == 1
+        assert fb["fetch_degraded"] == 0
+        assert fa["fetch_served"] == 1 and fa["stale_refusals"] == 0
+        assert fb["bytes_in"] == fa["bytes_out"] > 0
+    finally:
+        sa.shutdown()
+        b.stop()
+
+
+def test_peer_fetch_crosses_mesh_geometries(model, ref_gen, tp_mesh):
+    """Pages warmed on a tp:2 engine serve a SOLO sibling: the host
+    prefix store (and the DKTX frame it serves) is geometry-neutral,
+    so a fleet mixing shardings still shares one page fabric —
+    token-identical to the solo reference."""
+    p = _prompt(20, seed=37)
+    solo = ref_gen.generate(p[None], steps=6)[0]
+    a = ServingEngine(model, num_slots=2, mesh=tp_mesh(2))
+    sa = ServingServer(a).start()
+    b = ServingEngine(model, num_slots=2).start()
+    try:
+        for _ in range(2):
+            assert np.array_equal(a.wait(a.submit(p, 6)), solo)
+        hint = [{"endpoint": (sa.host, sa.port),
+                 "epoch": int(a.kv_epoch), "len": 16}]
+        out = b.wait(b.submit(p, 6, kv_peers=hint))
+        assert np.array_equal(out, solo)
+        assert b.peer_fabric.counters["fetch_ok"] == 1
+        # the solo engine now holds the tp-warmed rows bit-exactly
+        pf, kvf = b.prefix_store.peek(p)
+        pa, kva = a.prefix_store.peek(p)
+        assert pf == pa == 16
+        for (kf, vf), (ka, va) in zip(kvf, kva):
+            assert np.array_equal(kf, ka) and np.array_equal(vf, va)
+    finally:
+        sa.shutdown()
+        b.stop()
+
+
+# -------------------------------------------------------- stale epoch
+
+
+def test_stale_epoch_refusal_typed_everywhere(model, ref_gen):
+    """The epoch gate on all three faces: the wire refuses typed
+    (code ``stale_epoch``), the engine raises
+    :class:`StaleEpochError` (a :class:`PeerError`), and a requester
+    holding a stale hint degrades SILENTLY — identical tokens, nothing
+    inserted, one ``fetch_degraded`` on its ledger and one
+    ``stale_refusals`` on the sibling's."""
+    p = _prompt(18, seed=29)
+    solo = ref_gen.generate(p[None], steps=6)[0]
+    a = ServingEngine(model, num_slots=2)
+    sa = ServingServer(a).start()
+    b = ServingEngine(model, num_slots=2).start()
+    try:
+        for _ in range(2):
+            a.wait(a.submit(p, 6))
+        stale = int(a.kv_epoch) ^ 1
+        with ServingClient(sa.host, sa.port) as c:
+            reply, _ = c._roundtrip(
+                {"verb": "kv.fetch", "epoch": stale},
+                serialize_params(p[:16]),
+                raise_on_error=False,
+            )
+        assert reply["ok"] is False
+        assert reply["error"] == "stale_epoch"
+        assert a.peer_fabric.counters["stale_refusals"] == 1
+
+        with pytest.raises(StaleEpochError) as ei:
+            a.serve_prefix(p[:16], epoch=stale)
+        assert ei.value.code == "stale_epoch"
+        assert isinstance(ei.value, PeerError)
+
+        hint = [{"endpoint": (sa.host, sa.port),
+                 "epoch": stale, "len": 16}]
+        assert np.array_equal(b.wait(b.submit(p, 6, kv_peers=hint)),
+                              solo)
+        assert b.peer_fabric.counters["fetch_degraded"] == 1
+        assert b.prefix_store.coverage(p) == 0
+        tape = [
+            e for e in b.recorder.snapshot()
+            if e["kind"] == "kv.peer.degraded"
+        ]
+        assert tape and tape[-1]["error"] == "StaleEpochError"
+    finally:
+        sa.shutdown()
+        b.stop()
+
+
+# --------------------------------------------------------- fault seam
+
+
+@pytest.mark.chaos
+def test_kv_peer_seam_both_directions_degrades_identically(
+    model, ref_gen,
+):
+    """The ``kv.peer`` seam, both directions: an injected death on the
+    requester's dial AND on the sibling's serve each degrade that one
+    request to local recompute — identical tokens, empty local cache,
+    one ``fetch_degraded`` each, never a hang or an untyped error."""
+    from distkeras_tpu.faults import FaultPlan
+
+    a = ServingEngine(model, num_slots=2)
+    sa = ServingServer(a).start()
+    b = ServingEngine(model, num_slots=2).start()
+    try:
+        hint_of = lambda: [{"endpoint": (sa.host, sa.port),  # noqa: E731
+                            "epoch": int(a.kv_epoch), "len": 16}]
+        for i, direction in enumerate(("fetch", "serve")):
+            p = _prompt(17, seed=41 + i)  # fresh header per direction
+            solo = ref_gen.generate(p[None], steps=6)[0]
+            before = b.peer_fabric.counters["fetch_degraded"]
+            plan = FaultPlan(seed=0).arm(
+                "kv.peer", times=1,
+                when=lambda ctx, d=direction: (
+                    ctx.get("direction") == d
+                ),
+            )
+            with plan:
+                out = b.wait(b.submit(p, 6, kv_peers=hint_of()))
+            assert plan.fired("kv.peer") == 1
+            assert np.array_equal(out, solo)
+            assert b.peer_fabric.counters["fetch_degraded"] == (
+                before + 1
+            )
+            assert b.prefix_store.coverage(p) == 0
+    finally:
+        sa.shutdown()
+        b.stop()
+
+
+# ------------------------------------------------------------ breaker
+
+
+def test_breaker_open_skips_fetch_without_budget_burn():
+    """An open breaker SKIPS the peer op outright: no dial, no
+    retry-budget withdrawal, no retry counter — a sibling known sick
+    must never tax the budget healthy retries draw from. Pure fabric
+    unit: a dead endpoint and a hair-trigger breaker."""
+    from distkeras_tpu.serving.resilience import OPEN
+
+    fab = PeerFabric(
+        retry_budget={"ratio": 0.0, "burst": 1.0},
+        breaker={"window": 60.0, "min_requests": 1,
+                 "failure_threshold": 0.01, "open_secs": 60.0},
+        fetch_timeout=1.0, connect_timeout=0.2, max_fetch_retries=1,
+    )
+    ep = _dead_endpoint()
+    try:
+        # first fetch: the wire death opens the breaker; the granted
+        # retry re-gates and is refused by the now-open breaker
+        with pytest.raises(PeerError):
+            fab.fetch(ep, np.arange(8, dtype=np.int32), epoch=1)
+        assert fab.breaker(ep).state == OPEN
+        assert fab.counters["fetches"] == 1
+        budget0 = fab.budget.snapshot()
+        skips0 = fab.counters["breaker_skips"]
+        retries0 = fab.counters["fetch_retries"]
+        # second fetch: skipped at the gate — typed, instant, free
+        with pytest.raises(PeerError) as ei:
+            fab.fetch(ep, np.arange(8, dtype=np.int32), epoch=1)
+        assert "breaker" in str(ei.value)
+        assert fab.counters["breaker_skips"] == skips0 + 1
+        assert fab.counters["fetch_retries"] == retries0
+        assert fab.budget.snapshot() == budget0  # not one token
+    finally:
+        fab.close()
+
+
+# --------------------------------------------- degrade-to-recompute
+
+
+@pytest.mark.chaos
+def test_degrade_to_recompute_per_failure_class(model, ref_gen):
+    """The degrade matrix, one failure class at a time — dead peer,
+    clean miss, serve-side stall past the fetch deadline, open breaker
+    — each with a FRESH prompt family so the classes cannot mask each
+    other through the cache. Every class: token-identical output,
+    local cache untouched, exactly one ``fetch_degraded``, and the
+    recorder tape naming the class."""
+    from distkeras_tpu.faults import FaultPlan
+
+    a = ServingEngine(model, num_slots=2)
+    sa = ServingServer(a).start()
+    b = ServingEngine(model, num_slots=2).start()
+    b.peer_fabric.fetch_timeout = 0.5  # the deadline class cuts here
+    dead = _dead_endpoint()
+    try:
+        def degrade(seed, hint, plan=None, tape_error=None):
+            p = _prompt(18, seed=seed)
+            solo = ref_gen.generate(p[None], steps=6)[0]
+            before = b.peer_fabric.counters["fetch_degraded"]
+            if plan is not None:
+                with plan:
+                    out = b.wait(b.submit(p, 6, kv_peers=[hint]))
+            else:
+                out = b.wait(b.submit(p, 6, kv_peers=[hint]))
+            assert np.array_equal(out, solo), hint
+            assert b.peer_fabric.counters["fetch_degraded"] == (
+                before + 1
+            ), hint
+            assert b.prefix_store.coverage(p) == 0
+            if tape_error is not None:
+                tape = [
+                    e for e in b.recorder.snapshot()
+                    if e["kind"] == "kv.peer.degraded"
+                ]
+                assert tape and tape[-1]["error"] == tape_error
+
+        # 1. dead peer: the dial dies on the wire
+        degrade(51, {"endpoint": dead, "epoch": 1, "len": 16},
+                tape_error="PeerError")
+        # 2. clean miss: a live sibling that no longer holds the pages
+        #    answers typed hit:false
+        degrade(52, {"endpoint": (sa.host, sa.port),
+                     "epoch": int(a.kv_epoch), "len": 16},
+                tape_error="miss")
+        assert a.peer_fabric.counters["fetch_miss"] >= 1
+        # 3. deadline: the sibling stalls past the fetch timeout (the
+        #    serve-side seam delays longer than fetch_timeout; retry
+        #    hits the same stall)
+        degrade(53, {"endpoint": (sa.host, sa.port),
+                     "epoch": int(a.kv_epoch), "len": 16},
+                plan=FaultPlan(seed=0).arm(
+                    "kv.peer", action="delay", delay=1.5, times=2,
+                    when=lambda ctx: ctx.get("direction") == "serve",
+                ),
+                tape_error="PeerError")
+        # 4. open breaker (LAST: it poisons the sibling's endpoint):
+        #    skipped at the gate, the sibling is never dialed
+        br = b.peer_fabric.breaker((sa.host, sa.port))
+        for _ in range(5):
+            br.record_failure()
+        served0 = (
+            a.peer_fabric.counters["fetch_served"]
+            + a.peer_fabric.counters["fetch_miss"]
+            + a.peer_fabric.counters["stale_refusals"]
+        )
+        skips0 = b.peer_fabric.counters["breaker_skips"]
+        degrade(54, {"endpoint": (sa.host, sa.port),
+                     "epoch": int(a.kv_epoch), "len": 16},
+                tape_error="PeerError")
+        assert b.peer_fabric.counters["breaker_skips"] == skips0 + 1
+        assert (
+            a.peer_fabric.counters["fetch_served"]
+            + a.peer_fabric.counters["fetch_miss"]
+            + a.peer_fabric.counters["stale_refusals"]
+        ) == served0  # never dialed
+    finally:
+        sa.shutdown()
+        b.stop()

@@ -44,6 +44,16 @@ def _fmt_value(v) -> str:
     return f"{v:,}"
 
 
+def _fmt_bytes(v) -> str:
+    v = int(v or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return (f"{v}{unit}" if unit == "B"
+                    else f"{v:.1f}{unit}")
+        v /= 1024
+    return f"{v}B"
+
+
 _BLOCKS = "▁▂▃▄▅▆▇█"
 
 
@@ -220,6 +230,30 @@ def format_table(samples, width: int = 78, series: dict | None = None
                 if opens or closes:
                     guard += f" ↑{opens}↓{closes}"
                 break
+        # the fleet-KV-fabric column: per-replica peer traffic (bytes
+        # pulled in / served out over kv.fetch + direct push), the
+        # fetch hit/degrade ledger, and how stale the advertised
+        # prefix digest can be (seconds since the store last moved).
+        # Absent on targets without the peer counters (old builds).
+        fabric = ""
+        for s, _ in groups[replica]:
+            if s["name"] == "serving_kv_peer_bytes_in" and (
+                s.get("value") is not None
+            ):
+                vals = {}
+                for s2, _ in groups[replica]:
+                    vals[s2["name"]] = s2.get("value")
+                fabric = (
+                    "  fabric="
+                    f"in:{_fmt_bytes(vals.get('serving_kv_peer_bytes_in'))}"
+                    f"/out:{_fmt_bytes(vals.get('serving_kv_peer_bytes_out'))}"
+                    f" hit:{int(vals.get('serving_kv_peer_fetch_ok') or 0)}"
+                    f" degr:{int(vals.get('serving_kv_peer_fetch_degraded') or 0)}"
+                )
+                age = vals.get("serving_kv_fabric_digest_age_seconds")
+                if age is not None:
+                    fabric += f" age:{float(age):.1f}s"
+                break
         shed = ""
         for s, _ in groups[replica]:
             if s["name"] == "serving_shed_rung" and (
@@ -232,8 +266,8 @@ def format_table(samples, width: int = 78, series: dict | None = None
                 )
                 break
         lines.append(
-            f"== {replica}{role}{mesh}{fleet}{bubble}{guard}{shed} "
-            .ljust(width, "=")
+            f"== {replica}{role}{mesh}{fleet}{bubble}{guard}{shed}"
+            f"{fabric} ".ljust(width, "=")
         )
         rows = []
         for s, labels in sorted(

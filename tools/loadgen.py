@@ -118,10 +118,35 @@ def storm_tenants(seq: int = 256) -> list[dict]:
     ]
 
 
+def prefix_fleet_tenants(seq: int = 256, tenants_n: int = 6,
+                         header_frac: float = 0.5) -> list[dict]:
+    """The ``prefix_fleet`` preset: the fleet-KV-fabric stimulus —
+    ``tenants_n`` tenants, each with its OWN long shared header (a
+    system prompt, drawn once per trace and prepended to every one of
+    that tenant's requests) and a short random per-request tail.
+    Driven against a multi-replica fleet whose per-replica prefix
+    pools are budgeted BELOW the combined header working set (the
+    bench pairs it with ``prefix_cache_bytes`` sized to a fraction of
+    the header count), every replica can hold SOME tenants' pages but
+    none can hold all — so the fleet-wide hit rate is decided by
+    page-aware routing and peer fetch, not by any one store. Short
+    decodes keep the trace prefill-dominated: the shared header IS
+    the cost being saved."""
+    hl = max(8, int(seq * header_frac))
+    return [
+        {"name": f"t{i}", "weight": 1.0, "priority": 0,
+         "header_len": hl,
+         "prompt_len": (2, max(4, seq // 16)),
+         "steps": (3, max(5, seq // 32))}
+        for i in range(int(tenants_n))
+    ]
+
+
 PRESETS = {
     "interactive": interactive_tenants,
     "decode_heavy": decode_heavy_tenants,
     "storm": storm_tenants,
+    "prefix_fleet": prefix_fleet_tenants,
 }
 
 
@@ -236,6 +261,17 @@ def make_trace(*, process="poisson", rate=10.0, duration=None, n=None,
     # probability: traces from stream-less specs stay byte-identical
     # to what this generator produced before the field existed
     has_stream = any("stream" in t for t in tenants)
+    # per-tenant SHARED headers (``header_len``): drawn once per trace
+    # from a tenant-derived rng and prepended to every one of that
+    # tenant's prompts — the shared-prefix traffic the fleet KV fabric
+    # routes and peer-fetches. ``prompt_len`` then ranges the RANDOM
+    # TAIL. Header-less specs draw exactly the streams they always did.
+    headers = {}
+    for ti, spec in enumerate(tenants):
+        hl = int(spec.get("header_len", 0) or 0)
+        if hl:
+            hrng = np.random.default_rng((int(seed) << 8) + 2 + ti)
+            headers[ti] = hrng.integers(0, vocab, hl).astype(np.int32)
     trace = []
     for t in ts:
         ti = int(rng.choice(len(tenants), p=weights))
@@ -244,11 +280,14 @@ def make_trace(*, process="poisson", rate=10.0, duration=None, n=None,
         slo_, shi = spec.get("steps", (8, 32))
         plen = int(rng.integers(plo, max(plo + 1, phi)))
         steps = int(rng.integers(slo_, max(slo_ + 1, shi)))
+        prompt = rng.integers(0, vocab, plen).astype(np.int32)
+        if ti in headers:
+            prompt = np.concatenate([headers[ti], prompt])
         ev = {
             "t": float(t),
             "tenant": str(spec.get("name", f"tenant{ti}")),
             "priority": int(spec.get("priority", 0)),
-            "prompt": rng.integers(0, vocab, plen).astype(np.int32),
+            "prompt": prompt,
             "steps": steps,
         }
         if has_stream:

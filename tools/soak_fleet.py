@@ -64,6 +64,14 @@ no drain, no FIN handshakes beyond what the kernel sends for a dead
 process. The fault mix is seeded, so a failing soak replays::
 
     python tools/soak_fleet.py --replicas 3 --clients 4 --seed 0
+
+``--fabric`` runs the KV-FABRIC tier instead (``run_fabric_soak``):
+kill -9 the peer on the far end of a LIVE point-to-point KV transfer,
+in both fabric directions — the digest holder mid-``kv.fetch`` under a
+spilling shared-prefix load, and the reserved decode worker mid-push
+on the disagg direct path — asserting 0 hung / 0 untyped / 0 divergent
+outputs with the router's pairing ledger exactly balanced
+(``peer_sends == peer_ok + peer_typed + peer_degraded``).
 """
 
 from __future__ import annotations
@@ -95,23 +103,33 @@ def replica_main(args) -> int:
     from distkeras_tpu.faults import FaultPlan
     from distkeras_tpu.serving import ServingEngine, ServingServer
 
-    engine = ServingEngine.from_bundle(
-        args.bundle, num_slots=4, queue_capacity=8, prefix_cache=True,
+    kw = dict(
+        num_slots=args.slots, queue_capacity=args.queue_cap,
+        prefix_cache=not args.role,
         watchdog_interval=1.0, watchdog_grace=60.0,
         max_restarts=10_000, restart_backoff=0.01, quarantine_steps=8,
     )
+    if args.role:
+        # a disagg worker for the fabric tier's push phase; role
+        # engines keep the test_disagg idiom (no prefix store)
+        kw["role"] = args.role
+        if args.role == "prefill":
+            kw["prefill_chunk"] = 4
+    engine = ServingEngine.from_bundle(args.bundle, **kw)
     server = ServingServer(engine, retry_after_ms=20.0).start()
-    # the full warm recipe (decode step, every prefill/admit chunk
-    # bucket, every prefix-restore bucket), then arm storm detection:
-    # from here any serving-path mint of a NEW program is a storm, and
-    # the parent asserts zero across the fleet. Same recipe a
-    # controller scale-up applies before rotation — the soak's boots
-    # (initial, autoscale replacement, rollover replacements) all pay
-    # it BEFORE printing READY, so no routed request ever compiles.
-    engine._stepper.warmup()
-    engine._stepper.warm_prefill_buckets()
-    engine._stepper.warm_restore_buckets()
-    engine.compile_ledger.mark_warmed()
+    if not args.role:
+        # the full warm recipe (decode step, every prefill/admit chunk
+        # bucket, every prefix-restore bucket), then arm storm
+        # detection: from here any serving-path mint of a NEW program
+        # is a storm, and the parent asserts zero across the fleet.
+        # Same recipe a controller scale-up applies before rotation —
+        # the soak's boots (initial, autoscale replacement, rollover
+        # replacements) all pay it BEFORE printing READY, so no routed
+        # request ever compiles.
+        engine._stepper.warmup()
+        engine._stepper.warm_prefill_buckets()
+        engine._stepper.warm_restore_buckets()
+        engine.compile_ledger.mark_warmed()
     plan = FaultPlan(seed=args.seed).arm(
         "stepper.step", times=None, probability=1.0 / args.fault_every
     )
@@ -135,13 +153,18 @@ class SubprocessReplica:
     """``FleetController`` replica handle backed by a real process —
     the backend that makes kill -9 mean kill -9."""
 
-    def __init__(self, bundle, seed, fault_every, net_delay=0.0):
+    def __init__(self, bundle, seed, fault_every, net_delay=0.0,
+                 role=None, slots=4, queue_cap=8):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, _HERE, "--replica", "--bundle", bundle,
+               "--seed", str(seed), "--fault-every", str(fault_every),
+               "--net-delay", str(net_delay),
+               "--slots", str(slots), "--queue-cap", str(queue_cap)]
+        if role:
+            cmd += ["--role", role]
         self.proc = subprocess.Popen(
-            [sys.executable, _HERE, "--replica", "--bundle", bundle,
-             "--seed", str(seed), "--fault-every", str(fault_every),
-             "--net-delay", str(net_delay)],
+            cmd,
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True, env=env,
         )
@@ -698,6 +721,458 @@ def run_soak(replicas=3, clients=4, duration=8.0, seed=0,
     return summary
 
 
+# ---------------------------------------------------------- fabric tier
+
+
+def run_fabric_soak(seed=0, smoke=False, max_new=6) -> dict:
+    """The KV-fabric chaos tier: kill -9 the peer on the far end of a
+    LIVE point-to-point transfer, in BOTH fabric directions, and hold
+    the fail-soft bar. Two phases over real replica subprocesses:
+
+    - FETCH: a small-capacity unified fleet (1 slot + 1 queue entry
+      each) under shared-header load. The affinity home fills its
+      prefix store (two-touch), its digest reaches the router via
+      health, and saturation spills siblings that ``kv.fetch`` the
+      pages point-to-point — then the digest holder is kill -9'd with
+      fetches in flight. Every requester must degrade to local
+      recompute SILENTLY: the client sees retries/typed refusals at
+      worst, never a hang, never an untyped error, and every
+      completed output stays token-identical to its solo reference.
+    - PUSH: a disagg fleet (1 prefill + 2 decode) riding the direct
+      push path; the reserved decode worker is kill -9'd while
+      pairings are live. The prefill worker's push fails, the router
+      books ``peer_degraded`` and falls back to the relay, and the
+      pairing ledger must balance EXACTLY:
+      ``peer_sends == peer_ok + peer_typed + peer_degraded``.
+
+    Returns the summary dict ``main`` prints; ``summary["ok"]`` is
+    the acceptance bar (0 hung / 0 untyped / 0 divergent in both
+    phases, a HEALTHY transfer proven before each kill, a DEGRADED
+    one after it, the pairing ledger balanced)."""
+    import numpy as np
+
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.networking import RetryPolicy
+    from distkeras_tpu.ops.quantization import quantize_model
+    from distkeras_tpu.predictors import CachedSequenceGenerator
+    from distkeras_tpu.serving import (
+        FleetRouter,
+        ServingClient,
+        ServingError,
+    )
+    from distkeras_tpu.serving.prefix_cache import key_hash
+    from distkeras_tpu.utils.serialization import (
+        load_serving_bundle,
+        save_serving_bundle,
+    )
+
+    workdir = tempfile.mkdtemp(prefix="soak_fabric_")
+    bundle = os.path.join(workdir, "lm_int8.dkt")
+    model = zoo.transformer_lm(
+        vocab_size=61, seq_len=32, d_model=32, num_heads=2, depth=2,
+        seed=0,
+    )
+    save_serving_bundle(bundle, quantize_model(model.copy()))
+    ref_gen = CachedSequenceGenerator(load_serving_bundle(bundle))
+
+    rng = np.random.default_rng(seed)
+    # TWO tenant families, each with its own 16-token shared header:
+    # family 0 carries the healthy-fetch half of the phase, family 1
+    # is held back until the instant after the kill — its pages exist
+    # ONLY on the victim, so every post-kill fetch attempt must dial
+    # the corpse and degrade to recompute
+    headers = [rng.integers(0, 61, 16).astype(np.int32) for _ in range(3)]
+    fam = [
+        [
+            np.concatenate(
+                [h, rng.integers(0, 61, k).astype(np.int32)]
+            )
+            for k in (1, 2, 3)
+        ]
+        for h in headers[:2]
+    ]
+    prompts = fam[0] + fam[1]
+    fam2_from = len(fam[0])
+    # rung-16 digest hash of family 1's header: identifies which
+    # replica's advertised digest holds its pages (the kill victim)
+    fam2_hash = key_hash(headers[1])
+    refs = [ref_gen.generate(p[None], steps=max_new)[0] for p in prompts]
+    # family 2 exists ONLY for the deterministic post-kill probe: no
+    # client ever sends it, so no survivor can have cached its header
+    # — a probe hint naming the corpse MUST be dialed (coverage 0),
+    # must fail typed, and must degrade to recompute
+    probe_prompt = np.concatenate(
+        [headers[2], rng.integers(0, 61, 1).astype(np.int32)]
+    )
+    probe_ref = ref_gen.generate(probe_prompt[None], steps=max_new)[0]
+
+    lock = threading.Lock()
+
+    def new_rec():
+        return {
+            "attempts": 0, "completed": 0, "typed_errors": {},
+            "untyped": 0, "untyped_samples": [], "divergent": 0,
+        }
+
+    def start_clients(router, rec, stop_evt, n, fam2_evt=None):
+        def loop(ci):
+            policy = RetryPolicy(
+                max_attempts=30, base_delay=0.01, max_delay=0.2,
+                budget=300.0, seed=seed * 1000 + ci,
+            )
+            crng = np.random.default_rng(seed * 100 + ci)
+            with ServingClient(
+                router.host, router.port, retry=policy
+            ) as c:
+                while not stop_evt.is_set():
+                    if fam2_evt is not None and fam2_evt.is_set():
+                        pi = fam2_from + int(
+                            crng.integers(0, len(prompts) - fam2_from)
+                        )
+                    else:
+                        pi = int(crng.integers(0, fam2_from))
+                    with lock:
+                        rec["attempts"] += 1
+                    try:
+                        out = c.generate(prompts[pi], max_new)
+                    except ServingError as e:
+                        code = getattr(e, "code", type(e).__name__)
+                        with lock:
+                            rec["typed_errors"][code] = (
+                                rec["typed_errors"].get(code, 0) + 1
+                            )
+                        continue
+                    except Exception as e:  # noqa: BLE001 — the finding
+                        with lock:
+                            rec["untyped"] += 1
+                            if len(rec["untyped_samples"]) < 5:
+                                rec["untyped_samples"].append(repr(e))
+                        continue
+                    with lock:
+                        if np.array_equal(out, refs[pi]):
+                            rec["completed"] += 1
+                        else:
+                            rec["divergent"] += 1
+
+        threads = [
+            threading.Thread(target=loop, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        return threads
+
+    def finish(threads, stop_evt):
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=120.0)
+        return sum(t.is_alive() for t in threads)
+
+    clean = 10 ** 9  # no injected step faults: the kill IS the chaos
+    traffic = 0.8 if smoke else 1.5
+
+    def scrape_peer(reps, rec):
+        """Sum the LIVE replicas' requester/server fabric counters
+        (the victim's book died with it)."""
+        peer = {}
+        for rep in reps:
+            if not rep.alive():
+                continue
+            try:
+                with ServingClient(rep.endpoint[0], rep.endpoint[1],
+                                   timeout=15, retry=False) as c:
+                    kf = c.health().get("kv_fabric") or {}
+                    for k, v in (kf.get("peer") or {}).items():
+                        peer[k] = peer.get(k, 0) + int(v)
+            except Exception as e:  # noqa: BLE001 — post-run scrape
+                rec["control_errors"].append(repr(e))
+        return peer
+
+    # ---- phase 1: kill the digest holder mid-kv.fetch -------------
+    fetch = new_rec()
+    fetch["control_errors"] = []
+    reps = []
+    router = None
+    stop_evt = threading.Event()
+    threads = []
+    try:
+        # 1-slot / 1-queue replicas: concurrent clients saturate the
+        # affinity home immediately, so spillover (and with it the
+        # peer-fetch path) is constant, not incidental
+        reps = [
+            SubprocessReplica(bundle, seed=seed + 10 + i,
+                              fault_every=clean, slots=1, queue_cap=1)
+            for i in range(2 if smoke else 3)
+        ]
+        router = FleetRouter(
+            endpoints=[r.endpoint for r in reps],
+            health_interval=0.1, eject_after=4,
+            connect_timeout=2.0, request_timeout=60.0,
+            retry_after_ms=10.0,
+        ).start()
+        for r in reps:
+            if not router.wait_in_rotation(r.endpoint):
+                raise RuntimeError(f"replica {r.endpoint} never joined")
+        # warm SEQUENTIALLY through the router: no concurrency means
+        # no spill, so each family's pages land ONLY on its affinity
+        # home (two passes — two-touch admission inserts on the
+        # second sighting). Retry-wrapped: 1-slot replicas can refuse
+        # a back-to-back request typed overloaded for a beat.
+        warm_policy = RetryPolicy(
+            max_attempts=30, base_delay=0.01, max_delay=0.2,
+            budget=300.0, seed=seed,
+        )
+        with ServingClient(router.host, router.port,
+                           retry=warm_policy) as c:
+            for _ in range(2):
+                for p in prompts:
+                    c.generate(p, max_new)
+            for pi, p in enumerate(prompts):
+                if not np.array_equal(
+                    c.generate(p, max_new), refs[pi]
+                ):
+                    raise RuntimeError("warm output diverged from solo")
+        # the kill victim: the replica whose OWN advertised digest
+        # holds family 1's header rung — after the kill, family 1's
+        # pages exist nowhere else, so every hinted fetch for them
+        # must dial the corpse and degrade
+        deadline = time.monotonic() + 60
+        victim = None
+        while victim is None and time.monotonic() < deadline:
+            for rep in reps:
+                with ServingClient(rep.endpoint[0], rep.endpoint[1],
+                                   timeout=15, retry=False) as c:
+                    dg = (
+                        (c.health().get("kv_fabric") or {})
+                        .get("digest") or {}
+                    )
+                if fam2_hash in (dg.get("h") or ()):
+                    victim = rep
+                    break
+            else:
+                time.sleep(0.05)
+        if victim is None:
+            raise RuntimeError(
+                "no replica's digest ever held family 1's pages"
+            )
+        # the router must have polled the holders' digests before the
+        # clients start, or early spills route blind (no hints)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(
+                (r.get("kv_fabric") or {}).get("digest_n")
+                for r in router.replicas()
+            ):
+                break
+            time.sleep(0.05)
+        fam2_evt = threading.Event()
+        threads = start_clients(router, fetch, stop_evt,
+                                3 if smoke else 4, fam2_evt=fam2_evt)
+        # hold until a HEALTHY peer fetch has landed (a spilled
+        # sibling pulled family 0's pages and validated the frame)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if scrape_peer(reps, fetch).get("fetch_ok", 0) >= 1:
+                break
+            time.sleep(0.05)
+        time.sleep(traffic / 2)  # fetch traffic in flight
+        # bank the victim's requester-side book before it dies: a
+        # spilled request can land ON the digest holder and pull the
+        # OTHER family's pages, so the phase's healthy fetch_ok may
+        # live in the victim's counters — merged into the final
+        # aggregate below, where the survivors-only scrape would
+        # otherwise undercount it
+        victim_peer = {}
+        try:
+            with ServingClient(victim.endpoint[0], victim.endpoint[1],
+                               timeout=15, retry=False) as c:
+                kf = c.health().get("kv_fabric") or {}
+                victim_peer = {
+                    k: int(v) for k, v in (kf.get("peer") or {}).items()
+                }
+        except Exception as e:  # noqa: BLE001 — best-effort bank: a
+            # failed scrape only matters if the healthy fetch lived
+            # on the victim, and then the fetch_ok gate fails anyway
+            fetch["victim_bank_error"] = repr(e)
+        victim.kill9()  # mid-fetch: the digest holder dies
+        fetch["victim"] = list(victim.endpoint)
+        # flip the load to family 1: its pages lived only on the
+        # corpse, and the router's hints keep naming it until the
+        # ejection clears the digest — attempts in that window fetch
+        # against the dead peer and must degrade silently
+        fam2_evt.set()
+        time.sleep(traffic)  # survivors degrade to recompute
+        fetch["hung"] = finish(threads, stop_evt)
+        # the DETERMINISTIC mid-fetch-kill probe, on the now-quiet
+        # fleet and independent of routing races: hand a survivor a
+        # hint naming the corpse (exactly what the router's books
+        # said moments ago) — the survivor must dial it, fail typed,
+        # degrade to recompute, and still answer token-identically
+        from distkeras_tpu.utils.serialization import (
+            deserialize_params,
+            serialize_params,
+        )
+
+        survivor = next(r for r in reps if r.alive())
+        with ServingClient(survivor.endpoint[0], survivor.endpoint[1],
+                           timeout=60, retry=False) as c:
+            deadline = time.monotonic() + 60
+            while True:
+                reply, body = c._roundtrip(
+                    {"verb": "generate",
+                     "max_new_tokens": int(max_new),
+                     "kv_peers": [{
+                         "endpoint": list(victim.endpoint),
+                         "epoch": 1, "len": 16,
+                     }]},
+                    serialize_params(probe_prompt),
+                    raise_on_error=False,
+                )
+                if reply.get("ok") or reply.get("error") not in (
+                    "overloaded", "unavailable"
+                ) or time.monotonic() > deadline:
+                    break
+                time.sleep(0.05)  # the last in-flight work drains
+        fetch["probe_identical"] = bool(reply.get("ok")) and (
+            np.array_equal(
+                np.asarray(deserialize_params(body)), probe_ref
+            )
+        )
+        rc = router.stats()
+        fetch["router"] = {
+            k: rc[k]
+            for k in ("affinity_routed", "spilled", "digest_routed",
+                      "failovers", "ejections")
+        }
+        fetch["peer"] = scrape_peer(reps, fetch)
+        for k, v in victim_peer.items():
+            fetch["peer"][k] = fetch["peer"].get(k, 0) + v
+    except Exception as e:  # noqa: BLE001 — surfaced in summary
+        fetch["control_errors"].append(repr(e))
+        fetch["hung"] = finish(threads, stop_evt)
+        fetch.setdefault("peer", {})
+    finally:
+        if router is not None:
+            router.shutdown()
+        for rep in reps:
+            if rep.alive():
+                rep.kill9()
+
+    # ---- phase 2: kill the reserved decode worker mid-push --------
+    push = new_rec()
+    push["control_errors"] = []
+    reps = []
+    router = None
+    stop_evt = threading.Event()
+    threads = []
+    try:
+        reps = [
+            SubprocessReplica(bundle, seed=seed + 20,
+                              fault_every=clean, role="prefill"),
+            SubprocessReplica(bundle, seed=seed + 21,
+                              fault_every=clean, role="decode"),
+            SubprocessReplica(bundle, seed=seed + 22,
+                              fault_every=clean, role="decode"),
+        ]
+        router = FleetRouter(
+            endpoints=[r.endpoint for r in reps],
+            health_interval=0.05, eject_after=2,
+            connect_timeout=2.0, request_timeout=60.0,
+            retry_after_ms=10.0,
+        ).start()
+        for r in reps:
+            if not router.wait_in_rotation(r.endpoint):
+                raise RuntimeError(f"replica {r.endpoint} never joined")
+        # warm sequentially until a HEALTHY direct push has landed
+        # (role replicas compile on first touch — a kill during the
+        # compile window would prove nothing about the push path)
+        with ServingClient(router.host, router.port) as c:
+            deadline = time.monotonic() + 240
+            while router.stats()["peer_ok"] < 1:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "no healthy direct push ever landed"
+                    )
+                if not np.array_equal(
+                    c.generate(prompts[0], max_new), refs[0]
+                ):
+                    raise RuntimeError("warm output diverged from solo")
+        threads = start_clients(router, push, stop_evt,
+                                3 if smoke else 4)
+        # wait for a LIVE pairing: the router reserves the decode
+        # worker for the pairing's duration, so a decode with
+        # in_flight > 0 is (or is about to be) a push target
+        deadline = time.monotonic() + 120
+        victim_ep = None
+        while time.monotonic() < deadline:
+            for r in router.replicas():
+                if r.get("role") == "decode" and r["in_flight"] > 0:
+                    victim_ep = tuple(r["endpoint"])
+                    break
+            if victim_ep is not None:
+                break
+            time.sleep(0.002)
+        if victim_ep is None:
+            raise RuntimeError("no decode pairing ever went live")
+        victim = next(r for r in reps if r.endpoint == victim_ep)
+        victim.kill9()  # mid-push: the prefill worker's peer dies
+        push["victim"] = list(victim_ep)
+        time.sleep(traffic)  # degraded pairings relay via the sibling
+        push["hung"] = finish(threads, stop_evt)
+        rc = router.stats()
+        push["router"] = {
+            k: rc[k]
+            for k in ("disagg_routed", "peer_sends", "peer_ok",
+                      "peer_typed", "peer_degraded", "transfer_sends",
+                      "transfer_ok", "transfer_typed", "failovers",
+                      "ejections")
+        }
+        push["pairing_balanced"] = (
+            rc["peer_sends"]
+            == rc["peer_ok"] + rc["peer_typed"] + rc["peer_degraded"]
+        )
+    except Exception as e:  # noqa: BLE001 — surfaced in summary
+        push["control_errors"].append(repr(e))
+        push["hung"] = finish(threads, stop_evt)
+        push.setdefault("pairing_balanced", False)
+        push.setdefault("router", {})
+    finally:
+        if router is not None:
+            router.shutdown()
+        for rep in reps:
+            if rep.alive():
+                rep.kill9()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    summary = {"fetch": fetch, "push": push}
+    summary["ok"] = (
+        fetch["hung"] == 0
+        and push["hung"] == 0
+        and fetch["untyped"] == 0
+        and push["untyped"] == 0
+        and fetch["divergent"] == 0
+        and push["divergent"] == 0
+        and fetch["completed"] > 0
+        and push["completed"] > 0
+        and not fetch["control_errors"]
+        and not push["control_errors"]
+        # a HEALTHY validated peer fetch landed before the kill...
+        and fetch["peer"].get("fetch_ok", 0) >= 1
+        # ...and after it, a hint naming the corpse degraded to
+        # recompute with the output still token-identical
+        and fetch["peer"].get("fetch_degraded", 0) >= 1
+        and fetch.get("probe_identical") is True
+        # a healthy direct push landed before the kill, at least one
+        # pairing degraded to the relay after it, and every pairing
+        # resolved exactly once (the ISSUE's invariant)
+        and push["router"].get("peer_sends", 0) >= 1
+        and push["router"].get("peer_ok", 0) >= 1
+        and push["router"].get("peer_degraded", 0) >= 1
+        and push["pairing_balanced"]
+    )
+    return summary
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--replicas", type=int, default=3)
@@ -711,11 +1186,20 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 scale: 2 replicas, 3 clients, short "
                          "pacing")
+    ap.add_argument("--fabric", action="store_true",
+                    help="run the KV-fabric tier instead: kill -9 the "
+                         "digest holder mid-kv.fetch and the reserved "
+                         "decode worker mid-push")
     # internal: run as one replica subprocess
     ap.add_argument("--replica", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--bundle", help=argparse.SUPPRESS)
     ap.add_argument("--net-delay", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--role", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--slots", type=int, default=4,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--queue-cap", type=int, default=8,
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
@@ -723,6 +1207,16 @@ def main(argv=None) -> int:
         return replica_main(args)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.fabric:
+        summary = run_fabric_soak(seed=args.seed, smoke=args.smoke)
+        json.dump(summary, sys.stdout, indent=2, default=str)
+        print()
+        if not summary["ok"]:
+            print("FABRIC SOAK FAILED: hung clients, untyped errors, "
+                  "divergent outputs, or an unbalanced pairing ledger "
+                  "(see summary above)", file=sys.stderr)
+            return 1
+        return 0
     summary = run_soak(
         replicas=args.replicas, clients=args.clients,
         duration=args.duration, seed=args.seed,
